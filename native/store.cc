@@ -1,0 +1,375 @@
+// Shared-memory object store arena (TPU-host analog of the reference's
+// plasma store: ray src/ray/object_manager/plasma/{store.cc,client.cc}).
+//
+// Design differences from the reference, chosen for the TPU host model:
+//  - The reference runs a store *server* inside the raylet and hands clients
+//    mmap'd fds over a unix socket (fling.cc).  Here every process on the
+//    host maps one named /dev/shm arena directly; allocation metadata lives
+//    *inside* the arena guarded by a robust process-shared mutex, so reads
+//    and writes are zero-RPC and zero-copy.  The node agent is only involved
+//    for cross-host transfer and eviction policy.
+//  - Allocator: first-fit free list with block coalescing (the reference
+//    vendors dlmalloc; a few hundred lines suffice at our block sizes since
+//    objects are large tensor buffers, not tiny allocations).
+//  - Object index: fixed-capacity open-addressing hash table keyed by the
+//    16-byte object id, with pin counts and an LRU tick for eviction
+//    (ray: plasma/eviction_policy.h LRU).
+//
+// Exposed as a C ABI consumed from Python via ctypes
+// (ray_tpu/_private/native_store.py).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cerrno>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7261795f74707531ULL;  // "ray_tpu1"
+constexpr uint32_t kIndexSlots = 1 << 16;           // 65536 objects max
+constexpr uint64_t kAlign = 64;                     // tensor-friendly
+
+struct IndexEntry {
+  uint8_t id[16];
+  uint64_t offset;   // data offset from arena base
+  uint64_t size;
+  uint32_t state;    // 0=free 1=creating 2=sealed 3=tombstone
+  uint32_t pins;
+  uint64_t lru_tick;
+};
+
+struct BlockHeader {
+  uint64_t size;      // payload size (excluding header)
+  uint64_t next_free; // offset of next free block (if free), 0 = none
+  uint32_t is_free;
+  uint32_t pad;
+};
+
+struct ArenaHeader {
+  uint64_t magic;
+  uint64_t capacity;       // bytes usable for blocks
+  uint64_t data_start;     // offset of first block
+  uint64_t free_head;      // offset of first free block, 0 = none
+  uint64_t used_bytes;
+  uint64_t lru_clock;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+  IndexEntry index[kIndexSlots];
+};
+
+struct Handle {
+  ArenaHeader* hdr;
+  uint8_t* base;           // mmap base
+  uint64_t mapped_size;
+  int fd;
+};
+
+uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+uint32_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 16; i++) { h ^= id[i]; h *= 1099511628211ULL; }
+  return static_cast<uint32_t>(h ^ (h >> 32));
+}
+
+class MutexGuard {
+ public:
+  explicit MutexGuard(pthread_mutex_t* m) : m_(m) {
+    int rc = pthread_mutex_lock(m_);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(m_);
+  }
+  ~MutexGuard() { pthread_mutex_unlock(m_); }
+ private:
+  pthread_mutex_t* m_;
+};
+
+// Find the index slot for id; returns nullptr if absent and !for_insert.
+IndexEntry* find_slot(ArenaHeader* hdr, const uint8_t* id, bool for_insert) {
+  uint32_t start = hash_id(id) & (kIndexSlots - 1);
+  IndexEntry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kIndexSlots; probe++) {
+    IndexEntry* e = &hdr->index[(start + probe) & (kIndexSlots - 1)];
+    if (e->state == 0) {
+      if (for_insert) return first_tomb ? first_tomb : e;
+      return nullptr;
+    }
+    if (e->state == 3) {
+      if (!first_tomb) first_tomb = e;
+      continue;
+    }
+    if (std::memcmp(e->id, id, 16) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+BlockHeader* block_at(Handle* h, uint64_t off) {
+  return reinterpret_cast<BlockHeader*>(h->base + off);
+}
+
+// First-fit allocation from the free list; returns data offset or 0.
+uint64_t alloc_block(Handle* h, uint64_t size) {
+  ArenaHeader* hdr = h->hdr;
+  uint64_t need = align_up(size);
+  uint64_t prev = 0;
+  uint64_t cur = hdr->free_head;
+  while (cur) {
+    BlockHeader* b = block_at(h, cur);
+    if (b->size >= need) {
+      uint64_t remaining = b->size - need;
+      if (remaining > sizeof(BlockHeader) + kAlign) {
+        // Split: tail becomes a new free block.
+        uint64_t tail_off = cur + sizeof(BlockHeader) + need;
+        BlockHeader* tail = block_at(h, tail_off);
+        tail->size = remaining - sizeof(BlockHeader);
+        tail->next_free = b->next_free;
+        tail->is_free = 1;
+        b->size = need;
+        if (prev) block_at(h, prev)->next_free = tail_off;
+        else hdr->free_head = tail_off;
+      } else {
+        if (prev) block_at(h, prev)->next_free = b->next_free;
+        else hdr->free_head = b->next_free;
+      }
+      b->is_free = 0;
+      b->next_free = 0;
+      hdr->used_bytes += b->size + sizeof(BlockHeader);
+      return cur + sizeof(BlockHeader);
+    }
+    prev = cur;
+    cur = b->next_free;
+  }
+  return 0;
+}
+
+void free_block(Handle* h, uint64_t data_off) {
+  ArenaHeader* hdr = h->hdr;
+  uint64_t off = data_off - sizeof(BlockHeader);
+  BlockHeader* b = block_at(h, off);
+  hdr->used_bytes -= b->size + sizeof(BlockHeader);
+  b->is_free = 1;
+  // Insert sorted by offset so adjacent free blocks can coalesce.
+  uint64_t prev = 0, cur = hdr->free_head;
+  while (cur && cur < off) { prev = cur; cur = block_at(h, cur)->next_free; }
+  b->next_free = cur;
+  if (prev) block_at(h, prev)->next_free = off;
+  else hdr->free_head = off;
+  // Coalesce with next.
+  if (cur && off + sizeof(BlockHeader) + b->size == cur) {
+    BlockHeader* n = block_at(h, cur);
+    b->size += sizeof(BlockHeader) + n->size;
+    b->next_free = n->next_free;
+  }
+  // Coalesce with prev.
+  if (prev) {
+    BlockHeader* p = block_at(h, prev);
+    if (prev + sizeof(BlockHeader) + p->size == off) {
+      p->size += sizeof(BlockHeader) + b->size;
+      p->next_free = b->next_free;
+    }
+  }
+}
+
+// Evict least-recently-used unpinned sealed objects until we can fit `size`.
+// Must hold the mutex.  Returns data offset or 0.
+uint64_t alloc_with_eviction(Handle* h, uint64_t size) {
+  uint64_t off = alloc_block(h, size);
+  while (off == 0) {
+    IndexEntry* victim = nullptr;
+    for (uint32_t i = 0; i < kIndexSlots; i++) {
+      IndexEntry* e = &h->hdr->index[i];
+      if (e->state == 2 && e->pins == 0 &&
+          (!victim || e->lru_tick < victim->lru_tick)) {
+        victim = e;
+      }
+    }
+    if (!victim) return 0;
+    free_block(h, victim->offset);
+    victim->state = 3;
+    h->hdr->num_objects--;
+    off = alloc_block(h, size);
+  }
+  return off;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (or open, if it already exists) the named arena.
+void* rt_store_create(const char* name, uint64_t capacity) {
+  bool created = false;
+  int fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd >= 0) {
+    created = true;
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+  }
+  uint64_t total = sizeof(ArenaHeader) + capacity;
+  if (created && ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd); shm_unlink(name); return nullptr;
+  }
+  if (!created) {
+    struct stat st;
+    if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+    total = static_cast<uint64_t>(st.st_size);
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Handle* h = new Handle;
+  h->base = static_cast<uint8_t*>(mem);
+  h->hdr = reinterpret_cast<ArenaHeader*>(mem);
+  h->mapped_size = total;
+  h->fd = fd;
+  if (created) {
+    ArenaHeader* hdr = h->hdr;
+    std::memset(hdr, 0, sizeof(ArenaHeader));
+    hdr->capacity = capacity;
+    hdr->data_start = align_up(sizeof(ArenaHeader));
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hdr->mutex, &attr);
+    pthread_mutexattr_destroy(&attr);
+    // One big free block covering the whole arena.
+    uint64_t first = hdr->data_start;
+    BlockHeader* b = reinterpret_cast<BlockHeader*>(h->base + first);
+    b->size = total - first - sizeof(BlockHeader);
+    b->next_free = 0;
+    b->is_free = 1;
+    hdr->free_head = first;
+    __sync_synchronize();
+    hdr->magic = kMagic;
+  } else {
+    // Wait for the creator to finish initializing.
+    for (int i = 0; i < 10000 && h->hdr->magic != kMagic; i++) usleep(100);
+    if (h->hdr->magic != kMagic) {
+      munmap(mem, total); close(fd); delete h; return nullptr;
+    }
+  }
+  return h;
+}
+
+void* rt_store_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Handle* h = new Handle;
+  h->base = static_cast<uint8_t*>(mem);
+  h->hdr = reinterpret_cast<ArenaHeader*>(mem);
+  h->mapped_size = static_cast<uint64_t>(st.st_size);
+  h->fd = fd;
+  if (h->hdr->magic != kMagic) {
+    munmap(mem, h->mapped_size); close(fd); delete h; return nullptr;
+  }
+  return h;
+}
+
+// Allocate space for an object; returns data offset or 0 on failure.
+// Object is left in "creating" state until rt_store_seal.
+uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* existing = find_slot(h->hdr, id, false);
+  if (existing && existing->state != 3) return 0;  // already present
+  uint64_t off = alloc_with_eviction(h, size);
+  if (off == 0) return 0;
+  IndexEntry* e = find_slot(h->hdr, id, true);
+  if (!e) { free_block(h, off); return 0; }
+  std::memcpy(e->id, id, 16);
+  e->offset = off;
+  e->size = size;
+  e->state = 1;
+  e->pins = 1;  // creator holds a pin until seal
+  e->lru_tick = ++h->hdr->lru_clock;
+  h->hdr->num_objects++;
+  return off;
+}
+
+int rt_store_seal(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (!e || e->state != 1) return -1;
+  e->state = 2;
+  if (e->pins > 0) e->pins--;
+  return 0;
+}
+
+// Look up a sealed object; pins it and returns offset/size. 1=found.
+int rt_store_get(void* hv, const uint8_t* id, uint64_t* offset,
+                 uint64_t* size) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (!e || e->state != 2) return 0;
+  e->pins++;
+  e->lru_tick = ++h->hdr->lru_clock;
+  *offset = e->offset;
+  *size = e->size;
+  return 1;
+}
+
+int rt_store_contains(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  return (e && e->state == 2) ? 1 : 0;
+}
+
+void rt_store_release(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (e && e->pins > 0) e->pins--;
+}
+
+int rt_store_delete(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (!e || e->state == 3) return 0;
+  if (e->pins > 0) return -1;  // pinned: caller retries later
+  free_block(h, e->offset);
+  e->state = 3;
+  h->hdr->num_objects--;
+  return 0;
+}
+
+void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
+                    uint64_t* num_objects) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  *used = h->hdr->used_bytes;
+  *capacity = h->hdr->capacity;
+  *num_objects = h->hdr->num_objects;
+}
+
+uint8_t* rt_store_base(void* hv) {
+  return static_cast<Handle*>(hv)->base;
+}
+
+void rt_store_close(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  munmap(h->base, h->mapped_size);
+  close(h->fd);
+  delete h;
+}
+
+int rt_store_unlink(const char* name) {
+  return shm_unlink(name);
+}
+
+}  // extern "C"

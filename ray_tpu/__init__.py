@@ -1,0 +1,27 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Provides the capability surface of the reference framework (tasks, actors,
+objects, placement groups, data/train/tune/serve/rl libraries) re-designed
+TPU-first: XLA collectives over ICI inside a slice, a zmq control/object
+plane over DCN between hosts, jax/pjit/Pallas for all device compute.
+"""
+from ray_tpu.api import (available_resources, cancel, cluster_resources, get,
+                         get_actor, init, is_initialized, kill, nodes, put,
+                         remote, shutdown, timeline, wait)
+from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
+                                ObjectLostError, RayTpuError,
+                                TaskCancelledError, TaskError,
+                                WorkerCrashedError)
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "timeline",
+    "available_resources", "cluster_resources", "get_runtime_context",
+    "ObjectRef", "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
+    "WorkerCrashedError", "__version__",
+]

@@ -1,0 +1,88 @@
+"""Central config table with env-var overrides.
+
+Analog of the reference's single config macro table
+(ray: src/ray/common/ray_config_def.h — 217 RAY_CONFIG entries, overridable
+via RAY_<name> env vars and a _system_config dict passed to init).  Here the
+table is a dataclass; every field can be overridden by `RAY_TPU_<NAME>` env
+vars or the `_system_config` dict passed to `ray_tpu.init`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store ---
+    # Objects <= this many bytes travel inline in RPC replies / the owner's
+    # in-process memory store (ray: max_direct_call_object_size, 100KB).
+    max_inline_object_size: int = 100 * 1024
+    # Default shared-memory arena bytes per node agent.
+    object_store_memory: int = 512 * 1024 * 1024
+    # Chunk size for node-to-node object transfer over DCN (ray uses 64MB
+    # gRPC chunks; zmq multipart makes smaller chunks cheap).
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # --- scheduling ---
+    # Hybrid policy: pack onto lower-index nodes until utilization crosses
+    # this threshold, then spread (ray: scheduler_spread_threshold=0.5).
+    scheduler_spread_threshold: float = 0.5
+    # Max task leases a submitter keeps per scheduling key
+    # (ray: max_pending_lease_requests_per_scheduling_category).
+    max_leases_per_scheduling_key: int = 8
+    # Idle seconds before a leased worker is returned to the pool.
+    lease_idle_timeout_s: float = 1.0
+    # Workers prestarted per node agent at boot.
+    prestart_workers: int = 2
+    # Hard cap on worker processes per node agent.
+    max_workers_per_node: int = 16
+    # --- health / fault tolerance ---
+    heartbeat_period_s: float = 0.5
+    # Missed-heartbeat budget before a node is declared dead
+    # (ray: num_heartbeats_timeout analog).
+    node_death_timeout_s: float = 5.0
+    actor_restart_backoff_s: float = 0.2
+    default_task_max_retries: int = 3
+    # --- memory ---
+    memory_monitor_period_s: float = 0.25
+    memory_usage_threshold: float = 0.95
+    # --- misc ---
+    task_event_buffer_size: int = 4096
+    log_dir: str = ""
+    temp_dir: str = "/tmp/ray_tpu"
+
+    def override(self, d: dict[str, Any] | None) -> "Config":
+        cfg = dataclasses.replace(self)
+        for f in dataclasses.fields(cfg):
+            env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+            if env is not None:
+                setattr(cfg, f.name, _coerce(f.type, env))
+        if d:
+            for k, v in d.items():
+                if not hasattr(cfg, k):
+                    raise ValueError(f"unknown system config key {k!r}")
+                setattr(cfg, k, v)
+        return cfg
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls(**json.loads(s))
+
+
+def _coerce(typ: Any, raw: str) -> Any:
+    t = str(typ)
+    if "int" in t:
+        return int(raw)
+    if "float" in t:
+        return float(raw)
+    if "bool" in t:
+        return raw.lower() in ("1", "true", "yes")
+    return raw
+
+
+DEFAULT = Config()

@@ -1,0 +1,559 @@
+"""Cluster controller: the head-node control plane.
+
+TPU-native analog of the reference's GCS server
+(ray: src/ray/gcs/gcs_server/gcs_server.h:78).  Owns:
+  - node membership + health (ray: GcsNodeManager, GcsHealthCheckManager)
+  - actor directory + restart policy (ray: GcsActorManager gcs_actor_manager.cc:311)
+  - actor scheduling (ray: GcsActorScheduler gcs_actor_scheduler.cc:49)
+  - cluster resource view, periodically published to node agents — the
+    push-based analog of the ray_syncer resource gossip
+    (ray: src/ray/common/ray_syncer/ray_syncer.h:88)
+  - KV store for function/class exports and named entities
+    (ray: GcsKvManager / GcsFunctionManager)
+  - placement groups (ray: GcsPlacementGroupManager)
+  - pub/sub of node/actor events (ray: gcs_pub_sub.h)
+
+Single asyncio process; all state in memory (the reference's default
+StorageType::IN_MEMORY).  A snapshot/restore hook provides the
+Redis-persistence analog for controller fault tolerance.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import zmq.asyncio
+
+from ray_tpu._private import scheduler as sched
+from ray_tpu._private.config import Config
+from ray_tpu._private.rpc import ClientPool, Publisher, RpcServer
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (ray: rpc::ActorTableData states).
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    agent_addr: str
+    resources: dict[str, float]
+    available: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    state: str = "ALIVE"
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # load: queued-task resource demand reported by the agent, used by the
+    # hybrid policy's utilization term.
+    load: int = 0
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: str | None
+    namespace: str
+    owner_addr: str
+    creation_spec: list[bytes]          # serialized creation task frames
+    creation_header: dict
+    resources: dict[str, float]
+    max_restarts: int
+    state: str = PENDING
+    address: str | None = None          # worker rpc address once ALIVE
+    node_id: str | None = None
+    restarts_used: int = 0
+    death_cause: str | None = None
+    waiters: list[asyncio.Future] = field(default_factory=list)
+    detached: bool = False
+    pg_id: str | None = None
+    bundle_index: int = -1
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: str
+    name: str | None
+    strategy: str
+    bundles: list[dict[str, float]]
+    state: str = "PENDING"               # PENDING | CREATED | REMOVED
+    # bundle index -> node_id
+    bundle_nodes: dict[int, str] = field(default_factory=dict)
+    waiters: list[asyncio.Future] = field(default_factory=list)
+
+
+class Controller:
+    def __init__(self, config: Config, host: str = "127.0.0.1"):
+        self.config = config
+        self.ctx = zmq.asyncio.Context.instance()
+        self.server = RpcServer(self.ctx, host)
+        self.publisher = Publisher(self.ctx, host)
+        self.clients = ClientPool(self.ctx)
+        self.nodes: dict[str, NodeInfo] = {}
+        self.actors: dict[str, ActorInfo] = {}
+        self.named_actors: dict[tuple[str, str], str] = {}
+        self.pgs: dict[str, PlacementGroupInfo] = {}
+        self.kv: dict[str, dict[str, bytes]] = {}
+        self.jobs: dict[str, dict] = {}
+        self._tasks_events: list[dict] = []
+        self._bg: list[asyncio.Task] = []
+
+    # ---------------------------------------------------------------- setup
+    async def start(self) -> None:
+        self.server.register_all(self)
+        self.server.start()
+        loop = asyncio.get_running_loop()
+        self._bg.append(loop.create_task(self._health_loop()))
+        self._bg.append(loop.create_task(self._resource_broadcast_loop()))
+        logger.info("controller up at %s (pub %s)",
+                    self.server.address, self.publisher.address)
+
+    def close(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        self.server.close()
+        self.publisher.close()
+        self.clients.close()
+
+    # ------------------------------------------------------------ node mgmt
+    async def rpc_register_node(self, h: dict, _b: list) -> dict:
+        node = NodeInfo(
+            node_id=h["node_id"], agent_addr=h["agent_addr"],
+            resources=dict(h["resources"]), available=dict(h["resources"]),
+            labels=h.get("labels", {}),
+        )
+        self.nodes[node.node_id] = node
+        await self.publisher.publish(
+            "node", {"event": "alive", "node_id": node.node_id,
+                     "agent_addr": node.agent_addr})
+        logger.info("node %s registered: %s", node.node_id[:8], node.resources)
+        return {"config": self.config.to_json(),
+                "pub_addr": self.publisher.address}
+
+    async def rpc_heartbeat(self, h: dict, _b: list) -> dict:
+        node = self.nodes.get(h["node_id"])
+        if node is None or node.state != "ALIVE":
+            return {"ok": False}          # stale node: tell it to re-register
+        node.last_heartbeat = time.monotonic()
+        node.available = dict(h["available"])
+        node.load = h.get("load", 0)
+        return {"ok": True}
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.heartbeat_period_s)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if (node.state == "ALIVE"
+                        and now - node.last_heartbeat
+                        > self.config.node_death_timeout_s):
+                    await self._on_node_dead(node)
+
+    async def _on_node_dead(self, node: NodeInfo) -> None:
+        node.state = "DEAD"
+        logger.warning("node %s declared dead", node.node_id[:8])
+        await self.publisher.publish(
+            "node", {"event": "dead", "node_id": node.node_id,
+                     "agent_addr": node.agent_addr})
+        # Release PG bundles on the dead node.
+        for pg in self.pgs.values():
+            if pg.state == "CREATED" and node.node_id in pg.bundle_nodes.values():
+                pg.state = "PENDING"
+                pg.bundle_nodes = {i: n for i, n in pg.bundle_nodes.items()
+                                   if n != node.node_id}
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        # Restart or fail actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state == ALIVE:
+                await self._on_actor_dead(actor, f"node {node.node_id[:8]} died")
+
+    # ----------------------------------------------------------- resources
+    def _cluster_view(self) -> dict:
+        return {
+            n.node_id: {
+                "agent_addr": n.agent_addr,
+                "total": n.resources,
+                "available": n.available,
+                "load": n.load,
+                "labels": n.labels,
+            }
+            for n in self.nodes.values() if n.state == "ALIVE"
+        }
+
+    async def _resource_broadcast_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.heartbeat_period_s)
+            if self.nodes:
+                await self.publisher.publish(
+                    "resources", {"view": self._cluster_view()})
+
+    async def rpc_get_cluster_view(self, h: dict, _b: list) -> dict:
+        return {"view": self._cluster_view()}
+
+    # ------------------------------------------------------------------ KV
+    async def rpc_kv_put(self, h: dict, b: list) -> dict:
+        ns = self.kv.setdefault(h.get("ns", ""), {})
+        existed = h["key"] in ns
+        if not (h.get("no_overwrite") and existed):
+            ns[h["key"]] = b[0] if b else b""
+        return {"existed": existed}
+
+    async def rpc_kv_get(self, h: dict, _b: list) -> tuple[dict, list]:
+        ns = self.kv.get(h.get("ns", ""), {})
+        val = ns.get(h["key"])
+        return {"found": val is not None}, ([val] if val is not None else [])
+
+    async def rpc_kv_del(self, h: dict, _b: list) -> dict:
+        ns = self.kv.get(h.get("ns", ""), {})
+        return {"deleted": ns.pop(h["key"], None) is not None}
+
+    async def rpc_kv_keys(self, h: dict, _b: list) -> dict:
+        ns = self.kv.get(h.get("ns", ""), {})
+        prefix = h.get("prefix", "")
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        """Register + schedule an actor (ray: HandleRegisterActor/HandleCreateActor
+        gcs_actor_manager.cc:311,335)."""
+        name = h.get("name")
+        namespace = h.get("namespace", "default")
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != DEAD:
+                    if h.get("get_if_exists"):
+                        return {"actor_id": existing.actor_id, "existing": True}
+                    return {"error": f"actor name {name!r} already taken"}
+        actor = ActorInfo(
+            actor_id=h["actor_id"], name=name, namespace=namespace,
+            owner_addr=h["owner_addr"], creation_spec=list(blobs),
+            creation_header=h["creation_header"],
+            resources=h.get("resources", {}), max_restarts=h.get("max_restarts", 0),
+            detached=h.get("detached", False),
+            pg_id=h.get("pg_id"), bundle_index=h.get("bundle_index", -1),
+        )
+        self.actors[actor.actor_id] = actor
+        if name:
+            self.named_actors[(namespace, name)] = actor.actor_id
+        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        return {"actor_id": actor.actor_id}
+
+    async def _schedule_actor(self, actor: ActorInfo) -> None:
+        """Pick a node and ask its agent to start the actor
+        (ray: GcsActorScheduler::Schedule, ScheduleByGcs gcs_actor_scheduler.cc:60)."""
+        delay = self.config.actor_restart_backoff_s
+        while actor.state in (PENDING, RESTARTING):
+            view = self._cluster_view()
+            strategy = None
+            if actor.pg_id:
+                pg = self.pgs.get(actor.pg_id)
+                if pg is None or pg.state != "CREATED":
+                    await asyncio.sleep(delay)
+                    continue
+                # Constrain to the node holding the requested bundle.
+                idx = actor.bundle_index if actor.bundle_index >= 0 else 0
+                node_id = pg.bundle_nodes.get(idx)
+                strategy = sched.NodeAffinity(node_id, soft=False)
+            node_id = sched.pick_node(view, actor.resources, self.config,
+                                      strategy=strategy)
+            if node_id is None:
+                await asyncio.sleep(delay)   # infeasible now; retry
+                continue
+            node = self.nodes[node_id]
+            try:
+                reply, _ = await self.clients.get(node.agent_addr).call(
+                    "create_actor",
+                    {"actor_id": actor.actor_id,
+                     "creation_header": actor.creation_header,
+                     "resources": actor.resources,
+                     "owner_addr": actor.owner_addr},
+                    actor.creation_spec, timeout=60.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("actor %s placement on %s failed: %s",
+                               actor.actor_id[:8], node_id[:8], e)
+                await asyncio.sleep(delay)
+                continue
+            if reply.get("ok"):
+                actor.state = ALIVE
+                actor.address = reply["worker_addr"]
+                actor.node_id = node_id
+                for fut in actor.waiters:
+                    if not fut.done():
+                        fut.set_result(None)
+                actor.waiters.clear()
+                await self.publisher.publish(
+                    "actor", {"event": "alive", "actor_id": actor.actor_id,
+                              "address": actor.address})
+                return
+            if reply.get("error"):
+                await self._fail_actor(actor, reply["error"])
+                return
+            await asyncio.sleep(delay)
+
+    async def _fail_actor(self, actor: ActorInfo, cause: str) -> None:
+        actor.state = DEAD
+        actor.death_cause = cause
+        for fut in actor.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        actor.waiters.clear()
+        await self.publisher.publish(
+            "actor", {"event": "dead", "actor_id": actor.actor_id,
+                      "cause": cause})
+
+    async def _on_actor_dead(self, actor: ActorInfo, cause: str) -> None:
+        """Restart if budget remains (ray: GcsActorManager::OnWorkerDead
+        gcs_actor_manager.cc:991)."""
+        if actor.state == DEAD:
+            return
+        unlimited = actor.max_restarts < 0
+        if unlimited or actor.restarts_used < actor.max_restarts:
+            actor.restarts_used += 1
+            actor.state = RESTARTING
+            actor.address = None
+            actor.node_id = None
+            await self.publisher.publish(
+                "actor", {"event": "restarting", "actor_id": actor.actor_id})
+            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        else:
+            await self._fail_actor(actor, cause)
+
+    async def rpc_report_actor_death(self, h: dict, _b: list) -> dict:
+        actor = self.actors.get(h["actor_id"])
+        if actor:
+            if h.get("no_restart"):
+                actor.max_restarts = 0
+            await self._on_actor_dead(actor, h.get("cause", "worker died"))
+        return {}
+
+    async def rpc_get_actor_info(self, h: dict, _b: list) -> dict:
+        """Resolve an actor to an address; long-polls until ALIVE or DEAD."""
+        actor = self.actors.get(h["actor_id"])
+        if actor is None:
+            return {"state": "UNKNOWN"}
+        if h.get("wait") and actor.state in (PENDING, RESTARTING):
+            fut = asyncio.get_running_loop().create_future()
+            actor.waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=h.get("timeout", 60.0))
+            except asyncio.TimeoutError:
+                pass
+        return {"state": actor.state, "address": actor.address,
+                "node_id": actor.node_id, "cause": actor.death_cause}
+
+    async def rpc_get_actor_by_name(self, h: dict, _b: list) -> dict:
+        actor_id = self.named_actors.get(
+            (h.get("namespace", "default"), h["name"]))
+        if actor_id is None:
+            return {"found": False}
+        return {"found": True, "actor_id": actor_id}
+
+    async def rpc_remove_actor(self, h: dict, _b: list) -> dict:
+        """ray_tpu.kill() / handle GC: tear the actor down, no restart."""
+        actor = self.actors.get(h["actor_id"])
+        if actor is None:
+            return {}
+        actor.max_restarts = 0
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None and node.state == "ALIVE":
+            try:
+                await self.clients.get(node.agent_addr).call(
+                    "destroy_actor", {"actor_id": actor.actor_id},
+                    timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+        await self._fail_actor(actor, h.get("cause", "killed via ray_tpu.kill"))
+        return {}
+
+    # ----------------------------------------------------- placement groups
+    async def rpc_create_pg(self, h: dict, _b: list) -> dict:
+        pg = PlacementGroupInfo(
+            pg_id=h["pg_id"], name=h.get("name"), strategy=h["strategy"],
+            bundles=[dict(b) for b in h["bundles"]])
+        self.pgs[pg.pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"pg_id": pg.pg_id}
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        """Reserve bundles on agents per strategy (ray: GcsPlacementGroupScheduler
+        gcs_placement_group_scheduler.h:274; bundle policies
+        policy/bundle_scheduling_policy.h:31)."""
+        while pg.state == "PENDING":
+            view = self._cluster_view()
+            pending = [i for i in range(len(pg.bundles))
+                       if i not in pg.bundle_nodes]
+            placement = sched.place_bundles(
+                view, [pg.bundles[i] for i in pending], pg.strategy, self.config)
+            if placement is None:
+                await asyncio.sleep(self.config.heartbeat_period_s)
+                continue
+            ok = True
+            reserved: list[tuple[int, str]] = []
+            for idx, node_id in zip(pending, placement):
+                node = self.nodes[node_id]
+                try:
+                    reply, _ = await self.clients.get(node.agent_addr).call(
+                        "reserve_bundle",
+                        {"pg_id": pg.pg_id, "bundle_index": idx,
+                         "resources": pg.bundles[idx]}, timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    reply = {"ok": False}
+                if reply.get("ok"):
+                    reserved.append((idx, node_id))
+                else:
+                    ok = False
+                    break
+            if ok:
+                for idx, node_id in reserved:
+                    pg.bundle_nodes[idx] = node_id
+                if len(pg.bundle_nodes) == len(pg.bundles):
+                    pg.state = "CREATED"
+                    for fut in pg.waiters:
+                        if not fut.done():
+                            fut.set_result(None)
+                    pg.waiters.clear()
+                    await self.publisher.publish(
+                        "pg", {"event": "created", "pg_id": pg.pg_id})
+                    return
+            else:
+                # Roll back partial reservations and retry (STRICT semantics).
+                for idx, node_id in reserved:
+                    node = self.nodes.get(node_id)
+                    if node:
+                        try:
+                            await self.clients.get(node.agent_addr).call(
+                                "release_bundle",
+                                {"pg_id": pg.pg_id, "bundle_index": idx},
+                                timeout=10.0)
+                        except Exception:  # noqa: BLE001
+                            pass
+                await asyncio.sleep(self.config.heartbeat_period_s)
+
+    async def rpc_pg_ready(self, h: dict, _b: list) -> dict:
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None:
+            return {"state": "UNKNOWN"}
+        if pg.state == "PENDING" and h.get("wait"):
+            fut = asyncio.get_running_loop().create_future()
+            pg.waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=h.get("timeout", 60.0))
+            except asyncio.TimeoutError:
+                pass
+        return {"state": pg.state,
+                "bundle_nodes": {str(k): v for k, v in pg.bundle_nodes.items()}}
+
+    async def rpc_remove_pg(self, h: dict, _b: list) -> dict:
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None:
+            return {}
+        pg.state = "REMOVED"
+        for idx, node_id in pg.bundle_nodes.items():
+            node = self.nodes.get(node_id)
+            if node and node.state == "ALIVE":
+                try:
+                    await self.clients.get(node.agent_addr).call(
+                        "release_bundle",
+                        {"pg_id": pg.pg_id, "bundle_index": idx}, timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+        pg.bundle_nodes.clear()
+        return {}
+
+    # ------------------------------------------------------------ state API
+    async def rpc_list_nodes(self, h: dict, _b: list) -> dict:
+        return {"nodes": [
+            {"node_id": n.node_id, "state": n.state, "agent_addr": n.agent_addr,
+             "resources": n.resources, "available": n.available}
+            for n in self.nodes.values()]}
+
+    async def rpc_list_actors(self, h: dict, _b: list) -> dict:
+        return {"actors": [
+            {"actor_id": a.actor_id, "name": a.name, "state": a.state,
+             "node_id": a.node_id, "address": a.address,
+             "restarts": a.restarts_used}
+            for a in self.actors.values()]}
+
+    async def rpc_list_pgs(self, h: dict, _b: list) -> dict:
+        return {"pgs": [
+            {"pg_id": p.pg_id, "name": p.name, "state": p.state,
+             "strategy": p.strategy, "bundles": p.bundles}
+            for p in self.pgs.values()]}
+
+    async def rpc_push_task_events(self, h: dict, _b: list) -> dict:
+        """Task state-transition events for the timeline
+        (ray: GcsTaskManager gcs_task_manager.h:86)."""
+        self._tasks_events.extend(h.get("events", []))
+        cap = self.config.task_event_buffer_size * 16
+        if len(self._tasks_events) > cap:
+            self._tasks_events = self._tasks_events[-cap:]
+        return {}
+
+    async def rpc_get_task_events(self, h: dict, _b: list) -> dict:
+        return {"events": self._tasks_events[-h.get("limit", 10000):]}
+
+    async def rpc_register_job(self, h: dict, _b: list) -> dict:
+        self.jobs[h["job_id"]] = {"state": "RUNNING", "start": time.time(),
+                                  "driver_addr": h.get("driver_addr")}
+        return {}
+
+    async def rpc_ping(self, h: dict, _b: list) -> dict:
+        return {"pong": True, "t": time.time(),
+                "pub_addr": self.publisher.address}
+
+
+async def run_controller(config: Config, ready_cb=None) -> None:
+    c = Controller(config)
+    await c.start()
+    if ready_cb:
+        ready_cb(c)
+    await asyncio.Event().wait()
+
+
+def _watch_parent() -> None:
+    import os
+    import threading
+
+    def _loop():
+        while True:
+            if os.getppid() <= 1:
+                os._exit(0)
+            time.sleep(1.0)
+
+    threading.Thread(target=_loop, daemon=True, name="parent-watch").start()
+
+
+def main() -> None:
+    import argparse
+    import json as _json
+    import sys
+
+    _watch_parent()
+    p = argparse.ArgumentParser()
+    p.add_argument("--config-json", default="{}")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s controller: %(message)s")
+    config = Config().override(_json.loads(args.config_json))
+
+    async def _run():
+        c = Controller(config)
+        await c.start()
+        # Hand the chosen addresses back to the parent over stdout.
+        print(_json.dumps({"controller_addr": c.server.address,
+                           "pub_addr": c.publisher.address}), flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

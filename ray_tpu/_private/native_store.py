@@ -1,0 +1,226 @@
+"""ctypes binding to the native shared-memory object store (native/store.cc).
+
+Plasma-client analog (ray: src/ray/object_manager/plasma/client.cc): every
+process on a host maps the same /dev/shm arena; sealed objects are read
+zero-copy as memoryviews whose lifetime pins the object against eviction
+(the reference's client hold/release protocol).
+
+Objects are stored as a frame bundle:
+    [u32 nframes][u64 len_0 .. len_{n-1}] then each frame 64-byte aligned.
+Frame 0 is the pickle stream; frames 1.. are out-of-band buffers, so a numpy
+array deserialized from the arena aliases arena memory directly.
+"""
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import logging
+import os
+import struct
+import subprocess
+import weakref
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "build",
+                                        "libraytpustore.so"))
+_CC_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "store.cc"))
+
+_lib = None
+
+
+def _build_lib() -> None:
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    lock_path = _SO_PATH + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if (os.path.exists(_SO_PATH)
+                and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_CC_PATH)):
+            return
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", _SO_PATH + ".tmp", _CC_PATH, "-lpthread", "-lrt"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(_SO_PATH + ".tmp", _SO_PATH)
+
+
+def load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if (not os.path.exists(_SO_PATH)
+            or os.path.getmtime(_SO_PATH) < os.path.getmtime(_CC_PATH)):
+        _build_lib()
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.rt_store_create.restype = ctypes.c_void_p
+    lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rt_store_open.restype = ctypes.c_void_p
+    lib.rt_store_open.argtypes = [ctypes.c_char_p]
+    lib.rt_store_alloc.restype = ctypes.c_uint64
+    lib.rt_store_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+    lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_get.restype = ctypes.c_int
+    lib.rt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_store_contains.restype = ctypes.c_int
+    lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_delete.restype = ctypes.c_int
+    lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_stats.argtypes = [ctypes.c_void_p] + \
+        [ctypes.POINTER(ctypes.c_uint64)] * 3
+    lib.rt_store_base.restype = ctypes.c_void_p
+    lib.rt_store_base.argtypes = [ctypes.c_void_p]
+    lib.rt_store_close.argtypes = [ctypes.c_void_p]
+    lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def _align64(n: int) -> int:
+    return (n + 63) & ~63
+
+
+def _bundle_layout(frame_lens: list[int]) -> tuple[int, list[int]]:
+    """Return (total size, per-frame offsets) for a frame bundle."""
+    header = 4 + 8 * len(frame_lens)
+    offsets = []
+    pos = _align64(header)
+    for ln in frame_lens:
+        offsets.append(pos)
+        pos = _align64(pos + ln)
+    return pos, offsets
+
+
+class Arena:
+    """One mapped shared-memory arena (create on agents, open on workers)."""
+
+    def __init__(self, name: str, capacity: int | None = None,
+                 create: bool = False):
+        self.lib = load_lib()
+        self.name = name
+        if create:
+            self.handle = self.lib.rt_store_create(
+                name.encode(), ctypes.c_uint64(capacity or 0))
+        else:
+            self.handle = self.lib.rt_store_open(name.encode())
+        if not self.handle:
+            raise OSError(f"cannot map shm arena {name!r}")
+        self.base = self.lib.rt_store_base(self.handle)
+        self._created = create
+
+    # ---- write path ----
+    def put_frames(self, oid: bytes, frames: list) -> bool:
+        lens = [len(f) for f in frames]
+        total, offsets = _bundle_layout(lens)
+        off = self.lib.rt_store_alloc(self.handle, oid,
+                                      ctypes.c_uint64(total))
+        if off == 0:
+            return False
+        addr = self.base + off
+        hdr = struct.pack("<I", len(frames)) + struct.pack(
+            f"<{len(lens)}Q", *lens)
+        ctypes.memmove(addr, hdr, len(hdr))
+        for f, fo in zip(frames, offsets):
+            if len(f):
+                src = f if isinstance(f, (bytes, bytearray)) else bytes(f)
+                ctypes.memmove(addr + fo, src, len(src))
+        self.lib.rt_store_seal(self.handle, oid)
+        return True
+
+    # ---- read path ----
+    def get_frames(self, oid: bytes) -> list | None:
+        """Zero-copy read: returned memoryviews pin the object until GC'd."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_get(self.handle, oid,
+                                     ctypes.byref(off), ctypes.byref(size)):
+            return None
+        addr = self.base + off.value
+        buf = (ctypes.c_ubyte * size.value).from_address(addr)
+        # The pin is released when the last view of `buf` is collected.
+        weakref.finalize(buf, self.lib.rt_store_release, self.handle, oid)
+        mv = memoryview(buf)
+        (nframes,) = struct.unpack_from("<I", mv, 0)
+        lens = struct.unpack_from(f"<{nframes}Q", mv, 4)
+        _, offsets = _bundle_layout(list(lens))
+        return [mv[fo:fo + ln] for fo, ln in zip(offsets, lens)]
+
+    def contains(self, oid: bytes) -> bool:
+        return bool(self.lib.rt_store_contains(self.handle, oid))
+
+    def delete(self, oid: bytes) -> None:
+        self.lib.rt_store_delete(self.handle, oid)
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self.lib.rt_store_stats(self.handle, ctypes.byref(used),
+                                ctypes.byref(cap), ctypes.byref(num))
+        return {"used": used.value, "capacity": cap.value,
+                "num_objects": num.value}
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.rt_store_close(self.handle)
+            if self._created:
+                self.lib.rt_store_unlink(self.name.encode())
+            self.handle = None
+
+
+def _cleanup_stale_arenas() -> None:
+    """Unlink arenas whose owning agent (pid suffix) is gone — crash-killed
+    agents can't unlink their own /dev/shm segment."""
+    try:
+        for f in os.listdir("/dev/shm"):
+            if not f.startswith("raytpu_"):
+                continue
+            try:
+                pid = int(f.rsplit("_", 1)[-1])
+            except ValueError:
+                continue
+            if not os.path.exists(f"/proc/{pid}"):
+                try:
+                    os.unlink(os.path.join("/dev/shm", f))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+class NativeStoreBackend:
+    """Agent-side node-store backend over the native arena (drop-in for
+    object_store._DictBackend)."""
+
+    def __init__(self, node_id: str, capacity: int):
+        _cleanup_stale_arenas()
+        self._name = f"/raytpu_{node_id[:16]}_{os.getpid()}"
+        self.arena = Arena(self._name, capacity, create=True)
+
+    @property
+    def shm_name(self) -> str:
+        return self._name
+
+    def put(self, oid: bytes, frames: list) -> bool:
+        return self.arena.put_frames(oid, frames)
+
+    def get(self, oid: bytes):
+        return self.arena.get_frames(oid)
+
+    def contains(self, oid: bytes) -> bool:
+        return self.arena.contains(oid)
+
+    def delete(self, oid: bytes) -> None:
+        self.arena.delete(oid)
+
+    def pin(self, oid: bytes, delta: int) -> None:
+        pass  # pinning is per-reader via get_frames views
+
+    def stats(self) -> dict:
+        return self.arena.stats()
+
+    def close(self) -> None:
+        self.arena.close()

@@ -1,0 +1,573 @@
+"""Per-host node agent: local scheduler + worker pool.
+
+TPU-native analog of the reference's raylet
+(ray: src/ray/raylet/node_manager.h:119).  Owns:
+  - the worker-process pool (ray: WorkerPool worker_pool.h:159) — forks
+    Python workers, prestarts, reuses them across leases
+  - lease-based local task scheduling with spillback to other nodes using
+    the controller-synced cluster view (ray: ClusterTaskManager
+    cluster_task_manager.cc:44, LocalTaskManager::Spillback
+    local_task_manager.cc:674)
+  - placement-group bundle reservation (ray: PlacementGroupResourceManager)
+  - actor placement on behalf of the controller
+  - worker-death detection and fan-out (ray: worker_pool.cc process monitor)
+
+TPU adaptation: a chip is exclusively held by one process, so every lease
+whose demand includes "TPU" resolves to this host's singleton *device
+worker* — one process owning all local chips, hosting many actors/tasks as
+in-process executors.  This is the "one runtime per host" model the
+reference never needed for GPUs but TPU requires (SURVEY §7 hard parts).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+import zmq.asyncio
+
+from ray_tpu._private import scheduler as sched
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import ClientPool, RpcServer, Subscriber
+
+logger = logging.getLogger(__name__)
+
+
+def detect_resources() -> dict[str, float]:
+    """Best-effort host resource detection (ray: python/ray/_private/
+    accelerators/tpu.py detects chips via env + metadata)."""
+    res: dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
+    tpu = os.environ.get("RAY_TPU_CHIPS")
+    if tpu is not None:
+        n = float(tpu)
+    else:
+        n = float(len([d for d in os.listdir("/dev")
+                       if d.startswith("accel")])) if os.path.isdir("/dev") else 0.0
+        if n == 0 and os.environ.get("TPU_NAME"):
+            n = 1.0
+    if n > 0:
+        res["TPU"] = n
+    try:
+        import psutil
+
+        res["memory"] = float(psutil.virtual_memory().total)
+    except Exception:  # noqa: BLE001
+        pass
+    return res
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    proc: subprocess.Popen | None
+    addr: str | None = None
+    state: str = "starting"        # starting | idle | leased | actor | dead
+    lease_id: str | None = None
+    submitter: str | None = None   # rpc addr of current lease holder
+    is_device_worker: bool = False
+    actor_ids: set[str] = field(default_factory=set)
+    # actor_id -> lease header whose resources it holds
+    actor_leases: dict = field(default_factory=dict)
+    started_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class PendingLease:
+    header: dict
+    fut: asyncio.Future
+
+
+class NodeAgent:
+    def __init__(self, config: Config, controller_addr: str,
+                 resources: dict[str, float] | None = None,
+                 host: str = "127.0.0.1",
+                 node_id: str | None = None,
+                 env: dict[str, str] | None = None):
+        self.config = config
+        self.controller_addr = controller_addr
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.host = host
+        self.resources = dict(resources) if resources else detect_resources()
+        self.available = dict(self.resources)
+        self.ctx = zmq.asyncio.Context.instance()
+        self.server = RpcServer(self.ctx, host)
+        self.clients = ClientPool(self.ctx)
+        self.workers: dict[str, WorkerHandle] = {}
+        self._worker_env = dict(env or {})
+        self._starting: dict[str, asyncio.Future] = {}
+        self._pending: list[PendingLease] = []
+        self._lease_seq = itertools.count()
+        self.cluster_view: sched.View = {}
+        # lease_id -> (worker_id, lease header) for task leases
+        self._leases: dict[str, tuple[str, dict]] = {}
+        # pg_id:bundle_index -> {"resources": ..., "available": ...}
+        self.bundles: dict[str, dict] = {}
+        self._bg: list[asyncio.Task] = []
+        self._device_worker_id: str | None = None
+        self._closed = False
+        self.store = None  # shared-memory store runner, attached in start()
+
+    # ---------------------------------------------------------------- setup
+    async def start(self) -> None:
+        self.server.register_all(self)
+        self.server.start()
+        from ray_tpu._private.object_store import StoreRunner
+
+        self.store = StoreRunner(self.node_id, self.config)
+        self.store.register_handlers(self.server, self.clients)
+        reply, _ = await self.clients.get(self.controller_addr).call(
+            "register_node",
+            {"node_id": self.node_id, "agent_addr": self.server.address,
+             "resources": self.resources}, timeout=30.0)
+        self.pub_addr = reply["pub_addr"]
+        self.subscriber = Subscriber(self.ctx, self.pub_addr)
+        self.subscriber.subscribe("resources", self._on_resource_view)
+        self.subscriber.subscribe("node", self._on_node_event)
+        loop = asyncio.get_running_loop()
+        self._bg.append(loop.create_task(self._heartbeat_loop()))
+        self._bg.append(loop.create_task(self._reaper_loop()))
+        for _ in range(self.config.prestart_workers):
+            self._spawn_worker()
+        logger.info("agent %s up at %s resources=%s",
+                    self.node_id[:8], self.server.address, self.resources)
+
+    def close(self) -> None:
+        self._closed = True
+        for t in self._bg:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc and w.proc.poll() is None:
+                w.proc.terminate()
+        if self.store:
+            self.store.close()
+        self.server.close()
+        self.clients.close()
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._closed:
+            try:
+                reply, _ = await self.clients.get(self.controller_addr).call(
+                    "heartbeat",
+                    {"node_id": self.node_id, "available": self.available,
+                     "load": len(self._pending)},
+                    timeout=self.config.node_death_timeout_s)
+                if not reply.get("ok"):
+                    await self.clients.get(self.controller_addr).call(
+                        "register_node",
+                        {"node_id": self.node_id,
+                         "agent_addr": self.server.address,
+                         "resources": self.resources}, timeout=30.0)
+            except Exception:  # noqa: BLE001
+                pass
+            await asyncio.sleep(self.config.heartbeat_period_s)
+
+    async def _on_resource_view(self, _topic: str, payload: dict) -> None:
+        self.cluster_view = payload["view"]
+
+    async def _on_node_event(self, _topic: str, payload: dict) -> None:
+        if payload.get("event") == "dead":
+            self.cluster_view.pop(payload["node_id"], None)
+
+    # ---------------------------------------------------------- worker pool
+    def _spawn_worker(self, device_worker: bool = False) -> WorkerHandle:
+        from ray_tpu._private.ids import WorkerID
+
+        worker_id = WorkerID.from_random().hex()
+        env = {**os.environ, **self._worker_env,
+               "RAY_TPU_WORKER_ID": worker_id,
+               "RAY_TPU_NODE_ID": self.node_id,
+               "RAY_TPU_AGENT_ADDR": self.server.address,
+               "RAY_TPU_CONTROLLER_ADDR": self.controller_addr,
+               "RAY_TPU_PUB_ADDR": self.pub_addr,
+               "RAY_TPU_STORE_NAME": self.store.shm_name if self.store else "",
+               "RAY_TPU_IS_DEVICE_WORKER": "1" if device_worker else "0"}
+        if not device_worker:
+            # Plain workers must never grab the TPU chip
+            # (ray analog: CUDA_VISIBLE_DEVICES isolation in worker_pool).
+            env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+            if not os.environ.get("RAY_TPU_WORKER_LOGS") else None)
+        handle = WorkerHandle(worker_id=worker_id, proc=proc,
+                              is_device_worker=device_worker)
+        self.workers[worker_id] = handle
+        self._starting[worker_id] = asyncio.get_running_loop().create_future()
+        return handle
+
+    async def rpc_register_worker(self, h: dict, _b: list) -> dict:
+        w = self.workers.get(h["worker_id"])
+        if w is None:
+            return {"ok": False}
+        w.addr = h["addr"]
+        if w.state == "starting":
+            w.state = "idle"
+        fut = self._starting.pop(h["worker_id"], None)
+        if fut and not fut.done():
+            fut.set_result(w)
+        self._try_grant_pending()
+        return {"ok": True}
+
+    async def _get_idle_worker(self) -> WorkerHandle | None:
+        for w in self.workers.values():
+            if w.state == "idle" and not w.is_device_worker:
+                return w
+        n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
+        if n_alive >= self.config.max_workers_per_node:
+            return None
+        w = self._spawn_worker()
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(self._starting.get(w.worker_id, asyncio.sleep(0))),
+                timeout=60.0)
+        except asyncio.TimeoutError:
+            return None
+        return w if w.state == "idle" else None
+
+    async def _get_device_worker(self) -> WorkerHandle | None:
+        """The singleton process owning this host's TPU chips."""
+        if self._device_worker_id:
+            w = self.workers.get(self._device_worker_id)
+            if w and w.state != "dead":
+                if w.state == "starting":
+                    fut = self._starting.get(w.worker_id)
+                    if fut:
+                        await asyncio.wait_for(asyncio.shield(fut), timeout=120.0)
+                return w
+        w = self._spawn_worker(device_worker=True)
+        self._device_worker_id = w.worker_id
+        fut = self._starting.get(w.worker_id)
+        if fut:
+            try:
+                await asyncio.wait_for(asyncio.shield(fut), timeout=120.0)
+            except asyncio.TimeoutError:
+                return None
+        return w if w.state != "dead" else None
+
+    async def _reaper_loop(self) -> None:
+        """Detect dead worker processes; fail leases/actors accordingly."""
+        while not self._closed:
+            await asyncio.sleep(0.2)
+            for w in list(self.workers.values()):
+                if w.state != "dead" and w.proc and w.proc.poll() is not None:
+                    await self._on_worker_dead(w)
+
+    async def _on_worker_dead(self, w: WorkerHandle) -> None:
+        prev_state = w.state
+        w.state = "dead"
+        fut = self._starting.pop(w.worker_id, None)
+        if fut and not fut.done():
+            fut.set_result(w)
+        if w.worker_id == self._device_worker_id:
+            self._device_worker_id = None
+        if w.lease_id:
+            self._release_lease_resources(w)
+        for lease_h in w.actor_leases.values():
+            self._release(lease_h)
+        w.actor_leases.clear()
+        for actor_id in list(w.actor_ids):
+            try:
+                await self.clients.get(self.controller_addr).call(
+                    "report_actor_death",
+                    {"actor_id": actor_id,
+                     "cause": f"worker process {w.worker_id[:8]} exited "
+                              f"(code {w.proc.returncode if w.proc else '?'})"},
+                    timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+        if prev_state == "leased" and w.submitter:
+            try:
+                await self.clients.get(w.submitter).notify(
+                    "worker_died", {"worker_addr": w.addr,
+                                    "lease_id": w.lease_id})
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers.pop(w.worker_id, None)
+        self._try_grant_pending()
+
+    # -------------------------------------------------------------- leasing
+    def _pool_for(self, h: dict) -> dict[str, float]:
+        key = h.get("bundle_key")
+        if key:
+            b = self.bundles.get(key)
+            if b is None:
+                raise ValueError(f"unknown pg bundle {key}")
+            return b["available"]
+        return self.available
+
+    def _resources_fit(self, h: dict) -> bool:
+        demand = h.get("resources", {})
+        try:
+            pool = self._pool_for(h)
+        except ValueError:
+            return False
+        return sched.available(pool, demand)
+
+    def _acquire(self, h: dict) -> None:
+        pool = self._pool_for(h)
+        for k, v in h.get("resources", {}).items():
+            pool[k] = pool.get(k, 0.0) - v
+
+    def _release(self, h: dict) -> None:
+        key = h.get("bundle_key")
+        pool = self.bundles[key]["available"] if key in self.bundles \
+            else self.available
+        for k, v in h.get("resources", {}).items():
+            pool[k] = pool.get(k, 0.0) + v
+
+    def _release_lease_resources(self, w: WorkerHandle) -> None:
+        if w.lease_id:
+            entry = self._leases.pop(w.lease_id, None)
+            if entry:
+                self._release(entry[1])
+        w.lease_id = None
+        w.submitter = None
+
+    async def rpc_request_lease(self, h: dict, _b: list) -> dict:
+        """Grant a worker lease, queue, or point at a better node
+        (ray: NodeManager::HandleRequestWorkerLease node_manager.cc:1794)."""
+        demand = h.get("resources", {})
+        if not h.get("bundle_key") and not sched.feasible(self.resources, demand):
+            # Infeasible here: spill to any feasible node (ray: Spillback).
+            view = {nid: v for nid, v in self.cluster_view.items()
+                    if nid != self.node_id}
+            target = sched.pick_node(view, demand, self.config)
+            if target is not None:
+                return {"spill_to": self.cluster_view[target]["agent_addr"]}
+            return {"unfeasible": True}
+        if self._resources_fit(h):
+            return await self._grant(h)
+        # Consider spillback when another node could run it right now
+        # (pack-then-spread keeps locality by preferring the local node).
+        view = {nid: v for nid, v in self.cluster_view.items()
+                if nid != self.node_id}
+        if not h.get("bundle_key"):
+            target = sched.pick_node(view, demand, self.config)
+            if target is not None and h.get("allow_spill", True):
+                return {"spill_to": self.cluster_view[target]["agent_addr"]}
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append(PendingLease(h, fut))
+        return await fut
+
+    async def _grant(self, h: dict) -> dict:
+        # Check + reserve resources BEFORE any await so concurrent lease
+        # requests cannot double-book the same capacity while a worker spawns.
+        if not self._resources_fit(h):
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append(PendingLease(h, fut))
+            return await fut
+        self._acquire(h)
+        try:
+            if h.get("resources", {}).get("TPU", 0) > 0 or h.get("device_worker"):
+                w = await self._get_device_worker()
+            else:
+                w = await self._get_idle_worker()
+        except Exception:
+            self._release(h)
+            raise
+        if w is None or w.addr is None:
+            self._release(h)
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append(PendingLease(h, fut))
+            return await fut
+        lease_id = f"{self.node_id[:8]}-{next(self._lease_seq)}"
+        if not w.is_device_worker:
+            w.state = "leased"
+        w.lease_id = lease_id
+        w.submitter = h.get("submitter")
+        self._leases[lease_id] = (w.worker_id, h)
+        return {"granted": True, "worker_addr": w.addr, "lease_id": lease_id,
+                "worker_id": w.worker_id, "node_id": self.node_id}
+
+    async def rpc_return_lease(self, h: dict, _b: list) -> dict:
+        entry = self._leases.pop(h["lease_id"], None)
+        if entry:
+            worker_id, header = entry
+            self._release(header)
+            w = self.workers.get(worker_id)
+            if w is not None:
+                w.lease_id = None
+                w.submitter = None
+                if not w.is_device_worker and w.state == "leased":
+                    w.state = "idle"
+        self._try_grant_pending()
+        return {}
+
+    def _try_grant_pending(self) -> None:
+        if not self._pending:
+            return
+        still: list[PendingLease] = []
+        for p in self._pending:
+            if not p.fut.done() and self._resources_fit(p.header):
+                asyncio.get_running_loop().create_task(
+                    self._grant_pending(p))
+            elif not p.fut.done():
+                still.append(p)
+        self._pending = still
+
+    async def _grant_pending(self, p: PendingLease) -> None:
+        try:
+            reply = await self._grant(p.header)
+        except Exception as e:  # noqa: BLE001
+            if not p.fut.done():
+                p.fut.set_exception(e)
+            return
+        if not p.fut.done():
+            p.fut.set_result(reply)
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        """Place an actor into a worker process (controller-initiated)."""
+        demand = dict(h.get("resources", {}))
+        lease_h = {"resources": demand, "submitter": None,
+                   "bundle_key": h.get("creation_header", {}).get("bundle_key")}
+        if not lease_h["bundle_key"] and not sched.feasible(self.resources, demand):
+            return {"ok": False, "error": "infeasible"}
+        if not self._resources_fit(lease_h):
+            return {"ok": False}
+        if demand.get("TPU", 0) > 0:
+            w = await self._get_device_worker()
+        else:
+            w = await self._get_idle_worker()
+        if w is None or w.addr is None:
+            return {"ok": False}
+        self._acquire(lease_h)
+        if not w.is_device_worker:
+            w.state = "actor"
+        w.actor_ids.add(h["actor_id"])
+        w.actor_leases[h["actor_id"]] = lease_h
+        try:
+            reply, _ = await self.clients.get(w.addr).call(
+                "create_actor",
+                {**h["creation_header"], "actor_id": h["actor_id"],
+                 "owner_addr": h["owner_addr"]},
+                blobs, timeout=300.0)
+        except Exception as e:  # noqa: BLE001
+            self._release(lease_h)
+            w.actor_ids.discard(h["actor_id"])
+            w.actor_leases.pop(h["actor_id"], None)
+            return {"ok": False, "error": None, "detail": str(e)}
+        if reply.get("error"):
+            self._release(lease_h)
+            w.actor_ids.discard(h["actor_id"])
+            w.actor_leases.pop(h["actor_id"], None)
+            if not w.is_device_worker:
+                w.state = "idle"
+            self._try_grant_pending()
+            return {"ok": False, "error": reply["error"]}
+        return {"ok": True, "worker_addr": w.addr, "worker_id": w.worker_id}
+
+    async def rpc_destroy_actor(self, h: dict, _b: list) -> dict:
+        """Tear down one hosted actor and free its resources.  Dedicated
+        workers exit (process isolation, like ray); the shared device worker
+        only drops the actor instance — other TPU actors keep running."""
+        actor_id = h["actor_id"]
+        for w in self.workers.values():
+            if actor_id in w.actor_ids:
+                w.actor_ids.discard(actor_id)
+                lease_h = w.actor_leases.pop(actor_id, None)
+                if lease_h:
+                    self._release(lease_h)
+                if w.addr:
+                    try:
+                        if w.is_device_worker:
+                            await self.clients.get(w.addr).notify(
+                                "kill_actor_local", {"actor_id": actor_id})
+                        else:
+                            await self.clients.get(w.addr).notify(
+                                "exit_worker", {"reason": "actor killed",
+                                                "hard": True})
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._try_grant_pending()
+                return {"found": True}
+        return {"found": False}
+
+    # ---------------------------------------------------- placement bundles
+    async def rpc_reserve_bundle(self, h: dict, _b: list) -> dict:
+        key = f"{h['pg_id']}:{h['bundle_index']}"
+        if key in self.bundles:
+            return {"ok": True}
+        demand = h["resources"]
+        if not sched.available(self.available, demand):
+            return {"ok": False}
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        self.bundles[key] = {"resources": dict(demand),
+                             "available": dict(demand)}
+        return {"ok": True}
+
+    async def rpc_release_bundle(self, h: dict, _b: list) -> dict:
+        key = f"{h['pg_id']}:{h['bundle_index']}"
+        b = self.bundles.pop(key, None)
+        if b:
+            for k, v in b["resources"].items():
+                self.available[k] = self.available.get(k, 0.0) + v
+        self._try_grant_pending()
+        return {}
+
+    async def rpc_ping(self, h: dict, _b: list) -> dict:
+        return {"node_id": self.node_id}
+
+
+def _watch_parent() -> None:
+    """Exit when our parent dies (reparented to init), so killed drivers /
+    test runners never leak agent or worker trees."""
+    import threading
+
+    def _loop():
+        while True:
+            if os.getppid() <= 1:
+                os._exit(0)
+            time.sleep(1.0)
+
+    threading.Thread(target=_loop, daemon=True, name="parent-watch").start()
+
+
+def main() -> None:
+    import argparse
+    import json as _json
+    import signal
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--controller", required=True)
+    p.add_argument("--config-json", default="{}")
+    p.add_argument("--resources-json", default="")
+    p.add_argument("--node-id", default="")
+    args = p.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s agent: %(message)s")
+    config = Config().override(_json.loads(args.config_json))
+    resources = _json.loads(args.resources_json) if args.resources_json else None
+
+    _watch_parent()
+
+    async def _run():
+        agent = NodeAgent(config, args.controller, resources=resources,
+                          node_id=args.node_id or None)
+        await agent.start()
+
+        def _term(*_a):
+            agent.close()
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, _term)
+        print(_json.dumps({"agent_addr": agent.server.address,
+                           "node_id": agent.node_id}), flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

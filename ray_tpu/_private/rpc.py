@@ -1,0 +1,286 @@
+"""Async RPC + pub/sub over ZeroMQ.
+
+Analog of the reference's gRPC layer (ray: src/ray/rpc/grpc_server.h,
+client_call.h) and pub/sub (ray: src/ray/pubsub/publisher.h).  On TPU pods
+this is the DCN control/data plane between hosts; intra-slice tensor traffic
+never touches it (that is XLA collectives over ICI).
+
+Wire format (multipart frames):
+  request:  [msgid(8B LE), method(utf8), header(msgpack), *blobs]
+  reply:    [msgid(8B LE), status(b"ok"|b"err"), header(msgpack)|pickled exc, *blobs]
+msgid == 0 marks a one-way notification (no reply is sent).
+
+ROUTER on the server, one DEALER per peer on the client; replies are matched
+to futures by msgid.  All sockets live on a single asyncio loop per process;
+the driver runs that loop on a background thread (see worker.py).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import struct
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+import zmq
+import zmq.asyncio
+
+logger = logging.getLogger(__name__)
+
+Blobs = list[bytes]
+Handler = Callable[[dict, Blobs], Awaitable[tuple[dict, Blobs] | dict | None]]
+
+_ONEWAY = (0).to_bytes(8, "little")
+
+
+def pack_header(h: dict) -> bytes:
+    return msgpack.packb(h, use_bin_type=True)
+
+
+def unpack_header(b: bytes) -> dict:
+    return msgpack.unpackb(b, raw=False)
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """Raised client-side when the remote handler threw; carries the cause."""
+
+    def __init__(self, method: str, cause: BaseException | str):
+        super().__init__(f"remote call {method!r} failed: {cause!r}")
+        self.cause = cause
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RpcServer:
+    """ROUTER-socket server dispatching to registered async handlers."""
+
+    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1"):
+        self._ctx = ctx
+        self._sock = ctx.socket(zmq.ROUTER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
+        port = self._sock.bind_to_random_port(f"tcp://{host}")
+        self.address = f"{host}:{port}"
+        self._handlers: dict[str, Handler] = {}
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def register_all(self, obj: Any, prefix: str = "rpc_") -> None:
+        """Register every `rpc_<name>` coroutine method of obj as <name>."""
+        for attr in dir(obj):
+            if attr.startswith(prefix):
+                self.register(attr[len(prefix):], getattr(obj, attr))
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._serve())
+
+    async def _serve(self) -> None:
+        while not self._closed:
+            try:
+                frames = await self._sock.recv_multipart(copy=False)
+            except (asyncio.CancelledError, zmq.ZMQError):
+                return
+            asyncio.get_running_loop().create_task(self._dispatch(frames))
+
+    async def _dispatch(self, frames) -> None:
+        identity = frames[0].bytes
+        msgid = frames[1].bytes
+        method = frames[2].bytes.decode()
+        try:
+            header = unpack_header(frames[3].bytes) if len(frames) > 3 else {}
+            blobs = [f.bytes for f in frames[4:]]
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no handler for {method!r}")
+            result = await handler(header, blobs)
+            if msgid == _ONEWAY:
+                return
+            if result is None:
+                rh, rb = {}, []
+            elif isinstance(result, tuple):
+                rh, rb = result
+            else:
+                rh, rb = result, []
+            await self._sock.send_multipart(
+                [identity, msgid, b"ok", pack_header(rh), *rb], copy=False
+            )
+        except Exception as e:  # noqa: BLE001 - errors cross the wire
+            if msgid == _ONEWAY:
+                logger.exception("one-way handler %s failed", method)
+                return
+            tb = traceback.format_exc()
+            try:
+                payload = pickle.dumps((e, tb))
+            except Exception:
+                payload = pickle.dumps((RpcError(str(e)), tb))
+            try:
+                await self._sock.send_multipart([identity, msgid, b"err", payload])
+            except zmq.ZMQError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task:
+            self._task.cancel()
+        self._sock.close(0)
+
+
+class RpcClient:
+    """One DEALER connection to a peer; call() returns (header, blobs)."""
+
+    def __init__(self, ctx: zmq.asyncio.Context, address: str):
+        self.address = address
+        self._sock = ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(f"tcp://{address}")
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 1
+        self._task = asyncio.get_running_loop().create_task(self._recv_loop())
+        self._closed = False
+
+    async def _recv_loop(self) -> None:
+        while not self._closed:
+            try:
+                frames = await self._sock.recv_multipart(copy=False)
+            except (asyncio.CancelledError, zmq.ZMQError):
+                break
+            msgid = int.from_bytes(frames[0].bytes, "little")
+            fut = self._pending.pop(msgid, None)
+            if fut is None or fut.done():
+                continue
+            status = frames[1].bytes
+            if status == b"ok":
+                header = unpack_header(frames[2].bytes) if len(frames) > 2 else {}
+                fut.set_result((header, [f.bytes for f in frames[3:]]))
+            else:
+                exc, tb = pickle.loads(frames[2].bytes)
+                fut.set_exception(RemoteError(getattr(fut, "_method", "?"), exc))
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(self.address))
+        self._pending.clear()
+
+    async def call(
+        self,
+        method: str,
+        header: dict | None = None,
+        blobs: Blobs | None = None,
+        timeout: float | None = None,
+    ) -> tuple[dict, Blobs]:
+        if self._closed:
+            raise ConnectionLost(self.address)
+        msgid = self._next_id
+        self._next_id += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut._method = method
+        self._pending[msgid] = fut
+        await self._sock.send_multipart(
+            [msgid.to_bytes(8, "little"), method.encode(),
+             pack_header(header or {}), *(blobs or [])],
+            copy=False,
+        )
+        if timeout is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msgid, None)
+
+    async def notify(self, method: str, header: dict | None = None,
+                     blobs: Blobs | None = None) -> None:
+        await self._sock.send_multipart(
+            [_ONEWAY, method.encode(), pack_header(header or {}), *(blobs or [])],
+            copy=False,
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        self._task.cancel()
+        self._sock.close(0)
+
+
+class ClientPool:
+    """Lazily-created RpcClient per peer address (ray: rpc client pools)."""
+
+    def __init__(self, ctx: zmq.asyncio.Context):
+        self._ctx = ctx
+        self._clients: dict[str, RpcClient] = {}
+
+    def get(self, address: str) -> RpcClient:
+        cli = self._clients.get(address)
+        if cli is None or cli._closed:
+            cli = RpcClient(self._ctx, address)
+            self._clients[address] = cli
+        return cli
+
+    def drop(self, address: str) -> None:
+        cli = self._clients.pop(address, None)
+        if cli:
+            cli.close()
+
+    def close(self) -> None:
+        for cli in self._clients.values():
+            cli.close()
+        self._clients.clear()
+
+
+class Publisher:
+    """PUB socket; topics are utf8 prefixes (ray: pubsub publisher)."""
+
+    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1"):
+        self._sock = ctx.socket(zmq.PUB)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        port = self._sock.bind_to_random_port(f"tcp://{host}")
+        self.address = f"{host}:{port}"
+
+    async def publish(self, topic: str, payload: dict) -> None:
+        await self._sock.send_multipart([topic.encode(), pack_header(payload)])
+
+    def close(self) -> None:
+        self._sock.close(0)
+
+
+class Subscriber:
+    """SUB socket with per-topic-prefix async callbacks."""
+
+    def __init__(self, ctx: zmq.asyncio.Context, address: str):
+        self._sock = ctx.socket(zmq.SUB)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(f"tcp://{address}")
+        self._callbacks: list[tuple[str, Callable[[str, dict], Awaitable[None]]]] = []
+        self._task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    def subscribe(self, prefix: str,
+                  callback: Callable[[str, dict], Awaitable[None]]) -> None:
+        self._sock.setsockopt(zmq.SUBSCRIBE, prefix.encode())
+        self._callbacks.append((prefix, callback))
+
+    async def _recv_loop(self) -> None:
+        while True:
+            try:
+                topic_b, payload_b = await self._sock.recv_multipart()
+            except (asyncio.CancelledError, zmq.ZMQError):
+                return
+            topic = topic_b.decode()
+            payload = unpack_header(payload_b)
+            for prefix, cb in self._callbacks:
+                if topic.startswith(prefix):
+                    try:
+                        await cb(topic, payload)
+                    except Exception:
+                        logger.exception("subscriber callback failed for %s", topic)
+
+    def close(self) -> None:
+        self._task.cancel()
+        self._sock.close(0)

@@ -1,0 +1,145 @@
+"""Cluster-level scheduling policies.
+
+Analog of the reference's scheduling policy suite
+(ray: src/ray/raylet/scheduling/policy/): hybrid pack-then-spread default
+(hybrid_scheduling_policy.h:50), spread, node-affinity, and the bundle
+placement policies PACK / SPREAD / STRICT_PACK / STRICT_SPREAD
+(bundle_scheduling_policy.h:31,82,90,98,106).
+
+Used in two places, exactly like the reference's two-level scheduler:
+  - the controller places actors and placement-group bundles cluster-wide;
+  - each node agent consults its synced cluster view to spill tasks it
+    cannot run locally (ray: LocalTaskManager::Spillback).
+
+TPU note: STRICT_PACK is the slice-coherent placement primitive — a bundle
+set packed onto one host shares that host's ICI domain, which is why gang
+scheduling of per-host train workers uses it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# view: {node_id: {"agent_addr", "total", "available", "load", "labels"}}
+View = dict[str, dict]
+
+
+@dataclass
+class NodeAffinity:
+    node_id: str | None
+    soft: bool = False
+
+
+@dataclass
+class Spread:
+    pass
+
+
+def feasible(total: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(total.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def available(avail: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in demand.items() if v > 0)
+
+
+def _utilization(node: dict) -> float:
+    total, avail = node["total"], node["available"]
+    utils = [1.0 - avail.get(k, 0.0) / t for k, t in total.items() if t > 0]
+    return max(utils) if utils else 0.0
+
+
+def pick_node(view: View, demand: dict[str, float], config,
+              strategy=None) -> str | None:
+    """Pick the best node for one resource demand; None if nothing fits now.
+
+    Default hybrid policy (ray: hybrid_scheduling_policy.h:50): prefer the
+    lowest-id node whose utilization stays under the spread threshold (pack);
+    once every candidate is above it, prefer the least utilized (spread).
+    """
+    if isinstance(strategy, NodeAffinity) and strategy.node_id is not None:
+        node = view.get(strategy.node_id)
+        if node and feasible(node["total"], demand) \
+                and available(node["available"], demand):
+            return strategy.node_id
+        if not strategy.soft:
+            return None
+        # soft affinity: fall through to hybrid over remaining nodes
+
+    candidates = [
+        (nid, n) for nid, n in sorted(view.items())
+        if feasible(n["total"], demand) and available(n["available"], demand)
+    ]
+    if not candidates:
+        return None
+    if isinstance(strategy, Spread):
+        return min(candidates, key=lambda kv: (_utilization(kv[1]), kv[0]))[0]
+    threshold = config.scheduler_spread_threshold
+    for nid, n in candidates:
+        if _utilization(n) <= threshold:
+            return nid
+    return min(candidates, key=lambda kv: (_utilization(kv[1]), kv[0]))[0]
+
+
+def _sub(avail: dict[str, float], demand: dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def place_bundles(view: View, bundles: list[dict[str, float]], strategy: str,
+                  config) -> list[str] | None:
+    """Map each bundle to a node id, or None if the set cannot be placed.
+
+    Placement is computed against a scratch copy of availability so one
+    node's capacity is not double-booked within the request
+    (ray: bundle_scheduling_policy.cc scorer pattern).
+    """
+    scratch = {nid: dict(n["available"]) for nid, n in view.items()}
+    totals = {nid: n["total"] for nid, n in view.items()}
+    order = sorted(scratch)
+
+    def fits(nid: str, b: dict[str, float]) -> bool:
+        return feasible(totals[nid], b) and available(scratch[nid], b)
+
+    if strategy == "STRICT_PACK":
+        merged: dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                merged[k] = merged.get(k, 0.0) + v
+        for nid in order:
+            if fits(nid, merged):
+                return [nid] * len(bundles)
+        return None
+
+    if strategy == "STRICT_SPREAD":
+        placement: list[str] = []
+        used: set[str] = set()
+        for b in bundles:
+            found = None
+            for nid in order:
+                if nid not in used and fits(nid, b):
+                    found = nid
+                    break
+            if found is None:
+                return None
+            used.add(found)
+            _sub(scratch[found], b)
+            placement.append(found)
+        return placement
+
+    if strategy in ("PACK", "SPREAD"):
+        placement = []
+        for b in bundles:
+            cands = [nid for nid in order if fits(nid, b)]
+            if not cands:
+                return None
+            if strategy == "PACK":
+                # Prefer nodes already used by this pg, then lowest id.
+                cands.sort(key=lambda nid: (nid not in placement, nid))
+            else:
+                cands.sort(key=lambda nid: (placement.count(nid), nid))
+            nid = cands[0]
+            _sub(scratch[nid], b)
+            placement.append(nid)
+        return placement
+
+    raise ValueError(f"unknown placement strategy {strategy!r}")

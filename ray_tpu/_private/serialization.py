@@ -1,0 +1,96 @@
+"""Value serialization for tasks, actors, and objects.
+
+Analog of the reference's SerializationContext
+(ray: python/ray/_private/serialization.py:114): cloudpickle for code +
+pickle protocol 5 out-of-band buffers so large numpy/jax host arrays are
+carried as raw frames (zero-copy into/out of the shared-memory store) rather
+than being copied into the pickle stream.
+
+ObjectRefs embedded in values are hooked at (de)serialization time so the
+owner can track borrowers, mirroring the reference's reducer hooks for
+ObjectRef (ray: python/ray/_private/serialization.py _object_ref_reducer).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable
+
+import cloudpickle
+
+
+class SerializedValue:
+    """A pickled value plus its out-of-band buffers.
+
+    frames[0] is the pickle stream; frames[1:] are raw PickleBuffer payloads.
+    """
+
+    __slots__ = ("frames", "contained_refs")
+
+    def __init__(self, frames: list[bytes], contained_refs: list):
+        self.frames = frames
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(len(f) for f in self.frames)
+
+    def to_payload(self) -> list[bytes]:
+        return self.frames
+
+
+# Thread-local capture of ObjectRefs encountered while pickling a value.
+_capture = threading.local()
+
+
+def _note_ref(ref) -> None:
+    lst = getattr(_capture, "refs", None)
+    if lst is not None:
+        lst.append(ref)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+
+    def persistent_id(self, obj):  # noqa: D401 - hook, not docstring target
+        return None
+
+    def reducer_override(self, obj):
+        from ray_tpu.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            _note_ref(obj)
+            return (ObjectRef._from_serialized, (obj.binary(), obj.owner_addr))
+        return super().reducer_override(obj)
+
+
+def serialize(value: Any) -> SerializedValue:
+    import io
+
+    buffers: list[pickle.PickleBuffer] = []
+    _capture.refs = []
+    try:
+        sink = io.BytesIO()
+        _Pickler(sink, buffers.append).dump(value)
+        frames = [sink.getvalue()]
+        for b in buffers:
+            frames.append(b.raw().tobytes() if not isinstance(b.raw(), bytes) else b.raw())
+        return SerializedValue(frames, list(_capture.refs))
+    finally:
+        _capture.refs = None
+
+
+def deserialize(frames: list[bytes | memoryview]) -> Any:
+    bufs = [pickle.PickleBuffer(f) for f in frames[1:]]
+    return pickle.loads(frames[0], buffers=bufs)
+
+
+def dumps_function(fn: Callable) -> bytes:
+    """Pickle a remote function/actor class for export to the controller KV
+    (ray: python/ray/_private/function_manager.py:195 export)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(b: bytes) -> Callable:
+    return cloudpickle.loads(b)

@@ -1,0 +1,1168 @@
+"""CoreWorker: per-process runtime linked into drivers and workers.
+
+Analog of the reference's core_worker library
+(ray: src/ray/core_worker/core_worker.h:295 + python/ray/_raylet.pyx:3309).
+One instance per process, in one of two modes:
+  - "driver": created by ray_tpu.init(); submits tasks, owns returned objects
+  - "worker": created by worker_main in agent-forked processes; executes
+    tasks/actors and doubles as a submitter for nested tasks
+
+Subsystems, each mirroring a reference component:
+  - FunctionManager: content-hash export of pickled functions/classes to the
+    controller KV; lazy fetch+cache on workers
+    (ray: python/ray/_private/function_manager.py:195,264)
+  - LeaseManager: per-scheduling-key worker leases with reuse, pipelining and
+    spillback redirects (ray: NormalTaskSubmitter normal_task_submitter.h:75)
+  - actor submission: direct worker->worker calls with per-handle sequence
+    numbers, address re-resolution on restart
+    (ray: ActorTaskSubmitter transport/actor_task_submitter.cc)
+  - execution: ordered per-caller actor queues, threaded / asyncio actors
+    (ray: transport/actor_scheduling_queue.cc, fiber.h)
+  - ownership: owned-object table with inline values, locations, borrower
+    counts, and lineage resubmission (ray: reference_count.cc,
+    task_manager.cc, object_recovery_manager.h:41)
+
+The asyncio loop always runs on a dedicated IO thread; public API calls
+bridge onto it with run_coroutine_threadsafe (the GIL-discipline analog of
+_raylet.pyx keeping the hot path out of user threads).
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import itertools
+import logging
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any
+
+import zmq.asyncio
+
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import MemoryStore
+from ray_tpu._private.rpc import (ClientPool, ConnectionLost, RemoteError,
+                                  RpcServer, Subscriber)
+from ray_tpu._private.serialization import (SerializedValue, deserialize,
+                                            dumps_function, loads_function,
+                                            serialize)
+from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
+                                ObjectLostError, TaskCancelledError, TaskError,
+                                WorkerCrashedError)
+from ray_tpu.object_ref import ObjectRef, set_release_hook
+
+logger = logging.getLogger(__name__)
+
+_global_worker: "CoreWorker | None" = None
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init()")
+    return _global_worker
+
+
+def set_global_worker(w: "CoreWorker | None") -> None:
+    global _global_worker
+    _global_worker = w
+
+
+def _freeze(v):
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+# --------------------------------------------------------------------------
+@dataclass
+class OwnedObject:
+    """Owner-side record for one object (ray: reference_count.cc entry)."""
+
+    state: str = "pending"           # pending | inline | stored | error
+    frames: list[bytes] | None = None
+    locations: list[str] = field(default_factory=list)
+    error: BaseException | None = None
+    local_refs: int = 0
+    borrowers: int = 0
+    # Lineage for reconstruction (ray: TaskManager::ResubmitTask).
+    submit_spec: tuple | None = None
+    retries_left: int = 0
+
+
+@dataclass
+class PendingTask:
+    task_id: bytes
+    header: dict
+    blobs: list[bytes]
+    return_ids: list[bytes]
+    retries_left: int
+    retry_exceptions: bool
+    scheduling_key: tuple
+
+
+class LeaseManager:
+    """Leases workers from node agents and pushes queued tasks to them
+    (ray: NormalTaskSubmitter; lease reuse + rate limiting
+    normal_task_submitter.h:53-72)."""
+
+    def __init__(self, core: "CoreWorker"):
+        self.core = core
+        # scheduling_key -> state
+        self.queues: dict[tuple, list[PendingTask]] = {}
+        self.pushers: dict[tuple, int] = {}
+        self.headers: dict[tuple, dict] = {}
+        self.arrivals: dict[tuple, asyncio.Event] = {}
+
+    def submit(self, task: PendingTask) -> None:
+        q = self.queues.setdefault(task.scheduling_key, [])
+        q.append(task)
+        self.headers[task.scheduling_key] = {
+            "resources": task.header.get("resources", {}),
+            "bundle_key": task.header.get("bundle_key"),
+            "submitter": self.core.address,
+        }
+        ev = self.arrivals.get(task.scheduling_key)
+        if ev is not None:
+            ev.set()
+        self._maybe_start_pusher(task.scheduling_key)
+
+    def _maybe_start_pusher(self, key: tuple) -> None:
+        active = self.pushers.get(key, 0)
+        qlen = len(self.queues.get(key, []))
+        limit = self.core.config.max_leases_per_scheduling_key
+        if qlen > 0 and active < min(limit, qlen):
+            self.pushers[key] = active + 1
+            self.core.loop.create_task(self._pusher(key))
+
+    async def _pusher(self, key: tuple) -> None:
+        """One pusher = one lease lifetime: acquire worker, drain queue, and
+        hold the lease briefly when idle so steady task streams reuse the
+        same worker (ray: lease reuse + worker idle timeout)."""
+        lease = None
+        try:
+            lease = await self._acquire_lease(key)
+            if lease is None:
+                return
+            q = self.queues.get(key, [])
+            while True:
+                while q:
+                    task = q.pop(0)
+                    await self._push_one(task, lease)
+                # Queue drained: only the last surviving pusher lingers.
+                if self.pushers.get(key, 0) > 1:
+                    break
+                ev = self.arrivals.setdefault(key, asyncio.Event())
+                ev.clear()
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), self.core.config.lease_idle_timeout_s)
+                except asyncio.TimeoutError:
+                    break
+                if not q:
+                    break
+        finally:
+            self.pushers[key] = self.pushers.get(key, 1) - 1
+            if lease is not None:
+                await self._release_lease(lease)
+            # Re-check: tasks may have arrived while we were releasing.
+            self._maybe_start_pusher(key)
+
+    async def _acquire_lease(self, key: tuple) -> dict | None:
+        header = self.headers[key]
+        addr = self.core.agent_addr
+        for _hop in range(8):
+            try:
+                reply, _ = await self.core.clients.get(addr).call(
+                    "request_lease", header, timeout=300.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("lease request to %s failed: %s", addr, e)
+                return None
+            if reply.get("granted"):
+                return reply
+            if reply.get("spill_to"):
+                addr = reply["spill_to"]
+                continue
+            if reply.get("unfeasible"):
+                # No node can ever run this with current membership; park the
+                # queue and retry on a timer (cluster may grow).
+                await asyncio.sleep(1.0)
+                addr = self.core.agent_addr
+                continue
+        return None
+
+    async def _release_lease(self, lease: dict) -> None:
+        try:
+            agent = lease.get("agent_addr") or self.core.agent_addr
+            await self.core.clients.get(agent).call(
+                "return_lease", {"lease_id": lease["lease_id"]}, timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _push_one(self, task: PendingTask, lease: dict) -> None:
+        worker_addr = lease["worker_addr"]
+        try:
+            reply, blobs = await self.core.clients.get(worker_addr).call(
+                "push_task", task.header, task.blobs)
+        except (ConnectionLost, RemoteError) as e:
+            await self._on_push_failure(task, e)
+            return
+        self.core._on_task_reply(task, reply, blobs)
+
+    async def _on_push_failure(self, task: PendingTask, exc: Exception) -> None:
+        """Worker died mid-task: retry if budget remains
+        (ray: TaskManager::FailOrRetryPendingTask task_manager.h:48)."""
+        if task.retries_left > 0:
+            task.retries_left -= 1
+            logger.warning("task %s worker died; retrying (%d left)",
+                           task.task_id.hex()[:8], task.retries_left)
+            self.submit(task)
+        else:
+            err = WorkerCrashedError(
+                f"worker died executing task {task.task_id.hex()[:8]}: {exc}")
+            for rid in task.return_ids:
+                self.core._resolve_error(rid, err)
+
+
+@dataclass
+class ActorSubmitState:
+    """Caller-side state for one remote actor (per ActorHandle target)."""
+
+    actor_id: str
+    address: str | None = None
+    seqno: int = 0
+    resolving: asyncio.Future | None = None
+    dead: bool = False
+    death_cause: str = ""
+
+
+class ActorInstance:
+    """Worker-side hosted actor with ordered per-caller execution."""
+
+    def __init__(self, actor_id: str, instance: Any, max_concurrency: int,
+                 is_async: bool):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.is_async = is_async
+        self.max_concurrency = max_concurrency
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_concurrency,
+            thread_name_prefix=f"actor-{actor_id[:8]}")
+        # Per-caller ordered delivery (ray: ActorSchedulingQueue seq_nos).
+        self.next_seq: dict[str, int] = {}
+        self.buffered: dict[str, dict[int, tuple]] = {}
+
+
+class CoreWorker:
+    def __init__(self, mode: str, controller_addr: str, agent_addr: str,
+                 config: Config, worker_id: str | None = None,
+                 node_id: str = "", job_id: str = "", pub_addr: str = "",
+                 namespace: str = "default"):
+        self.mode = mode
+        self.config = config
+        self.controller_addr = controller_addr
+        self.agent_addr = agent_addr
+        self.pub_addr = pub_addr
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.node_id = node_id
+        self.job_id = job_id
+        self.namespace = namespace
+        self.memory = MemoryStore()
+        self.owned: dict[bytes, OwnedObject] = {}
+        self.functions: dict[str, Any] = {}
+        self._exported: set[str] = set()
+        self.actors_hosted: dict[str, ActorInstance] = {}
+        self.actor_states: dict[str, ActorSubmitState] = {}
+        self.current_actor_id: str | None = None
+        self.current_task_id: str | None = None
+        self._put_seq = itertools.count()
+        self._cancelled: set[bytes] = set()
+        self._running_async: dict[bytes, asyncio.Task] = {}
+        self._shutdown = threading.Event()
+        self._task_events: list[dict] = []
+        self.loop: asyncio.AbstractEventLoop = None  # set in start()
+        self._default_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+
+    # ---------------------------------------------------------------- setup
+    def start(self) -> None:
+        started = threading.Event()
+        self._io_thread = threading.Thread(
+            target=self._io_main, args=(started,), name="raytpu-io",
+            daemon=True)
+        self._io_thread.start()
+        started.wait(30.0)
+        if self.loop is None:
+            raise RuntimeError("IO loop failed to start")
+        set_release_hook(self._release_local_ref)
+
+    def _io_main(self, started: threading.Event) -> None:
+        asyncio.run(self._io_async_main(started))
+
+    async def _io_async_main(self, started: threading.Event) -> None:
+        self.loop = asyncio.get_running_loop()
+        self.ctx = zmq.asyncio.Context()
+        self.server = RpcServer(self.ctx)
+        self.clients = ClientPool(self.ctx)
+        self.server.register_all(self)
+        self.server.start()
+        self.address = self.server.address
+        self.lease_manager = LeaseManager(self)
+        if self.pub_addr:
+            self._subscribe_events(self.pub_addr)
+        if self.mode == "worker":
+            await self.clients.get(self.agent_addr).call(
+                "register_worker",
+                {"worker_id": self.worker_id, "addr": self.address},
+                timeout=30.0)
+        flusher = self.loop.create_task(self._event_flush_loop())
+        started.set()
+        try:
+            await self.loop.run_in_executor(None, self._shutdown.wait)
+        finally:
+            flusher.cancel()
+            self.server.close()
+            self.clients.close()
+
+    def _subscribe_events(self, pub_addr: str) -> None:
+        """Subscribe to controller events (must run on the IO loop)."""
+        self.pub_addr = pub_addr
+        self.subscriber = Subscriber(self.ctx, pub_addr)
+        self.subscriber.subscribe("actor", self._on_actor_event)
+
+    def connect_events(self, pub_addr: str) -> None:
+        self.loop.call_soon_threadsafe(self._subscribe_events, pub_addr)
+
+    def shutdown(self) -> None:
+        set_release_hook(None)
+        self._shutdown.set()
+        self._io_thread.join(5.0)
+        set_global_worker(None)
+
+    def run(self, coro, timeout: float | None = None):
+        """Bridge a coroutine from any user thread onto the IO loop."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    async def acall(self, addr: str, method: str, header: dict | None = None,
+                    blobs: list | None = None,
+                    timeout: float | None = None) -> tuple[dict, list]:
+        return await self.clients.get(addr).call(
+            method, header or {}, blobs, timeout)
+
+    def call(self, addr: str, method: str, header: dict | None = None,
+             blobs: list | None = None,
+             timeout: float | None = None) -> tuple[dict, list]:
+        """Thread-safe RPC from user threads; client sockets are created on
+        the IO loop (zmq asyncio sockets are loop-bound)."""
+        return self.run(self.acall(addr, method, header, blobs, timeout))
+
+    # ------------------------------------------------------------ functions
+    def export_function(self, fn: Any) -> str:
+        blob = dumps_function(fn)
+        fid = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        if fid not in self._exported:
+            self.call(self.controller_addr, "kv_put",
+                      {"ns": "fn", "key": fid}, [blob])
+            self._exported.add(fid)
+            self.functions[fid] = fn
+        return fid
+
+    async def _fetch_function(self, fid: str) -> Any:
+        fn = self.functions.get(fid)
+        if fn is not None:
+            return fn
+        reply, blobs = await self.clients.get(self.controller_addr).call(
+            "kv_get", {"ns": "fn", "key": fid})
+        if not reply.get("found"):
+            raise RuntimeError(f"function {fid} not found in KV")
+        fn = await self.loop.run_in_executor(None, loads_function, blobs[0])
+        self.functions[fid] = fn
+        return fn
+
+    # ----------------------------------------------------------- submission
+    def submit_task(self, fn: Any, args: tuple, kwargs: dict,
+                    options: dict) -> list[ObjectRef]:
+        fid = self.export_function(fn)
+        task_id = TaskID.from_random()
+        num_returns = options.get("num_returns", 1)
+        return_ids = [ObjectID.for_return(task_id, i).binary()
+                      for i in range(num_returns)]
+        resources = dict(options.get("resources") or {})
+        resources.setdefault("CPU", options.get("num_cpus", 1))
+        if options.get("num_tpus"):
+            resources["TPU"] = options["num_tpus"]
+        bundle_key = options.get("bundle_key")
+        header, blobs = self._build_task_payload(
+            task_id.binary(), fid, args, kwargs, num_returns, resources,
+            bundle_key, options)
+        retries = options.get("max_retries",
+                              self.config.default_task_max_retries)
+        scheduling_key = (fid, _freeze(resources), bundle_key)
+        task = PendingTask(
+            task_id=task_id.binary(), header=header, blobs=blobs,
+            return_ids=return_ids, retries_left=max(0, retries),
+            retry_exceptions=bool(options.get("retry_exceptions")),
+            scheduling_key=scheduling_key)
+        refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        for rid in return_ids:
+            rec = self.owned.setdefault(rid, OwnedObject())
+            rec.local_refs += 1
+            rec.submit_spec = (fid, header, blobs, scheduling_key)
+            rec.retries_left = max(0, retries)
+
+        def _go():
+            self.memory_entries_for(return_ids)
+            self.lease_manager.submit(task)
+
+        self.loop.call_soon_threadsafe(_go)
+        self._record_event(task_id.hex(), "SUBMITTED", fid)
+        return refs
+
+    def memory_entries_for(self, return_ids: list[bytes]) -> None:
+        for rid in return_ids:
+            self.memory.entry(rid)
+
+    def _build_task_payload(self, task_id: bytes, fid: str, args: tuple,
+                            kwargs: dict, num_returns: int,
+                            resources: dict, bundle_key: str | None,
+                            options: dict) -> tuple[dict, list[bytes]]:
+        # Top-level ObjectRef args are resolved to values worker-side before
+        # execution (ray: DependencyResolver; nested refs stay refs).
+        arg_refs: list[dict] = []
+        plain_args: list[Any] = []
+        for i, a in enumerate(args):
+            if isinstance(a, ObjectRef):
+                arg_refs.append({"pos": i, "id": a.hex(),
+                                 "owner": a.owner_addr or self.address})
+                plain_args.append(None)
+                self._add_borrow(a)
+            else:
+                plain_args.append(a)
+        sv = serialize((tuple(plain_args), kwargs))
+        for ref in sv.contained_refs:
+            self._add_borrow(ref)
+        header = {
+            "task_id": task_id.hex(), "function_id": fid,
+            "num_returns": num_returns, "resources": resources,
+            "owner_addr": self.address, "arg_refs": arg_refs,
+            "bundle_key": bundle_key,
+            "name": options.get("name", ""),
+        }
+        return header, sv.frames
+
+    def _add_borrow(self, ref: ObjectRef) -> None:
+        if ref.owner_addr == self.address or not ref.owner_addr:
+            rec = self.owned.get(ref.binary())
+            if rec:
+                rec.borrowers += 1
+        else:
+            async def _notify():
+                try:
+                    await self.clients.get(ref.owner_addr).notify(
+                        "add_borrow", {"object_id": ref.hex()})
+                except Exception:  # noqa: BLE001
+                    pass
+            self.loop.call_soon_threadsafe(
+                lambda: self.loop.create_task(_notify()))
+
+    # -------- task reply handling (owner side) --------
+    def _on_task_reply(self, task: PendingTask, reply: dict,
+                       blobs: list[bytes]) -> None:
+        status = reply.get("status")
+        if status == "ok":
+            returns = reply["returns"]
+            offset = 0
+            for i, meta in enumerate(returns):
+                rid = task.return_ids[i]
+                rec = self.owned.setdefault(rid, OwnedObject())
+                if meta["inline"]:
+                    nframes = meta["nframes"]
+                    frames = blobs[offset:offset + nframes]
+                    offset += nframes
+                    rec.state = "inline"
+                    rec.frames = frames
+                    self.memory.put_frames(rid, frames)
+                else:
+                    rec.state = "stored"
+                    rec.locations = [meta["location"]]
+                    self.memory.put_locations(rid, rec.locations)
+            self._record_event(task.task_id.hex(), "FINISHED")
+        elif status == "cancelled":
+            err = TaskCancelledError(task.task_id.hex())
+            for rid in task.return_ids:
+                self._resolve_error(rid, err)
+        else:
+            exc, tb = None, reply.get("traceback", "")
+            if blobs:
+                try:
+                    import pickle
+                    exc = pickle.loads(blobs[0])
+                except Exception:  # noqa: BLE001
+                    exc = RuntimeError(reply.get("error", "task failed"))
+            if task.retry_exceptions and task.retries_left > 0:
+                task.retries_left -= 1
+                self.lease_manager.submit(task)
+                return
+            err = TaskError(exc or RuntimeError("task failed"), tb)
+            for rid in task.return_ids:
+                self._resolve_error(rid, err)
+            self._record_event(task.task_id.hex(), "FAILED")
+
+    def _resolve_error(self, rid: bytes, err: BaseException) -> None:
+        rec = self.owned.setdefault(rid, OwnedObject())
+        rec.state = "error"
+        rec.error = err
+        self.memory.put_error(rid, err)
+
+    # ------------------------------------------------------------- get/put
+    def put_object(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(WorkerID.from_hex(self.worker_id),
+                               next(self._put_seq)).binary()
+        sv = serialize(value)
+        rec = self.owned.setdefault(oid, OwnedObject())
+        rec.local_refs += 1
+        if sv.total_bytes <= self.config.max_inline_object_size:
+            rec.state = "inline"
+            rec.frames = sv.frames
+
+            def _fill():
+                e = self.memory.entry(oid)
+                e.has_value, e.value = True, value
+                e.frames = sv.frames
+                e.event.set()
+            self.loop.call_soon_threadsafe(_fill)
+        else:
+            async def _store():
+                reply, _ = await self.clients.get(self.agent_addr).call(
+                    "store_put", {"object_id": oid.hex()}, sv.frames)
+                rec.state = "stored"
+                rec.locations = [self.agent_addr]
+                e = self.memory.entry(oid)
+                e.has_value, e.value = True, value
+                e.event.set()
+            self.run(_store())
+        return ObjectRef(oid, self.address)
+
+    def get_objects(self, refs: list[ObjectRef],
+                    timeout: float | None = None) -> list[Any]:
+        return self.run(self._get_objects_async(refs, timeout))
+
+    async def _get_objects_async(self, refs: list[ObjectRef],
+                                 timeout: float | None) -> list[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results = await asyncio.gather(
+            *[self._get_one(r, deadline) for r in refs])
+        out = []
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+            out.append(r)
+        return out
+
+    async def _get_one(self, ref: ObjectRef, deadline: float | None) -> Any:
+        e = self.memory.get_if_exists(ref.binary())
+        owned_here = ref.binary() in self.owned or ref.owner_addr in (
+            "", self.address)
+        if e is None and owned_here:
+            e = self.memory.entry(ref.binary())
+        if e is not None:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            try:
+                await asyncio.wait_for(e.event.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {ref.hex()[:12]}")
+            if e.error is not None:
+                return e.error
+            if e.has_value:
+                return e.value
+            if e.frames is not None:
+                value = await self.loop.run_in_executor(
+                    None, deserialize, e.frames)
+                e.has_value, e.value = True, value
+                return value
+            if e.locations:
+                return await self._pull_and_load(ref, e.locations, e)
+            # fallthrough: resolved elsewhere
+        return await self._get_from_owner(ref, deadline)
+
+    async def _get_from_owner(self, ref: ObjectRef,
+                              deadline: float | None) -> Any:
+        remaining = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        try:
+            reply, blobs = await self.clients.get(ref.owner_addr).call(
+                "resolve_object", {"object_id": ref.hex(), "wait": True},
+                timeout=remaining)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(ref.hex()[:12])
+        except (ConnectionLost, RemoteError) as err:
+            return ObjectLostError(
+                f"owner {ref.owner_addr} unreachable for "
+                f"{ref.hex()[:12]}: {err}")
+        state = reply.get("state")
+        if state == "inline":
+            value = await self.loop.run_in_executor(None, deserialize, blobs)
+            e = self.memory.entry(ref.binary())
+            e.has_value, e.value = True, value
+            e.event.set()
+            return value
+        if state == "error":
+            import pickle
+            return pickle.loads(blobs[0])
+        if state == "stored":
+            e = self.memory.entry(ref.binary())
+            return await self._pull_and_load(ref, reply["locations"], e)
+        return ObjectLostError(ref.hex()[:12])
+
+    async def _pull_and_load(self, ref: ObjectRef, locations: list[str],
+                             entry) -> Any:
+        """Fetch frames from a node store holding the object."""
+        for addr in locations:
+            try:
+                reply, blobs = await self.clients.get(addr).call(
+                    "store_get", {"object_id": ref.hex()}, timeout=120.0)
+            except Exception:  # noqa: BLE001
+                continue
+            if reply.get("found"):
+                value = await self.loop.run_in_executor(
+                    None, deserialize, blobs)
+                entry.has_value, entry.value = True, value
+                entry.event.set()
+                return value
+        # Every location failed: try lineage reconstruction.
+        rec = self.owned.get(ref.binary())
+        if rec and rec.submit_spec and rec.retries_left > 0:
+            rec.retries_left -= 1
+            fid, header, blobs_, key = rec.submit_spec
+            logger.warning("reconstructing %s via lineage", ref.hex()[:12])
+            rec.state = "pending"
+            self.memory.delete(ref.binary())
+            self.memory.entry(ref.binary())
+            task = PendingTask(
+                task_id=bytes.fromhex(header["task_id"]), header=header,
+                blobs=blobs_, return_ids=[ref.binary()],
+                retries_left=rec.retries_left, retry_exceptions=False,
+                scheduling_key=key)
+            self.lease_manager.submit(task)
+            return await self._get_one(ObjectRef(ref.binary(), self.address),
+                                       None)
+        return ObjectLostError(ref.hex()[:12])
+
+    def wait(self, refs: list[ObjectRef], num_returns: int,
+             timeout: float | None) -> tuple[list[ObjectRef], list[ObjectRef]]:
+        return self.run(self._wait_async(refs, num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        async def _ready(ref: ObjectRef) -> ObjectRef:
+            await self._get_one(ref, None)   # errors count as ready (like ray)
+            return ref
+
+        tasks = {asyncio.ensure_future(_ready(r)): r for r in refs}
+        done_refs: list[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = set(tasks)
+        while pending and len(done_refs) < num_returns:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            done, pending = await asyncio.wait(
+                pending, timeout=remaining,
+                return_when=asyncio.FIRST_COMPLETED)
+            if not done:
+                break
+            for d in done:
+                done_refs.append(tasks[d])
+        for p in pending:
+            p.cancel()
+        not_done = [r for r in refs if r not in done_refs]
+        return done_refs, not_done
+
+    def ref_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        async def _wait():
+            try:
+                v = await self._get_one(ref, None)
+                if isinstance(v, BaseException):
+                    fut.set_exception(v)
+                else:
+                    fut.set_result(v)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.loop.call_soon_threadsafe(lambda: self.loop.create_task(_wait()))
+        return fut
+
+    # -------------------------------------------------------------- refcount
+    def _release_local_ref(self, object_id: bytes) -> None:
+        rec = self.owned.get(object_id)
+        if rec is None:
+            return
+        rec.local_refs -= 1
+        if rec.local_refs <= 0 and rec.borrowers <= 0:
+            self._free_object(object_id, rec)
+
+    def _free_object(self, object_id: bytes, rec: OwnedObject) -> None:
+        self.owned.pop(object_id, None)
+        locations = list(rec.locations)
+        loop = self.loop
+        if loop is None or self._shutdown.is_set():
+            return
+
+        def _cleanup():
+            self.memory.delete(object_id)
+            for addr in locations:
+                loop.create_task(self._delete_remote(addr, object_id))
+        try:
+            loop.call_soon_threadsafe(_cleanup)
+        except RuntimeError:
+            pass
+
+    async def _delete_remote(self, addr: str, object_id: bytes) -> None:
+        try:
+            await self.clients.get(addr).notify(
+                "store_delete", {"object_id": object_id.hex()})
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def rpc_add_borrow(self, h: dict, _b: list) -> dict:
+        rec = self.owned.get(bytes.fromhex(h["object_id"]))
+        if rec:
+            rec.borrowers += 1
+        return {}
+
+    async def rpc_remove_borrow(self, h: dict, _b: list) -> dict:
+        oid = bytes.fromhex(h["object_id"])
+        rec = self.owned.get(oid)
+        if rec:
+            rec.borrowers -= 1
+            if rec.local_refs <= 0 and rec.borrowers <= 0:
+                self._free_object(oid, rec)
+        return {}
+
+    # ------------------------------------------------- owner-side resolution
+    async def rpc_resolve_object(self, h: dict, _b: list) -> tuple[dict, list]:
+        """Serve an object's value/locations to a borrower
+        (ray: OwnershipBasedObjectDirectory asking the owner)."""
+        oid = bytes.fromhex(h["object_id"])
+        rec = self.owned.get(oid)
+        if rec is None:
+            return {"state": "unknown"}, []
+        if rec.state == "pending" and h.get("wait"):
+            e = self.memory.entry(oid)
+            await e.event.wait()
+            rec = self.owned.get(oid) or rec
+        if rec.state == "inline":
+            return {"state": "inline"}, list(rec.frames or [])
+        if rec.state == "stored":
+            return {"state": "stored", "locations": rec.locations}, []
+        if rec.state == "error":
+            import pickle
+            return {"state": "error"}, [pickle.dumps(rec.error)]
+        return {"state": "pending"}, []
+
+    # ------------------------------------------------------------ execution
+    async def rpc_push_task(self, h: dict, blobs: list) -> tuple[dict, list]:
+        task_id = bytes.fromhex(h["task_id"])
+        if task_id in self._cancelled:
+            self._cancelled.discard(task_id)
+            return {"status": "cancelled"}, []
+        fn = await self._fetch_function(h["function_id"])
+        args, kwargs = await self._resolve_args(h, blobs)
+        self._record_event(h["task_id"], "RUNNING", h.get("name", ""))
+        try:
+            result = await self._run_user_code(
+                lambda: fn(*args, **kwargs), task_id=task_id)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(e)
+        return await self._pack_returns(result, h)
+
+    async def _resolve_args(self, h: dict, blobs: list) -> tuple[tuple, dict]:
+        args_t, kwargs = await self.loop.run_in_executor(
+            None, deserialize, blobs)
+        args = list(args_t)
+        if h.get("arg_refs"):
+            ref_objs = [ObjectRef(bytes.fromhex(r["id"]), r["owner"])
+                        for r in h["arg_refs"]]
+            values = await self._get_objects_async(ref_objs, None)
+            for r, v in zip(h["arg_refs"], values):
+                args[r["pos"]] = v
+        return tuple(args), kwargs
+
+    async def _run_user_code(self, thunk, task_id: bytes | None = None,
+                             executor=None, instance_actor: str | None = None):
+        prev_task = self.current_task_id
+        self.current_task_id = task_id.hex() if task_id else None
+        try:
+            return await self.loop.run_in_executor(
+                executor or self._default_executor, thunk)
+        finally:
+            self.current_task_id = prev_task
+
+    def _error_reply(self, e: BaseException) -> tuple[dict, list]:
+        import pickle
+        tb = traceback.format_exc()
+        try:
+            payload = pickle.dumps(e)
+        except Exception:  # noqa: BLE001
+            payload = pickle.dumps(RuntimeError(str(e)))
+        return {"status": "error", "traceback": tb}, [payload]
+
+    async def _pack_returns(self, result: Any, h: dict) -> tuple[dict, list]:
+        num_returns = h.get("num_returns", 1)
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                return self._error_reply(ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values"))
+        returns, out_blobs = [], []
+        task_id = bytes.fromhex(h["task_id"])
+        for i, v in enumerate(values):
+            sv = await self.loop.run_in_executor(None, serialize, v)
+            if sv.total_bytes <= self.config.max_inline_object_size:
+                returns.append({"inline": True, "nframes": len(sv.frames)})
+                out_blobs.extend(sv.frames)
+            else:
+                oid = ObjectID.for_return(TaskID(task_id), i)
+                reply, _ = await self.clients.get(self.agent_addr).call(
+                    "store_put", {"object_id": oid.hex()}, sv.frames)
+                returns.append({"inline": False, "location": self.agent_addr})
+        return {"status": "ok", "returns": returns}, out_blobs
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        try:
+            cls = await self._fetch_function(h["function_id"])
+            args, kwargs = await self._resolve_args(h, blobs)
+            is_async = bool(h.get("is_async"))
+            if is_async:
+                instance = cls(*args, **kwargs)
+            else:
+                instance = await self.loop.run_in_executor(
+                    self._default_executor, lambda: cls(*args, **kwargs))
+            self.actors_hosted[h["actor_id"]] = ActorInstance(
+                h["actor_id"], instance,
+                max_concurrency=h.get("max_concurrency", 1),
+                is_async=is_async)
+            self.current_actor_id = h["actor_id"]
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}\n"
+                             f"{traceback.format_exc()}"}
+
+    async def rpc_actor_call(self, h: dict, blobs: list) -> tuple[dict, list]:
+        inst = self.actors_hosted.get(h["actor_id"])
+        if inst is None:
+            return {"status": "error", "traceback": "actor not hosted here"}, [
+                __import__("pickle").dumps(
+                    ActorDiedError(h["actor_id"], "not hosted"))]
+        caller = h.get("caller", "?")
+        seq = h.get("seqno", 0)
+        # First seqno seen from a caller is its baseline: a restarted actor
+        # incarnation accepts the caller's continuing sequence without a
+        # handshake (ray: seq_no reset on actor restart via num_restarts).
+        nxt = inst.next_seq.setdefault(caller, seq)
+        if seq != nxt:
+            # Out-of-order arrival: park until predecessors START
+            # (ray: ActorSchedulingQueue buffering by seq_no).
+            fut = self.loop.create_future()
+            inst.buffered.setdefault(caller, {})[seq] = fut
+            await fut
+        # In-order start, possibly-concurrent execution: async actors and
+        # threaded actors (max_concurrency > 1) overlap; the default
+        # single-thread executor serializes (ray: fiber.h vs ordered queue).
+        started = await self._start_actor_method(inst, h, blobs)
+        inst.next_seq[caller] = seq + 1
+        buf = inst.buffered.get(caller, {})
+        nxt_fut = buf.pop(seq + 1, None)
+        if nxt_fut and not nxt_fut.done():
+            nxt_fut.set_result(None)
+        return await started
+
+    async def _start_actor_method(self, inst: ActorInstance, h: dict,
+                                  blobs: list):
+        """Resolve args and dispatch the method; returns an awaitable that
+        yields the packed reply.  Dispatch (executor submit / task create)
+        happens before returning, so callers can release the sequence lock
+        while execution proceeds."""
+        method = getattr(inst.instance, h["method"], None)
+        if method is None:
+            async def _err():
+                return self._error_reply(
+                    AttributeError(f"actor has no method {h['method']!r}"))
+            return _err()
+        args, kwargs = await self._resolve_args(h, blobs)
+        task_id = bytes.fromhex(h["task_id"])
+        self._record_event(h["task_id"], "RUNNING",
+                           f"{type(inst.instance).__name__}.{h['method']}")
+        if inst.is_async and asyncio.iscoroutinefunction(method):
+            atask = self.loop.create_task(method(*args, **kwargs))
+            self._running_async[task_id] = atask
+        else:
+            def _call():
+                prev = self.current_task_id
+                self.current_task_id = h["task_id"]
+                try:
+                    return method(*args, **kwargs)
+                finally:
+                    self.current_task_id = prev
+            atask = self.loop.run_in_executor(inst.executor, _call)
+
+        async def _finish():
+            try:
+                result = await atask
+            except asyncio.CancelledError:
+                return {"status": "cancelled"}, []
+            except BaseException as e:  # noqa: BLE001
+                return self._error_reply(e)
+            finally:
+                self._running_async.pop(task_id, None)
+            return await self._pack_returns(result, h)
+
+        return _finish()
+
+    async def rpc_kill_actor_local(self, h: dict, _b: list) -> dict:
+        self.actors_hosted.pop(h["actor_id"], None)
+        return {}
+
+    # -------- caller side --------
+    def _actor_state(self, actor_id: str) -> ActorSubmitState:
+        st = self.actor_states.get(actor_id)
+        if st is None:
+            st = ActorSubmitState(actor_id)
+            self.actor_states[actor_id] = st
+        return st
+
+    def submit_actor_task(self, actor_id: str, method: str, args: tuple,
+                          kwargs: dict, options: dict) -> list[ObjectRef]:
+        task_id = TaskID.from_random()
+        num_returns = options.get("num_returns", 1)
+        return_ids = [ObjectID.for_return(task_id, i).binary()
+                      for i in range(num_returns)]
+        header, blobs = self._build_task_payload(
+            task_id.binary(), "", args, kwargs, num_returns, {}, None, options)
+        header.update({"actor_id": actor_id, "method": method,
+                       "caller": self.worker_id})
+        for rid in return_ids:
+            rec = self.owned.setdefault(rid, OwnedObject())
+            rec.local_refs += 1
+        refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        max_task_retries = options.get("max_task_retries", 0)
+
+        def _go():
+            self.memory_entries_for(return_ids)
+            st = self._actor_state(actor_id)
+            header["seqno"] = st.seqno
+            st.seqno += 1
+            self.loop.create_task(self._push_actor_task(
+                st, header, blobs, return_ids, max_task_retries))
+
+        self.loop.call_soon_threadsafe(_go)
+        return refs
+
+    async def _push_actor_task(self, st: ActorSubmitState, header: dict,
+                               blobs: list, return_ids: list[bytes],
+                               retries: int) -> None:
+        while True:
+            if st.dead:
+                err = ActorDiedError(st.actor_id, st.death_cause)
+                for rid in return_ids:
+                    self._resolve_error(rid, err)
+                return
+            addr = await self._resolve_actor_addr(st)
+            if addr is None:
+                continue    # loops back; st.dead now set or address refreshed
+            try:
+                reply, rblobs = await self.clients.get(addr).call(
+                    "actor_call", header, blobs)
+            except (ConnectionLost, RemoteError):
+                if st.address == addr:
+                    st.address = None
+                # In-flight call lost: resend only with an explicit retry
+                # budget (ray: max_task_retries; default 0 = at-most-once,
+                # the call fails with an actor error).
+                if retries > 0:
+                    retries -= 1
+                    continue
+                err = ActorError(st.actor_id, "actor worker connection lost")
+                for rid in return_ids:
+                    self._resolve_error(rid, err)
+                return
+            task = PendingTask(
+                task_id=bytes.fromhex(header["task_id"]), header=header,
+                blobs=blobs, return_ids=return_ids, retries_left=0,
+                retry_exceptions=False, scheduling_key=())
+            self._on_task_reply(task, reply, rblobs)
+            return
+
+    async def _resolve_actor_addr(self, st: ActorSubmitState) -> str | None:
+        if st.address:
+            return st.address
+        if st.resolving is None or st.resolving.done():
+            st.resolving = self.loop.create_task(self._do_resolve(st))
+        await asyncio.shield(st.resolving)
+        return st.address
+
+    async def _do_resolve(self, st: ActorSubmitState) -> None:
+        reply, _ = await self.clients.get(self.controller_addr).call(
+            "get_actor_info",
+            {"actor_id": st.actor_id, "wait": True, "timeout": 120.0},
+            timeout=150.0)
+        if reply.get("state") == "ALIVE":
+            st.address = reply["address"]
+        elif reply.get("state") in ("DEAD", "UNKNOWN"):
+            st.dead = True
+            st.death_cause = reply.get("cause") or reply.get("state", "")
+
+    async def _on_actor_event(self, _topic: str, payload: dict) -> None:
+        st = self.actor_states.get(payload.get("actor_id", ""))
+        if st is None:
+            return
+        ev = payload.get("event")
+        if ev == "alive":
+            st.address = payload["address"]
+            st.dead = False
+            return
+        old = st.address
+        st.address = None
+        if ev == "dead":
+            st.dead = True
+            st.death_cause = payload.get("cause", "")
+        # zmq DEALER sockets never surface peer death; dropping the client
+        # fails its in-flight futures with ConnectionLost so callers waiting
+        # on a dead actor's reply unblock (ray: worker failure pubsub →
+        # ActorTaskSubmitter::DisconnectActor).
+        if old:
+            self.clients.drop(old)
+
+    def create_actor(self, cls: Any, args: tuple, kwargs: dict,
+                     options: dict) -> str:
+        fid = self.export_function(cls)
+        actor_id = ActorID.from_random().hex()
+        resources = dict(options.get("resources") or {})
+        resources.setdefault("CPU", options.get("num_cpus", 1))
+        if options.get("num_tpus"):
+            resources["TPU"] = options["num_tpus"]
+        task_id = TaskID.from_random()
+        header, blobs = self._build_task_payload(
+            task_id.binary(), fid, args, kwargs, 0, resources,
+            options.get("bundle_key"), options)
+        header.update({
+            "function_id": fid,
+            "max_concurrency": options.get("max_concurrency", 1),
+            "is_async": bool(options.get("is_async")),
+        })
+        reply, _ = self.call(
+            self.controller_addr, "create_actor",
+            {"actor_id": actor_id, "creation_header": header,
+             "owner_addr": self.address, "resources": resources,
+             "max_restarts": options.get("max_restarts", 0),
+             "name": options.get("name"),
+             "namespace": options.get("namespace", self.namespace),
+             "get_if_exists": options.get("get_if_exists", False),
+             "detached": options.get("lifetime") == "detached",
+             "pg_id": options.get("pg_id"),
+             "bundle_index": options.get("bundle_index", -1)},
+            blobs, timeout=120.0)
+        if reply.get("error"):
+            raise ValueError(reply["error"])
+        return reply["actor_id"]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.call(self.controller_addr, "remove_actor",
+                  {"actor_id": actor_id}, timeout=30.0)
+        st = self.actor_states.get(actor_id)
+        if st:
+            st.dead = True
+            st.address = None
+            st.death_cause = "killed"
+
+    def kill_actor_async(self, actor_id: str) -> None:
+        """Fire-and-forget kill used by ActorHandle GC (must not block in
+        __del__, which can run on any thread including the IO loop's)."""
+        loop = self.loop
+        if loop is None or self._shutdown.is_set():
+            return
+
+        def _go():
+            loop.create_task(self.acall(
+                self.controller_addr, "remove_actor",
+                {"actor_id": actor_id, "cause": "handle out of scope"},
+                timeout=30.0))
+        try:
+            loop.call_soon_threadsafe(_go)
+        except RuntimeError:
+            pass
+
+    # ------------------------------------------------------------- cancel
+    def cancel_task(self, ref: ObjectRef) -> None:
+        async def _cancel():
+            try:
+                await self.clients.get(ref.owner_addr or self.address).notify(
+                    "cancel_task", {"object_id": ref.hex()})
+            except Exception:  # noqa: BLE001
+                pass
+        self.run(_cancel())
+
+    async def rpc_cancel_task(self, h: dict, _b: list) -> dict:
+        # Owner-side: mark queued tasks cancelled; cancel running async ones.
+        oid = bytes.fromhex(h["object_id"])
+        for key, q in self.lease_manager.queues.items():
+            for t in list(q):
+                if oid in t.return_ids:
+                    q.remove(t)
+                    err = TaskCancelledError(t.task_id.hex())
+                    for rid in t.return_ids:
+                        self._resolve_error(rid, err)
+                    return {}
+        atask = self._running_async.get(oid)
+        if atask:
+            atask.cancel()
+        return {}
+
+    # ------------------------------------------------------------- control
+    async def rpc_worker_died(self, h: dict, _b: list) -> dict:
+        self.clients.drop(h.get("worker_addr", ""))
+        return {}
+
+    async def rpc_exit_worker(self, h: dict, _b: list) -> dict:
+        logger.info("worker exiting: %s", h.get("reason"))
+        self.loop.call_later(0.05, self._shutdown.set)
+        if h.get("hard"):
+            self.loop.call_later(0.1, lambda: os._exit(0))
+        return {}
+
+    async def rpc_ping(self, h: dict, _b: list) -> dict:
+        return {"worker_id": self.worker_id,
+                "actors": list(self.actors_hosted)}
+
+    # ------------------------------------------------------------ telemetry
+    def _record_event(self, task_id: str, state: str, name: str = "") -> None:
+        self._task_events.append(
+            {"task_id": task_id, "state": state, "name": name,
+             "t": time.time(), "worker": self.worker_id[:8],
+             "node": self.node_id[:8]})
+        if len(self._task_events) > self.config.task_event_buffer_size:
+            self._task_events = self._task_events[-self.config.
+                                                  task_event_buffer_size:]
+
+    async def _event_flush_loop(self) -> None:
+        """Push buffered task events to the controller timeline
+        (ray: TaskEventBuffer task_event_buffer.h:206)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self._task_events:
+                events, self._task_events = self._task_events, []
+                try:
+                    await self.clients.get(self.controller_addr).notify(
+                        "push_task_events", {"events": events})
+                except Exception:  # noqa: BLE001
+                    pass

@@ -1,0 +1,55 @@
+"""Entrypoint for agent-forked worker processes.
+
+Analog of the reference's default_worker.py
+(ray: python/ray/_private/workers/default_worker.py): read connection info
+from the environment the agent set, start the CoreWorker, serve until told
+to exit.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+
+def _watch_parent() -> None:
+    """Exit when the owning agent dies — workers must never outlive it."""
+    import threading
+    import time
+
+    def _loop():
+        while True:
+            if os.getppid() <= 1:
+                os._exit(0)
+            time.sleep(1.0)
+
+    threading.Thread(target=_loop, daemon=True, name="parent-watch").start()
+
+
+def main() -> None:
+    _watch_parent()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s worker[%(process)d]: %(message)s")
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+    config = Config().override(None)
+    core = CoreWorker(
+        mode="worker",
+        controller_addr=os.environ["RAY_TPU_CONTROLLER_ADDR"],
+        agent_addr=os.environ["RAY_TPU_AGENT_ADDR"],
+        config=config,
+        worker_id=os.environ["RAY_TPU_WORKER_ID"],
+        node_id=os.environ.get("RAY_TPU_NODE_ID", ""),
+        pub_addr=os.environ.get("RAY_TPU_PUB_ADDR", ""),
+    )
+    core.start()
+    set_global_worker(core)
+    try:
+        core._shutdown.wait()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

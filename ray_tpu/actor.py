@@ -1,0 +1,144 @@
+"""ActorClass / ActorHandle: the @ray_tpu.remote actor API.
+
+Analog of ray: python/ray/actor.py (ActorClass._remote, ActorHandle).
+Method calls go directly worker→worker with per-handle sequence numbers; the
+controller is only involved at creation, restart, and address resolution
+(ray: steady-state actor calls never touch the scheduler, SURVEY §3.3).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+from ray_tpu.remote_function import resolve_pg_options
+
+_ACTOR_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
+    "max_concurrency", "name", "namespace", "lifetime", "get_if_exists",
+    "scheduling_strategy", "placement_group", "placement_group_bundle_index",
+    "runtime_env", "memory", "num_returns",
+}
+
+
+def _validate(opts: dict) -> None:
+    for k in opts:
+        if k not in _ACTOR_OPTION_KEYS:
+            raise ValueError(f"unknown actor option {k!r}")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs,
+                                    {"num_returns": self._num_returns})
+
+    def options(self, **opts) -> "ActorMethod":
+        m = ActorMethod(self._handle, self._name,
+                        opts.get("num_returns", self._num_returns))
+        return m
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"actor methods cannot be called directly; use "
+                        f"{self._name}.remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, method_names: set[str] | None = None,
+                 owner: bool = False):
+        self._actor_id = actor_id
+        self._method_names = method_names or set()
+        # The original handle owns the actor's lifetime: dropping it kills
+        # the actor (ray: actor handle reference counting; non-detached
+        # actors die when all handles go out of scope).  Deserialized copies
+        # never own.
+        self._owner = owner
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    def __del__(self):
+        if getattr(self, "_owner", False):
+            try:
+                from ray_tpu._private.worker import _global_worker
+
+                if _global_worker is not None \
+                        and not _global_worker._shutdown.is_set():
+                    _global_worker.kill_actor_async(self._actor_id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+
+    def _invoke(self, method: str, args: tuple, kwargs: dict, opts: dict):
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker()
+        refs = core.submit_actor_task(self._actor_id, method, args, kwargs,
+                                      opts)
+        n = opts.get("num_returns", 1)
+        return refs[0] if n == 1 else refs
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"actor has no method {name!r}; methods: "
+                f"{sorted(self._method_names)}")
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:12]}…)"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names))
+
+
+class ActorClass:
+    def __init__(self, cls: type, **default_options):
+        _validate(default_options)
+        self._cls = cls
+        self._default_options = default_options
+        self._method_names = {
+            n for n, _ in inspect.getmembers(cls, inspect.isfunction)
+            if not n.startswith("__")
+        }
+        self._is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, inspect.isfunction))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._default_options)
+
+    def options(self, **options) -> "ActorClass":
+        _validate(options)
+        clone = ActorClass(self._cls)
+        clone._default_options = {**self._default_options, **options}
+        return clone
+
+    def _remote(self, args: tuple, kwargs: dict, opts: dict) -> ActorHandle:
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.remote_function import _wait_pg_ready
+
+        options = resolve_pg_options(opts)
+        options["is_async"] = self._is_async
+        core = global_worker()
+        if "pg_id" in options:
+            _wait_pg_ready(core, options["pg_id"])
+        actor_id = core.create_actor(self._cls, args, kwargs, options)
+        # Named/detached actors outlive their creating handle; anonymous
+        # actors are GC'd with it.
+        owner = not (options.get("name") or options.get("lifetime") == "detached")
+        return ActorHandle(actor_id, self._method_names, owner=owner)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor classes cannot be instantiated directly; use "
+            f"{self._cls.__name__}.remote()")
+
+    def __repr__(self):
+        return f"ActorClass({self._cls.__name__})"

@@ -1,0 +1,281 @@
+"""Public core API: init/shutdown, remote, get/put/wait, actors, kill.
+
+Analog of ray: python/ray/_private/worker.py public functions
+(init:1227, get:2578, put:2693, wait:2758, remote:3171, get_actor:2904).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import subprocess
+import sys
+import time
+from typing import Any, Iterable, Sequence
+
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import JobID
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu.remote_function import RemoteFunction
+
+logger = logging.getLogger(__name__)
+
+_head_processes: list[subprocess.Popen] = []
+_initialized = False
+
+
+def _read_json_line(proc: subprocess.Popen, timeout: float = 30.0) -> dict:
+    """Read the child's one-line JSON address announcement from stdout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"head process exited with {proc.returncode}")
+            time.sleep(0.01)
+            continue
+        line = line.strip()
+        if line.startswith(b"{"):
+            return json.loads(line)
+    raise TimeoutError("head process did not announce its address")
+
+
+def _spawn(args: list[str]) -> tuple[subprocess.Popen, dict]:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", *args], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL if not __import__("os").environ.get(
+            "RAY_TPU_HEAD_LOGS") else None)
+    info = _read_json_line(proc)
+    _head_processes.append(proc)
+    return proc, info
+
+
+def init(address: str | None = None,
+         resources: dict[str, float] | None = None,
+         namespace: str = "default",
+         object_store_memory: int | None = None,
+         _system_config: dict | None = None,
+         log_to_driver: bool = True) -> dict:
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    Without `address`, boots a local head: controller + one node agent as
+    subprocesses (ray: Node.start_head_processes node.py:1353 spawning
+    gcs_server + raylet).  With `address` ("controller host:port"), attaches
+    to a running cluster (ray: ray.init(address=...)).
+    """
+    global _initialized
+    if _initialized:
+        raise RuntimeError("ray_tpu.init() already called; "
+                           "call ray_tpu.shutdown() first")
+    config = Config().override(_system_config)
+    if object_store_memory:
+        config.object_store_memory = object_store_memory
+
+    if address is None:
+        _, cinfo = _spawn(["ray_tpu._private.controller",
+                           "--config-json", config.to_json()])
+        controller_addr = cinfo["controller_addr"]
+        agent_args = ["ray_tpu._private.node_agent",
+                      "--controller", controller_addr,
+                      "--config-json", config.to_json()]
+        if resources is not None:
+            agent_args += ["--resources-json", json.dumps(resources)]
+        _, ainfo = _spawn(agent_args)
+        agent_addr = ainfo["agent_addr"]
+        node_id = ainfo["node_id"]
+    else:
+        controller_addr = address
+        agent_addr, node_id = _pick_agent(controller_addr)
+
+    from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+    core = CoreWorker(mode="driver", controller_addr=controller_addr,
+                      agent_addr=agent_addr, config=config,
+                      node_id=node_id, job_id=JobID.from_random().hex(),
+                      namespace=namespace)
+    core.start()
+    # Fetch pub address + register the job.
+    reply, _ = core.call(controller_addr, "ping", {}, timeout=30.0)
+    if reply.get("pub_addr"):
+        core.connect_events(reply["pub_addr"])
+    core.call(controller_addr, "register_job",
+              {"job_id": core.job_id, "driver_addr": core.address})
+    set_global_worker(core)
+    _initialized = True
+    atexit.register(shutdown)
+    return {"controller_address": controller_addr, "node_id": node_id}
+
+
+def _pick_agent(controller_addr: str, timeout: float = 30.0) -> tuple[str, str]:
+    """Attach to an existing cluster: wait for an alive node and use its agent."""
+    import asyncio
+
+    import zmq.asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def _go():
+        ctx = zmq.asyncio.Context()
+        cli = RpcClient(ctx, controller_addr)
+        deadline = time.monotonic() + timeout
+        try:
+            while time.monotonic() < deadline:
+                reply, _ = await cli.call("list_nodes", {}, timeout=10.0)
+                nodes = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
+                if nodes:
+                    return nodes[0]["agent_addr"], nodes[0]["node_id"]
+                await asyncio.sleep(0.2)
+            raise TimeoutError("no alive nodes in cluster")
+        finally:
+            cli.close()
+            ctx.term()
+
+    return asyncio.run(_go())
+
+
+def shutdown() -> None:
+    global _initialized
+    from ray_tpu._private import worker as worker_mod
+
+    if worker_mod._global_worker is not None:
+        try:
+            worker_mod._global_worker.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    for proc in _head_processes:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in _head_processes:
+        try:
+            proc.wait(timeout=3.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    _head_processes.clear()
+    _initialized = False
+    atexit.unregister(shutdown)
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote decorator for functions and classes
+    (ray: worker.py:3171)."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    return decorator
+
+
+def get(refs: ObjectRef | Sequence[ObjectRef],
+        *, timeout: float | None = None) -> Any:
+    from ray_tpu._private.worker import global_worker
+
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
+    values = global_worker().get_objects(ref_list, timeout)
+    return values[0] if single else values
+
+
+def put(value: Any) -> ObjectRef:
+    from ray_tpu._private.worker import global_worker
+
+    if isinstance(value, ObjectRef):
+        raise TypeError("calling put() on an ObjectRef is not allowed")
+    return global_worker().put_object(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None,
+         fetch_local: bool = True) -> tuple[list[ObjectRef], list[ObjectRef]]:
+    from ray_tpu._private.worker import global_worker
+
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return global_worker().wait(refs, num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().kill_actor(actor.actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().cancel_task(ref)
+
+
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(
+        core.controller_addr, "get_actor_by_name",
+        {"name": name, "namespace": namespace or core.namespace},
+        timeout=30.0)
+    if not reply.get("found"):
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(reply["actor_id"])
+
+
+def available_resources() -> dict[str, float]:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "list_nodes", timeout=30.0)
+    out: dict[str, float] = {}
+    for n in reply["nodes"]:
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["available"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def cluster_resources() -> dict[str, float]:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "list_nodes", timeout=30.0)
+    out: dict[str, float] = {}
+    for n in reply["nodes"]:
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["resources"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def nodes() -> list[dict]:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "list_nodes", timeout=30.0)
+    return reply["nodes"]
+
+
+def timeline() -> list[dict]:
+    """Task state-transition events (ray: ray timeline → Chrome trace)."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "get_task_events",
+                         timeout=30.0)
+    return reply["events"]

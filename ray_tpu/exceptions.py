@@ -1,0 +1,62 @@
+"""Public exception types (analog of ray: python/ray/exceptions.py)."""
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised; carries the remote traceback text and original cause.
+
+    Raised from ray_tpu.get on a failed task's ObjectRef
+    (ray: RayTaskError python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause: BaseException, remote_tb: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        super().__init__(f"{type(cause).__name__}: {cause}\n{remote_tb}")
+
+
+class ActorError(RayTpuError):
+    """Actor call failed because the actor is dead or died mid-call
+    (ray: RayActorError)."""
+
+    def __init__(self, actor_id: str = "", cause: str = ""):
+        self.actor_id = actor_id
+        self.cause = cause
+        super().__init__(f"actor {actor_id[:8]} unavailable: {cause}")
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    """Object is gone from every node store and could not be reconstructed
+    (ray: ObjectLostError / ObjectReconstructionFailedError)."""
+
+    def __init__(self, object_id: str = ""):
+        self.object_id = object_id
+        super().__init__(f"object {object_id[:12]} lost")
+
+
+class WorkerCrashedError(RayTpuError):
+    """Worker process died while executing the task (ray: WorkerCrashedError)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get(timeout=...) expired (ray: GetTimeoutError)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task cancelled via ray_tpu.cancel (ray: TaskCancelledError)."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass

@@ -1,0 +1,8 @@
+"""Model zoo: TPU-first functional implementations (pure param pytrees +
+jit-able apply functions; no framework lock-in, shardings are declared as
+logical-axes pytrees consumed by ray_tpu.parallel)."""
+from ray_tpu.models.llama import (LlamaConfig, llama_configs, init_params,
+                                  forward, loss_fn, param_logical_axes)
+
+__all__ = ["LlamaConfig", "llama_configs", "init_params", "forward",
+           "loss_fn", "param_logical_axes"]

@@ -1,0 +1,75 @@
+"""ObjectRef: a future for a value in the distributed object store.
+
+Analog of ray.ObjectRef (ray: python/ray/_raylet.pyx ObjectRef).  A ref
+carries its owner's RPC address so any holder can resolve it by asking the
+owner (the reference's ownership model: reference_count.cc /
+ownership_based_object_directory.cc).  Local in-scope refs participate in
+owner-side reference counting via the release hook installed by the worker.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_release_hook: Callable[[bytes], None] | None = None
+
+
+def set_release_hook(hook: Callable[[bytes], None] | None) -> None:
+    global _release_hook
+    _release_hook = hook
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_addr", "__weakref__")
+
+    def __init__(self, object_id: bytes, owner_addr: str = ""):
+        self._id = object_id
+        self._owner_addr = owner_addr
+
+    @classmethod
+    def _from_serialized(cls, object_id: bytes, owner_addr: str) -> "ObjectRef":
+        return cls(object_id, owner_addr)
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def owner_addr(self) -> str:
+        return self._owner_addr
+
+    def future(self):
+        """concurrent.futures.Future view of this ref (asyncio interop)."""
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker().ref_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        from ray_tpu._private.worker import global_worker
+
+        fut = asyncio.wrap_future(global_worker().ref_future(self))
+        return fut.__await__()
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()[:16]}…)"
+
+    def __del__(self):
+        if _release_hook is not None:
+            try:
+                _release_hook(self._id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+
+    def __reduce__(self):
+        # Plain pickle path (outside task-arg serialization, which uses the
+        # reducer_override in serialization.py to also track borrowers).
+        return (ObjectRef._from_serialized, (self._id, self._owner_addr))

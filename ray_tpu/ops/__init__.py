@@ -1,0 +1,6 @@
+"""TPU compute ops: Pallas kernels + XLA fallbacks for the hot paths."""
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["attention", "rmsnorm", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,71 @@
+"""Attention dispatcher: Pallas flash kernel on TPU, XLA einsum fallback.
+
+The hot op of the whole framework (SURVEY §7: attention is where fusion
+genuinely fails without a kernel — the [b, h, s, s] score matrix must never
+materialize in HBM at long context).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True,
+                  q_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+    """Reference implementation: fp32 softmax, GQA, causal mask.
+
+    q: [b, sq, hq, d]; k/v: [b, skv, hkv, d].  q_offset shifts query
+    positions relative to kv positions (decode with a cache).
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, skv = q.shape[1], k.shape[1]
+        qpos = jnp.arange(sq)[:, None] + q_offset
+        kpos = jnp.arange(skv)[None, :]
+        mask = qpos >= kpos
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "impl"))
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              causal: bool = True, impl: str = "auto",
+              q_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+    """Multi-head attention with GQA.
+
+    impl: "auto" picks the Pallas flash kernel on TPU for long-enough
+    sequences, XLA otherwise (short sequences / CPU tests / decode).
+    """
+    use_flash = False
+    if impl == "flash":
+        use_flash = True
+    elif impl == "auto":
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+        # Flash kernel requires seq multiple of its block size.
+        use_flash = (on_tpu and causal and q.shape[1] == k.shape[1]
+                     and q.shape[1] % 128 == 0 and q.shape[-1] % 128 == 0)
+    if use_flash:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal, q_offset=q_offset)

@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+RMSNorm in fp32 accumulation regardless of input dtype (bf16-safe): the
+variance reduction is tiny relative to the surrounding matmuls, so XLA fuses
+it into the neighboring ops; a Pallas kernel buys nothing here (HBM-bound
+either way) — kernels are reserved for attention where fusion actually
+fails (see ops/flash_attention.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jnp.ndarray, weight: jnp.ndarray,
+            eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

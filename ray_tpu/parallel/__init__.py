@@ -1,0 +1,16 @@
+"""Parallelism layer: device meshes, sharding rules, collectives, ring
+attention.  The TPU-native replacement for the reference's four collective
+planes (SURVEY §2.4): inside a slice everything is XLA collectives over ICI
+scheduled by the compiler; this package only *declares* the layout.
+"""
+from ray_tpu.parallel.mesh import (MeshConfig, create_mesh, local_mesh,
+                                   mesh_shape_for)
+from ray_tpu.parallel.sharding import (LOGICAL_RULES, logical_sharding,
+                                       logical_spec, shard_params,
+                                       with_sharding_constraint)
+
+__all__ = [
+    "MeshConfig", "create_mesh", "local_mesh", "mesh_shape_for",
+    "LOGICAL_RULES", "logical_spec", "logical_sharding", "shard_params",
+    "with_sharding_constraint",
+]

@@ -1,0 +1,101 @@
+"""Logical-axis sharding rules (GSPMD layout declarations).
+
+The reference's DP/FSDP come from torch DDP/FSDP wrappers
+(ray: python/ray/train/torch/train_loop_utils.py:158,184); here every
+parallelism strategy is a *layout*: logical array axes map to mesh axes and
+XLA inserts the collectives (ZeRO-3 ≈ params sharded over "fsdp";
+Megatron-TP ≈ hidden/heads sharded over "tensor"; sequence parallelism ≈
+tokens sharded over "seq").
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axes (None = replicated).
+# fsdp shards the *largest* param axis; tensor shards the Megatron axis.
+LOGICAL_RULES: dict[str, tuple | str | None] = {
+    "batch": ("data", "fsdp"),   # batch sharded over dp × fsdp (fsdp reuses
+                                 # the data axis for activations, ZeRO style)
+    "seq": "seq",                # sequence/context parallel axis
+    "embed": "fsdp",             # param embedding dim: fsdp-sharded
+    "mlp": "tensor",             # ffn hidden: Megatron column/row split
+    "heads": "tensor",           # attention heads: tensor-parallel
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "vocab": "tensor",           # output projection vocab split
+    "expert": "expert",          # MoE expert dimension
+    "layers": None,              # scan-stacked layer dim stays replicated
+}
+
+
+def logical_spec(logical_axes: tuple[str | None, ...],
+                 rules: dict | None = None) -> P:
+    """Translate logical axis names to a PartitionSpec via the rules table."""
+    rules = rules or LOGICAL_RULES
+    spec = []
+    used: set[str] = set()
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        mesh_axes = rules.get(name)
+        if mesh_axes is None:
+            spec.append(None)
+        elif isinstance(mesh_axes, str):
+            spec.append(None if mesh_axes in used else mesh_axes)
+            used.add(mesh_axes)
+        else:
+            avail = tuple(a for a in mesh_axes if a not in used)
+            used.update(avail)
+            spec.append(avail if avail else None)
+    return P(*spec)
+
+
+def logical_sharding(mesh: Mesh, logical_axes: tuple[str | None, ...],
+                     rules: dict | None = None) -> NamedSharding:
+    spec = logical_spec(logical_axes, rules)
+    # Drop mesh axes of size 1?  Not needed: XLA treats them as replicated.
+    spec = P(*[_prune(mesh, s) for s in spec])
+    return NamedSharding(mesh, spec)
+
+
+def _prune(mesh: Mesh, entry):
+    """Remove axes not present in the mesh (lets one rules table serve
+    meshes with fewer axes)."""
+    if entry is None:
+        return None
+    if isinstance(entry, str):
+        return entry if entry in mesh.axis_names else None
+    kept = tuple(a for a in entry if a in mesh.axis_names)
+    return kept if kept else None
+
+
+def shard_params(params, axes_tree, mesh: Mesh, rules: dict | None = None):
+    """Device-put a param pytree according to its logical-axes pytree."""
+    shardings = jax.tree.map(
+        lambda ax: logical_sharding(mesh, ax, rules), axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple))
+    return jax.device_put(params, shardings)
+
+
+def param_shardings(axes_tree, mesh: Mesh, rules: dict | None = None):
+    return jax.tree.map(
+        lambda ax: logical_sharding(mesh, ax, rules), axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def with_sharding_constraint(x, logical_axes: tuple[str | None, ...],
+                             mesh: Mesh | None = None,
+                             rules: dict | None = None):
+    """Annotate an intermediate value's layout inside jit
+    (jax.lax.with_sharding_constraint with logical names)."""
+    if mesh is None:
+        try:
+            mesh = jax.sharding.get_abstract_mesh()  # inside jit
+        except Exception:  # noqa: BLE001
+            return x
+    spec = logical_spec(logical_axes, rules)
+    spec = P(*[_prune(mesh, s) for s in spec])
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec) if isinstance(mesh, Mesh) else spec)

@@ -1,0 +1,91 @@
+"""RemoteFunction: the object @ray_tpu.remote wraps a function into.
+
+Analog of ray: python/ray/remote_function.py (RemoteFunction, _remote:266).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "num_returns", "resources", "max_retries",
+    "retry_exceptions", "name", "scheduling_strategy", "placement_group",
+    "placement_group_bundle_index", "runtime_env", "memory",
+}
+
+
+def validate_options(opts: dict) -> None:
+    """ray: python/ray/_private/ray_option_utils.py validation table."""
+    for k in opts:
+        if k not in _OPTION_KEYS:
+            raise ValueError(f"unknown option {k!r}; valid: {sorted(_OPTION_KEYS)}")
+    if "num_returns" in opts and opts["num_returns"] is not None:
+        if not isinstance(opts["num_returns"], int) or opts["num_returns"] < 0:
+            raise ValueError("num_returns must be a non-negative int")
+
+
+def resolve_pg_options(opts: dict) -> dict:
+    """Translate placement-group / scheduling-strategy options into the
+    internal bundle_key the agent's resource pools understand."""
+    out = dict(opts)
+    strategy = out.pop("scheduling_strategy", None)
+    pg = out.pop("placement_group", None)
+    idx = out.pop("placement_group_bundle_index", -1)
+    if strategy is not None and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        idx = getattr(strategy, "placement_group_bundle_index", -1) or -1
+    if pg is not None:
+        out["pg_id"] = pg.id
+        out["bundle_index"] = idx
+        out["bundle_key"] = f"{pg.id}:{max(idx, 0)}"
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, **default_options):
+        validate_options(default_options)
+        self._function = fn
+        self._default_options = default_options
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_options)
+
+    def options(self, **options) -> "RemoteFunction":
+        validate_options(options)
+        merged = {**self._default_options, **options}
+        clone = RemoteFunction(self._function, **{})
+        clone._default_options = merged
+        return clone
+
+    def _remote(self, args: tuple, kwargs: dict, opts: dict):
+        from ray_tpu._private.worker import global_worker
+
+        options = resolve_pg_options(opts)
+        if options.get("placement_group") == "default":
+            options.pop("placement_group")
+        core = global_worker()
+        if "pg_id" in options:
+            _wait_pg_ready(core, options["pg_id"])
+        refs = core.submit_task(self._function, args, kwargs, options)
+        n = options.get("num_returns", 1)
+        if n == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "remote functions cannot be called directly; use "
+            f"{getattr(self._function, '__name__', 'fn')}.remote()")
+
+    def __repr__(self):
+        return f"RemoteFunction({getattr(self._function, '__name__', '?')})"
+
+
+def _wait_pg_ready(core, pg_id: str) -> None:
+    reply, _ = core.call(
+        core.controller_addr, "pg_ready",
+        {"pg_id": pg_id, "wait": True, "timeout": 120.0}, timeout=150.0)
+    if reply.get("state") != "CREATED":
+        raise RuntimeError(f"placement group {pg_id[:8]} not ready: "
+                           f"{reply.get('state')}")

@@ -1,0 +1,46 @@
+"""Runtime context: introspection of the current worker/task/actor.
+
+Analog of ray: python/ray/runtime_context.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class RuntimeContext:
+    job_id: str
+    node_id: str
+    worker_id: str
+    actor_id: str | None
+    task_id: str | None
+    namespace: str
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_actor_id(self) -> str | None:
+        return self.actor_id
+
+    def get_task_id(self) -> str | None:
+        return self.task_id
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    return RuntimeContext(
+        job_id=core.job_id,
+        node_id=core.node_id,
+        worker_id=core.worker_id,
+        actor_id=core.current_actor_id,
+        task_id=core.current_task_id,
+        namespace=core.namespace,
+    )

@@ -1,0 +1,29 @@
+"""Test fixtures.
+
+Mirrors the reference's strategy (SURVEY §4): a shared single-node runtime
+for most tests (ray: ray_start_shared fixtures), explicit multi-agent
+Cluster for scheduling/fault tests, and jax pinned to an 8-device virtual
+CPU platform so multi-chip sharding logic runs on one machine
+(the fake-ICI analog of ray's FakeMultiNodeProvider / MockNcclGroup).
+"""
+import os
+
+# Must be set before jax ever initializes: 8 virtual CPU devices stand in
+# for an 8-chip slice in all sharding tests.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ray_shared():
+    """One shared local cluster for the whole session (4 CPUs)."""
+    import ray_tpu
+
+    ray_tpu.init(resources={"CPU": 4})
+    yield ray_tpu
+    ray_tpu.shutdown()

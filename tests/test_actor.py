@@ -1,0 +1,164 @@
+"""Actor API tests (analog of ray: python/ray/tests/test_actor.py)."""
+import time
+
+import pytest
+
+
+def test_counter_ordering(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, d=1):
+            self.v += d
+            return self.v
+
+    c = Counter.remote(100)
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(101, 121))
+
+
+def test_actor_state_isolated(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+    a, b = Holder.remote(), Holder.remote()
+    ray_tpu.get([a.add.remote(1), a.add.remote(2)])
+    assert ray_tpu.get(b.add.remote(9)) == 1
+
+
+def test_named_actor(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc-test").remote()
+    h = ray_tpu.get_actor("svc-test")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+
+
+def test_get_actor_missing(ray_shared):
+    ray_tpu = ray_shared
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist-xyz")
+
+
+def test_async_actor_concurrency(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, i):
+            import asyncio
+            await asyncio.sleep(0.2)
+            return i
+
+    a = AsyncActor.remote()
+    ray_tpu.get(a.work.remote(-1))       # warm: actor created, addr cached
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.work.remote(i) for i in range(5)])
+    elapsed = time.monotonic() - t0
+    assert out == list(range(5))
+    # Concurrent: five 0.2s sleeps must overlap, not serialize to 1s.
+    assert elapsed < 0.9, elapsed
+
+
+def test_actor_error(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise KeyError("nope")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(b.fail.remote())
+    # Actor survives its own exceptions.
+    assert ray_tpu.get(b.ok.remote()) == 1
+
+
+def test_handle_passing(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(handle, v):
+        import ray_tpu as rt
+        rt.get(handle.set.remote(v))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, 42))
+    assert ray_tpu.get(s.get.remote()) == 42
+
+
+def test_kill_actor(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == 1
+    ray_tpu.kill(v)
+    with pytest.raises(ray_tpu.ActorError):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_num_returns(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Multi:
+        def pair(self):
+            return "a", "b"
+
+    m = Multi.remote()
+    r1, r2 = m.pair.options(num_returns=2).remote()
+    assert ray_tpu.get([r1, r2]) == ["a", "b"]
+
+
+def test_threaded_actor_max_concurrency(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Slow.remote()
+    ray_tpu.get(s.work.remote())         # warm
+    t0 = time.monotonic()
+    assert sum(ray_tpu.get([s.work.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - t0 < 1.1

@@ -1,0 +1,191 @@
+"""Core task API tests (analog of ray: python/ray/tests/test_basic*.py)."""
+import time
+
+import pytest
+
+
+def test_simple_task(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_put_get(ray_shared):
+    ray_tpu = ray_shared
+    obj = {"a": [1, 2, 3], "b": "hello"}
+    assert ray_tpu.get(ray_tpu.put(obj)) == obj
+
+
+def test_put_large_numpy(ray_shared):
+    import numpy as np
+    ray_tpu = ray_shared
+    arr = np.arange(1_000_000, dtype=np.float32)   # 4MB > inline threshold
+    out = ray_tpu.get(ray_tpu.put(arr))
+    assert (out == arr).all()
+
+
+def test_ref_as_arg(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)
+    assert ray_tpu.get(r2) == 13
+
+
+def test_chained_dependencies(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_error_propagation(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert isinstance(ei.value.cause, ValueError)
+    assert "kaboom" in str(ei.value)
+
+
+def test_error_through_dependency(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("first")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(use.remote(boom.remote()))
+
+
+def test_num_returns(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_wait(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(5.0)]
+    done, rest = ray_tpu.wait(refs, num_returns=1, timeout=3.0)
+    assert len(done) == 1 and len(rest) == 1
+    assert ray_tpu.get(done[0]) == 0.05
+
+
+def test_wait_timeout(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    done, rest = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.2)
+    assert done == [] and len(rest) == 1
+
+
+def test_get_timeout(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(5)) == 11
+
+
+def test_options_override(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == 1
+
+
+def test_invalid_option():
+    import ray_tpu as rt
+    with pytest.raises(ValueError):
+        @rt.remote(bogus_option=1)
+        def f():
+            pass
+
+
+def test_runtime_context(ray_shared):
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def ctx():
+        import ray_tpu as rt
+        c = rt.get_runtime_context()
+        return c.worker_id, c.task_id
+
+    wid, tid = ray_tpu.get(ctx.remote())
+    assert wid and tid
+
+
+def test_cluster_resources(ray_shared):
+    ray_tpu = ray_shared
+    assert ray_tpu.cluster_resources().get("CPU") == 4.0
+    assert len(ray_tpu.nodes()) >= 1
