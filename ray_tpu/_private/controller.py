@@ -70,6 +70,8 @@ class ActorInfo:
     detached: bool = False
     pg_id: str | None = None
     bundle_index: int = -1
+    affinity_node_id: str | None = None
+    affinity_soft: bool = False
 
 
 @dataclass
@@ -236,6 +238,8 @@ class Controller:
             detached=h.get("detached", False),
             pg_id=h.get("pg_id"), bundle_index=h.get("bundle_index", -1),
         )
+        actor.affinity_node_id = h.get("affinity_node_id")
+        actor.affinity_soft = h.get("affinity_soft", False)
         self.actors[actor.actor_id] = actor
         if name:
             self.named_actors[(namespace, name)] = actor.actor_id
@@ -258,6 +262,9 @@ class Controller:
                 idx = actor.bundle_index if actor.bundle_index >= 0 else 0
                 node_id = pg.bundle_nodes.get(idx)
                 strategy = sched.NodeAffinity(node_id, soft=False)
+            elif actor.affinity_node_id:
+                strategy = sched.NodeAffinity(actor.affinity_node_id,
+                                              soft=actor.affinity_soft)
             node_id = sched.pick_node(view, actor.resources, self.config,
                                       strategy=strategy)
             if node_id is None:

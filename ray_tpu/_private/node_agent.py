@@ -222,12 +222,12 @@ class NodeAgent:
         if n_alive >= self.config.max_workers_per_node:
             return None
         w = self._spawn_worker()
-        try:
-            await asyncio.wait_for(
-                asyncio.shield(self._starting.get(w.worker_id, asyncio.sleep(0))),
-                timeout=60.0)
-        except asyncio.TimeoutError:
-            return None
+        fut = self._starting.get(w.worker_id)
+        if fut is not None:
+            try:
+                await asyncio.wait_for(asyncio.shield(fut), timeout=60.0)
+            except asyncio.TimeoutError:
+                return None
         return w if w.state == "idle" else None
 
     async def _get_device_worker(self) -> WorkerHandle | None:
@@ -333,6 +333,23 @@ class NodeAgent:
         """Grant a worker lease, queue, or point at a better node
         (ray: NodeManager::HandleRequestWorkerLease node_manager.cc:1794)."""
         demand = h.get("resources", {})
+        affinity = h.get("affinity_node_id")
+        soft = h.get("affinity_soft", False)
+        if affinity and affinity != self.node_id:
+            # Route to the pinned node only if it could ever run the task
+            # (feasible by totals); it queues locally when merely busy.
+            target = self.cluster_view.get(affinity)
+            if target is not None and sched.feasible(target["total"], demand):
+                return {"spill_to": target["agent_addr"]}
+            if not soft:
+                return {"unfeasible": True}
+            affinity = None    # soft: fall back to normal scheduling
+        if affinity == self.node_id and not sched.feasible(self.resources,
+                                                           demand):
+            # Hard-pinned here but this node can never run it.
+            if not soft:
+                return {"unfeasible": True}
+            affinity = None
         if not h.get("bundle_key") and not sched.feasible(self.resources, demand):
             # Infeasible here: spill to any feasible node (ray: Spillback).
             view = {nid: v for nid, v in self.cluster_view.items()
@@ -347,7 +364,7 @@ class NodeAgent:
         # (pack-then-spread keeps locality by preferring the local node).
         view = {nid: v for nid, v in self.cluster_view.items()
                 if nid != self.node_id}
-        if not h.get("bundle_key"):
+        if not h.get("bundle_key") and not affinity:
             target = sched.pick_node(view, demand, self.config)
             if target is not None and h.get("allow_spill", True):
                 return {"spill_to": self.cluster_view[target]["agent_addr"]}

@@ -123,6 +123,8 @@ class LeaseManager:
         self.headers[task.scheduling_key] = {
             "resources": task.header.get("resources", {}),
             "bundle_key": task.header.get("bundle_key"),
+            "affinity_node_id": task.header.get("affinity_node_id"),
+            "affinity_soft": task.header.get("affinity_soft", False),
             "submitter": self.core.address,
         }
         ev = self.arrivals.get(task.scheduling_key)
@@ -324,8 +326,18 @@ class CoreWorker:
             await self.loop.run_in_executor(None, self._shutdown.wait)
         finally:
             flusher.cancel()
+            sub = getattr(self, "subscriber", None)
+            if sub is not None:
+                sub.close()
             self.server.close()
             self.clients.close()
+            # Terminate the context here, with every socket closed and
+            # LINGER 0 — a leaked live socket makes Context.__del__ block
+            # the whole interpreter at GC time.
+            try:
+                self.ctx.destroy(linger=0)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _subscribe_events(self, pub_addr: str) -> None:
         """Subscribe to controller events (must run on the IO loop)."""
@@ -401,7 +413,9 @@ class CoreWorker:
             bundle_key, options)
         retries = options.get("max_retries",
                               self.config.default_task_max_retries)
-        scheduling_key = (fid, _freeze(resources), bundle_key)
+        scheduling_key = (fid, _freeze(resources), bundle_key,
+                          options.get("affinity_node_id"),
+                          options.get("affinity_soft", False))
         task = PendingTask(
             task_id=task_id.binary(), header=header, blobs=blobs,
             return_ids=return_ids, retries_left=max(0, retries),
@@ -452,6 +466,9 @@ class CoreWorker:
             "bundle_key": bundle_key,
             "name": options.get("name", ""),
         }
+        if options.get("affinity_node_id"):
+            header["affinity_node_id"] = options["affinity_node_id"]
+            header["affinity_soft"] = options.get("affinity_soft", False)
         return header, sv.frames
 
     def _add_borrow(self, ref: ObjectRef) -> None:
@@ -1070,7 +1087,9 @@ class CoreWorker:
              "get_if_exists": options.get("get_if_exists", False),
              "detached": options.get("lifetime") == "detached",
              "pg_id": options.get("pg_id"),
-             "bundle_index": options.get("bundle_index", -1)},
+             "bundle_index": options.get("bundle_index", -1),
+             "affinity_node_id": options.get("affinity_node_id"),
+             "affinity_soft": options.get("affinity_soft", False)},
             blobs, timeout=120.0)
         if reply.get("error"):
             raise ValueError(reply["error"])

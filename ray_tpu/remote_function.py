@@ -34,6 +34,9 @@ def resolve_pg_options(opts: dict) -> dict:
     if strategy is not None and hasattr(strategy, "placement_group"):
         pg = strategy.placement_group
         idx = getattr(strategy, "placement_group_bundle_index", -1) or -1
+    elif strategy is not None and hasattr(strategy, "node_id"):
+        out["affinity_node_id"] = strategy.node_id
+        out["affinity_soft"] = bool(getattr(strategy, "soft", False))
     if pg is not None:
         out["pg_id"] = pg.id
         out["bundle_index"] = idx

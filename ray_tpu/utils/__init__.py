@@ -1,0 +1,15 @@
+"""Utility layer: placement groups, scheduling strategies, actor pool,
+distributed queue, collectives (analog of ray: python/ray/util/)."""
+from ray_tpu.utils.actor_pool import ActorPool
+from ray_tpu.utils.placement_group import (placement_group,
+                                           placement_group_table,
+                                           remove_placement_group)
+from ray_tpu.utils.queue import Queue
+from ray_tpu.utils.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "placement_group", "remove_placement_group", "placement_group_table",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "ActorPool", "Queue",
+]

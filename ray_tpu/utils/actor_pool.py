@@ -1,0 +1,74 @@
+"""ActorPool: load-balance tasks over a fixed set of actors
+(analog of ray: python/ray/util/actor_pool.py)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._pending: list[tuple[Callable, Any]] = []
+        self._results_order: list = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef"""
+        if self._idle:
+            actor = self._idle.pop(0)
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (actor, fn)
+            self._results_order.append(ref)
+        else:
+            self._pending.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in submission order."""
+        import ray_tpu
+
+        if not self._results_order:
+            raise StopIteration("no pending results")
+        ref = self._results_order.pop(0)
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._on_done(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        import ray_tpu
+
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        done, _ = ray_tpu.wait(list(self._future_to_actor),
+                               num_returns=1, timeout=timeout)
+        if not done:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = done[0]
+        self._results_order.remove(ref)
+        value = ray_tpu.get(ref)
+        self._on_done(ref)
+        return value
+
+    def _on_done(self, ref) -> None:
+        actor, _fn = self._future_to_actor.pop(ref)
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            new_ref = fn(actor, value)
+            self._future_to_actor[new_ref] = (actor, fn)
+            self._results_order.append(new_ref)
+        else:
+            self._idle.append(actor)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,92 @@
+"""Placement groups: gang resource reservation across the cluster.
+
+Analog of ray: python/ray/util/placement_group.py:41,145.  On TPU the
+bundle is the unit of slice-coherent placement: STRICT_PACK puts every
+bundle on one host (one ICI domain), STRICT_SPREAD gives per-host fault
+isolation for multi-host training (SURVEY §2.4 gang-scheduling row).
+"""
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: list[dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def ready(self, timeout: float = 60.0) -> bool:
+        """Block until all bundles are reserved (ray: pg.ready())."""
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            reply, _ = core.call(
+                core.controller_addr, "pg_ready",
+                {"pg_id": self.id, "wait": True,
+                 "timeout": max(0.1, deadline - time.monotonic())},
+                timeout=timeout + 10)
+            if reply.get("state") == "CREATED":
+                return True
+            if reply.get("state") == "REMOVED":
+                return False
+        return False
+
+    def bundle_locations(self) -> dict[int, str]:
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker()
+        reply, _ = core.call(core.controller_addr, "pg_ready",
+                             {"pg_id": self.id}, timeout=30.0)
+        return {int(k): v for k, v in reply.get("bundle_nodes", {}).items()}
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(bundles: Sequence[dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str | None = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"invalid strategy {strategy!r}; valid: {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    from ray_tpu._private.ids import PlacementGroupID
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    pg_id = PlacementGroupID.from_random().hex()
+    core.call(core.controller_addr, "create_pg",
+              {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
+               "strategy": strategy, "name": name}, timeout=30.0)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    core.call(core.controller_addr, "remove_pg", {"pg_id": pg.id},
+              timeout=30.0)
+
+
+def placement_group_table() -> list[dict]:
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "list_pgs", timeout=30.0)
+    return reply["pgs"]

@@ -1,0 +1,87 @@
+"""Distributed FIFO queue backed by an async actor
+(analog of ray: python/ray/util/queue.py)."""
+from __future__ import annotations
+
+from typing import Any
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item: Any, timeout: float | None = None) -> bool:
+        import asyncio
+
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: float | None = None):
+        import asyncio
+
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def empty(self) -> bool:
+        return self._q.empty()
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, name: str | None = None):
+        import ray_tpu
+
+        cls = ray_tpu.remote(_QueueActor)
+        if name:
+            cls = cls.options(name=name)
+        self._actor = cls.remote(maxsize)
+
+    def put(self, item: Any, timeout: float | None = None) -> None:
+        import ray_tpu
+
+        ok = ray_tpu.get(self._actor.put.remote(item, timeout))
+        if not ok:
+            raise Full("queue put timed out")
+
+    def get(self, timeout: float | None = None) -> Any:
+        import ray_tpu
+
+        ok, value = ray_tpu.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue get timed out")
+        return value
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def __reduce__(self):
+        return (Queue._from_actor, (self._actor,))
+
+    @classmethod
+    def _from_actor(cls, actor) -> "Queue":
+        q = cls.__new__(cls)
+        q._actor = actor
+        return q

@@ -1,0 +1,22 @@
+"""Scheduling strategy objects passed via options(scheduling_strategy=...).
+
+Analog of ray: python/ray/util/scheduling_strategies.py:15,41,135.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ray_tpu.utils.placement_group import PlacementGroup
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: PlacementGroup
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False

@@ -19,11 +19,21 @@ if "host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture
 def ray_shared():
-    """One shared local cluster for the whole session (4 CPUs)."""
+    """Shared local cluster (4 CPUs): initialized on first use, re-created
+    if another fixture (e.g. the multi-node cluster) tore it down."""
     import ray_tpu
 
-    ray_tpu.init(resources={"CPU": 4})
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
     yield ray_tpu
-    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _shutdown_at_end():
+    yield
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()

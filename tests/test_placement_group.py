@@ -1,0 +1,117 @@
+"""Placement group + scheduling strategy tests
+(analog of ray: python/ray/tests/test_placement_group*.py)."""
+import pytest
+
+
+def test_pg_create_ready(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    locs = pg.bundle_locations()
+    assert len(locs) == 2
+    remove_placement_group(pg)
+
+
+def test_pg_task_scheduling(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import (PlacementGroupSchedulingStrategy,
+                               placement_group, remove_placement_group)
+
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    strat = PlacementGroupSchedulingStrategy(pg,
+                                             placement_group_bundle_index=0)
+    node = ray_tpu.get(where.options(
+        scheduling_strategy=strat, num_cpus=1).remote())
+    assert node == pg.bundle_locations()[0]
+    remove_placement_group(pg)
+
+
+def test_pg_actor(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import (PlacementGroupSchedulingStrategy,
+                               placement_group, remove_placement_group)
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    class A:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        pg, placement_group_bundle_index=0)).remote()
+    assert ray_tpu.get(a.node.remote()) == pg.bundle_locations()[0]
+    del a
+    remove_placement_group(pg)
+
+
+def test_pg_invalid(ray_shared):
+    from ray_tpu.utils import placement_group
+
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([])
+
+
+def test_pg_infeasible_pending(ray_shared):
+    """A PG demanding more than the cluster has stays PENDING."""
+    from ray_tpu.utils import (placement_group, placement_group_table,
+                               remove_placement_group)
+
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    assert not pg.ready(timeout=1.5)
+    states = {p["pg_id"]: p["state"] for p in placement_group_table()}
+    assert states[pg.id] == "PENDING"
+    remove_placement_group(pg)
+
+
+def test_node_affinity(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import NodeAffinitySchedulingStrategy
+
+    node_id = ray_tpu.nodes()[0]["node_id"]
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    got = ray_tpu.get(where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id)).remote())
+    assert got == node_id
+
+
+def test_actor_pool(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import ActorPool
+
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote(), Sq.remote()])
+    out = list(pool.map(lambda a, v: a.sq.remote(v), range(8)))
+    assert out == [i * i for i in range(8)]
+
+
+def test_queue(ray_shared):
+    from ray_tpu.utils.queue import Empty, Queue
+
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get(timeout=0.1)
