@@ -1,0 +1,161 @@
+"""Benchmark driver: control-plane microbenchmarks + TPU model step.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+Headline metric = single-client async task throughput, matching the
+reference's canonical microbenchmark (ray: python/ray/_private/ray_perf.py,
+published 8,011 tasks/s in release/perf_metrics/microbenchmark.json —
+see BASELINE.md).  vs_baseline = ours / reference.
+
+`extra` carries the rest of the suite (sync tasks, actor calls, put/get)
+plus the TPU compute bench: Llama train-step tokens/sec/chip and MFU on
+whatever the default jax device is (the real chip under the driver).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TASKS_ASYNC = 8011.0   # reference single_client_tasks_async
+PEAK_BF16 = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12,
+             "TPU v6 lite": 918e12}
+
+
+def bench_control_plane() -> dict:
+    import ray_tpu
+
+    ray_tpu.init(resources={"CPU": 8})
+    out = {}
+    try:
+        @ray_tpu.remote
+        def noop(*a):
+            return b"ok"
+
+        # warm the worker pool
+        ray_tpu.get([noop.remote() for _ in range(20)])
+
+        n = 2000
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        out["tasks_async_per_s"] = n / (time.perf_counter() - t0)
+
+        n = 300
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        out["tasks_sync_per_s"] = n / (time.perf_counter() - t0)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.v = 0
+
+            def inc(self):
+                self.v += 1
+                return self.v
+
+        c = Counter.remote()
+        ray_tpu.get(c.inc.remote())
+        n = 2000
+        t0 = time.perf_counter()
+        ray_tpu.get([c.inc.remote() for _ in range(n)])
+        out["actor_calls_async_per_s"] = n / (time.perf_counter() - t0)
+
+        n = 300
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(c.inc.remote())
+        out["actor_calls_sync_per_s"] = n / (time.perf_counter() - t0)
+
+        import numpy as np
+
+        small = np.zeros(1024, np.uint8)
+        n = 1000
+        t0 = time.perf_counter()
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        out["put_small_per_s"] = n / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ray_tpu.get(refs)
+        out["get_small_per_s"] = n / (time.perf_counter() - t0)
+
+        big = np.random.bytes(256 * 1024 * 1024)
+        t0 = time.perf_counter()
+        ref = ray_tpu.put(big)
+        dt = time.perf_counter() - t0
+        out["put_gib_per_s"] = len(big) / dt / (1 << 30)
+    finally:
+        ray_tpu.shutdown()
+    return {k: round(v, 1) for k, v in out.items()}
+
+
+def bench_model() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.train import step as train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg = llama.llama_configs()["bench-350m" if on_tpu else "debug"]
+    batch, seq = (8, cfg.max_seq) if on_tpu else (2, 128)
+
+    mesh = create_mesh(MeshConfig(data=-1), devices=jax.devices()[:1])
+    optimizer = train_step.default_optimizer(total_steps=1000)
+    state = train_step.sharded_init(jax.random.PRNGKey(0), cfg, optimizer,
+                                    mesh)
+    step_fn = train_step.sharded_train_step(cfg, optimizer, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    batch_d = {"inputs": tokens, "targets": tokens}
+
+    with jax.set_mesh(mesh):
+        state, m = step_fn(state, batch_d)   # compile + 1 step
+        jax.block_until_ready(m["loss"])
+        n_steps = 10 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, m = step_fn(state, batch_d)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * n_steps / dt
+    flops_per_token = 6.0 * cfg.num_params() + \
+        12.0 * cfg.n_layers * cfg.dim * seq
+    peak = next((v for k, v in PEAK_BF16.items() if str(dev).startswith(k)),
+                197e12)
+    mfu = tokens_per_s * flops_per_token / peak if on_tpu else 0.0
+    return {"model": "bench-350m" if on_tpu else "debug",
+            "device": str(dev),
+            "train_tokens_per_s_chip": round(tokens_per_s, 1),
+            "train_step_ms": round(dt / n_steps * 1000, 2),
+            "mfu": round(mfu, 4),
+            "loss": round(float(m["loss"]), 4)}
+
+
+def main() -> None:
+    extra = {}
+    try:
+        extra["model_bench"] = bench_model()
+    except Exception as e:  # noqa: BLE001
+        extra["model_bench"] = {"error": repr(e)}
+    try:
+        cp = bench_control_plane()
+        extra.update(cp)
+        value = cp["tasks_async_per_s"]
+    except Exception as e:  # noqa: BLE001
+        extra["control_plane_error"] = repr(e)
+        value = 0.0
+    print(json.dumps({
+        "metric": "single_client_tasks_async",
+        "value": value,
+        "unit": "tasks/s",
+        "vs_baseline": round(value / BASELINE_TASKS_ASYNC, 4),
+        "extra": extra,
+    }))
+
+
+if __name__ == "__main__":
+    main()

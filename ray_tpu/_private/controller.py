@@ -459,6 +459,11 @@ class Controller:
         if pg is None:
             return {}
         pg.state = "REMOVED"
+        # Wake ready()-blocked clients promptly: they re-read state=REMOVED.
+        for fut in pg.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        pg.waiters.clear()
         for idx, node_id in pg.bundle_nodes.items():
             node = self.nodes.get(node_id)
             if node and node.state == "ALIVE":

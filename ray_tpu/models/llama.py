@@ -144,9 +144,9 @@ def _attention_block(x, lp, cfg: LlamaConfig, cos, sin):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if cfg.use_ring_attention:
-        from ray_tpu.parallel.ring import ring_attention
+        from ray_tpu.parallel.ring import ring_attention_gspmd
 
-        o = ring_attention(q, k, v, axis_name="seq")
+        o = ring_attention_gspmd(q, k, v, seq_axis="seq")
     else:
         o = attention(q, k, v, causal=True)
     o = o.reshape(b, s, cfg.n_heads * cfg.head_dim)

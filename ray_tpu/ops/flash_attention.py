@@ -1,0 +1,354 @@
+"""Pallas flash attention for TPU (FlashAttention-2 style, causal, GQA).
+
+The [b, h, s, s] score matrix never materializes in HBM: the forward kernel
+streams KV blocks through VMEM, keeping a running (max, sum, acc) per query
+block; the backward is two kernels (dq; dkv) recomputing P from the saved
+log-sum-exp, FlashAttention-2 style.
+
+This is the framework's own kernel (the reference delegates attention to
+user libraries entirely — ray has no attention op); layout is [b, h, s, d]
+inside the kernel with block_q = block_k = 128 to match MXU tiles.
+
+Constraints: seq % 128 == 0, head_dim % 128 == 0 (the dispatcher in
+ray_tpu.ops.attention falls back to XLA otherwise).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, sm_scale: float, causal: bool, block_k: int):
+    """One (batch, head, q-block) program; loops over kv blocks.
+
+    q_ref: [block_q, d]; k_ref/v_ref: [skv, d] (whole kv for this head in
+    VMEM); o_ref: [block_q, d]; lse_ref: [block_q, 128] (value broadcast
+    across lanes — TPU tiles need a 128 minor dim).
+    """
+    block_q, d = q_ref.shape
+    skv = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...]
+
+    num_kv = pl.cdiv(skv, block_k)
+
+    def body(kv_i, _):
+        k_start = kv_i * block_k
+
+        @pl.when(jnp.logical_or(not causal,
+                                k_start <= q_start + block_q - 1))
+        def _():
+            k = k_ref[pl.ds(k_start, block_k), :]
+            v = v_ref[pl.ds(k_start, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                qpos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            m_prev = m_ref[:, 0]                      # [bq]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_prev - m_cur)           # [bq]
+            p = jnp.exp(s - m_cur[:, None])           # [bq, bk] f32
+            l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+            acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                            + jax.lax.dot_general(
+                                p.astype(v.dtype), v,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32))
+            m_ref[:, 0] = m_cur
+
+        return ()
+
+    jax.lax.fori_loop(0, num_kv, body, ())
+
+    l = l_ref[:, 0]
+    l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows → zeros, not NaN
+    o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:, 0] = m_ref[:, 0] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    """q: [b, hq, sq, d]; k/v: [b, hkv, skv, d] → (o, lse[b, hq, sq])."""
+    b, hq, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    n_rep = hq // hkv
+    grid = (b, hq, sq // block_q)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, skv, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((None, None, skv, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, sm_scale: float, causal: bool, block_k: int):
+    """dQ for one (b, h, q-block): loop over kv blocks.
+    dS = P * (dO V^T - delta); dQ = dS K * scale."""
+    block_q, d = q_ref.shape
+    skv = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    q = q_ref[...]
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, 0]
+    delta = delta_ref[:, 0]
+
+    def body(kv_i, _):
+        k_start = kv_i * block_k
+
+        @pl.when(jnp.logical_or(not causal,
+                                k_start <= q_start + block_q - 1))
+        def _():
+            k = k_ref[pl.ds(k_start, block_k), :]
+            v = v_ref[pl.ds(k_start, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                qpos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])                     # [bq, bk]
+            dp = jax.lax.dot_general(
+                do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * sm_scale
+            acc_ref[...] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        return ()
+
+    jax.lax.fori_loop(0, pl.cdiv(skv, block_k), body, ())
+    dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, sm_scale: float, causal: bool, block_q: int,
+                n_rep: int):
+    """dK/dV for one (b, kv-head, kv-block): loop over q blocks × rep heads.
+    dV = P^T dO; dK = dS^T Q * scale."""
+    block_k, d = k_ref.shape
+    sq = q_ref.shape[1]
+    ki = pl.program_id(2)
+    k_start = ki * block_k
+
+    dk_acc[...] = jnp.zeros_like(dk_acc)
+    dv_acc[...] = jnp.zeros_like(dv_acc)
+    k = k_ref[...]
+    v = v_ref[...]
+
+    num_q = pl.cdiv(sq, block_q)
+
+    def body(idx, _):
+        rep = idx // num_q
+        q_i = idx % num_q
+        q_start = q_i * block_q
+
+        @pl.when(jnp.logical_or(not causal,
+                                q_start + block_q - 1 >= k_start))
+        def _():
+            q = q_ref[rep, pl.ds(q_start, block_q), :]
+            do = do_ref[rep, pl.ds(q_start, block_q), :].astype(jnp.float32)
+            lse = lse_ref[rep, pl.ds(q_start, block_q), 0]
+            delta = delta_ref[rep, pl.ds(q_start, block_q), 0]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                qpos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])                      # [bq, bk]
+            dv_acc[...] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[:, None]) * sm_scale          # [bq, bk]
+            dk_acc[...] += jax.lax.dot_general(
+                ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        return ()
+
+    jax.lax.fori_loop(0, num_q * n_rep, body, ())
+    dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+    dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    b, hq, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    n_rep = hq // hkv
+    do = g
+
+    # delta = rowsum(dO * O)  [b, hq, sq] — cheap elementwise, leave to XLA.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_b = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+    delta_b = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
+        grid=(b, hq, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, skv, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((None, None, skv, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 128),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+
+    # dK/dV: grid over kv heads; each program sees all n_rep q-heads that
+    # attend to this kv head ([n_rep, sq, d] blocks).
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, n_rep=n_rep),
+        grid=(b, hkv, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, n_rep, sq, d),
+                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, n_rep, sq, d),
+                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, n_rep, sq, 128),
+                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, n_rep, sq, 128),
+                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(_reshape_heads(q, hkv, n_rep), k, v,
+      _reshape_heads(do, hkv, n_rep),
+      _reshape_heads(lse_b, hkv, n_rep),
+      _reshape_heads(delta_b, hkv, n_rep))
+    return dq, dk, dv
+
+
+def _reshape_heads(x, hkv, n_rep):
+    """[b, hq, ...] → [b, hkv, n_rep, ...] grouped by kv head."""
+    b = x.shape[0]
+    return x.reshape(b, hkv, n_rep, *x.shape[2:])
+
+
+def _interpret() -> bool:
+    """Interpret mode off-TPU so CPU tests exercise the same kernel code."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------- dispatch
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Flash attention with GQA.  q: [b, sq, hq, d]; k/v: [b, skv, hkv, d];
+    returns [b, sq, hq, d] (layout matches ray_tpu.ops.attention)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    block_q = min(block_q, qt.shape[2])
+    block_k = min(block_k, kt.shape[2])
+    o = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k)
+    return o.transpose(0, 2, 1, 3)

@@ -95,6 +95,8 @@ def with_sharding_constraint(x, logical_axes: tuple[str | None, ...],
             mesh = jax.sharding.get_abstract_mesh()  # inside jit
         except Exception:  # noqa: BLE001
             return x
+        if mesh is None or not mesh.axis_names:   # no mesh in context
+            return x
     spec = logical_spec(logical_axes, rules)
     spec = P(*[_prune(mesh, s) for s in spec])
     return jax.lax.with_sharding_constraint(

@@ -1,0 +1,15 @@
+"""ray_tpu.train — distributed training library (reference: python/ray/train).
+
+Two layers:
+  - `ray_tpu.train.step`: pure-jax sharded train/eval steps (no control
+    plane) — the compute core every trainer drives.
+  - trainer/session/worker-group layers (reference: base_trainer.py,
+    backend_executor.py, worker_group.py) built on ray_tpu actors.
+"""
+from ray_tpu.train.step import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_train_step,
+    sharded_init,
+    sharded_train_step,
+)

@@ -31,8 +31,17 @@ class ActorPool:
 
         if not self._results_order:
             raise StopIteration("no pending results")
-        ref = self._results_order.pop(0)
-        value = ray_tpu.get(ref, timeout=timeout)
+        ref = self._results_order[0]
+        try:
+            value = ray_tpu.get(ref, timeout=timeout)
+        except ray_tpu.GetTimeoutError:
+            raise            # ref stays queued; a retry re-fetches this slot
+        except Exception:
+            # Task failed: recycle the actor, drop the slot, re-raise.
+            self._results_order.pop(0)
+            self._on_done(ref)
+            raise
+        self._results_order.pop(0)
         self._on_done(ref)
         return value
 
@@ -47,8 +56,10 @@ class ActorPool:
             raise TimeoutError("get_next_unordered timed out")
         ref = done[0]
         self._results_order.remove(ref)
-        value = ray_tpu.get(ref)
-        self._on_done(ref)
+        try:
+            value = ray_tpu.get(ref)
+        finally:
+            self._on_done(ref)   # recycle the actor even when the task raised
         return value
 
     def _on_done(self, ref) -> None:

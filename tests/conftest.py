@@ -8,13 +8,20 @@ CPU platform so multi-chip sharding logic runs on one machine
 """
 import os
 
-# Must be set before jax ever initializes: 8 virtual CPU devices stand in
-# for an 8-chip slice in all sharding tests.
+# 8 virtual CPU devices stand in for an 8-chip slice in all sharding tests.
+# The env-var route (JAX_PLATFORMS/XLA_FLAGS) does NOT work here: the
+# machine's sitecustomize imports jax at interpreter startup, so only
+# jax.config.update takes effect.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    # A backend already initialized (e.g. plugin imported jax first);
+    # tests then run on whatever devices exist.
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,104 @@
+"""Kernel correctness: flash attention (Pallas, interpret mode on CPU) and
+ring attention (8-device virtual mesh) against the XLA reference
+implementation.  Mirrors the reference's fake-backend testing trick
+(ray: MockNcclGroup, python/ray/experimental/channel/conftest.py:58)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import attention, xla_attention
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.parallel.ring import ring_attention_gspmd
+
+
+def _qkv(b=2, s=256, hq=4, hkv=2, d=128, dtype=jnp.float32):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(jax.random.fold_in(key, 1), (b, s, hq, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 2), (b, s, hkv, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 3), (b, s, hkv, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_forward_matches_xla(self):
+        q, k, v = _qkv()
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(o, o_ref, atol=2e-2, rtol=1e-2)
+
+    def test_backward_matches_xla(self):
+        q, k, v = _qkv()
+        d = q.shape[-1]
+
+        def loss(att):
+            def f(q, k, v):
+                return (att(q, k, v) * jnp.arange(d)).sum()
+            return f
+
+        g = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(xla_attention), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            rel = jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9)
+            assert rel < 5e-3, f"grad rel err {rel}"
+
+    def test_mqa_single_kv_head(self):
+        q, k, v = _qkv(hq=4, hkv=1)
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(o, o_ref, atol=2e-2, rtol=1e-2)
+
+    def test_dispatcher_fallback_short_seq(self):
+        # s=64 not a multiple of 128 → XLA path; just must run + match.
+        q, k, v = _qkv(s=64, d=64)
+        o = attention(q, k, v, causal=True)
+        o_ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(o, o_ref, atol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.fixture
+    def mesh(self):
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devs, ("data", "seq"))
+
+    def test_matches_full_attention(self, mesh):
+        q, k, v = _qkv(s=512, d=64)
+        sh = NamedSharding(mesh, P("data", "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        with jax.set_mesh(mesh):
+            o = jax.jit(ring_attention_gspmd)(qs, ks, vs)
+        o_ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_grad_matches(self, mesh):
+        q, k, v = _qkv(s=256, d=64)
+        d = q.shape[-1]
+        sh = NamedSharding(mesh, P("data", "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+        with jax.set_mesh(mesh):
+            g = jax.jit(jax.grad(
+                lambda q, k, v: (ring_attention_gspmd(q, k, v)
+                                 * jnp.arange(d)).sum(),
+                argnums=(0, 1, 2)))(qs, ks, vs)
+        g_ref = jax.grad(
+            lambda q, k, v: (xla_attention(q, k, v) * jnp.arange(d)).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            rel = jnp.abs(np.asarray(a) - np.asarray(b)).max() / \
+                (jnp.abs(b).max() + 1e-9)
+            assert rel < 1e-4, f"ring grad rel err {rel}"
+
+    def test_noncausal(self, mesh):
+        q, k, v = _qkv(s=256, d=64)
+        sh = NamedSharding(mesh, P("data", "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        with jax.set_mesh(mesh):
+            o = jax.jit(lambda q, k, v: ring_attention_gspmd(
+                q, k, v, causal=False))(qs, ks, vs)
+        o_ref = xla_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   atol=1e-4, rtol=1e-4)
