@@ -135,19 +135,36 @@ def bench_model() -> dict:
             "loss": round(float(m["loss"]), 4)}
 
 
+def _with_timeout(fn, seconds: int):
+    """Alarm-guarded call: the chip is single-holder on this box and a
+    stuck lease must not zero out the rest of the bench."""
+    import signal
+
+    def handler(signum, frame):
+        raise TimeoutError(f"{fn.__name__} exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        return fn()
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def main() -> None:
     extra = {}
     try:
-        extra["model_bench"] = bench_model()
-    except Exception as e:  # noqa: BLE001
-        extra["model_bench"] = {"error": repr(e)}
-    try:
-        cp = bench_control_plane()
+        cp = _with_timeout(bench_control_plane, 600)
         extra.update(cp)
         value = cp["tasks_async_per_s"]
     except Exception as e:  # noqa: BLE001
         extra["control_plane_error"] = repr(e)
         value = 0.0
+    try:
+        extra["model_bench"] = _with_timeout(bench_model, 900)
+    except Exception as e:  # noqa: BLE001
+        extra["model_bench"] = {"error": repr(e)}
     print(json.dumps({
         "metric": "single_client_tasks_async",
         "value": value,

@@ -883,10 +883,25 @@ class CoreWorker:
                     ActorDiedError(h["actor_id"], "not hosted"))]
         caller = h.get("caller", "?")
         seq = h.get("seqno", 0)
+        if os.environ.get("RAY_TPU_ACTOR_TRACE"):
+            logger.info("actor_call %s seq=%s nxt=%s method=%s",
+                        h["actor_id"][:8], seq,
+                        inst.next_seq.get(caller), h.get("method"))
         # First seqno seen from a caller is its baseline: a restarted actor
         # incarnation accepts the caller's continuing sequence without a
         # handshake (ray: seq_no reset on actor restart via num_restarts).
         nxt = inst.next_seq.setdefault(caller, seq)
+        if seq < nxt:
+            # Stale seqno: a retry resend after connection loss (the reply
+            # was lost, possibly after execution).  Execute immediately and
+            # out of order — at-least-once retry semantics, never park (a
+            # parked stale seq would never be woken: completions only pop
+            # upward).
+            try:
+                started = await self._start_actor_method(inst, h, blobs)
+            except BaseException as e:  # noqa: BLE001
+                return self._error_reply(e)
+            return await started
         if seq != nxt:
             # Out-of-order arrival: park until predecessors START
             # (ray: ActorSchedulingQueue buffering by seq_no).
@@ -896,12 +911,19 @@ class CoreWorker:
         # In-order start, possibly-concurrent execution: async actors and
         # threaded actors (max_concurrency > 1) overlap; the default
         # single-thread executor serializes (ray: fiber.h vs ordered queue).
-        started = await self._start_actor_method(inst, h, blobs)
-        inst.next_seq[caller] = seq + 1
-        buf = inst.buffered.get(caller, {})
-        nxt_fut = buf.pop(seq + 1, None)
-        if nxt_fut and not nxt_fut.done():
-            nxt_fut.set_result(None)
+        # The sequence MUST advance even when dispatch fails (bad args, arg
+        # resolution error): a burned seqno would otherwise park every later
+        # call from this caller forever.
+        try:
+            started = await self._start_actor_method(inst, h, blobs)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(e)
+        finally:
+            inst.next_seq[caller] = seq + 1
+            buf = inst.buffered.get(caller, {})
+            nxt_fut = buf.pop(seq + 1, None)
+            if nxt_fut and not nxt_fut.done():
+                nxt_fut.set_result(None)
         return await started
 
     async def _start_actor_method(self, inst: ActorInstance, h: dict,

@@ -25,8 +25,29 @@ def _watch_parent() -> None:
     threading.Thread(target=_loop, daemon=True, name="parent-watch").start()
 
 
+def _extend_sys_path() -> None:
+    """Append the driver's sys.path (shipped via env at init) so that
+    by-reference pickles of driver-module functions resolve here."""
+    import json
+    import sys
+
+    raw = os.environ.get("RAY_TPU_DRIVER_SYS_PATH")
+    if not raw:
+        return
+    for p in json.loads(raw):
+        if p not in sys.path:
+            sys.path.append(p)
+
+
 def main() -> None:
     _watch_parent()
+    _extend_sys_path()
+    # `kill -USR1 <pid>` dumps all thread stacks to stderr — the per-process
+    # half of the `ray stack` debugging story (ray: py-spy attach).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s worker[%(process)d]: %(message)s")

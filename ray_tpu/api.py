@@ -74,6 +74,16 @@ def init(address: str | None = None,
         config.object_store_memory = object_store_memory
 
     if address is None:
+        # Workers must be able to unpickle functions defined in driver-side
+        # modules (e.g. test files, scripts in odd directories): ship the
+        # driver's sys.path so by-reference pickles resolve (the local-mode
+        # slice of the reference's working_dir runtime env, ray:
+        # python/ray/_private/runtime_env/working_dir.py).
+        import os as _os
+
+        _os.environ["RAY_TPU_DRIVER_SYS_PATH"] = json.dumps(
+            [p for p in (q or _os.getcwd() for q in sys.path)
+             if _os.path.exists(p)])
         _, cinfo = _spawn(["ray_tpu._private.controller",
                            "--config-json", config.to_json()])
         controller_addr = cinfo["controller_addr"]

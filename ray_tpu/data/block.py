@@ -1,0 +1,109 @@
+"""Blocks: the unit of data movement (analog of ray Data's block =
+Arrow table in plasma; ray: python/ray/data/block.py BlockAccessor).
+
+Canonical block = pyarrow.Table (zero-copy through the shm object store);
+map_batches views it as numpy / pandas / pyarrow per `batch_format`.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+def _to_table(data: Any) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        cols = {}
+        for k, v in data.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                # tensor column: store as fixed-size-list of flattened rows
+                flat = arr.reshape(arr.shape[0], -1)
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(flat.ravel()), flat.shape[1])
+                continue
+            cols[k] = pa.array(arr)
+        return pa.table(cols)
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+def _rows_to_table(rows: list) -> pa.Table:
+    if rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return _to_table({k: [r[k] for r in rows] for k in keys})
+    return _to_table({"item": rows})
+
+
+class BlockAccessor:
+    """Uniform view over a block (ray: BlockAccessor.for_block)."""
+
+    def __init__(self, block: pa.Table):
+        self.block = block
+
+    @staticmethod
+    def for_block(block) -> "BlockAccessor":
+        return BlockAccessor(_to_table(block))
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self.block.schema
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self.block.slice(start, end - start)
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        out = {}
+        for name in self.block.column_names:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.combine_chunks().flatten().to_numpy(
+                    zero_copy_only=False)
+                out[name] = flat.reshape(-1, width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("numpy", "default", None):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.block
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterable[dict]:
+        cols = self.to_numpy()
+        names = list(cols)
+        for i in range(self.num_rows()):
+            yield {k: cols[k][i] for k in names}
+
+    @staticmethod
+    def concat(blocks: list[pa.Table]) -> pa.Table:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        return pa.concat_tables(blocks, promote_options="default")
+
+    @staticmethod
+    def empty() -> pa.Table:
+        return pa.table({})

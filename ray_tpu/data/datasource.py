@@ -1,0 +1,154 @@
+"""Datasources: read tasks that produce blocks, write helpers.
+
+Analog of ray: python/ray/data/datasource/ (parquet/csv/json/... over
+pyarrow.fs).  A ReadTask is a zero-arg callable returning an iterator of
+blocks; the planner turns each into one ray_tpu task so reads parallelize
+and stream like any other operator.
+"""
+from __future__ import annotations
+
+import glob as globmod
+import os
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, _rows_to_table, _to_table
+
+ReadTask = Callable[[], Iterator[Block]]
+
+
+def _expand_paths(paths: str | list[str], suffix: str | None) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, f"*{suffix}" if suffix else "*")
+            out.extend(sorted(globmod.glob(pat)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globmod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+# ------------------------------------------------------------------ reads
+def range_tasks(n: int, parallelism: int) -> list[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    sizes = [n // parallelism + (1 if i < n % parallelism else 0)
+             for i in range(parallelism)]
+    tasks, start = [], 0
+    for sz in sizes:
+        s, e = start, start + sz
+
+        def read(s=s, e=e) -> Iterator[Block]:
+            yield pa.table({"id": np.arange(s, e, dtype=np.int64)})
+
+        tasks.append(read)
+        start = e
+    return tasks
+
+
+def items_tasks(items: list, parallelism: int) -> list[ReadTask]:
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    chunk = (len(items) + parallelism - 1) // parallelism
+    tasks = []
+    for i in range(0, len(items), chunk):
+        part = items[i:i + chunk]
+
+        def read(part=part) -> Iterator[Block]:
+            yield _rows_to_table(part)
+
+        tasks.append(read)
+    return tasks
+
+
+def parquet_tasks(paths, parallelism: int) -> list[ReadTask]:
+    files = _expand_paths(paths, ".parquet")
+
+    def one(path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path)
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def csv_tasks(paths, parallelism: int, **opts) -> list[ReadTask]:
+    files = _expand_paths(paths, ".csv")
+
+    def one(path: str) -> Iterator[Block]:
+        import pyarrow.csv as pcsv
+
+        yield pcsv.read_csv(path)
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def json_tasks(paths, parallelism: int) -> list[ReadTask]:
+    files = _expand_paths(paths, ".json")
+
+    def one(path: str) -> Iterator[Block]:
+        import pyarrow.json as pjson
+
+        yield pjson.read_json(path)
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def text_tasks(paths, parallelism: int) -> list[ReadTask]:
+    files = _expand_paths(paths, None)
+
+    def one(path: str) -> Iterator[Block]:
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield pa.table({"text": lines})
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def numpy_tasks(arrays: list[np.ndarray], column: str = "data",
+                ) -> list[ReadTask]:
+    tasks = []
+    for arr in arrays:
+        def read(arr=arr) -> Iterator[Block]:
+            yield _to_table({column: arr})
+
+        tasks.append(read)
+    return tasks
+
+
+def generator_tasks(fns: list[Callable[[], Iterable[Any]]]) -> list[ReadTask]:
+    """Custom per-shard generators (streaming token pipelines)."""
+    def wrap(fn):
+        def read() -> Iterator[Block]:
+            for chunk in fn():
+                yield _to_table(chunk) if not isinstance(chunk, pa.Table) \
+                    else chunk
+
+        return read
+
+    return [wrap(fn) for fn in fns]
+
+
+# ----------------------------------------------------------------- writes
+def write_block(block: Block, path: str, fmt: str, index: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, out)
+    elif fmt == "csv":
+        import pyarrow.csv as pcsv
+
+        pcsv.write_csv(block, out)
+    elif fmt == "json":
+        block.to_pandas().to_json(out, orient="records", lines=True)
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+    return out

@@ -1,0 +1,528 @@
+"""Pull-based streaming executor over ray_tpu tasks/actors.
+
+Analog of ray: python/ray/data/_internal/execution/streaming_executor.py:48
+(scheduling step :272, select_operator_to_run streaming_executor_state.py:517)
+and the physical operators in _internal/execution/operators/.
+
+Design: physical operators form a chain; the driver loop each tick
+  1. harvests finished task refs from every operator (ray_tpu.wait, t=0),
+  2. moves outputs downstream,
+  3. grants new task launches to the most downstream operator that has
+     input + budget (pull-based: draining late operators first keeps the
+     pipeline's memory footprint bounded — the backpressure analog of the
+     reference's resource-budget select_operator_to_run),
+  4. yields final output block refs as they complete (streaming: consumers
+     iterate while upstream reads are still running).
+
+Blocks cross operator boundaries as ObjectRefs; block payloads live in the
+shm object store, not the driver heap.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterator
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data import logical as L
+
+DEFAULT_MAX_TASKS = 8
+
+
+# ---------------------------------------------------------------- UDF glue
+def _make_block_fn(op: L.LogicalOp) -> Callable:
+    """Turn a logical transform into block(s)->blocks callable run inside a
+    worker task."""
+    if isinstance(op, L.FlatMap):
+        fn = op.fn
+        is_flat = op.name.startswith(("FlatMap", "Fused"))
+
+        def run(block):
+            from ray_tpu.data.block import _rows_to_table
+
+            rows_out = []
+            for row in BlockAccessor.for_block(block).iter_rows():
+                rows_out.extend(fn(row))
+            if not rows_out:
+                return BlockAccessor.empty()
+            return _rows_to_table(rows_out)
+
+        return run
+    if isinstance(op, L.MapRows):
+        fn = op.fn
+
+        def run(block):
+            from ray_tpu.data.block import _rows_to_table
+
+            rows = [fn(r) for r in
+                    BlockAccessor.for_block(block).iter_rows()]
+            return _rows_to_table(rows) if rows else BlockAccessor.empty()
+
+        return run
+    if isinstance(op, L.Filter):
+        fn = op.fn
+
+        def run(block):
+            from ray_tpu.data.block import _rows_to_table
+
+            rows = [r for r in
+                    BlockAccessor.for_block(block).iter_rows() if fn(r)]
+            return _rows_to_table(rows) if rows else block.slice(0, 0)
+
+        return run
+    if isinstance(op, L.MapBatches):
+        fn = op.fn
+        fmt = op.batch_format
+        bs = op.batch_size
+
+        def run(block, fn=fn):
+            from ray_tpu.data.block import _to_table
+
+            acc = BlockAccessor.for_block(block)
+            n = acc.num_rows()
+            step = bs or n or 1
+            outs = []
+            for s in range(0, n, step):
+                batch = BlockAccessor(acc.slice(s, min(s + step, n))) \
+                    .to_batch(fmt)
+                res = fn(batch)
+                outs.append(_to_table(res))
+            if not outs:
+                return BlockAccessor.empty()
+            return BlockAccessor.concat(outs)
+
+        return run
+    raise TypeError(f"not a map-like op: {op}")
+
+
+@ray_tpu.remote
+def _run_block_task(fn, block):
+    return fn(block)
+
+
+@ray_tpu.remote
+def _read_task(read_fn):
+    return BlockAccessor.concat(list(read_fn()))
+
+
+class _BatchActor:
+    """Stateful UDF host for compute="actors" (ray: ActorPoolMapOperator)."""
+
+    def __init__(self, cls, ctor_args, fn_args, fn_kwargs, batch_format,
+                 batch_size):
+        self.udf = cls(*ctor_args)
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs
+        self.batch_format = batch_format
+        self.batch_size = batch_size
+
+    def run(self, block):
+        from ray_tpu.data.block import _to_table
+
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        step = self.batch_size or n or 1
+        outs = []
+        for s in range(0, n, step):
+            batch = BlockAccessor(acc.slice(s, min(s + step, n))) \
+                .to_batch(self.batch_format)
+            outs.append(_to_table(self.udf(
+                batch, *self.fn_args, **self.fn_kwargs)))
+        return BlockAccessor.concat(outs) if outs else BlockAccessor.empty()
+
+
+# ------------------------------------------------------------- operators
+class PhysicalOp:
+    name = "op"
+
+    def __init__(self):
+        self.inq: collections.deque = collections.deque()
+        self.in_done = False
+        self.outq: collections.deque = collections.deque()
+        self.inflight: dict[Any, Any] = {}
+        self.done = False
+
+    def add_input(self, ref) -> None:
+        self.inq.append(ref)
+
+    def mark_input_done(self) -> None:
+        self.in_done = True
+
+    def can_launch(self) -> bool:
+        return bool(self.inq) and len(self.inflight) < self.max_tasks
+
+    def launch_one(self) -> None:
+        raise NotImplementedError
+
+    def harvest(self) -> None:
+        if not self.inflight:
+            self._maybe_finish()
+            return
+        done, _ = ray_tpu.wait(list(self.inflight), num_returns=len(
+            self.inflight), timeout=0)
+        for ref in done:
+            self.inflight.pop(ref)
+            self.outq.append(ref)
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        if self.in_done and not self.inq and not self.inflight:
+            self.done = True
+
+    max_tasks = DEFAULT_MAX_TASKS
+
+
+class InputOp(PhysicalOp):
+    """Read stage: one task per ReadTask."""
+
+    name = "Input"
+
+    def __init__(self, read_tasks, max_tasks=DEFAULT_MAX_TASKS):
+        super().__init__()
+        for t in read_tasks:
+            self.inq.append(t)
+        self.in_done = True
+        self.max_tasks = max_tasks
+
+    def launch_one(self) -> None:
+        t = self.inq.popleft()
+        self.inflight[_read_task.remote(t)] = t
+
+
+class TaskMapOp(PhysicalOp):
+    name = "Map(tasks)"
+
+    def __init__(self, op: L.LogicalOp, max_tasks=DEFAULT_MAX_TASKS):
+        super().__init__()
+        self.fn = _make_block_fn(op)
+        self.name = f"Map[{op.name}]"
+        self.max_tasks = max_tasks
+        self.remote = _run_block_task
+        if isinstance(op, L.MapBatches) and (op.num_cpus or op.num_tpus):
+            opts = {}
+            if op.num_cpus:
+                opts["num_cpus"] = op.num_cpus
+            if op.num_tpus:
+                opts["num_tpus"] = op.num_tpus
+            self.remote = _run_block_task.options(**opts)
+
+    def launch_one(self) -> None:
+        ref = self.inq.popleft()
+        self.inflight[self.remote.remote(self.fn, ref)] = ref
+
+
+class ActorMapOp(PhysicalOp):
+    """compute="actors": fixed pool, blocks go to idle actors."""
+
+    name = "Map(actors)"
+
+    def __init__(self, op: L.MapBatches):
+        super().__init__()
+        conc = op.concurrency or 2
+        if isinstance(conc, tuple):
+            conc = conc[1]
+        self.pool_size = int(conc)
+        self.max_tasks = self.pool_size
+        self.name = f"ActorMap[{getattr(op.fn, '__name__', 'udf')}]"
+        opts = {}
+        if op.num_cpus:
+            opts["num_cpus"] = op.num_cpus
+        if op.num_tpus:
+            opts["num_tpus"] = op.num_tpus
+        cls = ray_tpu.remote(_BatchActor)
+        if opts:
+            cls = cls.options(**opts)
+        self.actors = [
+            cls.remote(op.fn, op.fn_constructor_args, op.fn_args,
+                       op.fn_kwargs, op.batch_format, op.batch_size)
+            for _ in range(self.pool_size)
+        ]
+        self.idle = list(self.actors)
+        self.ref_actor: dict[Any, Any] = {}
+
+    def can_launch(self) -> bool:
+        return bool(self.inq) and bool(self.idle)
+
+    def launch_one(self) -> None:
+        block_ref = self.inq.popleft()
+        actor = self.idle.pop()
+        ref = actor.run.remote(block_ref)
+        self.inflight[ref] = block_ref
+        self.ref_actor[ref] = actor
+
+    def harvest(self) -> None:
+        if self.inflight:
+            done, _ = ray_tpu.wait(list(self.inflight),
+                                   num_returns=len(self.inflight), timeout=0)
+            for ref in done:
+                self.inflight.pop(ref)
+                self.idle.append(self.ref_actor.pop(ref))
+                self.outq.append(ref)
+        self._maybe_finish()
+        if self.done:
+            for a in self.actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
+            self.actors = []
+
+
+class AllToAllOp(PhysicalOp):
+    """Barrier ops: repartition / shuffle / sort / aggregate.  Gathers all
+    input refs, then runs a fan-out+reduce on the driver via tasks."""
+
+    name = "AllToAll"
+
+    def __init__(self, op: L.LogicalOp):
+        super().__init__()
+        self.op = op
+        self.name = f"AllToAll[{op.name}]"
+        self._launched = False
+        self._reduce_refs: list = []
+
+    def can_launch(self) -> bool:
+        return self.in_done and not self._launched and not self.inflight
+
+    def launch_one(self) -> None:
+        self._launched = True
+        refs = list(self.inq)
+        self.inq.clear()
+        for ref in _all_to_all(self.op, refs):
+            self.inflight[ref] = ref
+
+    def _maybe_finish(self) -> None:
+        if self._launched and not self.inflight:
+            self.done = True
+
+
+class LimitOp(PhysicalOp):
+    """Early-stopping limit: truncates and stops consuming past n rows."""
+
+    name = "Limit"
+
+    def __init__(self, n: int):
+        super().__init__()
+        self.n = n
+        self.taken = 0
+
+    def can_launch(self) -> bool:
+        return bool(self.inq)
+
+    def launch_one(self) -> None:
+        ref = self.inq.popleft()
+        if self.taken >= self.n:
+            return
+        block = ray_tpu.get(ref)
+        rows = BlockAccessor.for_block(block).num_rows()
+        if self.taken + rows <= self.n:
+            self.outq.append(ref)
+            self.taken += rows
+        else:
+            keep = self.n - self.taken
+            self.outq.append(ray_tpu.put(block.slice(0, keep)))
+            self.taken = self.n
+        if self.taken >= self.n:
+            self.in_done = True
+            self.inq.clear()
+
+    def harvest(self) -> None:
+        self._maybe_finish()
+
+
+# ------------------------------------------------------- all-to-all tasks
+@ray_tpu.remote
+def _split_block(block, n: int, key, shuffle_seed):
+    """Map side of the shuffle: partition one block n ways."""
+    import numpy as np
+
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    if rows == 0:
+        return [block] * n
+    if key is not None:                       # range-ish partition by hash
+        cols = acc.to_numpy()
+        h = np.array([hash(x) % n for x in cols[key]])
+        return [block.take(np.nonzero(h == i)[0]) for i in range(n)]
+    if shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        perm = rng.permutation(rows)
+        parts = np.array_split(perm, n)
+        return [block.take(p) for p in parts]
+    parts = np.array_split(np.arange(rows), n)
+    return [block.take(p) for p in parts]
+
+
+@ray_tpu.remote
+def _concat_blocks(*parts):
+    return BlockAccessor.concat(list(parts))
+
+
+@ray_tpu.remote
+def _sort_block(block, key, desc):
+    import pyarrow.compute as pc  # noqa: F401
+
+    return block.sort_by([(key, "descending" if desc else "ascending")])
+
+
+@ray_tpu.remote
+def _merge_sorted(key, desc, *blocks):
+    merged = BlockAccessor.concat(list(blocks))
+    return merged.sort_by([(key, "descending" if desc else "ascending")])
+
+
+@ray_tpu.remote
+def _partial_agg(block, keys, aggs):
+    df = BlockAccessor.for_block(block).to_pandas()
+    if df.empty:
+        return block.slice(0, 0)
+    import pandas as pd  # noqa: F401
+
+    partial = {}
+    g = df.groupby(keys) if keys else None
+    cols = {}
+    for agg_name, col in aggs:
+        series = (g[col] if g is not None else df[col])
+        if agg_name == "mean":      # decompose for correct combine
+            cols[f"sum({col})"] = series.sum()
+            cols[f"count({col})"] = series.count()
+        elif agg_name == "count":
+            cols["count()"] = series.count()
+        else:
+            cols[f"{agg_name}({col})"] = getattr(series, agg_name)()
+    import pandas as pd
+
+    if g is not None:
+        out = pd.DataFrame(cols).reset_index()
+    else:
+        out = pd.DataFrame({k: [v] for k, v in cols.items()})
+    import pyarrow as pa
+
+    return pa.Table.from_pandas(out, preserve_index=False)
+
+
+@ray_tpu.remote
+def _final_agg(keys, aggs, *partials):
+    import pandas as pd
+    import pyarrow as pa
+
+    df = BlockAccessor.concat(list(partials)).to_pandas()
+    if df.empty:
+        return pa.table({})
+    combine = {}
+    rename = {}
+    for agg_name, col in aggs:
+        if agg_name == "mean":
+            combine[f"sum({col})"] = "sum"
+            combine[f"count({col})"] = "sum"
+        elif agg_name == "count":
+            combine["count()"] = "sum"
+        elif agg_name in ("sum", "min", "max"):
+            combine[f"{agg_name}({col})"] = agg_name
+        else:
+            combine[f"{agg_name}({col})"] = agg_name
+        rename[f"{agg_name}({col})"] = f"{agg_name}({col})"
+    if keys:
+        out = df.groupby(keys).agg(combine).reset_index()
+    else:
+        out = df.agg(combine).to_frame().T
+    for agg_name, col in aggs:
+        if agg_name == "mean":
+            out[f"mean({col})"] = out[f"sum({col})"] / out[f"count({col})"]
+            out = out.drop(columns=[f"sum({col})", f"count({col})"])
+    return pa.Table.from_pandas(out, preserve_index=False)
+
+
+def _all_to_all(op: L.LogicalOp, refs: list) -> list:
+    """Plan the barrier stage; returns output refs (already submitted)."""
+    if isinstance(op, (L.Repartition, L.RandomShuffle)):
+        n = op.num_blocks if isinstance(op, L.Repartition) \
+            else max(1, len(refs))
+        seed = getattr(op, "seed", None)
+        if isinstance(op, L.RandomShuffle):
+            seed = seed if seed is not None else 0xC0FFEE
+        if not refs:
+            return []
+        parts = [_split_block.options(num_returns=n).remote(
+            r, n, None, None if seed is None else seed + i)
+            for i, r in enumerate(refs)]
+        # parts[i] is a list of n refs (num_returns=n)
+        cols = list(zip(*[p if isinstance(p, list) else [p] for p in parts]))
+        return [_concat_blocks.remote(*col) for col in cols]
+    if isinstance(op, L.Sort):
+        if not refs:
+            return []
+        sorted_refs = [_sort_block.remote(r, op.key, op.descending)
+                       for r in refs]
+        return [_merge_sorted.remote(op.key, op.descending, *sorted_refs)]
+    if isinstance(op, L.Aggregate):
+        partials = [_partial_agg.remote(r, op.keys, op.aggs) for r in refs]
+        return [_final_agg.remote(op.keys, op.aggs, *partials)]
+    raise TypeError(f"unknown all-to-all op {op}")
+
+
+# ------------------------------------------------------------- executor
+def plan_physical(plan: L.ExecutionPlan,
+                  max_tasks: int = DEFAULT_MAX_TASKS) -> list[PhysicalOp]:
+    ops = L.fuse_row_ops(plan.ops)
+    phys: list[PhysicalOp] = []
+    for op in ops:
+        if isinstance(op, L.Read):
+            phys.append(InputOp(op.tasks, max_tasks))
+        elif isinstance(op, L.MapBatches) and op.compute == "actors":
+            phys.append(ActorMapOp(op))
+        elif isinstance(op, (L.MapBatches, L.MapRows, L.Filter, L.FlatMap)):
+            phys.append(TaskMapOp(op, max_tasks))
+        elif isinstance(op, (L.Repartition, L.RandomShuffle, L.Sort,
+                             L.Aggregate)):
+            phys.append(AllToAllOp(op))
+        elif isinstance(op, L.Limit):
+            phys.append(LimitOp(op.n))
+        elif isinstance(op, L.Union):
+            raise NotImplementedError("union handled at Dataset level")
+        else:
+            raise TypeError(f"cannot plan {op}")
+    return phys
+
+
+class StreamingExecutor:
+    def __init__(self, plan: L.ExecutionPlan,
+                 max_tasks: int = DEFAULT_MAX_TASKS):
+        self.ops = plan_physical(plan, max_tasks)
+
+    def execute(self) -> Iterator[Any]:
+        """Yield output block refs as they become available."""
+        import time as _t
+
+        ops = self.ops
+        if not ops:
+            return
+        while True:
+            progressed = False
+            # 1. harvest + propagate
+            for i, op in enumerate(ops):
+                before = len(op.outq)
+                op.harvest()
+                progressed |= len(op.outq) != before
+                if i + 1 < len(ops):
+                    nxt = ops[i + 1]
+                    while op.outq:
+                        nxt.add_input(op.outq.popleft())
+                        progressed = True
+                    if op.done and not nxt.in_done:
+                        nxt.mark_input_done()
+                        progressed = True
+            # 2. emit from the tail
+            tail = ops[-1]
+            while tail.outq:
+                progressed = True
+                yield tail.outq.popleft()
+            if tail.done:
+                return
+            # 3. grant launches, most-downstream first (backpressure)
+            for op in reversed(ops):
+                while op.can_launch():
+                    op.launch_one()
+                    progressed = True
+            if not progressed:
+                _t.sleep(0.005)

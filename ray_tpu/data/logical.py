@@ -1,0 +1,183 @@
+"""Logical plan + optimizer (analog of ray:
+python/ray/data/_internal/logical/ operators + planner rules).
+
+A Dataset holds an immutable chain of logical ops; consumption plans it
+into physical operators (executor.py).  The one optimizer rule that pays
+for itself is operator fusion: adjacent row/batch transforms collapse into
+a single task per block (ray: planner fuses Map chains the same way).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    name: str = dataclasses.field(default="", init=False)
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    tasks: list        # list[ReadTask]
+
+    def __post_init__(self):
+        self.name = "Read"
+
+
+@dataclasses.dataclass
+class MapBatches(LogicalOp):
+    fn: Callable | type
+    batch_size: int | None = None
+    batch_format: str = "numpy"
+    compute: str = "tasks"           # "tasks" | "actors"
+    concurrency: int | tuple | None = None
+    fn_args: tuple = ()
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    fn_constructor_args: tuple = ()
+    num_tpus: float = 0.0
+    num_cpus: float | None = None
+
+    def __post_init__(self):
+        self.name = "MapBatches"
+
+
+@dataclasses.dataclass
+class MapRows(LogicalOp):
+    fn: Callable
+
+    def __post_init__(self):
+        self.name = "Map"
+
+
+@dataclasses.dataclass
+class Filter(LogicalOp):
+    fn: Callable
+
+    def __post_init__(self):
+        self.name = "Filter"
+
+
+@dataclasses.dataclass
+class FlatMap(LogicalOp):
+    fn: Callable
+
+    def __post_init__(self):
+        self.name = "FlatMap"
+
+
+@dataclasses.dataclass
+class Repartition(LogicalOp):
+    num_blocks: int
+
+    def __post_init__(self):
+        self.name = "Repartition"
+
+
+@dataclasses.dataclass
+class RandomShuffle(LogicalOp):
+    seed: int | None = None
+
+    def __post_init__(self):
+        self.name = "RandomShuffle"
+
+
+@dataclasses.dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+
+    def __post_init__(self):
+        self.name = "Sort"
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalOp):
+    keys: list[str]
+    aggs: list[tuple[str, str]]      # (agg_name, column)
+
+    def __post_init__(self):
+        self.name = "Aggregate"
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    n: int
+
+    def __post_init__(self):
+        self.name = "Limit"
+
+
+@dataclasses.dataclass
+class Union(LogicalOp):
+    others: list        # list[ExecutionPlan]
+
+    def __post_init__(self):
+        self.name = "Union"
+
+
+@dataclasses.dataclass
+class Zip(LogicalOp):
+    other: Any          # ExecutionPlan
+
+    def __post_init__(self):
+        self.name = "Zip"
+
+
+class ExecutionPlan:
+    def __init__(self, ops: list[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "ExecutionPlan":
+        return ExecutionPlan([*self.ops, op])
+
+    def __repr__(self):
+        return " -> ".join(op.name for op in self.ops)
+
+
+ROW_OPS = (MapRows, Filter, FlatMap)
+
+
+def fuse_row_ops(ops: list[LogicalOp]) -> list[LogicalOp]:
+    """Collapse runs of row-level transforms into one fused op so each
+    block round-trips through a worker exactly once."""
+    out: list[LogicalOp] = []
+    run: list[LogicalOp] = []
+
+    def flush():
+        if not run:
+            return
+        if len(run) == 1:
+            out.append(run[0])
+        else:
+            fns = [(type(op).__name__, op.fn) for op in run]
+
+            def fused(row, fns=fns):
+                rows = [row]
+                for kind, fn in fns:
+                    nxt = []
+                    for r in rows:
+                        if kind == "MapRows":
+                            nxt.append(fn(r))
+                        elif kind == "Filter":
+                            if fn(r):
+                                nxt.append(r)
+                        else:               # FlatMap
+                            nxt.extend(fn(r))
+                    rows = nxt
+                return rows
+
+            op = FlatMap(fused)
+            op.name = "Fused[" + ",".join(
+                type(o).__name__ for o in run) + "]"
+            out.append(op)
+        run.clear()
+
+    for op in ops:
+        if isinstance(op, ROW_OPS):
+            run.append(op)
+        else:
+            flush()
+            out.append(op)
+    flush()
+    return out

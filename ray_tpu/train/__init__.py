@@ -1,15 +1,19 @@
 """ray_tpu.train — distributed training library (reference: python/ray/train).
 
-Two layers:
-  - `ray_tpu.train.step`: pure-jax sharded train/eval steps (no control
-    plane) — the compute core every trainer drives.
-  - trainer/session/worker-group layers (reference: base_trainer.py,
-    backend_executor.py, worker_group.py) built on ray_tpu actors.
+Layers:
+  - step: pure-jax sharded train/eval steps (dp/fsdp/tp/sp as layouts)
+  - worker_group / backend / backend_executor: gang-placed jax processes,
+    multi-host rendezvous, report plumbing, group restart on failure
+  - trainer: JaxTrainer(...).fit() -> Result
+  - session: report()/get_checkpoint()/get_context() inside the loop
 """
-from ray_tpu.train.step import (  # noqa: F401
-    TrainState,
-    create_train_state,
-    make_train_step,
-    sharded_init,
-    sharded_train_step,
-)
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
+                                  RunConfig, ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
+                                   report)
+from ray_tpu.train.step import (TrainState, create_train_state,  # noqa: F401
+                                make_train_step, sharded_init,
+                                sharded_train_step)
+from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,  # noqa: F401,E501
+                                   JaxTrainer, Result)

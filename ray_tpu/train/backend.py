@@ -1,0 +1,75 @@
+"""Training backends: per-worker collective/runtime setup hooks.
+
+Analog of ray: python/ray/train/backend.py (Backend.on_start/on_shutdown)
+and torch/config.py:65,150 (_TorchBackend.on_start = pick rendezvous addr,
+dist.init_process_group on every worker).
+
+TPU difference (SURVEY §2.4 "Collective backend"): inside a slice there is
+no process-group object to build — XLA schedules ICI collectives from the
+jit'd program.  The backend's only job is the *multi-host* jax runtime
+rendezvous: worker 0 donates coordinator ip:port, every worker calls
+jax.distributed.initialize(coordinator, num_processes, process_id), after
+which jax.devices() spans the whole slice and pjit programs are global.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu.train.worker_group import WorkerGroup
+
+
+class Backend:
+    def on_start(self, worker_group: "WorkerGroup") -> None:  # noqa: B027
+        pass
+
+    def on_shutdown(self, worker_group: "WorkerGroup") -> None:  # noqa: B027
+        pass
+
+    def on_training_start(self, worker_group: "WorkerGroup") -> None:  # noqa: B027,E501
+        pass
+
+
+def _jax_distributed_init(coordinator: str, num_processes: int,
+                          process_id: int) -> bool:
+    """Runs inside each TrainWorker actor."""
+    import jax
+
+    if num_processes == 1:
+        return True          # single process: local devices already global
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+class JaxBackend(Backend):
+    """Multi-host jax runtime bring-up over the worker group."""
+
+    def on_start(self, worker_group: "WorkerGroup") -> None:
+        n = worker_group.num_workers
+        if n <= 1:
+            return
+        ip, port = worker_group.execute_single(0, "get_address")
+        coordinator = f"{ip}:{port}"
+        import ray_tpu
+
+        ray_tpu.get([
+            w.run_fn.remote(_jax_distributed_init, coordinator, n, i)
+            for i, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group: "WorkerGroup") -> None:
+        def _shut():
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            return True
+
+        try:
+            worker_group.execute("run_fn", _shut, _timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass

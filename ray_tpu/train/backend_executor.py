@@ -1,0 +1,145 @@
+"""BackendExecutor: drives a WorkerGroup through a training run.
+
+Analog of ray: python/ray/train/_internal/backend_executor.py:67
+(start :129, start_training :445, get_next_results :572, _restart
+:740-756).  Responsibilities: gang-place workers, run the backend
+rendezvous, launch the user train fn everywhere, drain per-worker report
+streams in lock-step, restart the whole group on worker failure up to
+FailureConfig.max_failures (recovery unit = whole group: a dead host
+kills its ICI domain, SURVEY §7 "elastic restart with slice granularity").
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.backend import Backend, JaxBackend
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import FailureConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(self, scaling: ScalingConfig,
+                 backend: Backend | None = None,
+                 failure: FailureConfig | None = None,
+                 trial_name: str = "train"):
+        self.scaling = scaling
+        self.backend = backend or JaxBackend()
+        self.failure = failure or FailureConfig()
+        self.trial_name = trial_name
+        self.worker_group: WorkerGroup | None = None
+        self._num_failures = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers, self.scaling.bundles(),
+            strategy=self.scaling.placement_strategy)
+        self.backend.on_start(self.worker_group)
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self.backend.on_shutdown(self.worker_group)
+            except Exception:  # noqa: BLE001
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+    def _restart(self) -> None:
+        logger.warning("restarting worker group (failure %d)",
+                       self._num_failures)
+        self.shutdown()
+        self.start()
+
+    # ------------------------------------------------------------ training
+    def run(self, train_fn: Callable, config: dict | None = None,
+            on_report: Callable[[list[dict]], Any] | None = None,
+            resume_checkpoint: Checkpoint | None = None) -> list:
+        """Run train_fn on all workers to completion.  `on_report` sees the
+        per-round list of rank reports (aligned, one per worker) and may
+        return "stop" to early-stop.  Returns per-worker return values.
+        """
+        config = config or {}
+        max_failures = self.failure.max_failures
+        while True:
+            try:
+                return self._run_once(train_fn, config, on_report,
+                                      resume_checkpoint)
+            except TrainingFailedError:
+                self._num_failures += 1
+                if max_failures >= 0 and self._num_failures > max_failures:
+                    raise
+                self._restart()
+
+    def _run_once(self, train_fn, config, on_report,
+                  resume_checkpoint) -> list:
+        wg = self.worker_group
+        if wg is None:
+            raise RuntimeError("executor not started")
+        n = wg.num_workers
+        # local ranks: position within each node's worker list
+        node_ids = wg.execute("get_node_id")
+        seen: dict[str, int] = {}
+        local_ranks = []
+        for nid in node_ids:
+            local_ranks.append(seen.get(nid, 0))
+            seen[nid] = local_ranks[-1] + 1
+        self.backend.on_training_start(wg)
+        ray_tpu.get([
+            w.start_train_fn.remote(
+                train_fn, config, world_rank=i, world_size=n,
+                local_rank=local_ranks[i], trial_name=self.trial_name,
+                checkpoint=resume_checkpoint)
+            for i, w in enumerate(wg.workers)
+        ])
+
+        done = [False] * n
+        pending: list[list[dict]] = [[] for _ in range(n)]
+        while not all(done):
+            progressed = False
+            for i, w in enumerate(wg.workers):
+                if done[i] or pending[i]:
+                    continue
+                try:
+                    msg = ray_tpu.get(w.next_result.remote(timeout=1.0),
+                                      timeout=60.0)
+                except Exception as e:  # noqa: BLE001 - worker death
+                    raise TrainingFailedError(
+                        f"worker {i} died: {e!r}") from e
+                if msg is None:
+                    continue
+                progressed = True
+                if msg["type"] == "done":
+                    done[i] = True
+                elif msg["type"] == "report":
+                    pending[i].append(msg)
+            # lock-step: emit a round once every live worker reported
+            if all(p or done[i] for i, p in enumerate(pending)) and \
+                    any(pending):
+                round_msgs = [p.pop(0) if p else None for p in pending]
+                if on_report is not None:
+                    verdict = on_report(
+                        [m for m in round_msgs if m is not None])
+                    if verdict == "stop":
+                        wg.execute("stop")
+            if not progressed:
+                time.sleep(0.05)
+
+        statuses = wg.execute("get_status")
+        errors = [(i, s["error"]) for i, s in enumerate(statuses)
+                  if s["error"]]
+        if errors:
+            rank, tb = errors[0]
+            raise TrainingFailedError(
+                f"train fn failed on rank {rank}:\n{tb}")
+        return wg.execute("get_result")

@@ -1,0 +1,75 @@
+"""Train/run configuration dataclasses.
+
+Analog of the reference's air configs (ray: python/ray/air/config.py:103
+ScalingConfig, :399 FailureConfig; python/ray/train/CheckpointConfig) with
+TPU-native resource vocabulary: workers map to hosts of a slice, each
+worker owning all local chips (jax's one-process-per-host model,
+SURVEY §7 "Multi-host jax process model").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many train workers and what each reserves.
+
+    num_workers: processes (1 per host on a pod). use_tpu: reserve the
+    node's chips. resources_per_worker: extra custom resources.
+    topology: optional slice topology string (e.g. "v5e-64") used as a
+    gang resource so all workers land on one slice (the analog of the
+    reference's TPU pod-name resource, ray:
+    python/ray/_private/accelerators/tpu.py get_current_pod_name).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    num_cpus_per_worker: float = 1.0
+    num_tpus_per_worker: float = 0.0
+    resources_per_worker: dict[str, float] | None = None
+    topology: str | None = None
+    placement_strategy: str = "PACK"
+
+    def bundle(self) -> dict[str, float]:
+        b: dict[str, float] = {"CPU": self.num_cpus_per_worker}
+        if self.use_tpu or self.num_tpus_per_worker:
+            b["TPU"] = self.num_tpus_per_worker or 1.0
+        if self.topology:
+            b[f"tpu-slice:{self.topology}"] = 1.0
+        for k, v in (self.resources_per_worker or {}).items():
+            b[k] = b.get(k, 0.0) + v
+        return b
+
+    def bundles(self) -> list[dict[str, float]]:
+        return [self.bundle() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts before giving up (-1 = infinite)
+    (ray: FailureConfig air/config.py:399; BackendExecutor._restart)."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Bound + rank persisted checkpoints (ray: CheckpointConfig)."""
+
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig | None = None
+    checkpoint_config: CheckpointConfig | None = None
+    stop: dict[str, Any] | None = None
+    verbose: int = 1

@@ -1,0 +1,94 @@
+"""Per-train-worker session: report(), get_checkpoint(), world topology.
+
+Analog of ray: python/ray/train/_internal/session.py (:403 checkpoint
+upload, :667 report).  The session lives inside the TrainWorker actor;
+`report` hands (metrics, checkpoint) to the actor's outbound queue, which
+the BackendExecutor drains (ray: backend_executor.get_next_results:572).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["_Session"] = None
+_session_lock = threading.Lock()
+
+
+class _Session:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 node_id: str, trial_name: str,
+                 checkpoint: Checkpoint | None, config: dict):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_id = node_id
+        self.trial_name = trial_name
+        self.loaded_checkpoint = checkpoint
+        self.config = config
+        self.out: queue.Queue = queue.Queue(maxsize=8)
+        self.stop_event = threading.Event()
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None) -> None:
+        if self.stop_event.is_set():
+            raise StopIteration("training stopped by the coordinator")
+        self.out.put({"type": "report", "metrics": dict(metrics),
+                      "checkpoint": checkpoint, "rank": self.world_rank})
+
+
+def init_session(**kwargs) -> _Session:
+    global _session
+    with _session_lock:
+        _session = _Session(**kwargs)
+        return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "not inside a train worker: ray_tpu.train.report/"
+            "get_context must be called from the train loop")
+    return _session
+
+
+# ------------------------------------------------------------- public API
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    """Report metrics (+ optional checkpoint) from the train loop
+    (ray: train.report)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    """Checkpoint to resume from, if any (ray: train.get_checkpoint)."""
+    return get_session().loaded_checkpoint
+
+
+class TrainContext:
+    """ray: train.get_context() — world topology of the running worker."""
+
+    def get_world_rank(self) -> int:
+        return get_session().world_rank
+
+    def get_world_size(self) -> int:
+        return get_session().world_size
+
+    def get_local_rank(self) -> int:
+        return get_session().local_rank
+
+    def get_node_id(self) -> str:
+        return get_session().node_id
+
+    def get_trial_name(self) -> str:
+        return get_session().trial_name
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

@@ -1,0 +1,166 @@
+"""Trainers: the `Trainer(...).fit() -> Result` public surface.
+
+Analog of ray: python/ray/train/base_trainer.py:567 (fit), data_parallel_
+trainer.py (DataParallelTrainer), torch/torch_trainer.py.  The TPU-native
+flagship is `JaxTrainer`: gang-places one jax process per host, runs the
+multi-host rendezvous (backend.py), and the user loop shards with
+pjit/shard_map — per-step collectives are compiled, not RPCs.
+
+fit() here drives the BackendExecutor directly; when a Tuner wraps a
+trainer (`tune.Tuner(trainer)`), `as_trainable()` exposes the same run as
+a Tune trainable (ray: BaseTrainer.fit wraps itself in a 1-trial Tuner —
+we invert the layering so Train has no hard Tune dependency).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable
+
+from ray_tpu.train.backend import Backend, JaxBackend
+from ray_tpu.train.backend_executor import (BackendExecutor,
+                                            TrainingFailedError)
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+
+
+class Result:
+    """ray: ray.train.Result — final metrics + best/last checkpoint."""
+
+    def __init__(self, metrics: dict | None, checkpoint: Checkpoint | None,
+                 error: Exception | None = None,
+                 metrics_history: list[dict] | None = None,
+                 path: str | None = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.error = error
+        self.metrics_history = metrics_history or []
+        self.path = path
+
+    def __repr__(self):
+        return (f"Result(metrics={self.metrics}, "
+                f"checkpoint={self.checkpoint}, error={self.error})")
+
+
+class BaseTrainer:
+    _backend_cls: type[Backend] = JaxBackend
+
+    def __init__(self, train_loop_per_worker: Callable | None = None,
+                 *, train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 resume_from_checkpoint: Checkpoint | None = None,
+                 datasets: dict | None = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    # ------------------------------------------------------------ plumbing
+    def _storage_path(self) -> str:
+        base = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = self.run_config.name or "train"
+        return os.path.join(base, name)
+
+    def fit(self) -> Result:
+        executor = BackendExecutor(
+            self.scaling_config, self._backend_cls(),
+            self.run_config.failure_config or FailureConfig(),
+            trial_name=self.run_config.name or "train")
+        storage = self._storage_path()
+        manager = CheckpointManager(
+            storage,
+            self.run_config.checkpoint_config or CheckpointConfig())
+        history: list[dict] = []
+        last_metrics: dict | None = None
+        stop_criteria = self.run_config.stop or {}
+
+        def on_report(round_msgs: list[dict]):
+            nonlocal last_metrics
+            # rank-0 metrics are authoritative (ray: only rank-0 results
+            # propagate to Tune); any rank may attach the checkpoint.
+            by_rank = {m["rank"]: m for m in round_msgs}
+            rank0 = by_rank.get(0) or round_msgs[0]
+            last_metrics = rank0["metrics"]
+            history.append(last_metrics)
+            ckpt = next((m["checkpoint"] for m in round_msgs
+                         if m.get("checkpoint")), None)
+            if ckpt is not None:
+                manager.register(ckpt, last_metrics)
+            for key, bound in stop_criteria.items():
+                v = last_metrics.get(key)
+                if v is not None and v >= bound:
+                    return "stop"
+            return None
+
+        executor.start()
+        try:
+            self._pre_run(executor)
+            executor.run(self._train_fn(), self.train_loop_config,
+                         on_report=on_report,
+                         resume_checkpoint=self.resume_from_checkpoint)
+            error = None
+        except TrainingFailedError as e:
+            error = e
+        finally:
+            executor.shutdown()
+        return Result(metrics=last_metrics,
+                      checkpoint=manager.latest_checkpoint,
+                      error=error, metrics_history=history, path=storage)
+
+    def _train_fn(self) -> Callable:
+        if self.train_loop_per_worker is None:
+            raise ValueError("train_loop_per_worker is required")
+        return self.train_loop_per_worker
+
+    def _pre_run(self, executor: BackendExecutor) -> None:
+        """Hook: e.g. attach dataset shards before training starts."""
+        if not self.datasets:
+            return
+        # Each worker's session.config gains an iterator over its shard
+        # via ray_tpu.data streaming_split at run time (data lib).
+        self.train_loop_config.setdefault("_datasets", self.datasets)
+
+    # --------------------------------------------------------------- tune
+    def as_trainable(self) -> Callable:
+        """A Tune-compatible function trainable closing over this trainer
+        (ray: BaseTrainer.as_trainable base_trainer.py:819)."""
+        trainer = self
+
+        def trainable(config: dict):
+            from ray_tpu import tune
+
+            merged = dict(trainer.train_loop_config)
+            merged.update(config.get("train_loop_config", config))
+            t = type(trainer)(
+                trainer.train_loop_per_worker,
+                train_loop_config=merged,
+                scaling_config=trainer.scaling_config,
+                run_config=trainer.run_config,
+                datasets=trainer.datasets)
+            result = t.fit()
+            if result.error:
+                raise result.error
+            final = dict(result.metrics or {})
+            tune.report(final, checkpoint=result.checkpoint)
+            return final
+
+        return trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD data-parallel training (ray: DataParallelTrainer): same fn on
+    every worker; model replication/sharding is the step's mesh layout."""
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Flagship TPU trainer: one process per host, jax.distributed
+    rendezvous, user loop uses ray_tpu.train.step helpers with a global
+    mesh (analog of ray: TorchTrainer + TorchXLAConfig torch/xla/config.py:20,
+    re-designed: no xmp spawn — jax owns all local chips per process)."""
+
+    _backend_cls = JaxBackend

@@ -1,0 +1,160 @@
+"""WorkerGroup: N gang-placed train-worker actors.
+
+Analog of ray: python/ray/train/_internal/worker_group.py:102 (actors in a
+placement group) + backend_executor's rendezvous.  Each TrainWorker is one
+jax process (one per host on a pod — SURVEY §7: jax wants one process per
+host owning all local chips); the train fn runs on a thread inside the
+actor so the actor stays responsive for result polling and shutdown.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import traceback
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.utils.placement_group import (PlacementGroup, placement_group,
+                                           remove_placement_group)
+
+
+class TrainWorker:
+    """Actor: hosts one train process (rank) of the group."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._session = None
+        self._finished = False
+        self._error: str | None = None
+        self._result: Any = None
+
+    # --------------------------------------------------------- rendezvous
+    def get_address(self) -> tuple[str, int]:
+        """(ip, free_port) for the jax.distributed coordinator (worker 0)."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return socket.gethostbyname(socket.gethostname()), port
+
+    def get_node_id(self) -> str:
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    def run_fn(self, fn: Callable, *args, **kwargs):
+        """Execute an arbitrary callable in the worker process (backend
+        hooks, debugging probes)."""
+        return fn(*args, **kwargs)
+
+    def setup_env(self, env: dict[str, str]) -> bool:
+        import os
+
+        os.environ.update(env)
+        return True
+
+    # ---------------------------------------------------------- execution
+    def start_train_fn(self, fn: Callable, config: dict, *,
+                       world_rank: int, world_size: int, local_rank: int,
+                       trial_name: str, checkpoint=None) -> bool:
+        self._finished = False
+        self._error = None
+        self._result = None
+        self._session = session_mod.init_session(
+            world_rank=world_rank, world_size=world_size,
+            local_rank=local_rank,
+            node_id=ray_tpu.get_runtime_context().get_node_id(),
+            trial_name=trial_name, checkpoint=checkpoint, config=config)
+
+        def run():
+            try:
+                import inspect
+
+                sig = inspect.signature(fn)
+                self._result = fn(config) if len(
+                    sig.parameters) >= 1 else fn()
+            except StopIteration:
+                pass
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                self._finished = True
+                self._session.out.put({"type": "done"})
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout: float = 1.0) -> dict | None:
+        """Drain one message from the session queue (None on timeout)."""
+        import queue as q
+
+        if self._session is None:
+            return {"type": "done"}
+        try:
+            msg = self._session.out.get(timeout=timeout)
+        except q.Empty:
+            if self._finished:
+                return {"type": "done"}
+            return None
+        return msg
+
+    def get_status(self) -> dict:
+        return {"finished": self._finished, "error": self._error}
+
+    def get_result(self) -> Any:
+        return self._result
+
+    def stop(self) -> bool:
+        if self._session is not None:
+            self._session.stop_event.set()
+        return True
+
+
+class WorkerGroup:
+    """Owns the PG + actors.  `execute` fans a callable to all workers."""
+
+    def __init__(self, num_workers: int, bundles: list[dict],
+                 strategy: str = "PACK",
+                 pg: PlacementGroup | None = None):
+        self.num_workers = num_workers
+        self._own_pg = pg is None
+        self.pg = pg or placement_group(bundles, strategy=strategy)
+        if not self.pg.ready(timeout=120.0):
+            raise RuntimeError(
+                f"placement group {self.pg.id} not ready "
+                f"(bundles={bundles}, strategy={strategy})")
+        cls = ray_tpu.remote(TrainWorker)
+        self.workers = [
+            cls.options(
+                num_cpus=0,     # resources held by the PG bundle
+                placement_group=self.pg,
+                placement_group_bundle_index=i).remote()
+            for i in range(num_workers)
+        ]
+
+    def execute(self, method: str, *args, _timeout: float | None = None,
+                **kwargs) -> list:
+        """Call `method` on every worker, gather results."""
+        return ray_tpu.get([getattr(w, method).remote(*args, **kwargs)
+                            for w in self.workers], timeout=_timeout)
+
+    def execute_async(self, method: str, *args, **kwargs) -> list:
+        return [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+
+    def execute_single(self, idx: int, method: str, *args, **kwargs):
+        return ray_tpu.get(
+            getattr(self.workers[idx], method).remote(*args, **kwargs))
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []
+        if self._own_pg:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:  # noqa: BLE001
+                pass
