@@ -1,0 +1,13 @@
+"""ray_tpu.data — streaming distributed data library
+(reference: python/ray/data; SURVEY §2.3 Ray Data, §3.6 execution).
+
+Lazy logical plans over arrow blocks, executed by a pull-based streaming
+executor on ray_tpu tasks/actors; device-ready sharded batches via
+iter_jax_batches / streaming_split.
+"""
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa: F401,E501
+                                  from_items, from_numpy, from_pandas,
+                                  range, read_csv, read_json, read_parquet,
+                                  read_text)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401

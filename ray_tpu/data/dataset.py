@@ -1,0 +1,398 @@
+"""Dataset: the lazy, streaming public API.
+
+Analog of ray: python/ray/data/dataset.py:139 (Dataset), with the same
+contract: transformations are lazy logical ops; consumption plans and
+streams through the executor (SURVEY §3.6); blocks live in the object
+store, not the driver.
+
+TPU-native: `streaming_split` feeds per-train-worker shards through a
+coordinator actor; `iter_jax_batches` double-buffers into HBM.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import datasource as ds
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.executor import StreamingExecutor
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, plan: L.ExecutionPlan):
+        self._plan = plan
+        self._materialized: list | None = None   # block refs once computed
+        self._union_sources: list | None = None
+
+    def _as_plan(self) -> L.ExecutionPlan:
+        """A logical plan view even for materialized/union datasets, so
+        every transformation composes (post-union maps, etc.)."""
+        if self._plan is not None:
+            return self._plan
+        self.materialize()
+        refs = self._materialized
+
+        def mk(ref):
+            def read() -> Iterator:
+                yield ray_tpu.get(ref)
+
+            return read
+
+        return L.ExecutionPlan([L.Read([mk(r) for r in refs])])
+
+    # ------------------------------------------------------ transformations
+    def _with(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._as_plan().with_op(op))
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._with(L.MapRows(fn))
+
+    def map_batches(self, fn, *, batch_size: int | None = None,
+                    batch_format: str = "numpy", compute: str | None = None,
+                    concurrency: int | tuple | None = None,
+                    fn_args: tuple = (), fn_kwargs: dict | None = None,
+                    fn_constructor_args: tuple = (),
+                    num_cpus: float | None = None,
+                    num_tpus: float = 0.0) -> "Dataset":
+        """fn: batch->batch (callable) or a class (stateful actor UDF,
+        compute="actors")."""
+        if compute is None:
+            compute = "actors" if isinstance(fn, type) else "tasks"
+        return self._with(L.MapBatches(
+            fn, batch_size=batch_size, batch_format=batch_format,
+            compute=compute, concurrency=concurrency, fn_args=fn_args,
+            fn_kwargs=fn_kwargs or {},
+            fn_constructor_args=fn_constructor_args,
+            num_cpus=num_cpus, num_tpus=num_tpus))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._with(L.Filter(fn))
+
+    def flat_map(self, fn: Callable[[dict], Iterable[dict]]) -> "Dataset":
+        return self._with(L.FlatMap(fn))
+
+    def add_column(self, name: str, fn: Callable[[dict], Any]) -> "Dataset":
+        def add(row):
+            row = dict(row)
+            row[name] = fn(row)
+            return row
+
+        return self.map(add)
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        return self.map_batches(select)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(L.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._with(L.RandomShuffle(seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(n))
+
+    def groupby(self, key: str | list[str] | None):
+        from ray_tpu.data.grouped import GroupedData
+
+        keys = [key] if isinstance(key, str) else (key or [])
+        return GroupedData(self, keys)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        plans = [self._as_plan(), *[o._as_plan() for o in others]]
+        u = Dataset.__new__(Dataset)
+        u._plan = None
+        u._materialized = None
+        u._union_sources = plans
+        return u
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of equal-length datasets."""
+        left = self.materialize()._materialized
+        right = other.materialize()._materialized
+
+        @ray_tpu.remote
+        def zip_blocks(*parts):
+            import pyarrow as pa
+
+            half = len(parts) // 2
+            lt = BlockAccessor.concat(list(parts[:half]))
+            rt = BlockAccessor.concat(list(parts[half:]))
+            cols = {**BlockAccessor(lt).to_numpy(),
+                    **BlockAccessor(rt).to_numpy()}
+            from ray_tpu.data.block import _to_table
+
+            return _to_table(cols)
+
+        ref = zip_blocks.remote(*left, *right)
+        out = Dataset.__new__(Dataset)
+        out._plan = None
+        out._materialized = [ref]
+        out._union_sources = None
+        return out
+
+    # ------------------------------------------------------------ execution
+    def _ref_iter(self) -> Iterator:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        if getattr(self, "_union_sources", None):
+            def chain():
+                for p in self._union_sources:
+                    yield from StreamingExecutor(p).execute()
+
+            return chain()
+        return StreamingExecutor(self._plan).execute()
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._ref_iter)
+
+    def materialize(self) -> "Dataset":
+        if self._materialized is None:
+            self._materialized = list(self._ref_iter())
+        return self
+
+    # ----------------------------------------------------------- consumption
+    def iter_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[dict]:
+        return self.iterator().iter_rows()
+
+    def iter_jax_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_jax_batches(**kw)
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(BlockAccessor.for_block(ray_tpu.get(r)).num_rows()
+                   for r in self._ref_iter())
+
+    def schema(self):
+        for ref in self._ref_iter():
+            return BlockAccessor.for_block(ray_tpu.get(ref)).schema()
+        return None
+
+    def columns(self) -> list[str]:
+        sch = self.schema()
+        return list(sch.names) if sch is not None else []
+
+    def num_blocks(self) -> int:
+        self.materialize()
+        return len(self._materialized)
+
+    def size_bytes(self) -> int:
+        return sum(BlockAccessor.for_block(ray_tpu.get(r)).size_bytes()
+                   for r in self._ref_iter())
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [BlockAccessor.for_block(ray_tpu.get(r)).to_pandas()
+                  for r in self._ref_iter()]
+        frames = [f for f in frames if not f.empty] or frames[:1]
+        return pd.concat(frames, ignore_index=True) if frames \
+            else pd.DataFrame()
+
+    def to_numpy(self) -> dict[str, np.ndarray]:
+        return self.iterator().materialize_numpy()
+
+    # ---------------------------------------------------------------- split
+    def split(self, n: int) -> list["Dataset"]:
+        """Materialize and split into n datasets by block round-robin."""
+        self.materialize()
+        outs = []
+        for i in range(n):
+            part = self._materialized[i::n]
+            d = Dataset.__new__(Dataset)
+            d._plan = None
+            d._materialized = part
+            d._union_sources = None
+            outs.append(d)
+        return outs
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> list[DataIterator]:
+        """n DataIterators fed round-robin while execution streams
+        (ray: Dataset.streaming_split dataset.py:1236 via a coordinator
+        actor).  Each split may be consumed from a different process."""
+        if self._materialized is not None:
+            ops, mat = None, self._materialized
+        else:
+            ops, mat = self._as_plan().ops, None
+        coord = _SplitCoordinator.options(num_cpus=0).remote(ops, mat, n)
+
+        def make_factory(idx: int):
+            def refs() -> Iterator:
+                while True:
+                    ref = ray_tpu.get(coord.next_ref.remote(idx))
+                    if ref is None:
+                        return
+                    yield ref
+
+            return refs
+
+        its = [DataIterator(make_factory(i)) for i in range(n)]
+        for it in its:
+            it._coordinator = coord    # keep the actor alive
+        return its
+
+    # ---------------------------------------------------------------- write
+    def _write(self, path: str, fmt: str) -> None:
+        refs = list(self._ref_iter())
+
+        @ray_tpu.remote
+        def write_one(block, idx):
+            return ds.write_block(block, path, fmt, idx)
+
+        ray_tpu.get([write_one.remote(r, i) for i, r in enumerate(refs)])
+
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def __repr__(self):
+        if self._materialized is not None:
+            return f"MaterializedDataset({len(self._materialized)} blocks)"
+        return f"Dataset({self._plan})"
+
+
+class _SplitCoordinator:
+    """Actor running the streaming executor, handing refs to n consumers
+    round-robin (ray: StreamSplitDataIterator's coordinator)."""
+
+    def __init__(self, ops, materialized, n: int):
+        import collections
+        import threading
+
+        self.n = n
+        self.queues = [collections.deque() for _ in range(n)]
+        self.done = False
+        self.lock = threading.Lock()
+
+        def run():
+            try:
+                if materialized is not None:
+                    refs = iter(materialized)
+                else:
+                    refs = StreamingExecutor(
+                        L.ExecutionPlan(ops)).execute()
+                for i, ref in enumerate(refs):
+                    with self.lock:
+                        self.queues[i % n].append(ref)
+            finally:
+                self.done = True
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def next_ref(self, idx: int):
+        import time
+
+        while True:
+            with self.lock:
+                if self.queues[idx]:
+                    return self.queues[idx].popleft()
+                if self.done:
+                    return None
+            time.sleep(0.01)
+
+
+_SplitCoordinator = ray_tpu.remote(_SplitCoordinator)
+
+
+# ----------------------------------------------------------- constructors
+def _read(tasks: list) -> Dataset:
+    return Dataset(L.ExecutionPlan([L.Read(tasks)]))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return _read(ds.range_tasks(n, parallelism))
+
+
+def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+    return _read(ds.items_tasks(list(items), parallelism))
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    arrs = arr if isinstance(arr, list) else [arr]
+    return _read(ds.numpy_tasks(arrs, column))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    dfs = dfs if isinstance(dfs, list) else [dfs]
+    tables = [pa.Table.from_pandas(d, preserve_index=False) for d in dfs]
+
+    def mk(t):
+        def read():
+            yield t
+
+        return read
+
+    return _read([mk(t) for t in tables])
+
+
+def from_arrow(tables) -> Dataset:
+    tables = tables if isinstance(tables, list) else [tables]
+
+    def mk(t):
+        def read():
+            yield t
+
+        return read
+
+    return _read([mk(t) for t in tables])
+
+
+def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
+    return _read(ds.parquet_tasks(paths, parallelism))
+
+
+def read_csv(paths, *, parallelism: int = 8) -> Dataset:
+    return _read(ds.csv_tasks(paths, parallelism))
+
+
+def read_json(paths, *, parallelism: int = 8) -> Dataset:
+    return _read(ds.json_tasks(paths, parallelism))
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    return _read(ds.text_tasks(paths, parallelism))
+
+
+def from_generators(fns: list) -> Dataset:
+    return _read(ds.generator_tasks(fns))

@@ -1,0 +1,48 @@
+"""GroupedData: aggregations after groupby (ray: python/ray/data/grouped_data.py).
+
+Two-stage: per-block partial aggregation in tasks (mean decomposes into
+sum+count), single combine task — the standard map-side pre-aggregation
+shuffle.
+"""
+from __future__ import annotations
+
+from ray_tpu.data import logical as L
+
+
+class GroupedData:
+    def __init__(self, dataset, keys: list[str]):
+        self._ds = dataset
+        self._keys = keys
+
+    def _agg(self, pairs: list[tuple[str, str]]):
+        from ray_tpu.data.dataset import Dataset
+
+        return Dataset(self._ds._plan.with_op(
+            L.Aggregate(self._keys, pairs)))
+
+    def count(self):
+        # count needs a column; use the first key or synthesize
+        col = self._keys[0] if self._keys else None
+        if col is None:
+            raise ValueError("global count(): use Dataset.count()")
+        return self._agg([("count", col)])
+
+    def sum(self, col: str):
+        return self._agg([("sum", col)])
+
+    def min(self, col: str):
+        return self._agg([("min", col)])
+
+    def max(self, col: str):
+        return self._agg([("max", col)])
+
+    def mean(self, col: str):
+        return self._agg([("mean", col)])
+
+    def aggregate(self, **aggs: str):
+        """aggregate(total="sum:value", avg="mean:value")"""
+        pairs = []
+        for _name, spec in aggs.items():
+            op, col = spec.split(":")
+            pairs.append((op, col))
+        return self._agg(pairs)

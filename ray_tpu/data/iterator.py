@@ -1,0 +1,159 @@
+"""DataIterator: batch iteration with prefetch and device placement.
+
+Analog of ray: python/ray/data/iterator.py:60 (DataIterator.iter_batches)
++ train integration (streaming_split shards feeding per-host device
+prefetch).  TPU-native addition: `iter_jax_batches` double-buffers
+jax.device_put so host→HBM transfer of batch N+1 overlaps step N
+(SURVEY §7 step 6).
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+
+def _rebatch(blocks: Iterable, batch_size: int | None, batch_format: str,
+             drop_last: bool) -> Iterator[Any]:
+    """Slice a stream of blocks into exact-size batches."""
+    if batch_size is None:
+        for b in blocks:
+            acc = BlockAccessor.for_block(b)
+            if acc.num_rows():
+                yield acc.to_batch(batch_format)
+        return
+    buf: list = []
+    buffered = 0
+    for b in blocks:
+        acc = BlockAccessor.for_block(b)
+        if acc.num_rows() == 0:
+            continue
+        buf.append(acc.block)
+        buffered += acc.num_rows()
+        while buffered >= batch_size:
+            merged = BlockAccessor.concat(buf)
+            head = merged.slice(0, batch_size)
+            rest = merged.slice(batch_size, merged.num_rows - batch_size)
+            yield BlockAccessor(head).to_batch(batch_format)
+            buf = [rest] if rest.num_rows else []
+            buffered = rest.num_rows
+    if buffered and not drop_last:
+        merged = BlockAccessor.concat(buf)
+        yield BlockAccessor(merged).to_batch(batch_format)
+
+
+def _shuffle_buffered(batches: Iterator, buffer_size: int, seed) -> Iterator:
+    rng = np.random.default_rng(seed)
+    pool: list = []
+    for b in batches:
+        pool.append(b)
+        if len(pool) >= buffer_size:
+            idx = rng.integers(len(pool))
+            pool[idx], pool[-1] = pool[-1], pool[idx]
+            yield pool.pop()
+    rng.shuffle(pool)
+    yield from pool
+
+
+class DataIterator:
+    """Iterates batches from a block-ref stream (possibly still executing)."""
+
+    def __init__(self, ref_iter_factory: Callable[[], Iterator]):
+        self._factory = ref_iter_factory
+
+    def _block_stream(self, prefetch: int) -> Iterator:
+        """Fetch blocks with a lookahead of `prefetch` in-flight gets."""
+        refs = self._factory()
+        window: collections.deque = collections.deque()
+        for ref in refs:
+            window.append(ref)
+            if len(window) > prefetch:
+                yield ray_tpu.get(window.popleft())
+        while window:
+            yield ray_tpu.get(window.popleft())
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 2,
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: int | None = None,
+                     local_shuffle_seed: int | None = None) -> Iterator[Any]:
+        batches = _rebatch(self._block_stream(max(1, prefetch_batches)),
+                           batch_size, batch_format, drop_last)
+        if local_shuffle_buffer_size:
+            batches = _shuffle_buffered(batches, local_shuffle_buffer_size,
+                                        local_shuffle_seed)
+        # Background-thread prefetch decouples fetch/convert from consumer.
+        q: queue.Queue = queue.Queue(maxsize=max(1, prefetch_batches))
+        DONE, err_box = object(), []
+
+        def pump():
+            try:
+                for b in batches:
+                    q.put(b)
+            except BaseException as e:  # noqa: BLE001
+                err_box.append(e)
+            finally:
+                q.put(DONE)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                if err_box:
+                    raise err_box[0]
+                return
+            yield item
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self.iter_batches(batch_size=None,
+                                       batch_format="pyarrow"):
+            yield from BlockAccessor.for_block(batch).iter_rows()
+
+    # ------------------------------------------------------------- device
+    def iter_jax_batches(self, *, batch_size: int, sharding=None,
+                         dtypes: dict | None = None,
+                         drop_last: bool = True,
+                         prefetch_batches: int = 2) -> Iterator[Any]:
+        """Numpy batches → jax arrays on device, double-buffered: device_put
+        of the next batch is issued before the current one is yielded, so
+        host→HBM DMA overlaps the consumer's step."""
+        import jax
+
+        def to_device(np_batch: dict):
+            out = {}
+            for k, v in np_batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                out[k] = jax.device_put(v, sharding)
+            return out
+
+        it = self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                               drop_last=drop_last,
+                               prefetch_batches=prefetch_batches)
+        prev = None
+        for np_batch in it:
+            cur = to_device(np_batch)     # async dispatch; no host sync
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
+    def materialize_numpy(self, limit: int | None = None) -> dict:
+        """Gather everything into one numpy dict (tests/small data)."""
+        blocks = [BlockAccessor.for_block(b).block
+                  for b in self._block_stream(4)]
+        merged = BlockAccessor.concat(blocks) if blocks else None
+        if merged is None:
+            return {}
+        if limit is not None:
+            merged = merged.slice(0, limit)
+        return BlockAccessor(merged).to_numpy()

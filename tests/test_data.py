@@ -1,0 +1,192 @@
+"""Data library: transformations, streaming execution, splits, IO.
+
+Mirrors the reference's data tests (ray: python/ray/data/tests/) run
+against a single-node cluster.
+"""
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+class TestBasics:
+    def test_range_count_take(self, ray_shared):
+        ds = rd.range(100, parallelism=4)
+        assert ds.count() == 100
+        rows = ds.take(5)
+        assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+    def test_from_items_schema(self, ray_shared):
+        ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+        assert ds.count() == 2
+        assert set(ds.columns()) == {"a", "b"}
+
+    def test_map_filter_flatmap_fused(self, ray_shared):
+        ds = (rd.range(20, parallelism=2)
+              .map(lambda r: {"id": r["id"] * 2})
+              .filter(lambda r: r["id"] % 4 == 0)
+              .flat_map(lambda r: [r, r]))
+        vals = sorted(r["id"] for r in ds.take_all())
+        expect = sorted(v for v in range(0, 40, 2) if v % 4 == 0
+                        for _ in (0, 1))
+        assert vals == expect
+
+    def test_map_batches_tasks(self, ray_shared):
+        ds = rd.range(32, parallelism=4).map_batches(
+            lambda b: {"id": b["id"] + 1}, batch_size=8)
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 33))
+
+    def test_map_batches_actor_udf(self, ray_shared):
+        class AddConst:
+            def __init__(self, c=100):
+                self.c = c
+
+            def __call__(self, batch):
+                return {"id": batch["id"] + self.c}
+
+        ds = rd.range(16, parallelism=2).map_batches(
+            AddConst, concurrency=2, fn_constructor_args=(100,))
+        assert sorted(r["id"] for r in ds.take_all()) == \
+            list(range(100, 116))
+
+    def test_add_select_drop_columns(self, ray_shared):
+        ds = (rd.range(4).add_column("sq", lambda r: int(r["id"]) ** 2)
+              .select_columns(["sq"]))
+        assert sorted(r["sq"] for r in ds.take_all()) == [0, 1, 4, 9]
+
+
+class TestReshaping:
+    def test_repartition(self, ray_shared):
+        ds = rd.range(100, parallelism=2).repartition(5).materialize()
+        assert ds.num_blocks() == 5
+        assert ds.count() == 100
+
+    def test_random_shuffle_preserves_multiset(self, ray_shared):
+        ds = rd.range(50, parallelism=2).random_shuffle(seed=7)
+        vals = [r["id"] for r in ds.take_all()]
+        assert sorted(vals) == list(range(50))
+        assert vals != list(range(50))
+
+    def test_sort(self, ray_shared):
+        ds = rd.from_items([{"v": x} for x in [5, 3, 9, 1, 7]]).sort("v")
+        assert [r["v"] for r in ds.take_all()] == [1, 3, 5, 7, 9]
+        dsd = rd.from_items([{"v": x} for x in [5, 3, 9]]).sort(
+            "v", descending=True)
+        assert [r["v"] for r in dsd.take_all()] == [9, 5, 3]
+
+    def test_limit_streams_early(self, ray_shared):
+        ds = rd.range(1000, parallelism=8).limit(10)
+        assert ds.count() == 10
+
+    def test_union(self, ray_shared):
+        a = rd.range(5)
+        b = rd.range(5).map(lambda r: {"id": r["id"] + 100})
+        u = a.union(b)
+        assert u.count() == 10
+        # transforms compose after union
+        assert u.filter(lambda r: r["id"] >= 100).count() == 5
+
+    def test_zip(self, ray_shared):
+        a = rd.from_items([{"x": i} for i in range(4)])
+        b = rd.from_items([{"y": i * 10} for i in range(4)])
+        z = a.zip(b)
+        rows = z.take_all()
+        assert all(r["y"] == r["x"] * 10 for r in rows)
+
+
+class TestGroupBy:
+    def test_groupby_sum_mean(self, ray_shared):
+        items = [{"k": i % 3, "v": float(i)} for i in range(12)]
+        ds = rd.from_items(items, parallelism=3)
+        out = ds.groupby("k").sum("v").take_all()
+        expect = {}
+        for it in items:
+            expect[it["k"]] = expect.get(it["k"], 0.0) + it["v"]
+        got = {int(r["k"]): float(r["sum(v)"]) for r in out}
+        assert got == expect
+
+        mean_out = ds.groupby("k").mean("v").take_all()
+        got_mean = {int(r["k"]): float(r["mean(v)"]) for r in mean_out}
+        assert got_mean == {k: v / 4 for k, v in expect.items()}
+
+
+class TestIteration:
+    def test_iter_batches_sizes(self, ray_shared):
+        ds = rd.range(100, parallelism=4)
+        sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+        assert sizes == [32, 32, 32, 4]
+        sizes = [len(b["id"]) for b in
+                 ds.iter_batches(batch_size=32, drop_last=True)]
+        assert sizes == [32, 32, 32]
+
+    def test_iter_batches_formats(self, ray_shared):
+        ds = rd.range(10)
+        pd_batches = list(ds.iter_batches(batch_size=None,
+                                          batch_format="pandas"))
+        assert sum(len(b) for b in pd_batches) == 10
+
+    def test_local_shuffle(self, ray_shared):
+        ds = rd.range(64, parallelism=2)
+        flat = np.concatenate([
+            b["id"] for b in ds.iter_batches(
+                batch_size=8, local_shuffle_buffer_size=4,
+                local_shuffle_seed=3)])
+        assert sorted(flat.tolist()) == list(range(64))
+
+    def test_iter_jax_batches(self, ray_shared):
+        import jax.numpy as jnp
+
+        ds = rd.range(32, parallelism=2)
+        batches = list(ds.iter_jax_batches(batch_size=16))
+        assert len(batches) == 2
+        assert isinstance(batches[0]["id"], jnp.ndarray)
+
+    def test_tensor_columns(self, ray_shared):
+        arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+        ds = rd.from_numpy(arr, column="feat")
+        out = ds.map_batches(lambda b: {"feat": b["feat"] * 2}).to_numpy()
+        np.testing.assert_allclose(out["feat"], arr * 2)
+
+
+class TestSplit:
+    def test_split(self, ray_shared):
+        parts = rd.range(40, parallelism=4).split(2)
+        total = sum(p.count() for p in parts)
+        assert total == 40
+
+    def test_streaming_split_two_consumers(self, ray_shared):
+        its = rd.range(40, parallelism=4).streaming_split(2)
+        got = []
+        for it in its:
+            for b in it.iter_batches(batch_size=None):
+                got.extend(b["id"].tolist())
+        assert sorted(got) == list(range(40))
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, ray_shared, tmp_path):
+        p = str(tmp_path / "pq")
+        rd.range(50, parallelism=2).write_parquet(p)
+        back = rd.read_parquet(p)
+        assert back.count() == 50
+        assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+    def test_csv_roundtrip(self, ray_shared, tmp_path):
+        p = str(tmp_path / "csv")
+        rd.from_items([{"a": i, "b": i * 2} for i in range(10)],
+                      parallelism=2).write_csv(p)
+        back = rd.read_csv(p)
+        assert back.count() == 10
+
+    def test_read_text(self, ray_shared, tmp_path):
+        f = tmp_path / "t.txt"
+        f.write_text("hello\nworld\n")
+        ds = rd.read_text(str(f))
+        assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+    def test_from_pandas_to_pandas(self, ray_shared):
+        import pandas as pd
+
+        df = pd.DataFrame({"x": [1, 2, 3]})
+        out = rd.from_pandas(df).to_pandas()
+        assert out["x"].tolist() == [1, 2, 3]
