@@ -677,7 +677,19 @@ class CoreWorker:
 
     async def _wait_async(self, refs, num_returns, timeout):
         async def _ready(ref: ObjectRef) -> ObjectRef:
-            await self._get_one(ref, None)   # errors count as ready (like ray)
+            # Readiness must not deserialize or pull payloads: a timeout=0
+            # poll cancels in-flight _ready tasks, so any await beyond the
+            # entry event (e.g. run_in_executor deserialize) would make
+            # polling never observe completion.  Errors count as ready
+            # (like ray).
+            e = self.memory.get_if_exists(ref.binary())
+            if e is None and (ref.binary() in self.owned
+                              or ref.owner_addr in ("", self.address)):
+                e = self.memory.entry(ref.binary())
+            if e is not None:
+                await e.event.wait()
+            else:
+                await self._get_one(ref, None)   # remote owner: fetch local
             return ref
 
         tasks = {asyncio.ensure_future(_ready(r)): r for r in refs}

@@ -10,6 +10,7 @@ coordinator actor; `iter_jax_batches` double-buffers into HBM.
 """
 from __future__ import annotations
 
+import builtins
 import itertools
 from typing import Any, Callable, Iterable, Iterator
 
@@ -230,7 +231,7 @@ class Dataset:
         """Materialize and split into n datasets by block round-robin."""
         self.materialize()
         outs = []
-        for i in range(n):
+        for i in builtins.range(n):
             part = self._materialized[i::n]
             d = Dataset.__new__(Dataset)
             d._plan = None
@@ -260,7 +261,7 @@ class Dataset:
 
             return refs
 
-        its = [DataIterator(make_factory(i)) for i in range(n)]
+        its = [DataIterator(make_factory(i)) for i in builtins.range(n)]
         for it in its:
             it._coordinator = coord    # keep the actor alive
         return its
@@ -299,9 +300,13 @@ class _SplitCoordinator:
         import threading
 
         self.n = n
-        self.queues = [collections.deque() for _ in range(n)]
+        self.queues = [collections.deque() for _ in builtins.range(n)]
         self.done = False
         self.lock = threading.Lock()
+        # Pin handed-out refs: this actor owns the blocks, and a consumer
+        # may fetch a ref after the local ObjectRef would otherwise be
+        # GC'd (owner frees → ObjectLostError at the borrower).
+        self._handed: list = []
 
         def run():
             try:
@@ -324,7 +329,9 @@ class _SplitCoordinator:
         while True:
             with self.lock:
                 if self.queues[idx]:
-                    return self.queues[idx].popleft()
+                    ref = self.queues[idx].popleft()
+                    self._handed.append(ref)
+                    return ref
                 if self.done:
                     return None
             time.sleep(0.01)

@@ -140,6 +140,11 @@ class PhysicalOp:
         self.in_done = False
         self.outq: collections.deque = collections.deque()
         self.inflight: dict[Any, Any] = {}
+        # Launch-order emission: blocks leave each operator in the order
+        # they entered it, so downstream sees dataset order (ray data's
+        # default preserve_order streaming semantics; take(5) = first rows).
+        self.order: collections.deque = collections.deque()
+        self._completed: set = set()
         self.done = False
 
     def add_input(self, ref) -> None:
@@ -154,15 +159,27 @@ class PhysicalOp:
     def launch_one(self) -> None:
         raise NotImplementedError
 
+    def _track(self, ref, token) -> None:
+        self.inflight[ref] = token
+        self.order.append(ref)
+
+    def _drain_in_order(self) -> None:
+        while self.order and self.order[0] in self._completed:
+            ref = self.order.popleft()
+            self._completed.discard(ref)
+            self.outq.append(ref)
+
     def harvest(self) -> None:
         if not self.inflight:
+            self._drain_in_order()
             self._maybe_finish()
             return
         done, _ = ray_tpu.wait(list(self.inflight), num_returns=len(
             self.inflight), timeout=0)
         for ref in done:
             self.inflight.pop(ref)
-            self.outq.append(ref)
+            self._completed.add(ref)
+        self._drain_in_order()
         self._maybe_finish()
 
     def _maybe_finish(self) -> None:
@@ -186,7 +203,7 @@ class InputOp(PhysicalOp):
 
     def launch_one(self) -> None:
         t = self.inq.popleft()
-        self.inflight[_read_task.remote(t)] = t
+        self._track(_read_task.remote(t), t)
 
 
 class TaskMapOp(PhysicalOp):
@@ -208,7 +225,7 @@ class TaskMapOp(PhysicalOp):
 
     def launch_one(self) -> None:
         ref = self.inq.popleft()
-        self.inflight[self.remote.remote(self.fn, ref)] = ref
+        self._track(self.remote.remote(self.fn, ref), ref)
 
 
 class ActorMapOp(PhysicalOp):
@@ -247,7 +264,7 @@ class ActorMapOp(PhysicalOp):
         block_ref = self.inq.popleft()
         actor = self.idle.pop()
         ref = actor.run.remote(block_ref)
-        self.inflight[ref] = block_ref
+        self._track(ref, block_ref)
         self.ref_actor[ref] = actor
 
     def harvest(self) -> None:
@@ -257,7 +274,8 @@ class ActorMapOp(PhysicalOp):
             for ref in done:
                 self.inflight.pop(ref)
                 self.idle.append(self.ref_actor.pop(ref))
-                self.outq.append(ref)
+                self._completed.add(ref)
+            self._drain_in_order()
         self._maybe_finish()
         if self.done:
             for a in self.actors:
@@ -289,7 +307,7 @@ class AllToAllOp(PhysicalOp):
         refs = list(self.inq)
         self.inq.clear()
         for ref in _all_to_all(self.op, refs):
-            self.inflight[ref] = ref
+            self._track(ref, ref)
 
     def _maybe_finish(self) -> None:
         if self._launched and not self.inflight:

@@ -1,0 +1,33 @@
+"""ray_tpu.tune: hyperparameter tuning over trial actors.
+
+Capability analog of ray: python/ray/tune — Tuner.fit drives N trials
+(each a Trainable in its own actor) through searchers (grid/random/TPE)
+and schedulers (ASHA, PBT, median-stopping), with checkpoint-carrying
+pause/resume and experiment-state restore.
+"""
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search.sample import (choice, grid_search, lograndint,
+                                        loguniform, qloguniform, qrandint,
+                                        quniform, randint, randn,
+                                        sample_from, uniform)
+from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
+                                          ConcurrencyLimiter, Searcher)
+from ray_tpu.tune.search.tpe import TPESearch
+from ray_tpu.tune.trainable import (Trainable, get_checkpoint, report,
+                                    wrap_function)
+from ray_tpu.tune.tuner import (Result, ResultGrid, TuneConfig, Tuner, run)
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Result", "run",
+    "Trainable", "report", "get_checkpoint", "wrap_function",
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
+    "qrandint", "lograndint", "choice", "sample_from", "grid_search",
+]

@@ -1,0 +1,119 @@
+"""Trial bookkeeping + experiment state persistence.
+
+Analog of ray: python/ray/tune/experiment/trial.py and
+tune/execution/experiment_state.py — the controller snapshots every trial
+(config, status, results, checkpoint path) to `experiment_state.json` in
+the run's storage dir; `Tuner.restore` resumes unfinished trials from it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, trial_id: str | None, config: dict,
+                 experiment_name: str = "exp",
+                 resources: dict | None = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.config = config
+        self.experiment_name = experiment_name
+        self.resources = resources or {"CPU": 1.0}
+        self.status = PENDING
+        self.last_result: dict | None = None
+        self.results: list[dict] = []
+        self.checkpoint: Checkpoint | None = None
+        self.error: str | None = None
+        self.num_failures = 0
+        self.start_time: float | None = None
+        # set when PBT replaces the config before a restart
+        self.restore_config: dict | None = None
+
+    @property
+    def name(self) -> str:
+        return f"{self.experiment_name}_{self.trial_id}"
+
+    def metric_value(self, metric: str, mode: str = "max") -> float:
+        vals = [r[metric] for r in self.results
+                if r.get(metric) is not None]
+        if not vals:
+            return float("-inf") if mode == "max" else float("inf")
+        return max(vals) if mode == "max" else min(vals)
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": _jsonable(self.config),
+            "status": self.status,
+            "last_result": _jsonable(self.last_result),
+            "num_results": len(self.results),
+            "checkpoint_path": self.checkpoint.path if self.checkpoint
+            else None,
+            "error": self.error,
+            "num_failures": self.num_failures,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict, experiment_name: str) -> "Trial":
+        t = cls(d["trial_id"], d.get("config") or {}, experiment_name)
+        t.status = d["status"]
+        t.last_result = d.get("last_result")
+        if t.last_result:
+            t.results = [t.last_result]
+        if d.get("checkpoint_path") and os.path.exists(d["checkpoint_path"]):
+            t.checkpoint = Checkpoint(d["checkpoint_path"])
+        t.error = d.get("error")
+        return t
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+def _jsonable(obj: Any) -> Any:
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _jsonable(v) for k, v in obj.items()}
+        return repr(obj)
+
+
+class ExperimentState:
+    """Periodic JSON snapshots enabling Tuner.restore."""
+
+    def __init__(self, storage_path: str, name: str):
+        self.dir = os.path.join(storage_path, name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "experiment_state.json")
+
+    def save(self, trials: list[Trial], metadata: dict | None = None) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(),
+                       "metadata": metadata or {},
+                       "trials": [t.to_json() for t in trials]}, f, indent=1)
+        os.replace(tmp, self.path)
+
+    def load(self, experiment_name: str) -> tuple[list[Trial], dict]:
+        with open(self.path) as f:
+            data = json.load(f)
+        trials = [Trial.from_json(d, experiment_name)
+                  for d in data["trials"]]
+        return trials, data.get("metadata", {})
+
+    @staticmethod
+    def exists(storage_path: str, name: str) -> bool:
+        return os.path.exists(
+            os.path.join(storage_path, name, "experiment_state.json"))

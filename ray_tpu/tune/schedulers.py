@@ -1,0 +1,254 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT, HyperBand.
+
+Analog of ray: python/ray/tune/schedulers/ (async_hyperband.py ASHA,
+median_stopping_rule.py, pbt.py).  A scheduler sees every result and
+returns a decision; the controller enforces it.  PBT additionally mutates
+paused trials' configs and transplants checkpoints (exploit/explore).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Optional
+
+# decisions
+CONTINUE = "CONTINUE"
+PAUSE = "PAUSE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: str | None,
+                              mode: str | None) -> None:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    metric: str | None = None
+    mode: str = "max"
+
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: dict | None) -> None:
+        pass
+
+    def choose_trial_to_run(self, trials: list) -> Optional[Any]:
+        """Pick the next PENDING/PAUSED trial to (re)start, or None."""
+        for t in trials:
+            if t.status == "PENDING":
+                return t
+        for t in trials:
+            if t.status == "PAUSED":
+                return t
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (ray: tune/schedulers/async_hyperband.py): rungs at
+    grace_period * reduction_factor^k; at each rung a trial continues only
+    if its metric is in the top 1/reduction_factor of results recorded at
+    that rung.  Asynchronous: decisions never wait for stragglers."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str | None = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung value -> list of recorded metric scores (sign-normalised)
+        self._brackets: list[dict[float, list[float]]] = [
+            {} for _ in range(max(brackets, 1))]
+        self._trial_bracket: dict[str, int] = {}
+        self._rng = random.Random(0)
+
+    def _rungs(self, bracket: int) -> list[float]:
+        rungs = []
+        t = self.grace * (self.rf ** bracket)
+        while t < self.max_t:
+            rungs.append(t)
+            t *= self.rf
+        return rungs
+
+    def on_trial_add(self, trial) -> None:
+        self._trial_bracket[trial.trial_id] = \
+            self._rng.randrange(len(self._brackets))
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        sign = 1.0 if self.mode == "max" else -1.0
+        score = sign * float(v)
+        b = self._trial_bracket.get(trial.trial_id, 0)
+        rung_scores = self._brackets[b]
+        decision = CONTINUE
+        for rung in sorted(self._rungs(b), reverse=True):
+            if t < rung:
+                continue
+            recorded = rung_scores.setdefault(rung, [])
+            # record once per trial per rung
+            key = (trial.trial_id, rung)
+            if key not in getattr(self, "_seen", set()):
+                self._seen = getattr(self, "_seen", set())
+                self._seen.add(key)
+                recorded.append(score)
+            if len(recorded) >= self.rf:
+                cutoff = _quantile(recorded, 1.0 - 1.0 / self.rf)
+                if score < cutoff:
+                    decision = STOP
+            break  # only the highest reached rung gates
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running means at the same step (ray:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str | None = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: dict[str, list[float]] = {}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        v = result.get(self.metric)
+        if v is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "max" else -1.0
+        self._history.setdefault(trial.trial_id, []).append(sign * float(v))
+        if t < self.grace:
+            return CONTINUE
+        means = [sum(h) / len(h) for tid, h in self._history.items()
+                 if tid != trial.trial_id and h]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        my_best = max(self._history[trial.trial_id])
+        if my_best < _quantile(means, 0.5):
+            return STOP
+        return CONTINUE
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by multi-bracket ASHA — the
+    asynchronous variant dominates in practice (ray ships both; ASHA is
+    the recommended default, ray: tune/schedulers/__init__.py)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("brackets", 3)
+        super().__init__(**kwargs)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (ray: tune/schedulers/pbt.py): every perturbation_interval,
+    bottom-quantile trials PAUSE; on restart the controller calls
+    `exploit(trial)` which clones a top-quantile trial's checkpoint and
+    perturbs its hyperparameters (resample with prob 0.25, else ×1.2 or
+    ×0.8 for numeric; next/prev for categorical)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str | None = None, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int | None = None):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: dict[str, float] = {}
+        self._scores: dict[str, float] = {}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        v = result.get(self.metric)
+        if v is not None:
+            sign = 1.0 if self.mode == "max" else -1.0
+            self._scores[trial.trial_id] = sign * float(v)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        if len(self._scores) < 2:
+            return CONTINUE
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        if trial.trial_id in bottom:
+            return PAUSE   # controller will exploit+explore on resume
+        return CONTINUE
+
+    # ------------------------------------------------------- exploit/explore
+    def exploit(self, trial, trials: list) -> tuple[Any, dict] | None:
+        """Pick a top-quantile donor; return (donor_trial, mutated config)
+        or None if no donor is available."""
+        ranked = sorted(
+            (t for t in trials
+             if t.trial_id in self._scores and t.trial_id != trial.trial_id),
+            key=lambda t: self._scores[t.trial_id], reverse=True)
+        if not ranked:
+            return None
+        k = max(1, int(len(ranked) * self.quantile))
+        donor = self._rng.choice(ranked[:k])
+        new_config = dict(donor.config)
+        for key, spec in self.mutations.items():
+            cur = new_config.get(key)
+            new_config[key] = self._mutate(key, cur, spec)
+        return donor, new_config
+
+    def _mutate(self, key: str, cur: Any, spec: Any) -> Any:
+        from ray_tpu.tune.search.sample import Domain
+
+        resample = cur is None or self._rng.random() < self.resample_prob
+        if isinstance(spec, Domain):
+            if resample:
+                return spec.sample(self._rng)
+            factor = 1.2 if self._rng.random() > 0.5 else 0.8
+            v = cur * factor
+            if spec.lower is not None:
+                v = min(max(v, spec.lower), spec.upper)
+            return int(v) if spec.is_int else v
+        if isinstance(spec, (list, tuple)):
+            if resample or cur not in spec:
+                return self._rng.choice(list(spec))
+            i = list(spec).index(cur)
+            j = min(max(i + self._rng.choice([-1, 1]), 0), len(spec) - 1)
+            return spec[j]
+        if callable(spec):
+            return spec()
+        return cur
+
+
+def _quantile(xs: list[float], q: float) -> float:
+    s = sorted(xs)
+    if not s:
+        return -math.inf
+    idx = min(int(q * len(s)), len(s) - 1)
+    return s[idx]

@@ -1,0 +1,16 @@
+from ray_tpu.tune.search.sample import (choice, grid_search, lograndint,
+                                        loguniform, qloguniform, qrandint,
+                                        quniform, randint, randn,
+                                        sample_from, uniform)
+from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
+                                          ConcurrencyLimiter, Searcher)
+from ray_tpu.tune.search.tpe import TPESearch
+from ray_tpu.tune.search.variant_generator import (flatten,
+                                                   generate_variants)
+
+__all__ = [
+    "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
+    "qrandint", "lograndint", "choice", "sample_from", "grid_search",
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch",
+    "generate_variants", "flatten",
+]

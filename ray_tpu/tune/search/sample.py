@@ -1,0 +1,160 @@
+"""Search-space domains: tune.uniform / loguniform / choice / grid_search.
+
+Analog of ray: python/ray/tune/search/sample.py (Domain/Float/Integer/
+Categorical) and variant_generator.py's grid_search marker.  Domains are
+plain samplable descriptions; the variant generator and searchers resolve
+them into concrete configs.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Sequence
+
+
+class Domain:
+    """A samplable parameter range."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    # Bounds for searchers that model the space (TPE, PBT perturbation).
+    lower: float | None = None
+    upper: float | None = None
+    is_log: bool = False
+    is_int: bool = False
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: float | None = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform lower bound must be > 0")
+        self.lower, self.upper, self.is_log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> float:
+        if self.is_log:
+            import math
+
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(round(v / self.q) * self.q, 10)
+        return min(max(v, self.lower), self.upper)
+
+    def __repr__(self):
+        k = "loguniform" if self.is_log else "uniform"
+        return f"{k}({self.lower}, {self.upper})"
+
+
+class Integer(Domain):
+    is_int = True
+
+    def __init__(self, lower: int, upper: int, log: bool = False,
+                 q: int = 1):
+        self.lower, self.upper, self.is_log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> int:
+        if self.is_log:
+            import math
+
+            v = int(math.exp(rng.uniform(math.log(max(self.lower, 1)),
+                                         math.log(self.upper))))
+        else:
+            v = rng.randint(self.lower, self.upper - 1) \
+                if self.upper > self.lower else self.lower
+        if self.q > 1:
+            v = int(round(v / self.q) * self.q)
+        return min(max(v, self.lower), self.upper - 1) \
+            if self.upper > self.lower else self.lower
+
+    def __repr__(self):
+        return f"randint({self.lower}, {self.upper})"
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+    def __repr__(self):
+        return f"choice({self.categories})"
+
+
+class Normal(Domain):
+    def __init__(self, mean: float = 0.0, sd: float = 1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng: random.Random) -> float:
+        return rng.gauss(self.mean, self.sd)
+
+
+class Function(Domain):
+    """tune.sample_from — arbitrary callable over the partial config spec."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:
+        try:
+            return self.fn(None)
+        except TypeError:
+            return self.fn()
+
+
+class GridSearch:
+    """Marker for exhaustive expansion (ray: tune.grid_search)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def __repr__(self):
+        return f"grid_search({self.values})"
+
+
+# ------------------------------------------------------------- public API
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int = 1) -> Integer:
+    return Integer(lower, upper, q=q)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)

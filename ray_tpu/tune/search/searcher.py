@@ -1,0 +1,113 @@
+"""Searcher interface + BasicVariantGenerator + ConcurrencyLimiter.
+
+Analog of ray: python/ray/tune/search/searcher.py, basic_variant.py,
+concurrency_limiter.py.  A Searcher suggests configs for new trials and
+observes results; the controller owns trial lifecycle.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Optional
+
+from ray_tpu.tune.search.sample import Domain, GridSearch
+from ray_tpu.tune.search.variant_generator import (count_grid_variants,
+                                                   generate_variants)
+
+FINISHED = "FINISHED"   # sentinel: search space exhausted
+
+
+class Searcher:
+    def __init__(self, metric: str | None = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: str | None, mode: str | None,
+                              config: dict) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        """A concrete config, None (wait: nothing to suggest yet), or
+        FINISHED."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples, domains sampled randomly
+    (ray: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: dict | None = None, num_samples: int = 1,
+                 seed: int | None = None, **kwargs):
+        super().__init__(**kwargs)
+        self._space = param_space or {}
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._iter = None
+        self._round = 0
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config:
+            self._space = config
+        return super().set_search_properties(metric, mode, config)
+
+    @property
+    def total_trials(self) -> int:
+        return count_grid_variants(self._space) * self._num_samples
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        while True:
+            if self._iter is None:
+                if self._round >= self._num_samples:
+                    return FINISHED
+                self._iter = generate_variants(self._space, self._rng)
+                self._round += 1
+            try:
+                return next(self._iter)
+            except StopIteration:
+                self._iter = None
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (ray: tune/search/concurrency_limiter.py).
+    Essential for sequential model-based searchers like TPE."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        return self.searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        out = self.searcher.suggest(trial_id)
+        if out is not None and out != FINISHED:
+            self._live.add(trial_id)
+        return out
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+def has_unresolved_values(spec: Any) -> bool:
+    if isinstance(spec, dict):
+        return any(has_unresolved_values(v) for v in spec.values())
+    return isinstance(spec, (Domain, GridSearch))

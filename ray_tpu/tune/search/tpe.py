@@ -1,0 +1,125 @@
+"""TPESearch: native Tree-structured Parzen Estimator searcher.
+
+Capability analog of ray's hyperopt/optuna integrations (ray:
+python/ray/tune/search/hyperopt/hyperopt_search.py) with no external
+dependency: the classic TPE split — divide observed trials into good/bad
+by quantile gamma, model each set with a Parzen (Gaussian-kernel) mixture
+per dimension, and pick the candidate maximising l(x)/g(x).  Categorical
+dims use smoothed empirical frequencies.  Falls back to random sampling
+until `n_initial_points` results exist.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Optional
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.search.variant_generator import _assign, _walk
+
+
+class TPESearch(Searcher):
+    def __init__(self, space: dict | None = None, metric: str | None = None,
+                 mode: str = "max", n_initial_points: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: int | None = None):
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self._n_init = n_initial_points
+        self._gamma = gamma
+        self._n_cand = n_candidates
+        self._rng = random.Random(seed)
+        # trial_id -> (flat point dict, score or None)
+        self._points: dict[str, tuple[dict, float | None]] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config:
+            self._space = config
+        return super().set_search_properties(metric, mode, config)
+
+    # ------------------------------------------------------------ modeling
+    def _dims(self) -> list[tuple[tuple, Domain]]:
+        return [(p, v) for p, v in _walk(self._space)
+                if isinstance(v, Domain)]
+
+    def _observed(self) -> list[tuple[dict, float]]:
+        return [(pt, s) for pt, s in self._points.values() if s is not None]
+
+    def _to_unit(self, dom: Domain, v: float) -> float:
+        lo, hi = dom.lower, dom.upper
+        if dom.is_log:
+            return (math.log(v) - math.log(lo)) / \
+                (math.log(hi) - math.log(lo) + 1e-12)
+        return (v - lo) / (hi - lo + 1e-12)
+
+    def _parzen_logpdf(self, xs: list[float], x: float) -> float:
+        if not xs:
+            return 0.0
+        bw = max(1.0 / max(len(xs), 1) ** 0.5 * 0.5, 0.05)
+        acc = 0.0
+        for c in xs:
+            acc += math.exp(-0.5 * ((x - c) / bw) ** 2)
+        return math.log(acc / len(xs) / (bw * math.sqrt(2 * math.pi)) + 1e-12)
+
+    def _suggest_dim(self, dom: Domain, good: list[Any],
+                     bad: list[Any]) -> Any:
+        if isinstance(dom, Categorical):
+            # smoothed frequency ratio over categories
+            def score(cat):
+                g = (good.count(cat) + 1) / (len(good) + len(dom.categories))
+                b = (bad.count(cat) + 1) / (len(bad) + len(dom.categories))
+                return g / b
+            cands = [dom.sample(self._rng) for _ in range(self._n_cand)]
+            return max(cands, key=score)
+        gu = [self._to_unit(dom, v) for v in good]
+        bu = [self._to_unit(dom, v) for v in bad]
+        best_v, best_s = None, -math.inf
+        for _ in range(self._n_cand):
+            v = dom.sample(self._rng)
+            u = self._to_unit(dom, v)
+            s = self._parzen_logpdf(gu, u) - self._parzen_logpdf(bu, u)
+            if s > best_s:
+                best_v, best_s = v, s
+        return best_v
+
+    # ------------------------------------------------------------ Searcher
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        dims = self._dims()
+        config: dict = {}
+        for path, v in _walk(self._space):
+            if not isinstance(v, Domain):
+                _assign(config, path, v)
+        obs = self._observed()
+        if len(obs) < self._n_init:
+            for path, dom in dims:
+                _assign(config, path, dom.sample(self._rng))
+        else:
+            obs.sort(key=lambda o: o[1], reverse=(self.mode == "max"))
+            n_good = max(1, int(len(obs) * self._gamma))
+            good_pts = [o[0] for o in obs[:n_good]]
+            bad_pts = [o[0] for o in obs[n_good:]] or good_pts
+            for path, dom in dims:
+                key = "/".join(map(str, path))
+                good = [p[key] for p in good_pts if key in p]
+                bad = [p[key] for p in bad_pts if key in p]
+                _assign(config, path, self._suggest_dim(dom, good, bad))
+        flat = {"/".join(map(str, p)): _get(config, p) for p, _ in dims}
+        self._points[trial_id] = (flat, None)
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        if trial_id not in self._points:
+            return
+        if error or not result or self.metric not in result:
+            self._points.pop(trial_id, None)
+            return
+        pt, _ = self._points[trial_id]
+        self._points[trial_id] = (pt, float(result[self.metric]))
+
+
+def _get(config: dict, path: tuple) -> Any:
+    d = config
+    for k in path:
+        d = d[k]
+    return d

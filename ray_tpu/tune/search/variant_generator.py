@@ -1,0 +1,68 @@
+"""Expand a param_space into concrete trial configs.
+
+Analog of ray: python/ray/tune/search/variant_generator.py — grid_search
+entries form a cross product; Domain entries are sampled per variant;
+nested dicts are traversed recursively.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Iterator
+
+from ray_tpu.tune.search.sample import Domain, GridSearch
+
+
+def _walk(spec: Any, path: tuple = ()) -> Iterator[tuple[tuple, Any]]:
+    if isinstance(spec, dict):
+        for k, v in spec.items():
+            yield from _walk(v, path + (k,))
+    else:
+        yield path, spec
+
+
+def _assign(config: dict, path: tuple, value: Any) -> None:
+    d = config
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def count_grid_variants(spec: dict) -> int:
+    n = 1
+    for _, v in _walk(spec):
+        if isinstance(v, GridSearch):
+            n *= len(v.values)
+    return n
+
+
+def generate_variants(spec: dict, rng: random.Random) -> Iterator[dict]:
+    """Yield one concrete config per grid cross-product element, sampling
+    every Domain leaf independently per variant."""
+    grid_paths = [(p, v.values) for p, v in _walk(spec)
+                  if isinstance(v, GridSearch)]
+    combos = itertools.product(*[vals for _, vals in grid_paths]) \
+        if grid_paths else [()]
+    for combo in combos:
+        config: dict = {}
+        grid_at = {p: val for (p, _), val in zip(grid_paths, combo)}
+        for path, v in _walk(spec):
+            if isinstance(v, GridSearch):
+                _assign(config, path, grid_at[path])
+            elif isinstance(v, Domain):
+                _assign(config, path, v.sample(rng))
+            else:
+                _assign(config, path, v)
+        yield config
+
+
+def flatten(config: dict, prefix: str = "") -> dict:
+    """Flatten nested config to dotted keys (for searchers/dataframes)."""
+    out = {}
+    for k, v in config.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out

@@ -1,0 +1,233 @@
+"""Trainable: the unit of execution Tune schedules.
+
+Analog of ray: python/ray/tune/trainable/trainable.py (class API:
+setup/step/save_checkpoint/load_checkpoint) + function_trainable.py
+(a user function running in a thread, reporting via tune.report; each
+`train()` call returns the next reported result).  The controller runs
+one Trainable per trial as an actor and calls train() repeatedly — pause
+and PBT exploitation are checkpoint save/restore on actor boundaries.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+RESULT_DONE = "__trial_done__"          # marker key in a final result
+TRAINING_ITERATION = "training_iteration"
+
+_fn_session: Optional["_FnSession"] = None
+
+
+class Trainable:
+    """Class API: subclass, override setup/step/save_checkpoint/
+    load_checkpoint; Tune calls train() per iteration."""
+
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+        self._iteration = 0
+        self._start = time.time()
+        self.setup(self.config)
+
+    # ----------------------------------------------------------- user hooks
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """Reuse this instance for a new config (PBT explore without an
+        actor restart).  Return False to force a restart."""
+        return False
+
+    # ------------------------------------------------------- controller API
+    def train(self) -> dict:
+        result = self.step()
+        self._iteration += 1
+        result.setdefault(TRAINING_ITERATION, self._iteration)
+        result.setdefault("time_total_s", time.time() - self._start)
+        result.setdefault("trial_id", getattr(self, "trial_id", ""))
+        return result
+
+    def save(self) -> Checkpoint:
+        d = tempfile.mkdtemp(prefix="tune-ckpt-")
+        self.save_checkpoint(d)
+        self._write_meta(d)
+        return Checkpoint(d)
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        self._read_meta(checkpoint.path)
+        self.load_checkpoint(checkpoint.path)
+
+    def stop(self) -> None:
+        self.cleanup()
+
+    def _write_meta(self, d: str) -> None:
+        import json
+
+        with open(os.path.join(d, ".tune_metadata"), "w") as f:
+            json.dump({"iteration": self._iteration}, f)
+
+    def _read_meta(self, d: str) -> None:
+        import json
+
+        p = os.path.join(d, ".tune_metadata")
+        if os.path.exists(p):
+            with open(p) as f:
+                self._iteration = json.load(f)["iteration"]
+
+
+class _FnSession:
+    """Per-function-trial session backing tune.report/get_checkpoint."""
+
+    def __init__(self, checkpoint: Checkpoint | None):
+        self.results: queue.Queue = queue.Queue(maxsize=2)
+        self.continue_sem = threading.Semaphore(0)
+        self.loaded_checkpoint = checkpoint
+        self.stop_event = threading.Event()
+        self.last_checkpoint: Checkpoint | None = None
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None) -> None:
+        if self.stop_event.is_set():
+            raise StopIteration("trial stopped by the tune controller")
+        self.last_checkpoint = checkpoint
+        self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        # block until the controller consumed the result: keeps function
+        # trainables in lock-step with scheduling decisions (ray: function
+        # trainables block in session.report until train() is called again)
+        self.continue_sem.acquire()
+        if self.stop_event.is_set():
+            raise StopIteration("trial stopped by the tune controller")
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    """tune.report — valid inside a function trainable (or train worker
+    when called under Train; train.report takes precedence there)."""
+    if _fn_session is None:
+        raise RuntimeError("tune.report called outside a tune trial")
+    _fn_session.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    if _fn_session is None:
+        return None
+    return _fn_session.loaded_checkpoint
+
+
+class FunctionTrainable(Trainable):
+    """Wraps fn(config) in a thread; each train() returns the next
+    tune.report'ed result (ray: tune/trainable/function_trainable.py)."""
+
+    _fn: Callable = None  # set by wrap_function subclassing
+
+    def setup(self, config: dict) -> None:
+        self._session: _FnSession | None = None
+        self._thread: threading.Thread | None = None
+        self._error: list[str] = []
+        self._fn_done = threading.Event()
+        self._resume_ckpt: Checkpoint | None = None
+        self._ret: Any = None
+
+    def _ensure_started(self) -> None:
+        if self._thread is not None:
+            return
+        global _fn_session
+        self._session = _FnSession(self._resume_ckpt)
+        _fn_session = self._session
+
+        def runner():
+            try:
+                self._ret = type(self)._fn(self.config)
+            except StopIteration:
+                pass
+            except BaseException:  # noqa: BLE001
+                self._error.append(traceback.format_exc())
+            finally:
+                self._fn_done.set()
+                self._session.results.put(None)   # wake a blocked train()
+
+        self._thread = threading.Thread(target=runner, daemon=True,
+                                        name="tune-fn")
+        self._thread.start()
+
+    def step(self) -> dict:
+        self._ensure_started()
+        # release the fn thread blocked in report() for the PREVIOUS result:
+        # between train() calls the thread sits at the report barrier, so a
+        # pause/save sees a quiescent function (ray's session semantics).
+        if getattr(self, "_consumed_one", False):
+            self._session.continue_sem.release()
+        self._consumed_one = True
+        while True:
+            try:
+                item = self._session.results.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if self._fn_done.is_set() and self._session.results.empty():
+                    item = None
+                    break
+        if item is None:
+            if self._error:
+                raise RuntimeError(
+                    f"trial function failed:\n{self._error[0]}")
+            out = dict(self._ret) if isinstance(self._ret, dict) else {}
+            out[RESULT_DONE] = True
+            return out
+        metrics = item["metrics"]
+        self._last_fn_checkpoint = item.get("checkpoint")
+        return metrics
+
+    def resume_training(self) -> None:
+        """Unblock the fn thread after the controller consumed a result."""
+        if self._session is not None:
+            self._session.continue_sem.release()
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        ckpt = getattr(self, "_last_fn_checkpoint", None) or \
+            (self._session.last_checkpoint if self._session else None)
+        if ckpt is not None:
+            import shutil
+
+            for name in os.listdir(ckpt.path):
+                src = os.path.join(ckpt.path, name)
+                dst = os.path.join(checkpoint_dir, name)
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        self._resume_ckpt = Checkpoint(checkpoint_dir)
+
+    def cleanup(self) -> None:
+        if self._session is not None:
+            self._session.stop_event.set()
+            self._session.continue_sem.release()
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+
+
+def wrap_function(fn: Callable) -> type:
+    """Build a FunctionTrainable subclass bound to `fn`."""
+    return type(f"fn_{getattr(fn, '__name__', 'trainable')}",
+                (FunctionTrainable,), {"_fn": staticmethod(fn)})
+
+
+def is_trainable_class(obj: Any) -> bool:
+    return isinstance(obj, type) and issubclass(obj, Trainable)

@@ -1,0 +1,287 @@
+"""TuneController: the trial-driving event loop.
+
+Analog of ray: python/ray/tune/execution/tune_controller.py:68 — an event
+loop over trial actors (one actor per running trial, resources reserved
+via actor options), feeding every result to the scheduler + searcher and
+enforcing their decisions (CONTINUE / PAUSE / STOP).  Pause and PBT
+exploitation move checkpoints across actor restarts.  State snapshots to
+`experiment_state.json` after every transition enable restore.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.experiment import (ERROR, PAUSED, PENDING, RUNNING,
+                                     TERMINATED, ExperimentState, Trial)
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
+                                     TrialScheduler)
+from ray_tpu.tune.search.searcher import FINISHED, Searcher
+from ray_tpu.tune.trainable import RESULT_DONE, TRAINING_ITERATION
+
+logger = logging.getLogger(__name__)
+
+
+class _TrialRunner:
+    """In-actor host for one Trainable instance."""
+
+    def __init__(self, trainable_cls: type, config: dict, trial_id: str,
+                 checkpoint: Checkpoint | None = None):
+        self._t = trainable_cls(dict(config))
+        self._t.trial_id = trial_id
+        if checkpoint is not None:
+            self._t.restore(checkpoint)
+
+    def train(self) -> dict:
+        return self._t.train()
+
+    def save(self) -> Checkpoint:
+        return self._t.save()
+
+    def stop(self) -> None:
+        self._t.stop()
+
+    def reset(self, new_config: dict) -> bool:
+        ok = self._t.reset_config(dict(new_config))
+        if ok:
+            self._t.config = dict(new_config)
+        return bool(ok)
+
+
+class TuneController:
+    def __init__(self, trainable_cls: type, *,
+                 searcher: Searcher,
+                 scheduler: TrialScheduler | None = None,
+                 metric: str | None = None, mode: str = "max",
+                 max_concurrent: int = 0,
+                 storage_path: str, experiment_name: str,
+                 stop: dict | Callable | None = None,
+                 max_failures: int = 0,
+                 resources_per_trial: dict | None = None,
+                 checkpoint_freq: int = 0,
+                 restored_trials: list[Trial] | None = None):
+        self.trainable_cls = trainable_cls
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent
+        self.stop_criteria = stop
+        self.max_failures = max_failures
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.checkpoint_freq = checkpoint_freq
+        self.experiment_name = experiment_name
+        self.state = ExperimentState(storage_path, experiment_name)
+
+        self.trials: list[Trial] = list(restored_trials or [])
+        self._actors: dict[str, Any] = {}          # trial_id -> handle
+        self._futures: dict[Any, str] = {}         # train() ref -> trial_id
+        self._search_done = False
+        self.scheduler.set_search_properties(metric, mode)
+        for t in self.trials:
+            self.scheduler.on_trial_add(t)
+
+    # -------------------------------------------------------------- helpers
+    def _live(self) -> list[Trial]:
+        return [t for t in self.trials if t.status in (PENDING, RUNNING,
+                                                       PAUSED)]
+
+    def _running(self) -> list[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def _next_from_search(self) -> Optional[Trial]:
+        if self._search_done:
+            return None
+        tid = f"{len(self.trials):05d}"
+        out = self.searcher.suggest(tid)
+        if out == FINISHED:
+            self._search_done = True
+            return None
+        if out is None:
+            return None
+        trial = Trial(tid, out, self.experiment_name,
+                      resources=self.resources)
+        self.trials.append(trial)
+        self.scheduler.on_trial_add(trial)
+        return trial
+
+    def _start_trial(self, trial: Trial) -> None:
+        checkpoint = trial.checkpoint
+        config = trial.config
+        if trial.status == PAUSED and isinstance(
+                self.scheduler, sched_mod.PopulationBasedTraining):
+            exploited = self.scheduler.exploit(trial, self.trials)
+            if exploited is not None:
+                donor, new_config = exploited
+                ckpt = self._donor_checkpoint(donor)
+                if ckpt is not None:
+                    checkpoint = ckpt
+                    config = new_config
+                    trial.config = new_config
+        opts = _actor_options(trial.resources)
+        runner = ray_tpu.remote(_TrialRunner).options(**opts).remote(
+            self.trainable_cls, config, trial.trial_id, checkpoint)
+        self._actors[trial.trial_id] = runner
+        trial.status = RUNNING
+        trial.start_time = trial.start_time or time.time()
+        self._submit_train(trial)
+
+    def _donor_checkpoint(self, donor: Trial) -> Checkpoint | None:
+        """Latest checkpoint of a (possibly running) donor trial."""
+        handle = self._actors.get(donor.trial_id)
+        if handle is not None:
+            try:
+                return ray_tpu.get(handle.save.remote(), timeout=60.0)
+            except Exception:  # noqa: BLE001
+                pass
+        return donor.checkpoint
+
+    def _submit_train(self, trial: Trial) -> None:
+        ref = self._actors[trial.trial_id].train.remote()
+        self._futures[ref] = trial.trial_id
+
+    def _stop_actor(self, trial: Trial, save: bool = False) -> None:
+        handle = self._actors.pop(trial.trial_id, None)
+        if handle is None:
+            return
+        try:
+            if save:
+                trial.checkpoint = ray_tpu.get(handle.save.remote(),
+                                               timeout=60.0)
+            ray_tpu.get(handle.stop.remote(), timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.kill(handle)
+
+    def _should_stop(self, trial: Trial, result: dict) -> bool:
+        crit = self.stop_criteria
+        if crit is None:
+            return False
+        if callable(crit):
+            return bool(crit(trial.trial_id, result))
+        for key, bound in crit.items():
+            v = result.get(key)
+            if v is not None and v >= bound:
+                return True
+        return False
+
+    # ------------------------------------------------------------ main loop
+    def step(self) -> bool:
+        """One scheduling step; returns False when the experiment is done."""
+        # 1. launch work up to the concurrency cap
+        cap = self.max_concurrent or 10 ** 9
+        while len(self._running()) < cap:
+            trial = self.scheduler.choose_trial_to_run(
+                [t for t in self.trials if t.status in (PENDING, PAUSED)])
+            if trial is None:
+                trial = self._next_from_search()
+            if trial is None:
+                break
+            try:
+                self._start_trial(trial)
+            except Exception as e:  # noqa: BLE001
+                trial.status = ERROR
+                trial.error = repr(e)
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+        if not self._futures:
+            if self._live():
+                time.sleep(0.05)   # searcher momentarily out of suggestions
+                return True
+            return False
+
+        # 2. wait for any train() result
+        ready, _ = ray_tpu.wait(list(self._futures), num_returns=1,
+                                timeout=5.0)
+        for ref in ready:
+            trial_id = self._futures.pop(ref)
+            trial = next(t for t in self.trials if t.trial_id == trial_id)
+            try:
+                result = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001
+                self._on_trial_error(trial, e)
+                continue
+            self._on_trial_result(trial, result)
+        self.state.save(self.trials, {"metric": self.metric,
+                                      "mode": self.mode})
+        return bool(self._live() or self._futures)
+
+    _AUTO_KEYS = frozenset({TRAINING_ITERATION, "time_total_s", "trial_id"})
+
+    def _on_trial_result(self, trial: Trial, result: dict) -> None:
+        if result.pop(RESULT_DONE, False):
+            # the done marker only carries data when the fn returned a dict
+            if set(result) - self._AUTO_KEYS:
+                trial.results.append(result)
+                trial.last_result = result
+            self._complete(trial, TERMINATED)
+            return
+        trial.results.append(result)
+        trial.last_result = result
+        self.searcher.on_trial_result(trial.trial_id, result)
+        decision = self.scheduler.on_trial_result(trial, result)
+        if self._should_stop(trial, result):
+            decision = STOP
+        if decision == CONTINUE:
+            it = result.get(TRAINING_ITERATION, 0)
+            if self.checkpoint_freq and it % self.checkpoint_freq == 0:
+                handle = self._actors[trial.trial_id]
+                try:
+                    trial.checkpoint = ray_tpu.get(handle.save.remote(),
+                                                   timeout=60.0)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._submit_train(trial)
+        elif decision == PAUSE:
+            self._stop_actor(trial, save=True)
+            trial.status = PAUSED
+        elif decision == STOP:
+            self._complete(trial, TERMINATED)
+
+    def _on_trial_error(self, trial: Trial, err: Exception) -> None:
+        trial.num_failures += 1
+        logger.warning("trial %s failed (%d): %r", trial.trial_id,
+                       trial.num_failures, err)
+        self._stop_actor(trial)
+        if trial.num_failures <= self.max_failures:
+            trial.status = PENDING   # retried from last checkpoint
+            return
+        trial.status = ERROR
+        trial.error = repr(err)
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result,
+                                        error=True)
+
+    def _complete(self, trial: Trial, status: str) -> None:
+        self._stop_actor(trial, save=trial.checkpoint is None)
+        trial.status = status
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+
+    def run(self) -> list[Trial]:
+        try:
+            while self.step():
+                pass
+        finally:
+            for t in self._running():
+                self._stop_actor(t)
+                if t.status == RUNNING:
+                    t.status = TERMINATED
+            self.state.save(self.trials, {"metric": self.metric,
+                                          "mode": self.mode})
+        return self.trials
+
+
+def _actor_options(resources: dict) -> dict:
+    opts: dict = {}
+    r = dict(resources)
+    if "CPU" in r:
+        opts["num_cpus"] = r.pop("CPU")
+    if "TPU" in r:
+        opts["num_tpus"] = r.pop("TPU")
+    if r:
+        opts["resources"] = r
+    return opts

@@ -1,0 +1,236 @@
+"""Tuner / TuneConfig / ResultGrid: the public Tune surface.
+
+Analog of ray: python/ray/tune/tuner.py:44 (Tuner, fit :344, restore),
+tune/result_grid.py (ResultGrid), and the legacy `tune.run` entry point.
+A Trainer passed as the trainable rides through `as_trainable()`
+(ray: BaseTrainer.fit wraps itself in a 1-trial Tuner; here Tune wraps
+Train — same coupling, inverted dependency).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.experiment import ERROR, TERMINATED, ExperimentState, Trial
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
+                                          ConcurrencyLimiter, Searcher)
+from ray_tpu.tune.trainable import (Trainable, is_trainable_class,
+                                    wrap_function)
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """ray: python/ray/tune/tune_config.py."""
+
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    search_alg: Searcher | None = None
+    scheduler: TrialScheduler | None = None
+    seed: int | None = None
+    max_failures: int = 0
+    checkpoint_freq: int = 0
+
+
+class Result:
+    """One trial's outcome (ray: ray.train.Result as returned by tune)."""
+
+    def __init__(self, trial: Trial):
+        self.metrics = trial.last_result or {}
+        self.metrics_history = list(trial.results)
+        self.checkpoint = trial.checkpoint
+        self.error = trial.error
+        self.config = trial.config
+        self.trial_id = trial.trial_id
+        self.path = None
+
+    def __repr__(self):
+        return (f"Result(trial_id={self.trial_id}, metrics={self.metrics}, "
+                f"error={self.error})")
+
+
+class ResultGrid:
+    """ray: python/ray/tune/result_grid.py."""
+
+    def __init__(self, trials: list[Trial], metric: str | None,
+                 mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self._results = [Result(t) for t in trials]
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> list[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric is required (set TuneConfig.metric)")
+        scored = [r for r in self._results
+                  if r.metrics and r.metrics.get(metric) is not None]
+        if not scored:
+            raise RuntimeError("no trial reported metric "
+                               f"{metric!r}; errors: {self.errors}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored,
+                                                              key=key)
+
+    def get_dataframe(self) -> list[dict]:
+        """Rows of final metrics + flattened config (list of dicts — a
+        DataFrame without the pandas dependency)."""
+        from ray_tpu.tune.search.variant_generator import flatten
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row["trial_id"] = r.trial_id
+            for k, v in flatten(r.config or {}).items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return rows
+
+
+class Tuner:
+    """ray: python/ray/tune/tuner.py:44."""
+
+    def __init__(self, trainable: Any = None, *,
+                 param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 _restored_trials: list[Trial] | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials = _restored_trials
+
+    # ------------------------------------------------------------ plumbing
+    def _experiment_name(self) -> str:
+        if self.run_config.name:
+            return self.run_config.name
+        name = getattr(self.trainable, "__name__", None) or \
+            type(self.trainable).__name__
+        return f"{name}_tune"
+
+    def _storage(self) -> str:
+        return self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+
+    def _trainable_cls(self) -> type:
+        t = self.trainable
+        if is_trainable_class(t):
+            return t
+        if callable(t) and not hasattr(t, "as_trainable"):
+            return wrap_function(t)
+        if hasattr(t, "as_trainable"):   # a Trainer instance
+            return wrap_function(t.as_trainable())
+        raise TypeError(f"not a trainable: {t!r}")
+
+    def _searcher(self) -> Searcher:
+        tc = self.tune_config
+        if tc.search_alg is not None:
+            alg = tc.search_alg
+            alg.set_search_properties(tc.metric, tc.mode, self.param_space)
+            if tc.max_concurrent_trials and not isinstance(
+                    alg, (ConcurrencyLimiter, BasicVariantGenerator)):
+                alg = ConcurrencyLimiter(alg, tc.max_concurrent_trials)
+            return alg
+        return BasicVariantGenerator(self.param_space,
+                                     num_samples=tc.num_samples,
+                                     seed=tc.seed, metric=tc.metric,
+                                     mode=tc.mode)
+
+    def _resources(self) -> dict:
+        t = self.trainable
+        if hasattr(t, "scaling_config"):
+            # Trainer: the trial actor only coordinates; its workers hold
+            # the real resources (ray: _maybe_warn_resource_contention)
+            return {"CPU": 0.1}
+        return {"CPU": 1.0}
+
+    # -------------------------------------------------------------- public
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        controller = TuneController(
+            self._trainable_cls(),
+            searcher=self._searcher(),
+            scheduler=tc.scheduler,
+            metric=tc.metric, mode=tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            storage_path=self._storage(),
+            experiment_name=self._experiment_name(),
+            stop=self.run_config.stop,
+            max_failures=tc.max_failures,
+            resources_per_trial=self._resources(),
+            checkpoint_freq=tc.checkpoint_freq,
+            restored_trials=self._restored_trials)
+        trials = controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        return os.path.exists(os.path.join(path, "experiment_state.json"))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any,
+                resume_errored: bool = False) -> "Tuner":
+        """Resume an interrupted experiment from its storage dir
+        (ray: Tuner.restore tuner.py): finished trials keep results,
+        unfinished ones restart (from checkpoint when present)."""
+        path = path.rstrip("/")
+        storage, name = os.path.split(path)
+        state = ExperimentState(storage, name)
+        trials, meta = state.load(name)
+        for t in trials:
+            if t.status in (TERMINATED,):
+                continue
+            if t.status == ERROR and not resume_errored:
+                continue
+            t.status = "PENDING"
+            t.error = None
+            t.num_failures = 0
+        tuner = cls(trainable,
+                    tune_config=TuneConfig(metric=meta.get("metric"),
+                                           mode=meta.get("mode", "max"),
+                                           num_samples=0),
+                    run_config=RunConfig(name=name, storage_path=storage),
+                    _restored_trials=trials)
+        return tuner
+
+
+def run(trainable, *, config: dict | None = None, num_samples: int = 1,
+        metric: str | None = None, mode: str = "max",
+        scheduler: TrialScheduler | None = None,
+        search_alg: Searcher | None = None,
+        stop: dict | None = None, storage_path: str | None = None,
+        name: str | None = None, max_concurrent_trials: int = 0,
+        **_ignored) -> ResultGrid:
+    """Legacy entry point (ray: tune.run tune/tune.py)."""
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples,
+                               scheduler=scheduler, search_alg=search_alg,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=RunConfig(name=name, storage_path=storage_path,
+                             stop=stop))
+    return tuner.fit()

@@ -1,0 +1,252 @@
+"""Tune library tests: searchers, schedulers, Tuner end-to-end.
+
+Mirrors the reference's Tune test approach (ray: python/ray/tune/tests/)
+— pure-logic tests for samplers/schedulers, plus end-to-end Tuner.fit
+against the shared single-node runtime.
+"""
+import random
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.search.variant_generator import generate_variants
+
+
+# ------------------------------------------------------------ pure logic
+class TestSearchSpace:
+    def test_grid_cross_product(self):
+        space = {"a": tune.grid_search([1, 2, 3]),
+                 "b": tune.grid_search(["x", "y"]),
+                 "c": 7}
+        variants = list(generate_variants(space, random.Random(0)))
+        assert len(variants) == 6
+        assert {v["a"] for v in variants} == {1, 2, 3}
+        assert all(v["c"] == 7 for v in variants)
+
+    def test_domains_sample_in_bounds(self):
+        rng = random.Random(0)
+        for _ in range(100):
+            assert 0.1 <= tune.uniform(0.1, 2.0).sample(rng) <= 2.0
+            assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+            assert tune.randint(3, 10).sample(rng) in range(3, 10)
+            assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+        q = tune.quniform(0.0, 1.0, 0.25).sample(rng)
+        assert q in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+    def test_nested_spaces(self):
+        space = {"opt": {"lr": tune.grid_search([1, 2])}, "deep": True}
+        variants = list(generate_variants(space, random.Random(0)))
+        assert [v["opt"]["lr"] for v in variants] == [1, 2]
+
+    def test_basic_variant_counts(self):
+        gen = tune.BasicVariantGenerator(
+            {"a": tune.grid_search([1, 2]), "b": tune.uniform(0, 1)},
+            num_samples=3)
+        assert gen.total_trials == 6
+        seen = [gen.suggest(str(i)) for i in range(6)]
+        assert all(s is not None for s in seen)
+        from ray_tpu.tune.search.searcher import FINISHED
+
+        assert gen.suggest("7") == FINISHED
+
+
+class TestSchedulers:
+    def _trial(self, tid):
+        return Trial(tid, {}, "exp")
+
+    def test_asha_stops_bad_trials(self):
+        sched = tune.ASHAScheduler(metric="score", mode="max",
+                                   grace_period=1, reduction_factor=2,
+                                   max_t=100)
+        good, bad = self._trial("good"), self._trial("bad")
+        sched.on_trial_add(good)
+        sched.on_trial_add(bad)
+        # at rung t=1: good reports 1.0, bad reports 0.1 → bad cut
+        assert sched.on_trial_result(
+            good, {"training_iteration": 1, "score": 1.0}) == CONTINUE
+        assert sched.on_trial_result(
+            bad, {"training_iteration": 1, "score": 0.1}) == STOP
+
+    def test_asha_stops_at_max_t(self):
+        sched = tune.ASHAScheduler(metric="score", mode="max", max_t=5)
+        t = self._trial("t")
+        sched.on_trial_add(t)
+        assert sched.on_trial_result(
+            t, {"training_iteration": 5, "score": 1.0}) == STOP
+
+    def test_median_stopping(self):
+        sched = tune.MedianStoppingRule(metric="score", mode="max",
+                                        grace_period=2,
+                                        min_samples_required=2)
+        trials = [self._trial(f"t{i}") for i in range(3)]
+        for step in (1, 2):
+            for i, t in enumerate(trials[:2]):
+                assert sched.on_trial_result(
+                    t, {"training_iteration": step,
+                        "score": 1.0 + i}) == CONTINUE
+        # third trial far below the median of running means → stopped
+        sched.on_trial_result(trials[2], {"training_iteration": 1,
+                                          "score": 0.0})
+        assert sched.on_trial_result(
+            trials[2], {"training_iteration": 2, "score": 0.0}) == STOP
+
+    def test_pbt_mutation_bounds(self):
+        sched = tune.PopulationBasedTraining(
+            metric="score", mode="max", perturbation_interval=1,
+            hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+        v = sched._mutate("lr", 0.5, tune.uniform(0.1, 1.0))
+        assert 0.1 <= v <= 1.0
+        v2 = sched._mutate("k", "b", ["a", "b", "c"])
+        assert v2 in ("a", "b", "c")
+
+
+class TestTPE:
+    def test_tpe_improves_on_quadratic(self):
+        space = {"x": tune.uniform(-4.0, 4.0)}
+        tpe = tune.TPESearch(space, metric="loss", mode="min",
+                             n_initial_points=6, seed=0)
+        best = float("inf")
+        for i in range(40):
+            cfg = tpe.suggest(f"t{i}")
+            loss = (cfg["x"] - 1.0) ** 2
+            best = min(best, loss)
+            tpe.on_trial_complete(f"t{i}", {"loss": loss})
+        assert best < 0.1   # found near x=1
+
+
+# ------------------------------------------------------------ end-to-end
+def _trainable(config):
+    score = 0.0
+    for i in range(3):
+        score += config["lr"]
+        tune.report({"score": score, "training_iteration": i + 1})
+
+
+class TestTunerE2E:
+    def test_grid_search_fit(self, ray_shared, tmp_path):
+        tuner = tune.Tuner(
+            _trainable,
+            param_space={"lr": tune.grid_search([0.1, 0.5, 1.0])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=__import__("ray_tpu.train",
+                                  fromlist=["RunConfig"]).RunConfig(
+                name="grid", storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        assert len(grid) == 3
+        assert not grid.errors
+        best = grid.get_best_result()
+        assert best.config["lr"] == 1.0
+        assert best.metrics["score"] == pytest.approx(3.0)
+
+    def test_class_trainable_and_checkpointing(self, ray_shared, tmp_path):
+        from ray_tpu.train import RunConfig
+
+        class MyTrainable(tune.Trainable):
+            def setup(self, config):
+                self.x = config["start"]
+
+            def step(self):
+                self.x += 1
+                return {"x": self.x}
+
+            def save_checkpoint(self, d):
+                import json, os
+
+                with open(os.path.join(d, "x.json"), "w") as f:
+                    json.dump({"x": self.x}, f)
+
+            def load_checkpoint(self, d):
+                import json, os
+
+                with open(os.path.join(d, "x.json")) as f:
+                    self.x = json.load(f)["x"]
+
+        tuner = tune.Tuner(
+            MyTrainable, param_space={"start": 10},
+            tune_config=tune.TuneConfig(metric="x", mode="max",
+                                        checkpoint_freq=1),
+            run_config=RunConfig(name="cls", storage_path=str(tmp_path),
+                                 stop={"x": 13}))
+        grid = tuner.fit()
+        assert not grid.errors
+        assert grid.get_best_result().metrics["x"] == 13
+        assert grid[0].checkpoint is not None
+
+    def test_asha_e2e_stops_early(self, ray_shared, tmp_path):
+        from ray_tpu.train import RunConfig
+
+        def train_fn(config):
+            for i in range(20):
+                tune.report({"score": config["q"] * (i + 1),
+                             "training_iteration": i + 1})
+
+        tuner = tune.Tuner(
+            train_fn,
+            param_space={"q": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max",
+                scheduler=tune.ASHAScheduler(
+                    metric="score", mode="max", grace_period=2,
+                    reduction_factor=2, max_t=20)),
+            run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        assert not grid.errors
+        # the weakest trials must not have run to 20 iterations
+        iters = sorted(len(r.metrics_history) for r in grid)
+        assert iters[0] < 20
+        assert grid.get_best_result().config["q"] == 2.0
+
+    def test_tuner_restore(self, ray_shared, tmp_path):
+        from ray_tpu.train import RunConfig
+
+        def crashy(config):
+            for i in range(3):
+                tune.report({"v": i})
+            if config["boom"]:
+                raise RuntimeError("boom")
+
+        tuner = tune.Tuner(
+            crashy,
+            param_space={"boom": tune.grid_search([False, True])},
+            tune_config=tune.TuneConfig(metric="v", mode="max"),
+            run_config=RunConfig(name="res", storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        assert len(grid.errors) == 1
+        path = str(tmp_path / "res")
+        assert tune.Tuner.can_restore(path)
+
+        def fixed(config):
+            for i in range(3):
+                tune.report({"v": i})
+
+        grid2 = tune.Tuner.restore(path, fixed,
+                                   resume_errored=True).fit()
+        assert not grid2.errors
+        assert len(grid2) == 2
+
+    def test_trainer_as_trainable(self, ray_shared, tmp_path):
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+        def loop(config):
+            from ray_tpu import train
+
+            for i in range(2):
+                train.report({"loss": config.get("lr", 1.0) * (i + 1)})
+
+        trainer = JaxTrainer(
+            loop, scaling_config=ScalingConfig(num_workers=1,
+                                               num_cpus_per_worker=0.5),
+            run_config=RunConfig(name="inner", storage_path=str(tmp_path)))
+        tuner = tune.Tuner(
+            trainer,
+            param_space={"train_loop_config": {
+                "lr": tune.grid_search([0.5, 1.0])}},
+            tune_config=tune.TuneConfig(metric="loss", mode="min"),
+            run_config=RunConfig(name="outer", storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        assert not grid.errors
+        assert grid.get_best_result().config[
+            "train_loop_config"]["lr"] == 0.5
