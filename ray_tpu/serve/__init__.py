@@ -1,0 +1,22 @@
+"""ray_tpu.serve: online model serving (the Ray Serve analog).
+
+Controller reconcile loop + replica actors + power-of-two routing +
+stdlib HTTP proxy (SURVEY §2.3 / §3.5).
+"""
+from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
+                               http_port, ingress, run, shutdown, start,
+                               status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.proxy import Request
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "shutdown",
+    "status", "delete", "get_app_handle", "get_deployment_handle",
+    "http_port", "ingress", "batch", "multiplexed",
+    "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "Request",
+]

@@ -1,0 +1,194 @@
+"""Public Serve API: start/run/status/delete/shutdown + handles.
+
+Analog of ray: python/ray/serve/api.py (serve.run:510, serve.start,
+serve.status, serve.delete, serve.shutdown, serve.get_app_handle).
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Any
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
+
+PROXY_NAME = "SERVE_PROXY"
+
+_controller = None      # ActorHandle
+_proxy = None           # ActorHandle
+
+
+def start(http_options: dict | None = None, detached: bool = True):
+    """Ensure the Serve instance (controller + HTTP proxy) is running
+    (ray: serve.start)."""
+    global _controller, _proxy
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if _controller is None:
+        _controller = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
+            max_concurrency=32, num_cpus=0.1).remote()
+    if _proxy is None:
+        from ray_tpu.serve.proxy import ProxyActor
+
+        opts = http_options or {}
+        _proxy = ray_tpu.remote(ProxyActor).options(
+            name=PROXY_NAME, get_if_exists=True, lifetime="detached",
+            max_concurrency=64, num_cpus=0.1).remote(
+            _controller.actor_id, opts.get("host", "127.0.0.1"),
+            opts.get("port", 0))
+        ray_tpu.get(_proxy.ready.remote(), timeout=30.0)
+    return _controller
+
+
+def _deployment_version(app_node: Application) -> str:
+    """Code/config version: changing only user_config or num_replicas keeps
+    the version → in-place reconfigure/scale instead of replica restart
+    (ray: deployment_state.py version/config-change classification)."""
+    d = app_node.deployment
+    payload = cloudpickle.dumps((
+        d._cls, app_node.init_args, app_node.init_kwargs,
+        d.config.max_ongoing_requests, d.config.ray_actor_options))
+    return hashlib.sha1(payload).hexdigest()[:12]
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str = "/", _blocking: bool = True,
+        timeout_s: float = 120.0) -> DeploymentHandle:
+    """Deploy an application graph and return a handle to its ingress
+    (ray: serve.run api.py:510)."""
+    if not isinstance(app, Application):
+        raise TypeError("serve.run takes the result of Deployment.bind()")
+    ctrl = start()
+    nodes = app._walk({})
+    names = set()
+    for node in nodes:
+        if node.deployment.name in names:
+            raise ValueError(
+                f"duplicate deployment name {node.deployment.name!r} in app")
+        names.add(node.deployment.name)
+
+    deployments = []
+    for node in nodes:
+        # Replace bound child nodes with handles (model composition).
+        def sub(v):
+            if isinstance(v, Application):
+                return DeploymentHandle(v.deployment.name, name,
+                                        ctrl.actor_id)
+            return v
+        deployments.append({
+            "name": node.deployment.name,
+            "cls": node.deployment._cls,
+            "init_args": tuple(sub(a) for a in node.init_args),
+            "init_kwargs": {k: sub(v) for k, v in node.init_kwargs.items()},
+            "config": node.deployment.config,
+            "version": _deployment_version(node),
+        })
+    ray_tpu.get(ctrl.deploy_app.remote(
+        name, route_prefix, app.deployment.name, deployments), timeout=60.0)
+    if _blocking:
+        ok = ray_tpu.get(ctrl.wait_for_deployments_ready.remote(
+            name, timeout_s), timeout=timeout_s + 10.0)
+        if not ok:
+            raise TimeoutError(
+                f"app {name!r} did not become ready in {timeout_s}s: "
+                f"{status()}")
+    return DeploymentHandle(app.deployment.name, name, ctrl.actor_id)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ctrl = _require_controller()
+    st = ray_tpu.get(ctrl.status.remote())
+    if name not in st:
+        raise ValueError(f"no serve app named {name!r}")
+    ingress = ray_tpu.get(ctrl.get_app_routes.remote())
+    for _prefix, (app, ing) in ingress.items():
+        if app == name:
+            return DeploymentHandle(ing, name, ctrl.actor_id)
+    raise ValueError(f"app {name!r} has no ingress")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    ctrl = _require_controller()
+    return DeploymentHandle(deployment_name, app_name, ctrl.actor_id)
+
+
+def status() -> dict:
+    ctrl = _require_controller()
+    return ray_tpu.get(ctrl.status.remote())
+
+
+def delete(name: str, _blocking: bool = True) -> None:
+    ctrl = _require_controller()
+    ray_tpu.get(ctrl.delete_app.remote(name))
+    if _blocking:
+        import time
+
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if name not in ray_tpu.get(ctrl.status.remote()):
+                return
+            time.sleep(0.1)
+
+
+def http_port() -> int:
+    """Port the HTTP proxy is listening on (ephemeral by default)."""
+    if _proxy is None:
+        raise RuntimeError("serve is not started")
+    return ray_tpu.get(_proxy.get_port.remote())
+
+
+def shutdown() -> None:
+    """Tear down all apps, the controller and the proxy (ray:
+    serve.shutdown)."""
+    global _controller, _proxy
+    if _controller is not None:
+        try:
+            ray_tpu.get(_controller.graceful_shutdown.remote(), timeout=30.0)
+            import time
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if not ray_tpu.get(_controller.status.remote()):
+                    break
+                time.sleep(0.1)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.kill(_controller)
+        except Exception:  # noqa: BLE001
+            pass
+        _controller = None
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:  # noqa: BLE001
+            pass
+        _proxy = None
+
+
+def _require_controller():
+    global _controller
+    if _controller is None:
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            raise RuntimeError(
+                "serve is not running; call serve.start() or serve.run()")
+    return _controller
+
+
+def ingress(_app=None):
+    """Marker decorator for API parity (ray: @serve.ingress(app) wires a
+    FastAPI app; without FastAPI in this environment the ingress deployment
+    receives ray_tpu.serve.Request directly)."""
+    def wrap(cls):
+        return cls
+    return wrap if _app is None or isinstance(_app, type) else wrap

@@ -1,0 +1,136 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Analog of ray: python/ray/serve/batching.py (@serve.batch,
+_BatchQueue).  Calls to the decorated async method are queued; a batch is
+launched when `max_batch_size` requests are waiting or
+`batch_wait_timeout_s` elapses, whichever first.  The wrapped function
+receives a list of requests and must return a list of results of the same
+length.
+
+TPU note: XLA compiles one program per shape, so unconstrained dynamic
+batch sizes would trigger recompiles.  `pad_batch_to` rounds the batch up
+to fixed buckets (e.g. [1, 2, 4, 8]) by repeating the last element —
+the bucketed-shapes discipline from SURVEY §7 ("Serve continuous batching
+on TPU: static-shape XLA → bucketed shapes").  The extra padded results
+are dropped before responding.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable
+
+
+class _BatchQueue:
+    def __init__(self, func: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float,
+                 pad_batch_to: list[int] | None):
+        self.func = func
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self.pad_batch_to = sorted(pad_batch_to) if pad_batch_to else None
+        self.queue: list[tuple[Any, asyncio.Future]] = []
+        self._wakeup: asyncio.Event | None = None
+        self._loop_task: asyncio.Task | None = None
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._wakeup = asyncio.Event()
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._batch_loop())
+
+    async def submit(self, item: Any) -> Any:
+        self._ensure_loop()
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append((item, fut))
+        self._wakeup.set()
+        return await fut
+
+    async def _batch_loop(self) -> None:
+        while True:
+            await self._wakeup.wait()
+            self._wakeup.clear()
+            if not self.queue:
+                continue
+            # wait for more arrivals up to the batch window
+            if len(self.queue) < self.max_batch_size and self.timeout_s > 0:
+                try:
+                    await asyncio.wait_for(self._full(), self.timeout_s)
+                except asyncio.TimeoutError:
+                    pass
+            batch = self.queue[:self.max_batch_size]
+            del self.queue[:len(batch)]
+            if self.queue:
+                self._wakeup.set()
+            await self._run_batch(batch)
+
+    async def _full(self) -> None:
+        while len(self.queue) < self.max_batch_size:
+            self._wakeup.clear()
+            await self._wakeup.wait()
+
+    async def _run_batch(self, batch: list) -> None:
+        items = [it for it, _ in batch]
+        n = len(items)
+        if self.pad_batch_to:
+            target = next((b for b in self.pad_batch_to if b >= n),
+                          self.pad_batch_to[-1])
+            items = items + [items[-1]] * (target - n)
+        try:
+            results = self.func(items)
+            if asyncio.iscoroutine(results):
+                results = await results
+            results = list(results)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batched function returned {len(results)} results "
+                    f"for a batch of {len(items)}")
+            results = results[:n]   # drop only the pad overhang
+            for (_, fut), r in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(r)
+        except Exception as e:  # noqa: BLE001
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01,
+          pad_batch_to: list[int] | None = None):
+    """Decorator for replica methods: `async def m(self, items: list)`.
+
+    ray: serve/batching.py @serve.batch.
+    """
+    def wrap(f):
+        attr = f"__serve_batch_queue_{f.__name__}"
+
+        if _is_method(f):
+            @functools.wraps(f)
+            async def method_wrapper(self, item):
+                q = getattr(self, attr, None)
+                if q is None:
+                    q = _BatchQueue(
+                        functools.partial(f, self), max_batch_size,
+                        batch_wait_timeout_s, pad_batch_to)
+                    setattr(self, attr, q)
+                return await q.submit(item)
+            return method_wrapper
+
+        q = _BatchQueue(f, max_batch_size, batch_wait_timeout_s, pad_batch_to)
+
+        @functools.wraps(f)
+        async def func_wrapper(item):
+            return await q.submit(item)
+        return func_wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap
+
+
+def _is_method(f: Callable) -> bool:
+    import inspect
+
+    params = list(inspect.signature(f).parameters)
+    return bool(params) and params[0] == "self"

@@ -1,0 +1,63 @@
+"""Serve configuration dataclasses.
+
+Analog of ray: python/ray/serve/config.py + serve/schema.py (DeploymentSchema,
+AutoscalingConfig) — the declarative spec the controller reconciles against
+(ray: _private/deployment_state.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Scale replicas on ongoing-request load (ray: serve/config.py
+    AutoscalingConfig; policy in _private/autoscaling_state.py).
+
+    target_ongoing_requests: per-replica load the autoscaler steers toward.
+    """
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    metrics_interval_s: float = 0.2
+
+    def desired(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(self.min_replicas, 1)
+        want = total_ongoing / self.target_ongoing_requests
+        import math
+
+        want = math.ceil(want) if want > current else math.floor(want)
+        return max(self.min_replicas, min(self.max_replicas, int(want)))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Per-deployment settings (ray: serve/config.py DeploymentConfig)."""
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: Any = None
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+
+
+# Replica lifecycle states (ray: _private/common.py ReplicaState).
+REPLICA_STARTING = "STARTING"
+REPLICA_RUNNING = "RUNNING"
+REPLICA_STOPPING = "STOPPING"
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    replica_id: str
+    deployment: str
+    app: str
+    actor_id: str
+    state: str = REPLICA_STARTING
+    version: str = ""

@@ -1,0 +1,455 @@
+"""ServeController: the reconciling control plane for Serve.
+
+Analog of ray: python/ray/serve/_private/controller.py (ServeController,
+run_control_loop:372) + deployment_state.py (DeploymentState reconciler) +
+autoscaling_state.py (autoscaling policy) + deployment_scheduler.py.
+
+A *threaded* actor (not asyncio): the control loop and RPC methods run on
+the actor's thread pool so they may freely make blocking framework calls
+(create actor / get / kill) — the same reason the reference runs its
+reconciler off the replica event loops.  Replica membership is versioned;
+handles poll `get_deployment_info` (the long-poll analog of ray:
+_private/long_poll.py LongPollHost).
+
+Concurrency discipline: the controller lock only guards in-memory state —
+no RPC is ever made while holding it.  Replica starts/health checks are
+asynchronous (pending ObjectRefs polled each reconcile tick), so one slow
+replica init never stalls reconciliation of other deployments (ray:
+deployment_state starts replicas async and polls readiness).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+import uuid
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+RECONCILE_PERIOD_S = 0.2
+REPLICA_INIT_TIMEOUT_S = 120.0
+
+
+class _DeploymentState:
+    """Target spec + live replicas for one deployment (ray:
+    deployment_state.py DeploymentState)."""
+
+    def __init__(self, app: str, name: str, cls, init_args, init_kwargs,
+                 config, version: str):
+        self.app = app
+        self.name = name
+        self.cls = cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.version = version
+        self.target_replicas = config.num_replicas
+        # replica actor_id -> {"handle", "state", "init_ref", "init_deadline",
+        #                      "health_ref", "health_deadline", "last_health"}
+        self.replicas: dict[str, dict] = {}
+        # Old-version replicas still serving during a rolling code update;
+        # advertised only until the new version is up (ray: gradual rollout).
+        self.draining: dict[str, dict] = {}
+        self.membership_version = 0
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.deleting = False
+        self.superseded = False   # replaced by a newer _DeploymentState
+        # autoscale probe in flight: list of (rec, ref) + deadline
+        self.probe: tuple[list, float] | None = None
+
+
+class ServeController:
+    """Named detached actor; one per cluster (ray: controller.py:86)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # app -> {"route_prefix", "ingress", "deployments": {name: state}}
+        self._apps: dict[str, dict] = {}
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run_control_loop, daemon=True, name="serve-ctrl")
+        self._thread.start()
+
+    # ------------------------------------------------------------ public RPC
+    def deploy_app(self, app_name: str, route_prefix: str, ingress: str,
+                   deployments: list[dict]) -> None:
+        """Declarative (re)deploy of a whole app (ray: serve.run →
+        controller.deploy_apps).  Never blocks on replica RPCs: code
+        changes hand old replicas to the new state's drain list; config
+        changes are applied by the reconcile loop."""
+        reconfigures: list[tuple[Any, Any]] = []
+        with self._lock:
+            app = self._apps.setdefault(
+                app_name, {"route_prefix": route_prefix, "ingress": ingress,
+                           "deployments": {}})
+            app["route_prefix"] = route_prefix
+            app["ingress"] = ingress
+            new_names = {d["name"] for d in deployments}
+            for name, st in list(app["deployments"].items()):
+                if name not in new_names:
+                    st.deleting = True
+                    st.target_replicas = 0
+            for d in deployments:
+                cur = app["deployments"].get(d["name"])
+                if cur is not None and cur.version == d["version"] \
+                        and not cur.deleting:
+                    # Config-only change: rescale/reconfigure in place
+                    # (ray: deployment_state config-change classification).
+                    old_user_config = cur.config.user_config
+                    cur.config = d["config"]
+                    if cur.config.autoscaling_config is None:
+                        cur.target_replicas = d["config"].num_replicas
+                    if d["config"].user_config is not None and \
+                            d["config"].user_config != old_user_config:
+                        reconfigures.append((cur, d["config"].user_config))
+                    continue
+                new_st = _DeploymentState(
+                    app_name, d["name"], d["cls"], d["init_args"],
+                    d["init_kwargs"], d["config"], d["version"])
+                if cur is not None:
+                    cur.superseded = True
+                    # Old replicas keep serving until the new version is up.
+                    new_st.draining.update(cur.replicas)
+                    new_st.draining.update(cur.draining)
+                app["deployments"][d["name"]] = new_st
+        for st, user_config in reconfigures:
+            self._reconfigure_in_place(st, user_config)
+
+    def _reconfigure_in_place(self, st: _DeploymentState, user_config) -> None:
+        import ray_tpu
+
+        with self._lock:
+            handles = [rec["handle"] for rec in st.replicas.values()
+                       if rec["state"] == "RUNNING"]
+        refs = [h.reconfigure.remote(user_config) for h in handles]
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=30.0)
+            except Exception:  # noqa: BLE001
+                logger.warning("reconfigure failed:\n%s",
+                               traceback.format_exc())
+
+    def delete_app(self, app_name: str) -> None:
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return
+            for st in app["deployments"].values():
+                st.deleting = True
+                st.target_replicas = 0
+
+    def get_deployment_info(self, app_name: str, deployment: str) -> dict:
+        with self._lock:
+            st = self._state(app_name, deployment)
+            if st is None:
+                return {"version": -1, "replicas": [], "max_ongoing": 0}
+            running = [rid for rid, rec in st.replicas.items()
+                       if rec["state"] == "RUNNING"]
+            if not running:
+                # During a rolling update the old version keeps serving.
+                running = [rid for rid, rec in st.draining.items()
+                           if rec["state"] == "RUNNING"]
+            return {
+                "version": st.membership_version,
+                "replicas": running,
+                "max_ongoing": st.config.max_ongoing_requests,
+            }
+
+    def get_app_routes(self) -> dict:
+        """route_prefix -> (app, ingress deployment); polled by proxies
+        (ray: long-poll route table push)."""
+        with self._lock:
+            return {app["route_prefix"]: (name, app["ingress"])
+                    for name, app in self._apps.items()
+                    if any(not st.deleting
+                           for st in app["deployments"].values())}
+
+    def status(self) -> dict:
+        """Serve status tree (ray: serve.status / ServeStatusSchema)."""
+        with self._lock:
+            out = {}
+            for app_name, app in self._apps.items():
+                deps = {}
+                for name, st in app["deployments"].items():
+                    running = sum(1 for r in st.replicas.values()
+                                  if r["state"] == "RUNNING")
+                    deps[name] = {
+                        "status": ("DELETING" if st.deleting else
+                                   "HEALTHY" if running >= st.target_replicas
+                                   else "UPDATING"),
+                        "replicas": running,
+                        "target_replicas": st.target_replicas,
+                    }
+                alive = any(not st.deleting
+                            for st in app["deployments"].values())
+                out[app_name] = {
+                    "status": "RUNNING" if alive and all(
+                        d["status"] == "HEALTHY" for d in deps.values())
+                    else "DELETING" if not alive else "DEPLOYING",
+                    "route_prefix": app["route_prefix"],
+                    "deployments": deps,
+                }
+            return out
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            for app in self._apps.values():
+                for st in app["deployments"].values():
+                    st.deleting = True
+                    st.target_replicas = 0
+
+    def wait_for_deployments_ready(self, app_name: str,
+                                   timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                app = self._apps.get(app_name)
+                if app is not None:
+                    states = [st for st in app["deployments"].values()
+                              if not st.deleting]
+                    if states and all(
+                        sum(1 for r in st.replicas.values()
+                            if r["state"] == "RUNNING") >= st.target_replicas
+                            and st.target_replicas > 0
+                            for st in states):
+                        return True
+            time.sleep(0.05)
+        return False
+
+    # --------------------------------------------------------- control loop
+    def _state(self, app_name: str, deployment: str) -> _DeploymentState | None:
+        app = self._apps.get(app_name)
+        if app is None:
+            return None
+        return app["deployments"].get(deployment)
+
+    def _run_control_loop(self) -> None:
+        """ray: controller.py:372 run_control_loop."""
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.error("reconcile error:\n%s", traceback.format_exc())
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = [st for app in self._apps.values()
+                      for st in app["deployments"].values()]
+        for st in states:
+            # A state replaced by deploy_app mid-snapshot must not be
+            # reconciled: starting replicas into it would leak actors.
+            with self._lock:
+                if st.superseded or self._state(st.app, st.name) is not st:
+                    continue
+            self._autoscale(st)
+            self._reconcile_deployment(st)
+        with self._lock:
+            for app_name, app in list(self._apps.items()):
+                for name, st in list(app["deployments"].items()):
+                    if st.deleting and not st.replicas and not st.draining:
+                        del app["deployments"][name]
+                if not app["deployments"]:
+                    del self._apps[app_name]
+
+    def _autoscale(self, st: _DeploymentState) -> None:
+        """Scale on total ongoing requests (ray: autoscaling_state.py;
+        metric = replica-reported num_ongoing).  Probes are in-flight
+        ObjectRefs collected on a later tick — never a long block."""
+        cfg = st.config.autoscaling_config
+        if cfg is None or st.deleting:
+            return
+        import ray_tpu
+
+        if st.probe is not None:
+            refs_recs, deadline = st.probe
+            refs = [r for _, r in refs_recs]
+            ready, _pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0)
+            if len(ready) == len(refs) or time.monotonic() > deadline:
+                total = 0.0
+                for ref in ready:
+                    try:
+                        total += ray_tpu.get(ref, timeout=1.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                st.probe = None
+                self._apply_autoscale_decision(st, cfg, total,
+                                               len(refs_recs))
+            return
+        with self._lock:
+            running = [rec for rec in st.replicas.values()
+                       if rec["state"] == "RUNNING"]
+        if not running:
+            return
+        refs_recs = [(rec, rec["handle"].get_queue_len.remote())
+                     for rec in running]
+        st.probe = (refs_recs, time.monotonic() + 5.0)
+
+    def _apply_autoscale_decision(self, st, cfg, total: float,
+                                  n_running: int) -> None:
+        desired = cfg.desired(total, n_running)
+        now = time.monotonic()
+        if desired > st.target_replicas:
+            if now - st.last_scale_up >= cfg.upscale_delay_s:
+                st.target_replicas = desired
+                st.last_scale_up = now
+        elif desired < st.target_replicas:
+            if now - st.last_scale_down >= cfg.downscale_delay_s:
+                st.target_replicas = desired
+                st.last_scale_down = now
+        else:
+            st.last_scale_up = st.last_scale_down = now
+
+    def _reconcile_deployment(self, st: _DeploymentState) -> None:
+        """Start/stop replicas toward target; poll pending inits and
+        health checks (ray: deployment_state.py update loop)."""
+        self._poll_starting(st)
+        self._poll_health(st)
+
+        with self._lock:
+            running = {rid: rec for rid, rec in st.replicas.items()
+                       if rec["state"] == "RUNNING"}
+            starting = sum(1 for rec in st.replicas.values()
+                           if rec["state"] == "STARTING")
+            n = len(running) + starting
+            target = st.target_replicas
+        if n < target:
+            for _ in range(target - n):
+                self._start_replica(st)
+        elif len(running) > target:
+            extra = list(running)[target - len(running):] if target else \
+                list(running)
+            for rid in extra[:len(running) - target]:
+                self._remove_replica(st, rid, drain=True)
+        # Rolling update: once the new version serves, retire the old.
+        with self._lock:
+            new_up = any(rec["state"] == "RUNNING"
+                         for rec in st.replicas.values())
+            drain_now = (list(st.draining.items())
+                         if (new_up and len(running) >= target) or st.deleting
+                         else [])
+            for rid, _rec in drain_now:
+                st.draining.pop(rid, None)
+        for _rid, rec in drain_now:
+            self._stop_replica(rec, drain=True,
+                               timeout=st.config.graceful_shutdown_timeout_s)
+
+    def _poll_starting(self, st: _DeploymentState) -> None:
+        """Flip STARTING→RUNNING when the init probe resolves (non-blocking;
+        ray: replica startup polling in deployment_state)."""
+        import ray_tpu
+
+        with self._lock:
+            pending = [(rid, rec) for rid, rec in st.replicas.items()
+                       if rec["state"] == "STARTING"]
+        for rid, rec in pending:
+            ready, _ = ray_tpu.wait([rec["init_ref"]], timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(ready[0], timeout=1.0)
+                    with self._lock:
+                        rec["state"] = "RUNNING"
+                        rec["last_health"] = time.monotonic()
+                        st.membership_version += 1
+                except Exception:  # noqa: BLE001
+                    logger.error("replica init failed:\n%s",
+                                 traceback.format_exc())
+                    self._remove_replica(st, rid, drain=False)
+            elif time.monotonic() > rec["init_deadline"]:
+                logger.error("replica %s init timed out", rid[:8])
+                self._remove_replica(st, rid, drain=False)
+
+    def _poll_health(self, st: _DeploymentState) -> None:
+        """Issue/collect health probes without blocking (ray:
+        deployment_state health-check polling)."""
+        import ray_tpu
+
+        with self._lock:
+            running = [(rid, rec) for rid, rec in st.replicas.items()
+                       if rec["state"] == "RUNNING"]
+        for rid, rec in running:
+            ref = rec.get("health_ref")
+            if ref is not None:
+                ready, _ = ray_tpu.wait([ref], timeout=0)
+                if ready:
+                    rec["health_ref"] = None
+                    try:
+                        ray_tpu.get(ready[0], timeout=1.0)
+                        rec["last_health"] = time.monotonic()
+                    except Exception:  # noqa: BLE001
+                        logger.warning(
+                            "replica %s failed health check; replacing",
+                            rid[:8])
+                        self._remove_replica(st, rid, drain=False)
+                elif time.monotonic() > rec["health_deadline"]:
+                    logger.warning("replica %s health check timed out",
+                                   rid[:8])
+                    self._remove_replica(st, rid, drain=False)
+            elif time.monotonic() - rec.get("last_health", 0) \
+                    >= st.config.health_check_period_s:
+                rec["health_ref"] = rec["handle"].check_health.remote()
+                rec["health_deadline"] = time.monotonic() + \
+                    st.config.health_check_timeout_s
+
+    def _start_replica(self, st: _DeploymentState) -> None:
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+
+        actor_opts = dict(st.config.ray_actor_options)
+        actor_opts.setdefault("num_cpus", 0.1)
+        actor_opts["max_concurrency"] = max(
+            8, st.config.max_ongoing_requests + 2)
+        try:
+            handle = ray_tpu.remote(Replica).options(**actor_opts).remote(
+                st.cls, st.init_args, st.init_kwargs,
+                st.config.max_ongoing_requests, st.config.user_config)
+        except Exception:  # noqa: BLE001
+            logger.error("replica start failed:\n%s", traceback.format_exc())
+            return
+        rid = handle.actor_id
+        init_ref = handle.check_health.remote()
+        with self._lock:
+            if st.superseded or self._state(st.app, st.name) is not st:
+                # Lost a race with a redeploy: don't leak the actor.
+                ray_tpu.kill(handle)
+                return
+            st.replicas[rid] = {
+                "handle": handle, "state": "STARTING",
+                "init_ref": init_ref,
+                "init_deadline": time.monotonic() + REPLICA_INIT_TIMEOUT_S,
+                "health_ref": None, "health_deadline": 0.0,
+                "last_health": time.monotonic()}
+
+    def _remove_replica(self, st: _DeploymentState, rid: str,
+                        drain: bool) -> None:
+        with self._lock:
+            rec = st.replicas.pop(rid, None)
+            st.membership_version += 1
+        if rec is not None:
+            rec["state"] = "STOPPING"
+            self._stop_replica(rec, drain=drain,
+                               timeout=st.config.graceful_shutdown_timeout_s)
+
+    def _stop_replica(self, rec: dict, drain: bool = True,
+                      timeout: float = 5.0) -> None:
+        import ray_tpu
+
+        if drain:
+            try:
+                ray_tpu.get(rec["handle"].prepare_for_shutdown.remote(),
+                            timeout=timeout)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            ray_tpu.kill(rec["handle"])
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def new_version() -> str:
+    return uuid.uuid4().hex[:12]

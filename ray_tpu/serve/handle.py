@@ -1,0 +1,245 @@
+"""DeploymentHandle: the client-side router to a deployment's replicas.
+
+Analog of ray: python/ray/serve/handle.py (DeploymentHandle.remote:714,786)
+with the power-of-two-choices replica scheduler (ray:
+_private/replica_scheduler/pow_2_scheduler.py:51) folded in.  Replica
+membership comes from the controller and is cached with a TTL; the
+scheduler picks 2 random replicas and routes to the one with the lower
+locally-tracked in-flight count (the reference probes queue lengths over
+RPC; local counts are the zero-RPC equivalent since every request through
+this handle is visible to it).
+
+Threading: `remote()` must never block — handles are used from the driver
+(plain threads) AND from inside async replica/proxy actors, where blocking
+would deadlock the worker IO loop (membership RPC replies arrive on that
+same loop).  Membership refresh therefore runs on a per-handle daemon
+router thread; when no membership is cached yet, the request is queued to
+that thread and the DeploymentResponse is backed by a Future[ObjectRef].
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import queue as queue_mod
+import random
+import threading
+import time
+from typing import Any
+
+from ray_tpu.actor import ActorHandle
+from ray_tpu.object_ref import ObjectRef
+
+_MEMBERSHIP_TTL_S = 0.5
+
+
+class _NoCapacity(RuntimeError):
+    """No replica can accept the request right now — retried by the router
+    thread until the 30s assignment deadline."""
+
+
+class DeploymentResponse:
+    """Future for one request (ray: serve/handle.py DeploymentResponse).
+
+    Awaitable; `.result()` blocks (only call it off the worker IO loop);
+    passing it to another handle call chains on the underlying ObjectRef.
+    """
+
+    def __init__(self, ref: ObjectRef | None,
+                 ref_future: "concurrent.futures.Future | None" = None):
+        self._ref = ref
+        self._ref_future = ref_future
+
+    def _to_object_ref(self, timeout_s: float | None = 30.0) -> ObjectRef:
+        if self._ref is None:
+            self._ref = self._ref_future.result(timeout=timeout_s)
+        return self._ref
+
+    def result(self, timeout_s: float | None = None) -> Any:
+        import ray_tpu
+
+        return ray_tpu.get(self._to_object_ref(), timeout=timeout_s)
+
+    def __await__(self):
+        import asyncio
+
+        async def _resolve():
+            ref = self._ref
+            if ref is None:
+                ref = await asyncio.wrap_future(self._ref_future)
+                self._ref = ref
+            return await ref
+
+        return _resolve().__await__()
+
+    def __reduce__(self):
+        return (DeploymentResponse, (self._to_object_ref(),))
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, app: str, controller_id: str,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment
+        self.app_name = app
+        self._controller_id = controller_id
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._replicas: list[str] = []      # replica actor ids
+        self._handles: dict[str, ActorHandle] = {}
+        self._inflight: dict[str, int] = {}
+        self._max_ongoing = 0               # 0 = no cap known yet
+        self._fetched_at = 0.0
+        self._router_q: queue_mod.Queue | None = None
+        self._router_thread: threading.Thread | None = None
+
+    # -- membership ---------------------------------------------------------
+    def _refresh_blocking(self) -> None:
+        """Fetch membership from the controller.  Blocks — router thread /
+        driver thread only."""
+        import ray_tpu
+
+        info = ray_tpu.get(
+            ActorHandle(self._controller_id).get_deployment_info.remote(
+                self.app_name, self.deployment_name))
+        with self._lock:
+            self._fetched_at = time.monotonic()
+            self._replicas = list(info["replicas"])
+            self._max_ongoing = info.get("max_ongoing", 0)
+            for rid in self._replicas:
+                self._handles.setdefault(rid, ActorHandle(rid))
+                self._inflight.setdefault(rid, 0)
+            for rid in list(self._handles):
+                if rid not in self._replicas:
+                    self._handles.pop(rid)
+                    self._inflight.pop(rid, None)
+
+    def _ensure_router(self) -> queue_mod.Queue:
+        with self._lock:
+            if self._router_q is None:
+                self._router_q = queue_mod.Queue()
+                self._router_thread = threading.Thread(
+                    target=self._router_main, daemon=True,
+                    name=f"serve-router-{self.deployment_name}")
+                self._router_thread.start()
+            return self._router_q
+
+    def _router_main(self) -> None:
+        """Completes queued submits and keeps membership fresh while
+        requests are flowing (ray: Router long-poll updates,
+        _private/router.py:320)."""
+        while True:
+            try:
+                item = self._router_q.get(timeout=_MEMBERSHIP_TTL_S)
+            except queue_mod.Empty:
+                item = None
+            with self._lock:
+                stale = (time.monotonic() - self._fetched_at) \
+                    > _MEMBERSHIP_TTL_S
+            if stale:
+                try:
+                    self._refresh_blocking()
+                except Exception:  # noqa: BLE001 - controller restarting
+                    pass
+            if item is None:
+                continue
+            fut, args, kwargs, deadline = item
+            try:
+                fut.set_result(self._submit(args, kwargs))
+            except _NoCapacity as e:
+                if time.monotonic() > deadline:
+                    fut.set_exception(RuntimeError(str(e)))
+                else:
+                    time.sleep(0.05)
+                    self._router_q.put(item)
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+    # -- routing ------------------------------------------------------------
+    def _pick(self) -> tuple[str, ActorHandle]:
+        """Power-of-two choices over in-flight counts, skipping replicas at
+        their max_ongoing_requests cap — the routing-side backpressure of
+        ray: pow_2_scheduler.py:51 (replicas over capacity are not sent
+        more work; the request queues in the router instead)."""
+        with self._lock:
+            reps = self._replicas
+            if not reps:
+                raise _NoCapacity(
+                    f"deployment {self.deployment_name!r} has no running "
+                    f"replicas")
+            cap = self._max_ongoing
+            if cap > 0:
+                eligible = [r for r in reps
+                            if self._inflight.get(r, 0) < cap]
+                if not eligible:
+                    raise _NoCapacity(
+                        f"all replicas of {self.deployment_name!r} are at "
+                        f"max_ongoing_requests={cap}")
+            else:
+                eligible = reps
+            if len(eligible) == 1:
+                choice = eligible[0]
+            else:
+                a, b = random.sample(eligible, 2)
+                choice = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[choice] = self._inflight.get(choice, 0) + 1
+            handle = self._handles[choice]
+        return choice, handle
+
+    def _submit(self, args: tuple, kwargs: dict) -> ObjectRef:
+        rid, handle = self._pick()
+        try:
+            args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                         else a for a in args)
+            kwargs = {k: (v._to_object_ref()
+                          if isinstance(v, DeploymentResponse) else v)
+                      for k, v in kwargs.items()}
+        except BaseException:
+            self._done(rid)
+            raise
+        ref = handle.handle_request.remote(self._method, args, kwargs)
+        ref.future().add_done_callback(lambda _f: self._done(rid))
+        return ref
+
+    def _done(self, rid: str) -> None:
+        with self._lock:
+            if self._inflight.get(rid, 0) > 0:
+                self._inflight[rid] -= 1
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # An unresolved chained response would require a blocking wait to
+        # convert to an ObjectRef — never do that on the caller's thread
+        # (it may be a worker IO loop); hand it to the router thread.
+        chained_pending = any(
+            isinstance(a, DeploymentResponse) and a._ref is None
+            for a in list(args) + list(kwargs.values()))
+        with self._lock:
+            have = bool(self._replicas)
+            fresh = (time.monotonic() - self._fetched_at) < _MEMBERSHIP_TTL_S
+        if have and not chained_pending:
+            if not fresh:    # serve stale, refresh in background
+                self._ensure_router()
+            try:
+                return DeploymentResponse(self._submit(args, kwargs))
+            except _NoCapacity:
+                pass         # queue to the router thread below
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._ensure_router().put(
+            (fut, args, kwargs, time.monotonic() + 30.0))
+        return DeploymentResponse(None, ref_future=fut)
+
+    def options(self, method_name: str | None = None) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                self._controller_id,
+                                method_name or self._method)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.app_name}/{self.deployment_name}"
+                f".{self._method})")
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name,
+                                   self._controller_id, self._method))

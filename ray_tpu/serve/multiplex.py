@@ -1,0 +1,69 @@
+"""@serve.multiplexed: per-replica LRU cache of loaded models.
+
+Analog of ray: python/ray/serve/multiplex.py (_ModelMultiplexWrapper).
+A replica serving many fine-tuned variants keeps up to
+`max_num_models_per_replica` loaded, evicting least-recently-used (on TPU:
+evicting frees HBM for the incoming model's weights).
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import functools
+import inspect
+
+
+def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
+    def wrap(f):
+        attr = f"__serve_multiplex_{f.__name__}"
+
+        @functools.wraps(f)
+        async def wrapper(self, model_id: str):
+            # Indirect through the module-level setter: a direct global
+            # reference to the ContextVar would be captured by value when
+            # cloudpickle ships the decorated class (unpicklable).
+            _set_current_model_id(model_id)
+            state = getattr(self, attr, None)
+            if state is None:
+                state = {"models": collections.OrderedDict(),
+                         "lock": asyncio.Lock()}
+                setattr(self, attr, state)
+            models = state["models"]
+            async with state["lock"]:
+                if model_id in models:
+                    models.move_to_end(model_id)
+                    return models[model_id]
+                while len(models) >= max_num_models_per_replica:
+                    _mid, evicted = models.popitem(last=False)
+                    del_fn = getattr(evicted, "__del__", None)
+                    if del_fn is not None:
+                        try:
+                            del_fn()
+                        except Exception:  # noqa: BLE001
+                            pass
+                loaded = f(self, model_id)
+                if inspect.isawaitable(loaded):
+                    loaded = await loaded
+                models[model_id] = loaded
+                return loaded
+        return wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a multiplexed request, the requested model id (ray:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get("")
+
+
+def _set_current_model_id(model_id: str) -> None:
+    _current_model_id.set(model_id)
+
+
+import contextvars  # noqa: E402
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")

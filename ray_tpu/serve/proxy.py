@@ -1,0 +1,183 @@
+"""HTTP proxy actor: stdlib-asyncio HTTP/1.1 ingress for Serve apps.
+
+Analog of ray: python/ray/serve/_private/proxy.py (HTTPProxy:761 is an
+ASGI/uvicorn app; this environment has no uvicorn/starlette so the proxy
+speaks HTTP/1.1 directly over asyncio streams — same role, same routing).
+Requests are routed by longest-prefix match on the app route table polled
+from the controller (ray: long-poll route-table push) and forwarded through
+a DeploymentHandle to the app's ingress deployment.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import traceback
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+@dataclasses.dataclass
+class Request:
+    """What an ingress deployment receives for an HTTP request (stand-in
+    for the reference's starlette.requests.Request)."""
+    method: str
+    path: str
+    query: dict
+    headers: dict
+    body: bytes = b""
+
+    def json(self):
+        return json.loads(self.body) if self.body else None
+
+    def text(self) -> str:
+        return self.body.decode("utf-8", "replace")
+
+
+class ProxyActor:
+    """One per node in the reference (proxy.py:1130 ProxyActor); here one
+    per cluster, started by serve.start()."""
+
+    def __init__(self, controller_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._controller_id = controller_id
+        self._handle_cls = DeploymentHandle
+        self._routes: dict[str, tuple[str, str]] = {}
+        self._handles: dict[str, "DeploymentHandle"] = {}
+        self._port: int | None = None
+        self._server = None
+        loop = asyncio.get_running_loop()
+        self._ready = asyncio.Event()
+        loop.create_task(self._start(host, port))
+        loop.create_task(self._poll_routes())
+
+    async def _start(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        self._ready.set()
+
+    async def _poll_routes(self) -> None:
+        from ray_tpu.actor import ActorHandle
+
+        ctrl = ActorHandle(self._controller_id)
+        while True:
+            try:
+                self._routes = await ctrl.get_app_routes.remote()
+            except Exception:  # noqa: BLE001 - controller restarting
+                pass
+            await asyncio.sleep(0.5)
+
+    async def get_port(self) -> int:
+        await self._ready.wait()
+        return self._port
+
+    async def ready(self) -> bool:
+        await self._ready.wait()
+        return True
+
+    def _match(self, path: str) -> tuple[str, str, str] | None:
+        """Longest-prefix route match → (app, ingress, stripped path)."""
+        best = None
+        for prefix, (app, ingress) in self._routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or norm == "":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app, ingress)
+        if best is None:
+            return None
+        norm, app, ingress = best
+        return app, ingress, path[len(norm):] or "/"
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _version = \
+                        line.decode("latin1").strip().split(" ", 2)
+                except ValueError:
+                    await self._respond(writer, 400, {"error": "bad request"})
+                    break
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                keep = headers.get("connection", "keep-alive") != "close"
+                await self._dispatch(writer, method, target, headers, body)
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, writer, method: str, target: str,
+                        headers: dict, body: bytes) -> None:
+        parts = urlsplit(target)
+        path = unquote(parts.path)
+        if path == "/-/healthz":
+            await self._respond(writer, 200, "ok")
+            return
+        if path == "/-/routes":
+            await self._respond(
+                writer, 200,
+                {p: f"{a}:{i}" for p, (a, i) in self._routes.items()})
+            return
+        m = self._match(path)
+        if m is None:
+            await self._respond(writer, 404,
+                                {"error": f"no app for path {path!r}"})
+            return
+        app, ingress, sub_path = m
+        key = f"{app}/{ingress}"
+        handle = self._handles.get(key)
+        if handle is None:
+            handle = self._handle_cls(ingress, app, self._controller_id)
+            self._handles[key] = handle
+        query = {k: v[0] if len(v) == 1 else v
+                 for k, v in parse_qs(parts.query).items()}
+        req = Request(method=method, path=sub_path, query=query,
+                      headers=headers, body=body)
+        try:
+            result = await handle.remote(req)
+            await self._respond(writer, 200, result)
+        except Exception as e:  # noqa: BLE001
+            await self._respond(
+                writer, 500,
+                {"error": f"{type(e).__name__}: {e}",
+                 "traceback": traceback.format_exc()})
+
+    async def _respond(self, writer, status: int, payload) -> None:
+        if isinstance(payload, bytes):
+            body, ctype = payload, "application/octet-stream"
+        elif isinstance(payload, str):
+            body, ctype = payload.encode(), "text/plain; charset=utf-8"
+        else:
+            try:
+                body = json.dumps(payload).encode()
+            except TypeError:
+                body = json.dumps(repr(payload)).encode()
+            ctype = "application/json"
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error"}.get(status, "OK")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"\r\n".encode() + body)
+        await writer.drain()

@@ -1,0 +1,101 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Analog of ray: python/ray/serve/_private/replica.py (ReplicaActor).  Async
+actor: requests overlap up to max_ongoing_requests; sync user code runs on a
+thread pool so the event loop keeps serving queue-length probes (the same
+reason the reference's replica is an asyncio actor).
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import time
+from typing import Any
+
+
+class Replica:
+    """Created via ActorClass(Replica).options(max_concurrency=...)."""
+
+    def __init__(self, cls, init_args: tuple, init_kwargs: dict,
+                 max_ongoing_requests: int, user_config: Any = None):
+        self._cls = cls
+        self._max_ongoing = max_ongoing_requests
+        self._num_ongoing = 0
+        self._num_processed = 0
+        # Replica-side concurrency bound: routers cap dispatch too, but
+        # multiple handles can race past their local counts (ray: replica
+        # enforces max_ongoing_requests itself).  Bounds async handlers as
+        # well — the thread pool only bounds sync ones.
+        self._slots = asyncio.Semaphore(max_ongoing_requests)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, max_ongoing_requests))
+        self._instance = cls(*init_args, **init_kwargs)
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+
+    def _reconfigure_sync(self, user_config: Any) -> None:
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    async def reconfigure(self, user_config: Any) -> None:
+        """Apply a new user_config without restarting (ray: replica.py
+        reconfigure path driven by DeploymentState on config-only changes)."""
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is None:
+            return
+        if inspect.iscoroutinefunction(fn):
+            await fn(user_config)
+        else:
+            await asyncio.get_running_loop().run_in_executor(
+                self._pool, fn, user_config)
+
+    async def handle_request(self, method: str, args: tuple,
+                             kwargs: dict) -> Any:
+        """Execute one request (ray: replica.py handle_request).
+        `_num_ongoing` counts queued + executing — the queue-length signal
+        the router and autoscaler consume."""
+        self._num_ongoing += 1
+        try:
+            async with self._slots:
+                target = getattr(self._instance, method)
+                if inspect.iscoroutinefunction(target):
+                    return await target(*args, **kwargs)
+                return await asyncio.get_running_loop().run_in_executor(
+                    self._pool, lambda: target(*args, **kwargs))
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    async def get_queue_len(self) -> int:
+        """Probe for the power-of-two-choices router (ray:
+        replica_scheduler/pow_2_scheduler.py queue-length RPC)."""
+        return self._num_ongoing
+
+    async def get_metrics(self) -> dict:
+        return {"num_ongoing": self._num_ongoing,
+                "num_processed": self._num_processed,
+                "max_ongoing": self._max_ongoing,
+                "ts": time.time()}
+
+    async def check_health(self) -> bool:
+        """User class may define check_health; raising marks unhealthy
+        (ray: deployment_state.py health-check polling)."""
+        fn = getattr(self._instance, "check_health", None)
+        if fn is not None:
+            r = fn()
+            if inspect.isawaitable(r):
+                await r
+        return True
+
+    async def prepare_for_shutdown(self) -> None:
+        """Drain: wait for ongoing requests, then call user __del__-style
+        hook (ray: replica graceful shutdown)."""
+        while self._num_ongoing > 0:
+            await asyncio.sleep(0.02)
+        fn = getattr(self._instance, "shutdown", None)
+        if fn is not None:
+            r = fn()
+            if inspect.isawaitable(r):
+                await r

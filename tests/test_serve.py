@@ -1,0 +1,227 @@
+"""Serve tests: deploy, route, compose, reconfigure, batch, autoscale, HTTP.
+
+Mirrors the reference's serve test strategy (ray: python/ray/serve/tests/,
+unit subset mocks; integration against one-node ray.init — SURVEY §4).
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 8})
+    serve.start()
+    yield serve
+    serve.shutdown()
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    h = serve.run(double.bind(), name="fn_app", route_prefix="/double")
+    assert h.remote(21).result(timeout_s=30) == 42
+    serve.delete("fn_app")
+
+
+def test_class_deployment_and_composition(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder
+
+        async def __call__(self, x):
+            partial = await self.adder.remote(x)
+            return partial * 10
+
+    app = Ingress.bind(Adder.bind(5))
+    h = serve.run(app, name="compose", route_prefix="/compose")
+    assert h.remote(1).result(timeout_s=30) == 60
+    # status reflects both deployments
+    st = serve.status()["compose"]
+    assert st["status"] == "RUNNING"
+    assert set(st["deployments"]) == {"Adder", "Ingress"}
+    serve.delete("compose")
+
+
+def test_multi_replica_load_balancing(serve_instance):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class WhoAmI:
+        def __call__(self, _x):
+            import os
+
+            time.sleep(0.05)
+            return os.getpid()
+
+    h = serve.run(WhoAmI.bind(), name="lb", route_prefix="/lb")
+    resps = [h.remote(i) for i in range(16)]
+    pids = {r.result(timeout_s=30) for r in resps}
+    assert len(pids) == 2, f"expected both replicas used, got {pids}"
+    serve.delete("lb")
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 1})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _x):
+            return self.threshold
+
+    app = Thresholder.bind()
+    h = serve.run(app, name="cfg", route_prefix="/cfg")
+    assert h.remote(0).result(timeout_s=30) == 1
+
+    # Redeploy with only user_config changed: in-place reconfigure
+    Thresholder.config.user_config = {"threshold": 7}
+    h = serve.run(app, name="cfg", route_prefix="/cfg")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if h.remote(0).result(timeout_s=30) == 7:
+            break
+        time.sleep(0.2)
+    assert h.remote(0).result(timeout_s=30) == 7
+    serve.delete("cfg")
+
+
+def test_http_proxy_end_to_end(serve_instance):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request: serve.Request):
+            body = request.json()
+            return {"path": request.path, "method": request.method,
+                    "doubled": body["x"] * 2}
+
+    serve.run(Echo.bind(), name="http", route_prefix="/echo")
+    port = serve.http_port()
+    data = json.dumps({"x": 5}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo/sub?k=v", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        out = json.loads(resp.read())
+    assert out == {"path": "/sub", "method": "POST", "doubled": 10}
+
+    # health + routes endpoints
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/-/healthz", timeout=10) as resp:
+        assert resp.read() == b"ok"
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    serve.delete("http")
+
+
+def test_serve_batching(serve_instance):
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        def __init__(self):
+            self.sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        async def handle(self, items):
+            self.sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def max_batch_seen(self):
+            return max(self.sizes) if self.sizes else 0
+
+    h = serve.run(Batched.bind(), name="batch", route_prefix="/batch")
+    resps = [h.remote(i) for i in range(16)]
+    assert [r.result(timeout_s=30) for r in resps] == \
+        [i * 2 for i in range(16)]
+    probe = h.options(method_name="max_batch_seen")
+    assert probe.remote().result(timeout_s=30) > 1
+    serve.delete("batch")
+
+
+def test_autoscaling_up(serve_instance):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "upscale_delay_s": 0.2,
+                            "downscale_delay_s": 60.0})
+    class Slow:
+        def __call__(self, _x):
+            time.sleep(0.4)
+            return 1
+
+    h = serve.run(Slow.bind(), name="auto", route_prefix="/auto")
+
+    stop = threading.Event()
+
+    def flood():
+        while not stop.is_set():
+            try:
+                h.remote(0).result(timeout_s=60)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=flood, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 45
+        replicas = 1
+        while time.monotonic() < deadline:
+            st = serve.status().get("auto")
+            if st:
+                replicas = st["deployments"]["Slow"]["replicas"]
+                if replicas >= 2:
+                    break
+            time.sleep(0.3)
+        assert replicas >= 2, f"autoscaler never scaled up: {serve.status()}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    serve.delete("auto")
+
+
+def test_multiplexed(serve_instance):
+    @serve.deployment
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return f"model:{model_id}"
+
+        async def __call__(self, model_id):
+            model = await self.get_model(model_id)
+            return model
+
+    h = serve.run(Multi.bind(), name="mux", route_prefix="/mux")
+    assert h.remote("a").result(timeout_s=30) == "model:a"
+    assert h.remote("b").result(timeout_s=30) == "model:b"
+    assert h.remote("a").result(timeout_s=30) == "model:a"
+    serve.delete("mux")
