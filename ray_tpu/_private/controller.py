@@ -487,7 +487,9 @@ class Controller:
         return {"actors": [
             {"actor_id": a.actor_id, "name": a.name, "state": a.state,
              "node_id": a.node_id, "address": a.address,
-             "restarts": a.restarts_used}
+             "restarts": a.restarts_used, "resources": a.resources,
+             "function_id": a.creation_header.get("function_id", ""),
+             "class_name": a.creation_header.get("class_name", "")}
             for a in self.actors.values()]}
 
     async def rpc_list_pgs(self, h: dict, _b: list) -> dict:
@@ -529,6 +531,11 @@ async def run_controller(config: Config, ready_cb=None) -> None:
 def _watch_parent() -> None:
     import os
     import threading
+
+    if os.environ.get("RAY_TPU_DAEMONIZE"):
+        # CLI-started heads intentionally outlive the launching process
+        # (ray: `ray start --head` daemonizes; `ray stop` kills by pidfile).
+        return
 
     def _loop():
         while True:

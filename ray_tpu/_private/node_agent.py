@@ -180,6 +180,9 @@ class NodeAgent:
 
         worker_id = WorkerID.from_random().hex()
         env = {**os.environ, **self._worker_env,
+               # Workers always die with their agent, even when the agent
+               # itself is a daemonized head process.
+               "RAY_TPU_DAEMONIZE": "",
                "RAY_TPU_WORKER_ID": worker_id,
                "RAY_TPU_NODE_ID": self.node_id,
                "RAY_TPU_AGENT_ADDR": self.server.address,
@@ -535,8 +538,13 @@ class NodeAgent:
 
 def _watch_parent() -> None:
     """Exit when our parent dies (reparented to init), so killed drivers /
-    test runners never leak agent or worker trees."""
+    test runners never leak agent or worker trees.  Disabled for
+    CLI-daemonized heads (RAY_TPU_DAEMONIZE; `ray-tpu stop` kills by
+    pidfile)."""
     import threading
+
+    if os.environ.get("RAY_TPU_DAEMONIZE"):
+        return
 
     def _loop():
         while True:

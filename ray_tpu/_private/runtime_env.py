@@ -1,0 +1,160 @@
+"""Runtime environments: per-task/actor env_vars + code shipping.
+
+Analog of ray: python/ray/_private/runtime_env/ (working_dir.py,
+py_modules.py, plugin architecture; provisioning agent under
+runtime_env/agent/) and python/ray/runtime_env/runtime_env.py (the user
+API).  Collapsed for this runtime: the driver packages working_dir /
+py_modules into a content-addressed zip in the controller KV; workers
+fetch + extract once per digest and activate (sys.path + cwd + env vars)
+around execution.  Conda/pip provisioning is intentionally out of scope
+in this environment (no installs) — a plugin can add it via the same
+descriptor mechanism.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+_EXTRACT_ROOT = "/tmp/ray_tpu_runtime_envs"
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+class RuntimeEnv(dict):
+    """User-facing descriptor (ray: runtime_env/runtime_env.py RuntimeEnv).
+
+    Supported keys: env_vars (dict), working_dir (path), py_modules
+    (list of paths).
+    """
+
+    _KEYS = {"env_vars", "working_dir", "py_modules"}
+
+    def __init__(self, env_vars: dict | None = None,
+                 working_dir: str | None = None,
+                 py_modules: list | None = None, **kwargs):
+        unknown = set(kwargs) - self._KEYS
+        if unknown:
+            raise ValueError(
+                f"unsupported runtime_env keys {sorted(unknown)}; "
+                f"supported: {sorted(self._KEYS)}")
+        super().__init__()
+        if env_vars:
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        self.update(kwargs)
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+            for fname in files:
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"runtime_env package exceeds "
+                        f"{MAX_PACKAGE_BYTES >> 20}MB: {path}")
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def prepare(runtime_env: dict | None, core) -> dict | None:
+    """Driver-side: upload code packages, return the wire descriptor
+    (ray: runtime-env URI creation + GCS package upload)."""
+    if not runtime_env:
+        return None
+    desc: dict = {}
+    if runtime_env.get("env_vars"):
+        desc["env_vars"] = {str(k): str(v)
+                            for k, v in runtime_env["env_vars"].items()}
+    packages = []
+    paths = []
+    if runtime_env.get("working_dir"):
+        paths.append(("working_dir", runtime_env["working_dir"]))
+    for p in runtime_env.get("py_modules", ()):
+        paths.append(("py_module", p))
+    for kind, p in paths:
+        blob = _zip_dir(p)
+        digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        core.call(core.controller_addr, "kv_put",
+                  {"ns": "pkg", "key": digest}, [blob], timeout=120.0)
+        packages.append({"kind": kind, "digest": digest,
+                         "name": os.path.basename(os.path.abspath(p))})
+    if packages:
+        desc["packages"] = packages
+    return desc or None
+
+
+def _fetch_package(digest: str, core) -> str:
+    """Worker-side: content-addressed fetch + extract (idempotent; ray:
+    per-node runtime-env agent cache)."""
+    target = os.path.join(_EXTRACT_ROOT, digest)
+    marker = os.path.join(target, ".ready")
+    if os.path.exists(marker):
+        return target
+    reply, blobs = core.call(core.controller_addr, "kv_get",
+                             {"ns": "pkg", "key": digest}, timeout=120.0)
+    if not blobs:
+        raise RuntimeError(f"runtime_env package {digest} missing from KV")
+    os.makedirs(target, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(bytes(blobs[0]))) as zf:
+        zf.extractall(target)
+    with open(marker, "w") as f:
+        f.write("ok")
+    return target
+
+
+def prefetch(desc: dict | None, core) -> None:
+    """Blocking fetch of every package in the descriptor.  MUST be called
+    off the event loop (run_in_executor) before activating a runtime env
+    on the loop thread (async actors): _fetch_package's core.call blocks
+    on the loop, so calling it from the loop deadlocks the worker."""
+    for pkg in (desc or {}).get("packages", ()):
+        _fetch_package(pkg["digest"], core)
+
+
+@contextlib.contextmanager
+def activate(desc: dict | None, core):
+    """Worker-side activation around execution: env vars set/restored,
+    packages on sys.path (working_dir also becomes cwd).  Worker processes
+    are pooled, so activation must be reversible (the reference instead
+    dedicates workers per runtime env — worker_pool.h:159 runtime-env-keyed
+    pooling; that isolation level is a TODO here)."""
+    if not desc:
+        yield
+        return
+    saved_env: dict[str, str | None] = {}
+    added_paths: list[str] = []
+    saved_cwd = os.getcwd()
+    try:
+        for k, v in (desc.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        for pkg in desc.get("packages", ()):
+            path = _fetch_package(pkg["digest"], core)
+            sys.path.insert(0, path)
+            added_paths.append(path)
+            if pkg["kind"] == "working_dir":
+                os.chdir(path)
+        yield
+    finally:
+        os.chdir(saved_cwd)
+        for p in added_paths:
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old

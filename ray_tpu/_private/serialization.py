@@ -81,9 +81,29 @@ def serialize(value: Any) -> SerializedValue:
         _capture.refs = None
 
 
+def _note_deser_ref(ref) -> None:
+    """Capture ObjectRefs materialized during a deserialize_with_refs call
+    (borrower tracking, ray: serialization.py ObjectRef deserializer hook)."""
+    lst = getattr(_capture, "deser_refs", None)
+    if lst is not None:
+        lst.append(ref)
+
+
 def deserialize(frames: list[bytes | memoryview]) -> Any:
     bufs = [pickle.PickleBuffer(f) for f in frames[1:]]
     return pickle.loads(frames[0], buffers=bufs)
+
+
+def deserialize_with_refs(frames: list[bytes | memoryview]) -> tuple[Any, list]:
+    """Deserialize and also return the ObjectRefs contained in the value
+    (the executing side of the borrow protocol)."""
+    bufs = [pickle.PickleBuffer(f) for f in frames[1:]]
+    _capture.deser_refs = []
+    try:
+        value = pickle.loads(frames[0], buffers=bufs)
+        return value, list(_capture.deser_refs)
+    finally:
+        _capture.deser_refs = None
 
 
 def dumps_function(fn: Callable) -> bytes:

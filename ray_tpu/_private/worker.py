@@ -47,7 +47,8 @@ from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import MemoryStore
 from ray_tpu._private.rpc import (ClientPool, ConnectionLost, RemoteError,
                                   RpcServer, Subscriber)
-from ray_tpu._private.serialization import (SerializedValue, deserialize,
+from ray_tpu._private.serialization import (SerializedValue,
+                                            deserialize_with_refs,
                                             dumps_function, loads_function,
                                             serialize)
 from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
@@ -88,9 +89,40 @@ class OwnedObject:
     error: BaseException | None = None
     local_refs: int = 0
     borrowers: int = 0
+    # Refs nested inside this object's value: (object_id, owner_addr) pins
+    # added when the value was created (put / task return), released when
+    # this object is freed (ray: reference_count.cc contained-object refs).
+    contained: list = field(default_factory=list)
     # Lineage for reconstruction (ray: TaskManager::ResubmitTask).
     submit_spec: tuple | None = None
     retries_left: int = 0
+
+
+class _UntrackedRef(ObjectRef):
+    """Internal temporary ref: participates in no reference counting.
+    Bare ObjectRef construction inside the runtime must use this class —
+    a plain ObjectRef's __del__ would decrement counts (owner local_refs /
+    borrow table) that were never incremented for it."""
+
+    __slots__ = ()
+
+    def __del__(self):
+        pass
+
+
+def _copy_error(e: BaseException) -> BaseException:
+    """Shallow-copy a cached error before raising it: raising the cached
+    instance would attach the caller's traceback to it, pinning every frame
+    (and every actor handle / large object in those frames) for as long as
+    the error stays cached in the memory store."""
+    import copy
+
+    try:
+        err = copy.copy(e)
+        err.__traceback__ = None
+        return err
+    except Exception:  # noqa: BLE001 - uncopyable exception
+        return e
 
 
 @dataclass
@@ -102,6 +134,10 @@ class PendingTask:
     retries_left: int
     retry_exceptions: bool
     scheduling_key: tuple
+    # (object_id, owner_addr) pins added at submission for every ref shipped
+    # in the args; released when the reply arrives unless the executing
+    # worker reports the ref still held (ray: reference_count.cc borrows).
+    borrowed: list = field(default_factory=list)
 
 
 class LeaseManager:
@@ -227,6 +263,7 @@ class LeaseManager:
                 f"worker died executing task {task.task_id.hex()[:8]}: {exc}")
             for rid in task.return_ids:
                 self.core._resolve_error(rid, err)
+            self.core._release_task_borrows(task)
 
 
 @dataclass
@@ -245,10 +282,11 @@ class ActorInstance:
     """Worker-side hosted actor with ordered per-caller execution."""
 
     def __init__(self, actor_id: str, instance: Any, max_concurrency: int,
-                 is_async: bool):
+                 is_async: bool, runtime_env: dict | None = None):
         self.actor_id = actor_id
         self.instance = instance
         self.is_async = is_async
+        self.runtime_env = runtime_env
         self.max_concurrency = max_concurrency
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_concurrency,
@@ -274,6 +312,18 @@ class CoreWorker:
         self.namespace = namespace
         self.memory = MemoryStore()
         self.owned: dict[bytes, OwnedObject] = {}
+        # Borrower-side table: refs this process holds but does not own
+        # (object_id -> {count, owner}); see _register_borrows.
+        self.borrows: dict[bytes, dict] = {}
+        # Guards every owned/borrows counter mutation: ObjectRef.__del__
+        # runs on arbitrary GC threads, user code on executor threads, RPC
+        # handlers on the loop — bare `x -= 1` is a lost-update race.
+        # RLock because _free_object (under lock) releases contained pins,
+        # which re-enter the lock (ray: absl::Mutex on reference_count).
+        self._ref_lock = threading.RLock()
+        # Creation-arg pins per actor created by this process
+        # (actor_id -> [(object_id, owner_addr)]).
+        self.actor_creation_borrows: dict[str, list] = {}
         self.functions: dict[str, Any] = {}
         self._exported: set[str] = set()
         self.actors_hosted: dict[str, ActorInstance] = {}
@@ -323,7 +373,15 @@ class CoreWorker:
         flusher = self.loop.create_task(self._event_flush_loop())
         started.set()
         try:
-            await self.loop.run_in_executor(None, self._shutdown.wait)
+            # Asyncio-native shutdown signal.  Parking a default-executor
+            # thread on self._shutdown.wait would deadlock interpreter
+            # exit: concurrent.futures' _python_exit joins executor threads
+            # BEFORE regular atexit callbacks run, so a driver that never
+            # calls ray_tpu.shutdown() explicitly would hang forever.
+            self._shutdown_async = asyncio.Event()
+            if self._shutdown.is_set():
+                self._shutdown_async.set()
+            await self._shutdown_async.wait()
         finally:
             flusher.cancel()
             sub = getattr(self, "subscriber", None)
@@ -351,6 +409,12 @@ class CoreWorker:
     def shutdown(self) -> None:
         set_release_hook(None)
         self._shutdown.set()
+        ev = getattr(self, "_shutdown_async", None)
+        if ev is not None and self.loop is not None:
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
         self._io_thread.join(5.0)
         set_global_worker(None)
 
@@ -408,7 +472,7 @@ class CoreWorker:
         if options.get("num_tpus"):
             resources["TPU"] = options["num_tpus"]
         bundle_key = options.get("bundle_key")
-        header, blobs = self._build_task_payload(
+        header, blobs, borrowed = self._build_task_payload(
             task_id.binary(), fid, args, kwargs, num_returns, resources,
             bundle_key, options)
         retries = options.get("max_retries",
@@ -420,13 +484,14 @@ class CoreWorker:
             task_id=task_id.binary(), header=header, blobs=blobs,
             return_ids=return_ids, retries_left=max(0, retries),
             retry_exceptions=bool(options.get("retry_exceptions")),
-            scheduling_key=scheduling_key)
+            scheduling_key=scheduling_key, borrowed=borrowed)
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
-        for rid in return_ids:
-            rec = self.owned.setdefault(rid, OwnedObject())
-            rec.local_refs += 1
-            rec.submit_spec = (fid, header, blobs, scheduling_key)
-            rec.retries_left = max(0, retries)
+        with self._ref_lock:
+            for rid in return_ids:
+                rec = self.owned.setdefault(rid, OwnedObject())
+                rec.local_refs += 1
+                rec.submit_spec = (fid, header, blobs, scheduling_key)
+                rec.retries_left = max(0, retries)
 
         def _go():
             self.memory_entries_for(return_ids)
@@ -448,17 +513,22 @@ class CoreWorker:
         # execution (ray: DependencyResolver; nested refs stay refs).
         arg_refs: list[dict] = []
         plain_args: list[Any] = []
+        borrowed: dict[bytes, str] = {}    # deduped per task
         for i, a in enumerate(args):
             if isinstance(a, ObjectRef):
                 arg_refs.append({"pos": i, "id": a.hex(),
                                  "owner": a.owner_addr or self.address})
                 plain_args.append(None)
-                self._add_borrow(a)
+                borrowed.setdefault(a.binary(),
+                                    a.owner_addr or self.address)
             else:
                 plain_args.append(a)
         sv = serialize((tuple(plain_args), kwargs))
         for ref in sv.contained_refs:
-            self._add_borrow(ref)
+            borrowed.setdefault(ref.binary(),
+                                ref.owner_addr or self.address)
+        for oid, owner in borrowed.items():
+            self._add_borrow(oid, owner)
         header = {
             "task_id": task_id.hex(), "function_id": fid,
             "num_returns": num_returns, "resources": resources,
@@ -466,47 +536,176 @@ class CoreWorker:
             "bundle_key": bundle_key,
             "name": options.get("name", ""),
         }
+        if options.get("runtime_env"):
+            from ray_tpu._private import runtime_env as renv
+
+            header["runtime_env"] = renv.prepare(
+                options["runtime_env"], self)
         if options.get("affinity_node_id"):
             header["affinity_node_id"] = options["affinity_node_id"]
             header["affinity_soft"] = options.get("affinity_soft", False)
-        return header, sv.frames
+        return header, sv.frames, list(borrowed.items())
 
-    def _add_borrow(self, ref: ObjectRef) -> None:
-        if ref.owner_addr == self.address or not ref.owner_addr:
-            rec = self.owned.get(ref.binary())
-            if rec:
-                rec.borrowers += 1
+    def _add_borrow(self, oid: bytes, owner_addr: str) -> None:
+        if owner_addr == self.address or not owner_addr:
+            with self._ref_lock:
+                rec = self.owned.get(oid)
+                if rec:
+                    rec.borrowers += 1
         else:
             async def _notify():
                 try:
-                    await self.clients.get(ref.owner_addr).notify(
-                        "add_borrow", {"object_id": ref.hex()})
+                    await self.clients.get(owner_addr).notify(
+                        "add_borrow", {"object_id": oid.hex()})
                 except Exception:  # noqa: BLE001
                     pass
             self.loop.call_soon_threadsafe(
                 lambda: self.loop.create_task(_notify()))
 
+    def _release_borrow(self, oid: bytes, owner_addr: str) -> None:
+        """Undo one _add_borrow pin (submitter after reply, or borrower
+        dropping a still-held ref)."""
+        if owner_addr == self.address or not owner_addr:
+            with self._ref_lock:
+                rec = self.owned.get(oid)
+                if rec:
+                    rec.borrowers -= 1
+                    if rec.local_refs <= 0 and rec.borrowers <= 0:
+                        self._free_object(oid, rec)
+        else:
+            async def _notify():
+                try:
+                    await self.clients.get(owner_addr).notify(
+                        "remove_borrow", {"object_id": oid.hex()})
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                self.loop.call_soon_threadsafe(
+                    lambda: self.loop.create_task(_notify()))
+            except RuntimeError:
+                pass
+
+    def _release_task_borrows(self, task: "PendingTask") -> None:
+        """Release this task's submission pins.  By reply time the
+        executing worker has registered its own borrows for any arg refs it
+        still holds (deserialize-time registration, _register_borrows), so
+        the submission pins are pure transfer-window protection."""
+        for oid, owner in task.borrowed:
+            self._release_borrow(oid, owner)
+        task.borrowed = []
+
+    def _dedup_contained(self, contained_refs: list) -> list[tuple]:
+        """Unique (object_id, owner) pairs for refs nested in one value."""
+        seen: set[bytes] = set()
+        out: list[tuple] = []
+        for r in contained_refs:
+            oid = r.binary()
+            if oid in seen:
+                continue
+            seen.add(oid)
+            out.append((oid, r.owner_addr or self.address))
+        return out
+
+    async def _register_borrows(self, refs: list) -> None:
+        """Deserialize-time borrower registration (ray: reference_count.cc
+        borrower bookkeeping): this process counts local instances of refs
+        it does not own; the first instance registers with the owner, the
+        last drop (in _release_local_ref) sends remove_borrow.  Awaited
+        BEFORE the value is used so the registration lands while the
+        sender's pin (submission pin / contained pin) still protects the
+        object."""
+        to_ack: list[tuple[bytes, str]] = []
+        with self._ref_lock:
+            for r in refs:
+                oid = r.binary()
+                owner = r.owner_addr
+                if not owner or owner == self.address:
+                    continue    # own refs are counted via local_refs
+                entry = self.borrows.get(oid)
+                if entry is not None:
+                    entry["count"] += 1
+                    continue
+                self.borrows[oid] = {"count": 1, "owner": owner,
+                                     "acked": False}
+                to_ack.append((oid, owner))
+        if not to_ack:
+            return
+        # Concurrent acks: one round-trip/timeout total, not one per owner.
+        for oid, _owner in await self._pin_remote(to_ack):
+            with self._ref_lock:
+                entry = self.borrows.get(oid)
+                if entry is not None:
+                    entry["acked"] = True
+
+    async def _pin_remote(self, pairs: list[tuple[bytes, str]]
+                          ) -> list[tuple[bytes, str]]:
+        """add_borrow each (object_id, owner) with an ack; return the pairs
+        whose ack landed.  A failed/timed-out ack counts as NOT pinned and
+        its matching release must be skipped: if the add actually landed we
+        leak one borrow (object lives too long), never undercount and free
+        an object another borrower still holds."""
+        acked: list[tuple[bytes, str]] = []
+
+        async def _one(oid: bytes, owner: str) -> None:
+            try:
+                await self.clients.get(owner).call(
+                    "add_borrow", {"object_id": oid.hex()}, timeout=10.0)
+                acked.append((oid, owner))
+            except Exception:  # noqa: BLE001 - owner may already be gone
+                pass
+        await asyncio.gather(*[_one(o, w) for o, w in pairs])
+        return acked
+
     # -------- task reply handling (owner side) --------
     def _on_task_reply(self, task: PendingTask, reply: dict,
                        blobs: list[bytes]) -> None:
         status = reply.get("status")
+        if status != "error" or not (task.retry_exceptions
+                                     and task.retries_left > 0):
+            # Terminal reply: drop submission borrow pins (retried tasks
+            # keep theirs — the resend ships the same refs).
+            self._release_task_borrows(task)
         if status == "ok":
             returns = reply["returns"]
             offset = 0
             for i, meta in enumerate(returns):
                 rid = task.return_ids[i]
-                rec = self.owned.setdefault(rid, OwnedObject())
                 if meta["inline"]:
                     nframes = meta["nframes"]
                     frames = blobs[offset:offset + nframes]
                     offset += nframes
-                    rec.state = "inline"
-                    rec.frames = frames
-                    self.memory.put_frames(rid, frames)
                 else:
-                    rec.state = "stored"
-                    rec.locations = [meta["location"]]
-                    self.memory.put_locations(rid, rec.locations)
+                    frames = None
+                with self._ref_lock:
+                    rec = self.owned.get(rid)
+                    if rec is None:
+                        # Return ref already dropped (fire-and-forget):
+                        # don't resurrect the record — local_refs would
+                        # stay 0 and the executor's contained pins would
+                        # never release.  Free value + pins right away.
+                        tmp = OwnedObject()
+                        tmp.contained = [(bytes.fromhex(c[0]), c[1])
+                                         for c in meta.get("contained", ())]
+                        if not meta["inline"]:
+                            tmp.locations = [meta["location"]]
+                        self._free_object(rid, tmp)
+                        continue
+                    # A re-executed task (lineage reconstruction) re-pins
+                    # its contained refs; release the previous round's
+                    # pins first.
+                    prev_contained, rec.contained = rec.contained, [
+                        (bytes.fromhex(c[0]), c[1])
+                        for c in meta.get("contained", ())]
+                    if meta["inline"]:
+                        rec.state = "inline"
+                        rec.frames = frames
+                        self.memory.put_frames(rid, frames)
+                    else:
+                        rec.state = "stored"
+                        rec.locations = [meta["location"]]
+                        self.memory.put_locations(rid, rec.locations)
+                for c_oid, c_owner in prev_contained:
+                    self._release_borrow(c_oid, c_owner)
             self._record_event(task.task_id.hex(), "FINISHED")
         elif status == "cancelled":
             err = TaskCancelledError(task.task_id.hex())
@@ -530,7 +729,11 @@ class CoreWorker:
             self._record_event(task.task_id.hex(), "FAILED")
 
     def _resolve_error(self, rid: bytes, err: BaseException) -> None:
-        rec = self.owned.setdefault(rid, OwnedObject())
+        rec = self.owned.get(rid)
+        if rec is None:
+            # Ref already dropped before resolution — nobody can observe
+            # the error; don't resurrect a record that can never be freed.
+            return
         rec.state = "error"
         rec.error = err
         self.memory.put_error(rid, err)
@@ -540,8 +743,17 @@ class CoreWorker:
         oid = ObjectID.for_put(WorkerID.from_hex(self.worker_id),
                                next(self._put_seq)).binary()
         sv = serialize(value)
-        rec = self.owned.setdefault(oid, OwnedObject())
-        rec.local_refs += 1
+        with self._ref_lock:
+            rec = self.owned.setdefault(oid, OwnedObject())
+            rec.local_refs += 1
+            # Contained pins for refs nested in the value (released when
+            # this object is freed).  Fire-and-forget notify suffices here
+            # (unlike _pack_returns): this process's later remove_borrow
+            # rides the same owner connection, so the add is ordered
+            # before it.
+            for c_oid, owner in self._dedup_contained(sv.contained_refs):
+                rec.contained.append((c_oid, owner))
+                self._add_borrow(c_oid, owner)
         if sv.total_bytes <= self.config.max_inline_object_size:
             rec.state = "inline"
             rec.frames = sv.frames
@@ -576,9 +788,18 @@ class CoreWorker:
         out = []
         for r in results:
             if isinstance(r, BaseException):
-                raise r
+                raise _copy_error(r)
             out.append(r)
         return out
+
+    async def _deserialize_registering(self, frames) -> Any:
+        """Materialize a value, registering this process as a borrower of
+        any refs nested inside it (see _register_borrows)."""
+        value, contained = await self.loop.run_in_executor(
+            None, deserialize_with_refs, frames)
+        if contained:
+            await self._register_borrows(contained)
+        return value
 
     async def _get_one(self, ref: ObjectRef, deadline: float | None) -> Any:
         e = self.memory.get_if_exists(ref.binary())
@@ -599,8 +820,7 @@ class CoreWorker:
             if e.has_value:
                 return e.value
             if e.frames is not None:
-                value = await self.loop.run_in_executor(
-                    None, deserialize, e.frames)
+                value = await self._deserialize_registering(e.frames)
                 e.has_value, e.value = True, value
                 return value
             if e.locations:
@@ -624,7 +844,7 @@ class CoreWorker:
                 f"{ref.hex()[:12]}: {err}")
         state = reply.get("state")
         if state == "inline":
-            value = await self.loop.run_in_executor(None, deserialize, blobs)
+            value = await self._deserialize_registering(blobs)
             e = self.memory.entry(ref.binary())
             e.has_value, e.value = True, value
             e.event.set()
@@ -647,8 +867,7 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 continue
             if reply.get("found"):
-                value = await self.loop.run_in_executor(
-                    None, deserialize, blobs)
+                value = await self._deserialize_registering(blobs)
                 entry.has_value, entry.value = True, value
                 entry.event.set()
                 return value
@@ -667,8 +886,8 @@ class CoreWorker:
                 retries_left=rec.retries_left, retry_exceptions=False,
                 scheduling_key=key)
             self.lease_manager.submit(task)
-            return await self._get_one(ObjectRef(ref.binary(), self.address),
-                                       None)
+            return await self._get_one(
+                _UntrackedRef(ref.binary(), self.address), None)
         return ObjectLostError(ref.hex()[:12])
 
     def wait(self, refs: list[ObjectRef], num_returns: int,
@@ -718,7 +937,7 @@ class CoreWorker:
             try:
                 v = await self._get_one(ref, None)
                 if isinstance(v, BaseException):
-                    fut.set_exception(v)
+                    fut.set_exception(_copy_error(v))
                 else:
                     fut.set_result(v)
             except BaseException as e:  # noqa: BLE001
@@ -729,15 +948,61 @@ class CoreWorker:
 
     # -------------------------------------------------------------- refcount
     def _release_local_ref(self, object_id: bytes) -> None:
-        rec = self.owned.get(object_id)
-        if rec is None:
+        """ObjectRef.__del__ hook.  Owner-side: drop a local count.
+        Borrower-side: the last local instance sends remove_borrow to the
+        owner (ray: borrower removal path)."""
+        with self._ref_lock:
+            rec = self.owned.get(object_id)
+            if rec is not None:
+                rec.local_refs -= 1
+                if rec.local_refs <= 0 and rec.borrowers <= 0:
+                    self._free_object(object_id, rec)
+                return
+            entry = self.borrows.get(object_id)
+            if entry is None:
+                return
+            entry["count"] -= 1
+            if entry["count"] > 0:
+                return
+            self.borrows.pop(object_id, None)
+        # Past the lock: the entry is detached, only this thread sees it.
+        # Un-acked registration (owner unreachable at deserialize time): a
+        # remove here would be unmatched and could undercount the owner's
+        # borrower count — skip it.
+        if entry.get("acked", True):
+            self._release_borrow(object_id, entry["owner"])
+        # Drop the borrower-side cached value too: it may hold nested
+        # ObjectRef instances whose releases cascade — without eviction
+        # the cache would pin every nested borrow forever (the owner-side
+        # analog lives in _free_object).
+        self._evict_cached(object_id)
+
+    def _evict_cached(self, object_id: bytes) -> None:
+        """Delete a memory-store entry from any thread (the store is
+        loop-affine)."""
+        loop = self.loop
+        if loop is None or self._shutdown.is_set():
             return
-        rec.local_refs -= 1
-        if rec.local_refs <= 0 and rec.borrowers <= 0:
-            self._free_object(object_id, rec)
+        try:
+            loop.call_soon_threadsafe(self.memory.delete, object_id)
+        except RuntimeError:
+            pass
+
+    def _note_deserialized_own_ref(self, object_id: bytes) -> None:
+        """A deserialized copy of one of our own refs counts as a local
+        reference (its __del__ will decrement)."""
+        with self._ref_lock:
+            rec = self.owned.get(object_id)
+            if rec is not None:
+                rec.local_refs += 1
 
     def _free_object(self, object_id: bytes, rec: OwnedObject) -> None:
-        self.owned.pop(object_id, None)
+        with self._ref_lock:
+            self.owned.pop(object_id, None)
+            contained, rec.contained = rec.contained, []
+        # Refs nested in this object's value lose their container pin.
+        for oid, owner in contained:
+            self._release_borrow(oid, owner)
         locations = list(rec.locations)
         loop = self.loop
         if loop is None or self._shutdown.is_set():
@@ -760,18 +1025,11 @@ class CoreWorker:
             pass
 
     async def rpc_add_borrow(self, h: dict, _b: list) -> dict:
-        rec = self.owned.get(bytes.fromhex(h["object_id"]))
-        if rec:
-            rec.borrowers += 1
+        self._add_borrow(bytes.fromhex(h["object_id"]), self.address)
         return {}
 
     async def rpc_remove_borrow(self, h: dict, _b: list) -> dict:
-        oid = bytes.fromhex(h["object_id"])
-        rec = self.owned.get(oid)
-        if rec:
-            rec.borrowers -= 1
-            if rec.local_refs <= 0 and rec.borrowers <= 0:
-                self._free_object(oid, rec)
+        self._release_borrow(bytes.fromhex(h["object_id"]), self.address)
         return {}
 
     # ------------------------------------------------- owner-side resolution
@@ -804,19 +1062,38 @@ class CoreWorker:
         fn = await self._fetch_function(h["function_id"])
         args, kwargs = await self._resolve_args(h, blobs)
         self._record_event(h["task_id"], "RUNNING", h.get("name", ""))
+
+        def _thunk():
+            from ray_tpu._private import runtime_env as renv
+
+            with renv.activate(h.get("runtime_env"), self):
+                return fn(*args, **kwargs)
         try:
-            result = await self._run_user_code(
-                lambda: fn(*args, **kwargs), task_id=task_id)
+            result = await self._run_user_code(_thunk, task_id=task_id)
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(e)
+        finally:
+            self._evict_untracked_args(h)
         return await self._pack_returns(result, h)
 
+    def _evict_untracked_args(self, h: dict) -> None:
+        """Drop cached values fetched for this task's top-level ref args.
+        Untracked fetches (no owned record, no borrow entry) have no
+        release path of their own; left in the cache they'd pin any refs
+        nested inside those values forever."""
+        for r in h.get("arg_refs", ()):
+            oid = bytes.fromhex(r["id"])
+            if oid not in self.owned and oid not in self.borrows:
+                self.memory.delete(oid)
+
     async def _resolve_args(self, h: dict, blobs: list) -> tuple[tuple, dict]:
-        args_t, kwargs = await self.loop.run_in_executor(
-            None, deserialize, blobs)
+        """Deserialize args (registering borrows for nested refs — ray:
+        borrower protocol, reference_count.cc) and resolve top-level refs
+        to values."""
+        args_t, kwargs = await self._deserialize_registering(blobs)
         args = list(args_t)
         if h.get("arg_refs"):
-            ref_objs = [ObjectRef(bytes.fromhex(r["id"]), r["owner"])
+            ref_objs = [_UntrackedRef(bytes.fromhex(r["id"]), r["owner"])
                         for r in h["arg_refs"]]
             values = await self._get_objects_async(ref_objs, None)
             for r, v in zip(h["arg_refs"], values):
@@ -856,14 +1133,40 @@ class CoreWorker:
         task_id = bytes.fromhex(h["task_id"])
         for i, v in enumerate(values):
             sv = await self.loop.run_in_executor(None, serialize, v)
+            # Refs nested in a return value get a contained pin, added
+            # HERE — and ACKED before the reply, because the reply releases
+            # the caller's submission pins (different connection: no FIFO
+            # guarantee) — owned by the caller's return-object record,
+            # which releases it when the return object is freed (ray:
+            # contained-in-owned refs, reference_count.cc).
+            pairs = self._dedup_contained(sv.contained_refs)
+            pinned: list[tuple[bytes, str]] = []
+            remote_pins = []
+            for oid, owner in pairs:
+                if owner == self.address:
+                    with self._ref_lock:
+                        rec_c = self.owned.get(oid)
+                        if rec_c:
+                            rec_c.borrowers += 1
+                            pinned.append((oid, owner))
+                else:
+                    remote_pins.append((oid, owner))
+            if remote_pins:
+                pinned.extend(await self._pin_remote(remote_pins))
+            # Only pins that actually landed are reported to the caller:
+            # its later release must match an add, or the owner undercounts.
+            contained = [[oid.hex(), owner] for oid, owner in pinned]
             if sv.total_bytes <= self.config.max_inline_object_size:
-                returns.append({"inline": True, "nframes": len(sv.frames)})
+                returns.append({"inline": True, "nframes": len(sv.frames),
+                                "contained": contained})
                 out_blobs.extend(sv.frames)
             else:
                 oid = ObjectID.for_return(TaskID(task_id), i)
                 reply, _ = await self.clients.get(self.agent_addr).call(
                     "store_put", {"object_id": oid.hex()}, sv.frames)
-                returns.append({"inline": False, "location": self.agent_addr})
+                returns.append({"inline": False,
+                                "location": self.agent_addr,
+                                "contained": contained})
         return {"status": "ok", "returns": returns}, out_blobs
 
     # --------------------------------------------------------------- actors
@@ -872,20 +1175,36 @@ class CoreWorker:
             cls = await self._fetch_function(h["function_id"])
             args, kwargs = await self._resolve_args(h, blobs)
             is_async = bool(h.get("is_async"))
+            renv_desc = h.get("runtime_env")
+
+            def _construct():
+                from ray_tpu._private import runtime_env as renv
+
+                with renv.activate(renv_desc, self):
+                    return cls(*args, **kwargs)
             if is_async:
-                instance = cls(*args, **kwargs)
+                if renv_desc and renv_desc.get("packages"):
+                    # Packages must be on disk before activate runs on the
+                    # loop thread (see runtime_env.prefetch).
+                    from ray_tpu._private import runtime_env as renv
+
+                    await self.loop.run_in_executor(
+                        None, renv.prefetch, renv_desc, self)
+                instance = _construct()
             else:
                 instance = await self.loop.run_in_executor(
-                    self._default_executor, lambda: cls(*args, **kwargs))
+                    self._default_executor, _construct)
             self.actors_hosted[h["actor_id"]] = ActorInstance(
                 h["actor_id"], instance,
                 max_concurrency=h.get("max_concurrency", 1),
-                is_async=is_async)
+                is_async=is_async, runtime_env=renv_desc)
             self.current_actor_id = h["actor_id"]
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}\n"
                              f"{traceback.format_exc()}"}
+        finally:
+            self._evict_untracked_args(h)
 
     async def rpc_actor_call(self, h: dict, blobs: list) -> tuple[dict, list]:
         inst = self.actors_hosted.get(h["actor_id"])
@@ -955,14 +1274,35 @@ class CoreWorker:
         self._record_event(h["task_id"], "RUNNING",
                            f"{type(inst.instance).__name__}.{h['method']}")
         if inst.is_async and asyncio.iscoroutinefunction(method):
-            atask = self.loop.create_task(method(*args, **kwargs))
+            if inst.runtime_env:
+                from ray_tpu._private import runtime_env as renv
+
+                if inst.runtime_env.get("packages"):
+                    # Packages must be on disk before activate runs on
+                    # the loop thread (see runtime_env.prefetch).
+                    await self.loop.run_in_executor(
+                        None, renv.prefetch, inst.runtime_env, self)
+
+                async def _run_async():
+                    # env_vars/working_dir stay active across awaits; with
+                    # concurrent async methods of differently-enved actors
+                    # this is best-effort (same documented limitation as
+                    # runtime_env.activate itself).
+                    with renv.activate(inst.runtime_env, self):
+                        return await method(*args, **kwargs)
+                atask = self.loop.create_task(_run_async())
+            else:
+                atask = self.loop.create_task(method(*args, **kwargs))
             self._running_async[task_id] = atask
         else:
             def _call():
+                from ray_tpu._private import runtime_env as renv
+
                 prev = self.current_task_id
                 self.current_task_id = h["task_id"]
                 try:
-                    return method(*args, **kwargs)
+                    with renv.activate(inst.runtime_env, self):
+                        return method(*args, **kwargs)
                 finally:
                     self.current_task_id = prev
             atask = self.loop.run_in_executor(inst.executor, _call)
@@ -976,6 +1316,7 @@ class CoreWorker:
                 return self._error_reply(e)
             finally:
                 self._running_async.pop(task_id, None)
+                self._evict_untracked_args(h)
             return await self._pack_returns(result, h)
 
         return _finish()
@@ -998,13 +1339,14 @@ class CoreWorker:
         num_returns = options.get("num_returns", 1)
         return_ids = [ObjectID.for_return(task_id, i).binary()
                       for i in range(num_returns)]
-        header, blobs = self._build_task_payload(
+        header, blobs, borrowed = self._build_task_payload(
             task_id.binary(), "", args, kwargs, num_returns, {}, None, options)
         header.update({"actor_id": actor_id, "method": method,
                        "caller": self.worker_id})
-        for rid in return_ids:
-            rec = self.owned.setdefault(rid, OwnedObject())
-            rec.local_refs += 1
+        with self._ref_lock:
+            for rid in return_ids:
+                rec = self.owned.setdefault(rid, OwnedObject())
+                rec.local_refs += 1
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         max_task_retries = options.get("max_task_retries", 0)
 
@@ -1014,19 +1356,26 @@ class CoreWorker:
             header["seqno"] = st.seqno
             st.seqno += 1
             self.loop.create_task(self._push_actor_task(
-                st, header, blobs, return_ids, max_task_retries))
+                st, header, blobs, return_ids, max_task_retries, borrowed))
 
         self.loop.call_soon_threadsafe(_go)
         return refs
 
     async def _push_actor_task(self, st: ActorSubmitState, header: dict,
                                blobs: list, return_ids: list[bytes],
-                               retries: int) -> None:
+                               retries: int,
+                               borrowed: list | None = None) -> None:
+        task = PendingTask(
+            task_id=bytes.fromhex(header["task_id"]), header=header,
+            blobs=blobs, return_ids=return_ids, retries_left=0,
+            retry_exceptions=False, scheduling_key=(),
+            borrowed=borrowed or [])
         while True:
             if st.dead:
                 err = ActorDiedError(st.actor_id, st.death_cause)
                 for rid in return_ids:
                     self._resolve_error(rid, err)
+                self._release_task_borrows(task)
                 return
             addr = await self._resolve_actor_addr(st)
             if addr is None:
@@ -1046,11 +1395,8 @@ class CoreWorker:
                 err = ActorError(st.actor_id, "actor worker connection lost")
                 for rid in return_ids:
                     self._resolve_error(rid, err)
+                self._release_task_borrows(task)
                 return
-            task = PendingTask(
-                task_id=bytes.fromhex(header["task_id"]), header=header,
-                blobs=blobs, return_ids=return_ids, retries_left=0,
-                retry_exceptions=False, scheduling_key=())
             self._on_task_reply(task, reply, rblobs)
             return
 
@@ -1074,10 +1420,16 @@ class CoreWorker:
             st.death_cause = reply.get("cause") or reply.get("state", "")
 
     async def _on_actor_event(self, _topic: str, payload: dict) -> None:
-        st = self.actor_states.get(payload.get("actor_id", ""))
+        actor_id = payload.get("actor_id", "")
+        ev = payload.get("event")
+        if ev == "dead":
+            # Even with no submit state (actor created here, never
+            # called), the death must release this process's
+            # creation-arg pins.
+            self._release_creation_borrows(actor_id)
+        st = self.actor_states.get(actor_id)
         if st is None:
             return
-        ev = payload.get("event")
         if ev == "alive":
             st.address = payload["address"]
             st.dead = False
@@ -1095,7 +1447,9 @@ class CoreWorker:
             self.clients.drop(old)
 
     def create_actor(self, cls: Any, args: tuple, kwargs: dict,
-                     options: dict) -> str:
+                     options: dict) -> tuple[str, bool]:
+        """Returns (actor_id, existing) — existing=True when get_if_exists
+        matched a live actor instead of creating one."""
         fid = self.export_function(cls)
         actor_id = ActorID.from_random().hex()
         resources = dict(options.get("resources") or {})
@@ -1103,31 +1457,57 @@ class CoreWorker:
         if options.get("num_tpus"):
             resources["TPU"] = options["num_tpus"]
         task_id = TaskID.from_random()
-        header, blobs = self._build_task_payload(
+        # Creation-arg borrow pins live as long as the actor: the instance
+        # typically retains deserialized refs, and there is no reply-time
+        # held-ref report for creation tasks.  Released when this process
+        # kills the actor or observes its death.
+        header, blobs, creation_borrows = self._build_task_payload(
             task_id.binary(), fid, args, kwargs, 0, resources,
             options.get("bundle_key"), options)
         header.update({
             "function_id": fid,
+            "class_name": getattr(cls, "__name__", "?"),
             "max_concurrency": options.get("max_concurrency", 1),
             "is_async": bool(options.get("is_async")),
         })
-        reply, _ = self.call(
-            self.controller_addr, "create_actor",
-            {"actor_id": actor_id, "creation_header": header,
-             "owner_addr": self.address, "resources": resources,
-             "max_restarts": options.get("max_restarts", 0),
-             "name": options.get("name"),
-             "namespace": options.get("namespace", self.namespace),
-             "get_if_exists": options.get("get_if_exists", False),
-             "detached": options.get("lifetime") == "detached",
-             "pg_id": options.get("pg_id"),
-             "bundle_index": options.get("bundle_index", -1),
-             "affinity_node_id": options.get("affinity_node_id"),
-             "affinity_soft": options.get("affinity_soft", False)},
-            blobs, timeout=120.0)
-        if reply.get("error"):
-            raise ValueError(reply["error"])
-        return reply["actor_id"]
+        try:
+            reply, _ = self.call(
+                self.controller_addr, "create_actor",
+                {"actor_id": actor_id, "creation_header": header,
+                 "owner_addr": self.address, "resources": resources,
+                 "max_restarts": options.get("max_restarts", 0),
+                 "name": options.get("name"),
+                 "namespace": options.get("namespace", self.namespace),
+                 "get_if_exists": options.get("get_if_exists", False),
+                 "detached": options.get("lifetime") == "detached",
+                 "pg_id": options.get("pg_id"),
+                 "bundle_index": options.get("bundle_index", -1),
+                 "affinity_node_id": options.get("affinity_node_id"),
+                 "affinity_soft": options.get("affinity_soft", False)},
+                blobs, timeout=120.0)
+            if reply.get("error"):
+                raise ValueError(reply["error"])
+        except BaseException:
+            # Failed creation (name taken, controller error, timeout):
+            # the creation payload is discarded, so its pins must go too.
+            for oid, owner in creation_borrows:
+                self._release_borrow(oid, owner)
+            raise
+        existing = bool(reply.get("existing"))
+        if creation_borrows:
+            if existing:
+                # get_if_exists hit: the creation payload is discarded, so
+                # its pins must be released immediately.
+                for oid, owner in creation_borrows:
+                    self._release_borrow(oid, owner)
+            else:
+                self.actor_creation_borrows[reply["actor_id"]] = \
+                    creation_borrows
+        return reply["actor_id"], existing
+
+    def _release_creation_borrows(self, actor_id: str) -> None:
+        for oid, owner in self.actor_creation_borrows.pop(actor_id, ()):
+            self._release_borrow(oid, owner)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         self.call(self.controller_addr, "remove_actor",
@@ -1137,6 +1517,7 @@ class CoreWorker:
             st.dead = True
             st.address = None
             st.death_cause = "killed"
+        self._release_creation_borrows(actor_id)
 
     def kill_actor_async(self, actor_id: str) -> None:
         """Fire-and-forget kill used by ActorHandle GC (must not block in
@@ -1150,6 +1531,7 @@ class CoreWorker:
                 self.controller_addr, "remove_actor",
                 {"actor_id": actor_id, "cause": "handle out of scope"},
                 timeout=30.0))
+            self._release_creation_borrows(actor_id)
         try:
             loop.call_soon_threadsafe(_go)
         except RuntimeError:

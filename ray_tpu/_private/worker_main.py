@@ -39,7 +39,29 @@ def _extend_sys_path() -> None:
             sys.path.append(p)
 
 
+def _pin_jax_platform() -> None:
+    """Apply the JAX_PLATFORMS env var via jax.config.
+
+    On this image a sitecustomize imports jax at interpreter startup, so
+    the env var alone is ignored; the backend only initializes lazily,
+    which means config.update still takes effect here.  Plain (non-device)
+    workers get JAX_PLATFORMS=cpu from the agent so they never grab the
+    TPU chip (ray analog: CUDA_VISIBLE_DEVICES isolation in worker_pool) —
+    without this, every actor's tiny jitted op round-trips the TPU tunnel.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001 - backend already up; run as-is
+        pass
+
+
 def main() -> None:
+    _pin_jax_platform()
     _watch_parent()
     _extend_sys_path()
     # `kill -USR1 <pid>` dumps all thread stacks to stderr — the per-process

@@ -42,6 +42,13 @@ class ActorMethod:
                         opts.get("num_returns", self._num_returns))
         return m
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this actor method (ray: dag/class_node.py
+        ClassMethodNode via actor_method.bind)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"actor methods cannot be called directly; use "
                         f"{self._name}.remote()")
@@ -129,10 +136,16 @@ class ActorClass:
         core = global_worker()
         if "pg_id" in options:
             _wait_pg_ready(core, options["pg_id"])
-        actor_id = core.create_actor(self._cls, args, kwargs, options)
-        # Named/detached actors outlive their creating handle; anonymous
-        # actors are GC'd with it.
-        owner = not (options.get("name") or options.get("lifetime") == "detached")
+        actor_id, existing = core.create_actor(self._cls, args, kwargs,
+                                               options)
+        # The creating handle owns the actor's lifetime unless the actor
+        # is detached OR named (ray counts every handle — including ones
+        # from get_actor — and kills on the last drop; this runtime does
+        # not do distributed handle counting, and killing a named actor on
+        # the creator's drop would break other processes' get_actor
+        # handles, so named actors live until ray_tpu.kill / shutdown).
+        owner = not (existing or options.get("name")
+                     or options.get("lifetime") == "detached")
         return ActorHandle(actor_id, self._method_names, owner=owner)
 
     def __call__(self, *args, **kwargs):

@@ -69,6 +69,12 @@ def init(address: str | None = None,
     if _initialized:
         raise RuntimeError("ray_tpu.init() already called; "
                            "call ray_tpu.shutdown() first")
+    import os as _os
+
+    if address is None:
+        # Job-submission child drivers attach to the submitting cluster
+        # (ray: RAY_ADDRESS honored by ray.init).
+        address = _os.environ.get("RAY_TPU_ADDRESS") or None
     config = Config().override(_system_config)
     if object_store_memory:
         config.object_store_memory = object_store_memory

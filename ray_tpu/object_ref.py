@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+# Called as hook(object_id) on ref drop; the worker resolves the owner
+# from its owned/borrows tables.
 _release_hook: Callable[[bytes], None] | None = None
 
 
@@ -27,7 +29,21 @@ class ObjectRef:
 
     @classmethod
     def _from_serialized(cls, object_id: bytes, owner_addr: str) -> "ObjectRef":
-        return cls(object_id, owner_addr)
+        ref = cls(object_id, owner_addr)
+        from ray_tpu._private.serialization import _note_deser_ref
+
+        _note_deser_ref(ref)
+        # On the owner, every deserialized copy is a live local reference —
+        # without this, `del copy` would release a count the original never
+        # granted and free the object early.
+        try:
+            from ray_tpu._private.worker import _global_worker
+
+            if _global_worker is not None:
+                _global_worker._note_deserialized_own_ref(object_id)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+        return ref
 
     def binary(self) -> bytes:
         return self._id

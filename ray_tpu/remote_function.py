@@ -81,6 +81,13 @@ class RemoteFunction:
             "remote functions cannot be called directly; use "
             f"{getattr(self._function, '__name__', 'fn')}.remote()")
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node instead of immediate submission (ray:
+        dag/function_node.py via remote_function.bind)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __repr__(self):
         return f"RemoteFunction({getattr(self._function, '__name__', '?')})"
 

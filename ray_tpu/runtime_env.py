@@ -1,0 +1,4 @@
+"""Public runtime-env API (ray: python/ray/runtime_env/runtime_env.py)."""
+from ray_tpu._private.runtime_env import RuntimeEnv
+
+__all__ = ["RuntimeEnv"]

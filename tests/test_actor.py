@@ -1,4 +1,5 @@
 """Actor API tests (analog of ray: python/ray/tests/test_actor.py)."""
+import gc
 import time
 
 import pytest
@@ -46,9 +47,24 @@ def test_named_actor(ray_shared):
         def ping(self):
             return "pong"
 
-    Svc.options(name="svc-test").remote()
+    creator = Svc.options(name="svc-test").remote()
     h = ray_tpu.get_actor("svc-test")
     assert ray_tpu.get(h.ping.remote()) == "pong"
+    # Named actors survive the creating handle going out of scope: this
+    # runtime has no distributed handle counting, so killing on the
+    # creator's drop would break other processes' get_actor handles
+    # (ray instead counts every handle; divergence documented in
+    # actor.py).  They live until ray_tpu.kill / shutdown.
+    del creator
+    gc.collect()
+    time.sleep(0.3)
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    ray_tpu.kill(h)
+    d = Svc.options(name="svc-detached", lifetime="detached").remote()
+    del d
+    h2 = ray_tpu.get_actor("svc-detached")
+    assert ray_tpu.get(h2.ping.remote()) == "pong"
+    ray_tpu.kill(h2)
 
 
 def test_get_actor_missing(ray_shared):

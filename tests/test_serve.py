@@ -17,7 +17,7 @@ from ray_tpu import serve
 @pytest.fixture(scope="module")
 def serve_instance():
     if not ray_tpu.is_initialized():
-        ray_tpu.init(resources={"CPU": 8})
+        ray_tpu.init(resources={"CPU": 4})
     serve.start()
     yield serve
     serve.shutdown()
