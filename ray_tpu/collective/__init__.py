@@ -1,0 +1,27 @@
+"""ray_tpu.collective: explicit collective groups across actors/tasks.
+
+Analog of ray: python/ray/util/collective/collective.py (GroupManager:40,
+init_collective_group:120, allreduce:258) with NCCL/GLOO backends
+(collective_group/nccl_collective_group.py, gloo_collective_group.py).
+
+TPU-first split (SURVEY §2.4 "Collective backend"):
+- *Within a slice* collectives are XLA's job: jax.lax.psum/all_gather/
+  ppermute inside pjit/shard_map over a Mesh — no runtime API needed, the
+  compiler schedules ICI.  This module is NOT that path.
+- *Across actor processes* (hosts over DCN) this module provides the
+  gloo-analog control-plane collectives: host numpy/jax arrays moved
+  through the object store with a named rendezvous actor per group.
+"""
+from ray_tpu.collective.collective import (allgather, allreduce, barrier,
+                                           broadcast, create_collective_group,
+                                           destroy_collective_group,
+                                           get_rank, get_collective_group_size,
+                                           init_collective_group, recv,
+                                           reducescatter, send)
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "allreduce", "allgather", "reducescatter",
+    "broadcast", "barrier", "send", "recv", "get_rank",
+    "get_collective_group_size",
+]

@@ -1,0 +1,241 @@
+"""Collective groups over the object plane (the gloo-analog backend).
+
+Analog of ray: python/ray/util/collective/collective.py — same public
+functions, same group-name semantics.  Backend: a named `_Rendezvous`
+actor per group matches per-(seq, op) contributions from all ranks and
+hands back the object refs; each rank then reduces locally.  This is the
+DCN control-plane path — for device collectives inside a slice use
+jax.lax collectives under pjit/shard_map (ray_tpu.parallel), which XLA
+schedules over ICI (SURVEY §2.4).
+
+All-reduce here is gather+local-reduce: O(world) per rank, fine for the
+small host counts and small tensors this plane carries (gradients stay on
+the ICI plane; this carries host-side state like data-loader offsets,
+eval metrics, rendezvous info).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+
+# Process-global group registry (ray: collective.py GroupManager:40 is a
+# process singleton).  NOT thread-local: actor methods may run on any
+# thread of the actor's pool (max_concurrency > 1).
+_registry_lock = threading.Lock()
+_registry: dict[str, "_GroupState"] = {}
+
+
+class _Rendezvous:
+    """Named actor: matches contributions from world_size ranks.
+
+    Async actor so waiting ranks don't block each other (the reference's
+    rendezvous is the NCCL unique-id store, collective_group/
+    nccl_collective_group.py _rendezvous helpers).
+    """
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        # (seq, op) -> {"refs": {rank: obj}, "event": asyncio.Event}
+        self.pending: dict = {}
+        self.asyncio = asyncio
+
+    async def configure(self, world_size: int) -> None:
+        """Re-arm for a (re-)created group: a mismatched world_size means a
+        new incarnation reused this detached actor's name — old pending
+        slots would release collectives early or hand back stale refs."""
+        if world_size != self.world_size:
+            self.world_size = world_size
+            self.pending.clear()
+            if hasattr(self, "p2p"):
+                self.p2p.clear()
+
+    def _slot(self, key):
+        slot = self.pending.get(key)
+        if slot is None:
+            slot = {"refs": {}, "event": self.asyncio.Event(), "taken": 0}
+            self.pending[key] = slot
+        return slot
+
+    async def exchange(self, key, rank: int, ref) -> dict:
+        """Deposit rank's contribution; wait for all; return all refs."""
+        slot = self._slot(tuple(key))
+        slot["refs"][rank] = ref
+        if len(slot["refs"]) == self.world_size:
+            slot["event"].set()
+        await slot["event"].wait()
+        refs = dict(slot["refs"])
+        slot["taken"] += 1
+        if slot["taken"] == self.world_size:
+            self.pending.pop(tuple(key), None)
+        return refs
+
+    def _p2p_queue(self, key):
+        if not hasattr(self, "p2p"):
+            self.p2p = {}
+        q = self.p2p.get(tuple(key))
+        if q is None:
+            # asyncio.Queue gives FIFO matching of repeated sends with the
+            # same (src, dst, tag) — no lost messages on rapid re-send.
+            q = self.asyncio.Queue()
+            self.p2p[tuple(key)] = q
+        return q
+
+    async def put_p2p(self, key, ref) -> None:
+        await self._p2p_queue(key).put(ref)
+
+    async def take_p2p(self, key):
+        return await self._p2p_queue(key).get()
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, rendezvous):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.rendezvous = rendezvous
+        self.seq = 0
+
+
+def _groups() -> dict:
+    return _registry
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "object_store",
+                          group_name: str = "default") -> None:
+    """Join a collective group; call from every participating actor/task
+    (ray: collective.py:120)."""
+    if rank < 0 or rank >= world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    rdv = ray_tpu.remote(_Rendezvous).options(
+        name=f"collective_rdv:{group_name}", get_if_exists=True,
+        lifetime="detached", max_concurrency=max(32, world_size * 4),
+        num_cpus=0).remote(world_size)
+    # A stale rendezvous (same name, earlier group incarnation) must not
+    # carry its old world_size or pending slots into this group.
+    ray_tpu.get(rdv.configure.remote(world_size))
+    with _registry_lock:
+        _registry[group_name] = _GroupState(group_name, world_size, rank, rdv)
+
+
+def create_collective_group(actors: list, world_size: int, ranks: list[int],
+                            backend: str = "object_store",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration (ray: collective.py create_collective_group):
+    each actor must expose an `init_collective_group(world_size, rank,
+    backend, group_name)` method (typically calling this module's
+    init_collective_group)."""
+    refs = [a.init_collective_group.remote(world_size, r, backend, group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down the group cluster-wide (ray: collective.py
+    destroy_collective_group).  Call only after all ranks are done."""
+    with _registry_lock:
+        g = _registry.pop(group_name, None)
+    if g is not None:
+        try:
+            ray_tpu.kill(g.rendezvous)
+        except Exception:  # noqa: BLE001 - another rank already killed it
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g.rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g.world_size if g else -1
+
+
+def _group(group_name: str) -> _GroupState:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process; call init_collective_group first")
+    return g
+
+
+def _exchange(g: _GroupState, op: str, value) -> dict:
+    g.seq += 1
+    ref = ray_tpu.put(value)
+    # Refs ride inside a list: a bare ObjectRef argument is resolved to its
+    # value before dispatch (task dependency resolution), but the
+    # rendezvous must pass the *ref* through untouched (same wrapping trick
+    # as ray: util/collective passing refs in containers).
+    refs = ray_tpu.get(g.rendezvous.exchange.remote(
+        (op, g.seq), g.rank, [ref]))
+    return {r: ray_tpu.get(refs[r][0]) for r in sorted(refs)}
+
+
+_REDUCE_OPS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "prod": lambda xs: np.prod(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+}
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """ray: collective.py:258.  Returns the reduced array (numpy in,
+    numpy out; jax arrays are accepted and returned as numpy)."""
+    g = _group(group_name)
+    parts = _exchange(g, f"allreduce:{op}", np.asarray(tensor))
+    return _REDUCE_OPS[op](np.stack(list(parts.values())))
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    g = _group(group_name)
+    parts = _exchange(g, "allgather", np.asarray(tensor))
+    return [parts[r] for r in sorted(parts)]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    """Each rank gets its 1/world slice of the reduction (ray:
+    collective.reducescatter)."""
+    g = _group(group_name)
+    parts = _exchange(g, f"reducescatter:{op}", np.asarray(tensor))
+    reduced = _REDUCE_OPS[op](np.stack(list(parts.values())))
+    chunks = np.array_split(reduced, g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    parts = _exchange(g, f"broadcast:{src_rank}",
+                      np.asarray(tensor) if g.rank == src_rank
+                      else np.zeros(0))
+    return parts[src_rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    _exchange(g, "barrier", np.zeros(0))
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    """P2P send (ray: collective.send)."""
+    g = _group(group_name)
+    ref = ray_tpu.put(np.asarray(tensor))
+    ray_tpu.get(g.rendezvous.put_p2p.remote(
+        (g.rank, dst_rank, tag), [ref]))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    """P2P recv (ray: collective.recv)."""
+    g = _group(group_name)
+    wrapped = ray_tpu.get(g.rendezvous.take_p2p.remote(
+        (src_rank, g.rank, tag)))
+    return ray_tpu.get(wrapped[0])

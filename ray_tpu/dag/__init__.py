@@ -1,0 +1,13 @@
+"""ray_tpu.dag: lazy DAGs of tasks/actor-method calls + compiled execution.
+
+Analog of ray: python/ray/dag/ (DAGNode dag_node.py:27,
+experimental_compile :129, CompiledDAG compiled_dag_node.py:479).
+"""
+from ray_tpu.dag.dag_node import (ClassMethodNode, CompiledDAG, DAGNode,
+                                  FunctionNode, InputAttributeNode, InputNode,
+                                  MultiOutputNode)
+
+__all__ = [
+    "DAGNode", "InputNode", "InputAttributeNode", "FunctionNode",
+    "ClassMethodNode", "MultiOutputNode", "CompiledDAG",
+]

@@ -1,0 +1,18 @@
+"""ray_tpu.rl: reinforcement learning (the RLlib analog, SURVEY §2.3).
+
+EnvRunnerGroup (CPU sampling actors) + LearnerGroup (jitted TPU updates)
++ Algorithm-as-Trainable, with PPO and DQN (ray: rllib/algorithms/).
+"""
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.env import make_env, register_env
+from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.learner import Learner, LearnerGroup
+from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay import ReplayBuffer
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
+    "ReplayBuffer", "make_env", "register_env",
+]

@@ -1,0 +1,194 @@
+"""Algorithm + AlgorithmConfig: the RL training driver.
+
+Analog of ray: rllib/algorithms/algorithm.py (Algorithm.step:898,
+training_step:1674) and algorithm_config.py (builder-style
+AlgorithmConfig).  Algorithm subclasses ray_tpu.tune.Trainable, so
+`Tuner(PPO, param_space=config.to_dict())` works exactly like the
+reference's `Algorithm is a Tune Trainable` contract.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Builder: .environment().env_runners().training().resources()
+    (ray: rllib/algorithms/algorithm_config.py)."""
+
+    algo_class: type | None = None
+
+    def __init__(self):
+        self.env = "CartPole-v1"
+        self.num_env_runners = 2
+        self.rollout_fragment_length = 256
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.train_batch_size = 512
+        self.num_sgd_iter = 4
+        self.minibatch_size = 128
+        self.hidden = 64
+        self.seed = 0
+        self.num_learners = 1
+        self.num_tpus_per_learner = 0.0
+        self.extra: dict[str, Any] = {}
+
+    # -- builder steps ------------------------------------------------------
+    def environment(self, env=None, **_kw) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int | None = None,
+                    rollout_fragment_length: int | None = None,
+                    **_kw) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, gamma=None, lr=None, train_batch_size=None,
+                 num_sgd_iter=None, minibatch_size=None,
+                 **kw) -> "AlgorithmConfig":
+        for name, v in [("gamma", gamma), ("lr", lr),
+                        ("train_batch_size", train_batch_size),
+                        ("num_sgd_iter", num_sgd_iter),
+                        ("minibatch_size", minibatch_size)]:
+            if v is not None:
+                setattr(self, name, v)
+        self.extra.update({k: v for k, v in kw.items() if v is not None})
+        return self
+
+    def learners(self, num_learners: int | None = None,
+                 num_tpus_per_learner: float | None = None,
+                 **_kw) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def debugging(self, seed: int | None = None, **_kw) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items() if k != "extra"}
+        d.update(self.extra)
+        return d
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        """ray: config.build_algo()."""
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class bound")
+        return self.algo_class(config=self.to_dict())
+
+    build_algo = build
+
+
+class Algorithm(Trainable):
+    """Base RL algorithm; subclasses define loss_builder() and
+    training_step() (ray: algorithm.py:898 step / :1674 training_step)."""
+
+    _default_config: AlgorithmConfig | None = None
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = (cls._default_config or AlgorithmConfig()).copy()
+        cfg.algo_class = cls
+        return cfg
+
+    # -- Trainable hooks ----------------------------------------------------
+    def setup(self, config: dict) -> None:
+        defaults = type(self).get_default_config().to_dict()
+        defaults.update(config or {})
+        self.cfg = defaults
+        probe = make_env(self.cfg["env"], seed=0)
+        self.obs_dim = probe.obs_dim
+        self.n_actions = probe.n_actions
+        self.env_runner_group = EnvRunnerGroup(
+            self.cfg["env"], num_env_runners=self.cfg["num_env_runners"],
+            gamma=self.cfg["gamma"],
+            gae_lambda=self.cfg.get("gae_lambda", 0.95))
+        learner_cfg = dict(self.cfg, obs_dim=self.obs_dim,
+                           n_actions=self.n_actions)
+        self.learner_group = LearnerGroup(
+            learner_cfg, type(self).loss_builder,
+            num_learners=self.cfg["num_learners"],
+            num_tpus_per_learner=self.cfg["num_tpus_per_learner"])
+        self._params_np = self.learner_group.get_params_numpy()
+        self._timesteps = 0
+        self._episode_returns: list[float] = []
+
+    def step(self) -> dict:
+        t0 = time.perf_counter()
+        metrics = self.training_step()
+        recent = self._episode_returns[-100:]
+        result = {
+            "env_runners": {
+                "episode_return_mean":
+                    float(np.mean(recent)) if recent else float("nan"),
+                "num_episodes": len(self._episode_returns),
+            },
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{f"learner/{k}": v for k, v in (metrics or {}).items()},
+        }
+        result["episode_return_mean"] = \
+            result["env_runners"]["episode_return_mean"]
+        return result
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def _collect(self, epsilon: float | None = None) -> dict:
+        per = max(1, self.cfg["train_batch_size"]
+                  // self.cfg["num_env_runners"])
+        batches = self.env_runner_group.sample(
+            self._params_np, per, epsilon=epsilon)
+        for b in batches:
+            self._episode_returns.extend(b.pop("episode_returns").tolist())
+            self._timesteps += len(b["obs"])
+        return {k: np.concatenate([b[k] for b in batches])
+                for k in batches[0]}
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = self.learner_group.get_state()
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump({"learner": state, "timesteps": self._timesteps},
+                        f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        for ln in self.learner_group.learners:
+            import ray_tpu
+
+            ray_tpu.get(ln.set_params.remote(state["learner"]["params"]))
+        self._params_np = state["learner"]["params"]
+        self._timesteps = state["timesteps"]
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    @staticmethod
+    def loss_builder(config: dict):
+        raise NotImplementedError

@@ -1,0 +1,106 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Analog of ray: rllib/algorithms/dqn/ (DQN, DQNConfig; double-DQN loss in
+dqn_torch_learner/dqn_rainbow_learner).  The "pi" head doubles as the
+Q-network (argmax action selection on env runners via epsilon-greedy).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.replay_capacity = 50_000
+        self.learning_starts = 1_000
+        self.target_update_freq = 500       # env steps between target syncs
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.train_batch_size = 256         # sampled per iteration
+        self.sgd_batch_size = 64
+
+    def training(self, *, replay_capacity=None, learning_starts=None,
+                 target_update_freq=None, epsilon_decay_steps=None,
+                 sgd_batch_size=None, **kw) -> "DQNConfig":
+        for name, v in [("replay_capacity", replay_capacity),
+                        ("learning_starts", learning_starts),
+                        ("target_update_freq", target_update_freq),
+                        ("epsilon_decay_steps", epsilon_decay_steps),
+                        ("sgd_batch_size", sgd_batch_size)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+class DQN(Algorithm):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        gamma = config.get("gamma", 0.99)
+
+        def loss_fn(params, batch):
+            q = models.policy_logits(params, batch["obs"], jnp)
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1)[:, 0]
+            # Double DQN: online net picks, target net evaluates
+            # (target Q values are computed outside and shipped in batch).
+            target = batch["q_targets"]
+            loss = jnp.mean((q_taken - target) ** 2)
+            return loss, {"q_mean": jnp.mean(q_taken),
+                          "td_error": jnp.mean(jnp.abs(q_taken - target))}
+        return loss_fn
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        self.replay = ReplayBuffer(self.cfg["replay_capacity"],
+                                   seed=self.cfg["seed"])
+        self._target_params = self._params_np
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        frac = min(1.0, self._timesteps / self.cfg["epsilon_decay_steps"])
+        return self.cfg["epsilon_initial"] + frac * (
+            self.cfg["epsilon_final"] - self.cfg["epsilon_initial"])
+
+    def training_step(self) -> dict:
+        from ray_tpu.rl import models
+
+        batch = self._collect(epsilon=self._epsilon())
+        self.replay.add_batch(batch)
+        if len(self.replay) < self.cfg["learning_starts"]:
+            return {"buffer_size": float(len(self.replay))}
+        metrics = {}
+        for _ in range(4):
+            sample = self.replay.sample(self.cfg["sgd_batch_size"])
+            # Double-DQN targets with the frozen target net (numpy).
+            q_next_online = models.policy_logits(self._params_np,
+                                                 sample["next_obs"])
+            best = np.argmax(q_next_online, axis=-1)
+            q_next_target = models.policy_logits(self._target_params,
+                                                 sample["next_obs"])
+            q_sel = q_next_target[np.arange(len(best)), best]
+            sample["q_targets"] = (
+                sample["rewards"] + self.cfg["gamma"] *
+                (1.0 - sample["dones"]) * q_sel).astype(np.float32)
+            metrics = self.learner_group.update(sample, num_sgd_iter=1)
+        self._params_np = self.learner_group.get_params_numpy()
+        if self._timesteps - self._last_target_sync >= \
+                self.cfg["target_update_freq"]:
+            self._target_params = self._params_np
+            self._last_target_sync = self._timesteps
+        metrics["epsilon"] = self._epsilon()
+        return metrics
+
+
+DQN._default_config = DQNConfig()
+DQNConfig.algo_class = DQN

@@ -1,0 +1,122 @@
+"""EnvRunner actors: CPU-side experience collection.
+
+Analog of ray: rllib/env/single_agent_env_runner.py (EnvRunner) and
+rllib/env/env_runner_group.py:71 (EnvRunnerGroup) — N actors step envs
+with the latest policy params (numpy forward pass; the TPU stays busy
+learning while CPU actors collect, the same split as rllib's
+EnvRunnerGroup.sample + LearnerGroup.update).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.env import make_env
+
+
+class EnvRunner:
+    """One sampling actor: runs an env loop with the shipped params."""
+
+    def __init__(self, env_name, seed: int = 0, gamma: float = 0.99,
+                 gae_lambda: float = 0.95):
+        self.env = make_env(env_name, seed=seed)
+        self.rng = np.random.default_rng(seed + 1000)
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.obs = self.env.reset()
+        self.episode_return = 0.0
+        self.completed_returns: list[float] = []
+
+    def sample(self, params: dict, n_steps: int,
+               epsilon: float | None = None) -> dict:
+        """Collect n_steps transitions.  With epsilon set, act
+        epsilon-greedily on Q-values (DQN); otherwise sample the categorical
+        policy and attach GAE advantages (PPO).
+        """
+        obs_buf = np.zeros((n_steps, len(self.obs)), np.float32)
+        act_buf = np.zeros((n_steps,), np.int64)
+        rew_buf = np.zeros((n_steps,), np.float32)
+        done_buf = np.zeros((n_steps,), np.float32)
+        logp_buf = np.zeros((n_steps,), np.float32)
+        next_obs_buf = np.zeros_like(obs_buf)
+
+        for t in range(n_steps):
+            obs_buf[t] = self.obs
+            logits = models.policy_logits(params, self.obs)
+            if epsilon is not None:
+                if self.rng.random() < epsilon:
+                    a = int(self.rng.integers(len(logits)))
+                else:
+                    a = int(np.argmax(logits))
+                logp = 0.0
+            else:
+                a, logp = models.sample_action(logits, self.rng)
+            nxt, r, terminated, truncated = self.env.step(a)
+            act_buf[t], rew_buf[t], logp_buf[t] = a, r, logp
+            next_obs_buf[t] = nxt
+            self.episode_return += r
+            done = terminated or truncated
+            done_buf[t] = float(terminated)   # bootstrap through truncation
+            if done:
+                self.completed_returns.append(self.episode_return)
+                self.episode_return = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = nxt
+
+        batch = {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+                 "dones": done_buf, "logp": logp_buf,
+                 "next_obs": next_obs_buf}
+        if epsilon is None:
+            batch.update(self._gae(params, batch))
+        rets, self.completed_returns = self.completed_returns, []
+        batch["episode_returns"] = np.array(rets, np.float32)
+        return batch
+
+    def _gae(self, params: dict, batch: dict) -> dict:
+        """Generalized advantage estimation (rllib:
+        connectors/learner/general_advantage_estimation.py semantics)."""
+        v = models.value(params, batch["obs"])
+        v_next = models.value(params, batch["next_obs"])
+        n = len(v)
+        adv = np.zeros(n, np.float32)
+        last = 0.0
+        for t in range(n - 1, -1, -1):
+            nonterminal = 1.0 - batch["dones"][t]
+            delta = batch["rewards"][t] + \
+                self.gamma * v_next[t] * nonterminal - v[t]
+            last = delta + self.gamma * self.gae_lambda * nonterminal * last
+            adv[t] = last
+        returns = adv + v
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return {"advantages": adv.astype(np.float32),
+                "value_targets": returns.astype(np.float32)}
+
+
+class EnvRunnerGroup:
+    """Driver-side handle to N EnvRunner actors (ray:
+    env_runner_group.py:71)."""
+
+    def __init__(self, env_name, num_env_runners: int = 2,
+                 gamma: float = 0.99, gae_lambda: float = 0.95,
+                 num_cpus_per_env_runner: float = 1.0):
+        cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            cls.options(num_cpus=num_cpus_per_env_runner).remote(
+                env_name, seed=i * 7919, gamma=gamma, gae_lambda=gae_lambda)
+            for i in range(num_env_runners)]
+
+    def sample(self, params_np: dict, n_steps_per_runner: int,
+               epsilon: float | None = None) -> list[dict]:
+        params_ref = ray_tpu.put(params_np)     # ship once, not per runner
+        return ray_tpu.get([
+            r.sample.remote(params_ref, n_steps_per_runner, epsilon)
+            for r in self.runners])
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

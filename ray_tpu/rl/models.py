@@ -1,0 +1,77 @@
+"""Policy/value networks as pure functions over param pytrees.
+
+Analog of the reference's RLModule (ray: rllib/core/rl_module/) — the
+jax-native shape: params are a dict pytree, `apply` is a pure function
+jittable on the learner (TPU) and runnable with numpy on CPU env-runners
+(same code path, different array module — no torch-style module objects).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def mlp_init(rng, sizes: list[int]) -> dict:
+    """He-init MLP params as a dict pytree."""
+    import jax
+
+    params = {}
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        import jax.numpy as jnp
+
+        w = jax.random.normal(keys[i], (fan_in, fan_out),
+                              jnp.float32) * np.sqrt(2.0 / fan_in)
+        params[f"w{i}"] = w
+        params[f"b{i}"] = jnp.zeros((fan_out,), jnp.float32)
+    return params
+
+
+def mlp_apply(params: dict, x, xp=np):
+    """Forward pass; `xp` = numpy (env runners) or jax.numpy (learner)."""
+    n_layers = len(params) // 2
+    h = x
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = xp.tanh(h)
+    return h
+
+
+def policy_value_init(rng, obs_dim: int, n_actions: int,
+                      hidden: int = 64) -> dict:
+    """Separate policy and value MLPs (rllib default fcnet)."""
+    import jax
+
+    k1, k2 = jax.random.split(rng)
+    return {
+        "pi": mlp_init(k1, [obs_dim, hidden, hidden, n_actions]),
+        "vf": mlp_init(k2, [obs_dim, hidden, hidden, 1]),
+    }
+
+
+def policy_logits(params: dict, obs, xp=np):
+    return mlp_apply(params["pi"], obs, xp)
+
+
+def value(params: dict, obs, xp=np):
+    return mlp_apply(params["vf"], obs, xp)[..., 0]
+
+
+def to_numpy(params) -> dict:
+    """Device → host copy for shipping to env runners."""
+    import jax
+
+    return jax.tree.map(lambda a: np.asarray(a), params)
+
+
+def sample_action(logits: np.ndarray, rng: np.random.Generator) -> tuple:
+    """Categorical sample + log-prob (numpy, env-runner side)."""
+    z = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=-1, keepdims=True)
+    if logits.ndim == 1:
+        a = rng.choice(len(p), p=p)
+        return int(a), float(np.log(p[a] + 1e-8))
+    acts = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+    logp = np.log(p[np.arange(len(acts)), acts] + 1e-8)
+    return acts, logp

@@ -1,0 +1,77 @@
+"""PPO: clipped-surrogate policy gradient (the reference's flagship algo).
+
+Analog of ray: rllib/algorithms/ppo/ (PPO, PPOConfig; torch loss in
+ppo_torch_learner.py) — jax loss jitted on the learner.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.gae_lambda = 0.95
+
+    def training(self, *, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, gae_lambda=None, **kw) -> "PPOConfig":
+        for name, v in [("clip_param", clip_param),
+                        ("vf_loss_coeff", vf_loss_coeff),
+                        ("entropy_coeff", entropy_coeff),
+                        ("gae_lambda", gae_lambda)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+class PPO(Algorithm):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        clip = config.get("clip_param", 0.2)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+
+        def loss_fn(params, batch):
+            logits = models.policy_logits(params, batch["obs"], jnp)
+            logp_all = logits - jnp.max(logits, axis=-1, keepdims=True)
+            logp_all = logp_all - jnp.log(
+                jnp.sum(jnp.exp(logp_all), axis=-1, keepdims=True))
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+            pi_loss = -jnp.mean(surrogate)
+            v = models.value(params, batch["obs"], jnp)
+            vf_loss = jnp.mean((v - batch["value_targets"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_kl": jnp.mean(batch["logp"] - logp)}
+        return loss_fn
+
+    def training_step(self) -> dict:
+        batch = self._collect()
+        metrics = self.learner_group.update(
+            batch, num_sgd_iter=self.cfg["num_sgd_iter"],
+            minibatch_size=self.cfg["minibatch_size"])
+        self._params_np = self.learner_group.get_params_numpy()
+        return metrics
+
+
+PPO._default_config = PPOConfig()
+PPOConfig.algo_class = PPO

@@ -1,0 +1,37 @@
+"""Replay buffers for off-policy algorithms.
+
+Analog of ray: rllib/utils/replay_buffers/ (EpisodeReplayBuffer /
+MultiAgentReplayBuffer) — a flat uniform-sampling transition buffer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 50_000, seed: int = 0):
+        self.capacity = capacity
+        self.rng = np.random.default_rng(seed)
+        self._storage: dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+
+    def add_batch(self, batch: dict) -> None:
+        n = len(batch["obs"])
+        if not self._storage:
+            for k in ("obs", "actions", "rewards", "dones", "next_obs"):
+                shape = (self.capacity,) + tuple(batch[k].shape[1:])
+                self._storage[k] = np.zeros(shape, batch[k].dtype)
+        for i in range(n):
+            j = self._next
+            for k, arr in self._storage.items():
+                arr[j] = batch[k][i]
+            self._next = (self._next + 1) % self.capacity
+            self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self._size, size=batch_size)
+        return {k: arr[idx] for k, arr in self._storage.items()}
+
+    def __len__(self) -> int:
+        return self._size

@@ -1,0 +1,14 @@
+"""ray_tpu.workflow: durable DAG execution with per-step checkpoints.
+
+Analog of ray: python/ray/workflow/ (workflow_executor.py drives the DAG,
+workflow_storage.py persists every step's result; api.py run/resume).
+A workflow is a ray_tpu.dag graph; each node's result is checkpointed to
+storage as it completes, so `resume` re-runs only the steps that never
+finished (ray: step checkpoint + deterministic replay).
+"""
+from ray_tpu.workflow.execution import (cancel, delete, get_output,
+                                        get_status, list_all, resume, run,
+                                        run_async)
+
+__all__ = ["run", "run_async", "resume", "get_output", "get_status",
+           "list_all", "cancel", "delete"]

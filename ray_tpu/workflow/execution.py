@@ -1,0 +1,223 @@
+"""Workflow executor + storage.
+
+Analog of ray: python/ray/workflow/workflow_executor.py (DAG drive) +
+workflow_storage.py (filesystem step store) + api.py (run/resume/status).
+
+Storage layout (one dir per workflow under the storage root):
+  <root>/<workflow_id>/meta.json              — status + dag description
+  <root>/<workflow_id>/steps/<step_key>.pkl   — pickled step results
+
+Step identity: a deterministic key from the node's position/function name,
+so resume matches completed steps without re-executing them (ray:
+workflow_storage step id scheme).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
+                                  InputAttributeNode, InputNode,
+                                  MultiOutputNode)
+
+_DEFAULT_ROOT = os.path.expanduser("~/.ray_tpu_workflows")
+
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+
+def _root(storage: str | None) -> str:
+    root = storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
+                                     _DEFAULT_ROOT)
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _wf_dir(workflow_id: str, storage: str | None) -> str:
+    d = os.path.join(_root(storage), workflow_id)
+    os.makedirs(os.path.join(d, "steps"), exist_ok=True)
+    return d
+
+
+def _write_meta(d: str, meta: dict) -> None:
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def _read_meta(d: str) -> dict:
+    try:
+        with open(os.path.join(d, "meta.json")) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {}
+
+
+def _step_key(node: DAGNode, path: str) -> str:
+    """Deterministic step id: structural path + callable name."""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._fn, "__name__", "fn")
+    elif isinstance(node, ClassMethodNode):
+        name = node._method._name
+    else:
+        name = type(node).__name__
+    return f"{name}-{hashlib.sha1(path.encode()).hexdigest()[:10]}"
+
+
+class _Execution:
+    def __init__(self, workflow_id: str, storage: str | None):
+        self.workflow_id = workflow_id
+        self.dir = _wf_dir(workflow_id, storage)
+
+    def _step_path(self, key: str) -> str:
+        return os.path.join(self.dir, "steps", f"{key}.pkl")
+
+    def load_step(self, key: str):
+        p = self._step_path(key)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                return True, pickle.load(f)
+        return False, None
+
+    def save_step(self, key: str, value: Any) -> None:
+        p = self._step_path(key)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, p)   # atomic: a crash never leaves a torn step
+
+    def execute(self, dag: DAGNode, args: tuple, kwargs: dict) -> Any:
+        """Walk the DAG; checkpoint every step result as it completes.
+        Steps found checkpointed are NOT re-run (ray: workflow replay)."""
+        # Structural paths give every node a stable step key across runs.
+        paths: dict[int, str] = {}
+
+        def assign(node: DAGNode, path: str) -> None:
+            if id(node) in paths:
+                return
+            paths[id(node)] = path
+            for i, c in enumerate(node._children()):
+                assign(c, f"{path}/{i}")
+
+        assign(dag, "root")
+        memo: dict[int, Any] = {}
+
+        def resolve(node: DAGNode):
+            if id(node) in memo:
+                return memo[id(node)]
+            if isinstance(node, (InputNode, InputAttributeNode,
+                                 MultiOutputNode)):
+                value = node._execute_impl(resolve, args, kwargs)
+            else:
+                key = _step_key(node, paths[id(node)])
+                done, value = self.load_step(key)
+                if not done:
+                    ref = node._execute_impl(resolve, args, kwargs)
+                    value = ray_tpu.get(ref) if hasattr(ref, "binary") \
+                        else ref
+                    self.save_step(key, value)
+            memo[id(node)] = value
+            return value
+
+        return resolve(dag)
+
+
+def run(dag: DAGNode, *args, workflow_id: str | None = None,
+        storage: str | None = None, **kwargs) -> Any:
+    """Execute a DAG durably; returns the final result (ray:
+    workflow.run)."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
+    ex = _Execution(workflow_id, storage)
+    meta = {"workflow_id": workflow_id, "status": RUNNING,
+            "start": time.time(),
+            "dag": None}
+    try:
+        meta["dag"] = cloudpickle.dumps((dag, args, kwargs)).hex()
+    except Exception:  # noqa: BLE001 - unpicklable dag: no resume support
+        pass
+    _write_meta(ex.dir, meta)
+    try:
+        result = ex.execute(dag, args, kwargs)
+    except Exception:
+        meta["status"] = FAILED
+        _write_meta(ex.dir, meta)
+        raise
+    meta["status"] = SUCCEEDED
+    meta["end"] = time.time()
+    ex.save_step("__output__", result)
+    _write_meta(ex.dir, meta)
+    return result
+
+
+def run_async(dag: DAGNode, *args, workflow_id: str | None = None,
+              storage: str | None = None, **kwargs):
+    """Run in a background thread; returns a concurrent Future (ray:
+    workflow.run_async returns an ObjectRef)."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    return pool.submit(run, dag, *args, workflow_id=workflow_id,
+                       storage=storage, **kwargs)
+
+
+def resume(workflow_id: str, storage: str | None = None) -> Any:
+    """Re-drive an interrupted workflow; completed steps replay from
+    checkpoints (ray: workflow.resume)."""
+    d = _wf_dir(workflow_id, storage)
+    meta = _read_meta(d)
+    if not meta:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if meta.get("status") == SUCCEEDED:
+        return get_output(workflow_id, storage=storage)
+    if not meta.get("dag"):
+        raise ValueError(f"workflow {workflow_id!r} has no stored DAG")
+    dag, args, kwargs = cloudpickle.loads(bytes.fromhex(meta["dag"]))
+    return run(dag, *args, workflow_id=workflow_id, storage=storage,
+               **kwargs)
+
+
+def get_output(workflow_id: str, storage: str | None = None) -> Any:
+    ex = _Execution(workflow_id, storage)
+    done, value = ex.load_step("__output__")
+    if not done:
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status={get_status(workflow_id, storage)})")
+    return value
+
+
+def get_status(workflow_id: str, storage: str | None = None) -> str:
+    meta = _read_meta(os.path.join(_root(storage), workflow_id))
+    return meta.get("status", "NOT_FOUND")
+
+
+def list_all(storage: str | None = None) -> list[tuple[str, str]]:
+    root = _root(storage)
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _read_meta(os.path.join(root, wid))
+        if meta:
+            out.append((wid, meta.get("status", "?")))
+    return out
+
+
+def cancel(workflow_id: str, storage: str | None = None) -> None:
+    d = os.path.join(_root(storage), workflow_id)
+    meta = _read_meta(d)
+    if meta:
+        meta["status"] = CANCELED
+        _write_meta(d, meta)
+
+
+def delete(workflow_id: str, storage: str | None = None) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(_root(storage), workflow_id),
+                  ignore_errors=True)

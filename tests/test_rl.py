@@ -1,0 +1,122 @@
+"""RL library tests: env, runners, PPO learning, DQN machinery, Tune interop.
+
+Mirrors ray: rllib/**/tests (learning tests assert reward improvement on
+CartPole with small budgets — e.g. rllib/algorithms/ppo/tests/test_ppo.py).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+    yield ray_tpu
+
+
+def test_cartpole_env_dynamics():
+    from ray_tpu.rl.env import CartPole
+
+    env = CartPole(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    done = False
+    steps = 0
+    while not done and steps < 600:
+        obs, r, term, trunc = env.step(steps % 2)
+        total += r
+        done = term or trunc
+        steps += 1
+    assert 1 <= steps <= 500
+
+
+def test_env_runner_sampling(rt):
+    import jax
+
+    from ray_tpu.rl import models
+    from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+    params = models.to_numpy(
+        models.policy_value_init(jax.random.PRNGKey(0), 4, 2, hidden=16))
+    group = EnvRunnerGroup("CartPole-v1", num_env_runners=2)
+    batches = group.sample(params, 64)
+    assert len(batches) == 2
+    for b in batches:
+        assert b["obs"].shape == (64, 4)
+        assert "advantages" in b and "value_targets" in b
+        assert abs(float(b["advantages"].mean())) < 0.2   # normalized
+    group.stop()
+
+
+def test_ppo_learns_cartpole(rt):
+    from ray_tpu.rl import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2)
+              .training(lr=1e-3, train_batch_size=1024, num_sgd_iter=6,
+                        minibatch_size=256, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    first = None
+    best = -1.0
+    for i in range(12):
+        result = algo.step()
+        ret = result["episode_return_mean"]
+        if first is None and ret == ret:
+            first = ret
+        if ret == ret:
+            best = max(best, ret)
+        if best >= 120.0:
+            break
+    algo.cleanup()
+    assert first is not None, "no episodes completed"
+    assert best >= 60.0, (
+        f"PPO failed to improve: first={first:.1f} best={best:.1f}")
+    assert best > first * 1.2 or best >= 100.0
+
+
+def test_dqn_machinery(rt):
+    from ray_tpu.rl import DQNConfig
+
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=1)
+              .training(train_batch_size=128, learning_starts=128,
+                        sgd_batch_size=32)
+              .debugging(seed=0))
+    algo = config.build()
+    for _ in range(3):
+        result = algo.step()
+    # After learning_starts, TD updates happen and epsilon decays.
+    assert "learner/td_error" in result or "learner/buffer_size" in result
+    assert algo._timesteps >= 3 * 128
+    algo.cleanup()
+
+
+def test_algorithm_checkpoint_roundtrip(rt, tmp_path):
+    from ray_tpu.rl import PPOConfig
+
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=1)
+            .training(train_batch_size=128)).build()
+    algo.step()
+    d = str(tmp_path / "ck")
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    algo.save_checkpoint(d)
+    ts = algo._timesteps
+    algo2 = (PPOConfig().environment("CartPole-v1")
+             .env_runners(num_env_runners=1)
+             .training(train_batch_size=128)).build()
+    algo2.load_checkpoint(d)
+    assert algo2._timesteps == ts
+    p1 = algo._params_np["pi"]["w0"]
+    p2 = algo2._params_np["pi"]["w0"]
+    np.testing.assert_allclose(p1, p2)
+    algo.cleanup()
+    algo2.cleanup()
