@@ -1,0 +1,15 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Analog of ray: python/ray/autoscaler/ (StandardAutoscaler
+_private/autoscaler.py:172, NodeProvider plugin iface node_provider.py,
+FakeMultiNodeProvider fake_multi_node/node_provider.py:237, and the v2
+InstanceManager state machine).
+"""
+from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,
+                                           StandardAutoscaler,
+                                           request_resources)
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider)
+
+__all__ = ["StandardAutoscaler", "AutoscalerConfig", "NodeProvider",
+           "LocalNodeProvider", "request_resources"]

@@ -1,0 +1,176 @@
+"""StandardAutoscaler: scale node count to demand.
+
+Analog of ray: python/ray/autoscaler/_private/autoscaler.py:172
+(StandardAutoscaler.update: read load → bin-pack demand onto node types →
+launch/terminate via NodeProvider) and monitor.py:126 (the head-side loop
+driving it).  Demand signals: per-node queued-lease `load` heartbeated by
+agents, plus explicit `request_resources` (ray: autoscaler sdk).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+REQUEST_KEY = "autoscaler_requested"
+
+
+@dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    idle_timeout_s: float = 30.0
+    update_interval_s: float = 1.0
+    # How long a freshly-launched node may take to register before it is
+    # counted as capacity / eligible for idle termination (ray analog:
+    # NodeLauncher pending-launch tracking in autoscaler.py).
+    startup_grace_s: float = 60.0
+    # resources of each worker node the provider launches
+    worker_node_config: dict = field(default_factory=lambda: {
+        "resources": {"CPU": 1}})
+
+
+def request_resources(num_cpus: float = 0, bundles: list | None = None,
+                      controller_addr: str | None = None) -> None:
+    """Pin a minimum demand floor (ray: autoscaler/sdk.py
+    request_resources); the autoscaler keeps enough nodes for it."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    payload = {"num_cpus": num_cpus, "bundles": bundles or []}
+    core.call(core.controller_addr, "kv_put",
+              {"ns": "autoscaler", "key": REQUEST_KEY},
+              [json.dumps(payload).encode()], timeout=10.0)
+
+
+class StandardAutoscaler:
+    """Head-side loop scaling a NodeProvider (ray: autoscaler.py:172).
+
+    Runs in the driver (or a dedicated monitor process) with direct RPC
+    access to the controller.
+    """
+
+    def __init__(self, provider, config: AutoscalerConfig | None = None,
+                 controller_addr: str | None = None):
+        from ray_tpu._private.worker import global_worker
+
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self.core = global_worker()
+        self.controller_addr = controller_addr or self.core.controller_addr
+        self._idle_since: dict[str, float] = {}
+        self._launched_at: dict[str, float] = {}
+        self._provider_nodes: list[str] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- control
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.config.update_interval_s)
+
+    # -------------------------------------------------------------- policy
+    def _cluster_state(self) -> tuple[list[dict], dict]:
+        reply, _ = self.core.call(self.controller_addr, "list_nodes",
+                                  timeout=30.0)
+        nodes = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
+        try:
+            r, blobs = self.core.call(
+                self.controller_addr, "kv_get",
+                {"ns": "autoscaler", "key": REQUEST_KEY}, timeout=10.0)
+            requested = json.loads(bytes(blobs[0])) if blobs else {}
+        except Exception:  # noqa: BLE001
+            requested = {}
+        return nodes, requested
+
+    def update(self) -> None:
+        """One reconcile step (ray: StandardAutoscaler.update)."""
+        nodes, requested = self._cluster_state()
+        self._provider_nodes = self.provider.non_terminated_nodes()
+        n_workers = len(self._provider_nodes)
+        now = time.monotonic()
+        for pid in list(self._launched_at):
+            if pid not in self._provider_nodes:
+                self._launched_at.pop(pid, None)
+
+        # Nodes launched but (probably) not yet registered with the
+        # controller count as pending capacity, so one unmet demand signal
+        # doesn't launch a new node every tick while the first boots.
+        n_alive_workers = max(0, len(nodes) - 1)   # minus the head node
+        pending = [pid for pid in self._provider_nodes
+                   if now - self._launched_at.get(pid, 0.0)
+                   < self.config.startup_grace_s]
+        n_pending = max(0, min(len(pending), n_workers - n_alive_workers))
+
+        # ---- scale up: queued demand or an explicit resource request
+        queued = sum(n.get("load", 0) for n in nodes)
+        node_cpu = self.config.worker_node_config["resources"].get("CPU", 1)
+        total_cpu = sum(n["resources"].get("CPU", 0) for n in nodes) \
+            + n_pending * node_cpu
+        want_cpu = requested.get("num_cpus", 0) + sum(
+            b.get("CPU", 0) for b in requested.get("bundles", []))
+        need = 0
+        if queued > 0:
+            need = max(need, -(-queued // max(1, int(node_cpu))) - n_pending)
+        if want_cpu > total_cpu:
+            need = max(need, -(-int(want_cpu - total_cpu) // int(node_cpu)))
+        can_add = self.config.max_workers - n_workers
+        if need > 0 and can_add > 0:
+            count = min(need, can_add)
+            logger.info("scaling up %d worker node(s) (queued=%s)",
+                        count, queued)
+            for pid in self.provider.create_node(
+                    self.config.worker_node_config, count) or []:
+                self._launched_at[pid] = now
+            return   # let them register before judging idleness
+
+        # ---- scale down: fully-idle nodes past the idle timeout
+        if n_workers <= self.config.min_workers:
+            return
+        # Per-node idleness via the provider's node-id mapping (ray:
+        # provider node tags); a provider node with no mapping yet is
+        # still booting — never "idle" inside the startup grace, and
+        # judged by whole-cluster idleness after it (conservative).
+        by_id = {n["node_id"]: n for n in nodes}
+        cluster_idle = queued == 0 and all(
+            n["available"] == n["resources"] for n in nodes)
+        for pid in list(self._provider_nodes):
+            nid = self.provider.node_id(pid) \
+                if hasattr(self.provider, "node_id") else None
+            cnode = by_id.get(nid) if nid else None
+            if cnode is not None:
+                node_idle = (cnode.get("load", 0) == 0
+                             and cnode["available"] == cnode["resources"])
+            else:
+                if now - self._launched_at.get(pid, 0.0) \
+                        < self.config.startup_grace_s:
+                    continue   # booting
+                node_idle = cluster_idle
+            if not node_idle:
+                self._idle_since.pop(pid, None)
+                continue
+            first = self._idle_since.setdefault(pid, now)
+            if now - first >= self.config.idle_timeout_s and \
+                    len(self.provider.non_terminated_nodes()) > \
+                    self.config.min_workers:
+                logger.info("terminating idle node %s", pid)
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)

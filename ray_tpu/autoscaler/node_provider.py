@@ -1,0 +1,105 @@
+"""NodeProvider: the cloud-plugin interface + a local-process provider.
+
+Analog of ray: python/ray/autoscaler/node_provider.py (NodeProvider iface:
+create_node / terminate_node / non_terminated_nodes) and
+_private/fake_multi_node/node_provider.py:237 (FakeMultiNodeProvider —
+"nodes" are local processes, which is exactly the right shape here: one
+node_agent process per simulated host).  A GCE/GKE TPU provider implements
+the same interface with TPU-VM create/delete calls.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any
+
+
+class NodeProvider:
+    """Plugin interface (ray: node_provider.py)."""
+
+    def create_node(self, node_config: dict, count: int = 1) -> list[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+    def is_running(self, provider_node_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Nodes = node_agent subprocesses joined to a running controller
+    (the FakeMultiNodeProvider analog; doubles as a single-host
+    multi-agent scale-out)."""
+
+    def __init__(self, controller_addr: str, config_json: str | None = None):
+        self.controller_addr = controller_addr
+        self.config_json = config_json
+        self.nodes: dict[str, dict[str, Any]] = {}
+
+    def create_node(self, node_config: dict, count: int = 1) -> list[str]:
+        from ray_tpu._private.config import Config
+
+        created = []
+        for _ in range(count):
+            pid = f"local-{uuid.uuid4().hex[:8]}"
+            args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+                    "--controller", self.controller_addr,
+                    "--config-json",
+                    self.config_json or Config().to_json(),
+                    "--resources-json",
+                    json.dumps(node_config.get("resources", {"CPU": 1}))]
+            proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                                    stderr=subprocess.DEVNULL)
+            rec = {"proc": proc, "created": time.time(), "node_id": None}
+            self.nodes[pid] = rec
+            # The agent prints one JSON line with its cluster node_id on
+            # startup — capture it so the autoscaler can map provider
+            # nodes to cluster nodes (ray: provider node tags).
+            threading.Thread(target=self._read_node_id, args=(rec,),
+                             daemon=True).start()
+            created.append(pid)
+        return created
+
+    @staticmethod
+    def _read_node_id(rec: dict) -> None:
+        try:
+            for line in rec["proc"].stdout:
+                line = line.strip()
+                if line.startswith(b"{"):
+                    rec["node_id"] = json.loads(line).get("node_id")
+                    break
+        except Exception:  # noqa: BLE001
+            pass
+
+    def node_id(self, provider_node_id: str) -> str | None:
+        """Cluster node_id of a provider node, once registered."""
+        rec = self.nodes.get(provider_node_id)
+        return rec.get("node_id") if rec else None
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        rec = self.nodes.pop(provider_node_id, None)
+        if rec is None:
+            return
+        proc = rec["proc"]
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> list[str]:
+        return [pid for pid, rec in self.nodes.items()
+                if rec["proc"].poll() is None]
+
+    def is_running(self, provider_node_id: str) -> bool:
+        rec = self.nodes.get(provider_node_id)
+        return rec is not None and rec["proc"].poll() is None

@@ -1,0 +1,249 @@
+"""Job submission: run driver entrypoints on the cluster, track status/logs.
+
+Analog of ray: python/ray/dashboard/modules/job/ (JobManager
+job_manager.py:57, job_supervisor.py driving `ray job submit` entrypoints,
+SDK sdk.py JobSubmissionClient).  REST transport collapses to actor calls:
+a detached `_JobManager` actor owns a `_JobSupervisor` actor per job, which
+runs the entrypoint as a subprocess with RAY_TPU_ADDRESS exported so the
+child driver attaches to this cluster.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import ray_tpu
+
+JOB_MANAGER_NAME = "_JOB_MANAGER"
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = PENDING
+    start_time: float = 0.0
+    end_time: float = 0.0
+    return_code: int | None = None
+    metadata: dict = field(default_factory=dict)
+
+
+class _JobSupervisor:
+    """One per job: runs the entrypoint subprocess and captures output
+    (ray: job_supervisor.py)."""
+
+    def __init__(self, job_id: str, entrypoint: str, controller_addr: str,
+                 env: dict | None = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.status = PENDING
+        self.return_code: int | None = None
+        self.log = ""
+        self._proc: subprocess.Popen | None = None
+        self._thread = threading.Thread(
+            target=self._run, args=(controller_addr, env or {}), daemon=True)
+        self._thread.start()
+
+    def _run(self, controller_addr: str, extra_env: dict) -> None:
+        self.status = RUNNING
+        env = {**os.environ, **extra_env,
+               "RAY_TPU_ADDRESS": controller_addr}
+        try:
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            out, _ = self._proc.communicate()
+            self.log = out or ""
+            self.return_code = self._proc.returncode
+            if self.status != STOPPED:
+                self.status = SUCCEEDED if self._proc.returncode == 0 \
+                    else FAILED
+        except Exception as e:  # noqa: BLE001
+            self.log += f"\nsupervisor error: {e}"
+            self.status = FAILED
+
+    def get_status(self) -> dict:
+        return {"status": self.status, "return_code": self.return_code}
+
+    def get_logs(self) -> str:
+        return self.log
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self.status = STOPPED
+            self._proc.terminate()
+            return True
+        return False
+
+
+class _JobManager:
+    """Detached registry actor (ray: job_manager.py:57 JobManager)."""
+
+    def __init__(self):
+        self.jobs: dict[str, JobInfo] = {}
+        self.supervisors: dict[str, object] = {}
+
+    def submit(self, entrypoint: str, job_id: str | None,
+               metadata: dict | None, env: dict | None,
+               controller_addr: str) -> str:
+        job_id = job_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        if job_id in self.jobs:
+            raise ValueError(f"job {job_id!r} already exists")
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint,
+                       start_time=time.time(), status=RUNNING,
+                       metadata=metadata or {})
+        # num_cpus=0: the supervisor mostly sleeps in communicate(); it
+        # must not hold scheduling capacity after the job finishes (the
+        # entrypoint subprocess carries the real work).
+        sup = ray_tpu.remote(_JobSupervisor).options(
+            num_cpus=0, max_concurrency=4).remote(
+            job_id, entrypoint, controller_addr, env)
+        self.jobs[job_id] = info
+        self.supervisors[job_id] = sup
+        return job_id
+
+    def status(self, job_id: str) -> dict:
+        info = self._info(job_id)
+        sup = self.supervisors.get(job_id)
+        if sup is not None and info.status in (PENDING, RUNNING):
+            st = ray_tpu.get(sup.get_status.remote(), timeout=30.0)
+            info.status = st["status"]
+            info.return_code = st["return_code"]
+            if info.status in (SUCCEEDED, FAILED, STOPPED) \
+                    and not info.end_time:
+                info.end_time = time.time()
+        return vars(info)
+
+    def logs(self, job_id: str) -> str:
+        sup = self.supervisors.get(job_id)
+        if sup is None:
+            return ""
+        return ray_tpu.get(sup.get_logs.remote(), timeout=30.0)
+
+    def stop(self, job_id: str) -> bool:
+        sup = self.supervisors.get(job_id)
+        if sup is None:
+            return False
+        stopped = ray_tpu.get(sup.stop.remote(), timeout=30.0)
+        if stopped:
+            self.jobs[job_id].status = STOPPED
+        return stopped
+
+    def list(self) -> list[dict]:
+        return [self.status(j) for j in list(self.jobs)]
+
+    def _info(self, job_id: str) -> JobInfo:
+        if job_id not in self.jobs:
+            raise ValueError(f"no job {job_id!r}")
+        return self.jobs[job_id]
+
+
+class _HttpTransport:
+    """REST transport against a dashboard (ray: sdk.py's aiohttp calls).
+    Selected when the client address is http(s)://."""
+
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+
+    def _req(self, method: str, path: str, body: dict | None = None):
+        import json as _json
+        import urllib.request
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return _json.loads(resp.read().decode())
+
+    def submit(self, entrypoint, job_id, metadata, runtime_env):
+        return self._req("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "job_id": job_id,
+            "metadata": metadata, "runtime_env": runtime_env})["job_id"]
+
+    def info(self, job_id):
+        return self._req("GET", f"/api/jobs/{job_id}")
+
+    def logs(self, job_id):
+        return self._req("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def stop(self, job_id):
+        return self._req("POST", f"/api/jobs/{job_id}/stop")["stopped"]
+
+    def list(self):
+        return self._req("GET", "/api/jobs/")
+
+
+class JobSubmissionClient:
+    """ray: dashboard/modules/job/sdk.py JobSubmissionClient — same verbs.
+    address=None / "auto": direct actor transport on the connected
+    cluster; address="http://host:8265": REST against the dashboard
+    (the reference's only transport)."""
+
+    def __init__(self, address: str | None = None):
+        self._http: _HttpTransport | None = None
+        if address and address.startswith(("http://", "https://")):
+            self._http = _HttpTransport(address)
+            return
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        self._mgr = ray_tpu.remote(_JobManager).options(
+            name=JOB_MANAGER_NAME, get_if_exists=True, lifetime="detached",
+            max_concurrency=16, num_cpus=0).remote()
+
+    def submit_job(self, *, entrypoint: str, job_id: str | None = None,
+                   metadata: dict | None = None,
+                   runtime_env: dict | None = None) -> str:
+        if self._http:
+            return self._http.submit(entrypoint, job_id, metadata,
+                                     runtime_env)
+        from ray_tpu._private.worker import global_worker
+
+        env = dict((runtime_env or {}).get("env_vars") or {})
+        return ray_tpu.get(self._mgr.submit.remote(
+            entrypoint, job_id, metadata, env,
+            global_worker().controller_addr), timeout=60.0)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        if self._http:
+            return self._http.info(job_id)
+        return ray_tpu.get(self._mgr.status.remote(job_id), timeout=30.0)
+
+    def get_job_logs(self, job_id: str) -> str:
+        if self._http:
+            return self._http.logs(job_id)
+        return ray_tpu.get(self._mgr.logs.remote(job_id), timeout=30.0)
+
+    def stop_job(self, job_id: str) -> bool:
+        if self._http:
+            return self._http.stop(job_id)
+        return ray_tpu.get(self._mgr.stop.remote(job_id), timeout=30.0)
+
+    def list_jobs(self) -> list[dict]:
+        if self._http:
+            return self._http.list()
+        return ray_tpu.get(self._mgr.list.remote(), timeout=60.0)
+
+    def wait_until_finished(self, job_id: str,
+                            timeout_s: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in (SUCCEEDED, FAILED, STOPPED):
+                return st
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still {st} after {timeout_s}s")

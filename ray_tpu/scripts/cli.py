@@ -1,0 +1,251 @@
+"""ray-tpu CLI: start/stop/status/list/timeline/submit.
+
+Analog of ray: python/ray/scripts/scripts.py (ray start/stop/status/
+memory/timeline/… 2619 LoC; command registry at the bottom).  Invoke as
+`python -m ray_tpu.scripts.cli <command>`.
+
+Head state lives in /tmp/ray_tpu_head.json so `stop`/`status`/drivers on
+the same box can find the cluster (ray: /tmp/ray/ray_current_cluster).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HEAD_STATE = "/tmp/ray_tpu_head.json"
+
+
+def _read_state() -> dict:
+    try:
+        with open(HEAD_STATE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def _require_address(args) -> str:
+    addr = getattr(args, "address", None) or \
+        os.environ.get("RAY_TPU_ADDRESS") or _read_state().get("address")
+    if not addr:
+        sys.exit("no cluster: run `ray-tpu start --head` or pass --address")
+    return addr
+
+
+def cmd_start(args) -> None:
+    """ray: `ray start --head` / `ray start --address=...`."""
+    from ray_tpu._private.config import Config
+
+    config = Config()
+    if args.head:
+        from ray_tpu.api import _read_json_line
+
+        # start_new_session + RAY_TPU_DAEMONIZE: the head must outlive this
+        # CLI process — `ray-tpu stop` kills it by pidfile.
+        denv = {**os.environ, "RAY_TPU_DAEMONIZE": "1"}
+        cprocs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.controller",
+             "--config-json", config.to_json()], stdout=subprocess.PIPE,
+            start_new_session=True, env=denv)
+        cinfo = _read_json_line(cprocs)
+        controller_addr = cinfo["controller_addr"]
+        agent_args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+                      "--controller", controller_addr,
+                      "--config-json", config.to_json()]
+        if args.resources:
+            agent_args += ["--resources-json", args.resources]
+        aprocs = subprocess.Popen(agent_args, stdout=subprocess.PIPE,
+                                  start_new_session=True, env=denv)
+        ainfo = _read_json_line(aprocs)
+        with open(HEAD_STATE, "w") as f:
+            json.dump({"address": controller_addr,
+                       "pids": [cprocs.pid, aprocs.pid],
+                       "node_id": ainfo["node_id"]}, f)
+        print(f"started head: controller at {controller_addr}")
+        print(f"attach drivers with ray_tpu.init(address="
+              f"{controller_addr!r}) or RAY_TPU_ADDRESS={controller_addr}")
+    else:
+        addr = args.address or _require_address(args)
+        agent_args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+                      "--controller", addr,
+                      "--config-json", config.to_json()]
+        if args.resources:
+            agent_args += ["--resources-json", args.resources]
+        from ray_tpu.api import _read_json_line
+
+        proc = subprocess.Popen(
+            agent_args, stdout=subprocess.PIPE, start_new_session=True,
+            env={**os.environ, "RAY_TPU_DAEMONIZE": "1"})
+        info = _read_json_line(proc)
+        st = _read_state()
+        st.setdefault("pids", []).append(proc.pid)
+        with open(HEAD_STATE, "w") as f:
+            json.dump(st, f)
+        print(f"joined {addr} as node {info['node_id'][:12]}")
+
+
+def cmd_stop(_args) -> None:
+    """ray: `ray stop`."""
+    st = _read_state()
+    n = 0
+    for pid in st.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            n += 1
+        except ProcessLookupError:
+            pass
+    time.sleep(0.5)
+    for pid in st.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    try:
+        os.unlink(HEAD_STATE)
+    except FileNotFoundError:
+        pass
+    print(f"stopped {n} head processes")
+
+
+def _attach(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_require_address(args))
+    return ray_tpu
+
+
+def cmd_status(args) -> None:
+    """ray: `ray status` — node/resource overview."""
+    rt = _attach(args)
+    nodes = rt.nodes()
+    print(f"{len(nodes)} node(s)")
+    for n in nodes:
+        print(f"  {n['node_id'][:12]} {n['state']:6} "
+              f"resources={n['resources']} available={n['available']}")
+
+
+def cmd_list(args) -> None:
+    """ray: `ray list actors|nodes|tasks|placement-groups|jobs`."""
+    _attach(args)
+    from ray_tpu.utils import state
+
+    kind = args.kind.replace("-", "_")
+    fn = {"actors": state.list_actors, "nodes": state.list_nodes,
+          "tasks": state.list_tasks,
+          "placement_groups": state.list_placement_groups,
+          "jobs": state.list_jobs}.get(kind)
+    if fn is None:
+        sys.exit(f"unknown kind {args.kind!r}")
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    """ray: `ray summary tasks|actors`."""
+    _attach(args)
+    from ray_tpu.utils import state
+
+    fn = {"tasks": state.summarize_tasks,
+          "actors": state.summarize_actors}.get(args.kind)
+    if fn is None:
+        sys.exit(f"unknown kind {args.kind!r}")
+    print(json.dumps(fn(), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    """ray: `ray timeline` — Chrome trace JSON from task events."""
+    rt = _attach(args)
+    events = rt.timeline()
+    trace = []
+    for ev in events:
+        trace.append({"name": ev.get("name") or ev.get("state", "?"),
+                      "ph": "i",
+                      "ts": ev.get("ts", 0) * 1e6,
+                      "pid": ev.get("worker_id", "")[:8],
+                      "tid": ev.get("task_id", "")[:8],
+                      "args": ev})
+    out = args.out or "ray-tpu-timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out}")
+
+
+def cmd_job(args) -> None:
+    """ray: `ray job submit/status/logs/stop/list`."""
+    os.environ.setdefault("RAY_TPU_ADDRESS", _require_address(args))
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        import shlex
+
+        parts = ([args.job_id] if args.job_id else []) + args.entrypoint
+        jid = client.submit_job(entrypoint=shlex.join(parts))
+        print(jid)
+        if args.wait:
+            print(client.wait_until_finished(jid))
+            print(client.get_job_logs(jid))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start head or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("--resources", help='JSON, e.g. \'{"CPU": 8}\'')
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop local head processes")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in [("status", cmd_status)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list")
+    sp.add_argument("kind")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary")
+    sp.add_argument("kind")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline")
+    sp.add_argument("--out")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "job", usage="ray-tpu job submit [--wait] -- <entrypoint...> | "
+                     "ray-tpu job status|logs|stop <job_id> | "
+                     "ray-tpu job list")
+    sp.add_argument("job_cmd",
+                    choices=["submit", "status", "logs", "stop", "list"])
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("job_id", nargs="?")
+    sp.add_argument("entrypoint", nargs="*")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+Analog of ray: python/ray/util/metrics.py (Counter/Gauge/Histogram over the
+C++ OpenCensus registry, src/ray/stats/metric_defs.cc).  Metrics are
+buffered per process and flushed to the controller KV periodically; the
+state API / dashboard reads the aggregated snapshot (the per-node
+Prometheus-agent export of the reference, python/ray/_private/
+metrics_agent.py, collapses to the controller here).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "Metric"] = {}
+_flusher: threading.Thread | None = None
+FLUSH_PERIOD_S = 2.0
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] | None = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict[str, str] = {}
+        # (tag tuple) -> value
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown}; declared "
+                             f"{self.tag_keys}")
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name, "description": self.description,
+                "type": type(self).__name__.lower(),
+                "tag_keys": list(self.tag_keys),
+                "values": [
+                    {"tags": dict(zip(self.tag_keys, k)), "value": v}
+                    for k, v in self._values.items()],
+            }
+
+
+class Counter(Metric):
+    """Monotonic counter (ray: util/metrics.py Counter)."""
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    """Last-value gauge (ray: util/metrics.py Gauge)."""
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Bucketed histogram (ray: util/metrics.py Histogram)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] | None = None,
+                 tag_keys: Sequence[str] | None = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1.0, 10.0, 100.0])
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._values[k] = self._sums[k]   # snapshot shows the sum
+
+    def snapshot(self) -> dict:
+        base = super().snapshot()
+        with self._lock:
+            base["boundaries"] = self.boundaries
+            base["counts"] = [
+                {"tags": dict(zip(self.tag_keys, k)), "counts": c}
+                for k, c in self._counts.items()]
+        return base
+
+
+def _ensure_flusher() -> None:
+    """Push local metric snapshots to the controller KV (the metrics-agent
+    export path, collapsed)."""
+    global _flusher
+    with _registry_lock:
+        if _flusher is not None:
+            return
+        _flusher = threading.Thread(target=_flush_loop, daemon=True,
+                                    name="metrics-flush")
+        _flusher.start()
+
+
+def _flush_loop() -> None:
+    import json
+
+    while True:
+        time.sleep(FLUSH_PERIOD_S)
+        try:
+            from ray_tpu._private.worker import _global_worker
+
+            core = _global_worker
+            if core is None or core._shutdown.is_set():
+                continue
+            with _registry_lock:
+                snaps = [m.snapshot() for m in _registry.values()]
+            if not snaps:
+                continue
+            core.call(core.controller_addr, "kv_put",
+                      {"ns": "metrics", "key": core.worker_id},
+                      [json.dumps({"ts": time.time(),
+                                   "metrics": snaps}).encode()],
+                      timeout=10.0)
+        except Exception:  # noqa: BLE001 - metrics must never crash work
+            pass

@@ -1,0 +1,116 @@
+"""State API: programmatic cluster observability.
+
+Analog of ray: python/ray/util/state/api.py (StateApiClient:110,
+list_actors:781, summarize_tasks:1365) — list/get/summarize entities from
+the controller (the GCS analog).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def _core():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker()
+
+
+def list_nodes() -> list[dict]:
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "list_nodes", timeout=30.0)
+    return reply["nodes"]
+
+
+def list_actors(filters: list[tuple] | None = None) -> list[dict]:
+    """ray: util/state/api.py list_actors (filters like
+    [("state", "=", "ALIVE")])."""
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "list_actors", timeout=30.0)
+    actors = reply["actors"]
+    for f in filters or ():
+        key, op, val = f
+        if op == "=":
+            actors = [a for a in actors if a.get(key) == val]
+        elif op == "!=":
+            actors = [a for a in actors if a.get(key) != val]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return actors
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Task state-transition events (ray: list_tasks over
+    GcsTaskManager's buffer)."""
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "get_task_events",
+                         timeout=30.0)
+    return reply["events"][-limit:]
+
+
+def list_placement_groups() -> list[dict]:
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "list_pgs", timeout=30.0)
+    return reply["pgs"]
+
+
+def list_jobs() -> list[dict]:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    return JobSubmissionClient().list_jobs()
+
+
+def summarize_tasks() -> dict:
+    """Counts by (function, state) (ray: summarize_tasks api.py:1365)."""
+    latest: dict[str, dict] = {}
+    for ev in list_tasks(limit=100_000):
+        latest[ev["task_id"]] = ev
+    summary: dict[str, dict[str, int]] = {}
+    for ev in latest.values():
+        fn = ev.get("name") or ev.get("function", "?")
+        state = ev.get("state", "?")
+        summary.setdefault(fn, {})
+        summary[fn][state] = summary[fn].get(state, 0) + 1
+    return {"cluster": {"summary": summary,
+                        "total_tasks": len(latest)}}
+
+
+def summarize_actors() -> dict:
+    summary: dict[str, int] = {}
+    for a in list_actors():
+        summary[a["state"]] = summary.get(a["state"], 0) + 1
+    return {"cluster": {"summary_by_state": summary}}
+
+
+def list_metrics() -> list[dict]:
+    """Aggregated application metrics from every worker's last flush
+    (ray: per-node Prometheus endpoints; see ray_tpu.utils.metrics)."""
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "kv_keys",
+                         {"ns": "metrics"}, timeout=30.0)
+    out = []
+    for key in reply.get("keys", []):
+        r, blobs = core.call(core.controller_addr, "kv_get",
+                             {"ns": "metrics", "key": key}, timeout=30.0)
+        if blobs:
+            snap = json.loads(bytes(blobs[0]))
+            snap["worker_id"] = key
+            out.append(snap)
+    return out
+
+
+def get_actor(actor_id: str) -> dict | None:
+    for a in list_actors():
+        if a["actor_id"] == actor_id:
+            return a
+    return None
+
+
+def get_log(job_id: str | None = None, tail: int = 100) -> str:
+    """Job driver logs (ray: get_log / ray logs)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    if job_id is None:
+        raise ValueError("job_id required")
+    logs = JobSubmissionClient().get_job_logs(job_id)
+    return "\n".join(logs.splitlines()[-tail:])
