@@ -1,0 +1,10 @@
+"""Dashboard: HTTP observability + job REST API for the cluster.
+
+Analog of ray: python/ray/dashboard/ (DashboardHead head.py:79, per-module
+aiohttp handlers under dashboard/modules/).  The React frontend is replaced
+by a minimal HTML index; the REST surface mirrors the reference's routes so
+tooling built against them ports over.
+"""
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,343 @@
+"""DashboardHead: aiohttp server exposing cluster state over HTTP.
+
+Analog of ray: python/ray/dashboard/head.py:79 (DashboardHead) with the
+per-module route handlers of python/ray/dashboard/modules/{node,actor,job,
+metrics,state,healthz}.  Runs in-process (thread + private event loop) on
+the head node; `ray-tpu start --head` and `ray_tpu.init(dashboard=True)`
+launch it.
+
+Routes (reference parity):
+  GET  /api/version                   version + session info
+  GET  /api/cluster_status            autoscaler-style cluster summary
+  GET  /nodes  /api/v0/nodes          node table
+  GET  /api/v0/actors                 actor table
+  GET  /api/v0/tasks                  task events
+  GET  /api/v0/tasks/summarize        counts by (function, state)
+  GET  /api/v0/placement_groups       placement groups
+  GET  /api/v0/objects                owner-side object stats
+  GET  /api/jobs/                     job list            (ray jobs REST)
+  POST /api/jobs/                     submit a job
+  GET  /api/jobs/{id}                 job status
+  POST /api/jobs/{id}/stop            stop a job
+  GET  /api/jobs/{id}/logs            job logs
+  GET  /metrics                       Prometheus text exposition
+  GET  /api/v0/timeline               Chrome trace JSON
+  GET  /api/healthz  /api/gcs_healthz liveness
+  GET  /                              minimal HTML summary
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_PORT = 8265          # same default as the reference dashboard
+
+
+def _json(data, status: int = 200):
+    from aiohttp import web
+
+    return web.Response(text=json.dumps(data), status=status,
+                        content_type="application/json")
+
+
+class DashboardHead:
+    """HTTP head service over the controller's state (ray: head.py:79)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = _DEFAULT_PORT):
+        self.host = host
+        self.port = port
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._runner = None
+        self.url = f"http://{host}:{port}"
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DashboardHead":
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dashboard-head")
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+
+        async def _close():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            loop.stop()
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), loop)
+            self._thread.join(timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        self._add_routes(app)
+
+        async def _up():
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            # Port 0 → bound port discovery for tests.
+            for s in self._runner.sites:
+                srv = getattr(s, "_server", None)
+                if srv and srv.sockets:
+                    self.port = srv.sockets[0].getsockname()[1]
+            self.url = f"http://{self.host}:{self.port}"
+            self._started.set()
+        loop.run_until_complete(_up())
+        loop.run_forever()
+
+    # -------------------------------------------------------------- routes
+    def _add_routes(self, app) -> None:
+        from aiohttp import web
+
+        r = app.router
+        r.add_get("/", self._index)
+        r.add_get("/api/version", self._version)
+        r.add_get("/api/healthz", self._healthz)
+        r.add_get("/api/gcs_healthz", self._healthz)
+        r.add_get("/api/cluster_status", self._cluster_status)
+        r.add_get("/nodes", self._nodes)
+        r.add_get("/api/v0/nodes", self._nodes)
+        r.add_get("/api/v0/actors", self._actors)
+        r.add_get("/api/v0/tasks", self._tasks)
+        r.add_get("/api/v0/tasks/summarize", self._tasks_summarize)
+        r.add_get("/api/v0/placement_groups", self._pgs)
+        r.add_get("/api/v0/objects", self._objects)
+        r.add_get("/api/v0/timeline", self._timeline)
+        r.add_get("/metrics", self._metrics)
+        r.add_get("/api/jobs/", self._jobs_list)
+        r.add_post("/api/jobs/", self._jobs_submit)
+        r.add_get("/api/jobs/{job_id}", self._jobs_get)
+        r.add_post("/api/jobs/{job_id}/stop", self._jobs_stop)
+        r.add_get("/api/jobs/{job_id}/logs", self._jobs_logs)
+        _ = web  # imported for side effects above
+
+    # Handlers call the (blocking, thread-safe) state API off this loop.
+    async def _call(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    async def _index(self, _req):
+        from aiohttp import web
+
+        from ray_tpu.utils import state
+
+        nodes = await self._call(state.list_nodes)
+        actors = await self._call(state.list_actors)
+        alive = [n for n in nodes if n["state"] == "ALIVE"]
+        rows = "".join(
+            f"<tr><td>{n['node_id'][:12]}</td><td>{n['state']}</td>"
+            f"<td>{n.get('agent_addr', '')}</td>"
+            f"<td>{json.dumps(n.get('resources', {}))}</td></tr>"
+            for n in nodes)
+        html = (
+            "<html><head><title>ray-tpu dashboard</title></head><body>"
+            f"<h1>ray-tpu</h1><p>{len(alive)} alive node(s), "
+            f"{len([a for a in actors if a['state'] == 'ALIVE'])} alive "
+            "actor(s)</p>"
+            "<table border=1><tr><th>node</th><th>state</th><th>agent</th>"
+            f"<th>resources</th></tr>{rows}</table>"
+            "<p>REST: /api/v0/nodes /api/v0/actors /api/v0/tasks "
+            "/api/jobs/ /metrics /api/v0/timeline</p></body></html>")
+        return web.Response(text=html, content_type="text/html")
+
+    async def _version(self, _req):
+        import ray_tpu
+
+        return _json({"version": getattr(ray_tpu, "__version__", "0.1.0"),
+                      "ray_version": getattr(ray_tpu, "__version__",
+                                             "0.1.0"),
+                      "session_name": "ray-tpu"})
+
+    async def _healthz(self, _req):
+        from aiohttp import web
+
+        try:
+            from ray_tpu.utils import state
+
+            await self._call(state.list_nodes)
+            return web.Response(text="success")
+        except Exception as e:  # noqa: BLE001
+            return web.Response(text=f"unhealthy: {e}", status=503)
+
+    async def _cluster_status(self, _req):
+        import ray_tpu
+
+        nodes = await self._call(ray_tpu.nodes)
+        total = await self._call(ray_tpu.cluster_resources)
+        avail = await self._call(ray_tpu.available_resources)
+        return _json({
+            "data": {
+                "clusterStatus": {
+                    "loadMetricsReport": {
+                        "usage": {
+                            k: [total.get(k, 0) - avail.get(k, 0),
+                                total.get(k, 0)] for k in total},
+                    },
+                    "aliveNodes": len([n for n in nodes
+                                       if n["state"] == "ALIVE"]),
+                }}})
+
+    async def _nodes(self, _req):
+        from ray_tpu.utils import state
+
+        return _json({"result": True,
+                      "data": {"nodes": await self._call(state.list_nodes)}})
+
+    async def _actors(self, _req):
+        from ray_tpu.utils import state
+
+        return _json({"result": await self._call(state.list_actors)})
+
+    async def _tasks(self, req):
+        from ray_tpu.utils import state
+
+        limit = int(req.query.get("limit", "1000"))
+        return _json({"result": await self._call(state.list_tasks, limit)})
+
+    async def _tasks_summarize(self, _req):
+        from ray_tpu.utils import state
+
+        return _json({"result": await self._call(state.summarize_tasks)})
+
+    async def _pgs(self, _req):
+        from ray_tpu.utils import state
+
+        return _json({"result":
+                      await self._call(state.list_placement_groups)})
+
+    async def _objects(self, _req):
+        def _stats():
+            from ray_tpu._private.worker import global_worker
+
+            core = global_worker()
+            return {"num_owned_objects": len(core.owned),
+                    "num_borrowed": len(core.borrows),
+                    "memory_store_entries": len(core.memory)}
+        return _json({"result": await self._call(_stats)})
+
+    async def _timeline(self, _req):
+        import ray_tpu
+
+        events = await self._call(ray_tpu.timeline)
+        return _json(events)
+
+    async def _metrics(self, _req):
+        """Prometheus text exposition (ray: per-node metrics agent +
+        metric_defs.cc; here one endpoint aggregating worker flushes)."""
+        from aiohttp import web
+
+        from ray_tpu.utils import state
+
+        lines: list[str] = []
+        try:
+            snaps = await self._call(state.list_metrics)
+        except Exception:  # noqa: BLE001
+            snaps = []
+        for snap in snaps:
+            wid = str(snap.get("worker_id", "?"))[:12]
+            for m in snap.get("metrics", []):
+                name = "ray_tpu_" + m.get("name", "unnamed")
+                mtype = m.get("type", "gauge")
+                lines.append(f"# TYPE {name} "
+                             f"{'counter' if mtype == 'counter' else 'gauge'}")
+                for v in m.get("values", ()):
+                    tags = {**v.get("tags", {}), "worker": wid}
+                    tag_s = ",".join(f'{k}="{tv}"' for k, tv in
+                                     sorted(tags.items()))
+                    lines.append(f"{name}{{{tag_s}}} {v.get('value', 0)}")
+        # Always-on cluster gauges.
+        try:
+            from ray_tpu.utils import state as st
+
+            nodes = await self._call(st.list_nodes)
+            alive = len([n for n in nodes if n["state"] == "ALIVE"])
+            lines.append(f"ray_tpu_cluster_alive_nodes {alive}")
+        except Exception:  # noqa: BLE001
+            pass
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    # ------------------------------------------------------------ jobs REST
+    async def _jobs_list(self, _req):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        jobs = await self._call(lambda: JobSubmissionClient().list_jobs())
+        return _json(jobs)
+
+    async def _jobs_submit(self, req):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        body = await req.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            return _json({"error": "entrypoint required"}, status=400)
+
+        def _submit():
+            cli = JobSubmissionClient()
+            return cli.submit_job(
+                entrypoint=entrypoint,
+                job_id=body.get("job_id") or body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"))
+        try:
+            job_id = await self._call(_submit)
+        except Exception as e:  # noqa: BLE001
+            return _json({"error": str(e)}, status=500)
+        return _json({"job_id": job_id, "submission_id": job_id})
+
+    async def _jobs_get(self, req):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        jid = req.match_info["job_id"]
+        try:
+            info = await self._call(
+                lambda: JobSubmissionClient().get_job_info(jid))
+        except Exception as e:  # noqa: BLE001
+            return _json({"error": str(e)}, status=404)
+        return _json(info)
+
+    async def _jobs_stop(self, req):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        jid = req.match_info["job_id"]
+        stopped = await self._call(
+            lambda: JobSubmissionClient().stop_job(jid))
+        return _json({"stopped": bool(stopped)})
+
+    async def _jobs_logs(self, req):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        jid = req.match_info["job_id"]
+        try:
+            logs = await self._call(
+                lambda: JobSubmissionClient().get_job_logs(jid))
+        except Exception as e:  # noqa: BLE001
+            return _json({"error": str(e)}, status=404)
+        return _json({"logs": logs})
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = _DEFAULT_PORT) -> DashboardHead:
+    """Start the dashboard against the already-initialized runtime."""
+    head = DashboardHead(host, port)
+    return head.start()

@@ -1,0 +1,106 @@
+"""joblib backend: `with joblib.parallel_backend("ray_tpu")` runs
+sklearn/joblib workloads as cluster tasks.
+
+Analog of ray: python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend over Ray's multiprocessing Pool).  Same shape:
+a joblib ParallelBackendBase whose effective_n_jobs is the cluster CPU
+count and whose apply_async ships batches as remote tasks.
+"""
+from __future__ import annotations
+
+import ray_tpu
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (ray: register_ray())."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+class _Result:
+    def __init__(self, ref, callback):
+        self._ref = ref
+        self._callback = callback
+
+    def get(self, timeout: float | None = None):
+        result = ray_tpu.get(self._ref, timeout=timeout)
+        if self._callback:
+            self._callback(result)
+        return result
+
+
+try:
+    from joblib._parallel_backends import ParallelBackendBase as _Base
+except Exception:  # noqa: BLE001 - joblib absent: class still importable
+    _Base = object
+
+
+class RayTpuBackend(_Base):
+    """joblib ParallelBackendBase implementation over remote tasks."""
+
+    supports_timeout = True
+    supports_sharedmem = False
+    supports_retrieve_callback = False
+    default_n_jobs = -1
+
+    def __init__(self, **kw):
+        if _Base is not object:
+            super().__init__(**kw)
+        self.parallel = None
+        self._task = None
+
+    # -- joblib backend protocol -------------------------------------------
+    def configure(self, n_jobs: int = 1, parallel=None, **_kw) -> int:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.parallel = parallel
+        return self.effective_n_jobs(n_jobs)
+
+    def effective_n_jobs(self, n_jobs: int) -> int:
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 in Parallel has no meaning")
+        cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        if n_jobs is None or n_jobs < 0:
+            return cpus
+        return min(n_jobs, cpus)
+
+    def apply_async(self, func, callback=None) -> _Result:
+        if self._task is None:
+            @ray_tpu.remote
+            def _run_joblib_batch(batch):
+                return batch()
+            self._task = _run_joblib_batch
+        return _Result(self._task.remote(func), callback)
+
+    # joblib >= 1.4 name for apply_async
+    def submit(self, func, callback=None) -> _Result:
+        return self.apply_async(func, callback)
+
+    def get_nested_backend(self):
+        from joblib._parallel_backends import SequentialBackend
+
+        return SequentialBackend(nesting_level=1), None
+
+    def abort_everything(self, ensure_ready: bool = True) -> None:
+        self._task = None
+
+    def terminate(self) -> None:
+        pass
+
+    def stop_call(self) -> None:
+        pass
+
+    def start_call(self) -> None:
+        pass
+
+    def compute_batch_size(self) -> int:
+        return 1
+
+    def batch_completed(self, batch_size, duration) -> None:
+        pass
+
+    def retrieval_context(self):
+        import contextlib
+
+        return contextlib.nullcontext()

@@ -1,0 +1,225 @@
+"""multiprocessing.Pool shim over cluster tasks.
+
+Analog of ray: python/ray/util/multiprocessing/pool.py (Pool) — the same
+drop-in `multiprocessing.Pool` surface (apply/apply_async/map/map_async/
+imap/imap_unordered/starmap), each chunk of work running as a remote task
+so a pool can span the whole cluster rather than one host's cores.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+_CHUNK_TASK = None
+
+
+def _chunk_task():
+    global _CHUNK_TASK
+    if _CHUNK_TASK is None:
+        @ray_tpu.remote
+        def _run_chunk(fn, chunk, star):
+            if star:
+                return [fn(*item) for item in chunk]
+            return [fn(item) for item in chunk]
+        _CHUNK_TASK = _run_chunk
+    return _CHUNK_TASK
+
+
+def _with_initializer(fn: Callable, initializer: Callable,
+                      initargs: tuple, token: str) -> Callable:
+    """Run `initializer` once per worker process before the first item
+    (multiprocessing Pool(initializer=...) semantics; workers are pooled,
+    so a process-global sentinel — one per Pool — dedups across chunks)."""
+    def wrapper(*args):
+        import builtins
+
+        if not getattr(builtins, token, False):
+            initializer(*initargs)
+            setattr(builtins, token, True)
+        return fn(*args)
+    return wrapper
+
+
+_init_ids = itertools.count()
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult lookalike over ObjectRefs."""
+
+    def __init__(self, refs: list, single: bool, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._result = None
+        self._done = False
+
+    def get(self, timeout: float | None = None):
+        if not self._done:
+            try:
+                chunks = ray_tpu.get(self._refs, timeout=timeout)
+            except Exception as e:
+                if self._error_callback:
+                    self._error_callback(e)
+                raise
+            flat = [x for c in chunks for x in c]
+            self._result = flat[0] if self._single else flat
+            self._done = True
+            if self._callback:
+                self._callback(self._result)
+        return self._result
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    """Drop-in multiprocessing.Pool running on the cluster
+    (ray: util/multiprocessing/pool.py Pool)."""
+
+    def __init__(self, processes: int | None = None,
+                 initializer: Callable | None = None,
+                 initargs: tuple = (), ray_address: str | None = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=ray_address)
+        if processes is None:
+            processes = max(
+                1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        self._initializer = initializer
+        self._initargs = initargs
+        self._init_token = f"_ray_tpu_pool_init_{next(_init_ids)}"
+        self._closed = False
+
+    # -------------------------------------------------------------- helpers
+    def _check(self) -> None:
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunks(self, iterable: Iterable, chunksize: int | None,
+                star: bool) -> list[list]:
+        items = list(iterable)
+        if chunksize is None:
+            # same heuristic as multiprocessing: ~4 chunks per process
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], star
+
+    def _submit(self, fn, chunks, star):
+        task = _chunk_task()
+        if self._initializer:
+            fn = _with_initializer(fn, self._initializer, self._initargs,
+                                   self._init_token)
+        return [task.remote(fn, c, star) for c in chunks]
+
+    # ------------------------------------------------------------------ api
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict | None = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        self._check()
+        kwds = kwds or {}
+
+        @ray_tpu.remote
+        def _apply(a, kw):
+            return fn(*a, **kw)
+        ref = _apply.remote(args, kwds)
+
+        class _One(AsyncResult):
+            def get(self, timeout=None):
+                if not self._done:
+                    try:
+                        self._result = ray_tpu.get(self._refs[0],
+                                                   timeout=timeout)
+                    except Exception as e:
+                        if self._error_callback:
+                            self._error_callback(e)
+                        raise
+                    self._done = True
+                    if self._callback:
+                        self._callback(self._result)
+                return self._result
+        return _One([ref], True, callback, error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: int | None = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: int | None = None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check()
+        chunks, star = self._chunks(iterable, chunksize, False)
+        return AsyncResult(self._submit(fn, chunks, star), False,
+                           callback, error_callback)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: int | None = None) -> list:
+        self._check()
+        chunks, star = self._chunks(iterable, chunksize, True)
+        return AsyncResult(self._submit(fn, chunks, star), False).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable,
+                      chunksize: int | None = None) -> AsyncResult:
+        self._check()
+        chunks, star = self._chunks(iterable, chunksize, True)
+        return AsyncResult(self._submit(fn, chunks, star), False)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        self._check()
+        chunks, star = self._chunks(iterable, chunksize, False)
+        refs = self._submit(fn, chunks, star)
+        for ref in refs:                     # ordered
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        self._check()
+        chunks, star = self._chunks(iterable, chunksize, False)
+        refs = self._submit(fn, chunks, star)
+        pending = list(refs)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        self._check()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

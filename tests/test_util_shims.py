@@ -1,0 +1,67 @@
+"""util shims: multiprocessing.Pool and the joblib backend.
+
+Mirrors ray: python/ray/util/multiprocessing tests + util/joblib tests
+(drop-in Pool surface; joblib parallel_backend("ray") running sklearn-ish
+workloads as tasks).
+"""
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+    yield ray_tpu
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_apply(rt):
+    from ray_tpu.utils.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(_add, (3, 4)) == 7
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_async_and_imap(rt):
+    from ray_tpu.utils.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        ar = p.map_async(_sq, range(6))
+        assert ar.get(timeout=60) == [0, 1, 4, 9, 16, 25]
+        assert ar.ready() and ar.successful()
+        assert list(p.imap(_sq, range(5), chunksize=2)) == [0, 1, 4, 9, 16]
+        assert sorted(p.imap_unordered(_sq, range(5), chunksize=2)) == \
+            [0, 1, 4, 9, 16]
+        one = p.apply_async(_add, (10, 20))
+        assert one.get(timeout=60) == 30
+
+
+def test_pool_closed_rejects(rt):
+    from ray_tpu.utils.multiprocessing import Pool
+
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_joblib_backend(rt):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.utils.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
