@@ -162,44 +162,68 @@ def _mlp_block(x, lp, cfg: LlamaConfig):
     return x + (h @ lp["w_down"])
 
 
-def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
-            ) -> jnp.ndarray:
-    """tokens [b, s] int32 → logits [b, s, vocab] float32."""
+def run_trunk(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+              layer_fn) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared decoder trunk: embed → scanned (remat) layers → final norm →
+    lm_head.  `layer_fn(x, lp, cos, sin, aux) -> (x, aux)` lets variants
+    (e.g. models.moe's routed FFN) swap the layer body without
+    re-implementing the scaffold.  Returns (logits fp32, aux)."""
     b, s = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     x = with_sharding_constraint(x, ("batch", "seq", None))
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
     def layer(carry, lp):
-        y = _attention_block(carry, lp, cfg, cos, sin)
-        y = _mlp_block(y, lp, cfg)
-        y = with_sharding_constraint(y, ("batch", "seq", None))
-        return y, None
+        x, aux = carry
+        x, aux = layer_fn(x, lp, cos, sin, aux)
+        x = with_sharding_constraint(x, ("batch", "seq", None))
+        return (x, aux), None
 
     body = layer
     if cfg.remat:
         body = jax.checkpoint(
             layer, policy=jax.checkpoint_policies.nothing_saveable)
-    x, _ = lax.scan(body, x, params["layers"])
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                           params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return with_sharding_constraint(logits, ("batch", "seq", "vocab"))
+    return with_sharding_constraint(logits, ("batch", "seq", "vocab")), aux
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            ) -> jnp.ndarray:
+    """tokens [b, s] int32 → logits [b, s, vocab] float32."""
+    def layer_fn(x, lp, cos, sin, aux):
+        y = _attention_block(x, lp, cfg, cos, sin)
+        return _mlp_block(y, lp, cfg), aux
+
+    logits, _ = run_trunk(params, tokens, cfg, layer_fn)
+    return logits
+
+
+def split_batch(batch: dict) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """{"tokens": [b, s+1]} or {"inputs", "targets"} → (inputs, targets)."""
+    if "inputs" in batch:
+        return batch["inputs"], batch["targets"]
+    return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Next-token CE, optionally masked (pad tokens excluded)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> jnp.ndarray:
     """Next-token cross entropy; batch = {"tokens": [b, s+1] int32} or
     {"inputs", "targets"}."""
-    if "inputs" in batch:
-        inputs, targets = batch["inputs"], batch["targets"]
-    else:
-        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    inputs, targets = split_batch(batch)
     logits = forward(params, inputs, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    if mask is not None:
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return nll.mean()
+    return cross_entropy(logits, targets, batch.get("mask"))
 
 
 # ---------------------------------------------------------------- decode

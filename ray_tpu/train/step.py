@@ -23,6 +23,18 @@ from ray_tpu.models import llama
 from ray_tpu.parallel.sharding import logical_sharding, param_shardings
 
 
+def model_module(cfg: llama.LlamaConfig):
+    """Model family for a config: moe for MoEConfig (a LlamaConfig
+    subclass, so it must be checked first), llama otherwise.  Keeps the
+    train helpers honest — an MoE config must never silently build a
+    dense model."""
+    from ray_tpu.models import moe
+
+    if isinstance(cfg, moe.MoEConfig):
+        return moe
+    return llama
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TrainState:
@@ -46,7 +58,7 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
 
 def create_train_state(key: jax.Array, cfg: llama.LlamaConfig,
                        optimizer: optax.GradientTransformation) -> TrainState:
-    params = llama.init_params(key, cfg)
+    params = model_module(cfg).init_params(key, cfg)
     return TrainState(params=params, opt_state=optimizer.init(params),
                       step=jnp.zeros((), jnp.int32))
 
@@ -55,7 +67,7 @@ def make_train_step(cfg: llama.LlamaConfig,
                     optimizer: optax.GradientTransformation,
                     loss_fn: Callable | None = None) -> Callable:
     """Returns step(state, batch) -> (state, metrics). Pure; jit outside."""
-    loss_fn = loss_fn or llama.loss_fn
+    loss_fn = loss_fn or model_module(cfg).loss_fn
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         def compute_loss(params):
@@ -80,11 +92,12 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
     """NamedShardings for a TrainState: params follow the logical-axes
     table; optimizer-state leaves mirror whichever param they track
     (matched by shape), scalars replicate."""
-    axes = llama.param_logical_axes(cfg)
+    model = model_module(cfg)
+    axes = model.param_logical_axes(cfg)
     p_sh = param_shardings(axes, mesh)
 
     params_shape = jax.eval_shape(
-        lambda k: llama.init_params(k, cfg), jax.random.PRNGKey(0))
+        lambda k: model.init_params(k, cfg), jax.random.PRNGKey(0))
     shape_to_sh = {}
     for (path_a, leaf), (path_b, sh) in zip(
             jax.tree_util.tree_leaves_with_path(params_shape),

@@ -1,0 +1,122 @@
+"""MoE (expert parallelism) + pipeline parallelism tests.
+
+Both are greenfield vs the reference (SURVEY §2.4: EP and PP ABSENT from
+ray — it only gang-schedules user libraries).  Validated on the 8-device
+virtual CPU mesh: sharded execution must match unsharded numerics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device test platform")
+
+
+def test_moe_forward_and_loss():
+    from ray_tpu.models import moe
+
+    cfg = moe.moe_configs()["moe-debug"]
+    params = moe.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size)
+    logits, aux = jax.jit(
+        lambda p, t: moe.forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 64, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0.0          # load-balance loss is positive
+    loss = jax.jit(lambda p, b: moe.loss_fn(p, b, cfg))(
+        params, {"tokens": tokens})
+    assert np.isfinite(float(loss))
+
+
+def test_moe_expert_parallel_matches_replicated():
+    import dataclasses
+
+    from ray_tpu.models import moe
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.parallel.sharding import shard_params
+
+    # fp32: routing is deterministic, so sharded == replicated exactly up
+    # to reduction order.  (In bf16, top-k/capacity ties near boundaries
+    # may legitimately flip under different tilings.)
+    cfg = dataclasses.replace(moe.moe_configs()["moe-debug"],
+                              dtype=jnp.float32)
+    params = moe.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                cfg.vocab_size)
+
+    ref_logits, ref_aux = jax.jit(
+        lambda p, t: moe.forward(p, t, cfg))(params, tokens)
+
+    mesh = create_mesh(MeshConfig(data=2, expert=4, fsdp=1, tensor=1))
+    axes = moe.param_logical_axes(cfg)
+    sharded = shard_params(params, axes, mesh)
+    with jax.set_mesh(mesh):
+        out, aux = jax.jit(
+            lambda p, t: moe.forward(p, t, cfg))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-3)
+
+
+def test_moe_capacity_drops_renormalize():
+    from ray_tpu.models import moe
+
+    cfg = moe.moe_configs()["moe-debug"]
+    h = jax.random.normal(jax.random.PRNGKey(0), (64, cfg.dim),
+                          jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1),
+                          (cfg.dim, cfg.n_experts), jnp.float32) * 0.1
+    dispatch, combine, aux = moe.route(h, w, cfg)
+    T = h.shape[0]
+    # combine weights per token sum to ~1 (or 0 if fully dropped)
+    sums = np.asarray(combine.sum(axis=(1, 2)))
+    assert ((np.abs(sums - 1.0) < 1e-3) | (sums < 1e-6)).all()
+    # capacity respected: per (expert, slot) at most one token
+    occ = np.asarray(dispatch.sum(axis=0))
+    assert (occ <= 1.0 + 1e-6).all()
+
+
+def test_train_step_dispatches_moe():
+    """An MoE config through the generic train helpers must build expert
+    params and use the MoE loss (regression: helpers hardcoded llama)."""
+    from ray_tpu.models import moe
+    from ray_tpu.train import step as ts
+
+    cfg = moe.moe_configs()["moe-debug"]
+    opt = ts.default_optimizer(total_steps=10)
+    state = ts.create_train_state(jax.random.PRNGKey(0), cfg, opt)
+    assert "we_gate" in state.params["layers"]
+    assert "router" in state.params["layers"]
+    step = ts.make_train_step(cfg, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0,
+                                cfg.vocab_size)
+    state, metrics = jax.jit(step)(state, {"tokens": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pipeline_matches_sequential():
+    from jax.sharding import Mesh
+
+    from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+    n_stages, n_micro, mb, d = 4, 8, 4, 16
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    per_stage = [{"w": jax.random.normal(k, (d, d)) * 0.1, "b":
+                  jnp.zeros((d,))} for k in keys]
+    stacked = stack_stage_params(per_stage)
+    xs = jax.random.normal(jax.random.PRNGKey(9), (n_micro, mb, d))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    # sequential reference
+    ref = xs
+    for p in per_stage:
+        ref = jax.vmap(lambda x, p=p: stage_fn(p, x))(ref)
+
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("stage",))
+    out = pipeline_apply(stage_fn, stacked, xs, mesh, axis="stage")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
