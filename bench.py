@@ -79,11 +79,18 @@ def bench_control_plane() -> dict:
         ray_tpu.get(refs)
         out["get_small_per_s"] = n / (time.perf_counter() - t0)
 
-        big = np.random.bytes(256 * 1024 * 1024)
+        big = np.random.randint(0, 255, 256 * 1024 * 1024,
+                                np.uint8)   # 256 MiB host array
         t0 = time.perf_counter()
         ref = ray_tpu.put(big)
         dt = time.perf_counter() - t0
-        out["put_gib_per_s"] = len(big) / dt / (1 << 30)
+        out["put_gib_per_s"] = big.nbytes / dt / (1 << 30)
+        del big
+        t0 = time.perf_counter()
+        got = ray_tpu.get(ref)
+        dt = time.perf_counter() - t0
+        out["get_gib_per_s"] = got.nbytes / dt / (1 << 30)
+        del got, ref
     finally:
         ray_tpu.shutdown()
     return {k: round(v, 1) for k, v in out.items()}

@@ -25,6 +25,7 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -42,7 +43,19 @@ struct IndexEntry {
   uint32_t state;    // 0=free 1=creating 2=sealed 3=tombstone
   uint32_t pins;
   uint64_t lru_tick;
+  int32_t creator_pid;  // owner of the creating-state pin (crash sweep)
+  uint32_t pad;
 };
+
+// Every live read pin is attributed to a pid so the agent can reclaim
+// pins of crash-killed readers (the reference's plasma store releases a
+// client's holds when its unix socket closes; this serverless arena
+// sweeps instead — rt_store_sweep_dead).
+struct PinRecord {
+  int32_t pid;       // 0 = slot free
+  uint8_t id[16];
+};
+constexpr uint32_t kPinSlots = 8192;
 
 struct BlockHeader {
   uint64_t size;      // payload size (excluding header)
@@ -61,6 +74,7 @@ struct ArenaHeader {
   uint64_t num_objects;
   pthread_mutex_t mutex;
   IndexEntry index[kIndexSlots];
+  PinRecord pin_records[kPinSlots];
 };
 
 struct Handle {
@@ -111,6 +125,29 @@ IndexEntry* find_slot(ArenaHeader* hdr, const uint8_t* id, bool for_insert) {
 
 BlockHeader* block_at(Handle* h, uint64_t off) {
   return reinterpret_cast<BlockHeader*>(h->base + off);
+}
+
+// Record one pid-attributed read pin (best effort: a full table means the
+// pin is untracked — it still releases normally, just not crash-swept).
+void pin_record_add(ArenaHeader* hdr, const uint8_t* id, int32_t pid) {
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    PinRecord* r = &hdr->pin_records[i];
+    if (r->pid == 0) {
+      r->pid = pid;
+      std::memcpy(r->id, id, 16);
+      return;
+    }
+  }
+}
+
+void pin_record_remove(ArenaHeader* hdr, const uint8_t* id, int32_t pid) {
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    PinRecord* r = &hdr->pin_records[i];
+    if (r->pid == pid && std::memcmp(r->id, id, 16) == 0) {
+      r->pid = 0;
+      return;
+    }
+  }
 }
 
 // First-fit allocation from the free list; returns data offset or 0.
@@ -221,7 +258,10 @@ void* rt_store_create(const char* name, uint64_t capacity) {
     if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
     total = static_cast<uint64_t>(st.st_size);
   }
-  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // MAP_POPULATE pre-faults the whole arena at create time: without it the
+  // first large write eats one page fault per 4K page (~4x bandwidth loss).
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | (created ? MAP_POPULATE : 0), fd, 0);
   if (mem == MAP_FAILED) { close(fd); return nullptr; }
   Handle* h = new Handle;
   h->base = static_cast<uint8_t*>(mem);
@@ -263,6 +303,9 @@ void* rt_store_open(const char* name) {
   if (fd < 0) return nullptr;
   struct stat st;
   if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  // No MAP_POPULATE here: the creator already faulted the pages in, so
+  // opener accesses are cheap minor faults — a full pre-population would
+  // stall every worker's first store access for the whole arena size.
   void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (mem == MAP_FAILED) { close(fd); return nullptr; }
@@ -293,9 +336,23 @@ uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
   e->size = size;
   e->state = 1;
   e->pins = 1;  // creator holds a pin until seal
+  e->creator_pid = static_cast<int32_t>(getpid());
   e->lru_tick = ++h->hdr->lru_clock;
   h->hdr->num_objects++;
   return off;
+}
+
+// Abort a creating-state allocation (copy failed before seal): free the
+// block and tombstone the entry.
+int rt_store_abort(void* hv, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (!e || e->state != 1) return -1;
+  free_block(h, e->offset);
+  e->state = 3;
+  h->hdr->num_objects--;
+  return 0;
 }
 
 int rt_store_seal(void* hv, const uint8_t* id) {
@@ -316,6 +373,7 @@ int rt_store_get(void* hv, const uint8_t* id, uint64_t* offset,
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state != 2) return 0;
   e->pins++;
+  pin_record_add(h->hdr, id, static_cast<int32_t>(getpid()));
   e->lru_tick = ++h->hdr->lru_clock;
   *offset = e->offset;
   *size = e->size;
@@ -334,6 +392,36 @@ void rt_store_release(void* hv, const uint8_t* id) {
   MutexGuard g(&h->hdr->mutex);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (e && e->pins > 0) e->pins--;
+  pin_record_remove(h->hdr, id, static_cast<int32_t>(getpid()));
+}
+
+// Reclaim pins (and half-created objects) owned by dead processes.  Called
+// periodically by the node agent; returns the number of pins reclaimed.
+int rt_store_sweep_dead(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  int reclaimed = 0;
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    PinRecord* r = &h->hdr->pin_records[i];
+    if (r->pid == 0) continue;
+    if (kill(r->pid, 0) != 0 && errno == ESRCH) {
+      IndexEntry* e = find_slot(h->hdr, r->id, false);
+      if (e && e->pins > 0) e->pins--;
+      r->pid = 0;
+      reclaimed++;
+    }
+  }
+  for (uint32_t i = 0; i < kIndexSlots; i++) {
+    IndexEntry* e = &h->hdr->index[i];
+    if (e->state == 1 && e->creator_pid > 0 &&
+        kill(e->creator_pid, 0) != 0 && errno == ESRCH) {
+      free_block(h, e->offset);
+      e->state = 3;
+      h->hdr->num_objects--;
+      reclaimed++;
+    }
+  }
+  return reclaimed;
 }
 
 int rt_store_delete(void* hv, const uint8_t* id) {
@@ -359,6 +447,10 @@ void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
 
 uint8_t* rt_store_base(void* hv) {
   return static_cast<Handle*>(hv)->base;
+}
+
+uint64_t rt_store_mapped_size(void* hv) {
+  return static_cast<Handle*>(hv)->mapped_size;
 }
 
 void rt_store_close(void* hv) {

@@ -32,6 +32,9 @@ class Config:
     # Max task leases a submitter keeps per scheduling key
     # (ray: max_pending_lease_requests_per_scheduling_category).
     max_leases_per_scheduling_key: int = 8
+    # In-flight pushes per leased worker (hides RPC round-trip latency;
+    # ray: normal_task_submitter.h pipelining discipline).
+    task_push_pipeline_depth: int = 4
     # Idle seconds before a leased worker is returned to the pool.
     lease_idle_timeout_s: float = 1.0
     # Workers prestarted per node agent at boot.

@@ -60,6 +60,8 @@ def load_lib():
     lib.rt_store_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_uint64]
     lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_abort.restype = ctypes.c_int
+    lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_get.restype = ctypes.c_int
     lib.rt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.POINTER(ctypes.c_uint64),
@@ -73,6 +75,10 @@ def load_lib():
         [ctypes.POINTER(ctypes.c_uint64)] * 3
     lib.rt_store_base.restype = ctypes.c_void_p
     lib.rt_store_base.argtypes = [ctypes.c_void_p]
+    lib.rt_store_mapped_size.restype = ctypes.c_uint64
+    lib.rt_store_mapped_size.argtypes = [ctypes.c_void_p]
+    lib.rt_store_sweep_dead.restype = ctypes.c_int
+    lib.rt_store_sweep_dead.argtypes = [ctypes.c_void_p]
     lib.rt_store_close.argtypes = [ctypes.c_void_p]
     lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
@@ -110,6 +116,11 @@ class Arena:
             raise OSError(f"cannot map shm arena {name!r}")
         self.base = self.lib.rt_store_base(self.handle)
         self._created = create
+        # Writable view over the whole mapping: frame payloads are copied in
+        # with one memoryview slice assignment (no intermediate bytes()).
+        size = self.lib.rt_store_mapped_size(self.handle)
+        self._map = memoryview(
+            (ctypes.c_ubyte * size).from_address(self.base)).cast("B")
 
     # ---- write path ----
     def put_frames(self, oid: bytes, frames: list) -> bool:
@@ -119,14 +130,30 @@ class Arena:
                                       ctypes.c_uint64(total))
         if off == 0:
             return False
-        addr = self.base + off
-        hdr = struct.pack("<I", len(frames)) + struct.pack(
-            f"<{len(lens)}Q", *lens)
-        ctypes.memmove(addr, hdr, len(hdr))
-        for f, fo in zip(frames, offsets):
-            if len(f):
-                src = f if isinstance(f, (bytes, bytearray)) else bytes(f)
-                ctypes.memmove(addr + fo, src, len(src))
+        try:
+            hdr = struct.pack("<I", len(frames)) + struct.pack(
+                f"<{len(lens)}Q", *lens)
+            self._map[off:off + len(hdr)] = hdr
+            for f, fo in zip(frames, offsets):
+                n = len(f)
+                if n:
+                    dst = self.base + off + fo
+                    if isinstance(f, bytes):
+                        ctypes.memmove(dst, f, n)
+                    else:
+                        mv = memoryview(f)
+                        try:
+                            # Writable buffers: raw memmove (fastest path).
+                            ctypes.memmove(
+                                dst, (ctypes.c_char * n).from_buffer(mv), n)
+                        except (TypeError, BufferError):
+                            # Read-only views copy via slice assignment.
+                            self._map[off + fo:off + fo + n] = mv.cast("B")
+        except BaseException:
+            # Never leak a creating-state block: abort the allocation so
+            # the entry doesn't sit unreclaimable until a crash sweep.
+            self.lib.rt_store_abort(self.handle, oid)
+            raise
         self.lib.rt_store_seal(self.handle, oid)
         return True
 
@@ -141,12 +168,21 @@ class Arena:
         addr = self.base + off.value
         buf = (ctypes.c_ubyte * size.value).from_address(addr)
         # The pin is released when the last view of `buf` is collected.
-        weakref.finalize(buf, self.lib.rt_store_release, self.handle, oid)
-        mv = memoryview(buf)
+        # Bound-method indirection, NOT a direct rt_store_release capture:
+        # a finalizer firing after close() must not touch the freed handle.
+        weakref.finalize(buf, self._release_pin, oid)
+        # Read-only: sealed objects are immutable; a writable view would
+        # let `got += 1` silently corrupt the object for every reader on
+        # the node (ray: plasma fetched buffers are immutable).
+        mv = memoryview(buf).toreadonly()
         (nframes,) = struct.unpack_from("<I", mv, 0)
         lens = struct.unpack_from(f"<{nframes}Q", mv, 4)
         _, offsets = _bundle_layout(list(lens))
         return [mv[fo:fo + ln] for fo, ln in zip(offsets, lens)]
+
+    def _release_pin(self, oid: bytes) -> None:
+        if self.handle:
+            self.lib.rt_store_release(self.handle, oid)
 
     def contains(self, oid: bytes) -> bool:
         return bool(self.lib.rt_store_contains(self.handle, oid))
@@ -162,6 +198,10 @@ class Arena:
                                 ctypes.byref(cap), ctypes.byref(num))
         return {"used": used.value, "capacity": cap.value,
                 "num_objects": num.value}
+
+    def sweep_dead(self) -> int:
+        """Reclaim pins held by crash-killed processes (agent-side)."""
+        return int(self.lib.rt_store_sweep_dead(self.handle))
 
     def close(self) -> None:
         if self.handle:
@@ -218,6 +258,9 @@ class NativeStoreBackend:
 
     def pin(self, oid: bytes, delta: int) -> None:
         pass  # pinning is per-reader via get_frames views
+
+    def sweep_dead(self) -> int:
+        return self.arena.sweep_dead()
 
     def stats(self) -> dict:
         return self.arena.stats()

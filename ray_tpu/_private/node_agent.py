@@ -255,11 +255,24 @@ class NodeAgent:
 
     async def _reaper_loop(self) -> None:
         """Detect dead worker processes; fail leases/actors accordingly."""
+        last_sweep = 0.0
         while not self._closed:
             await asyncio.sleep(0.2)
             for w in list(self.workers.values()):
                 if w.state != "dead" and w.proc and w.proc.poll() is not None:
                     await self._on_worker_dead(w)
+            # Reclaim arena pins held by crash-killed readers (any process
+            # that mmap'd the store and died without releasing; the
+            # reference's plasma does this on client-socket close).
+            now = time.monotonic()
+            if now - last_sweep >= 5.0 and self.store is not None:
+                last_sweep = now
+                sweep = getattr(self.store.backend, "sweep_dead", None)
+                if sweep is not None:
+                    try:
+                        sweep()
+                    except Exception:  # noqa: BLE001
+                        pass
 
     async def _on_worker_dead(self, w: WorkerHandle) -> None:
         prev_state = w.state
@@ -533,7 +546,8 @@ class NodeAgent:
         return {}
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:
-        return {"node_id": self.node_id}
+        return {"node_id": self.node_id,
+                "store_name": self.store.shm_name if self.store else ""}
 
 
 def _watch_parent() -> None:

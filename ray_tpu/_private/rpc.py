@@ -5,9 +5,12 @@ client_call.h) and pub/sub (ray: src/ray/pubsub/publisher.h).  On TPU pods
 this is the DCN control/data plane between hosts; intra-slice tensor traffic
 never touches it (that is XLA collectives over ICI).
 
-Wire format (multipart frames):
-  request:  [msgid(8B LE), method(utf8), header(msgpack), *blobs]
-  reply:    [msgid(8B LE), status(b"ok"|b"err"), header(msgpack)|pickled exc, *blobs]
+Wire format (multipart frames; metadata packed into ONE frame so a request
+is 2 frames not 4 — per-frame zmq send overhead is the control-plane
+hot-path cost):
+  request:  [meta = msgpack([msgid, method, header]), *blobs]
+  reply:    [meta = msgpack([msgid, ok(bool), header]), *blobs]
+            on error: [msgpack([msgid, False, None]), pickled (exc, tb)]
 msgid == 0 marks a one-way notification (no reply is sent).
 
 ROUTER on the server, one DEALER per peer on the client; replies are matched
@@ -31,8 +34,6 @@ logger = logging.getLogger(__name__)
 
 Blobs = list[bytes]
 Handler = Callable[[dict, Blobs], Awaitable[tuple[dict, Blobs] | dict | None]]
-
-_ONEWAY = (0).to_bytes(8, "little")
 
 
 def pack_header(h: dict) -> bytes:
@@ -95,16 +96,14 @@ class RpcServer:
 
     async def _dispatch(self, frames) -> None:
         identity = frames[0].bytes
-        msgid = frames[1].bytes
-        method = frames[2].bytes.decode()
+        msgid, method, header = msgpack.unpackb(frames[1].bytes, raw=False)
         try:
-            header = unpack_header(frames[3].bytes) if len(frames) > 3 else {}
-            blobs = [f.bytes for f in frames[4:]]
+            blobs = [f.bytes for f in frames[2:]]
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
-            result = await handler(header, blobs)
-            if msgid == _ONEWAY:
+            result = await handler(header or {}, blobs)
+            if msgid == 0:
                 return
             if result is None:
                 rh, rb = {}, []
@@ -113,10 +112,10 @@ class RpcServer:
             else:
                 rh, rb = result, []
             await self._sock.send_multipart(
-                [identity, msgid, b"ok", pack_header(rh), *rb], copy=False
-            )
+                [identity, msgpack.packb([msgid, True, rh]), *rb],
+                copy=False)
         except Exception as e:  # noqa: BLE001 - errors cross the wire
-            if msgid == _ONEWAY:
+            if msgid == 0:
                 logger.exception("one-way handler %s failed", method)
                 return
             tb = traceback.format_exc()
@@ -125,7 +124,8 @@ class RpcServer:
             except Exception:
                 payload = pickle.dumps((RpcError(str(e)), tb))
             try:
-                await self._sock.send_multipart([identity, msgid, b"err", payload])
+                await self._sock.send_multipart(
+                    [identity, msgpack.packb([msgid, False, None]), payload])
             except zmq.ZMQError:
                 pass
 
@@ -155,16 +155,15 @@ class RpcClient:
                 frames = await self._sock.recv_multipart(copy=False)
             except (asyncio.CancelledError, zmq.ZMQError):
                 break
-            msgid = int.from_bytes(frames[0].bytes, "little")
+            msgid, ok, header = msgpack.unpackb(frames[0].bytes, raw=False)
             fut = self._pending.pop(msgid, None)
             if fut is None or fut.done():
                 continue
-            status = frames[1].bytes
-            if status == b"ok":
-                header = unpack_header(frames[2].bytes) if len(frames) > 2 else {}
-                fut.set_result((header, [f.bytes for f in frames[3:]]))
+            if ok:
+                fut.set_result((header or {},
+                                [f.bytes for f in frames[1:]]))
             else:
-                exc, tb = pickle.loads(frames[2].bytes)
+                exc, tb = pickle.loads(frames[1].bytes)
                 fut.set_exception(RemoteError(getattr(fut, "_method", "?"), exc))
         for fut in self._pending.values():
             if not fut.done():
@@ -186,8 +185,7 @@ class RpcClient:
         fut._method = method
         self._pending[msgid] = fut
         await self._sock.send_multipart(
-            [msgid.to_bytes(8, "little"), method.encode(),
-             pack_header(header or {}), *(blobs or [])],
+            [msgpack.packb([msgid, method, header]), *(blobs or [])],
             copy=False,
         )
         if timeout is None:
@@ -200,7 +198,7 @@ class RpcClient:
     async def notify(self, method: str, header: dict | None = None,
                      blobs: Blobs | None = None) -> None:
         await self._sock.send_multipart(
-            [_ONEWAY, method.encode(), pack_header(header or {}), *(blobs or [])],
+            [msgpack.packb([0, method, header]), *(blobs or [])],
             copy=False,
         )
 

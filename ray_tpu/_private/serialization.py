@@ -73,9 +73,14 @@ def serialize(value: Any) -> SerializedValue:
     try:
         sink = io.BytesIO()
         _Pickler(sink, buffers.append).dump(value)
-        frames = [sink.getvalue()]
+        frames: list = [sink.getvalue()]
         for b in buffers:
-            frames.append(b.raw().tobytes() if not isinstance(b.raw(), bytes) else b.raw())
+            raw = b.raw()   # 1-D C-contiguous "B" view (raises otherwise)
+            # Large buffers stay zero-copy views into the source object
+            # (numpy/jax host arrays) all the way to the shm arena / wire —
+            # the reference's plasma path has the same discipline.  Small
+            # ones are snapshotted: cheap, and frees the source immediately.
+            frames.append(raw if raw.nbytes >= 1 << 20 else raw.tobytes())
         return SerializedValue(frames, list(_capture.refs))
     finally:
         _capture.refs = None

@@ -186,10 +186,24 @@ class LeaseManager:
             if lease is None:
                 return
             q = self.queues.get(key, [])
+            depth = self.core.config.task_push_pipeline_depth
             while True:
                 while q:
-                    task = q.pop(0)
-                    await self._push_one(task, lease)
+                    # Pipeline pushes onto one leased worker to hide the RPC
+                    # round-trip — but never take more than this pusher's
+                    # fair share of the queue, or a fast lease would hoard
+                    # tasks other idle workers could run in parallel (ray:
+                    # NormalTaskSubmitter pipelines per lease with the same
+                    # constraint).
+                    active = max(1, self.pushers.get(key, 1))
+                    fair = -(-len(q) // active)          # ceil division
+                    batch = [q.pop(0)
+                             for _ in range(min(depth, fair, len(q)))]
+                    if len(batch) == 1:
+                        await self._push_one(batch[0], lease)
+                    else:
+                        await asyncio.gather(
+                            *[self._push_one(t, lease) for t in batch])
                 # Queue drained: only the last surviving pusher lingers.
                 if self.pushers.get(key, 0) > 1:
                     break
@@ -326,6 +340,8 @@ class CoreWorker:
         self.actor_creation_borrows: dict[str, list] = {}
         self.functions: dict[str, Any] = {}
         self._exported: set[str] = set()
+        # id(fn) -> (fid, weakref) — see export_function.
+        self._fid_by_identity: dict[int, tuple] = {}
         self.actors_hosted: dict[str, ActorInstance] = {}
         self.actor_states: dict[str, ActorSubmitState] = {}
         self.current_actor_id: str | None = None
@@ -335,6 +351,12 @@ class CoreWorker:
         self._running_async: dict[bytes, asyncio.Task] = {}
         self._shutdown = threading.Event()
         self._task_events: list[dict] = []
+        # Direct mapping of the local node store (plasma-client analog,
+        # ray: plasma/client.cc mmaps store memory into the worker): puts
+        # and gets of node-store objects bypass the agent RPC entirely.
+        self.store_name: str = os.environ.get("RAY_TPU_STORE_NAME", "")
+        self._arena = None
+        self._arena_tried = False
         self.loop: asyncio.AbstractEventLoop = None  # set in start()
         self._default_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
@@ -420,6 +442,15 @@ class CoreWorker:
 
     def run(self, coro, timeout: float | None = None):
         """Bridge a coroutine from any user thread onto the IO loop."""
+        if threading.current_thread() is getattr(self, "_io_thread", None):
+            # Blocking the loop on itself would deadlock forever (e.g. a
+            # custom __setstate__ calling ray_tpu.get() during inline
+            # deserialization) — fail loudly instead.
+            coro.close()
+            raise RuntimeError(
+                "ray_tpu blocking API called from the runtime IO thread "
+                "(e.g. inside a deserialization hook); move the call into "
+                "task/actor code")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
@@ -438,6 +469,18 @@ class CoreWorker:
 
     # ------------------------------------------------------------ functions
     def export_function(self, fn: Any) -> str:
+        # Identity cache: the same function object is submitted thousands of
+        # times on the hot path; re-pickling + re-hashing it per call costs
+        # ~100µs each (ray keeps the same discipline — the function is
+        # exported once per (fn, job), function_manager.py:195).  Weakrefs,
+        # not hard pins: a driver minting fresh closures per call must not
+        # accumulate them (dead entries drop via the weakref callback).
+        import weakref
+
+        key = id(fn)
+        hit = self._fid_by_identity.get(key)
+        if hit is not None and hit[1]() is fn:
+            return hit[0]
         blob = dumps_function(fn)
         fid = hashlib.blake2b(blob, digest_size=16).hexdigest()
         if fid not in self._exported:
@@ -445,6 +488,12 @@ class CoreWorker:
                       {"ns": "fn", "key": fid}, [blob])
             self._exported.add(fid)
             self.functions[fid] = fn
+        try:
+            ref = weakref.ref(
+                fn, lambda _r, k=key: self._fid_by_identity.pop(k, None))
+            self._fid_by_identity[key] = (fid, ref)
+        except TypeError:
+            pass   # not weakref-able: skip caching
         return fid
 
     async def _fetch_function(self, fid: str) -> Any:
@@ -524,6 +573,12 @@ class CoreWorker:
             else:
                 plain_args.append(a)
         sv = serialize((tuple(plain_args), kwargs))
+        # Snapshot zero-copy view frames: the push happens later on the IO
+        # loop (and again on retry / lineage resubmit), so args must have
+        # submission-time semantics — a caller mutating its array after
+        # .remote() must not corrupt the task (ray: by-value arg copies).
+        sv.frames = [f.tobytes() if isinstance(f, memoryview) else f
+                     for f in sv.frames]
         for ref in sv.contained_refs:
             borrowed.setdefault(ref.binary(),
                                 ref.owner_addr or self.address)
@@ -739,6 +794,32 @@ class CoreWorker:
         self.memory.put_error(rid, err)
 
     # ------------------------------------------------------------- get/put
+    def local_arena(self):
+        """The mmap'd local node store, or None (dict backend / remote
+        agent / native build unavailable)."""
+        if not self._arena_tried:
+            self._arena_tried = True
+            if self.store_name:
+                try:
+                    from ray_tpu._private.native_store import Arena
+
+                    self._arena = Arena(self.store_name)
+                except Exception:  # noqa: BLE001 - fall back to agent RPC
+                    self._arena = None
+        return self._arena
+
+    def _store_frames_local(self, oid: bytes, frames: list) -> bool:
+        """Write frames into the local node store, zero-RPC when the arena
+        is mapped; falls back to the agent store_put RPC."""
+        arena = self.local_arena()
+        if arena is not None:
+            try:
+                if arena.put_frames(oid, frames):
+                    return True
+            except Exception:  # noqa: BLE001
+                pass
+        return False
+
     def put_object(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(WorkerID.from_hex(self.worker_id),
                                next(self._put_seq)).binary()
@@ -764,6 +845,17 @@ class CoreWorker:
                 e.frames = sv.frames
                 e.event.set()
             self.loop.call_soon_threadsafe(_fill)
+        elif self._store_frames_local(oid, sv.frames):
+            # Zero-RPC path: wrote straight into the mmap'd arena from the
+            # caller's thread.
+            rec.state = "stored"
+            rec.locations = [self.agent_addr]
+
+            def _fill_stored():
+                e = self.memory.entry(oid)
+                e.has_value, e.value = True, value
+                e.event.set()
+            self.loop.call_soon_threadsafe(_fill_stored)
         else:
             async def _store():
                 reply, _ = await self.clients.get(self.agent_addr).call(
@@ -795,8 +887,13 @@ class CoreWorker:
     async def _deserialize_registering(self, frames) -> Any:
         """Materialize a value, registering this process as a borrower of
         any refs nested inside it (see _register_borrows)."""
-        value, contained = await self.loop.run_in_executor(
-            None, deserialize_with_refs, frames)
+        # Small payloads deserialize inline: a thread-pool hop costs more
+        # (queue wakeup + context switch, ~0.2ms) than the pickle itself.
+        if sum(len(f) for f in frames) <= self.config.max_inline_object_size:
+            value, contained = deserialize_with_refs(frames)
+        else:
+            value, contained = await self.loop.run_in_executor(
+                None, deserialize_with_refs, frames)
         if contained:
             await self._register_borrows(contained)
         return value
@@ -860,6 +957,18 @@ class CoreWorker:
     async def _pull_and_load(self, ref: ObjectRef, locations: list[str],
                              entry) -> Any:
         """Fetch frames from a node store holding the object."""
+        if self.agent_addr in locations:
+            arena = self.local_arena()
+            if arena is not None:
+                # Zero-copy read: frames are memoryviews into the mmap'd
+                # arena; the deserialized numpy/jax buffers alias shm
+                # directly (ray: plasma client get + zero-copy numpy).
+                frames = arena.get_frames(ref.binary())
+                if frames is not None:
+                    value = await self._deserialize_registering(frames)
+                    entry.has_value, entry.value = True, value
+                    entry.event.set()
+                    return value
         for addr in locations:
             try:
                 reply, blobs = await self.clients.get(addr).call(
@@ -1162,8 +1271,11 @@ class CoreWorker:
                 out_blobs.extend(sv.frames)
             else:
                 oid = ObjectID.for_return(TaskID(task_id), i)
-                reply, _ = await self.clients.get(self.agent_addr).call(
-                    "store_put", {"object_id": oid.hex()}, sv.frames)
+                stored = await self.loop.run_in_executor(
+                    None, self._store_frames_local, oid.binary(), sv.frames)
+                if not stored:
+                    reply, _ = await self.clients.get(self.agent_addr).call(
+                        "store_put", {"object_id": oid.hex()}, sv.frames)
                 returns.append({"inline": False,
                                 "location": self.agent_addr,
                                 "contained": contained})

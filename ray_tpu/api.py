@@ -112,6 +112,14 @@ def init(address: str | None = None,
                       node_id=node_id, job_id=JobID.from_random().hex(),
                       namespace=namespace)
     core.start()
+    # Learn the local node store's shm name so puts/gets mmap it directly
+    # (plasma-client analog; workers get it via env from the agent).
+    if not core.store_name:
+        try:
+            areply, _ = core.call(agent_addr, "ping", {}, timeout=10.0)
+            core.store_name = areply.get("store_name", "")
+        except Exception:  # noqa: BLE001 - agent RPC fallback still works
+            pass
     # Fetch pub address + register the job.
     reply, _ = core.call(controller_addr, "ping", {}, timeout=30.0)
     if reply.get("pub_addr"):

@@ -189,3 +189,21 @@ def test_cluster_resources(ray_shared):
     ray_tpu = ray_shared
     assert ray_tpu.cluster_resources().get("CPU") == 4.0
     assert len(ray_tpu.nodes()) >= 1
+
+
+def test_mutating_arg_after_submit_does_not_corrupt(ray_shared):
+    """Large args have submission-time semantics: mutating the caller's
+    array after .remote() must not change what the task sees (ray:
+    by-value argument copies)."""
+    import numpy as np
+
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    arr = np.zeros(2_000_000, np.uint8)     # > zero-copy view threshold
+    ref = total.remote(arr)
+    arr[:] = 1                              # post-submit mutation
+    assert ray_tpu.get(ref) == 0.0

@@ -94,3 +94,49 @@ print("CHILD_OK")
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=60)
     assert "CHILD_OK" in out.stdout, out.stderr
+
+
+def test_get_frames_read_only(arena):
+    """Sealed objects are immutable: fetched views must refuse writes
+    (ray: plasma fetched buffers are immutable)."""
+    import pytest as _pytest
+
+    oid = b"R" * 16
+    arena.put_frames(oid, [b"immutable-data"])
+    frames = arena.get_frames(oid)
+    assert frames[0].readonly
+    with _pytest.raises((TypeError, NotImplementedError)):
+        frames[0][0] = 0
+
+
+def test_sweep_dead_reclaims_killed_reader_pin(arena):
+    """A reader killed with SIGKILL leaks its pin; rt_store_sweep_dead
+    reclaims it so the object becomes deletable/evictable again (plasma
+    analog: client-socket close releases holds)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    oid = b"K" * 16
+    arena.put_frames(oid, [b"pinned-by-child" * 100])
+    code = f"""
+import sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from ray_tpu._private.native_store import Arena
+a = Arena({arena.name!r})
+fr = a.get_frames({oid!r})
+assert fr is not None
+print("pinned", flush=True)
+time.sleep(60)
+"""
+    child = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE)
+    assert child.stdout.readline().strip() == b"pinned"
+    arena.delete(oid)
+    assert arena.contains(oid), "delete must be refused while pinned"
+    child.kill()
+    child.wait()
+    _time.sleep(0.2)
+    assert arena.sweep_dead() >= 1
+    arena.delete(oid)
+    assert not arena.contains(oid)
