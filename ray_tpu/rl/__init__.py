@@ -7,12 +7,15 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.learner import Learner, LearnerGroup
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig", "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
     "ReplayBuffer", "make_env", "register_env",
 ]

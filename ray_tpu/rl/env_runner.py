@@ -29,15 +29,18 @@ class EnvRunner:
         self.completed_returns: list[float] = []
 
     def sample(self, params: dict, n_steps: int,
-               epsilon: float | None = None) -> dict:
+               epsilon: float | None = None,
+               with_gae: bool = True) -> dict:
         """Collect n_steps transitions.  With epsilon set, act
         epsilon-greedily on Q-values (DQN); otherwise sample the categorical
-        policy and attach GAE advantages (PPO).
+        policy, attaching GAE advantages when with_gae (PPO; IMPALA/SAC
+        take raw fragments and correct off-policy on the learner).
         """
         obs_buf = np.zeros((n_steps, len(self.obs)), np.float32)
         act_buf = np.zeros((n_steps,), np.int64)
         rew_buf = np.zeros((n_steps,), np.float32)
         done_buf = np.zeros((n_steps,), np.float32)
+        trunc_buf = np.zeros((n_steps,), np.float32)
         logp_buf = np.zeros((n_steps,), np.float32)
         next_obs_buf = np.zeros_like(obs_buf)
 
@@ -58,6 +61,7 @@ class EnvRunner:
             self.episode_return += r
             done = terminated or truncated
             done_buf[t] = float(terminated)   # bootstrap through truncation
+            trunc_buf[t] = float(truncated and not terminated)
             if done:
                 self.completed_returns.append(self.episode_return)
                 self.episode_return = 0.0
@@ -66,9 +70,9 @@ class EnvRunner:
                 self.obs = nxt
 
         batch = {"obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-                 "dones": done_buf, "logp": logp_buf,
+                 "dones": done_buf, "truncs": trunc_buf, "logp": logp_buf,
                  "next_obs": next_obs_buf}
-        if epsilon is None:
+        if epsilon is None and with_gae:
             batch.update(self._gae(params, batch))
         rets, self.completed_returns = self.completed_returns, []
         batch["episode_returns"] = np.array(rets, np.float32)
@@ -84,9 +88,13 @@ class EnvRunner:
         last = 0.0
         for t in range(n - 1, -1, -1):
             nonterminal = 1.0 - batch["dones"][t]
+            # The lambda-carry must stop at ANY episode edge (terminal or
+            # truncation): the next buffer row belongs to a fresh episode.
+            boundary = max(batch["dones"][t], batch["truncs"][t])
             delta = batch["rewards"][t] + \
                 self.gamma * v_next[t] * nonterminal - v[t]
-            last = delta + self.gamma * self.gae_lambda * nonterminal * last
+            last = delta + self.gamma * self.gae_lambda * \
+                (1.0 - boundary) * last
             adv[t] = last
         returns = adv + v
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -108,10 +116,12 @@ class EnvRunnerGroup:
             for i in range(num_env_runners)]
 
     def sample(self, params_np: dict, n_steps_per_runner: int,
-               epsilon: float | None = None) -> list[dict]:
+               epsilon: float | None = None,
+               with_gae: bool = True) -> list[dict]:
         params_ref = ray_tpu.put(params_np)     # ship once, not per runner
         return ray_tpu.get([
-            r.sample.remote(params_ref, n_steps_per_runner, epsilon)
+            r.sample.remote(params_ref, n_steps_per_runner, epsilon,
+                            with_gae)
             for r in self.runners])
 
     def stop(self) -> None:

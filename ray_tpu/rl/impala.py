@@ -1,0 +1,141 @@
+"""IMPALA: importance-weighted actor-learner with V-trace correction.
+
+Analog of ray: rllib/algorithms/impala/ (IMPALA / IMPALAConfig; V-trace in
+rllib/algorithms/impala/vtrace_torch.py semantics).  TPU-native shape: the
+V-trace backward recursion is a `jax.lax.scan` over the time axis (no
+Python loop under jit), batched over fragments, so the whole off-policy
+update compiles to one XLA program on the learner.
+
+Env runners keep sampling with slightly stale params (the reference's
+async actor-learner decoupling); the behaviour log-probs shipped with each
+fragment drive the importance ratios.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vtrace_clip_rho = 1.0       # rho-bar (value-fit truncation)
+        self.vtrace_clip_pg_rho = 1.0    # rho-bar for the policy gradient
+        self.vtrace_lambda = 1.0
+        self.num_sgd_iter = 1
+
+    def training(self, *, vf_loss_coeff=None, entropy_coeff=None,
+                 vtrace_clip_rho=None, vtrace_clip_pg_rho=None,
+                 vtrace_lambda=None, **kw) -> "IMPALAConfig":
+        for name, v in [("vf_loss_coeff", vf_loss_coeff),
+                        ("entropy_coeff", entropy_coeff),
+                        ("vtrace_clip_rho", vtrace_clip_rho),
+                        ("vtrace_clip_pg_rho", vtrace_clip_pg_rho),
+                        ("vtrace_lambda", vtrace_lambda)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+class IMPALA(Algorithm):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        gamma = config.get("gamma", 0.99)
+        rho_bar = config.get("vtrace_clip_rho", 1.0)
+        pg_rho_bar = config.get("vtrace_clip_pg_rho", 1.0)
+        lam = config.get("vtrace_lambda", 1.0)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+
+        def loss_fn(params, batch):
+            # Batch axes: [B fragments, T steps, ...] — time-major inside
+            # the scan, fragment axis rides along vectorized.
+            obs = batch["obs"]                      # [B,T,obs]
+            B, T = obs.shape[:2]
+            flat = lambda a: a.reshape((B * T,) + a.shape[2:])  # noqa: E731
+            logits = models.policy_logits(params, flat(obs), jnp)
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            actions = flat(batch["actions"])
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=-1)[:, 0].reshape(B, T)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+
+            values = models.value(params, flat(obs), jnp).reshape(B, T)
+            # Per-step successor values — NOT a shift of `values`: at an
+            # intra-fragment episode edge the next row is a fresh episode's
+            # reset obs, while next_obs[t] is the true successor state.
+            v_next = models.value(
+                params, flat(batch["next_obs"]), jnp).reshape(B, T)
+
+            rhos = jnp.exp(logp - batch["logp"])           # [B,T]
+            clipped_rho = jnp.minimum(rho_bar, rhos)
+            cs = lam * jnp.minimum(1.0, rhos)
+            discounts = gamma * (1.0 - batch["dones"])     # [B,T]
+            # Any episode edge (terminal OR truncation) stops the
+            # correction carry — the recursion must not couple episodes.
+            carry = (1.0 - jnp.maximum(batch["dones"],
+                                       batch["truncs"]))   # [B,T]
+
+            deltas = clipped_rho * (
+                batch["rewards"] + discounts * v_next - values)
+
+            # Backward recursion: acc_t = delta_t + disc_t*c_t*carry*acc_{t+1}
+            def bwd(acc, xs):
+                delta_t, disc_t, c_t, k_t = xs
+                acc = delta_t + disc_t * c_t * k_t * acc
+                return acc, acc
+
+            _, vs_minus_v_rev = jax.lax.scan(
+                bwd, jnp.zeros((B,), values.dtype),
+                (deltas.T[::-1], discounts.T[::-1], cs.T[::-1],
+                 carry.T[::-1]))
+            vs = values + vs_minus_v_rev[::-1].T           # [B,T]
+
+            # vs_{t+1}: the next row's corrected value within an episode,
+            # the raw bootstrap V(next_obs) at edges / the fragment end.
+            vs_shift = jnp.concatenate(
+                [vs[:, 1:], v_next[:, -1:]], axis=1)
+            vs_tp1 = carry * vs_shift + (1.0 - carry) * v_next
+            vs_tp1 = vs_tp1.at[:, -1].set(v_next[:, -1])
+            pg_adv = jax.lax.stop_gradient(
+                jnp.minimum(pg_rho_bar, rhos) *
+                (batch["rewards"] + discounts * vs_tp1 - values))
+            pi_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean(
+                (jax.lax.stop_gradient(vs) - values) ** 2)
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": jnp.mean(rhos)}
+        return loss_fn
+
+    def training_step(self) -> dict:
+        per = max(1, self.cfg["train_batch_size"]
+                  // self.cfg["num_env_runners"])
+        fragments = self.env_runner_group.sample(
+            self._params_np, per, with_gae=False)
+        for b in fragments:
+            self._episode_returns.extend(b.pop("episode_returns").tolist())
+            self._timesteps += len(b["obs"])
+        # Stack to [B,T,...]: each runner fragment is one time-ordered row.
+        batch = {k: np.stack([b[k] for b in fragments])
+                 for k in fragments[0]}
+        metrics = self.learner_group.update(
+            batch, num_sgd_iter=self.cfg.get("num_sgd_iter", 1))
+        self._params_np = self.learner_group.get_params_numpy()
+        return metrics
+
+
+IMPALA._default_config = IMPALAConfig()
+IMPALAConfig.algo_class = IMPALA

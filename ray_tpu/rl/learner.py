@@ -26,18 +26,28 @@ class Learner:
 
         self.config = config
         rng = jax.random.PRNGKey(config.get("seed", 0))
-        self.params = models.policy_value_init(
-            rng, config["obs_dim"], config["n_actions"],
-            hidden=config.get("hidden", 64))
+        # Algorithms with non-default param trees (e.g. SAC's twin Q +
+        # temperature) ship a params_builder in the config dict.
+        builder = config.get("params_builder") or (
+            lambda r, od, na, hidden: models.policy_value_init(
+                r, od, na, hidden=hidden))
+        self.params = builder(rng, config["obs_dim"], config["n_actions"],
+                              config.get("hidden", 64))
         self.tx = optax.adam(config.get("lr", 3e-4))
         self.opt_state = self.tx.init(self.params)
         loss_fn = loss_builder(config)
+        # Optional jitted post-minibatch transform (e.g. polyak target
+        # sync); composed into the one compiled update step.
+        post = config.get("post_update_builder")
+        post_fn = post(config) if post else None
 
         def _update(params, opt_state, batch):
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            if post_fn is not None:
+                params = post_fn(params)
             return params, opt_state, loss, metrics
 
         self._update = jax.jit(_update)

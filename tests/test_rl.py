@@ -120,3 +120,49 @@ def test_algorithm_checkpoint_roundtrip(rt, tmp_path):
     np.testing.assert_allclose(p1, p2)
     algo.cleanup()
     algo2.cleanup()
+
+
+def test_impala_vtrace_learns(rt):
+    from ray_tpu.rl import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2)
+              .training(lr=2e-3, train_batch_size=512, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    first, best = None, -1.0
+    for _ in range(10):
+        result = algo.step()
+        ret = result["episode_return_mean"]
+        if first is None and ret == ret:
+            first = ret
+        if ret == ret:
+            best = max(best, ret)
+        assert "learner/mean_rho" in result
+        if best >= 100.0:
+            break
+    algo.cleanup()
+    assert first is not None, "no episodes completed"
+    assert best >= 40.0, f"IMPALA failed to improve: best={best:.1f}"
+
+
+def test_sac_machinery(rt):
+    from ray_tpu.rl import SACConfig
+
+    config = (SACConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=1)
+              .training(train_batch_size=128, learning_starts=128,
+                        sgd_batch_size=32, updates_per_step=2)
+              .debugging(seed=0))
+    algo = config.build()
+    result = {}
+    for _ in range(3):
+        result = algo.step()
+    assert "learner/critic_loss" in result or "learner/buffer_size" in result
+    # Temperature must stay positive and finite.
+    if "learner/alpha" in result:
+        assert 0.0 < result["learner/alpha"] < 100.0
+    assert algo._timesteps >= 3 * 128
+    algo.cleanup()
