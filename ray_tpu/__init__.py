@@ -12,6 +12,7 @@ from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 ObjectLostError, RayTpuError,
                                 TaskCancelledError, TaskError,
                                 WorkerCrashedError)
+from ray_tpu._private import profiling
 from ray_tpu.object_ref import ObjectRef
 from ray_tpu.runtime_context import get_runtime_context
 
@@ -21,6 +22,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "timeline",
     "available_resources", "cluster_resources", "get_runtime_context",
+    "profiling",
     "ObjectRef", "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
     "WorkerCrashedError", "__version__",

@@ -50,6 +50,8 @@ class Config:
     default_task_max_retries: int = 3
     # --- memory ---
     memory_monitor_period_s: float = 0.25
+    # Kill a worker when host/cgroup memory use crosses this fraction
+    # (ray: memory_usage_threshold, ray_config_def.h:65).
     memory_usage_threshold: float = 0.95
     # --- misc ---
     task_event_buffer_size: int = 4096

@@ -75,6 +75,7 @@ class WorkerHandle:
     # actor_id -> lease header whose resources it holds
     actor_leases: dict = field(default_factory=dict)
     started_at: float = field(default_factory=time.monotonic)
+    oom_killed: bool = False
 
 
 @dataclass
@@ -132,6 +133,7 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reaper_loop()))
+        self._bg.append(loop.create_task(self._memory_monitor_loop()))
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info("agent %s up at %s resources=%s",
@@ -274,6 +276,30 @@ class NodeAgent:
                     except Exception:  # noqa: BLE001
                         pass
 
+    async def _memory_monitor_loop(self) -> None:
+        """Kill a worker when host/cgroup memory crosses the threshold
+        (ray: MemoryMonitor memory_monitor.h:52 + retriable-FIFO policy)."""
+        from ray_tpu._private.memory_monitor import (MemoryMonitor,
+                                                     pick_oom_victim)
+
+        mon = MemoryMonitor(self.config.memory_usage_threshold)
+        while not self._closed:
+            await asyncio.sleep(self.config.memory_monitor_period_s)
+            try:
+                if not mon.should_kill():
+                    continue
+                victim = pick_oom_victim(list(self.workers.values()))
+                if victim is None or not victim.proc:
+                    continue
+                logger.warning(
+                    "memory above %.0f%%: OOM-killing worker %s (%s)",
+                    self.config.memory_usage_threshold * 100,
+                    victim.worker_id[:8], victim.state)
+                victim.oom_killed = True
+                victim.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+
     async def _on_worker_dead(self, w: WorkerHandle) -> None:
         prev_state = w.state
         w.state = "dead"
@@ -292,8 +318,10 @@ class NodeAgent:
                 await self.clients.get(self.controller_addr).call(
                     "report_actor_death",
                     {"actor_id": actor_id,
-                     "cause": f"worker process {w.worker_id[:8]} exited "
-                              f"(code {w.proc.returncode if w.proc else '?'})"},
+                     "cause": ("OOM-killed by the node memory monitor"
+                               if w.oom_killed else
+                               f"worker process {w.worker_id[:8]} exited "
+                               f"(code {w.proc.returncode if w.proc else '?'})")},
                     timeout=10.0)
             except Exception:  # noqa: BLE001
                 pass
@@ -301,7 +329,8 @@ class NodeAgent:
             try:
                 await self.clients.get(w.submitter).notify(
                     "worker_died", {"worker_addr": w.addr,
-                                    "lease_id": w.lease_id})
+                                    "lease_id": w.lease_id,
+                                    "oom": w.oom_killed})
             except Exception:  # noqa: BLE001
                 pass
         self.workers.pop(w.worker_id, None)

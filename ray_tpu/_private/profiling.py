@@ -1,0 +1,35 @@
+"""User-facing profiling spans recorded into the task-event timeline.
+
+Analog of ray: python/ray/_private/profiling.py (`profiling.profile`
+context) — spans land in the same controller-side event buffer the task
+state transitions use (ray: TaskEventBuffer task_event_buffer.h:206), so
+`ray_tpu.timeline()` / the CLI's Chrome-trace export interleaves them
+with task lifecycle events.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+@contextmanager
+def profile(event_name: str, extra_data: dict | None = None):
+    """Record a named span attributed to the current task (or the driver).
+
+    with ray_tpu.profiling.profile("shuffle-partition"):
+        ...
+    """
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    owner = core.current_task_id or "driver"
+    name = event_name if not extra_data else \
+        f"{event_name} {extra_data}"
+    core._record_event(owner, "PROFILE_BEGIN", name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        core._record_event(
+            owner, "PROFILE_END",
+            f"{name} ({(time.perf_counter() - t0) * 1e3:.2f}ms)")

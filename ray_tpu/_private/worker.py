@@ -260,6 +260,9 @@ class LeaseManager:
             reply, blobs = await self.core.clients.get(worker_addr).call(
                 "push_task", task.header, task.blobs)
         except (ConnectionLost, RemoteError) as e:
+            if worker_addr in self.core._oom_worker_addrs:
+                e = ConnectionLost(
+                    f"{worker_addr}: OOM-killed by the node memory monitor")
             await self._on_push_failure(task, e)
             return
         self.core._on_task_reply(task, reply, blobs)
@@ -348,6 +351,7 @@ class CoreWorker:
         self.current_task_id: str | None = None
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
+        self._oom_worker_addrs: set[str] = set()
         self._running_async: dict[bytes, asyncio.Task] = {}
         self._shutdown = threading.Event()
         self._task_events: list[dict] = []
@@ -1677,7 +1681,13 @@ class CoreWorker:
 
     # ------------------------------------------------------------- control
     async def rpc_worker_died(self, h: dict, _b: list) -> dict:
-        self.clients.drop(h.get("worker_addr", ""))
+        addr = h.get("worker_addr", "")
+        if h.get("oom"):
+            # Remembered so the push-failure error names the real killer
+            # (ray: OOM kills surface as OutOfMemoryError, not a generic
+            # worker crash).
+            self._oom_worker_addrs.add(addr)
+        self.clients.drop(addr)
         return {}
 
     async def rpc_exit_worker(self, h: dict, _b: list) -> dict:

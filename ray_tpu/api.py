@@ -75,6 +75,20 @@ def init(address: str | None = None,
         # Job-submission child drivers attach to the submitting cluster
         # (ray: RAY_ADDRESS honored by ray.init).
         address = _os.environ.get("RAY_TPU_ADDRESS") or None
+    if address == "auto":
+        address = _os.environ.get("RAY_TPU_ADDRESS") or None
+        if address is None:
+            raise ConnectionError(
+                "address='auto' but no running cluster found "
+                "(RAY_TPU_ADDRESS unset)")
+    if address:
+        # Client-mode URI (ray: ray.init("ray://host:port") proxies the
+        # API to a cluster; here the driver IS a first-class cluster
+        # client over DCN, so the scheme just strips to host:port).
+        for scheme in ("ray-tpu://", "ray://"):
+            if address.startswith(scheme):
+                address = address[len(scheme):]
+                break
     config = Config().override(_system_config)
     if object_store_memory:
         config.object_store_memory = object_store_memory

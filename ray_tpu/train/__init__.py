@@ -17,3 +17,4 @@ from ray_tpu.train.step import (TrainState, create_train_state,  # noqa: F401
                                 sharded_train_step)
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,  # noqa: F401,E501
                                    JaxTrainer, Result)
+from ray_tpu.train import torch  # noqa: F401  (TorchTrainer lives here)

@@ -1,0 +1,156 @@
+"""TorchTrainer: distributed torch training on the CPU hosts of a pod.
+
+Analog of ray: python/ray/train/torch/ (TorchTrainer torch_trainer.py,
+_TorchBackend.on_start torch/config.py:65,150 — rendezvous + per-worker
+dist.init_process_group; prepare_model/prepare_data_loader
+train_loop_utils.py:12,158).
+
+Role in the TPU framework: torch is the host-side path — CPU preprocessing
+models, reference baselines, and parity for users migrating torch loops.
+Device compute belongs to JaxTrainer (chips are jax-owned); the gloo
+process group here is the host-collective plane, matching the reference's
+CPU/gloo configuration.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.train.backend import Backend
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+def _torch_pg_init(master_addr: str, master_port: int, world_size: int,
+                   rank: int) -> bool:
+    """Runs inside each TrainWorker (ray: _setup_torch_process_group,
+    torch/config.py:65)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return True
+    dist.init_process_group(
+        backend="gloo",
+        init_method=f"tcp://{master_addr}:{master_port}",
+        world_size=world_size, rank=rank)
+    return True
+
+
+def _torch_pg_shutdown() -> bool:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class TorchBackend(Backend):
+    """Gloo process-group bring-up over the worker group."""
+
+    def on_start(self, worker_group) -> None:
+        n = worker_group.num_workers
+        if n <= 1:
+            return
+        import ray_tpu
+
+        ip, port = worker_group.execute_single(0, "get_address")
+        ray_tpu.get([
+            w.run_fn.remote(_torch_pg_init, ip, port, n, rank)
+            for rank, w in enumerate(worker_group.workers)
+        ])
+
+    def on_shutdown(self, worker_group) -> None:
+        try:
+            worker_group.execute("run_fn", _torch_pg_shutdown,
+                                 _timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Torch data-parallel trainer (ray: TorchTrainer)."""
+
+    _backend_cls = TorchBackend
+
+
+def prepare_model(model, parallel_strategy: str | None = "ddp"):
+    """Wrap the model for the process group (ray: prepare_model
+    train_loop_utils.py:158 — DDP/FSDP wrap + device move).  On this
+    host-side path the device is CPU; with one worker the model is
+    returned unwrapped."""
+    import torch.distributed as dist
+
+    if parallel_strategy is None or not dist.is_initialized() \
+            or dist.get_world_size() <= 1:
+        return model
+    from torch.nn.parallel import DistributedDataParallel
+
+    if parallel_strategy == "ddp":
+        return DistributedDataParallel(model)
+    if parallel_strategy == "fsdp":
+        from torch.distributed.fsdp import FullyShardedDataParallel
+
+        return FullyShardedDataParallel(model)
+    raise ValueError(f"unknown parallel_strategy {parallel_strategy!r}")
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across the group with a DistributedSampler
+    (ray: prepare_data_loader train_loop_utils.py:12).  Preserves the
+    loader's own config (workers, pinning, collate, shuffle intent);
+    custom batch_samplers cannot be re-sharded generically and pass
+    through unchanged, as the reference does."""
+    import torch.distributed as dist
+
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return data_loader
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if data_loader.batch_size is None:
+        # batch_sampler-driven loader: sharding it would break the user's
+        # batching contract — leave it alone (the user shards manually).
+        return data_loader
+    ds = data_loader.dataset
+    sampler = DistributedSampler(
+        ds, num_replicas=dist.get_world_size(), rank=dist.get_rank(),
+        # Keep the caller's ordering intent: sequential loaders (eval)
+        # must not become shuffled.
+        shuffle=isinstance(data_loader.sampler, RandomSampler))
+    loader = DataLoader(ds, batch_size=data_loader.batch_size,
+                        sampler=sampler,
+                        num_workers=data_loader.num_workers,
+                        pin_memory=data_loader.pin_memory,
+                        collate_fn=data_loader.collate_fn,
+                        worker_init_fn=data_loader.worker_init_fn,
+                        generator=data_loader.generator,
+                        drop_last=data_loader.drop_last)
+    return _EpochTrackingLoader(loader)
+
+
+class _EpochTrackingLoader:
+    """Calls DistributedSampler.set_epoch per epoch automatically: without
+    it every epoch replays one shuffle order (ray: prepare_data_loader's
+    _WrappedDataLoader does the same)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._epoch = 0
+
+    def __iter__(self):
+        self._loader.sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+def backward(loss) -> None:
+    """ray: train.torch.backward — plain backward on the CPU/gloo path."""
+    loss.backward()
+
+
+__all__ = ["TorchTrainer", "TorchBackend", "prepare_model",
+           "prepare_data_loader", "backward"]
