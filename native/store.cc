@@ -213,28 +213,6 @@ void free_block(Handle* h, uint64_t data_off) {
   }
 }
 
-// Evict least-recently-used unpinned sealed objects until we can fit `size`.
-// Must hold the mutex.  Returns data offset or 0.
-uint64_t alloc_with_eviction(Handle* h, uint64_t size) {
-  uint64_t off = alloc_block(h, size);
-  while (off == 0) {
-    IndexEntry* victim = nullptr;
-    for (uint32_t i = 0; i < kIndexSlots; i++) {
-      IndexEntry* e = &h->hdr->index[i];
-      if (e->state == 2 && e->pins == 0 &&
-          (!victim || e->lru_tick < victim->lru_tick)) {
-        victim = e;
-      }
-    }
-    if (!victim) return 0;
-    free_block(h, victim->offset);
-    victim->state = 3;
-    h->hdr->num_objects--;
-    off = alloc_block(h, size);
-  }
-  return off;
-}
-
 }  // namespace
 
 extern "C" {
@@ -327,7 +305,12 @@ uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
   MutexGuard g(&h->hdr->mutex);
   IndexEntry* existing = find_slot(h->hdr, id, false);
   if (existing && existing->state != 3) return 0;  // already present
-  uint64_t off = alloc_with_eviction(h, size);
+  // No implicit eviction: every sealed object is referenced (owners
+  // delete via store_delete when refs drop), so dropping one here would
+  // lose data.  On full, the caller falls back to the agent, which
+  // SPILLS the LRU object to disk (rt_store_oldest) and retries — the
+  // reference's plasma → LocalObjectManager spill path.
+  uint64_t off = alloc_block(h, size);
   if (off == 0) return 0;
   IndexEntry* e = find_slot(h->hdr, id, true);
   if (!e) { free_block(h, off); return 0; }
@@ -434,6 +417,24 @@ int rt_store_delete(void* hv, const uint8_t* id) {
   e->state = 3;
   h->hdr->num_objects--;
   return 0;
+}
+
+// Id of the least-recently-used unpinned sealed object (spill candidate),
+// or 0 if none.  The caller copies it out (get+release) then deletes.
+int rt_store_oldest(void* hv, uint8_t* out_id) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* victim = nullptr;
+  for (uint32_t i = 0; i < kIndexSlots; i++) {
+    IndexEntry* e = &h->hdr->index[i];
+    if (e->state == 2 && e->pins == 0 &&
+        (!victim || e->lru_tick < victim->lru_tick)) {
+      victim = e;
+    }
+  }
+  if (!victim) return 0;
+  std::memcpy(out_id, victim->id, 16);
+  return 1;
 }
 
 void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,

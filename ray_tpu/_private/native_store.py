@@ -79,6 +79,8 @@ def load_lib():
     lib.rt_store_mapped_size.argtypes = [ctypes.c_void_p]
     lib.rt_store_sweep_dead.restype = ctypes.c_int
     lib.rt_store_sweep_dead.argtypes = [ctypes.c_void_p]
+    lib.rt_store_oldest.restype = ctypes.c_int
+    lib.rt_store_oldest.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_close.argtypes = [ctypes.c_void_p]
     lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
@@ -187,8 +189,10 @@ class Arena:
     def contains(self, oid: bytes) -> bool:
         return bool(self.lib.rt_store_contains(self.handle, oid))
 
-    def delete(self, oid: bytes) -> None:
-        self.lib.rt_store_delete(self.handle, oid)
+    def delete(self, oid: bytes) -> bool:
+        """True when the object is gone (freed now or already absent);
+        False when a live pin blocked the delete."""
+        return self.lib.rt_store_delete(self.handle, oid) == 0
 
     def stats(self) -> dict:
         used = ctypes.c_uint64()
@@ -202,6 +206,13 @@ class Arena:
     def sweep_dead(self) -> int:
         """Reclaim pins held by crash-killed processes (agent-side)."""
         return int(self.lib.rt_store_sweep_dead(self.handle))
+
+    def oldest(self) -> bytes | None:
+        """LRU unpinned sealed object id — the next spill candidate."""
+        out = ctypes.create_string_buffer(16)
+        if self.lib.rt_store_oldest(self.handle, out):
+            return out.raw
+        return None
 
     def close(self) -> None:
         if self.handle:
@@ -253,14 +264,17 @@ class NativeStoreBackend:
     def contains(self, oid: bytes) -> bool:
         return self.arena.contains(oid)
 
-    def delete(self, oid: bytes) -> None:
-        self.arena.delete(oid)
+    def delete(self, oid: bytes) -> bool:
+        return self.arena.delete(oid)
 
     def pin(self, oid: bytes, delta: int) -> None:
         pass  # pinning is per-reader via get_frames views
 
     def sweep_dead(self) -> int:
         return self.arena.sweep_dead()
+
+    def oldest(self) -> bytes | None:
+        return self.arena.oldest()
 
     def stats(self) -> dict:
         return self.arena.stats()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -106,9 +107,9 @@ class _DictBackend:
         size = sum(len(f) for f in frames)
         if oid in self._data:
             return True
-        while self.used + size > self.capacity and self._evict_one():
-            pass
         if self.used + size > self.capacity:
+            # No implicit eviction (data loss); the StoreRunner spills the
+            # LRU object to disk and retries (plasma → spill discipline).
             return False
         self._data[oid] = frames
         self._lru[oid] = time.monotonic()
@@ -124,27 +125,25 @@ class _DictBackend:
     def contains(self, oid: bytes) -> bool:
         return oid in self._data
 
-    def delete(self, oid: bytes) -> None:
+    def delete(self, oid: bytes) -> bool:
         frames = self._data.pop(oid, None)
         self._lru.pop(oid, None)
         self._pinned.pop(oid, None)
         if frames is not None:
             self.used -= sum(len(f) for f in frames)
+        return True
 
     def pin(self, oid: bytes, delta: int) -> None:
         self._pinned[oid] = max(0, self._pinned.get(oid, 0) + delta)
 
-    def _evict_one(self) -> bool:
-        """Evict the least-recently-used unpinned object
+    def oldest(self) -> bytes | None:
+        """LRU unpinned object id — the next spill candidate
         (ray: plasma LRU eviction_policy.h:105)."""
         candidates = [oid for oid in self._lru
                       if self._pinned.get(oid, 0) == 0]
         if not candidates:
-            return False
-        victim = min(candidates, key=lambda o: self._lru[o])
-        logger.debug("evicting object %s", victim.hex()[:12])
-        self.delete(victim)
-        return True
+            return None
+        return min(candidates, key=lambda o: self._lru[o])
 
     def stats(self) -> dict:
         return {"used": self.used, "capacity": self.capacity,
@@ -165,13 +164,22 @@ def _make_backend(node_id: str, capacity: int):
 
 class StoreRunner:
     """Node-agent-side object store service (ray: PlasmaStoreRunner embedded
-    in the raylet, store_runner.h:14)."""
+    in the raylet, store_runner.h:14) with disk spilling: when the arena is
+    full, LRU objects spill to files and restore on demand (ray:
+    LocalObjectManager local_object_manager.h:41 + external_storage.py)."""
 
     def __init__(self, node_id: str, config):
+        import tempfile
+
         self.node_id = node_id
         self.config = config
         self.backend = _make_backend(node_id, config.object_store_memory)
         self._clients = None
+        self.spill_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_spill_{node_id[:8]}_{os.getpid()}")
+        self.spilled: dict[bytes, str] = {}     # oid -> file path
+        self.spilled_bytes = 0
 
     @property
     def shm_name(self) -> str:
@@ -186,21 +194,118 @@ class StoreRunner:
         server.register("store_pull", self.rpc_store_pull)
         server.register("store_stats", self.rpc_store_stats)
 
+    # -------------------------------------------------------------- spill
+    def _write_spill_file(self, oid: bytes, frames: list) -> tuple[str, int]:
+        """Serialize a frame bundle to the spill dir; returns (path, bytes).
+        Format: [u32 nframes][u64 len_i ...][payloads...]."""
+        import struct as _struct
+
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid.hex())
+        size = 0
+        with open(path, "wb") as f:
+            f.write(_struct.pack("<I", len(frames)))
+            for fr in frames:
+                f.write(_struct.pack("<Q", len(fr)))
+            for fr in frames:
+                f.write(fr)
+                size += len(fr)
+        return path, size
+
+    def _spill_one(self) -> bool:
+        """Write the LRU object's frames to disk and drop it from memory."""
+        oid = self.backend.oldest()
+        if oid is None:
+            return False
+        frames = self.backend.get(oid)
+        if frames is None:
+            return False
+        path, size = self._write_spill_file(oid, frames)
+        del frames          # drop read pins before deleting from the arena
+        if not self.backend.delete(oid):
+            # Raced with a reader pinning it: the arena copy stays
+            # authoritative; drop the file so nothing double-counts.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self.spilled[oid] = path
+        self.spilled_bytes += size
+        logger.info("spilled %s (%d B) to %s", oid.hex()[:12], size, path)
+        return True
+
+    def _read_spilled(self, oid: bytes) -> list[bytes] | None:
+        path = self.spilled.get(oid)
+        if path is None:
+            return None
+        import struct as _struct
+
+        try:
+            with open(path, "rb") as f:
+                (n,) = _struct.unpack("<I", f.read(4))
+                lens = _struct.unpack(f"<{n}Q", f.read(8 * n))
+                return [f.read(ln) for ln in lens]
+        except OSError:
+            return None
+
+    def _delete_spilled(self, oid: bytes) -> None:
+        path = self.spilled.pop(oid, None)
+        if path:
+            try:
+                self.spilled_bytes -= os.path.getsize(path)
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def put_with_spill(self, oid: bytes, frames: list) -> bool:
+        """Insert, spilling LRU objects to disk until it fits (ray: plasma
+        CreateRequestQueue backpressure → spill)."""
+        # Duplicate puts (client retry, task re-execution) are a success,
+        # NOT a reason to spill: the native backend's put returns False
+        # for already-present ids exactly like for a full arena.
+        if self.backend.contains(oid) or oid in self.spilled:
+            return True
+        if self.backend.put(oid, frames):
+            return True
+        for _ in range(4096):
+            if not self._spill_one():
+                break
+            if self.backend.put(oid, frames):
+                return True
+        # Arena can't hold it even after spilling: spill the new object
+        # itself straight to disk.
+        path, size = self._write_spill_file(oid, frames)
+        self.spilled[oid] = path
+        self.spilled_bytes += size
+        return True
+
     async def rpc_store_put(self, h: dict, blobs: list) -> dict:
-        ok = self.backend.put(bytes.fromhex(h["object_id"]), list(blobs))
+        ok = self.put_with_spill(bytes.fromhex(h["object_id"]),
+                                 list(blobs))
         return {"ok": ok}
 
     async def rpc_store_get(self, h: dict, _b: list) -> tuple[dict, list]:
-        frames = self.backend.get(bytes.fromhex(h["object_id"]))
+        oid = bytes.fromhex(h["object_id"])
+        frames = self.backend.get(oid)
         if frames is None:
-            return {"found": False}, []
+            # Restore from disk (ray: spilled_object_reader.cc); best
+            # effort re-insert so repeat readers hit memory.
+            restored = self._read_spilled(oid)
+            if restored is None:
+                return {"found": False}, []
+            if self.backend.put(oid, restored):
+                self._delete_spilled(oid)
+            return {"found": True}, restored
         return {"found": True}, list(frames)
 
     async def rpc_store_contains(self, h: dict, _b: list) -> dict:
         return {"found": self.backend.contains(bytes.fromhex(h["object_id"]))}
 
     async def rpc_store_delete(self, h: dict, _b: list) -> dict:
-        self.backend.delete(bytes.fromhex(h["object_id"]))
+        oid = bytes.fromhex(h["object_id"])
+        self.backend.delete(oid)
+        self._delete_spilled(oid)
         return {}
 
     async def rpc_store_pull(self, h: dict, _b: list) -> dict:
@@ -209,6 +314,13 @@ class StoreRunner:
         oid = bytes.fromhex(h["object_id"])
         if self.backend.contains(oid):
             return {"ok": True}
+        if oid in self.spilled:
+            # Already on local disk: restore instead of a network fetch.
+            restored = self._read_spilled(oid)
+            if restored is not None:
+                if self.backend.put(oid, restored):
+                    self._delete_spilled(oid)
+                return {"ok": True}
         for addr in h.get("from", []):
             try:
                 reply, blobs = await self._clients.get(addr).call(
@@ -216,11 +328,16 @@ class StoreRunner:
             except Exception:  # noqa: BLE001
                 continue
             if reply.get("found"):
-                return {"ok": self.backend.put(oid, blobs)}
+                return {"ok": self.put_with_spill(oid, blobs)}
         return {"ok": False}
 
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:
-        return self.backend.stats()
+        return {**self.backend.stats(),
+                "spilled_objects": len(self.spilled),
+                "spilled_bytes": self.spilled_bytes}
 
     def close(self) -> None:
         self.backend.close()
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)

@@ -75,8 +75,13 @@ def llama_configs() -> dict[str, LlamaConfig]:
                                  n_kv_heads=8, ffn_dim=8192,
                                  vocab_size=128256),
         # bench config: fits one v5e chip (16GB HBM) with optimizer state.
-        "bench-350m": LlamaConfig(dim=1024, n_layers=24, n_heads=16,
-                                  n_kv_heads=8, ffn_dim=4096,
+        # head_dim 128 (not 64) so the Pallas flash kernel's MXU-tile gate
+        # accepts it — fwd AND the remat recompute run the kernel instead
+        # of materializing [s,s] scores.  remat stays on: at batch 8 ×
+        # seq 2048 the fp32 MLP activations alone are ~6 GB/layer-group
+        # without it.
+        "bench-350m": LlamaConfig(dim=1024, n_layers=24, n_heads=8,
+                                  n_kv_heads=4, ffn_dim=4096,
                                   vocab_size=32768, max_seq=2048),
         "debug": LlamaConfig(dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
                              ffn_dim=256, vocab_size=256, max_seq=128,
@@ -227,6 +232,40 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------- decode
+def prefill(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prompt pass for serving: final hidden states plus the per-layer
+    K/V to seed a decode cache.
+
+    tokens [b, P] (right-padded).  Returns (hidden [b, P, dim] post final
+    norm — callers project ONLY the rows they need through lm_head; a
+    full [b, P, vocab] fp32 logits tensor would be GBs at serving shapes,
+    k [L, b, P, n_kv, hd], v likewise), RoPE already applied.  Padding
+    rows produce garbage K/V that decode never attends to: the decode
+    mask admits only kpos <= pos and each decode step overwrites its own
+    position before reading it (see decode_step).
+    """
+    b, P = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(cfg.head_dim, P, cfg.rope_theta)
+
+    def layer(x, lp):
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, P, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = attention(q, k, v, causal=True)
+        x = x + (o.reshape(b, P, -1) @ lp["wo"])
+        x = _mlp_block(x, lp, cfg)
+        return x, (k.astype(cfg.dtype), v.astype(cfg.dtype))
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return x, ks, vs
+
+
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
@@ -238,53 +277,55 @@ def decode_step(params: dict, cache: dict, tokens: jnp.ndarray,
     """One decode step for continuous-batched serving.
 
     tokens [b] int32 (current token per sequence); cache positions advance
-    per sequence.  Returns (logits [b, vocab], new cache).  Static shapes
-    throughout (XLA-friendly: dynamic_update_slice into a fixed cache).
+    per sequence.  Returns (logits [b, vocab], new cache).
+
+    TPU shape: layers ride a `lax.scan` (one compiled body), and the K/V
+    write is a per-sequence `dynamic_update_slice` (vmapped over the
+    batch) — it touches ONE cache row per sequence instead of a full
+    one-hot read-modify-write of the cache (which is what makes naive
+    decode HBM-bound: 2×cache traffic per layer per token).
     """
     b = tokens.shape[0]
     max_len = cache["k"].shape[2]
     pos = cache["pos"]                                  # [b]
     x = params["embed"][tokens][:, None, :].astype(cfg.dtype)  # [b,1,d]
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    kpos = jnp.arange(max_len)[None, :]                 # [1, max]
+    mask = kpos <= pos[:, None]                         # [b, max]
 
-    new_k, new_v = [], []
-    for li in range(cfg.n_layers):
-        lp = jax.tree.map(lambda p, li=li: p[li], params["layers"])
+    def write_row(c, kv, p):
+        # c [max_len, kvh, hd], kv [1, kvh, hd]: write one position.
+        return lax.dynamic_update_slice(c, kv, (p, 0, 0))
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs        # ck/cv [b, max_len, kvh, hd]
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=pos[:, None])
         k = apply_rope(k, cos, sin, positions=pos[:, None])
-        # Scatter this step's k/v into each sequence's own cache position
-        # (static shapes: one-hot mask update, no dynamic slicing per row).
-        onehot = jax.nn.one_hot(pos, max_len, dtype=cfg.dtype)  # [b, max]
-        ck = cache["k"][li] * (1 - onehot)[:, :, None, None] + \
-            k.astype(cfg.dtype) * onehot[:, :, None, None]
-        cv = cache["v"][li] * (1 - onehot)[:, :, None, None] + \
-            v.astype(cfg.dtype) * onehot[:, :, None, None]
-        new_k.append(ck)
-        new_v.append(cv)
-        # attend over the cache with per-sequence causal mask (pos >= kpos)
-        n_rep = cfg.n_heads // cfg.n_kv_heads
-        kk = jnp.repeat(ck, n_rep, axis=2)
-        vv = jnp.repeat(cv, n_rep, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
-                            preferred_element_type=jnp.float32)
-        logits *= cfg.head_dim ** -0.5
-        kpos = jnp.arange(max_len)[None, :]
-        mask = kpos <= pos[:, None]
-        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        ck = jax.vmap(write_row)(ck, k.astype(cfg.dtype), pos)
+        cv = jax.vmap(write_row)(cv, v.astype(cfg.dtype), pos)
+        # Grouped-query attention without materializing repeated K/V:
+        # queries fold into [kv-group, rep] and share the group's cache.
+        qg = q.reshape(b, 1, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        a = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
+                       preferred_element_type=jnp.float32)
+        a *= cfg.head_dim ** -0.5
+        a = jnp.where(mask[:, None, None, None, :], a, -1e30)
+        probs = jax.nn.softmax(a, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv)
         o = o.reshape(b, 1, cfg.n_heads * cfg.head_dim)
         x = x + (o @ lp["wo"])
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         gg = jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
         x = x + ((gg.astype(cfg.dtype) * (h2 @ lp["w_up"])) @ lp["w_down"])
+        return x, (ck, cv)
 
+    x, (nk, nv) = lax.scan(layer, x,
+                           (params["layers"], cache["k"], cache["v"]))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    new_cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
-                 "pos": pos + 1}
-    return logits, new_cache
+    return logits, {"k": nk, "v": nv, "pos": pos + 1}

@@ -1,0 +1,382 @@
+"""Continuous-batched LLM inference engine + Serve deployment.
+
+The judged serve configuration (BASELINE.json north star: "Ray Serve's
+replica scheduler runs continuous-batched LLM inference on TPU";
+reference analog: serve LLM workloads under ray: release/serve_tests/ and
+the vLLM-on-Serve pattern — rebuilt TPU-first rather than ported).
+
+TPU-native shape (SURVEY §7 "Serve continuous batching on TPU"):
+  - ONE jitted decode program over a fixed [max_batch] slot array —
+    sequences join/leave slots between steps; shapes never change, so XLA
+    compiles exactly one decode program (plus one prefill program per
+    prompt-length bucket).
+  - KV cache is a donated jit argument: decode updates alias in place
+    (no per-step cache copy in HBM).
+  - Prompt lengths are bucketed to powers of two; padding rows produce
+    garbage K/V that the decode mask never admits (llama.prefill).
+  - Sampling (greedy / temperature) happens on device; only the [B]
+    next-token vector crosses to the host per step.
+
+The engine loop runs on one thread inside the replica actor; requests
+arrive via a thread-safe queue and resolve concurrent.futures.Futures,
+so the Serve router's async path and the engine's step loop compose.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+
+def _buckets_for(max_len: int, smallest: int = 32) -> list[int]:
+    out, b = [], smallest
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+@dataclass
+class _Request:
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float
+    eos_id: int | None
+    future: concurrent.futures.Future
+    submitted_at: float = field(default_factory=time.perf_counter)
+    first_token_at: float | None = None
+    tokens: list[int] = field(default_factory=list)
+    slot: int = -1
+
+
+class LLMEngine:
+    """Continuous-batching decode engine over llama-family params."""
+
+    def __init__(self, cfg, params=None, *, max_batch: int = 8,
+                 max_len: int | None = None, seed: int = 0,
+                 steps_per_sync: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len or cfg.max_seq
+        # Decode steps per host round-trip.  Device→host sync latency is
+        # the TPU serving bottleneck (through a tunnel it can be >100ms);
+        # scanning K steps inside ONE compiled program amortizes it — the
+        # multi-step scheduling discipline of TPU LLM servers.  EOS /
+        # admission are checked every K tokens; overshoot is trimmed.
+        self.steps_per_sync = max(1, steps_per_sync)
+        self.params = params if params is not None else llama.init_params(
+            jax.random.PRNGKey(seed), cfg)
+        self.cache = llama.init_kv_cache(cfg, max_batch, self.max_len)
+        self._buckets = _buckets_for(self.max_len)
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        # One compiled K-step decode program; cache donated (in-place).
+        def _decode_k(params, cache, tokens, temps, rng):
+            def step(carry, key):
+                cache, toks = carry
+                logits, cache = llama.decode_step(params, cache, toks,
+                                                  cfg)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                sampled = jax.random.categorical(
+                    key, logits / jnp.maximum(temps, 1e-6)[:, None]
+                ).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                return (cache, nxt), nxt
+
+            keys = jax.random.split(rng, self.steps_per_sync)
+            (cache, last), seq = jax.lax.scan(step, (cache, tokens), keys)
+            return seq, last, cache   # seq [K, B]
+
+        self._decode = jax.jit(_decode_k, donate_argnums=(1,))
+
+        # Wave prefill: ONE compiled program admits a whole wave of
+        # requests — computes all their prompt KV and scatter-writes each
+        # into its slot.  Per-request prefill calls would each round-trip
+        # the (donated) cache through the runtime; one call per wave pays
+        # that cost once (the dominant serving overhead on a tunneled
+        # chip).  Waves are padded by duplicating the last row (same slot
+        # written twice with identical data — harmless), so there is one
+        # compile per prompt-length bucket, not per wave size.
+        def _prefill_wave(params, cache, tokens, true_lens, slots, temps,
+                          rng):
+            W = tokens.shape[0]
+            hidden, ks, vs = llama.prefill(params, tokens, cfg)
+
+            def write_one(carry, i):
+                k, v, pos = carry
+                k = jax.lax.dynamic_update_slice(
+                    k, ks[:, i][:, None], (0, slots[i], 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    v, vs[:, i][:, None], (0, slots[i], 0, 0, 0))
+                pos = pos.at[slots[i]].set(true_lens[i])
+                return (k, v, pos), None
+
+            (k, v, pos), _ = jax.lax.scan(
+                write_one, (cache["k"], cache["v"], cache["pos"]),
+                jnp.arange(W))
+            # Project only the W last-position rows through lm_head (the
+            # full [W, P, vocab] logits tensor would be GBs at serving
+            # shapes).
+            last_h = hidden[jnp.arange(W), true_lens - 1]    # [W, dim]
+            last = (last_h @ params["lm_head"]).astype(jnp.float32)
+            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            # Per-row keys folded from the SLOT index: duplicate padding
+            # rows (same slot, same logits, same temp) then draw the SAME
+            # sample, so cur-token and recorded token can't diverge under
+            # temperature sampling.
+            keys = jax.vmap(lambda s: jax.random.fold_in(rng, s))(slots)
+            sampled = jax.vmap(
+                lambda k_, l_, t_: jax.random.categorical(
+                    k_, l_ / jnp.maximum(t_, 1e-6)))(
+                        keys, last, temps).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return nxt, {"k": k, "v": v, "pos": pos}
+
+        self._prefill = jax.jit(_prefill_wave, donate_argnums=(1,))
+
+        # Slot state.  Current tokens live ON DEVICE between blocks: the
+        # decode output feeds the next decode input directly, so the only
+        # device→host sync per block is the token-sequence fetch.
+        self._slots: list[_Request | None] = [None] * max_batch
+        self._cur_dev = jnp.zeros((max_batch,), jnp.int32)
+        self._temps = np.zeros((max_batch,), np.float32)
+        self._set_slots = jax.jit(
+            lambda cur, slots, toks: cur.at[slots].set(toks))
+        self._waiting: queue.Queue[_Request] = queue.Queue()
+        self._error: BaseException | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        self.completed = 0
+
+    # ------------------------------------------------------------- public
+    def submit(self, prompt: list[int], max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               eos_id: int | None = None) -> concurrent.futures.Future:
+        """Thread-safe; resolves to {tokens, ttft_s, total_s}."""
+        if len(prompt) >= self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_len {self.max_len}")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.max_len}; "
+                "decode past the cache end would corrupt output")
+        if self._error is not None:
+            raise RuntimeError(
+                "LLM engine is dead after an earlier failure") \
+                from self._error
+        req = _Request(list(prompt), max_new_tokens, temperature, eos_id,
+                       concurrent.futures.Future())
+        self._waiting.put(req)
+        self._wake.set()
+        return req.future
+
+    def generate(self, prompt: list[int], max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 eos_id: int | None = None) -> dict:
+        """Blocking convenience wrapper."""
+        self.start()
+        return self.submit(prompt, max_new_tokens, temperature,
+                           eos_id).result()
+
+    def warmup(self, buckets: list[int] | None = None) -> None:
+        """Pre-compile the decode program and prefill buckets so the first
+        real request doesn't pay XLA compile time in its TTFT (the
+        standard TPU-serving warmup discipline)."""
+        for b in buckets or self._buckets:
+            self.generate(list(range(1, min(b, self.max_len - 1) + 1)),
+                          max_new_tokens=1)
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="llm-engine", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -------------------------------------------------------------- engine
+    def _admit(self) -> None:
+        """Prefill a whole wave of waiting requests in ONE device call;
+        one batched fetch materializes their first tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        wave: list[tuple[int, _Request]] = []    # (slot, request)
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                break
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.slot = free
+            self._slots[free] = req
+            self._temps[free] = req.temperature
+            wave.append((free, req))
+        if not wave:
+            return
+        W = len(wave)
+        bucket = next(b for b in self._buckets
+                      if b >= max(len(r.prompt) for _, r in wave))
+        # Pad the wave by duplicating the last row: the duplicate writes
+        # the same slot with the same data, so correctness is unaffected
+        # and the wave size stays a single compiled shape.
+        padded_w = self.max_batch
+        tokens = np.zeros((padded_w, bucket), np.int32)
+        true_lens = np.ones((padded_w,), np.int32)
+        slots = np.zeros((padded_w,), np.int32)
+        temps = np.zeros((padded_w,), np.float32)
+        for j in range(padded_w):
+            slot, req = wave[min(j, W - 1)]
+            tokens[j, :len(req.prompt)] = req.prompt
+            true_lens[j] = len(req.prompt)
+            slots[j] = slot
+            temps[j] = req.temperature
+        self._rng, sub = jax.random.split(self._rng)
+        slots_dev = jnp.asarray(slots)
+        nxt, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(true_lens), slots_dev,
+            jnp.asarray(temps), sub)
+        # Duplicate padding rows target the same slot with the same token.
+        self._cur_dev = self._set_slots(self._cur_dev, slots_dev, nxt)
+        firsts = np.asarray(nxt)[:W]
+        now = time.perf_counter()
+        for (slot, req), first in zip(wave, firsts):
+            req.first_token_at = now
+            req.tokens.append(int(first))
+            if self._done(req):
+                self._finish(slot)
+
+    def _done(self, req: _Request) -> bool:
+        return (len(req.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None
+                    and req.tokens[-1] == req.eos_id))
+
+    def _finish(self, slot: int) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self.completed += 1
+        now = time.perf_counter()
+        if not req.future.done():
+            req.future.set_result({
+                "tokens": req.tokens,
+                "ttft_s": (req.first_token_at or now) - req.submitted_at,
+                "total_s": now - req.submitted_at,
+            })
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001
+            # Fail every in-flight and waiting request: a silent thread
+            # death would hang their futures forever, and the donated
+            # cache is invalid after a failed call anyway.
+            self._error = e
+            for i, req in enumerate(self._slots):
+                if req is not None and not req.future.done():
+                    req.future.set_exception(e)
+                self._slots[i] = None
+            while True:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self._stop.set()
+            raise
+
+    def _loop_inner(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        while not self._stop.is_set():
+            self._admit()
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+            if not active:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            self._rng, sub = jax.random.split(self._rng)
+            seq, last, self.cache = self._decode(
+                self.params, self.cache, self._cur_dev,
+                jnp.asarray(self._temps), sub)
+            self._cur_dev = last                # stays on device
+            seq = np.asarray(seq)               # the ONE sync per block
+            for i in active:
+                req = self._slots[i]
+                for tok in seq[:, i]:
+                    req.tokens.append(int(tok))
+                    if self._done(req):
+                        # Trim K-step overshoot past EOS/max_new_tokens.
+                        self._finish(i)
+                        break
+
+    def stats(self) -> dict:
+        return {"completed": self.completed,
+                "active": sum(s is not None for s in self._slots),
+                "waiting": self._waiting.qsize(),
+                "max_batch": self.max_batch,
+                "max_len": self.max_len}
+
+
+class LLMServer:
+    """Serve deployment body: one engine per replica.
+
+    serve.deployment(LLMServer).options(...) — requests carry token-id
+    prompts; a tokenizer front can be composed as another deployment.
+    """
+
+    def __init__(self, model: str = "debug", *, max_batch: int = 8,
+                 max_len: int | None = None, params=None, seed: int = 0,
+                 warmup: bool = False):
+        from ray_tpu.models import llama
+
+        cfg = llama.llama_configs()[model] if isinstance(model, str) \
+            else model
+        self.engine = LLMEngine(cfg, params, max_batch=max_batch,
+                                max_len=max_len, seed=seed)
+        self.engine.start()
+        if warmup:
+            self.engine.warmup()
+
+    async def __call__(self, request: dict) -> dict:
+        import asyncio
+
+        fut = self.engine.submit(
+            request["prompt"],
+            max_new_tokens=request.get("max_new_tokens", 32),
+            temperature=request.get("temperature", 0.0),
+            eos_id=request.get("eos_id"))
+        return await asyncio.wrap_future(fut)
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,106 @@
+"""Continuous-batched LLM engine: numerics vs full forward, slot reuse,
+concurrency, and the Serve deployment body.
+
+Reference analog: serve LLM workloads (ray: release/serve_tests/) — here
+correctness-tested at debug scale on CPU: incremental prefill+decode must
+reproduce the full-context forward pass exactly (fp32).
+"""
+import concurrent.futures
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=64, remat=False, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+def _reference_greedy(params, cfg, prompt, n_new):
+    """Full-context forward per step — the slow-but-sure decoder."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = llama.forward(params, jnp.asarray([toks]), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_engine_matches_full_forward_greedy(small):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = small
+    eng = LLMEngine(cfg, params, max_batch=2, max_len=64)
+    try:
+        for prompt in ([5, 9, 2], [17, 3, 44, 8, 11, 23, 6]):
+            got = eng.generate(prompt, max_new_tokens=8)
+            assert got["tokens"] == _reference_greedy(
+                params, cfg, prompt, 8), prompt
+            assert got["ttft_s"] > 0 and got["total_s"] >= got["ttft_s"]
+    finally:
+        eng.stop()
+
+
+def test_continuous_batching_oversubscribed(small):
+    """More requests than slots: admission waits for free slots, every
+    request completes, greedy results stay independent of batching."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = small
+    eng = LLMEngine(cfg, params, max_batch=2, max_len=64)
+    eng.start()
+    try:
+        prompts = [[i + 1, i + 2, i + 3] for i in range(5)]
+        futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        results = [f.result(timeout=120) for f in futs]
+        assert eng.completed == 5
+        for p, r in zip(prompts, results):
+            assert r["tokens"] == _reference_greedy(params, cfg, p, 6), p
+    finally:
+        eng.stop()
+
+
+def test_eos_stops_generation(small):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = small
+    eng = LLMEngine(cfg, params, max_batch=1, max_len=64)
+    try:
+        free_run = eng.generate([5, 9, 2], max_new_tokens=8)
+        eos = free_run["tokens"][2]
+        stopped = eng.generate([5, 9, 2], max_new_tokens=8, eos_id=eos)
+        assert stopped["tokens"] == free_run["tokens"][:3]
+    finally:
+        eng.stop()
+
+
+def test_llm_server_deployment_body(small):
+    import asyncio
+
+    from ray_tpu.serve.llm import LLMServer
+
+    cfg, params = small
+    server = LLMServer(cfg, params=params, max_batch=2, max_len=64)
+    try:
+        async def drive():
+            return await asyncio.gather(*[
+                server({"prompt": [3, 1, 4], "max_new_tokens": 4})
+                for _ in range(3)])
+
+        results = asyncio.run(drive())
+        assert all(len(r["tokens"]) == 4 for r in results)
+        assert server.stats()["completed"] >= 3
+    finally:
+        server.engine.stop()

@@ -45,23 +45,31 @@ def test_zero_copy_numpy(arena):
     assert len(frames) >= 2
 
 
-def test_eviction_lru(arena):
-    # Fill beyond capacity with unpinned objects; oldest must be evicted.
+def test_no_implicit_eviction_when_full(arena):
+    """A full arena refuses new puts instead of silently dropping sealed
+    (referenced) objects — the StoreRunner spills to disk on failure
+    (ray: plasma never evicts referenced objects; LocalObjectManager
+    spills them)."""
     blob = [b"z" * (1024 * 1024)]
-    ids = [bytes([i]) * 16 for i in range(12)]
+    ids = [bytes([i + 1]) * 16 for i in range(12)]
+    stored = []
     for oid in ids:
-        assert arena.put_frames(oid, blob), "eviction should free space"
-    assert not arena.contains(ids[0])       # LRU victim gone
-    assert arena.contains(ids[-1])
+        if not arena.put_frames(oid, blob):
+            break
+        stored.append(oid)
+    assert 0 < len(stored) < 12, "arena should fill before 12 MB"
+    for oid in stored:
+        assert arena.contains(oid), "no sealed object may be dropped"
+    # oldest() surfaces the LRU spill candidate for the StoreRunner.
+    assert arena.oldest() == stored[0]
 
 
-def test_pinned_objects_survive_eviction(arena):
-    oid0 = b"P" * 16
-    arena.put_frames(oid0, [b"q" * (1024 * 1024)])
+def test_oldest_skips_pinned(arena):
+    oid0, oid1 = b"P" * 16, b"Q" * 16
+    arena.put_frames(oid0, [b"q" * 1024])
+    arena.put_frames(oid1, [b"r" * 1024])
     pinned = arena.get_frames(oid0)          # holds a pin via the views
-    for i in range(12):
-        arena.put_frames(bytes([100 + i]) * 16, [b"z" * (1024 * 1024)])
-    assert arena.contains(oid0), "pinned object must not be evicted"
+    assert arena.oldest() == oid1, "pinned object must not be a victim"
     assert bytes(pinned[0][:1]) == b"q"
     del pinned
 
