@@ -32,9 +32,16 @@ class Config:
     # Max task leases a submitter keeps per scheduling key
     # (ray: max_pending_lease_requests_per_scheduling_category).
     max_leases_per_scheduling_key: int = 8
-    # In-flight pushes per leased worker (hides RPC round-trip latency;
-    # ray: normal_task_submitter.h pipelining discipline).
-    task_push_pipeline_depth: int = 4
+    # Max tasks coalesced into one push to a leased worker (hides RPC
+    # round-trip latency and amortizes per-message overhead; the pusher
+    # still takes only its fair share of the queue, so batching never
+    # starves other idle workers).
+    task_push_pipeline_depth: int = 16
+    # Max queued calls per actor coalesced into one RPC, and how many
+    # such batches may be in flight concurrently (execution overlap for
+    # async/threaded actors).
+    actor_call_batch_size: int = 64
+    actor_max_inflight_batches: int = 16
     # Idle seconds before a leased worker is returned to the pool.
     lease_idle_timeout_s: float = 1.0
     # Workers prestarted per node agent at boot.

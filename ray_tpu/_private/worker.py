@@ -199,11 +199,26 @@ class LeaseManager:
                     fair = -(-len(q) // active)          # ceil division
                     batch = [q.pop(0)
                              for _ in range(min(depth, fair, len(q)))]
-                    if len(batch) == 1:
-                        await self._push_one(batch[0], lease)
+                    # One RPC for a whole batch of dependency-free tasks:
+                    # per-message zmq + event-loop overhead is the
+                    # control-plane cost, so coalescing amortizes it N×.
+                    # Tasks WITH top-level ref args never join a batch —
+                    # their arg resolution may need an earlier batch
+                    # member's reply, which only ships when the whole
+                    # batch finishes (deadlock).
+                    plain = [t for t in batch
+                             if not t.header.get("arg_refs")]
+                    dep = [t for t in batch if t.header.get("arg_refs")]
+                    ops = []
+                    if len(plain) == 1:
+                        ops.append(self._push_one(plain[0], lease))
+                    elif plain:
+                        ops.append(self._push_batch(plain, lease))
+                    ops.extend(self._push_one(t, lease) for t in dep)
+                    if len(ops) == 1:
+                        await ops[0]
                     else:
-                        await asyncio.gather(
-                            *[self._push_one(t, lease) for t in batch])
+                        await asyncio.gather(*ops)
                 # Queue drained: only the last surviving pusher lingers.
                 if self.pushers.get(key, 0) > 1:
                     break
@@ -267,6 +282,31 @@ class LeaseManager:
             return
         self.core._on_task_reply(task, reply, blobs)
 
+    async def _push_batch(self, batch: list, lease: dict) -> None:
+        """Push N tasks in one RPC (worker executes them in order and
+        replies once with all results)."""
+        worker_addr = lease["worker_addr"]
+        blobs: list = []
+        headers = []
+        for t in batch:
+            headers.append({**t.header, "nframes": len(t.blobs)})
+            blobs.extend(t.blobs)
+        try:
+            reply, rblobs = await self.core.clients.get(worker_addr).call(
+                "push_task_batch", {"tasks": headers}, blobs)
+        except (ConnectionLost, RemoteError) as e:
+            if worker_addr in self.core._oom_worker_addrs:
+                e = ConnectionLost(
+                    f"{worker_addr}: OOM-killed by the node memory monitor")
+            for t in batch:
+                await self._on_push_failure(t, e)
+            return
+        offset = 0
+        for t, tr in zip(batch, reply["replies"]):
+            n = tr.pop("nblobs")
+            self.core._on_task_reply(t, tr, rblobs[offset:offset + n])
+            offset += n
+
     async def _on_push_failure(self, task: PendingTask, exc: Exception) -> None:
         """Worker died mid-task: retry if budget remains
         (ray: TaskManager::FailOrRetryPendingTask task_manager.h:48)."""
@@ -293,6 +333,11 @@ class ActorSubmitState:
     resolving: asyncio.Future | None = None
     dead: bool = False
     death_cause: str = ""
+    # Coalescing outbox: queued calls drain in seqno order, many per RPC.
+    outbox: list = field(default_factory=list)
+    draining: bool = False
+    # Bounds concurrent in-flight batches (created lazily on the loop).
+    send_sem: Any = None
 
 
 class ActorInstance:
@@ -352,6 +397,11 @@ class CoreWorker:
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
         self._oom_worker_addrs: set[str] = set()
+        # Worker-local cache of this worker's own task returns: a consumer
+        # task scheduled here reads them without asking the owner (ray:
+        # locality — plasma already holds the return on the producing
+        # node).  Bounded FIFO; consumers also evict after use.
+        self._return_cache: list[bytes] = []
         self._running_async: dict[bytes, asyncio.Task] = {}
         self._shutdown = threading.Event()
         self._task_events: list[dict] = []
@@ -1167,7 +1217,54 @@ class CoreWorker:
         return {"state": "pending"}, []
 
     # ------------------------------------------------------------ execution
+    async def rpc_push_task_batch(self, h: dict,
+                                  blobs: list) -> tuple[dict, list]:
+        """Batched push: execute each task in order, one combined reply
+        (amortizes per-message RPC overhead on the task hot path).  One
+        member's escaping exception must NOT void its completed siblings
+        (their side effects and pin ACKs are already real), so every
+        member is error-isolated into its own reply."""
+        replies, out_blobs = [], []
+        offset = 0
+        for th in h["tasks"]:
+            n = th.pop("nframes")
+            try:
+                reply, rb = await self.rpc_push_task(
+                    th, blobs[offset:offset + n])
+            except BaseException as e:  # noqa: BLE001
+                reply, rb = self._error_reply(e)
+            offset += n
+            reply["nblobs"] = len(rb)
+            replies.append(reply)
+            out_blobs.extend(rb)
+        return {"replies": replies}, out_blobs
+
     async def rpc_push_task(self, h: dict, blobs: list) -> tuple[dict, list]:
+        try:
+            reply, rb = await self._execute_pushed_task(h, blobs)
+        except BaseException as e:  # noqa: BLE001
+            reply, rb = self._error_reply(e)
+        if reply.get("status") == "error" and self.mode == "worker":
+            # Cache the error locally too: a same-batch consumer of this
+            # task's return must resolve it WITHOUT an owner round-trip —
+            # the owner only learns the error when the whole batch
+            # replies, which waits on that consumer (deadlock otherwise).
+            import pickle
+
+            try:
+                cause = pickle.loads(rb[0]) if rb else None
+            except Exception:  # noqa: BLE001
+                cause = None
+            err = TaskError(cause or RuntimeError("task failed"),
+                            reply.get("traceback", ""))
+            tid = TaskID(bytes.fromhex(h["task_id"]))
+            for i in range(h.get("num_returns", 1)):
+                self._cache_local_return(
+                    ObjectID.for_return(tid, i).binary(), error=err)
+        return reply, rb
+
+    async def _execute_pushed_task(self, h: dict,
+                                   blobs: list) -> tuple[dict, list]:
         task_id = bytes.fromhex(h["task_id"])
         if task_id in self._cancelled:
             self._cancelled.discard(task_id)
@@ -1269,21 +1366,50 @@ class CoreWorker:
             # Only pins that actually landed are reported to the caller:
             # its later release must match an add, or the owner undercounts.
             contained = [[oid.hex(), owner] for oid, owner in pinned]
+            rid = ObjectID.for_return(TaskID(task_id), i).binary()
             if sv.total_bytes <= self.config.max_inline_object_size:
                 returns.append({"inline": True, "nframes": len(sv.frames),
                                 "contained": contained})
                 out_blobs.extend(sv.frames)
+                if self.mode == "worker":
+                    self._cache_local_return(rid, frames=sv.frames)
             else:
-                oid = ObjectID.for_return(TaskID(task_id), i)
                 stored = await self.loop.run_in_executor(
-                    None, self._store_frames_local, oid.binary(), sv.frames)
+                    None, self._store_frames_local, rid, sv.frames)
                 if not stored:
                     reply, _ = await self.clients.get(self.agent_addr).call(
-                        "store_put", {"object_id": oid.hex()}, sv.frames)
+                        "store_put", {"object_id": rid.hex()}, sv.frames)
                 returns.append({"inline": False,
                                 "location": self.agent_addr,
                                 "contained": contained})
+                if self.mode == "worker":
+                    self._cache_local_return(
+                        rid, locations=[self.agent_addr])
         return {"status": "ok", "returns": returns}, out_blobs
+
+    def _cache_local_return(self, rid: bytes, frames: list | None = None,
+                            locations: list | None = None,
+                            error: BaseException | None = None) -> None:
+        """Locality cache: a same-worker consumer resolves this return
+        without an owner round-trip — which would DEADLOCK inside a
+        batched push (the producer's reply ships only when the whole
+        batch completes) and is a wasted RTT otherwise.  Retried tasks
+        overwrite by object id; as in the reference, retries assume
+        deterministic tasks (a stale copy on a worker equals a stale
+        plasma copy on a node)."""
+        e = self.memory.entry(rid)
+        if frames is not None:
+            e.frames = frames
+        if locations is not None:
+            e.locations = list(locations)
+        if error is not None:
+            e.error = error
+        e.event.set()
+        self._return_cache.append(rid)
+        while len(self._return_cache) > 512:
+            old = self._return_cache.pop(0)
+            if old not in self.owned and old not in self.borrows:
+                self.memory.delete(old)
 
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
@@ -1323,11 +1449,48 @@ class CoreWorker:
             self._evict_untracked_args(h)
 
     async def rpc_actor_call(self, h: dict, blobs: list) -> tuple[dict, list]:
+        started = await self._actor_call_begin(h, blobs)
+        return await started
+
+    async def rpc_actor_call_batch(self, h: dict,
+                                   blobs: list) -> tuple[dict, list]:
+        """Batched actor calls from one caller: START all in seqno order
+        (so async/threaded actors still overlap execution), then gather
+        the replies into one message (amortizes per-call RPC overhead)."""
+        finishers = []
+        offset = 0
+        for ch in h["calls"]:
+            n = ch.pop("nframes")
+            finishers.append(
+                await self._actor_call_begin(ch, blobs[offset:offset + n]))
+            offset += n
+        # Error-isolate each member: a sibling's escaping exception must
+        # not abort calls that already executed (their side effects are
+        # real; a batch-level error would retry or fail them all).
+        results = await asyncio.gather(*finishers,
+                                       return_exceptions=True)
+        replies, out_blobs = [], []
+        for r in results:
+            if isinstance(r, BaseException):
+                rh, rb = self._error_reply(r)
+            else:
+                rh, rb = r
+            rh["nblobs"] = len(rb)
+            replies.append(rh)
+            out_blobs.extend(rb)
+        return {"replies": replies}, out_blobs
+
+    async def _actor_call_begin(self, h: dict, blobs: list):
+        """Ordering + dispatch phase; returns an awaitable yielding the
+        packed reply (execution proceeds concurrently after dispatch)."""
         inst = self.actors_hosted.get(h["actor_id"])
         if inst is None:
-            return {"status": "error", "traceback": "actor not hosted here"}, [
-                __import__("pickle").dumps(
-                    ActorDiedError(h["actor_id"], "not hosted"))]
+            async def _not_hosted():
+                return ({"status": "error",
+                         "traceback": "actor not hosted here"},
+                        [__import__("pickle").dumps(
+                            ActorDiedError(h["actor_id"], "not hosted"))])
+            return _not_hosted()
         caller = h.get("caller", "?")
         seq = h.get("seqno", 0)
         if os.environ.get("RAY_TPU_ACTOR_TRACE"):
@@ -1347,8 +1510,8 @@ class CoreWorker:
             try:
                 started = await self._start_actor_method(inst, h, blobs)
             except BaseException as e:  # noqa: BLE001
-                return self._error_reply(e)
-            return await started
+                return self._immediate_reply(self._error_reply(e))
+            return started
         if seq != nxt:
             # Out-of-order arrival: park until predecessors START
             # (ray: ActorSchedulingQueue buffering by seq_no).
@@ -1364,14 +1527,20 @@ class CoreWorker:
         try:
             started = await self._start_actor_method(inst, h, blobs)
         except BaseException as e:  # noqa: BLE001
-            return self._error_reply(e)
+            return self._immediate_reply(self._error_reply(e))
         finally:
             inst.next_seq[caller] = seq + 1
             buf = inst.buffered.get(caller, {})
             nxt_fut = buf.pop(seq + 1, None)
             if nxt_fut and not nxt_fut.done():
                 nxt_fut.set_result(None)
-        return await started
+        return started
+
+    @staticmethod
+    def _immediate_reply(reply: tuple):
+        async def _done():
+            return reply
+        return _done()
 
     async def _start_actor_method(self, inst: ActorInstance, h: dict,
                                   blobs: list):
@@ -1471,49 +1640,108 @@ class CoreWorker:
             st = self._actor_state(actor_id)
             header["seqno"] = st.seqno
             st.seqno += 1
-            self.loop.create_task(self._push_actor_task(
-                st, header, blobs, return_ids, max_task_retries, borrowed))
+            self._push_actor_task(
+                st, header, blobs, return_ids, max_task_retries, borrowed)
 
         self.loop.call_soon_threadsafe(_go)
         return refs
 
-    async def _push_actor_task(self, st: ActorSubmitState, header: dict,
-                               blobs: list, return_ids: list[bytes],
-                               retries: int,
-                               borrowed: list | None = None) -> None:
+    def _push_actor_task(self, st: ActorSubmitState, header: dict,
+                         blobs: list, return_ids: list[bytes],
+                         retries: int,
+                         borrowed: list | None = None) -> None:
         task = PendingTask(
             task_id=bytes.fromhex(header["task_id"]), header=header,
             blobs=blobs, return_ids=return_ids, retries_left=0,
             retry_exceptions=False, scheduling_key=(),
             borrowed=borrowed or [])
+        # Coalescing outbox: one drainer per actor sends queued calls in
+        # seqno order, many per RPC when the queue is deep (per-message
+        # overhead is the 1:1 actor-call throughput cost); a lone call
+        # goes out immediately as a single actor_call.
+        st.outbox.append((task, retries))
+        if not st.draining:
+            st.draining = True
+            self.loop.create_task(self._drain_actor_outbox(st))
+
+    async def _drain_actor_outbox(self, st: ActorSubmitState) -> None:
+        """Dispatch outbox batches, keeping several in flight: a batch's
+        reply arrives only when its calls COMPLETE, so awaiting each batch
+        would serialize long-running calls on async/threaded actors.
+        zmq per-connection ordering + receiver seqno parking preserve call
+        order across concurrent batches."""
+        if st.send_sem is None:
+            st.send_sem = asyncio.Semaphore(
+                self.config.actor_max_inflight_batches)
+        try:
+            while st.outbox:
+                limit = self.config.actor_call_batch_size
+                batch = st.outbox[:limit]
+                del st.outbox[:len(batch)]
+                await st.send_sem.acquire()
+                t = self.loop.create_task(self._send_actor_batch(st, batch))
+                t.add_done_callback(lambda _t, s=st: s.send_sem.release())
+        finally:
+            st.draining = False
+            if st.outbox:
+                st.draining = True
+                self.loop.create_task(self._drain_actor_outbox(st))
+
+    def _fail_actor_call(self, task: PendingTask,
+                         err: BaseException) -> None:
+        for rid in task.return_ids:
+            self._resolve_error(rid, err)
+        self._release_task_borrows(task)
+
+    async def _send_actor_batch(self, st: ActorSubmitState,
+                                batch: list) -> None:
+        """Deliver one batch (retrying per-call budgets on connection
+        loss); returns once every call has a reply or a terminal error."""
         while True:
             if st.dead:
                 err = ActorDiedError(st.actor_id, st.death_cause)
-                for rid in return_ids:
-                    self._resolve_error(rid, err)
-                self._release_task_borrows(task)
+                for task, _ in batch:
+                    self._fail_actor_call(task, err)
                 return
             addr = await self._resolve_actor_addr(st)
             if addr is None:
-                continue    # loops back; st.dead now set or address refreshed
+                continue    # loops back; st.dead set or address refreshed
             try:
+                if len(batch) == 1:
+                    task, _ = batch[0]
+                    reply, rblobs = await self.clients.get(addr).call(
+                        "actor_call", task.header, task.blobs)
+                    self._on_task_reply(task, reply, rblobs)
+                    return
+                headers = [{**t.header, "nframes": len(t.blobs)}
+                           for t, _ in batch]
+                blobs: list = []
+                for t, _ in batch:
+                    blobs.extend(t.blobs)
                 reply, rblobs = await self.clients.get(addr).call(
-                    "actor_call", header, blobs)
+                    "actor_call_batch", {"calls": headers}, blobs)
             except (ConnectionLost, RemoteError):
                 if st.address == addr:
                     st.address = None
-                # In-flight call lost: resend only with an explicit retry
-                # budget (ray: max_task_retries; default 0 = at-most-once,
-                # the call fails with an actor error).
-                if retries > 0:
-                    retries -= 1
-                    continue
-                err = ActorError(st.actor_id, "actor worker connection lost")
-                for rid in return_ids:
-                    self._resolve_error(rid, err)
-                self._release_task_borrows(task)
-                return
-            self._on_task_reply(task, reply, rblobs)
+                # In-flight calls lost: resend only those with an explicit
+                # retry budget (ray: max_task_retries; default 0 =
+                # at-most-once → actor error).
+                still = []
+                for task, r in batch:
+                    if r > 0:
+                        still.append((task, r - 1))
+                    else:
+                        self._fail_actor_call(task, ActorError(
+                            st.actor_id, "actor worker connection lost"))
+                if not still:
+                    return
+                batch = still
+                continue
+            offset = 0
+            for (task, _), tr in zip(batch, reply["replies"]):
+                n = tr.pop("nblobs")
+                self._on_task_reply(task, tr, rblobs[offset:offset + n])
+                offset += n
             return
 
     async def _resolve_actor_addr(self, st: ActorSubmitState) -> str | None:
