@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -87,11 +88,19 @@ class PlacementGroupInfo:
 
 
 class Controller:
-    def __init__(self, config: Config, host: str = "127.0.0.1"):
+    def __init__(self, config: Config, host: str = "127.0.0.1",
+                 port: int | None = None,
+                 snapshot_path: str | None = None):
         self.config = config
+        self.host = host
         self.ctx = zmq.asyncio.Context.instance()
-        self.server = RpcServer(self.ctx, host)
-        self.publisher = Publisher(self.ctx, host)
+        self.server = RpcServer(self.ctx, host, port=port)
+        # Created in start(): a restarted controller must rebind the
+        # publisher at the SNAPSHOTTED port, or every subscribed agent
+        # and driver goes silently dark (SUB sockets reconnect to the
+        # old endpoint underneath).
+        self.publisher: Publisher | None = None
+        self._restored_pub_port: int | None = None
         self.clients = ClientPool(self.ctx)
         self.nodes: dict[str, NodeInfo] = {}
         self.actors: dict[str, ActorInfo] = {}
@@ -101,16 +110,117 @@ class Controller:
         self.jobs: dict[str, dict] = {}
         self._tasks_events: list[dict] = []
         self._bg: list[asyncio.Task] = []
+        # Metadata persistence (the Redis-backed GCS fault-tolerance
+        # analog, ray: StorageType::REDIS_PERSIST gcs_server.cc:41-78):
+        # durable tables snapshot to a local file; a restarted controller
+        # at the same port restores them, agents re-register via the
+        # heartbeat not-ok path, and live actor addresses keep working.
+        self.snapshot_path = snapshot_path
+        self._restored_at: float | None = None
+        self._last_snapshot_blob: bytes | None = None
 
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
+        restored = False
+        if self.snapshot_path and os.path.exists(self.snapshot_path):
+            try:
+                self._restore_snapshot()
+                restored = True
+            except Exception:  # noqa: BLE001
+                logger.exception("snapshot restore failed; starting fresh")
+        self.publisher = Publisher(self.ctx, self.host,
+                                   port=self._restored_pub_port)
         self.server.register_all(self)
         self.server.start()
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._health_loop()))
         self._bg.append(loop.create_task(self._resource_broadcast_loop()))
+        if self.snapshot_path:
+            self._bg.append(loop.create_task(self._snapshot_loop()))
+        if restored:
+            self._restart_restored_scheduling(loop)
         logger.info("controller up at %s (pub %s)",
                     self.server.address, self.publisher.address)
+
+    def _restart_restored_scheduling(self, loop) -> None:
+        """Resume work interrupted by the crash: PENDING/RESTARTING actor
+        creations and PENDING placement groups were persisted precisely so
+        the restarted controller can drive them to completion; without
+        this they stall forever (their waiters never resolve)."""
+        self._restored_at = time.monotonic()
+        for actor in self.actors.values():
+            if actor.state in (PENDING, RESTARTING):
+                loop.create_task(self._schedule_actor(actor))
+        for pg in self.pgs.values():
+            if pg.state == "PENDING":
+                loop.create_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------- persistence
+    def _snapshot_state(self) -> dict:
+        import pickle
+
+        return pickle.dumps({
+            "actors": {
+                aid: {
+                    "actor_id": a.actor_id, "name": a.name,
+                    "namespace": a.namespace, "owner_addr": a.owner_addr,
+                    "creation_spec": a.creation_spec,
+                    "creation_header": a.creation_header,
+                    "resources": a.resources,
+                    "max_restarts": a.max_restarts, "state": a.state,
+                    "address": a.address, "node_id": a.node_id,
+                    "restarts_used": a.restarts_used,
+                    "death_cause": a.death_cause, "detached": a.detached,
+                    "pg_id": a.pg_id, "bundle_index": a.bundle_index,
+                    "affinity_node_id": a.affinity_node_id,
+                    "affinity_soft": a.affinity_soft,
+                } for aid, a in self.actors.items()},
+            "named_actors": dict(self.named_actors),
+            "pgs": {
+                pid: {"pg_id": p.pg_id, "name": p.name,
+                      "strategy": p.strategy, "bundles": p.bundles,
+                      "state": p.state,
+                      "bundle_nodes": dict(p.bundle_nodes)}
+                for pid, p in self.pgs.items()},
+            "kv": self.kv,
+            "jobs": self.jobs,
+            "pub_port": int(self.publisher.address.rsplit(":", 1)[1]),
+        })
+
+    def _restore_snapshot(self) -> None:
+        import pickle
+
+        with open(self.snapshot_path, "rb") as f:
+            snap = pickle.loads(f.read())
+        for aid, a in snap["actors"].items():
+            self.actors[aid] = ActorInfo(**a)
+        self.named_actors = {tuple(k) if not isinstance(k, tuple) else k: v
+                             for k, v in snap["named_actors"].items()}
+        for pid, p in snap["pgs"].items():
+            self.pgs[pid] = PlacementGroupInfo(
+                pg_id=p["pg_id"], name=p["name"], strategy=p["strategy"],
+                bundles=p["bundles"], state=p["state"],
+                bundle_nodes=p["bundle_nodes"])
+        self.kv = snap["kv"]
+        self.jobs = snap["jobs"]
+        self._restored_pub_port = snap.get("pub_port")
+        logger.info("restored snapshot: %d actors, %d pgs, %d kv ns",
+                    len(self.actors), len(self.pgs), len(self.kv))
+
+    async def _snapshot_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                blob = self._snapshot_state()
+                if blob == self._last_snapshot_blob:
+                    continue        # unchanged: skip the disk write
+                tmp = self.snapshot_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self.snapshot_path)
+                self._last_snapshot_blob = blob
+            except Exception:  # noqa: BLE001
+                logger.exception("snapshot write failed")
 
     def close(self) -> None:
         for t in self._bg:
@@ -152,6 +262,19 @@ class Controller:
                         and now - node.last_heartbeat
                         > self.config.node_death_timeout_s):
                     await self._on_node_dead(node)
+            # Post-restore reconciliation: restored ALIVE actors whose
+            # node never re-registered (it died during the controller
+            # outage) would otherwise stay ALIVE forever — their node is
+            # absent from self.nodes, so _on_node_dead can never fire.
+            if (self._restored_at is not None
+                    and now - self._restored_at
+                    > 2 * self.config.node_death_timeout_s):
+                self._restored_at = None
+                known = set(self.nodes)
+                for actor in list(self.actors.values()):
+                    if actor.state == ALIVE and actor.node_id not in known:
+                        await self._on_actor_dead(
+                            actor, "node lost during controller outage")
 
     async def _on_node_dead(self, node: NodeInfo) -> None:
         node.state = "DEAD"
@@ -193,6 +316,14 @@ class Controller:
 
     async def rpc_get_cluster_view(self, h: dict, _b: list) -> dict:
         return {"view": self._cluster_view()}
+
+    async def rpc_push_logs(self, h: dict, _b: list) -> dict:
+        """Worker log lines from a node agent → "logs" topic (drivers
+        with log_to_driver print them; ray: log_monitor → GCS pubsub)."""
+        await self.publisher.publish(
+            "logs", {"node_id": h.get("node_id", "?"),
+                     "lines": h.get("lines", [])})
+        return {}
 
     # ------------------------------------------------------------------ KV
     async def rpc_kv_put(self, h: dict, b: list) -> dict:
@@ -554,6 +685,8 @@ def main() -> None:
     _watch_parent()
     p = argparse.ArgumentParser()
     p.add_argument("--config-json", default="{}")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--snapshot-path", default="")
     args = p.parse_args()
     logging.basicConfig(
         level=logging.INFO,
@@ -561,7 +694,8 @@ def main() -> None:
     config = Config().override(_json.loads(args.config_json))
 
     async def _run():
-        c = Controller(config)
+        c = Controller(config, port=args.port or None,
+                       snapshot_path=args.snapshot_path or None)
         await c.start()
         # Hand the chosen addresses back to the parent over stdout.
         print(_json.dumps({"controller_addr": c.server.address,

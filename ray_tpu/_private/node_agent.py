@@ -113,6 +113,13 @@ class NodeAgent:
         self._device_worker_id: str | None = None
         self._closed = False
         self.store = None  # shared-memory store runner, attached in start()
+        import tempfile
+
+        self._log_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"session_{self.node_id[:8]}_{os.getpid()}", "logs")
+        # log file path -> bytes already forwarded
+        self._log_offsets: dict[str, int] = {}
 
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
@@ -134,6 +141,7 @@ class NodeAgent:
         self._bg.append(loop.create_task(self._heartbeat_loop()))
         self._bg.append(loop.create_task(self._reaper_loop()))
         self._bg.append(loop.create_task(self._memory_monitor_loop()))
+        self._bg.append(loop.create_task(self._log_tail_loop()))
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info("agent %s up at %s resources=%s",
@@ -196,10 +204,23 @@ class NodeAgent:
             # Plain workers must never grab the TPU chip
             # (ray analog: CUDA_VISIBLE_DEVICES isolation in worker_pool).
             env["JAX_PLATFORMS"] = "cpu"
+        if os.environ.get("RAY_TPU_WORKER_LOGS"):
+            stdout = stderr = None          # inherit (debugging)
+        else:
+            # Per-worker log files; the agent tails them and forwards new
+            # lines to drivers (ray: worker logs in the session dir +
+            # log_monitor.py streaming driver-bound logs via GCS pubsub).
+            os.makedirs(self._log_dir, exist_ok=True)
+            stdout = open(os.path.join(
+                self._log_dir, f"worker-{worker_id[:12]}.out"), "ab")
+            stderr = open(os.path.join(
+                self._log_dir, f"worker-{worker_id[:12]}.err"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
-            if not os.environ.get("RAY_TPU_WORKER_LOGS") else None)
+            env=env, stdout=stdout, stderr=stderr)
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               is_device_worker=device_worker)
         self.workers[worker_id] = handle
@@ -276,6 +297,77 @@ class NodeAgent:
                     except Exception:  # noqa: BLE001
                         pass
 
+    async def _log_tail_loop(self) -> None:
+        """Tail worker log files; forward new lines to the controller,
+        which rebroadcasts them on the "logs" topic for drivers
+        (ray: log_monitor.py → GCS pubsub → driver console)."""
+        while not self._closed:
+            await asyncio.sleep(0.5)
+            try:
+                lines = self._collect_new_log_lines()
+            except Exception:  # noqa: BLE001
+                continue
+            if not lines:
+                continue
+            try:
+                await self.clients.get(self.controller_addr).notify(
+                    "push_logs", {"node_id": self.node_id[:8],
+                                  "lines": lines})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _collect_new_log_lines(self, max_lines: int = 200) -> list:
+        lines: list = []
+        if not os.path.isdir(self._log_dir):
+            return lines
+        for fname in sorted(os.listdir(self._log_dir)):
+            path = os.path.join(self._log_dir, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._log_offsets.get(path, 0)
+            if size <= off:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(min(size - off, 256 * 1024))
+            except OSError:
+                continue
+            # Forward only complete lines; partial tails wait for more.
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                continue
+            src = fname.rsplit(".", 1)[0]
+            batch = chunk[:cut].splitlines(keepends=True)
+            # Advance the offset ONLY past lines actually forwarded; a
+            # burst beyond the cap is picked up next poll, not dropped.
+            consumed = 0
+            for ln in batch[:max_lines]:
+                lines.append(
+                    [src, ln.rstrip(b"\r\n").decode("utf-8",
+                                                    "replace")[:2000]])
+                consumed += len(ln)
+            self._log_offsets[path] = off + consumed
+        return lines
+
+    def _prune_worker_logs(self, worker_id: str) -> None:
+        """Forward a dead worker's remaining lines on the next poll, then
+        drop its files + offsets (churned workers must not accumulate)."""
+        prefix = f"worker-{worker_id[:12]}"
+
+        def _cleanup():
+            for suffix in (".out", ".err"):
+                path = os.path.join(self._log_dir, prefix + suffix)
+                self._log_offsets.pop(path, None)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        # 2s grace: two tail polls pick up the crash output first.
+        asyncio.get_running_loop().call_later(2.0, _cleanup)
+
     async def _memory_monitor_loop(self) -> None:
         """Kill a worker when host/cgroup memory crosses the threshold
         (ray: MemoryMonitor memory_monitor.h:52 + retriable-FIFO policy)."""
@@ -334,6 +426,7 @@ class NodeAgent:
             except Exception:  # noqa: BLE001
                 pass
         self.workers.pop(w.worker_id, None)
+        self._prune_worker_logs(w.worker_id)
         self._try_grant_pending()
 
     # -------------------------------------------------------------- leasing

@@ -63,12 +63,19 @@ class ConnectionLost(RpcError):
 class RpcServer:
     """ROUTER-socket server dispatching to registered async handlers."""
 
-    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1"):
+    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1",
+                 port: int | None = None):
         self._ctx = ctx
         self._sock = ctx.socket(zmq.ROUTER)
         self._sock.setsockopt(zmq.LINGER, 0)
         self._sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
-        port = self._sock.bind_to_random_port(f"tcp://{host}")
+        if port:
+            # Fixed port: lets a restarted controller come back at the
+            # SAME address so agents/clients reconnect transparently
+            # (zmq DEALERs retry; the GCS-fault-tolerance analog).
+            self._sock.bind(f"tcp://{host}:{port}")
+        else:
+            port = self._sock.bind_to_random_port(f"tcp://{host}")
         self.address = f"{host}:{port}"
         self._handlers: dict[str, Handler] = {}
         self._task: asyncio.Task | None = None
@@ -236,10 +243,17 @@ class ClientPool:
 class Publisher:
     """PUB socket; topics are utf8 prefixes (ray: pubsub publisher)."""
 
-    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1"):
+    def __init__(self, ctx: zmq.asyncio.Context, host: str = "127.0.0.1",
+                 port: int | None = None):
         self._sock = ctx.socket(zmq.PUB)
         self._sock.setsockopt(zmq.LINGER, 0)
-        port = self._sock.bind_to_random_port(f"tcp://{host}")
+        if port:
+            # Fixed port: a restarted controller's publisher comes back at
+            # the same endpoint, so existing SUB sockets resubscribe
+            # transparently (zmq reconnects underneath).
+            self._sock.bind(f"tcp://{host}:{port}")
+        else:
+            port = self._sock.bind_to_random_port(f"tcp://{host}")
         self.address = f"{host}:{port}"
 
     async def publish(self, topic: str, payload: dict) -> None:

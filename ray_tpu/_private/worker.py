@@ -478,6 +478,17 @@ class CoreWorker:
         self.pub_addr = pub_addr
         self.subscriber = Subscriber(self.ctx, pub_addr)
         self.subscriber.subscribe("actor", self._on_actor_event)
+        if self.mode == "driver" and getattr(self, "log_to_driver", False):
+            self.subscriber.subscribe("logs", self._on_log_lines)
+
+    async def _on_log_lines(self, _topic: str, payload: dict) -> None:
+        """Print streamed worker logs on the driver console
+        (ray: log_monitor-fed driver output, prefixed per worker)."""
+        import sys
+
+        node = payload.get("node_id", "?")
+        for src, line in payload.get("lines", []):
+            print(f"({src}, node={node}) {line}", file=sys.stderr)
 
     def connect_events(self, pub_addr: str) -> None:
         self.loop.call_soon_threadsafe(self._subscribe_events, pub_addr)
@@ -1398,6 +1409,11 @@ class CoreWorker:
         deterministic tasks (a stale copy on a worker equals a stale
         plasma copy on a node)."""
         e = self.memory.entry(rid)
+        # Reset before set: a retried task that failed here earlier must
+        # not leave its stale error (or stale frames) shadowing the new
+        # outcome for same-worker consumers.
+        e.frames, e.locations, e.error, e.has_value, e.value = \
+            None, [], None, False, None
         if frames is not None:
             e.frames = frames
         if locations is not None:

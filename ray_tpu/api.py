@@ -125,6 +125,7 @@ def init(address: str | None = None,
                       agent_addr=agent_addr, config=config,
                       node_id=node_id, job_id=JobID.from_random().hex(),
                       namespace=namespace)
+    core.log_to_driver = log_to_driver
     core.start()
     # Learn the local node store's shm name so puts/gets mmap it directly
     # (plasma-client analog; workers get it via env from the agent).

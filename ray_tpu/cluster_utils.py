@@ -30,10 +30,35 @@ class Cluster:
         info["_proc"] = proc
         return info
 
-    def start_head(self) -> str:
-        info = self._spawn(["ray_tpu._private.controller",
-                            "--config-json", self._config_json])
+    def start_head(self, snapshot_path: str | None = None) -> str:
+        args = ["ray_tpu._private.controller",
+                "--config-json", self._config_json]
+        self._snapshot_path = snapshot_path
+        if snapshot_path:
+            args += ["--snapshot-path", snapshot_path]
+        info = self._spawn(args)
         self.address = info["controller_addr"]
+        self._head_proc = info["_proc"]
+        return self.address
+
+    def kill_head(self) -> None:
+        """Hard-kill the controller (GCS fault-tolerance chaos path,
+        ray: test_gcs_fault_tolerance.py)."""
+        self._head_proc.kill()
+        self._head_proc.wait()
+
+    def restart_head(self) -> str:
+        """Restart the controller at the SAME address, restoring state
+        from the snapshot (ray: GCS restart with Redis persistence)."""
+        assert self.address and self._snapshot_path, \
+            "restart requires start_head(snapshot_path=...)"
+        port = int(self.address.rsplit(":", 1)[1])
+        info = self._spawn(["ray_tpu._private.controller",
+                            "--config-json", self._config_json,
+                            "--port", str(port),
+                            "--snapshot-path", self._snapshot_path])
+        assert info["controller_addr"] == self.address
+        self._head_proc = info["_proc"]
         return self.address
 
     def add_node(self, resources: dict[str, float] | None = None,
