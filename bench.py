@@ -68,6 +68,17 @@ def bench_control_plane() -> dict:
             ray_tpu.get(c.inc.remote())
         out["actor_calls_sync_per_s"] = n / (time.perf_counter() - t0)
 
+        # n:n — several actors, calls fanned across all of them
+        # (reference "n_n_actor_calls_async").
+        actors = [Counter.remote() for _ in range(4)]
+        ray_tpu.get([a.inc.remote() for a in actors])
+        n = 2000
+        t0 = time.perf_counter()
+        ray_tpu.get([actors[i % 4].inc.remote() for i in range(n)])
+        out["actor_calls_nn_async_per_s"] = n / (time.perf_counter() - t0)
+        for a in actors:
+            ray_tpu.kill(a)
+
         import numpy as np
 
         small = np.zeros(1024, np.uint8)

@@ -136,6 +136,14 @@ class Controller:
         self._bg.append(loop.create_task(self._health_loop()))
         self._bg.append(loop.create_task(self._resource_broadcast_loop()))
         if self.snapshot_path:
+            # Write an initial snapshot NOW: a kill before the first
+            # periodic write would otherwise restart with no pub-port
+            # record, rebinding the publisher somewhere subscribers
+            # aren't.
+            try:
+                self._write_snapshot(self._snapshot_state())
+            except Exception:  # noqa: BLE001
+                logger.exception("initial snapshot failed")
             self._bg.append(loop.create_task(self._snapshot_loop()))
         if restored:
             self._restart_restored_scheduling(loop)
@@ -156,10 +164,11 @@ class Controller:
                 loop.create_task(self._schedule_pg(pg))
 
     # ------------------------------------------------------- persistence
-    def _snapshot_state(self) -> dict:
-        import pickle
-
-        return pickle.dumps({
+    def _collect_state(self) -> dict:
+        """Plain-dict copy of the durable tables.  Runs ON the loop so
+        the view is consistent; the expensive pickle happens off-loop
+        over these frozen shallow copies (values are immutable bytes)."""
+        return {
             "actors": {
                 aid: {
                     "actor_id": a.actor_id, "name": a.name,
@@ -182,10 +191,10 @@ class Controller:
                       "state": p.state,
                       "bundle_nodes": dict(p.bundle_nodes)}
                 for pid, p in self.pgs.items()},
-            "kv": self.kv,
-            "jobs": self.jobs,
+            "kv": {ns: dict(d) for ns, d in self.kv.items()},
+            "jobs": dict(self.jobs),
             "pub_port": int(self.publisher.address.rsplit(":", 1)[1]),
-        })
+        }
 
     def _restore_snapshot(self) -> None:
         import pickle
@@ -207,18 +216,37 @@ class Controller:
         logger.info("restored snapshot: %d actors, %d pgs, %d kv ns",
                     len(self.actors), len(self.pgs), len(self.kv))
 
+    def _snapshot_state(self) -> bytes:
+        import pickle
+
+        return pickle.dumps(self._collect_state())
+
+    def _write_snapshot(self, blob: bytes) -> None:
+        if blob == self._last_snapshot_blob:
+            return              # unchanged: skip the disk write
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.snapshot_path)
+        self._last_snapshot_blob = blob
+
     async def _snapshot_loop(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(1.0)
             try:
-                blob = self._snapshot_state()
-                if blob == self._last_snapshot_blob:
-                    continue        # unchanged: skip the disk write
-                tmp = self.snapshot_path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, self.snapshot_path)
-                self._last_snapshot_blob = blob
+                # State collection runs on the loop (consistent view of
+                # the tables, shallow copies over immutable values); the
+                # pickle + disk write — the expensive part under large
+                # actor tables — runs in the executor so heartbeat
+                # handling never stalls toward the node-death timeout.
+                import pickle
+
+                state = self._collect_state()
+                blob = await loop.run_in_executor(None, pickle.dumps,
+                                                  state)
+                await loop.run_in_executor(None, self._write_snapshot,
+                                           blob)
             except Exception:  # noqa: BLE001
                 logger.exception("snapshot write failed")
 
@@ -226,7 +254,8 @@ class Controller:
         for t in self._bg:
             t.cancel()
         self.server.close()
-        self.publisher.close()
+        if self.publisher is not None:
+            self.publisher.close()
         self.clients.close()
 
     # ------------------------------------------------------------ node mgmt

@@ -338,9 +338,17 @@ class NodeAgent:
             # Forward only complete lines; partial tails wait for more.
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                continue
+                if len(chunk) >= 256 * 1024:
+                    # One giant unterminated line would stall this file
+                    # forever (the newline sits beyond the read cap):
+                    # forward it truncated and move on.
+                    cut = len(chunk) - 1
+                else:
+                    continue
             src = fname.rsplit(".", 1)[0]
-            batch = chunk[:cut].splitlines(keepends=True)
+            # Keep each line's newline so `consumed` counts every byte —
+            # an off-by-one here leaks phantom blank lines next poll.
+            batch = chunk[:cut + 1].splitlines(keepends=True)
             # Advance the offset ONLY past lines actually forwarded; a
             # burst beyond the cap is picked up next poll, not dropped.
             consumed = 0

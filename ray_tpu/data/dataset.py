@@ -175,6 +175,9 @@ class Dataset:
     def iter_rows(self) -> Iterator[dict]:
         return self.iterator().iter_rows()
 
+    def iter_torch_batches(self, **kw) -> Iterator:
+        return self.iterator().iter_torch_batches(**kw)
+
     def iter_jax_batches(self, **kw) -> Iterator:
         return self.iterator().iter_jax_batches(**kw)
 

@@ -147,6 +147,31 @@ class DataIterator:
         if prev is not None:
             yield prev
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes: dict | None = None,
+                           device: str | None = None,
+                           drop_last: bool = False,
+                           prefetch_batches: int = 2,
+                           local_shuffle_buffer_size: int | None = None,
+                           ) -> Iterator[dict]:
+        """Numpy batches → torch tensors (ray: iterator.iter_torch_batches)
+        — the host-side torch feed for TorchTrainer loops."""
+        import torch
+
+        for np_batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last, prefetch_batches=prefetch_batches,
+                local_shuffle_buffer_size=local_shuffle_buffer_size):
+            out = {}
+            for k, v in np_batch.items():
+                t = torch.as_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def materialize_numpy(self, limit: int | None = None) -> dict:
         """Gather everything into one numpy dict (tests/small data)."""
         blocks = [BlockAccessor.for_block(b).block

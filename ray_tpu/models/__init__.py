@@ -3,6 +3,8 @@ jit-able apply functions; no framework lock-in, shardings are declared as
 logical-axes pytrees consumed by ray_tpu.parallel)."""
 from ray_tpu.models.llama import (LlamaConfig, llama_configs, init_params,
                                   forward, loss_fn, param_logical_axes)
+from ray_tpu.models.resnet import ResNetConfig, resnet_configs
 
 __all__ = ["LlamaConfig", "llama_configs", "init_params", "forward",
-           "loss_fn", "param_logical_axes"]
+           "loss_fn", "param_logical_axes",
+           "ResNetConfig", "resnet_configs"]

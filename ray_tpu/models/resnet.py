@@ -1,0 +1,170 @@
+"""ResNet image classifier, TPU-first (the vision model family).
+
+Role: the reference's Train benchmarks are image pipelines (ray:
+doc/source/train/benchmarks.rst "GPU image training", 746 images/s on 16
+GPU workers) — this is the jax-native model those workloads train.  Like
+models/llama.py it is pure-functional: params are a pytree, `forward` is
+a free function, and the logical-axes table feeds parallel.sharding so
+the same model runs DP/fsdp over a mesh.
+
+Design notes (vs torchvision-style ResNet):
+  - NHWC layout (TPU-native; NCHW costs transposes on every conv)
+  - lax.conv_general_dilated drives the MXU directly
+  - BatchNorm is replaced by GroupNorm: batch-independent, no running
+    stats to synchronize across data-parallel shards (the reference
+    wraps SyncBatchNorm into DDP; GroupNorm makes that machinery
+    unnecessary and is standard practice for jax vision stacks)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    # Stage widths and block counts; resnet18 = (2,2,2,2) basic blocks.
+    widths: tuple = (64, 128, 256, 512)
+    depths: tuple = (2, 2, 2, 2)
+    groups: int = 32               # GroupNorm groups
+    dtype: Any = jnp.bfloat16
+
+    def num_params(self) -> int:
+        # eval_shape: shapes only, no RNG work or array allocation.
+        tree = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), self))
+        import math
+
+        return sum(math.prod(x.shape) for x in jax.tree.leaves(tree))
+
+
+def resnet_configs() -> dict[str, ResNetConfig]:
+    return {
+        "resnet18": ResNetConfig(depths=(2, 2, 2, 2)),
+        "resnet34": ResNetConfig(depths=(3, 4, 6, 3)),
+        "resnet-debug": ResNetConfig(num_classes=10, widths=(8, 16, 16, 32),
+                                     depths=(1, 1, 1, 1), groups=4,
+                                     dtype=jnp.float32),
+    }
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * (2.0 / fan_in) ** 0.5)
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> dict:
+    """Param pytree; blocks keyed 'stage{i}_block{j}'."""
+    keys = iter(jax.random.split(key, 256))
+    p: dict = {"stem": {"w": _conv_init(next(keys), 7, 7, 3,
+                                        cfg.widths[0]),
+                        "scale": jnp.ones((cfg.widths[0],)),
+                        "bias": jnp.zeros((cfg.widths[0],))}}
+    cin = cfg.widths[0]
+    for si, (width, depth) in enumerate(zip(cfg.widths, cfg.depths)):
+        for bi in range(depth):
+            blk = {
+                "w1": _conv_init(next(keys), 3, 3, cin, width),
+                "s1": jnp.ones((width,)), "b1": jnp.zeros((width,)),
+                "w2": _conv_init(next(keys), 3, 3, width, width),
+                "s2": jnp.ones((width,)), "b2": jnp.zeros((width,)),
+            }
+            if cin != width:
+                blk["w_proj"] = _conv_init(next(keys), 1, 1, cin, width)
+            p[f"stage{si}_block{bi}"] = blk
+            cin = width
+    p["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes),
+                               jnp.float32) * (cin ** -0.5),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    return p
+
+
+def param_logical_axes(cfg: ResNetConfig) -> dict:
+    """Logical axes for parallel.sharding: convs shard output channels
+    over 'mlp' (the tensor axis), the head over 'vocab'."""
+    def conv_axes(blk: dict) -> dict:
+        out = {}
+        for k in blk:
+            if k.startswith("w"):
+                out[k] = (None, None, None, "mlp")
+            else:
+                out[k] = (None,)
+        return out
+
+    axes: dict = {"stem": {"w": (None, None, None, "mlp"),
+                           "scale": (None,), "bias": (None,)}}
+    cin = cfg.widths[0]
+    for si, (width, depth) in enumerate(zip(cfg.widths, cfg.depths)):
+        for bi in range(depth):
+            blk = {"w1": 0, "s1": 0, "b1": 0, "w2": 0, "s2": 0, "b2": 0}
+            if cin != width:
+                blk["w_proj"] = 0
+            axes[f"stage{si}_block{bi}"] = conv_axes(blk)
+            cin = width
+    axes["head"] = {"w": ("embed", "vocab"), "b": (None,)}
+    return axes
+
+
+def _group_norm(x, scale, bias, groups: int, eps: float = 1e-5):
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(n, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + eps)
+    return (xf.reshape(n, h, w, c) * scale + bias).astype(x.dtype)
+
+
+def _conv(x, w, stride: int = 1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def forward(params: dict, images: jnp.ndarray,
+            cfg: ResNetConfig) -> jnp.ndarray:
+    """images [N,H,W,3] float; returns logits [N, num_classes] fp32."""
+    x = images.astype(cfg.dtype)
+    stem = params["stem"]
+    x = _conv(x, stem["w"], stride=2)
+    x = _group_norm(x, stem["scale"], stem["bias"], cfg.groups)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                          (1, 2, 2, 1), "SAME")
+    cin = cfg.widths[0]
+    for si, (width, depth) in enumerate(zip(cfg.widths, cfg.depths)):
+        for bi in range(depth):
+            blk = params[f"stage{si}_block{bi}"]
+            stride = 2 if (bi == 0 and si > 0) else 1
+            h = _conv(x, blk["w1"], stride=stride)
+            h = _group_norm(h, blk["s1"], blk["b1"], cfg.groups)
+            h = jax.nn.relu(h)
+            h = _conv(h, blk["w2"])
+            h = _group_norm(h, blk["s2"], blk["b2"], cfg.groups)
+            shortcut = x
+            if "w_proj" in blk:
+                shortcut = _conv(x, blk["w_proj"], stride=stride)
+            elif stride != 1:
+                shortcut = x[:, ::stride, ::stride, :]
+            x = jax.nn.relu(h + shortcut)
+            cin = width
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)     # global average pool
+    head = params["head"]
+    return x @ head["w"] + head["b"]
+
+
+def loss_fn(params: dict, batch: dict, cfg: ResNetConfig) -> jnp.ndarray:
+    """Cross-entropy on {'images': [N,H,W,3], 'labels': [N]}."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None],
+                               axis=-1)[:, 0]
+    return jnp.mean(nll)
