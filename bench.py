@@ -146,12 +146,36 @@ def bench_model() -> dict:
     peak = next((v for k, v in PEAK_BF16.items() if str(dev).startswith(k)),
                 197e12)
     mfu = tokens_per_s * flops_per_token / peak if on_tpu else 0.0
-    return {"model": "bench-350m" if on_tpu else "debug",
-            "device": str(dev),
-            "train_tokens_per_s_chip": round(tokens_per_s, 1),
-            "train_step_ms": round(dt / n_steps * 1000, 2),
-            "mfu": round(mfu, 4),
-            "loss": round(loss_val, 4)}
+    out = {"model": "bench-350m" if on_tpu else "debug",
+           "device": str(dev),
+           "train_tokens_per_s_chip": round(tokens_per_s, 1),
+           "train_step_ms": round(dt / n_steps * 1000, 2),
+           "mfu": round(mfu, 4),
+           "loss": round(loss_val, 4)}
+    if on_tpu:
+        # Long-context point (SP/flash-attention story): same model at
+        # 4x the sequence length, flash fwd+bwd streaming KV blocks.
+        import dataclasses
+
+        lcfg = dataclasses.replace(cfg, max_seq=8192)
+        lb, ls = 4, 8192
+        lstate = train_step.sharded_init(jax.random.PRNGKey(0), lcfg,
+                                         optimizer, mesh)
+        lstep = train_step.sharded_train_step(lcfg, optimizer, mesh)
+        ltok = jax.random.randint(jax.random.PRNGKey(2), (lb, ls), 0,
+                                  lcfg.vocab_size, jnp.int32)
+        lbatch = {"inputs": ltok, "targets": ltok}
+        with jax.set_mesh(mesh):
+            lstate, lm = lstep(lstate, lbatch)
+            float(lm["loss"])
+            t0 = time.perf_counter()
+            for _ in range(5):
+                lstate, lm = lstep(lstate, lbatch)
+            float(lm["loss"])
+            ldt = time.perf_counter() - t0
+        out["long_context_seq"] = ls
+        out["long_context_tokens_per_s"] = round(lb * ls * 5 / ldt, 1)
+    return out
 
 
 def bench_serve_llm() -> dict:

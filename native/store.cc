@@ -363,6 +363,19 @@ int rt_store_get(void* hv, const uint8_t* id, uint64_t* offset,
   return 1;
 }
 
+// Locate a creating-state entry for chunked assembly writes; no pin
+// (the creator's own alloc pin protects it until seal).
+int rt_store_peek(void* hv, const uint8_t* id, uint64_t* offset,
+                  uint64_t* size) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  IndexEntry* e = find_slot(h->hdr, id, false);
+  if (!e || e->state != 1) return 0;
+  *offset = e->offset;
+  *size = e->size;
+  return 1;
+}
+
 int rt_store_contains(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
   MutexGuard g(&h->hdr->mutex);

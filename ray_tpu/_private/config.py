@@ -42,6 +42,10 @@ class Config:
     # async/threaded actors).
     actor_call_batch_size: int = 64
     actor_max_inflight_batches: int = 16
+    # Node-to-node object transfer: chunk size + parallel chunk window
+    # (ray: 64MB chunks, 8 in flight — object_manager.cc:508).
+    transfer_chunk_bytes: int = 64 * 1024 * 1024
+    transfer_chunks_in_flight: int = 8
     # Idle seconds before a leased worker is returned to the pool.
     lease_idle_timeout_s: float = 1.0
     # Workers prestarted per node agent at boot.

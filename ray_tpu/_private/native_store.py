@@ -81,6 +81,10 @@ def load_lib():
     lib.rt_store_sweep_dead.argtypes = [ctypes.c_void_p]
     lib.rt_store_oldest.restype = ctypes.c_int
     lib.rt_store_oldest.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_store_peek.restype = ctypes.c_int
+    lib.rt_store_peek.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64)]
     lib.rt_store_close.argtypes = [ctypes.c_void_p]
     lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
@@ -162,21 +166,9 @@ class Arena:
     # ---- read path ----
     def get_frames(self, oid: bytes) -> list | None:
         """Zero-copy read: returned memoryviews pin the object until GC'd."""
-        off = ctypes.c_uint64()
-        size = ctypes.c_uint64()
-        if not self.lib.rt_store_get(self.handle, oid,
-                                     ctypes.byref(off), ctypes.byref(size)):
+        mv = self.get_raw(oid)
+        if mv is None:
             return None
-        addr = self.base + off.value
-        buf = (ctypes.c_ubyte * size.value).from_address(addr)
-        # The pin is released when the last view of `buf` is collected.
-        # Bound-method indirection, NOT a direct rt_store_release capture:
-        # a finalizer firing after close() must not touch the freed handle.
-        weakref.finalize(buf, self._release_pin, oid)
-        # Read-only: sealed objects are immutable; a writable view would
-        # let `got += 1` silently corrupt the object for every reader on
-        # the node (ray: plasma fetched buffers are immutable).
-        mv = memoryview(buf).toreadonly()
         (nframes,) = struct.unpack_from("<I", mv, 0)
         lens = struct.unpack_from(f"<{nframes}Q", mv, 4)
         _, offsets = _bundle_layout(list(lens))
@@ -185,6 +177,51 @@ class Arena:
     def _release_pin(self, oid: bytes) -> None:
         if self.handle:
             self.lib.rt_store_release(self.handle, oid)
+
+    # ---- chunked-transfer raw access (node-to-node object plane) ----
+    def get_raw(self, oid: bytes) -> memoryview | None:
+        """Read-only view of the WHOLE frame bundle (header + payloads) —
+        get_frames parses it, chunked pushes slice it.
+
+        The returned view pins the object until collected.  The finalizer
+        uses bound-method indirection, NOT a direct rt_store_release
+        capture: a finalizer firing after close() must not touch the
+        freed handle.  Read-only because sealed objects are immutable —
+        a writable view would let `got += 1` silently corrupt the object
+        for every reader on the node (ray: plasma fetched buffers are
+        immutable)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_get(self.handle, oid,
+                                     ctypes.byref(off), ctypes.byref(size)):
+            return None
+        buf = (ctypes.c_ubyte * size.value).from_address(
+            self.base + off.value)
+        weakref.finalize(buf, self._release_pin, oid)
+        return memoryview(buf).toreadonly()
+
+    def create_raw(self, oid: bytes, total: int) -> bool:
+        """Allocate an unsealed region for chunked assembly."""
+        return self.lib.rt_store_alloc(
+            self.handle, oid, ctypes.c_uint64(total)) != 0
+
+    def write_raw(self, oid: bytes, offset: int, chunk: bytes) -> bool:
+        """Write one chunk into a creating-state region."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_peek(self.handle, oid, ctypes.byref(off),
+                                      ctypes.byref(size)):
+            return False
+        if offset + len(chunk) > size.value:
+            return False
+        ctypes.memmove(self.base + off.value + offset, chunk, len(chunk))
+        return True
+
+    def seal_raw(self, oid: bytes) -> bool:
+        return self.lib.rt_store_seal(self.handle, oid) == 0
+
+    def abort_raw(self, oid: bytes) -> None:
+        self.lib.rt_store_abort(self.handle, oid)
 
     def contains(self, oid: bytes) -> bool:
         return bool(self.lib.rt_store_contains(self.handle, oid))
@@ -275,6 +312,22 @@ class NativeStoreBackend:
 
     def oldest(self) -> bytes | None:
         return self.arena.oldest()
+
+    # Chunked-transfer raw region access (see Arena)
+    def get_raw(self, oid: bytes):
+        return self.arena.get_raw(oid)
+
+    def create_raw(self, oid: bytes, total: int) -> bool:
+        return self.arena.create_raw(oid, total)
+
+    def write_raw(self, oid: bytes, offset: int, chunk) -> bool:
+        return self.arena.write_raw(oid, offset, chunk)
+
+    def seal_raw(self, oid: bytes) -> bool:
+        return self.arena.seal_raw(oid)
+
+    def abort_raw(self, oid: bytes) -> None:
+        self.arena.abort_raw(oid)
 
     def stats(self) -> dict:
         return self.arena.stats()

@@ -180,6 +180,10 @@ class StoreRunner:
             f"ray_tpu_spill_{node_id[:8]}_{os.getpid()}")
         self.spilled: dict[bytes, str] = {}     # oid -> file path
         self.spilled_bytes = 0
+        # In-flight pull dedup: concurrent gets of one remote object join
+        # a single transfer (and never mistake a sibling's creating-state
+        # allocation for a full arena).
+        self._pulling: dict[bytes, asyncio.Future] = {}
 
     @property
     def shm_name(self) -> str:
@@ -189,6 +193,8 @@ class StoreRunner:
         self._clients = clients
         server.register("store_put", self.rpc_store_put)
         server.register("store_get", self.rpc_store_get)
+        server.register("store_get_meta", self.rpc_store_get_meta)
+        server.register("store_get_chunk", self.rpc_store_get_chunk)
         server.register("store_contains", self.rpc_store_contains)
         server.register("store_delete", self.rpc_store_delete)
         server.register("store_pull", self.rpc_store_pull)
@@ -197,20 +203,26 @@ class StoreRunner:
     # -------------------------------------------------------------- spill
     def _write_spill_file(self, oid: bytes, frames: list) -> tuple[str, int]:
         """Serialize a frame bundle to the spill dir; returns (path, bytes).
-        Format: [u32 nframes][u64 len_i ...][payloads...]."""
+
+        The on-disk layout is IDENTICAL to the arena bundle layout
+        (aligned frame offsets): chunked node-to-node pulls serve raw
+        slices from either source interchangeably."""
         import struct as _struct
+
+        from ray_tpu._private.native_store import _bundle_layout
 
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, oid.hex())
-        size = 0
+        lens = [len(fr) for fr in frames]
+        total, offsets = _bundle_layout(lens)
         with open(path, "wb") as f:
             f.write(_struct.pack("<I", len(frames)))
-            for fr in frames:
-                f.write(_struct.pack("<Q", len(fr)))
-            for fr in frames:
+            f.write(_struct.pack(f"<{len(lens)}Q", *lens))
+            for fr, fo in zip(frames, offsets):
+                f.seek(fo)
                 f.write(fr)
-                size += len(fr)
-        return path, size
+            f.truncate(total)
+        return path, total
 
     def _spill_one(self) -> bool:
         """Write the LRU object's frames to disk and drop it from memory."""
@@ -241,11 +253,18 @@ class StoreRunner:
             return None
         import struct as _struct
 
+        from ray_tpu._private.native_store import _bundle_layout
+
         try:
             with open(path, "rb") as f:
                 (n,) = _struct.unpack("<I", f.read(4))
                 lens = _struct.unpack(f"<{n}Q", f.read(8 * n))
-                return [f.read(ln) for ln in lens]
+                _, offsets = _bundle_layout(list(lens))
+                out = []
+                for ln, fo in zip(lens, offsets):
+                    f.seek(fo)
+                    out.append(f.read(ln))
+                return out
         except OSError:
             return None
 
@@ -308,28 +327,147 @@ class StoreRunner:
         self._delete_spilled(oid)
         return {}
 
+    # --------------------------------------------- node-to-node transfer
+    async def rpc_store_get_meta(self, h: dict, _b: list) -> dict:
+        """Bundle size for a chunked pull."""
+        oid = bytes.fromhex(h["object_id"])
+        raw_fn = getattr(self.backend, "get_raw", None)
+        if raw_fn is not None:
+            raw = raw_fn(oid)
+            if raw is not None:
+                return {"found": True, "size": len(raw)}
+        if oid in self.spilled:
+            try:
+                return {"found": True,
+                        "size": os.path.getsize(self.spilled[oid]),
+                        "spilled": True}
+            except OSError:
+                pass
+        return {"found": self.backend.contains(oid)}
+
+    async def rpc_store_get_chunk(self, h: dict,
+                                  _b: list) -> tuple[dict, list]:
+        """One raw slice of the frame bundle (pinned zero-copy view)."""
+        oid = bytes.fromhex(h["object_id"])
+        off, length = h["offset"], h["length"]
+        raw_fn = getattr(self.backend, "get_raw", None)
+        raw = raw_fn(oid) if raw_fn is not None else None
+        if raw is None:
+            path = self.spilled.get(oid)
+            if path is not None:
+                def _read_range():
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        return f.read(length)
+                try:
+                    # Off-loop: a 64MB synchronous read would stall every
+                    # other RPC this agent serves.
+                    data = await asyncio.to_thread(_read_range)
+                    return {"found": True}, [data]
+                except OSError:
+                    pass
+            return {"found": False}, []
+        return {"found": True}, [raw[off:off + length]]
+
+    async def _pull_chunked(self, oid: bytes, addr: str,
+                            size: int) -> bool:
+        """Assemble a remote object from parallel chunk fetches directly
+        into the local arena (ray: ObjectManager 64MB chunks, 8 in
+        flight, object_manager.cc:508)."""
+        chunk = self.config.transfer_chunk_bytes
+        if not self.backend.create_raw(oid, size):
+            # Arena full: make room the same way puts do.
+            for _ in range(4096):
+                if not self._spill_one():
+                    return False
+                if self.backend.create_raw(oid, size):
+                    break
+            else:
+                return False
+        sem = asyncio.Semaphore(self.config.transfer_chunks_in_flight)
+        failed = asyncio.Event()
+
+        async def fetch(off: int) -> None:
+            async with sem:
+                if failed.is_set():
+                    return
+                try:
+                    reply, blobs = await self._clients.get(addr).call(
+                        "store_get_chunk",
+                        {"object_id": oid.hex(), "offset": off,
+                         "length": min(chunk, size - off)}, timeout=120.0)
+                except Exception:  # noqa: BLE001
+                    failed.set()
+                    return
+                if not reply.get("found") or not self.backend.write_raw(
+                        oid, off, blobs[0]):
+                    failed.set()
+
+        await asyncio.gather(*[fetch(off)
+                               for off in range(0, size, chunk)])
+        if failed.is_set():
+            self.backend.abort_raw(oid)
+            return False
+        return self.backend.seal_raw(oid)
+
     async def rpc_store_pull(self, h: dict, _b: list) -> dict:
         """Replicate an object from a remote node store into this one
-        (ray: PullManager pull_manager.h:52 → ObjectManager::Push)."""
+        (ray: PullManager pull_manager.h:52 → ObjectManager::Push).
+        Concurrent pulls of the same object coalesce."""
         oid = bytes.fromhex(h["object_id"])
+        inflight = self._pulling.get(oid)
+        if inflight is not None:
+            return {"ok": await asyncio.shield(inflight)}
+        fut = asyncio.get_running_loop().create_future()
+        self._pulling[oid] = fut
+        try:
+            ok = await self._do_pull(oid, h)
+        except BaseException:
+            fut.set_result(False)
+            raise
+        else:
+            fut.set_result(ok)
+        finally:
+            self._pulling.pop(oid, None)
+        return {"ok": ok}
+
+    async def _do_pull(self, oid: bytes, h: dict) -> bool:
         if self.backend.contains(oid):
-            return {"ok": True}
+            return True
         if oid in self.spilled:
             # Already on local disk: restore instead of a network fetch.
-            restored = self._read_spilled(oid)
+            restored = await asyncio.to_thread(self._read_spilled, oid)
             if restored is not None:
                 if self.backend.put(oid, restored):
                     self._delete_spilled(oid)
-                return {"ok": True}
+                return True
+        chunked_ok = hasattr(self.backend, "create_raw")
         for addr in h.get("from", []):
+            if chunked_ok:
+                try:
+                    meta, _ = await self._clients.get(addr).call(
+                        "store_get_meta", {"object_id": h["object_id"]},
+                        timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    continue
+                if not meta.get("found"):
+                    continue
+                size = meta.get("size")
+                if (size and size > self.config.transfer_chunk_bytes
+                        and size <= self.config.object_store_memory
+                        and await self._pull_chunked(oid, addr, size)):
+                    return True
+                # Fall through to the whole-object path: it handles
+                # objects larger than the arena (spill-to-disk landing)
+                # and transient chunk failures.
             try:
                 reply, blobs = await self._clients.get(addr).call(
                     "store_get", {"object_id": h["object_id"]}, timeout=60.0)
             except Exception:  # noqa: BLE001
                 continue
             if reply.get("found"):
-                return {"ok": self.put_with_spill(oid, blobs)}
-        return {"ok": False}
+                return self.put_with_spill(oid, blobs)
+        return False
 
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:
         return {**self.backend.stats(),

@@ -1022,6 +1022,22 @@ class CoreWorker:
     async def _pull_and_load(self, ref: ObjectRef, locations: list[str],
                              entry) -> Any:
         """Fetch frames from a node store holding the object."""
+        arena0 = self.local_arena()
+        if (arena0 is not None and locations
+                and self.agent_addr not in locations):
+            # Remote object + local arena: pull THROUGH the local node
+            # store (chunked, parallel, cached for other local readers —
+            # ray: gets always materialize into local plasma via the
+            # PullManager) then read it zero-copy.
+            try:
+                reply, _ = await self.clients.get(self.agent_addr).call(
+                    "store_pull",
+                    {"object_id": ref.hex(), "from": list(locations)},
+                    timeout=300.0)
+                if reply.get("ok"):
+                    locations = [self.agent_addr] + list(locations)
+            except Exception:  # noqa: BLE001
+                pass
         if self.agent_addr in locations:
             arena = self.local_arena()
             if arena is not None:

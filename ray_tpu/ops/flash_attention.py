@@ -177,34 +177,35 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, sm_scale: float, causal: bool, block_q: int,
-                n_rep: int):
-    """dK/dV for one (b, kv-head, kv-block): loop over q blocks × rep heads.
-    dV = P^T dO; dK = dS^T Q * scale."""
+                *, sm_scale: float, causal: bool, n_rep: int):
+    """dK/dV for one (b, kv-head, kv-block); the q axis is the MINOR grid
+    dimension, so q/do/lse/delta stream through VMEM one block at a time
+    (whole-sequence blocks would blow VMEM at long context — the
+    long-context path is the point of this kernel).  dk/dv accumulate in
+    scratch, which persists across the sequential q iterations, and write
+    out on the last one.  dV = P^T dO; dK = dS^T Q * scale."""
     block_k, d = k_ref.shape
-    sq = q_ref.shape[1]
+    block_q = q_ref.shape[1]
     ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
     k_start = ki * block_k
+    q_start = qi * block_q
 
-    dk_acc[...] = jnp.zeros_like(dk_acc)
-    dv_acc[...] = jnp.zeros_like(dv_acc)
-    k = k_ref[...]
-    v = v_ref[...]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    num_q = pl.cdiv(sq, block_q)
-
-    def body(idx, _):
-        rep = idx // num_q
-        q_i = idx % num_q
-        q_start = q_i * block_q
-
-        @pl.when(jnp.logical_or(not causal,
-                                q_start + block_q - 1 >= k_start))
-        def _():
-            q = q_ref[rep, pl.ds(q_start, block_q), :]
-            do = do_ref[rep, pl.ds(q_start, block_q), :].astype(jnp.float32)
-            lse = lse_ref[rep, pl.ds(q_start, block_q), 0]
-            delta = delta_ref[rep, pl.ds(q_start, block_q), 0]
+    @pl.when(jnp.logical_or(not causal, q_start + block_q - 1 >= k_start))
+    def _compute():
+        k = k_ref[...]
+        v = v_ref[...]
+        for rep in range(n_rep):        # small constant (GQA group)
+            q = q_ref[rep]
+            do = do_ref[rep].astype(jnp.float32)
+            lse = lse_ref[rep, :, 0]
+            delta = delta_ref[rep, :, 0]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
@@ -226,11 +227,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        return ()
-
-    jax.lax.fori_loop(0, num_q * n_rep, body, ())
-    dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
-    dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+    @pl.when(qi == num_q - 1)
+    def _write():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
@@ -270,31 +270,33 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
         interpret=_interpret(),
     )(q, k, v, do, lse_b, delta_b)
 
-    # dK/dV: grid over kv heads; each program sees all n_rep q-heads that
-    # attend to this kv head ([n_rep, sq, d] blocks).
+    # dK/dV: grid over kv heads × kv blocks × q blocks (q minor, so each
+    # program streams one [n_rep, block_q, d] slice — VMEM stays bounded
+    # at any sequence length; dk/dv accumulate in scratch across the
+    # sequential q iterations).
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, n_rep=n_rep),
-        grid=(b, hkv, skv // block_k),
+                          n_rep=n_rep),
+        grid=(b, hkv, skv // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, n_rep, sq, d),
-                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, n_rep, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, n_rep, sq, d),
-                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
-            pl.BlockSpec((None, None, n_rep, sq, 128),
-                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
-            pl.BlockSpec((None, None, n_rep, sq, 128),
-                         lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, n_rep, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi, 0)),
+            pl.BlockSpec((None, None, n_rep, block_q, 128),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi, 0)),
+            pl.BlockSpec((None, None, n_rep, block_q, 128),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
             pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
