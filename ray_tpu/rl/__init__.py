@@ -4,6 +4,7 @@ EnvRunnerGroup (CPU sampling actors) + LearnerGroup (jitted TPU updates)
 + Algorithm-as-Trainable, with PPO and DQN (ray: rllib/algorithms/).
 """
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
@@ -15,7 +16,7 @@ from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "IMPALA", "IMPALAConfig", "SAC", "SACConfig",
+    "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
     "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
     "ReplayBuffer", "make_env", "register_env",
 ]

@@ -1,0 +1,101 @@
+"""BC: behavior cloning from offline experience (the offline-RL entry).
+
+Analog of ray: rllib/algorithms/bc/ (BC / BCConfig over rllib/offline/
+data readers) — supervised policy learning from logged (obs, action)
+pairs, no environment interaction during training.  Offline batches ride
+ray_tpu.data Datasets (the reference reads offline JSON/Parquet through
+Ray Data the same way).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.num_env_runners = 0        # offline: no sampling actors
+        self.offline_data = None        # ray_tpu.data.Dataset | dict
+        self.eval_episodes = 2          # rollouts per step() for metrics
+
+    def offline(self, offline_data=None, **_kw) -> "BCConfig":
+        if offline_data is not None:
+            self.offline_data = offline_data
+        return self
+
+
+class BC(Algorithm):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        def loss_fn(params, batch):
+            logits = models.policy_logits(params, batch["obs"], jnp)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][:, None], axis=-1)[:, 0]
+            loss = jnp.mean(nll)
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == batch["actions"])
+                .astype(jnp.float32))
+            return loss, {"bc_loss": loss, "action_accuracy": acc}
+        return loss_fn
+
+    def setup(self, config: dict) -> None:
+        config = dict(config or {})
+        offline = config.pop("offline_data", None)
+        if offline is None:
+            raise ValueError("BC requires offline_data "
+                             "(config.offline(offline_data=...))")
+        # Accept a ray_tpu.data Dataset or a plain column dict.
+        if hasattr(offline, "to_numpy"):
+            offline = offline.to_numpy()
+        self._offline = {
+            "obs": np.asarray(offline["obs"], np.float32),
+            "actions": np.asarray(offline["actions"], np.int64),
+        }
+        # One eval runner when eval is on; a pure-offline run (eval
+        # disabled) spawns NO sampling actors.
+        cfg_eval = dict(config)
+        cfg_eval["num_env_runners"] = \
+            1 if config.get("eval_episodes", 2) > 0 else 0
+        super().setup(cfg_eval)
+        # Ship the offline batch to the object store ONCE; each update
+        # passes the ref, not the arrays (ray: offline data rides the
+        # object store, not per-call RPC payloads).
+        import ray_tpu
+
+        self._offline_ref = ray_tpu.put(self._offline)
+        self._n_offline = len(self._offline["obs"])
+
+    def training_step(self) -> dict:
+        metrics = self.learner_group.update(
+            self._offline_ref,
+            num_sgd_iter=self.cfg["num_sgd_iter"],
+            minibatch_size=self.cfg["minibatch_size"])
+        self._params_np = self.learner_group.get_params_numpy()
+        self._timesteps += self._n_offline
+        # Greedy eval rollouts (epsilon=0 → argmax) until the configured
+        # number of episodes completes.
+        want = self.cfg.get("eval_episodes", 2)
+        done = 0
+        for _ in range(max(1, want) * 4):
+            if done >= want:
+                break
+            frags = self.env_runner_group.sample(
+                self._params_np, 200, epsilon=0.0)
+            for b in frags:
+                rets = b["episode_returns"].tolist()
+                done += len(rets)
+                self._episode_returns.extend(rets)
+        return metrics
+
+
+BC._default_config = BCConfig()
+BCConfig.algo_class = BC
