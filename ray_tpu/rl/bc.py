@@ -56,23 +56,25 @@ class BC(Algorithm):
         # Accept a ray_tpu.data Dataset or a plain column dict.
         if hasattr(offline, "to_numpy"):
             offline = offline.to_numpy()
-        self._offline = {
+        batch = {
             "obs": np.asarray(offline["obs"], np.float32),
             "actions": np.asarray(offline["actions"], np.int64),
         }
-        # One eval runner when eval is on; a pure-offline run (eval
-        # disabled) spawns NO sampling actors.
+        # Default ONE eval runner when eval is on (none when off), but an
+        # explicit .env_runners() choice wins.
         cfg_eval = dict(config)
-        cfg_eval["num_env_runners"] = \
-            1 if config.get("eval_episodes", 2) > 0 else 0
+        if "num_env_runners" not in config or \
+                config.get("num_env_runners", 0) == 0:
+            cfg_eval["num_env_runners"] = \
+                1 if config.get("eval_episodes", 2) > 0 else 0
         super().setup(cfg_eval)
-        # Ship the offline batch to the object store ONCE; each update
-        # passes the ref, not the arrays (ray: offline data rides the
-        # object store, not per-call RPC payloads).
+        # Ship the offline batch to the object store ONCE; updates pass
+        # the ref, not the arrays, and the driver keeps no second copy
+        # (ray: offline data rides the object store).
         import ray_tpu
 
-        self._offline_ref = ray_tpu.put(self._offline)
-        self._n_offline = len(self._offline["obs"])
+        self._offline_ref = ray_tpu.put(batch)
+        self._n_offline = len(batch["obs"])
 
     def training_step(self) -> dict:
         metrics = self.learner_group.update(

@@ -10,6 +10,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.llm import LLMEngine, LLMServer
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import Request
 
@@ -19,4 +20,5 @@ __all__ = [
     "http_port", "ingress", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "Request",
+    "LLMEngine", "LLMServer",
 ]

@@ -225,3 +225,31 @@ def test_multiplexed(serve_instance):
     assert h.remote("b").result(timeout_s=30) == "model:b"
     assert h.remote("a").result(timeout_s=30) == "model:a"
     serve.delete("mux")
+
+
+def test_llm_deployment_through_serve(serve_instance):
+    """Continuous-batched LLM replica served through the full stack:
+    serve.run → router → replica actor hosting the engine (the judged
+    serve configuration at debug scale)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=64, remat=False, dtype=jnp.float32)
+
+    # Replicas are async actors already; the engine thread does the
+    # batching while __call__ awaits futures.
+    LLMDeployment = serve.deployment(serve.LLMServer).options(
+        name="llm", num_replicas=1)
+    h = serve.run(LLMDeployment.bind(cfg, max_batch=2, max_len=64,
+                                     seed=11),
+                  name="llm_app", route_prefix="/llm")
+    futs = [h.remote({"prompt": [3 + i, 1, 4], "max_new_tokens": 5})
+            for i in range(4)]
+    results = [f.result(timeout_s=120) for f in futs]
+    for r in results:
+        assert len(r["tokens"]) == 5
+        assert r["ttft_s"] > 0
+    serve.delete("llm_app")
