@@ -14,6 +14,7 @@ from ray_tpu.tune.search.sample import (choice, grid_search, lograndint,
                                         sample_from, uniform)
 from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
                                           ConcurrencyLimiter, Searcher)
+from ray_tpu.tune.search.bohb import BOHBSearch
 from ray_tpu.tune.search.tpe import TPESearch
 from ray_tpu.tune.trainable import (Trainable, get_checkpoint, report,
                                     wrap_function)
@@ -24,7 +25,7 @@ ASHAScheduler = AsyncHyperBandScheduler
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "Result", "run",
     "Trainable", "report", "get_checkpoint", "wrap_function",
-    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch",
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch", "BOHBSearch",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",

@@ -4,6 +4,7 @@ from ray_tpu.tune.search.sample import (choice, grid_search, lograndint,
                                         sample_from, uniform)
 from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
                                           ConcurrencyLimiter, Searcher)
+from ray_tpu.tune.search.bohb import BOHBSearch
 from ray_tpu.tune.search.tpe import TPESearch
 from ray_tpu.tune.search.variant_generator import (flatten,
                                                    generate_variants)
@@ -11,6 +12,6 @@ from ray_tpu.tune.search.variant_generator import (flatten,
 __all__ = [
     "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
     "qrandint", "lograndint", "choice", "sample_from", "grid_search",
-    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch",
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter", "TPESearch", "BOHBSearch",
     "generate_variants", "flatten",
 ]

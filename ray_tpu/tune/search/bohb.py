@@ -1,0 +1,75 @@
+"""BOHBSearch: budget-aware Bayesian optimization (BOHB-style).
+
+Capability analog of ray's TuneBOHB integration (ray:
+python/ray/tune/search/bohb/bohb_search.py, which wraps hpbandster) with
+no external dependency.  The BOHB recipe (Falkner et al. 2018): pair a
+HyperBand-style scheduler with a TPE model built PER BUDGET — when
+suggesting, use the largest budget (training_iteration) that has enough
+observations, so early-rung results guide sampling while late-rung
+results dominate once available.
+
+Pair with tune.schedulers.HyperBandScheduler/AsyncHyperBandScheduler —
+intermediate results are observed via on_trial_result, so trials stopped
+at a rung still contribute their last score at that budget.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.tune.search.tpe import TPESearch
+
+
+class BOHBSearch(TPESearch):
+    def __init__(self, *args, min_points_per_budget: int = 4, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._min_pts = min_points_per_budget
+        # trial_id -> {budget: score}; budget = training_iteration.
+        self._by_budget: dict[str, dict[int, float]] = {}
+
+    # -------------------------------------------------------- observations
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        if self.metric not in result:
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._by_budget.setdefault(trial_id, {})[budget] = \
+            float(result[self.metric])
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        if error:
+            self._by_budget.pop(trial_id, None)
+            self._points.pop(trial_id, None)
+            return
+        if result and self.metric in result:
+            self.on_trial_result(trial_id, result)
+
+    def suggest(self, trial_id: str):
+        # Lazy re-score: suggest() is the only consumer of the per-budget
+        # scores, so the O(trials × budgets) refresh runs once per new
+        # trial, not once per reported result.
+        self._refresh_scores()
+        return super().suggest(trial_id)
+
+    def _refresh_scores(self) -> None:
+        """Re-score every observed point at the modeling budget: the
+        largest budget with >= min_points observations (smaller budgets
+        back-fill trials that never reached it)."""
+        budgets: dict[int, int] = {}
+        for scores in self._by_budget.values():
+            for b in scores:
+                budgets[b] = budgets.get(b, 0) + 1
+        eligible = [b for b, n in budgets.items() if n >= self._min_pts]
+        model_budget = max(eligible) if eligible else \
+            (max(budgets) if budgets else 1)
+        for tid, scores in self._by_budget.items():
+            if tid not in self._points:
+                continue
+            pt, _ = self._points[tid]
+            # Score at the modeling budget, else the trial's largest
+            # smaller budget (its best-known performance).
+            at = [b for b in scores if b <= model_budget]
+            if not at:
+                continue
+            self._points[tid] = (pt, scores[max(at)])
+
+
+__all__ = ["BOHBSearch"]

@@ -63,9 +63,14 @@ class TuneController:
                  max_failures: int = 0,
                  resources_per_trial: dict | None = None,
                  checkpoint_freq: int = 0,
+                 num_samples: int = 0,
                  restored_trials: list[Trial] | None = None):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
+        # Trial budget for model-based searchers, which suggest forever
+        # (ray: num_samples bounds any search_alg, not just the basic
+        # variant generator).  0 = unbounded (grid searchers self-end).
+        self.num_samples = num_samples
         self.scheduler = scheduler or FIFOScheduler()
         self.metric = metric
         self.mode = mode
@@ -95,6 +100,9 @@ class TuneController:
 
     def _next_from_search(self) -> Optional[Trial]:
         if self._search_done:
+            return None
+        if self.num_samples and len(self.trials) >= self.num_samples:
+            self._search_done = True
             return None
         tid = f"{len(self.trials):05d}"
         out = self.searcher.suggest(tid)

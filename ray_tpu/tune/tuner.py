@@ -159,6 +159,19 @@ class Tuner:
                                      seed=tc.seed, metric=tc.metric,
                                      mode=tc.mode)
 
+    def _external_trial_cap(self) -> int:
+        """num_samples bounds model-based searchers, which suggest
+        forever; a BasicVariantGenerator (bare or concurrency-wrapped)
+        self-limits via its own num_samples and must NOT be double
+        capped.  0 = no external cap."""
+        alg = self.tune_config.search_alg
+        if alg is None:
+            return 0
+        inner = alg.searcher if isinstance(alg, ConcurrencyLimiter) else alg
+        if isinstance(inner, BasicVariantGenerator):
+            return 0
+        return self.tune_config.num_samples
+
     def _resources(self) -> dict:
         t = self.trainable
         if hasattr(t, "scaling_config"):
@@ -182,6 +195,7 @@ class Tuner:
             max_failures=tc.max_failures,
             resources_per_trial=self._resources(),
             checkpoint_freq=tc.checkpoint_freq,
+            num_samples=self._external_trial_cap(),
             restored_trials=self._restored_trials)
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)

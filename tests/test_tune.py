@@ -179,7 +179,14 @@ class TestTunerE2E:
         from ray_tpu.train import RunConfig
 
         def train_fn(config):
+            import time as _time
+
             for i in range(20):
+                # Pace iterations so all trials interleave across rungs:
+                # on a loaded 1-core box an unpaced weak trial can finish
+                # before any rung has comparison data, and async ASHA
+                # (correctly) never stops a trial it never compared.
+                _time.sleep(0.05)
                 tune.report({"score": config["q"] * (i + 1),
                              "training_iteration": i + 1})
 
@@ -250,3 +257,58 @@ class TestTunerE2E:
         assert not grid.errors
         assert grid.get_best_result().config[
             "train_loop_config"]["lr"] == 0.5
+
+
+class TestBOHB:
+    def test_bohb_budget_aware_optimization(self):
+        """BOHB conditions its TPE model on the largest budget with
+        enough observations; low-budget noise must not dominate once
+        high-budget results exist (ray: TuneBOHB semantics)."""
+        space = {"x": tune.uniform(-4.0, 4.0)}
+        bohb = tune.BOHBSearch(space, metric="loss", mode="min",
+                               n_initial_points=6, seed=0,
+                               min_points_per_budget=4)
+        best = float("inf")
+        for i in range(40):
+            tid = f"t{i}"
+            cfg = bohb.suggest(tid)
+            true = (cfg["x"] - 1.0) ** 2
+            # Budget 1: a rank-SCRAMBLING proxy (optimum at x=-3, the
+            # opposite corner) — a searcher modeling only the low budget
+            # would walk away from x=1; only budget-3 conditioning finds
+            # the true optimum.
+            bohb.on_trial_result(
+                tid, {"loss": (cfg["x"] + 3.0) ** 2,
+                      "training_iteration": 1})
+            bohb.on_trial_result(
+                tid, {"loss": true, "training_iteration": 3})
+            bohb.on_trial_complete(
+                tid, {"loss": true, "training_iteration": 3})
+            best = min(best, true)
+        assert best < 0.1
+
+    def test_bohb_with_asha_scheduler_e2e(self, ray_shared, tmp_path):
+        """BOHB search + ASHA rung stopping through the full Tuner."""
+        def trainable(config):
+            for i in range(4):
+                tune.report({"score": -(config["x"] - 1.0) ** 2,
+                             "training_iteration": i + 1})
+
+        from ray_tpu.train import RunConfig
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.uniform(-4.0, 4.0)},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=10,
+                search_alg=tune.BOHBSearch(
+                    metric="score", mode="max", n_initial_points=4,
+                    seed=1),
+                scheduler=tune.AsyncHyperBandScheduler(
+                    metric="score", mode="max", max_t=4,
+                    grace_period=1)),
+            run_config=RunConfig(name="bohb_e2e",
+                                 storage_path=str(tmp_path)))
+        results = tuner.fit()
+        best = results.get_best_result()
+        assert best.metrics["score"] > -4.0
