@@ -13,7 +13,7 @@ from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 TaskCancelledError, TaskError,
                                 WorkerCrashedError)
 from ray_tpu._private import profiling
-from ray_tpu.object_ref import ObjectRef
+from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
@@ -23,7 +23,8 @@ __all__ = [
     "kill", "cancel", "get_actor", "nodes", "timeline",
     "available_resources", "cluster_resources", "get_runtime_context",
     "profiling",
-    "ObjectRef", "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "ObjectRef", "ObjectRefGenerator",
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
     "WorkerCrashedError", "__version__",
 ]

@@ -656,6 +656,8 @@ class CoreWorker:
             "bundle_key": bundle_key,
             "name": options.get("name", ""),
         }
+        if options.get("dynamic"):
+            header["dynamic"] = True
         if options.get("runtime_env"):
             from ray_tpu._private import runtime_env as renv
 
@@ -790,6 +792,10 @@ class CoreWorker:
             offset = 0
             for i, meta in enumerate(returns):
                 rid = task.return_ids[i]
+                if meta.get("dynamic") is not None:
+                    offset = self._resolve_dynamic_return(
+                        task, rid, meta, blobs, offset)
+                    continue
                 if meta["inline"]:
                     nframes = meta["nframes"]
                     frames = blobs[offset:offset + nframes]
@@ -847,6 +853,74 @@ class CoreWorker:
             for rid in task.return_ids:
                 self._resolve_error(rid, err)
             self._record_event(task.task_id.hex(), "FAILED")
+
+    def _resolve_dynamic_return(self, task: PendingTask, rid: bytes,
+                                meta: dict, blobs: list,
+                                offset: int) -> int:
+        """Materialize a dynamic-generator reply: one owned record per
+        yielded item (the caller owns items exactly like fixed returns),
+        and the return-0 value becomes an ObjectRefGenerator.  The
+        return-0 record pins every item (contained refs), so items live
+        while the generator object does."""
+        from ray_tpu.object_ref import ObjectRefGenerator
+
+        tid = TaskID(task.task_id)
+        gen_refs: list[ObjectRef] = []
+        contained: list[tuple[bytes, str]] = []
+        prev_item_pins: list[tuple[bytes, str]] = []
+        with self._ref_lock:
+            base = self.owned.get(rid)
+            for j, im in enumerate(meta["dynamic"]):
+                iid = ObjectID.for_return(tid, j + 1).binary()
+                irec = self.owned.setdefault(iid, OwnedObject())
+                # Pins for refs nested in the item value (re-execution
+                # releases the previous round's, as in the fixed path).
+                prev_item_pins.extend(irec.contained)
+                irec.contained = [(bytes.fromhex(c[0]), c[1])
+                                  for c in im.get("contained", ())]
+                # Items share the task's lineage: losing one re-runs the
+                # whole generator task (same deterministic item ids).
+                if base is not None:
+                    irec.submit_spec = base.submit_spec
+                    irec.retries_left = base.retries_left
+                if im["inline"]:
+                    n = im["nframes"]
+                    irec.state = "inline"
+                    irec.frames = blobs[offset:offset + n]
+                    self.memory.put_frames(iid, irec.frames)
+                    offset += n
+                else:
+                    irec.state = "stored"
+                    irec.locations = [im["location"]]
+                    self.memory.put_locations(iid, irec.locations)
+                # One count for the live ObjectRef handed out below, one
+                # pin owned by the return-0 record.
+                irec.local_refs += 1
+                irec.borrowers += 1
+                contained.append((iid, self.address))
+                gen_refs.append(ObjectRef(iid, self.address))
+            value = ObjectRefGenerator(gen_refs)
+            sv = serialize(value)     # for remote resolvers of return-0
+            rec = self.owned.get(rid)
+            if rec is None:
+                # Return ref dropped already: release the pins right away
+                # (the live gen_refs die with this frame).
+                tmp = OwnedObject()
+                tmp.contained = contained
+                self._free_object(rid, tmp)
+                return offset
+            prev_contained, rec.contained = rec.contained, contained
+            rec.state = "inline"
+            rec.frames = sv.frames
+            e = self.memory.entry(rid)
+            e.frames = sv.frames
+            e.has_value, e.value = True, value
+            e.event.set()
+        for c_oid, c_owner in prev_contained:
+            self._release_borrow(c_oid, c_owner)
+        for c_oid, c_owner in prev_item_pins:
+            self._release_borrow(c_oid, c_owner)
+        return offset
 
     def _resolve_error(self, rid: bytes, err: BaseException) -> None:
         rec = self.owned.get(rid)
@@ -1357,6 +1431,8 @@ class CoreWorker:
         return {"status": "error", "traceback": tb}, [payload]
 
     async def _pack_returns(self, result: Any, h: dict) -> tuple[dict, list]:
+        if h.get("dynamic"):
+            return await self._pack_dynamic_returns(result, h)
         num_returns = h.get("num_returns", 1)
         if num_returns == 1:
             values = [result]
@@ -1370,29 +1446,7 @@ class CoreWorker:
         task_id = bytes.fromhex(h["task_id"])
         for i, v in enumerate(values):
             sv = await self.loop.run_in_executor(None, serialize, v)
-            # Refs nested in a return value get a contained pin, added
-            # HERE — and ACKED before the reply, because the reply releases
-            # the caller's submission pins (different connection: no FIFO
-            # guarantee) — owned by the caller's return-object record,
-            # which releases it when the return object is freed (ray:
-            # contained-in-owned refs, reference_count.cc).
-            pairs = self._dedup_contained(sv.contained_refs)
-            pinned: list[tuple[bytes, str]] = []
-            remote_pins = []
-            for oid, owner in pairs:
-                if owner == self.address:
-                    with self._ref_lock:
-                        rec_c = self.owned.get(oid)
-                        if rec_c:
-                            rec_c.borrowers += 1
-                            pinned.append((oid, owner))
-                else:
-                    remote_pins.append((oid, owner))
-            if remote_pins:
-                pinned.extend(await self._pin_remote(remote_pins))
-            # Only pins that actually landed are reported to the caller:
-            # its later release must match an add, or the owner undercounts.
-            contained = [[oid.hex(), owner] for oid, owner in pinned]
+            contained = await self._pin_contained_refs(sv)
             rid = ObjectID.for_return(TaskID(task_id), i).binary()
             if sv.total_bytes <= self.config.max_inline_object_size:
                 returns.append({"inline": True, "nframes": len(sv.frames),
@@ -1413,6 +1467,31 @@ class CoreWorker:
                     self._cache_local_return(
                         rid, locations=[self.agent_addr])
         return {"status": "ok", "returns": returns}, out_blobs
+
+    async def _pin_contained_refs(self, sv) -> list:
+        """Pin refs nested in a return value — added HERE and ACKED
+        before the reply, because the reply releases the caller's
+        submission pins (different connection: no FIFO guarantee) — the
+        pins become owned by the caller's return-object record, which
+        releases them when the return object is freed (ray:
+        contained-in-owned refs, reference_count.cc).  Only pins that
+        actually landed are reported: the caller's later release must
+        match an add, or the owner undercounts."""
+        pairs = self._dedup_contained(sv.contained_refs)
+        pinned: list[tuple[bytes, str]] = []
+        remote_pins = []
+        for oid, owner in pairs:
+            if owner == self.address:
+                with self._ref_lock:
+                    rec_c = self.owned.get(oid)
+                    if rec_c:
+                        rec_c.borrowers += 1
+                        pinned.append((oid, owner))
+            else:
+                remote_pins.append((oid, owner))
+        if remote_pins:
+            pinned.extend(await self._pin_remote(remote_pins))
+        return [[oid.hex(), owner] for oid, owner in pinned]
 
     def _cache_local_return(self, rid: bytes, frames: list | None = None,
                             locations: list | None = None,
@@ -1442,6 +1521,50 @@ class CoreWorker:
             old = self._return_cache.pop(0)
             if old not in self.owned and old not in self.borrows:
                 self.memory.delete(old)
+
+    async def _pack_dynamic_returns(self, result: Any,
+                                    h: dict) -> tuple[dict, list]:
+        """num_returns="dynamic": materialize the generator's items as
+        individual return objects (item i → return index i+1; index 0 is
+        the generator descriptor the caller resolves to an
+        ObjectRefGenerator).  ray: dynamic generator returns."""
+        task_id = bytes.fromhex(h["task_id"])
+        try:
+            iter(result)
+        except TypeError:
+            return self._error_reply(TypeError(
+                'num_returns="dynamic" requires the task to return an '
+                f"iterable/generator, got {type(result).__name__}"))
+        # The generator BODY runs lazily — drain it in the executor like
+        # any other user code (on the loop it would stall all RPC
+        # handling); body exceptions propagate via the generic error path.
+        items = await self.loop.run_in_executor(None, list, result)
+        metas, out_blobs = [], []
+        for i, v in enumerate(items):
+            sv = await self.loop.run_in_executor(None, serialize, v)
+            contained = await self._pin_contained_refs(sv)
+            rid = ObjectID.for_return(TaskID(task_id), i + 1).binary()
+            if sv.total_bytes <= self.config.max_inline_object_size:
+                metas.append({"inline": True, "nframes": len(sv.frames),
+                              "contained": contained})
+                out_blobs.extend(sv.frames)
+                if self.mode == "worker":
+                    self._cache_local_return(rid, frames=sv.frames)
+            else:
+                stored = await self.loop.run_in_executor(
+                    None, self._store_frames_local, rid, sv.frames)
+                if not stored:
+                    await self.clients.get(self.agent_addr).call(
+                        "store_put", {"object_id": rid.hex()}, sv.frames)
+                metas.append({"inline": False,
+                              "location": self.agent_addr,
+                              "contained": contained})
+                if self.mode == "worker":
+                    self._cache_local_return(
+                        rid, locations=[self.agent_addr])
+        return {"status": "ok",
+                "returns": [{"inline": True, "nframes": 0,
+                             "contained": [], "dynamic": metas}]}, out_blobs
 
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, h: dict, blobs: list) -> dict:

@@ -38,8 +38,12 @@ class ActorMethod:
                                     {"num_returns": self._num_returns})
 
     def options(self, **opts) -> "ActorMethod":
-        m = ActorMethod(self._handle, self._name,
-                        opts.get("num_returns", self._num_returns))
+        nr = opts.get("num_returns", self._num_returns)
+        if nr == "dynamic":
+            raise NotImplementedError(
+                'num_returns="dynamic" is only supported on task '
+                "functions, not actor methods")
+        m = ActorMethod(self._handle, self._name, nr)
         return m
 
     def bind(self, *args, **kwargs):

@@ -89,3 +89,33 @@ class ObjectRef:
         # Plain pickle path (outside task-arg serialization, which uses the
         # reducer_override in serialization.py to also track borrowers).
         return (ObjectRef._from_serialized, (self._id, self._owner_addr))
+
+
+class ObjectRefGenerator:
+    """The resolved value of a num_returns="dynamic" task: an iterable of
+    ObjectRefs, one per yielded item (ray: DynamicObjectRefGenerator —
+    python/ray/_raylet.pyx ObjectRefGenerator).
+
+    Pickles as its ref list, so passing a generator to another task moves
+    the refs through the normal borrower protocol.
+    """
+
+    __slots__ = ("_refs",)
+
+    def __init__(self, refs: list):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+    def __getitem__(self, i: int):
+        return self._refs[i]
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._refs,))

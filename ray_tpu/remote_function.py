@@ -20,8 +20,12 @@ def validate_options(opts: dict) -> None:
         if k not in _OPTION_KEYS:
             raise ValueError(f"unknown option {k!r}; valid: {sorted(_OPTION_KEYS)}")
     if "num_returns" in opts and opts["num_returns"] is not None:
-        if not isinstance(opts["num_returns"], int) or opts["num_returns"] < 0:
-            raise ValueError("num_returns must be a non-negative int")
+        nr = opts["num_returns"]
+        if nr == "dynamic":
+            return      # generator task: one ref resolving to N item refs
+        if not isinstance(nr, int) or nr < 0:
+            raise ValueError(
+                'num_returns must be a non-negative int or "dynamic"')
 
 
 def resolve_pg_options(opts: dict) -> dict:
@@ -70,6 +74,12 @@ class RemoteFunction:
         core = global_worker()
         if "pg_id" in options:
             _wait_pg_ready(core, options["pg_id"])
+        if options.get("num_returns") == "dynamic":
+            # One return ref whose value is an ObjectRefGenerator over the
+            # yielded items (ray: num_returns="dynamic").
+            options = {**options, "num_returns": 1, "dynamic": True}
+            return core.submit_task(self._function, args, kwargs,
+                                    options)[0]
         refs = core.submit_task(self._function, args, kwargs, options)
         n = options.get("num_returns", 1)
         if n == 1:

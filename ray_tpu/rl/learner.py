@@ -25,6 +25,9 @@ class Learner:
         import optax
 
         self.config = config
+        # Own seeded generator for minibatch shuffling: the global numpy
+        # RNG would make training non-reproducible across processes.
+        self._np_rng = np.random.default_rng(config.get("seed", 0) + 17)
         rng = jax.random.PRNGKey(config.get("seed", 0))
         # Algorithms with non-default param trees (e.g. SAC's twin Q +
         # temperature) ship a params_builder in the config dict.
@@ -62,7 +65,7 @@ class Learner:
         idx_all = np.arange(n)
         last_metrics: dict = {}
         for _epoch in range(num_sgd_iter):
-            np.random.shuffle(idx_all)
+            self._np_rng.shuffle(idx_all)
             for s in range(0, n, mb):
                 idx = idx_all[s:s + mb]
                 mbatch = {k: jnp.asarray(v[idx]) for k, v in batch.items()

@@ -207,3 +207,50 @@ def test_mutating_arg_after_submit_does_not_corrupt(ray_shared):
     ref = total.remote(arr)
     arr[:] = 1                              # post-submit mutation
     assert ray_tpu.get(ref) == 0.0
+
+
+def test_dynamic_generator_returns(ray_shared):
+    """num_returns="dynamic": a generator task's yields become individual
+    object refs behind one ObjectRefGenerator (ray: dynamic generators)."""
+    import numpy as np
+
+    ray_tpu = ray_shared
+    from ray_tpu.object_ref import ObjectRefGenerator
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def produce(n):
+        for i in range(n):
+            yield {"i": i, "big": np.full(300_000, i, np.uint8)}
+
+    gen = ray_tpu.get(produce.remote(4))
+    assert isinstance(gen, ObjectRefGenerator) and len(gen) == 4
+    for i, ref in enumerate(gen):
+        item = ray_tpu.get(ref)
+        assert item["i"] == i
+        assert item["big"][0] == i and len(item["big"]) == 300_000
+
+    # Item refs pass to downstream tasks like any other ref.
+    @ray_tpu.remote
+    def total(item):
+        return int(item["big"].sum())
+
+    assert ray_tpu.get(total.remote(gen[2])) == 2 * 300_000
+
+
+def test_dynamic_generator_empty_and_nongen(ray_shared):
+    ray_tpu = ray_shared
+    from ray_tpu.object_ref import ObjectRefGenerator
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def empty():
+        return iter(())
+
+    gen = ray_tpu.get(empty.remote())
+    assert isinstance(gen, ObjectRefGenerator) and len(gen) == 0
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def not_iterable():
+        return 42
+
+    with pytest.raises(Exception, match="iterable|generator"):
+        ray_tpu.get(not_iterable.remote())
