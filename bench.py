@@ -203,6 +203,10 @@ def bench_serve_llm() -> dict:
         # Warmup: compile the REAL prompt bucket + the K-step decode
         # program (a short warmup prompt would compile the wrong bucket).
         eng.generate(list(range(1, prompt_len + 1)), max_new_tokens=2)
+        # Idle TTFT: single request, no queue — prefill + first decode.
+        idle = [eng.generate(
+            rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+            max_new_tokens=2)["ttft_s"] for _ in range(3)]
         prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                    for _ in range(n_requests)]
         t0 = time.perf_counter()
@@ -214,6 +218,7 @@ def bench_serve_llm() -> dict:
             "model": "bench-350m" if on_tpu else "debug",
             "requests_per_s": round(n_requests / wall, 2),
             "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1000, 1),
+            "idle_ttft_ms": round(sorted(idle)[1] * 1000, 1),
             "decode_tokens_per_s": round(
                 n_requests * new_tokens / wall, 1),
         }

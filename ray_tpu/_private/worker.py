@@ -865,11 +865,18 @@ class CoreWorker:
         from ray_tpu.object_ref import ObjectRefGenerator
 
         tid = TaskID(task.task_id)
+        rid0 = ObjectID.for_return(tid, 0).binary()
+        # Lineage reconstruction of a lost ITEM resubmits the task with
+        # return_ids=[item_id]: the reply then restores item payloads
+        # only — rid is NOT the generator's return-0, so the generator
+        # value/pins must not be rebuilt onto the item's record.
+        item_reconstruction = rid != rid0
         gen_refs: list[ObjectRef] = []
         contained: list[tuple[bytes, str]] = []
         prev_item_pins: list[tuple[bytes, str]] = []
+        prev_contained: list[tuple[bytes, str]] = []
         with self._ref_lock:
-            base = self.owned.get(rid)
+            rec = self.owned.get(rid)
             for j, im in enumerate(meta["dynamic"]):
                 iid = ObjectID.for_return(tid, j + 1).binary()
                 irec = self.owned.setdefault(iid, OwnedObject())
@@ -880,9 +887,9 @@ class CoreWorker:
                                   for c in im.get("contained", ())]
                 # Items share the task's lineage: losing one re-runs the
                 # whole generator task (same deterministic item ids).
-                if base is not None:
-                    irec.submit_spec = base.submit_spec
-                    irec.retries_left = base.retries_left
+                if rec is not None:
+                    irec.submit_spec = rec.submit_spec
+                    irec.retries_left = rec.retries_left
                 if im["inline"]:
                     n = im["nframes"]
                     irec.state = "inline"
@@ -893,29 +900,31 @@ class CoreWorker:
                     irec.state = "stored"
                     irec.locations = [im["location"]]
                     self.memory.put_locations(iid, irec.locations)
-                # One count for the live ObjectRef handed out below, one
-                # pin owned by the return-0 record.
-                irec.local_refs += 1
-                irec.borrowers += 1
-                contained.append((iid, self.address))
-                gen_refs.append(ObjectRef(iid, self.address))
-            value = ObjectRefGenerator(gen_refs)
-            sv = serialize(value)     # for remote resolvers of return-0
-            rec = self.owned.get(rid)
-            if rec is None:
-                # Return ref dropped already: release the pins right away
-                # (the live gen_refs die with this frame).
-                tmp = OwnedObject()
-                tmp.contained = contained
-                self._free_object(rid, tmp)
-                return offset
-            prev_contained, rec.contained = rec.contained, contained
-            rec.state = "inline"
-            rec.frames = sv.frames
-            e = self.memory.entry(rid)
-            e.frames = sv.frames
-            e.has_value, e.value = True, value
-            e.event.set()
+                if not item_reconstruction:
+                    # One count for the live ObjectRef handed out below,
+                    # one pin owned by the return-0 record.
+                    irec.local_refs += 1
+                    irec.borrowers += 1
+                    contained.append((iid, self.address))
+                    gen_refs.append(ObjectRef(iid, self.address))
+            if not item_reconstruction:
+                value = ObjectRefGenerator(gen_refs)
+                sv = serialize(value)  # for remote resolvers of return-0
+                if rec is None:
+                    # Return ref dropped already: release the pins right
+                    # away (the live gen_refs die with this frame).
+                    tmp = OwnedObject()
+                    tmp.contained = contained
+                    self._free_object(rid, tmp)
+                else:
+                    prev_contained, rec.contained = rec.contained, \
+                        contained
+                    rec.state = "inline"
+                    rec.frames = sv.frames
+                    e = self.memory.entry(rid)
+                    e.frames = sv.frames
+                    e.has_value, e.value = True, value
+                    e.event.set()
         for c_oid, c_owner in prev_contained:
             self._release_borrow(c_oid, c_owner)
         for c_oid, c_owner in prev_item_pins:
