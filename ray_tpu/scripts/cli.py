@@ -1,4 +1,4 @@
-"""ray-tpu CLI: start/stop/status/list/timeline/submit.
+"""ray-tpu CLI: start/stop/status/memory/list/summary/timeline/job.
 
 Analog of ray: python/ray/scripts/scripts.py (ray start/stop/status/
 memory/timeline/… 2619 LoC; command registry at the bottom).  Invoke as
@@ -128,6 +128,32 @@ def cmd_status(args) -> None:
               f"resources={n['resources']} available={n['available']}")
 
 
+def cmd_memory(args) -> None:
+    """ray: `ray memory` — per-node object store usage + spill state."""
+    rt = _attach(args)
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    total_used = total_objs = 0
+    for n in rt.nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            stats, _ = core.call(n["agent_addr"], "store_stats", {},
+                                 timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            print(f"  {n['node_id'][:12]} store unreachable: {e}")
+            continue
+        used, cap = stats.get("used", 0), stats.get("capacity", 0)
+        print(f"  {n['node_id'][:12]} store {used / 1e6:.1f}MB / "
+              f"{cap / 1e6:.1f}MB  objects={stats.get('num_objects', 0)}  "
+              f"spilled={stats.get('spilled_objects', 0)} "
+              f"({stats.get('spilled_bytes', 0) / 1e6:.1f}MB on disk)")
+        total_used += used
+        total_objs += stats.get("num_objects", 0)
+    print(f"cluster: {total_used / 1e6:.1f}MB in {total_objs} object(s)")
+
+
 def cmd_list(args) -> None:
     """ray: `ray list actors|nodes|tasks|placement-groups|jobs`."""
     _attach(args)
@@ -211,7 +237,7 @@ def main(argv: list[str] | None = None) -> None:
     sp = sub.add_parser("stop", help="stop local head processes")
     sp.set_defaults(fn=cmd_stop)
 
-    for name, fn in [("status", cmd_status)]:
+    for name, fn in [("status", cmd_status), ("memory", cmd_memory)]:
         sp = sub.add_parser(name)
         sp.add_argument("--address")
         sp.set_defaults(fn=fn)

@@ -193,3 +193,21 @@ def test_cli_status_and_list(rt):
 
     # _require_address picks up explicit address
     assert cli._require_address(A) == A.address
+
+
+def test_cli_status_and_memory(rt):
+    """`ray-tpu status` and `ray-tpu memory` against a live cluster
+    (ray: `ray status` / `ray memory` CLI)."""
+    import subprocess
+    import sys
+
+    from ray_tpu._private.worker import global_worker
+
+    addr = global_worker().controller_addr
+    for cmd, expect in (("status", "node(s)"), ("memory", "cluster:")):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", cmd,
+             "--address", addr],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-1000:]
+        assert expect in out.stdout, out.stdout
