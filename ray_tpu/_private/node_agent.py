@@ -676,8 +676,15 @@ class NodeAgent:
         return {}
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:
+        states: dict[str, int] = {}
+        for w in self.workers.values():
+            states[w.state] = states.get(w.state, 0) + 1
         return {"node_id": self.node_id,
-                "store_name": self.store.shm_name if self.store else ""}
+                "store_name": self.store.shm_name if self.store else "",
+                "available": self.available,
+                "pending_leases": len(self._pending),
+                "active_leases": len(self._leases),
+                "workers_by_state": states}
 
 
 def _watch_parent() -> None:

@@ -216,9 +216,14 @@ class LeaseManager:
                         ops.append(self._push_batch(plain, lease))
                     ops.extend(self._push_one(t, lease) for t in dep)
                     if len(ops) == 1:
-                        await ops[0]
+                        oks = [await ops[0]]
                     else:
-                        await asyncio.gather(*ops)
+                        oks = await asyncio.gather(*ops)
+                    if not all(oks):
+                        # Dead lease: abandon it — failed tasks already
+                        # re-queued and will ride a fresh lease (the
+                        # finally block restarts a pusher).
+                        return
                 # Queue drained: only the last surviving pusher lingers.
                 if self.pushers.get(key, 0) > 1:
                     break
@@ -249,6 +254,8 @@ class LeaseManager:
                 logger.warning("lease request to %s failed: %s", addr, e)
                 return None
             if reply.get("granted"):
+                # The agent vouches a live worker holds this address.
+                self.core._revive_addr(reply["worker_addr"])
                 return reply
             if reply.get("spill_to"):
                 addr = reply["spill_to"]
@@ -269,43 +276,60 @@ class LeaseManager:
         except Exception:  # noqa: BLE001
             pass
 
-    async def _push_one(self, task: PendingTask, lease: dict) -> None:
-        worker_addr = lease["worker_addr"]
-        try:
-            reply, blobs = await self.core.clients.get(worker_addr).call(
-                "push_task", task.header, task.blobs)
-        except (ConnectionLost, RemoteError) as e:
-            if worker_addr in self.core._oom_worker_addrs:
-                e = ConnectionLost(
-                    f"{worker_addr}: OOM-killed by the node memory monitor")
-            await self._on_push_failure(task, e)
-            return
-        self.core._on_task_reply(task, reply, blobs)
+    def _dead_addr_error(self, worker_addr: str) -> ConnectionLost | None:
+        """A send to a known-dead worker must fail NOW: zmq would happily
+        open a fresh connection to the dead address and hang forever."""
+        if worker_addr in self.core._oom_worker_addrs:
+            return ConnectionLost(
+                f"{worker_addr}: OOM-killed by the node memory monitor")
+        if worker_addr in self.core._dead_worker_addrs:
+            return ConnectionLost(f"{worker_addr}: worker is dead")
+        return None
 
-    async def _push_batch(self, batch: list, lease: dict) -> None:
-        """Push N tasks in one RPC (worker executes them in order and
-        replies once with all results)."""
+    async def _push_one(self, task: PendingTask, lease: dict) -> bool:
+        """Returns False when the lease's worker failed (the caller must
+        abandon the lease — retried tasks re-queue onto a fresh one)."""
         worker_addr = lease["worker_addr"]
-        blobs: list = []
-        headers = []
-        for t in batch:
-            headers.append({**t.header, "nframes": len(t.blobs)})
-            blobs.extend(t.blobs)
-        try:
-            reply, rblobs = await self.core.clients.get(worker_addr).call(
-                "push_task_batch", {"tasks": headers}, blobs)
-        except (ConnectionLost, RemoteError) as e:
-            if worker_addr in self.core._oom_worker_addrs:
-                e = ConnectionLost(
-                    f"{worker_addr}: OOM-killed by the node memory monitor")
+        err = self._dead_addr_error(worker_addr)
+        if err is None:
+            try:
+                reply, blobs = await self.core.clients.get(
+                    worker_addr).call("push_task", task.header, task.blobs)
+            except (ConnectionLost, RemoteError) as e:
+                err = self._dead_addr_error(worker_addr) or e
+        if err is not None:
+            await self._on_push_failure(task, err)
+            return False
+        self.core._on_task_reply(task, reply, blobs)
+        return True
+
+    async def _push_batch(self, batch: list, lease: dict) -> bool:
+        """Push N tasks in one RPC (worker executes them in order and
+        replies once with all results).  False = dead lease."""
+        worker_addr = lease["worker_addr"]
+        err = self._dead_addr_error(worker_addr)
+        if err is None:
+            blobs: list = []
+            headers = []
             for t in batch:
-                await self._on_push_failure(t, e)
-            return
+                headers.append({**t.header, "nframes": len(t.blobs)})
+                blobs.extend(t.blobs)
+            try:
+                reply, rblobs = await self.core.clients.get(
+                    worker_addr).call("push_task_batch",
+                                      {"tasks": headers}, blobs)
+            except (ConnectionLost, RemoteError) as e:
+                err = self._dead_addr_error(worker_addr) or e
+        if err is not None:
+            for t in batch:
+                await self._on_push_failure(t, err)
+            return False
         offset = 0
         for t, tr in zip(batch, reply["replies"]):
             n = tr.pop("nblobs")
             self.core._on_task_reply(t, tr, rblobs[offset:offset + n])
             offset += n
+        return True
 
     async def _on_push_failure(self, task: PendingTask, exc: Exception) -> None:
         """Worker died mid-task: retry if budget remains
@@ -338,6 +362,8 @@ class ActorSubmitState:
     draining: bool = False
     # Bounds concurrent in-flight batches (created lazily on the loop).
     send_sem: Any = None
+    # Consecutive sends skipped because the resolved address is dead.
+    stale_spins: int = 0
 
 
 class ActorInstance:
@@ -397,6 +423,12 @@ class CoreWorker:
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
         self._oom_worker_addrs: set[str] = set()
+        # Known-dead worker addresses (set for O(1) membership on the
+        # push hot path + FIFO order for bounded eviction).  Entries are
+        # REVIVED when a fresh worker provably lives at the address (lease
+        # grant / actor-alive event) — ephemeral ports get reused.
+        self._dead_worker_addrs: set[str] = set()
+        self._dead_addr_order: list[str] = []
         # Worker-local cache of this worker's own task returns: a consumer
         # task scheduled here reads them without asking the owner (ray:
         # locality — plasma already holds the return on the producing
@@ -1870,6 +1902,21 @@ class CoreWorker:
             addr = await self._resolve_actor_addr(st)
             if addr is None:
                 continue    # loops back; st.dead set or address refreshed
+            if addr in self._dead_worker_addrs:
+                # Known-dead worker: zmq would hang on a fresh connection.
+                # Nothing was SENT, so no retry budget burns — wait for
+                # the death/restart events to update the actor state.
+                st.address = None
+                st.stale_spins += 1
+                if st.stale_spins > 150:   # ~30s of stale ALIVE replies
+                    for task, _ in batch:
+                        self._fail_actor_call(task, ActorError(
+                            st.actor_id,
+                            "actor worker is dead (no restart observed)"))
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            st.stale_spins = 0
             try:
                 if len(batch) == 1:
                     task, _ = batch[0]
@@ -1921,6 +1968,9 @@ class CoreWorker:
             "get_actor_info",
             {"actor_id": st.actor_id, "wait": True, "timeout": 120.0},
             timeout=150.0)
+        # NOTE: no _revive_addr here — a controller ALIVE reply can be
+        # stale (death report still in flight); only the supervising
+        # agent's lease grant or a fresh alive EVENT proves liveness.
         if reply.get("state") == "ALIVE":
             st.address = reply["address"]
         elif reply.get("state") in ("DEAD", "UNKNOWN"):
@@ -1939,6 +1989,7 @@ class CoreWorker:
         if st is None:
             return
         if ev == "alive":
+            self._revive_addr(payload["address"])
             st.address = payload["address"]
             st.dead = False
             return
@@ -2079,8 +2130,25 @@ class CoreWorker:
             # (ray: OOM kills surface as OutOfMemoryError, not a generic
             # worker crash).
             self._oom_worker_addrs.add(addr)
+        # Dead-address registry: zmq DEALERs never surface peer death, so
+        # a LATER send to this address would create a fresh silently-
+        # hanging connection.  Sends check this set first (ray: worker
+        # failure pubsub gates the submitter the same way).
+        if addr and addr not in self._dead_worker_addrs:
+            self._dead_worker_addrs.add(addr)
+            self._dead_addr_order.append(addr)
+            while len(self._dead_addr_order) > 1024:
+                self._dead_worker_addrs.discard(
+                    self._dead_addr_order.pop(0))
         self.clients.drop(addr)
         return {}
+
+    def _revive_addr(self, addr: str) -> None:
+        """A live worker provably exists at this address now (lease
+        granted on it / actor alive there): clear stale death marks so a
+        reused ephemeral port isn't treated as dead forever."""
+        self._dead_worker_addrs.discard(addr)
+        self._oom_worker_addrs.discard(addr)
 
     async def rpc_exit_worker(self, h: dict, _b: list) -> dict:
         logger.info("worker exiting: %s", h.get("reason"))

@@ -1,0 +1,84 @@
+"""Chaos: random worker kills under load; retried tasks all complete.
+
+Mirrors ray: python/ray/_private/test_utils.py:1433 (ResourceKillerActor)
+and the nightly chaos suites — the framework's availability story is that
+task retries + lineage + the worker reaper absorb process churn.
+"""
+import os
+import random
+import signal
+import subprocess
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _worker_pids() -> list[int]:
+    """Workers of THIS cluster only: children of our spawned agent (a
+    machine-wide grep could kill another test session's workers)."""
+    from ray_tpu import api as _api
+
+    agent_pids = {str(p.pid) for p in _api._head_processes}
+    out = subprocess.run(["ps", "-eo", "pid,ppid,args"],
+                         capture_output=True, text=True).stdout
+    pids = []
+    for line in out.splitlines():
+        parts = line.split(None, 2)
+        if (len(parts) == 3 and parts[1] in agent_pids
+                and "ray_tpu._private.worker_main" in parts[2]):
+            try:
+                pids.append(int(parts[0]))
+            except ValueError:
+                pass
+    return pids
+
+
+def test_tasks_survive_random_worker_kills():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4})
+    try:
+        @ray_tpu.remote(max_retries=20)
+        def work(i):
+            time.sleep(0.1)
+            return i * i
+
+        stop = threading.Event()
+        killed = []
+
+        def killer():
+            # Kill interval must exceed worker startup (~2s on this box:
+            # python + the sitecustomize jax preimport), or the cluster
+            # livelocks replacing workers that die before registering —
+            # the reference's ResourceKiller paces kills the same way.
+            rng = random.Random(0)
+            last_kill = 0.0
+            while not stop.is_set() and len(killed) < 6:
+                time.sleep(0.25)           # poll fast, kill paced
+                if time.monotonic() - last_kill < 2.0:
+                    continue
+                pids = _worker_pids()
+                if pids:
+                    victim = rng.choice(pids)
+                    try:
+                        os.kill(victim, signal.SIGKILL)
+                        killed.append(victim)
+                        last_kill = time.monotonic()
+                    except ProcessLookupError:
+                        pass
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        try:
+            refs = [work.remote(i) for i in range(120)]
+            results = ray_tpu.get(refs, timeout=240)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert results == [i * i for i in range(120)]
+        assert killed, "chaos thread never killed a worker"
+    finally:
+        ray_tpu.shutdown()
