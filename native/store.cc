@@ -32,7 +32,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x7261795f74707531ULL;  // "ray_tpu1"
+constexpr uint64_t kMagic = 0x7261795f74707532ULL;  // "ray_tpu2"
 constexpr uint32_t kIndexSlots = 1 << 16;           // 65536 objects max
 constexpr uint64_t kAlign = 64;                     // tensor-friendly
 
@@ -50,9 +50,12 @@ struct IndexEntry {
 // Every live read pin is attributed to a pid so the agent can reclaim
 // pins of crash-killed readers (the reference's plasma store releases a
 // client's holds when its unix socket closes; this serverless arena
-// sweeps instead — rt_store_sweep_dead).
+// sweeps instead — rt_store_sweep_dead).  The table is open-addressing
+// hashed on (id, pid) — add/remove sit on the zero-copy get/release hot
+// path under the global mutex, so an O(kPinSlots) scan would serialize
+// all readers as pins accumulate.
 struct PinRecord {
-  int32_t pid;       // 0 = slot free
+  int32_t pid;       // 0 = never used, -1 = tombstone (probe continues)
   uint8_t id[16];
 };
 constexpr uint32_t kPinSlots = 8192;
@@ -72,6 +75,7 @@ struct ArenaHeader {
   uint64_t used_bytes;
   uint64_t lru_clock;
   uint64_t num_objects;
+  uint64_t pin_overflow;   // pins dropped because the table was full
   pthread_mutex_t mutex;
   IndexEntry index[kIndexSlots];
   PinRecord pin_records[kPinSlots];
@@ -127,24 +131,47 @@ BlockHeader* block_at(Handle* h, uint64_t off) {
   return reinterpret_cast<BlockHeader*>(h->base + off);
 }
 
+uint32_t pin_hash(const uint8_t* id, int32_t pid) {
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < 16; i++) { h ^= id[i]; h *= 1099511628211ULL; }
+  h ^= static_cast<uint32_t>(pid);
+  h *= 1099511628211ULL;
+  return static_cast<uint32_t>(h ^ (h >> 32));
+}
+
 // Record one pid-attributed read pin (best effort: a full table means the
-// pin is untracked — it still releases normally, just not crash-swept).
+// pin is untracked — it still releases normally, just not crash-swept;
+// pin_overflow counts those drops so they are visible in stats).
 void pin_record_add(ArenaHeader* hdr, const uint8_t* id, int32_t pid) {
-  for (uint32_t i = 0; i < kPinSlots; i++) {
-    PinRecord* r = &hdr->pin_records[i];
-    if (r->pid == 0) {
+  uint32_t start = pin_hash(id, pid) & (kPinSlots - 1);
+  for (uint32_t probe = 0; probe < kPinSlots; probe++) {
+    PinRecord* r = &hdr->pin_records[(start + probe) & (kPinSlots - 1)];
+    if (r->pid == 0 || r->pid == -1) {
       r->pid = pid;
       std::memcpy(r->id, id, 16);
       return;
     }
   }
+  hdr->pin_overflow++;
 }
 
 void pin_record_remove(ArenaHeader* hdr, const uint8_t* id, int32_t pid) {
-  for (uint32_t i = 0; i < kPinSlots; i++) {
-    PinRecord* r = &hdr->pin_records[i];
+  uint32_t start = pin_hash(id, pid) & (kPinSlots - 1);
+  for (uint32_t probe = 0; probe < kPinSlots; probe++) {
+    uint32_t idx = (start + probe) & (kPinSlots - 1);
+    PinRecord* r = &hdr->pin_records[idx];
+    if (r->pid == 0) return;  // hit a never-used slot: not present
     if (r->pid == pid && std::memcmp(r->id, id, 16) == 0) {
-      r->pid = 0;
+      r->pid = -1;
+      // If the next slot is free, this tombstone (and any contiguous run
+      // of tombstones before it) terminates no probe chain — convert the
+      // run back to free so chains stay short.
+      if (hdr->pin_records[(idx + 1) & (kPinSlots - 1)].pid == 0) {
+        while (hdr->pin_records[idx].pid == -1) {
+          hdr->pin_records[idx].pid = 0;
+          idx = (idx + kPinSlots - 1) & (kPinSlots - 1);
+        }
+      }
       return;
     }
   }
@@ -399,11 +426,13 @@ int rt_store_sweep_dead(void* hv) {
   int reclaimed = 0;
   for (uint32_t i = 0; i < kPinSlots; i++) {
     PinRecord* r = &h->hdr->pin_records[i];
-    if (r->pid == 0) continue;
+    if (r->pid <= 0) continue;
     if (kill(r->pid, 0) != 0 && errno == ESRCH) {
       IndexEntry* e = find_slot(h->hdr, r->id, false);
       if (e && e->pins > 0) e->pins--;
-      r->pid = 0;
+      // Tombstone (not free): this slot may sit mid-probe-chain for a
+      // colliding live record.
+      r->pid = -1;
       reclaimed++;
     }
   }
@@ -457,6 +486,12 @@ void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
   *used = h->hdr->used_bytes;
   *capacity = h->hdr->capacity;
   *num_objects = h->hdr->num_objects;
+}
+
+uint64_t rt_store_pin_overflow(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(&h->hdr->mutex);
+  return h->hdr->pin_overflow;
 }
 
 uint8_t* rt_store_base(void* hv) {

@@ -166,16 +166,20 @@ class Controller:
     # ------------------------------------------------------- persistence
     def _collect_state(self) -> dict:
         """Plain-dict copy of the durable tables.  Runs ON the loop so
-        the view is consistent; the expensive pickle happens off-loop
-        over these frozen shallow copies (values are immutable bytes)."""
+        the view is consistent; the expensive pickle happens off-loop,
+        so every mutable leaf shared with a live table must be deep-copied
+        HERE — otherwise an on-loop mutation during the off-loop pickle
+        raises and that snapshot round is silently skipped."""
+        import copy
+
         return {
             "actors": {
                 aid: {
                     "actor_id": a.actor_id, "name": a.name,
                     "namespace": a.namespace, "owner_addr": a.owner_addr,
                     "creation_spec": a.creation_spec,
-                    "creation_header": a.creation_header,
-                    "resources": a.resources,
+                    "creation_header": copy.deepcopy(a.creation_header),
+                    "resources": dict(a.resources),
                     "max_restarts": a.max_restarts, "state": a.state,
                     "address": a.address, "node_id": a.node_id,
                     "restarts_used": a.restarts_used,
@@ -187,12 +191,13 @@ class Controller:
             "named_actors": dict(self.named_actors),
             "pgs": {
                 pid: {"pg_id": p.pg_id, "name": p.name,
-                      "strategy": p.strategy, "bundles": p.bundles,
+                      "strategy": p.strategy,
+                      "bundles": copy.deepcopy(p.bundles),
                       "state": p.state,
                       "bundle_nodes": dict(p.bundle_nodes)}
                 for pid, p in self.pgs.items()},
             "kv": {ns: dict(d) for ns, d in self.kv.items()},
-            "jobs": dict(self.jobs),
+            "jobs": copy.deepcopy(self.jobs),
             "pub_port": int(self.publisher.address.rsplit(":", 1)[1]),
         }
 

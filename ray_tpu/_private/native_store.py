@@ -79,6 +79,8 @@ def load_lib():
     lib.rt_store_mapped_size.argtypes = [ctypes.c_void_p]
     lib.rt_store_sweep_dead.restype = ctypes.c_int
     lib.rt_store_sweep_dead.argtypes = [ctypes.c_void_p]
+    lib.rt_store_pin_overflow.restype = ctypes.c_uint64
+    lib.rt_store_pin_overflow.argtypes = [ctypes.c_void_p]
     lib.rt_store_oldest.restype = ctypes.c_int
     lib.rt_store_oldest.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.rt_store_peek.restype = ctypes.c_int
@@ -200,6 +202,21 @@ class Arena:
         weakref.finalize(buf, self._release_pin, oid)
         return memoryview(buf).toreadonly()
 
+    def read_bundle_copy(self, oid: bytes) -> bytes | None:
+        """COPY of the whole frame bundle with the pin released before
+        returning.  The spill path uses this instead of get_raw: a
+        finalizer-released pin only drops when GC breaks the ctypes
+        reference cycle, which would make spill-then-delete flaky."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_get(self.handle, oid,
+                                     ctypes.byref(off), ctypes.byref(size)):
+            return None
+        try:
+            return ctypes.string_at(self.base + off.value, size.value)
+        finally:
+            self.lib.rt_store_release(self.handle, oid)
+
     def create_raw(self, oid: bytes, total: int) -> bool:
         """Allocate an unsealed region for chunked assembly."""
         return self.lib.rt_store_alloc(
@@ -238,7 +255,9 @@ class Arena:
         self.lib.rt_store_stats(self.handle, ctypes.byref(used),
                                 ctypes.byref(cap), ctypes.byref(num))
         return {"used": used.value, "capacity": cap.value,
-                "num_objects": num.value}
+                "num_objects": num.value,
+                "pin_overflow":
+                    int(self.lib.rt_store_pin_overflow(self.handle))}
 
     def sweep_dead(self) -> int:
         """Reclaim pins held by crash-killed processes (agent-side)."""
@@ -316,6 +335,9 @@ class NativeStoreBackend:
     # Chunked-transfer raw region access (see Arena)
     def get_raw(self, oid: bytes):
         return self.arena.get_raw(oid)
+
+    def get_bundle_copy(self, oid: bytes) -> bytes | None:
+        return self.arena.read_bundle_copy(oid)
 
     def create_raw(self, oid: bytes, total: int) -> bool:
         return self.arena.create_raw(oid, total)

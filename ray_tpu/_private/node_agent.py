@@ -296,6 +296,13 @@ class NodeAgent:
                         sweep()
                     except Exception:  # noqa: BLE001
                         pass
+                try:
+                    # Deletes refused while a reader pinned the object are
+                    # retried once the pin (possibly crash-swept above) is
+                    # gone.
+                    self.store.retry_deletes()
+                except Exception:  # noqa: BLE001
+                    pass
 
     async def _log_tail_loop(self) -> None:
         """Tail worker log files; forward new lines to the controller,

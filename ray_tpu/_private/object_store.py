@@ -180,6 +180,14 @@ class StoreRunner:
             f"ray_tpu_spill_{node_id[:8]}_{os.getpid()}")
         self.spilled: dict[bytes, str] = {}     # oid -> file path
         self.spilled_bytes = 0
+        # Deletes refused because a zero-copy reader still pins the object
+        # are retried from the agent's reaper loop (retry_deletes); without
+        # this the dead object would linger, get spilled under pressure,
+        # and leak on disk forever.
+        self._pending_deletes: set[bytes] = set()
+        # Serializes spill-to-disk decisions across concurrent async puts
+        # (the file writes themselves run off-loop in a thread).
+        self._spill_lock = asyncio.Lock()
         # In-flight pull dedup: concurrent gets of one remote object join
         # a single transfer (and never mistake a sibling's creating-state
         # allocation for a full arena).
@@ -224,16 +232,36 @@ class StoreRunner:
             f.truncate(total)
         return path, total
 
-    def _spill_one(self) -> bool:
-        """Write the LRU object's frames to disk and drop it from memory."""
+    async def _spill_one(self) -> bool:
+        """Write the LRU object's frames to disk and drop it from memory.
+        The file write runs off the event loop: spilling a few GB
+        synchronously would stall the agent's heartbeat loop past
+        node_death_timeout_s and turn memory pressure into node death."""
         oid = self.backend.oldest()
         if oid is None:
             return False
-        frames = self.backend.get(oid)
-        if frames is None:
-            return False
-        path, size = self._write_spill_file(oid, frames)
-        del frames          # drop read pins before deleting from the arena
+        if oid in self._pending_deletes:
+            # Tombstoned (delete was refused while pinned): free it now
+            # instead of wasting disk on a dead object.
+            if self.backend.delete(oid):
+                self._pending_deletes.discard(oid)
+                return True
+        copy_fn = getattr(self.backend, "get_bundle_copy", None)
+        if copy_fn is not None:
+            # Explicitly-unpinned copy read: the subsequent delete must not
+            # depend on GC collecting a zero-copy view's finalizer.
+            data = copy_fn(oid)
+            if data is None:
+                return False
+            path, size = await asyncio.to_thread(self._write_spill_raw,
+                                                 oid, data)
+        else:
+            frames = self.backend.get(oid)
+            if frames is None:
+                return False
+            path, size = await asyncio.to_thread(self._write_spill_file,
+                                                 oid, frames)
+            del frames      # dict backend: plain bytes, nothing pinned
         if not self.backend.delete(oid):
             # Raced with a reader pinning it: the arena copy stays
             # authoritative; drop the file so nothing double-counts.
@@ -246,6 +274,15 @@ class StoreRunner:
         self.spilled_bytes += size
         logger.info("spilled %s (%d B) to %s", oid.hex()[:12], size, path)
         return True
+
+    def _write_spill_raw(self, oid: bytes, data: bytes) -> tuple[str, int]:
+        """Write an already-laid-out frame bundle (the arena's raw bytes)
+        straight to the spill file — the two layouts are identical."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(data)
+        return path, len(data)
 
     def _read_spilled(self, oid: bytes) -> list[bytes] | None:
         path = self.spilled.get(oid)
@@ -277,9 +314,10 @@ class StoreRunner:
             except OSError:
                 pass
 
-    def put_with_spill(self, oid: bytes, frames: list) -> bool:
+    async def put_with_spill(self, oid: bytes, frames: list) -> bool:
         """Insert, spilling LRU objects to disk until it fits (ray: plasma
-        CreateRequestQueue backpressure → spill)."""
+        CreateRequestQueue backpressure → spill).  All disk I/O runs off
+        the event loop (see _spill_one): heartbeats share this loop."""
         # Duplicate puts (client retry, task re-execution) are a success,
         # NOT a reason to spill: the native backend's put returns False
         # for already-present ids exactly like for a full arena.
@@ -287,21 +325,29 @@ class StoreRunner:
             return True
         if self.backend.put(oid, frames):
             return True
-        for _ in range(4096):
-            if not self._spill_one():
-                break
+        async with self._spill_lock:
+            # Re-check after the lock wait: a concurrent duplicate put may
+            # have landed this oid (in arena or on disk) meanwhile.
+            if self.backend.contains(oid) or oid in self.spilled:
+                return True
             if self.backend.put(oid, frames):
                 return True
-        # Arena can't hold it even after spilling: spill the new object
-        # itself straight to disk.
-        path, size = self._write_spill_file(oid, frames)
-        self.spilled[oid] = path
-        self.spilled_bytes += size
-        return True
+            for _ in range(4096):
+                if not await self._spill_one():
+                    break
+                if self.backend.put(oid, frames):
+                    return True
+            # Arena can't hold it even after spilling: spill the new
+            # object itself straight to disk.
+            path, size = await asyncio.to_thread(self._write_spill_file,
+                                                 oid, frames)
+            self.spilled[oid] = path
+            self.spilled_bytes += size
+            return True
 
     async def rpc_store_put(self, h: dict, blobs: list) -> dict:
-        ok = self.put_with_spill(bytes.fromhex(h["object_id"]),
-                                 list(blobs))
+        ok = await self.put_with_spill(bytes.fromhex(h["object_id"]),
+                                       list(blobs))
         return {"ok": ok}
 
     async def rpc_store_get(self, h: dict, _b: list) -> tuple[dict, list]:
@@ -309,8 +355,9 @@ class StoreRunner:
         frames = self.backend.get(oid)
         if frames is None:
             # Restore from disk (ray: spilled_object_reader.cc); best
-            # effort re-insert so repeat readers hit memory.
-            restored = self._read_spilled(oid)
+            # effort re-insert so repeat readers hit memory.  Off-loop:
+            # restoring a multi-GB object inline would stall heartbeats.
+            restored = await asyncio.to_thread(self._read_spilled, oid)
             if restored is None:
                 return {"found": False}, []
             if self.backend.put(oid, restored):
@@ -323,9 +370,24 @@ class StoreRunner:
 
     async def rpc_store_delete(self, h: dict, _b: list) -> dict:
         oid = bytes.fromhex(h["object_id"])
-        self.backend.delete(oid)
+        if not self.backend.delete(oid):
+            # Refused: a zero-copy reader still pins it.  Tombstone so the
+            # reaper retries after the pin releases — otherwise the dead
+            # object lingers, gets spilled under pressure, and leaks.
+            self._pending_deletes.add(oid)
         self._delete_spilled(oid)
         return {}
+
+    def retry_deletes(self) -> int:
+        """Retry tombstoned deletes (called from the agent's reaper loop,
+        after sweep_dead has reclaimed crashed readers' pins)."""
+        done = 0
+        for oid in list(self._pending_deletes):
+            if self.backend.delete(oid):
+                self._pending_deletes.discard(oid)
+                self._delete_spilled(oid)
+                done += 1
+        return done
 
     # --------------------------------------------- node-to-node transfer
     async def rpc_store_get_meta(self, h: dict, _b: list) -> dict:
@@ -377,13 +439,14 @@ class StoreRunner:
         chunk = self.config.transfer_chunk_bytes
         if not self.backend.create_raw(oid, size):
             # Arena full: make room the same way puts do.
-            for _ in range(4096):
-                if not self._spill_one():
+            async with self._spill_lock:
+                for _ in range(4096):
+                    if not await self._spill_one():
+                        return False
+                    if self.backend.create_raw(oid, size):
+                        break
+                else:
                     return False
-                if self.backend.create_raw(oid, size):
-                    break
-            else:
-                return False
         sem = asyncio.Semaphore(self.config.transfer_chunks_in_flight)
         failed = asyncio.Event()
 
@@ -466,7 +529,7 @@ class StoreRunner:
             except Exception:  # noqa: BLE001
                 continue
             if reply.get("found"):
-                return self.put_with_spill(oid, blobs)
+                return await self.put_with_spill(oid, blobs)
         return False
 
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:

@@ -103,8 +103,9 @@ class RpcServer:
 
     async def _dispatch(self, frames) -> None:
         identity = frames[0].bytes
-        msgid, method, header = msgpack.unpackb(frames[1].bytes, raw=False)
+        msgid, method = 0, "?"
         try:
+            msgid, method, header = msgpack.unpackb(frames[1].bytes, raw=False)
             blobs = [f.bytes for f in frames[2:]]
             handler = self._handlers.get(method)
             if handler is None:

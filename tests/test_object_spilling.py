@@ -13,27 +13,28 @@ def test_spill_and_restore_roundtrip():
     from ray_tpu._private.config import Config
     from ray_tpu._private.object_store import StoreRunner
 
+    import asyncio
+
     cfg = Config()
     cfg.object_store_memory = 4 * 1024 * 1024        # 4 MB arena
     runner = StoreRunner("ab" * 8, cfg)
-    try:
+
+    async def go():
         payloads = {}
         for i in range(8):                            # 8 x 1 MB > arena
             oid = bytes([i]) * 16
             data = np.full(1024 * 1024, i, np.uint8).tobytes()
             payloads[oid] = data
-            assert runner.put_with_spill(oid, [data])
+            assert await runner.put_with_spill(oid, [data])
         assert runner.spilled, "nothing was spilled"
-        import asyncio
-
-        async def fetch(oid):
+        for oid, data in payloads.items():
             reply, blobs = await runner.rpc_store_get(
                 {"object_id": oid.hex()}, [])
             assert reply["found"], oid
-            return bytes(blobs[0])
+            assert bytes(blobs[0]) == data
 
-        for oid, data in payloads.items():
-            assert asyncio.run(fetch(oid)) == data
+    try:
+        asyncio.run(go())
     finally:
         runner.close()
 
@@ -87,7 +88,7 @@ def test_chunked_cross_node_pull():
         oid = b"\x07" * 16
         payload = np.random.default_rng(0).integers(
             0, 255, 8 * 1024 * 1024, np.uint8).tobytes()   # 8 chunks
-        assert a.put_with_spill(oid, [b"hdr", payload])
+        assert await a.put_with_spill(oid, [b"hdr", payload])
         reply = await b.rpc_store_pull(
             {"object_id": oid.hex(), "from": [servers[0].address]}, [])
         assert reply["ok"], "chunked pull failed"
@@ -129,10 +130,10 @@ def test_chunked_pull_from_spilled_source():
 
         oid = b"\x09" * 16
         payload = bytes(range(256)) * (3 * 1024 * 32)     # ~3MB
-        assert a.put_with_spill(oid, [payload])
+        assert await a.put_with_spill(oid, [payload])
         # Force it onto disk on the source.
         while a.backend.contains(oid):
-            assert a._spill_one()
+            assert await a._spill_one()
         assert oid in a.spilled
         reply = await b.rpc_store_pull(
             {"object_id": oid.hex(), "from": [srv_a.address]}, [])
