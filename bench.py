@@ -102,9 +102,111 @@ def bench_control_plane() -> dict:
         dt = time.perf_counter() - t0
         out["get_gib_per_s"] = got.nbytes / dt / (1 << 30)
         del got, ref
+
+        # Placement-group churn (reference: placement_group create+remove,
+        # ray_perf.py — 824 PG/s bar; stress-test latencies 0.94/0.91 ms).
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+        n = 100
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}])
+            pg.ready(timeout=30.0)
+            remove_placement_group(pg)
+        out["pg_create_remove_per_s"] = n / (time.perf_counter() - t0)
+
+        # Many-actors scale point (reference: many_actors release bench —
+        # creation + readiness churn, not steady-state calls).
+        n = 200
+        t0 = time.perf_counter()
+        actors = [Counter.remote() for _ in range(n)]
+        ray_tpu.get([a.inc.remote() for a in actors])
+        out["many_actors_ready_per_s"] = n / (time.perf_counter() - t0)
+        for a in actors:
+            ray_tpu.kill(a)
+
+        # wait()-heavy pattern (reference: ray.wait loops in ray_perf.py).
+        n = 1000
+        refs = [noop.remote() for _ in range(n)]
+        t0 = time.perf_counter()
+        remaining = refs
+        while remaining:
+            _done, remaining = ray_tpu.wait(remaining,
+                                            num_returns=min(
+                                                100, len(remaining)))
+        out["wait_batches_per_s"] = n / (time.perf_counter() - t0)
     finally:
         ray_tpu.shutdown()
     return {k: round(v, 1) for k, v in out.items()}
+
+
+def bench_multi_client() -> dict:
+    """K driver processes hammering one cluster (reference:
+    multi_client_tasks_async 23,312/s and multi-client put 38.5 GiB/s on a
+    64-core node; this box has ONE core, so these bound at the single-core
+    aggregate)."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(resources={"CPU": 8})
+    out = {}
+    try:
+        import os
+
+        addr = global_worker().controller_addr
+        repo_dir = os.path.abspath(os.path.dirname(__file__) or ".")
+        n_clients, n_tasks = 3, 600
+        script = f"""
+import sys, time, json
+sys.path.insert(0, {repo_dir!r})
+import ray_tpu
+ray_tpu.init(address={addr!r})
+
+@ray_tpu.remote
+def noop():
+    return b"ok"
+
+ray_tpu.get([noop.remote() for _ in range(20)])
+t0 = time.perf_counter()
+ray_tpu.get([noop.remote() for _ in range({n_tasks})])
+dt = time.perf_counter() - t0
+import numpy as np
+big = np.zeros(64 * 1024 * 1024, np.uint8)
+t1 = time.perf_counter()
+ref = ray_tpu.put(big)
+put_dt = time.perf_counter() - t1
+print(json.dumps({{"tasks_per_s": {n_tasks}/dt,
+                   "put_gib_per_s": big.nbytes/put_dt/(1<<30)}}))
+ray_tpu.shutdown()
+import os; os._exit(0)
+"""
+        t0 = time.perf_counter()
+        procs = [subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.DEVNULL, text=True)
+                 for _ in range(n_clients)]
+        results = []
+        for p in procs:
+            stdout, _ = p.communicate(timeout=300)
+            for line in stdout.splitlines():
+                try:
+                    results.append(json.loads(line))
+                    break
+                except json.JSONDecodeError:
+                    continue
+        wall = time.perf_counter() - t0
+        if results:
+            out["multi_client_tasks_per_s"] = round(
+                n_clients * n_tasks / wall, 1)
+            out["multi_client_put_gib_per_s"] = round(
+                sum(r["put_gib_per_s"] for r in results), 2)
+            out["multi_client_n"] = n_clients
+    finally:
+        ray_tpu.shutdown()
+    return out
 
 
 def bench_model() -> dict:
@@ -252,6 +354,10 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         extra["control_plane_error"] = repr(e)
         value = 0.0
+    try:
+        extra.update(_with_timeout(bench_multi_client, 300))
+    except Exception as e:  # noqa: BLE001
+        extra["multi_client_error"] = repr(e)
     try:
         extra["model_bench"] = _with_timeout(bench_model, 900)
     except Exception as e:  # noqa: BLE001

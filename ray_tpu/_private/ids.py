@@ -19,9 +19,28 @@ ID_SIZE = 16
 
 NIL = b"\x00" * ID_SIZE
 
+# Random ids are minted thousands of times per second on the task-submit
+# hot path; a urandom syscall each (~10µs) is measurable.  Instead: one
+# urandom prefix per process + an itertools counter (next() is atomic
+# under the GIL — submit_task runs on arbitrary user threads), reseeded
+# after fork (forked workers would otherwise mint the parent's stream).
+import itertools as _itertools
+
+_prefix = os.urandom(ID_SIZE - 8)
+_counter = _itertools.count(1)
+
+
+def _reseed() -> None:
+    global _prefix, _counter
+    _prefix = os.urandom(ID_SIZE - 8)
+    _counter = _itertools.count(1)
+
+
+os.register_at_fork(after_in_child=_reseed)
+
 
 def random_id() -> bytes:
-    return os.urandom(ID_SIZE)
+    return _prefix + next(_counter).to_bytes(8, "little")
 
 
 def hex_id(b: bytes) -> str:

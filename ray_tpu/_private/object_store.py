@@ -262,6 +262,17 @@ class StoreRunner:
             path, size = await asyncio.to_thread(self._write_spill_file,
                                                  oid, frames)
             del frames      # dict backend: plain bytes, nothing pinned
+        if not self.backend.contains(oid):
+            # Deleted while the file write was in flight: the object is
+            # dead — registering the spill file would resurrect it (and
+            # leak the file forever).  Memory was freed by the delete, so
+            # this still counts as progress for the caller's retry loop.
+            self._pending_deletes.discard(oid)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return True
         if not self.backend.delete(oid):
             # Raced with a reader pinning it: the arena copy stays
             # authoritative; drop the file so nothing double-counts.

@@ -65,15 +65,60 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
+_SAFE_SCALARS = frozenset({type(None), bool, int, float, complex, str,
+                           bytes, bytearray})
+_SAFE_CONTAINERS = frozenset({list, tuple, set, frozenset})
+
+try:
+    import numpy as _np
+except Exception:  # noqa: BLE001
+    _np = None
+
+
+def _stdlib_picklable(v: Any) -> bool:
+    """True when the C pickler provably produces the SAME result cloudpickle
+    would: exact builtin scalar/container types, object-free numpy arrays,
+    and ObjectRefs.  Everything else (instances of user classes — possibly
+    defined in __main__, which stdlib pickles by broken reference but
+    cloudpickle by value — functions, jax arrays, subclasses) falls back to
+    the CloudPickler."""
+    t = v.__class__
+    if t in _SAFE_SCALARS:
+        return True
+    if t is dict:
+        return all(_stdlib_picklable(k) and _stdlib_picklable(x)
+                   for k, x in v.items())
+    if t in _SAFE_CONTAINERS:
+        return all(_stdlib_picklable(x) for x in v)
+    if _np is not None and t is _np.ndarray:
+        return not v.dtype.hasobject
+    from ray_tpu.object_ref import ObjectRef
+
+    return t is ObjectRef
+
+
 def serialize(value: Any) -> SerializedValue:
     import io
 
     buffers: list[pickle.PickleBuffer] = []
     _capture.refs = []
     try:
-        sink = io.BytesIO()
-        _Pickler(sink, buffers.append).dump(value)
-        frames: list = [sink.getvalue()]
+        fast = False
+        try:
+            fast = _stdlib_picklable(value)
+        except RecursionError:
+            fast = False
+        if fast:
+            # Hot path: the C pickler (~10x the pure-Python CloudPickler
+            # for small values).  ObjectRef capture still works — its
+            # __reduce__ calls _note_ref.
+            stream = pickle.dumps(value, protocol=5,
+                                  buffer_callback=buffers.append)
+        else:
+            sink = io.BytesIO()
+            _Pickler(sink, buffers.append).dump(value)
+            stream = sink.getvalue()
+        frames: list = [stream]
         for b in buffers:
             raw = b.raw()   # 1-D C-contiguous "B" view (raises otherwise)
             # Large buffers stay zero-copy views into the source object

@@ -206,9 +206,14 @@ class LeaseManager:
                     # their arg resolution may need an earlier batch
                     # member's reply, which only ships when the whole
                     # batch finishes (deadlock).
-                    plain = [t for t in batch
-                             if not t.header.get("arg_refs")]
-                    dep = [t for t in batch if t.header.get("arg_refs")]
+                    def _solo(t):
+                        # Streaming tasks also go solo: their reply waits
+                        # on the LAST item, which would gate every batch
+                        # sibling's reply behind the stream.
+                        return (t.header.get("arg_refs")
+                                or t.header.get("streaming"))
+                    plain = [t for t in batch if not _solo(t)]
+                    dep = [t for t in batch if _solo(t)]
                     ops = []
                     if len(plain) == 1:
                         ops.append(self._push_one(plain[0], lease))
@@ -348,6 +353,19 @@ class LeaseManager:
 
 
 @dataclass
+class StreamState:
+    """Owner-side state of one streaming-generator task (ray:
+    ObjectRefGenerator streaming reports, _raylet.pyx:277,1103): item refs
+    appear here as the executing worker ships them, long before the task's
+    final reply."""
+
+    refs: list = field(default_factory=list)      # minted item ObjectRefs
+    total: int | None = None                      # set by the final reply
+    error: BaseException | None = None
+    event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+@dataclass
 class ActorSubmitState:
     """Caller-side state for one remote actor (per ActorHandle target)."""
 
@@ -422,6 +440,17 @@ class CoreWorker:
         self.current_task_id: str | None = None
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
+        # task_id -> StreamState for streaming-generator tasks this process
+        # submitted (owner side; mutated only on the IO loop).
+        self.streams: dict[bytes, StreamState] = {}
+        # Abandoned streams (generator GC'd): late items must NOT re-create
+        # state (it would never be removed and would pin the item refs
+        # forever).  Bounded FIFO of task_ids.
+        self._dead_streams: set[bytes] = set()
+        self._dead_stream_order: list[bytes] = []
+        # return-0 object id -> task_id, recorded at streaming submits so
+        # the generator wrapper can find its stream (popped immediately).
+        self._ret0_task_ids: dict[bytes, bytes] = {}
         self._oom_worker_addrs: set[str] = set()
         # Known-dead worker addresses (set for O(1) membership on the
         # push hot path + FIFO order for bounded eviction).  Entries are
@@ -446,6 +475,12 @@ class CoreWorker:
         self.loop: asyncio.AbstractEventLoop = None  # set in start()
         self._default_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
+        # Batched cross-thread posts: call_soon_threadsafe costs a self-pipe
+        # write (syscall) per call, which at thousands of submits/releases
+        # per second dominates the submit path.  One wakeup drains many.
+        self._post_pending: list = []
+        self._post_scheduled = False
+        self._post_mutex = threading.Lock()
 
     # ---------------------------------------------------------------- setup
     def start(self) -> None:
@@ -536,6 +571,41 @@ class CoreWorker:
                 pass
         self._io_thread.join(5.0)
         set_global_worker(None)
+
+    def _post_to_loop(self, fn) -> None:
+        """Run fn() on the IO loop; safe from any thread.  Posts made while
+        a wakeup is already pending ride the same drain (one self-pipe
+        write per burst instead of one per call)."""
+        with self._post_mutex:
+            self._post_pending.append(fn)
+            if self._post_scheduled:
+                return
+            self._post_scheduled = True
+        loop = self.loop
+        try:
+            if loop is None:
+                raise RuntimeError("IO loop not running")
+            loop.call_soon_threadsafe(self._drain_posts)
+        except RuntimeError:
+            # Reset so a later post retries the wakeup — a stuck True flag
+            # would silently drop every future post (submit hangs).
+            with self._post_mutex:
+                self._post_scheduled = False
+            raise
+
+    def _drain_posts(self) -> None:
+        while True:
+            with self._post_mutex:
+                pending = self._post_pending
+                if not pending:
+                    self._post_scheduled = False
+                    return
+                self._post_pending = []
+            for fn in pending:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001
+                    logger.exception("posted callback failed")
 
     def run(self, coro, timeout: float | None = None):
         """Bridge a coroutine from any user thread onto the IO loop."""
@@ -632,6 +702,8 @@ class CoreWorker:
             retry_exceptions=bool(options.get("retry_exceptions")),
             scheduling_key=scheduling_key, borrowed=borrowed)
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
+        if options.get("streaming"):
+            self._ret0_task_ids[return_ids[0]] = task_id.binary()
         with self._ref_lock:
             for rid in return_ids:
                 rec = self.owned.setdefault(rid, OwnedObject())
@@ -643,13 +715,196 @@ class CoreWorker:
             self.memory_entries_for(return_ids)
             self.lease_manager.submit(task)
 
-        self.loop.call_soon_threadsafe(_go)
+        self._post_to_loop(_go)
         self._record_event(task_id.hex(), "SUBMITTED", fid)
         return refs
 
     def memory_entries_for(self, return_ids: list[bytes]) -> None:
         for rid in return_ids:
             self.memory.entry(rid)
+
+    # ------------------------------------------------ streaming generators
+    def submit_streaming_task(self, fn: Any, args: tuple, kwargs: dict,
+                              options: dict):
+        """Submit a generator task whose items stream back as they are
+        produced (ray: streaming ObjectRefGenerator).  Returns the
+        generator immediately — no waiting for the task."""
+        from ray_tpu.object_ref import StreamingObjectRefGenerator
+
+        options = {**options, "num_returns": 1, "streaming": True}
+        refs = self.submit_task(fn, args, kwargs, options)
+        return StreamingObjectRefGenerator(
+            self._task_id_of(refs[0]), refs[0], self)
+
+    def submit_streaming_actor_task(self, actor_id: str, method: str,
+                                    args: tuple, kwargs: dict,
+                                    options: dict):
+        from ray_tpu.object_ref import StreamingObjectRefGenerator
+
+        options = {**options, "num_returns": 1, "streaming": True}
+        refs = self.submit_actor_task(actor_id, method, args, kwargs,
+                                      options)
+        return StreamingObjectRefGenerator(
+            self._task_id_of(refs[0]), refs[0], self)
+
+    def _task_id_of(self, ref: ObjectRef) -> bytes:
+        """task_id for a return-0 ref minted by this process this session
+        (submit paths record it)."""
+        return self._ret0_task_ids.pop(ref.binary())
+
+    def _stream_state(self, task_id: bytes) -> StreamState:
+        st = self.streams.get(task_id)
+        if st is None:
+            st = StreamState()
+            self.streams[task_id] = st
+        return st
+
+    def stream_next(self, task_id: bytes, index: int,
+                    timeout: float | None = None) -> ObjectRef:
+        """Blocking wait for item `index` of a streaming task.  Raises
+        StopAsyncIteration past the end, or the task's error."""
+        return self.run(self._stream_next_async(task_id, index), timeout)
+
+    async def _stream_next_async(self, task_id: bytes,
+                                 index: int) -> ObjectRef:
+        st = self._stream_state(task_id)
+        while True:
+            if index < len(st.refs):
+                return st.refs[index]
+            if st.total is not None and index >= st.total:
+                if st.error is not None:
+                    raise st.error
+                raise StopAsyncIteration
+            st.event.clear()
+            await st.event.wait()
+
+    def drop_stream(self, task_id: bytes) -> None:
+        """Generator finalizer hook: forget the stream state (item refs
+        release via their own ObjectRef finalizers) and tombstone the
+        stream so late items are refused."""
+        def _drop():
+            self.streams.pop(task_id, None)
+            self._dead_streams.add(task_id)
+            self._dead_stream_order.append(task_id)
+            while len(self._dead_stream_order) > 4096:
+                self._dead_streams.discard(self._dead_stream_order.pop(0))
+        try:
+            self._post_to_loop(_drop)
+        except RuntimeError:
+            pass    # loop gone at teardown: nothing to clean
+
+    async def rpc_stream_item(self, h: dict, blobs: list) -> dict:
+        """Owner-side registration of one streamed item (the executing
+        worker awaits this ack — that is the stream's backpressure AND the
+        guarantee that every item is registered before the final task
+        reply arrives)."""
+        task_id = bytes.fromhex(h["task_id"])
+        if task_id in self._dead_streams:
+            # Consumer abandoned the stream: refuse the item so nothing
+            # pins it (the producer keeps its retry budget intact; the
+            # final reply cleans up the return-0 record).
+            return {}
+        st = self._stream_state(task_id)
+        index = h["index"]
+        tid = TaskID(task_id)
+        iid = ObjectID.for_return(tid, index + 1).binary()
+        with self._ref_lock:
+            irec = self.owned.setdefault(iid, OwnedObject())
+            prev_pins, irec.contained = irec.contained, [
+                (bytes.fromhex(c[0]), c[1]) for c in h.get("contained", ())]
+            rec0 = self.owned.get(ObjectID.for_return(tid, 0).binary())
+            if rec0 is not None:
+                irec.submit_spec = rec0.submit_spec
+                irec.retries_left = rec0.retries_left
+            if h.get("inline"):
+                irec.state = "inline"
+                irec.frames = list(blobs)
+                self.memory.put_frames(iid, irec.frames)
+            else:
+                irec.state = "stored"
+                irec.locations = [h["location"]]
+                self.memory.put_locations(iid, irec.locations)
+            if index >= len(st.refs):
+                # One count for the ObjectRef held in the stream (handed
+                # to the consumer by stream_next).
+                irec.local_refs += 1
+                st.refs.append(ObjectRef(iid, self.address))
+            # else: a retried task re-shipped an index we already hold —
+            # payload refreshed above, no new ref/pin.
+        for c_oid, c_owner in prev_pins:
+            self._release_borrow(c_oid, c_owner)
+        st.event.set()
+        return {}
+
+    def _finish_stream(self, task: PendingTask, reply: dict,
+                       blobs: list) -> None:
+        """Owner-side handling of a streaming task's final reply: resolve
+        the return-0 ref to an ObjectRefGenerator over all items (dynamic
+        compat — the items are pinned as its contained refs) and wake
+        consumers."""
+        from ray_tpu.object_ref import ObjectRefGenerator
+
+        st = self._stream_state(task.task_id)
+        abandoned = task.task_id in self._dead_streams
+        status = reply.get("status")
+        total = int(reply.get("streamed", 0))
+        rid0 = task.return_ids[0]
+        if status == "ok":
+            prev_contained: list = []
+            rec = None
+            with self._ref_lock:
+                rec = self.owned.get(rid0)
+                contained = []
+                for ref in st.refs[:total]:
+                    iid = ref.binary()
+                    irec = self.owned.get(iid)
+                    if irec is not None:
+                        irec.borrowers += 1
+                        contained.append((iid, self.address))
+                value = ObjectRefGenerator(list(st.refs[:total]))
+                sv = serialize(value)
+                if rec is None:
+                    tmp = OwnedObject()
+                    tmp.contained = contained
+                    self._free_object(rid0, tmp)
+                else:
+                    prev_contained, rec.contained = rec.contained, contained
+                    rec.state = "inline"
+                    rec.frames = sv.frames
+                    e = self.memory.entry(rid0)
+                    e.frames = sv.frames
+                    e.has_value, e.value = True, value
+                    e.event.set()
+            for c_oid, c_owner in prev_contained:
+                self._release_borrow(c_oid, c_owner)
+            st.total = total
+            self._record_event(task.task_id.hex(), "FINISHED")
+        elif status == "cancelled":
+            st.error = TaskCancelledError(task.task_id.hex())
+            st.total = total
+            self._resolve_error(rid0, st.error)
+        else:
+            exc, tb = None, reply.get("traceback", "")
+            if blobs:
+                try:
+                    import pickle
+
+                    exc = pickle.loads(blobs[0])
+                except Exception:  # noqa: BLE001
+                    exc = RuntimeError("task failed")
+            if task.retry_exceptions and task.retries_left > 0:
+                task.retries_left -= 1
+                self.lease_manager.submit(task)
+                return
+            st.error = TaskError(exc or RuntimeError("task failed"), tb)
+            st.total = total
+            self._resolve_error(rid0, st.error)
+            self._record_event(task.task_id.hex(), "FAILED")
+        st.event.set()
+        if abandoned:
+            # The state above was a transient re-creation (the consumer is
+            # gone); drop it again so nothing stays pinned.
+            self.streams.pop(task.task_id, None)
 
     def _build_task_payload(self, task_id: bytes, fid: str, args: tuple,
                             kwargs: dict, num_returns: int,
@@ -690,6 +945,8 @@ class CoreWorker:
         }
         if options.get("dynamic"):
             header["dynamic"] = True
+        if options.get("streaming"):
+            header["streaming"] = True
         if options.get("runtime_env"):
             from ray_tpu._private import runtime_env as renv
 
@@ -713,8 +970,7 @@ class CoreWorker:
                         "add_borrow", {"object_id": oid.hex()})
                 except Exception:  # noqa: BLE001
                     pass
-            self.loop.call_soon_threadsafe(
-                lambda: self.loop.create_task(_notify()))
+            self._post_to_loop(lambda: self.loop.create_task(_notify()))
 
     def _release_borrow(self, oid: bytes, owner_addr: str) -> None:
         """Undo one _add_borrow pin (submitter after reply, or borrower
@@ -733,11 +989,7 @@ class CoreWorker:
                         "remove_borrow", {"object_id": oid.hex()})
                 except Exception:  # noqa: BLE001
                     pass
-            try:
-                self.loop.call_soon_threadsafe(
-                    lambda: self.loop.create_task(_notify()))
-            except RuntimeError:
-                pass
+            self._post_to_loop(lambda: self.loop.create_task(_notify()))
 
     def _release_task_borrows(self, task: "PendingTask") -> None:
         """Release this task's submission pins.  By reply time the
@@ -819,6 +1071,9 @@ class CoreWorker:
             # Terminal reply: drop submission borrow pins (retried tasks
             # keep theirs — the resend ships the same refs).
             self._release_task_borrows(task)
+        if task.header.get("streaming"):
+            self._finish_stream(task, reply, blobs)
+            return
         if status == "ok":
             returns = reply["returns"]
             offset = 0
@@ -1285,13 +1540,9 @@ class CoreWorker:
     def _evict_cached(self, object_id: bytes) -> None:
         """Delete a memory-store entry from any thread (the store is
         loop-affine)."""
-        loop = self.loop
-        if loop is None or self._shutdown.is_set():
+        if self.loop is None or self._shutdown.is_set():
             return
-        try:
-            loop.call_soon_threadsafe(self.memory.delete, object_id)
-        except RuntimeError:
-            pass
+        self._post_to_loop(lambda: self.memory.delete(object_id))
 
     def _note_deserialized_own_ref(self, object_id: bytes) -> None:
         """A deserialized copy of one of our own refs counts as a local
@@ -1317,10 +1568,7 @@ class CoreWorker:
             self.memory.delete(object_id)
             for addr in locations:
                 loop.create_task(self._delete_remote(addr, object_id))
-        try:
-            loop.call_soon_threadsafe(_cleanup)
-        except RuntimeError:
-            pass
+        self._post_to_loop(_cleanup)
 
     async def _delete_remote(self, addr: str, object_id: bytes) -> None:
         try:
@@ -1366,9 +1614,25 @@ class CoreWorker:
         member's escaping exception must NOT void its completed siblings
         (their side effects and pin ACKs are already real), so every
         member is error-isolated into its own reply."""
+        tasks = h["tasks"]
+        fns = []
+        for th in tasks:
+            fn = self.functions.get(th["function_id"])
+            if (fn is None or th.get("arg_refs") or th.get("runtime_env")
+                    or th.get("dynamic") or th.get("streaming")
+                    or bytes.fromhex(th["task_id"]) in self._cancelled):
+                fns = None
+                break
+            fns.append(fn)
+        if fns is not None:
+            # Fast path: the whole batch runs in ONE executor hop
+            # (deserialize → call → serialize in the thread) instead of
+            # 3 thread-pool round-trips per task — the per-task context
+            # switches are the dominant control-plane cost.
+            return await self._push_batch_fast(tasks, blobs, fns)
         replies, out_blobs = [], []
         offset = 0
-        for th in h["tasks"]:
+        for th in tasks:
             n = th.pop("nframes")
             try:
                 reply, rb = await self.rpc_push_task(
@@ -1376,6 +1640,124 @@ class CoreWorker:
             except BaseException as e:  # noqa: BLE001
                 reply, rb = self._error_reply(e)
             offset += n
+            reply["nblobs"] = len(rb)
+            replies.append(reply)
+            out_blobs.extend(rb)
+        return {"replies": replies}, out_blobs
+
+    def _exec_simple_thread(self, th: dict, frames: list, fn) -> dict:
+        """Executor-thread body of the fast path: deserialize args, run the
+        user function, serialize returns, attempt arena store of large
+        returns.  Touches no loop-affine state (memory store, asyncio)."""
+        import pickle as _pickle
+
+        rec = {"arg_contained": (), "svs": None, "err": None, "stored": ()}
+        prev = self.current_task_id
+        self.current_task_id = th["task_id"]
+        self._record_event(th["task_id"], "RUNNING", th.get("name", ""))
+        try:
+            value, contained = deserialize_with_refs(frames)
+            rec["arg_contained"] = contained
+            args, kwargs = value
+            result = fn(*args, **kwargs)
+            num_returns = th.get("num_returns", 1)
+            values = [result] if num_returns == 1 else list(result)
+            if num_returns != 1 and len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but "
+                    f"returned {len(values)} values")
+            svs = [serialize(v) for v in values]
+            rec["svs"] = svs
+            stored = [None] * len(svs)
+            tid = TaskID(bytes.fromhex(th["task_id"]))
+            inline_max = self.config.max_inline_object_size
+            for i, sv in enumerate(svs):
+                if sv.total_bytes > inline_max:
+                    rid = ObjectID.for_return(tid, i).binary()
+                    stored[i] = self._store_frames_local(rid, sv.frames)
+            rec["stored"] = stored
+        except BaseException as e:  # noqa: BLE001
+            tb_str = traceback.format_exc()
+            try:
+                payload = _pickle.dumps(e)
+            except Exception:  # noqa: BLE001
+                payload = _pickle.dumps(RuntimeError(str(e)))
+            rec["err"] = (payload, tb_str)
+        finally:
+            self.current_task_id = prev
+        return rec
+
+    async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
+        """Loop-side completion of one fast-path execution: borrow
+        registration, contained-ref pins, local return caching, agent
+        store fallback."""
+        import pickle as _pickle
+
+        tid = TaskID(bytes.fromhex(th["task_id"]))
+        if rec["arg_contained"]:
+            await self._register_borrows(rec["arg_contained"])
+        if rec["err"] is not None:
+            payload, tb_str = rec["err"]
+            if self.mode == "worker":
+                try:
+                    cause = _pickle.loads(payload)
+                except Exception:  # noqa: BLE001
+                    cause = RuntimeError("task failed")
+                err = TaskError(cause, tb_str)
+                for i in range(th.get("num_returns", 1)):
+                    self._cache_local_return(
+                        ObjectID.for_return(tid, i).binary(), error=err)
+            return {"status": "error", "traceback": tb_str}, [payload]
+        returns, rb = [], []
+        for i, sv in enumerate(rec["svs"]):
+            contained = await self._pin_contained_refs(sv)
+            rid = ObjectID.for_return(tid, i).binary()
+            if rec["stored"][i] is None:       # inline-sized
+                returns.append({"inline": True, "nframes": len(sv.frames),
+                                "contained": contained})
+                rb.extend(sv.frames)
+                if self.mode == "worker":
+                    self._cache_local_return(rid, frames=sv.frames)
+            else:
+                if rec["stored"][i] is False:  # arena full/absent
+                    await self.clients.get(self.agent_addr).call(
+                        "store_put", {"object_id": rid.hex()}, sv.frames)
+                returns.append({"inline": False,
+                                "location": self.agent_addr,
+                                "contained": contained})
+                if self.mode == "worker":
+                    self._cache_local_return(rid,
+                                             locations=[self.agent_addr])
+        return {"status": "ok", "returns": returns}, rb
+
+    async def _push_batch_fast(self, tasks: list, blobs: list,
+                               fns: list) -> tuple[dict, list]:
+        """One-executor-hop execution of a batch of simple tasks (function
+        cached, no top-level ref args, no runtime_env, not dynamic).  The
+        thread does the pure-Python work (deserialize, user code,
+        serialize, arena store attempt); everything loop-affine (borrow
+        registration, contained-ref pins, memory-store caching, agent
+        RPC fallback) happens here afterwards."""
+        def _run_all():
+            recs = []
+            offset = 0
+            for th, fn in zip(tasks, fns):
+                n = th["nframes"]
+                recs.append(self._exec_simple_thread(
+                    th, blobs[offset:offset + n], fn))
+                offset += n
+            return recs
+
+        recs = await self.loop.run_in_executor(self._default_executor,
+                                               _run_all)
+        replies, out_blobs = [], []
+        for th, rec in zip(tasks, recs):
+            # Per-member isolation: a finalize failure (e.g. agent store
+            # RPC down) must not void siblings whose side effects are real.
+            try:
+                reply, rb = await self._finalize_simple(th, rec)
+            except BaseException as e:  # noqa: BLE001
+                reply, rb = self._error_reply(e)
             reply["nblobs"] = len(rb)
             replies.append(reply)
             out_blobs.extend(rb)
@@ -1420,6 +1802,12 @@ class CoreWorker:
 
             with renv.activate(h.get("runtime_env"), self):
                 return fn(*args, **kwargs)
+        if h.get("streaming"):
+            try:
+                return await self._run_streaming(h, _thunk,
+                                                 self._default_executor)
+            finally:
+                self._evict_untracked_args(h)
         try:
             result = await self._run_user_code(_thunk, task_id=task_id)
         except BaseException as e:  # noqa: BLE001
@@ -1427,6 +1815,102 @@ class CoreWorker:
         finally:
             self._evict_untracked_args(h)
         return await self._pack_returns(result, h)
+
+    def _make_stream_shipper(self, h: dict):
+        """Shared item shipper for streaming generators: serializes one
+        item and delivers it to the owner as an ACKED stream_item call
+        (the ack is the backpressure, and it guarantees every item is
+        registered owner-side before the final reply — which travels on a
+        different socket — can arrive)."""
+        owner = h["owner_addr"]
+        tid = TaskID(bytes.fromhex(h["task_id"]))
+        inline_max = self.config.max_inline_object_size
+
+        async def _ship(item, idx: int) -> None:
+            sv = serialize(item)
+            contained = await self._pin_contained_refs(sv)
+            iid = ObjectID.for_return(tid, idx + 1).binary()
+            hdr = {"task_id": h["task_id"], "index": idx,
+                   "contained": contained}
+            if sv.total_bytes <= inline_max:
+                hdr["inline"] = True
+                if self.mode == "worker":
+                    self._cache_local_return(iid, frames=sv.frames)
+                await self.clients.get(owner).call(
+                    "stream_item", hdr, sv.frames, timeout=60.0)
+            else:
+                if not self._store_frames_local(iid, sv.frames):
+                    await self.clients.get(self.agent_addr).call(
+                        "store_put", {"object_id": iid.hex()}, sv.frames)
+                hdr["inline"] = False
+                hdr["location"] = self.agent_addr
+                if self.mode == "worker":
+                    self._cache_local_return(iid,
+                                             locations=[self.agent_addr])
+                await self.clients.get(owner).call("stream_item", hdr,
+                                                   timeout=60.0)
+
+        return _ship
+
+    async def _run_streaming(self, h: dict, thunk,
+                             executor) -> tuple[dict, list]:
+        """Executor side of a streaming generator: iterate the user
+        generator on the executor thread, shipping each item as produced
+        (see _make_stream_shipper)."""
+        loop = self.loop
+        ship = self._make_stream_shipper(h)
+        count = 0
+
+        def _producer():
+            nonlocal count
+            prev = self.current_task_id
+            self.current_task_id = h["task_id"]
+            try:
+                for item in thunk():
+                    asyncio.run_coroutine_threadsafe(
+                        ship(item, count), loop).result()
+                    count += 1
+            finally:
+                self.current_task_id = prev
+
+        try:
+            await loop.run_in_executor(executor, _producer)
+        except BaseException as e:  # noqa: BLE001
+            reply, rb = self._error_reply(e)
+            reply["streaming"] = True
+            reply["streamed"] = count
+            return reply, rb
+        finally:
+            self._evict_untracked_args(h)
+        return {"status": "ok", "streaming": True, "streamed": count}, []
+
+    async def _run_streaming_async(self, h: dict,
+                                   factory) -> tuple[dict, list]:
+        """Async-actor streaming: factory() returns an async generator
+        (iterated on the loop, items ship as yielded) or a coroutine
+        (awaited; its value streams as a single item)."""
+        import inspect as _inspect
+
+        ship = self._make_stream_shipper(h)
+        count = 0
+        try:
+            target = factory()
+            if _inspect.isasyncgen(target):
+                async for item in target:
+                    await ship(item, count)
+                    count += 1
+            else:
+                item = await target
+                await ship(item, count)
+                count += 1
+        except BaseException as e:  # noqa: BLE001
+            reply, rb = self._error_reply(e)
+            reply["streaming"] = True
+            reply["streamed"] = count
+            return reply, rb
+        finally:
+            self._evict_untracked_args(h)
+        return {"status": "ok", "streaming": True, "streamed": count}, []
 
     def _evict_untracked_args(self, h: dict) -> None:
         """Drop cached values fetched for this task's top-level ref args.
@@ -1648,11 +2132,75 @@ class CoreWorker:
         started = await self._actor_call_begin(h, blobs)
         return await started
 
+    def _actor_batch_simple(self, inst: ActorInstance, calls: list) -> bool:
+        """True when the whole batch can run as one executor thunk: sync
+        single-threaded actor (executor FIFO preserves call order across
+        concurrent batches), contiguous in-order seqnos from one caller,
+        no ref args / runtime_env / dynamic returns."""
+        if inst.is_async or inst.max_concurrency != 1 or inst.runtime_env:
+            return False
+        caller = calls[0].get("caller")
+        expected = inst.next_seq.get(caller, calls[0].get("seqno", 0))
+        for ch in calls:
+            if (ch.get("arg_refs") or ch.get("dynamic")
+                    or ch.get("streaming")
+                    or ch.get("actor_id") != inst.actor_id
+                    or ch.get("caller") != caller
+                    or ch.get("seqno", 0) != expected
+                    or not callable(getattr(inst.instance,
+                                            ch.get("method", ""), None))):
+                return False
+            expected += 1
+        return True
+
+    async def _actor_batch_fast(self, inst: ActorInstance, calls: list,
+                                blobs: list) -> tuple[dict, list]:
+        """One-executor-hop execution of a simple actor-call batch (see
+        _push_batch_fast).  Seqnos advance for the whole batch up front —
+        the batch occupies one FIFO slot on the actor's executor, so a
+        later batch's thunk queues behind it and order is preserved."""
+        caller = calls[0].get("caller")
+        last_seq = calls[-1].get("seqno", 0)
+        inst.next_seq[caller] = last_seq + 1
+        buf = inst.buffered.get(caller, {})
+        nxt_fut = buf.pop(last_seq + 1, None)
+        if nxt_fut and not nxt_fut.done():
+            nxt_fut.set_result(None)
+
+        methods = [getattr(inst.instance, ch["method"]) for ch in calls]
+
+        def _run_all():
+            recs = []
+            offset = 0
+            for ch, m in zip(calls, methods):
+                n = ch["nframes"]
+                recs.append(self._exec_simple_thread(
+                    ch, blobs[offset:offset + n], m))
+                offset += n
+            return recs
+
+        recs = await self.loop.run_in_executor(inst.executor, _run_all)
+        replies, out_blobs = [], []
+        for ch, rec in zip(calls, recs):
+            try:
+                reply, rb = await self._finalize_simple(ch, rec)
+            except BaseException as e:  # noqa: BLE001
+                reply, rb = self._error_reply(e)
+            reply["nblobs"] = len(rb)
+            replies.append(reply)
+            out_blobs.extend(rb)
+        return {"replies": replies}, out_blobs
+
     async def rpc_actor_call_batch(self, h: dict,
                                    blobs: list) -> tuple[dict, list]:
         """Batched actor calls from one caller: START all in seqno order
         (so async/threaded actors still overlap execution), then gather
         the replies into one message (amortizes per-call RPC overhead)."""
+        calls = h["calls"]
+        if calls:
+            inst = self.actors_hosted.get(calls[0].get("actor_id", ""))
+            if inst is not None and self._actor_batch_simple(inst, calls):
+                return await self._actor_batch_fast(inst, calls, blobs)
         finishers = []
         offset = 0
         for ch in h["calls"]:
@@ -1754,6 +2302,26 @@ class CoreWorker:
         task_id = bytes.fromhex(h["task_id"])
         self._record_event(h["task_id"], "RUNNING",
                            f"{type(inst.instance).__name__}.{h['method']}")
+        if h.get("streaming"):
+            import inspect as _inspect
+
+            if _inspect.isasyncgenfunction(method) or (
+                    inst.is_async
+                    and asyncio.iscoroutinefunction(method)):
+                # Async generator (or coroutine) method: iterate on the
+                # loop, shipping items as yielded.
+                return self._run_streaming_async(
+                    h, lambda: method(*args, **kwargs))
+
+            # Sync streaming generator method: items ship as produced; the
+            # generator runs on the actor's own executor (FIFO with its
+            # other calls).
+            def _gen_thunk():
+                from ray_tpu._private import runtime_env as renv
+
+                with renv.activate(inst.runtime_env, self):
+                    return method(*args, **kwargs)
+            return self._run_streaming(h, _gen_thunk, inst.executor)
         if inst.is_async and asyncio.iscoroutinefunction(method):
             if inst.runtime_env:
                 from ray_tpu._private import runtime_env as renv
@@ -1824,6 +2392,8 @@ class CoreWorker:
             task_id.binary(), "", args, kwargs, num_returns, {}, None, options)
         header.update({"actor_id": actor_id, "method": method,
                        "caller": self.worker_id})
+        if options.get("streaming"):
+            self._ret0_task_ids[return_ids[0]] = task_id.binary()
         with self._ref_lock:
             for rid in return_ids:
                 rec = self.owned.setdefault(rid, OwnedObject())
@@ -1839,7 +2409,7 @@ class CoreWorker:
             self._push_actor_task(
                 st, header, blobs, return_ids, max_task_retries, borrowed)
 
-        self.loop.call_soon_threadsafe(_go)
+        self._post_to_loop(_go)
         return refs
 
     def _push_actor_task(self, st: ActorSubmitState, header: dict,
@@ -1872,7 +2442,17 @@ class CoreWorker:
         try:
             while st.outbox:
                 limit = self.config.actor_call_batch_size
-                batch = st.outbox[:limit]
+                if st.outbox[0][0].header.get("streaming"):
+                    # Streaming calls ride alone: their reply waits on the
+                    # LAST generated item, which would gate every batch
+                    # sibling's reply behind the whole stream.
+                    batch = st.outbox[:1]
+                else:
+                    batch = []
+                    for entry in st.outbox[:limit]:
+                        if entry[0].header.get("streaming"):
+                            break
+                        batch.append(entry)
                 del st.outbox[:len(batch)]
                 await st.send_sem.acquire()
                 t = self.loop.create_task(self._send_actor_batch(st, batch))

@@ -34,6 +34,11 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def remote(self, *args, **kwargs):
+        if self._num_returns == "streaming":
+            from ray_tpu._private.worker import global_worker
+
+            return global_worker().submit_streaming_actor_task(
+                self._handle._actor_id, self._name, args, kwargs, {})
         return self._handle._invoke(self._name, args, kwargs,
                                     {"num_returns": self._num_returns})
 
@@ -42,7 +47,8 @@ class ActorMethod:
         if nr == "dynamic":
             raise NotImplementedError(
                 'num_returns="dynamic" is only supported on task '
-                "functions, not actor methods")
+                'functions; use num_returns="streaming" for actor '
+                "generator methods")
         m = ActorMethod(self._handle, self._name, nr)
         return m
 

@@ -86,9 +86,70 @@ class ObjectRef:
                 pass
 
     def __reduce__(self):
-        # Plain pickle path (outside task-arg serialization, which uses the
-        # reducer_override in serialization.py to also track borrowers).
+        # Used by the C-pickler fast path in serialization.serialize (and
+        # plain pickling elsewhere).  _note_ref records the ref for borrow
+        # tracking when a serialize() capture is active; it is a no-op
+        # otherwise.
+        from ray_tpu._private.serialization import _note_ref
+
+        _note_ref(self)
         return (ObjectRef._from_serialized, (self._id, self._owner_addr))
+
+
+class StreamingObjectRefGenerator:
+    """Iterator over a streaming generator task's item refs, yielding each
+    ref AS the task produces it — the task may still be running (ray:
+    streaming ObjectRefGenerator, python/ray/_raylet.pyx:277).
+
+    `next()` blocks until the next item is announced; raises the task's
+    error (after all successfully produced items) or StopIteration."""
+
+    def __init__(self, task_id: bytes, gen_ref: "ObjectRef", core):
+        self._task_id = task_id
+        # Holding the return-0 ref keeps the items pinned (they are its
+        # contained refs once the task completes).
+        self._gen_ref = gen_ref
+        self._core = core
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        try:
+            ref = self._core.stream_next(self._task_id, self._index)
+        except StopAsyncIteration:
+            raise StopIteration from None
+        self._index += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        idx = self._index
+        ref = await loop.run_in_executor(
+            None, lambda: self._core.stream_next(self._task_id, idx))
+        self._index += 1
+        return ref
+
+    def task_done_ref(self) -> "ObjectRef":
+        """Ref resolving (at task completion) to an ObjectRefGenerator of
+        all items — the dynamic-generator compatibility view."""
+        return self._gen_ref
+
+    def __repr__(self):
+        return (f"StreamingObjectRefGenerator("
+                f"{self._task_id.hex()[:12]}…, next={self._index})")
+
+    def __del__(self):
+        try:
+            self._core.drop_stream(self._task_id)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
 
 class ObjectRefGenerator:

@@ -23,9 +23,11 @@ def validate_options(opts: dict) -> None:
         nr = opts["num_returns"]
         if nr == "dynamic":
             return      # generator task: one ref resolving to N item refs
+        if nr == "streaming":
+            return      # generator task: items stream back as produced
         if not isinstance(nr, int) or nr < 0:
-            raise ValueError(
-                'num_returns must be a non-negative int or "dynamic"')
+            raise ValueError('num_returns must be a non-negative int, '
+                             '"dynamic", or "streaming"')
 
 
 def resolve_pg_options(opts: dict) -> dict:
@@ -80,6 +82,11 @@ class RemoteFunction:
             options = {**options, "num_returns": 1, "dynamic": True}
             return core.submit_task(self._function, args, kwargs,
                                     options)[0]
+        if options.get("num_returns") == "streaming":
+            # Items stream back as produced (ray: ObjectRefGenerator);
+            # returns the generator immediately.
+            return core.submit_streaming_task(self._function, args,
+                                              kwargs, options)
         refs = core.submit_task(self._function, args, kwargs, options)
         n = options.get("num_returns", 1)
         if n == 1:

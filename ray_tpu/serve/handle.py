@@ -74,13 +74,50 @@ class DeploymentResponse:
         return (DeploymentResponse, (self._to_object_ref(),))
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterating yields each item the replica's user
+    generator produces, as it is produced (ray: serve/handle.py
+    DeploymentResponseGenerator via handle.options(stream=True))."""
+
+    def __init__(self, gen_future: "concurrent.futures.Future"):
+        self._gen_future = gen_future
+        self._gen = None
+
+    def _resolve(self):
+        if self._gen is None:
+            self._gen = self._gen_future.result(timeout=30.0)
+        return self._gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        import ray_tpu
+
+        return ray_tpu.get(next(self._resolve()))
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Any:
+        import asyncio
+
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        gen = await loop.run_in_executor(None, self._resolve)
+        ref = await gen.__anext__()
+        return await loop.run_in_executor(None, ray_tpu.get, ref)
+
+
 class DeploymentHandle:
     def __init__(self, deployment: str, app: str, controller_id: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment
         self.app_name = app
         self._controller_id = controller_id
         self._method = method_name
+        self._stream = stream
         self._lock = threading.Lock()
         self._replicas: list[str] = []      # replica actor ids
         self._handles: dict[str, ActorHandle] = {}
@@ -140,9 +177,9 @@ class DeploymentHandle:
                     pass
             if item is None:
                 continue
-            fut, args, kwargs, deadline = item
+            fut, submit_fn, args, kwargs, deadline = item
             try:
-                fut.set_result(self._submit(args, kwargs))
+                fut.set_result(submit_fn(args, kwargs))
             except _NoCapacity as e:
                 if time.monotonic() > deadline:
                     fut.set_exception(RuntimeError(str(e)))
@@ -204,13 +241,50 @@ class DeploymentHandle:
             if self._inflight.get(rid, 0) > 0:
                 self._inflight[rid] -= 1
 
+    def _submit_streaming(self, args: tuple, kwargs: dict):
+        """Route one streaming request: returns a
+        StreamingObjectRefGenerator over the replica generator's items."""
+        rid, handle = self._pick()
+        try:
+            args = tuple(a._to_object_ref()
+                         if isinstance(a, DeploymentResponse) else a
+                         for a in args)
+            kwargs = {k: (v._to_object_ref()
+                          if isinstance(v, DeploymentResponse) else v)
+                      for k, v in kwargs.items()}
+            gen = handle.handle_request_streaming.options(
+                num_returns="streaming").remote(self._method, args, kwargs)
+        except BaseException:
+            self._done(rid)
+            raise
+        gen.task_done_ref().future().add_done_callback(
+            lambda _f: self._done(rid))
+        return gen
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        # An unresolved chained response would require a blocking wait to
-        # convert to an ObjectRef — never do that on the caller's thread
-        # (it may be a worker IO loop); hand it to the router thread.
         chained_pending = any(
             isinstance(a, DeploymentResponse) and a._ref is None
             for a in list(args) + list(kwargs.values()))
+        if self._stream:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            with self._lock:
+                have = bool(self._replicas)
+            if have and not chained_pending:
+                try:
+                    fut.set_result(self._submit_streaming(args, kwargs))
+                    return DeploymentResponseGenerator(fut)
+                except _NoCapacity:
+                    fut = concurrent.futures.Future()
+            # No membership / unresolved chained response / no capacity:
+            # the router thread resolves the generator off the caller's
+            # thread (which may be a worker IO loop — never block it).
+            self._ensure_router().put(
+                (fut, self._submit_streaming, args, kwargs,
+                 time.monotonic() + 30.0))
+            return DeploymentResponseGenerator(fut)
+        # An unresolved chained response would require a blocking wait to
+        # convert to an ObjectRef — never do that on the caller's thread
+        # (it may be a worker IO loop); hand it to the router thread.
         with self._lock:
             have = bool(self._replicas)
             fresh = (time.monotonic() - self._fetched_at) < _MEMBERSHIP_TTL_S
@@ -223,13 +297,15 @@ class DeploymentHandle:
                 pass         # queue to the router thread below
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._ensure_router().put(
-            (fut, args, kwargs, time.monotonic() + 30.0))
+            (fut, self._submit, args, kwargs, time.monotonic() + 30.0))
         return DeploymentResponse(None, ref_future=fut)
 
-    def options(self, method_name: str | None = None) -> "DeploymentHandle":
+    def options(self, method_name: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
         return DeploymentHandle(self.deployment_name, self.app_name,
                                 self._controller_id,
-                                method_name or self._method)
+                                method_name or self._method,
+                                self._stream if stream is None else stream)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -242,4 +318,5 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name,
-                                   self._controller_id, self._method))
+                                   self._controller_id, self._method,
+                                   self._stream))

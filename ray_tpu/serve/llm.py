@@ -53,6 +53,13 @@ class _Request:
     first_token_at: float | None = None
     tokens: list[int] = field(default_factory=list)
     slot: int = -1
+    # Optional thread-safe sink for token streaming: every decoded token
+    # is pushed as produced; None marks end-of-stream.
+    token_queue: Any = None
+
+    def emit(self, tok: int | None) -> None:
+        if self.token_queue is not None:
+            self.token_queue.put(tok)
 
 
 class LLMEngine:
@@ -163,8 +170,12 @@ class LLMEngine:
     # ------------------------------------------------------------- public
     def submit(self, prompt: list[int], max_new_tokens: int = 32,
                temperature: float = 0.0,
-               eos_id: int | None = None) -> concurrent.futures.Future:
-        """Thread-safe; resolves to {tokens, ttft_s, total_s}."""
+               eos_id: int | None = None,
+               token_queue: "queue.Queue | None" = None,
+               ) -> concurrent.futures.Future:
+        """Thread-safe; resolves to {tokens, ttft_s, total_s}.  With
+        `token_queue`, every decoded token is ALSO pushed to the queue as
+        produced (None = end) — the token-streaming hook."""
         if len(prompt) >= self.max_len:
             raise ValueError(
                 f"prompt length {len(prompt)} >= max_len {self.max_len}")
@@ -178,7 +189,8 @@ class LLMEngine:
                 "LLM engine is dead after an earlier failure") \
                 from self._error
         req = _Request(list(prompt), max_new_tokens, temperature, eos_id,
-                       concurrent.futures.Future())
+                       concurrent.futures.Future(),
+                       token_queue=token_queue)
         self._waiting.put(req)
         self._wake.set()
         return req.future
@@ -265,6 +277,7 @@ class LLMEngine:
         for (slot, req), first in zip(wave, firsts):
             req.first_token_at = now
             req.tokens.append(int(first))
+            req.emit(int(first))
             if self._done(req):
                 self._finish(slot)
 
@@ -278,6 +291,7 @@ class LLMEngine:
         self._slots[slot] = None
         self.completed += 1
         now = time.perf_counter()
+        req.emit(None)
         if not req.future.done():
             req.future.set_result({
                 "tokens": req.tokens,
@@ -294,14 +308,17 @@ class LLMEngine:
             # cache is invalid after a failed call anyway.
             self._error = e
             for i, req in enumerate(self._slots):
-                if req is not None and not req.future.done():
-                    req.future.set_exception(e)
+                if req is not None:
+                    req.emit(None)
+                    if not req.future.done():
+                        req.future.set_exception(e)
                 self._slots[i] = None
             while True:
                 try:
                     req = self._waiting.get_nowait()
                 except queue.Empty:
                     break
+                req.emit(None)
                 if not req.future.done():
                     req.future.set_exception(e)
             self._stop.set()
@@ -329,6 +346,7 @@ class LLMEngine:
                 req = self._slots[i]
                 for tok in seq[:, i]:
                     req.tokens.append(int(tok))
+                    req.emit(int(tok))
                     if self._done(req):
                         # Trim K-step overshoot past EOS/max_new_tokens.
                         self._finish(i)
@@ -371,6 +389,34 @@ class LLMServer:
             temperature=request.get("temperature", 0.0),
             eos_id=request.get("eos_id"))
         return await asyncio.wrap_future(fut)
+
+    def stream(self, request: dict):
+        """Token-streaming generator: yields each token id as the engine
+        decodes it.  Consumed via handle.options(stream=True).remote(...)
+        or the HTTP proxy's chunked path (x-serve-stream: 1)."""
+        if isinstance(request, dict) and "prompt" not in request:
+            request = request.get("body") or request
+        q: queue.Queue = queue.Queue()
+        fut = self.engine.submit(
+            request["prompt"],
+            max_new_tokens=request.get("max_new_tokens", 32),
+            temperature=request.get("temperature", 0.0),
+            eos_id=request.get("eos_id"),
+            token_queue=q)
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            yield tok
+        # The None sentinel is emitted just BEFORE the future resolves;
+        # wait briefly so an engine failure can't silently truncate the
+        # stream as a clean-looking completion.
+        try:
+            exc = fut.exception(timeout=5.0)
+        except concurrent.futures.TimeoutError:
+            exc = None
+        if exc is not None:
+            raise exc
 
     def stats(self) -> dict:
         return self.engine.stats()

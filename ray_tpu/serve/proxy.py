@@ -153,14 +153,55 @@ class ProxyActor:
                  for k, v in parse_qs(parts.query).items()}
         req = Request(method=method, path=sub_path, query=query,
                       headers=headers, body=body)
+        # Streaming response (token streaming etc.): the client opts in via
+        # header; each item the ingress generator yields becomes one HTTP
+        # chunk (ray: serve ASGI StreamingResponse path).
+        stream = (headers.get("x-serve-stream") == "1"
+                  or "text/event-stream" in headers.get("accept", ""))
         try:
-            result = await handle.remote(req)
-            await self._respond(writer, 200, result)
+            if stream:
+                # Cache the stream-mode handle: a fresh handle per request
+                # would leak its router thread and reset inflight counts.
+                skey = key + ":stream"
+                shandle = self._handles.get(skey)
+                if shandle is None:
+                    shandle = handle.options(stream=True)
+                    self._handles[skey] = shandle
+                gen = shandle.remote(req)
+                await self._respond_stream(writer, gen)
+            else:
+                result = await handle.remote(req)
+                await self._respond(writer, 200, result)
         except Exception as e:  # noqa: BLE001
             await self._respond(
                 writer, 500,
                 {"error": f"{type(e).__name__}: {e}",
                  "traceback": traceback.format_exc()})
+
+    async def _respond_stream(self, writer, gen) -> None:
+        """Chunked transfer: one chunk per generator item, written as the
+        replica produces them."""
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/octet-stream\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        await writer.drain()
+        try:
+            async for item in gen:
+                if isinstance(item, bytes):
+                    chunk = item
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = (json.dumps(item) + "\n").encode()
+                writer.write(f"{len(chunk):x}\r\n".encode()
+                             + chunk + b"\r\n")
+                await writer.drain()
+        except Exception as e:  # noqa: BLE001
+            msg = json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode()
+            writer.write(f"{len(msg):x}\r\n".encode() + msg + b"\r\n")
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
 
     async def _respond(self, writer, status: int, payload) -> None:
         if isinstance(payload, bytes):

@@ -68,6 +68,24 @@ class Replica:
             self._num_ongoing -= 1
             self._num_processed += 1
 
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict):
+        """Streaming request: a sync generator the caller invokes with
+        num_returns="streaming" — items ship to the consumer as the user
+        generator produces them (ray: replica ASGI streaming path).  A
+        non-generator result streams as a single item."""
+        self._num_ongoing += 1
+        try:
+            target = getattr(self._instance, method)
+            result = target(*args, **kwargs)
+            if inspect.isgenerator(result):
+                yield from result
+            else:
+                yield result
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
     async def get_queue_len(self) -> int:
         """Probe for the power-of-two-choices router (ray:
         replica_scheduler/pow_2_scheduler.py queue-length RPC)."""

@@ -1,0 +1,85 @@
+"""Serve response streaming: replica generator items reach the consumer
+(handle and HTTP chunked) while the generator is still producing
+(reference: serve ASGI StreamingResponse + DeploymentResponseGenerator,
+ray: python/ray/serve/handle.py stream=True).
+"""
+import socket
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def app():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+
+    @serve.deployment(max_ongoing_requests=4)
+    class Streamer:
+        def __call__(self, request):
+            # Proxy path: request is a serve Request; handle path: dict.
+            n = 4
+            for i in range(n):
+                yield f"tok{i} "
+                time.sleep(0.3)
+
+        def nums(self, upto):
+            for i in range(upto):
+                yield i * i
+
+    handle = serve.run(Streamer.bind(), name="streamer",
+                       route_prefix="/stream")
+    yield handle
+    serve.shutdown()
+
+
+def test_handle_streaming(app):
+    items = []
+    t_first = None
+    t0 = time.perf_counter()
+    for item in app.options(method_name="nums", stream=True).remote(5):
+        if t_first is None:
+            t_first = time.perf_counter() - t0
+        items.append(item)
+    assert items == [0, 1, 4, 9, 16]
+
+
+def test_handle_streaming_first_item_early(app):
+    t0 = time.perf_counter()
+    gen = app.options(stream=True).remote({})
+    first = next(iter(gen))
+    first_s = time.perf_counter() - t0
+    assert first == "tok0 "
+    # The generator takes ~1.2s total; the first item must not wait for it.
+    assert first_s < 1.0, f"first item took {first_s:.2f}s"
+    rest = list(gen)
+    assert rest == ["tok1 ", "tok2 ", "tok3 "]
+
+
+def test_http_chunked_streaming(app):
+    port = serve.http_port()
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    s.sendall(b"GET /stream HTTP/1.1\r\n"
+              b"Host: x\r\nx-serve-stream: 1\r\n"
+              b"Connection: close\r\n\r\n")
+    t0 = time.perf_counter()
+    buf = b""
+    first_chunk_at = None
+    while True:
+        data = s.recv(4096)
+        if not data:
+            break
+        buf += data
+        if first_chunk_at is None and b"tok0" in buf:
+            first_chunk_at = time.perf_counter() - t0
+    s.close()
+    head, _, body = buf.partition(b"\r\n\r\n")
+    assert b"200 OK" in head
+    assert b"chunked" in head.lower()
+    for i in range(4):
+        assert f"tok{i}".encode() in body
+    assert first_chunk_at is not None and first_chunk_at < 1.2, \
+        f"first chunk at {first_chunk_at}"

@@ -1,0 +1,140 @@
+"""Streaming generators: the caller consumes item 0 while the task is
+still producing item N (reference: ObjectRefGenerator,
+ray: python/ray/_raylet.pyx:277 + streaming_generator_returns plumbing
+_raylet.pyx:1103-1190).  Contrast with num_returns="dynamic", which ships
+all items only at task completion.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    # Warm the worker pool: forking a worker costs ~2s on the 1-core box
+    # and must not be charged to the first-item latency assertions.
+    ray_tpu.get([warm.remote() for _ in range(4)])
+    yield
+
+
+def test_items_stream_before_task_completes(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(5):
+            yield i
+            time.sleep(0.5)
+
+    gen = slow_gen.remote()           # returns immediately
+    t0 = time.perf_counter()
+    first = ray_tpu.get(next(gen))
+    first_latency = time.perf_counter() - t0
+    assert first == 0
+    # Item 0 must arrive long before the task finishes (~2.5s total).
+    assert first_latency < 1.5, f"first item took {first_latency:.2f}s"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == [1, 2, 3, 4]
+
+
+def test_streaming_generator_error_propagates(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        raise ValueError("boom")
+
+    gen = bad_gen.remote()
+    assert ray_tpu.get(next(gen)) == 1
+    with pytest.raises(Exception, match="boom"):
+        for r in gen:
+            ray_tpu.get(r)
+
+
+def test_streaming_generator_large_items(cluster):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big_gen():
+        for i in range(3):
+            yield np.full(300_000, i, np.uint8)   # > inline threshold
+
+    out = [ray_tpu.get(r) for r in big_gen.remote()]
+    assert [int(a[0]) for a in out] == [0, 1, 2]
+    assert all(a.nbytes == 300_000 for a in out)
+
+
+def test_streaming_actor_method(cluster):
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i * 10
+                time.sleep(0.3)
+
+    g = Gen.remote()
+    t0 = time.perf_counter()
+    gen = g.stream.options(num_returns="streaming").remote(4)
+    first = ray_tpu.get(next(gen))
+    assert first == 0
+    assert time.perf_counter() - t0 < 1.2
+    assert [ray_tpu.get(r) for r in gen] == [10, 20, 30]
+
+
+def test_streaming_async_actor_generator(cluster):
+    @ray_tpu.remote
+    class AGen:
+        async def stream(self, n):
+            import asyncio
+            for i in range(n):
+                yield i + 5
+                await asyncio.sleep(0.05)
+
+    a = AGen.options(max_concurrency=4).remote()
+    gen = a.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [5, 6, 7, 8]
+
+
+def test_quick_call_not_gated_by_stream(cluster):
+    """A quick call to the same (threaded) actor must not wait for a
+    concurrent streaming call's final reply."""
+    @ray_tpu.remote
+    class Mixed:
+        def slow_stream(self, n):
+            for i in range(n):
+                yield i
+                time.sleep(0.4)
+
+        def quick(self):
+            return "fast"
+
+    m = Mixed.options(max_concurrency=2).remote()
+    ray_tpu.get(m.quick.remote())
+    gen = m.slow_stream.options(num_returns="streaming").remote(5)
+    assert ray_tpu.get(next(gen)) == 0
+    t0 = time.perf_counter()
+    assert ray_tpu.get(m.quick.remote()) == "fast"
+    assert time.perf_counter() - t0 < 1.5    # stream takes ~2s total
+    assert [ray_tpu.get(r) for r in gen] == [1, 2, 3, 4]
+
+
+def test_streaming_generator_passed_to_task(cluster):
+    """A ref out of a streaming generator is a normal ObjectRef: it can be
+    passed to another task."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 21
+        yield 2
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    refs = list(gen.remote())
+    assert ray_tpu.get(double.remote(refs[0])) == 42
