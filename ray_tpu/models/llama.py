@@ -159,8 +159,13 @@ def remat_policy(cfg: "LlamaConfig | None" = None):
 
     "nothing": full recompute — the minimal-HBM profile for models at
     the memory ceiling."""
-    if cfg is not None and cfg.remat_mode == "nothing":
+    mode = cfg.remat_mode if cfg is not None else "flash_resid"
+    if mode == "nothing":
         return jax.checkpoint_policies.nothing_saveable
+    if mode != "flash_resid":
+        raise ValueError(
+            f"unknown remat_mode {mode!r}; valid: 'flash_resid', "
+            "'nothing'")
     return jax.checkpoint_policies.save_only_these_names(
         "flash_o", "flash_lse")
 
@@ -193,6 +198,25 @@ def _mlp_block(x, lp, cfg: LlamaConfig):
     return x + (h @ lp["w_down"])
 
 
+def embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
+                 dtype) -> jnp.ndarray:
+    """Token-embedding lookup that stays efficient under a vocab-sharded
+    table.  A plain gather over a "tensor"-sharded vocab axis makes the
+    GSPMD partitioner all-gather the table, fully replicate the result,
+    and reshard ("[SPMD] Involuntary full rematerialization" in the
+    multichip dryrun).  With vocab sharded we contract a one-hot matrix
+    against the table instead: the matmul rides the MXU, every device
+    touches only its vocab shard, and XLA inserts one psum over the
+    tensor axis (the iota-embed trick of public TPU LLM codebases)."""
+    from ray_tpu.parallel.sharding import logical_axis_size
+
+    if logical_axis_size("vocab") > 1:
+        one_hot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+        return jnp.einsum("bsv,vd->bsd", one_hot, table,
+                          preferred_element_type=jnp.float32).astype(dtype)
+    return table[tokens].astype(dtype)
+
+
 def run_trunk(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
               layer_fn) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shared decoder trunk: embed → scanned (remat) layers → final norm →
@@ -200,7 +224,7 @@ def run_trunk(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     (e.g. models.moe's routed FFN) swap the layer body without
     re-implementing the scaffold.  Returns (logits fp32, aux)."""
     b, s = tokens.shape
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     x = with_sharding_constraint(x, ("batch", "seq", None))
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
@@ -271,7 +295,7 @@ def prefill(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     position before reading it (see decode_step).
     """
     b, P = tokens.shape
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     cos, sin = rope_frequencies(cfg.head_dim, P, cfg.rope_theta)
 
     def layer(x, lp):
@@ -313,7 +337,7 @@ def decode_step(params: dict, cache: dict, tokens: jnp.ndarray,
     b = tokens.shape[0]
     max_len = cache["k"].shape[2]
     pos = cache["pos"]                                  # [b]
-    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)  # [b,1,d]
+    x = embed_lookup(params["embed"], tokens[:, None], cfg.dtype)  # [b,1,d]
     cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
     n_rep = cfg.n_heads // cfg.n_kv_heads
     kpos = jnp.arange(max_len)[None, :]                 # [1, max]

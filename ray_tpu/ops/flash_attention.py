@@ -366,13 +366,19 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     # Blocks must DIVIDE the sequence (the grids floor-divide): halve the
-    # power-of-two defaults until they do.  seq % 128 == 0 is the
-    # dispatcher's entry gate, so this always terminates >= 128.
+    # power-of-two defaults until they do, never below the 128 MXU tile.
+    # seq % 128 == 0 is the dispatcher's entry gate, so power-of-two
+    # blocks always land; a non-power-of-two caller block that can't
+    # divide is an error rather than a silent degenerate grid.
     block_q = min(block_q, qt.shape[2])
-    while qt.shape[2] % block_q:
+    while qt.shape[2] % block_q and block_q > 128:
         block_q //= 2
     block_k = min(block_k, kt.shape[2])
-    while kt.shape[2] % block_k:
+    while kt.shape[2] % block_k and block_k > 128:
         block_k //= 2
+    if qt.shape[2] % block_q or kt.shape[2] % block_k:
+        raise ValueError(
+            f"block sizes ({block_q}, {block_k}) do not divide seq "
+            f"({qt.shape[2]}, {kt.shape[2]}); use power-of-two blocks")
     o = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k)
     return o.transpose(0, 2, 1, 3)

@@ -60,6 +60,38 @@ def logical_sharding(mesh: Mesh, logical_axes: tuple[str | None, ...],
     return NamedSharding(mesh, spec)
 
 
+def current_abstract_mesh():
+    """The mesh in the current jit trace context, or None (shared probe —
+    with_sharding_constraint, ring attention, and embed_lookup all need
+    it)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:  # noqa: BLE001 - outside jit / no mesh
+        return None
+    if mesh is None or not mesh.axis_names:
+        return None
+    return mesh
+
+
+def logical_axis_size(logical: str, mesh=None,
+                      rules: dict | None = None) -> int:
+    """Product of mesh-axis sizes a logical axis maps to under the rules
+    (1 = effectively unsharded).  Lets model code branch on layout
+    without hardcoding physical axis names."""
+    if mesh is None:
+        mesh = current_abstract_mesh()
+    if mesh is None:
+        return 1
+    entry = (rules or LOGICAL_RULES).get(logical)
+    if entry is None:
+        return 1
+    names = (entry,) if isinstance(entry, str) else entry
+    size = 1
+    for a in names:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
 def _prune(mesh: Mesh, entry):
     """Remove axes not present in the mesh (lets one rules table serve
     meshes with fewer axes)."""
@@ -91,11 +123,8 @@ def with_sharding_constraint(x, logical_axes: tuple[str | None, ...],
     """Annotate an intermediate value's layout inside jit
     (jax.lax.with_sharding_constraint with logical names)."""
     if mesh is None:
-        try:
-            mesh = jax.sharding.get_abstract_mesh()  # inside jit
-        except Exception:  # noqa: BLE001
-            return x
-        if mesh is None or not mesh.axis_names:   # no mesh in context
+        mesh = current_abstract_mesh()
+        if mesh is None:
             return x
     spec = logical_spec(logical_axes, rules)
     spec = P(*[_prune(mesh, s) for s in spec])

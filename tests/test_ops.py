@@ -85,17 +85,19 @@ class TestFlashAttention:
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(a, b, atol=1e-5)
         # The policy must actually shortcut the fwd-kernel re-run: the
-        # remat backward's jaxpr should contain fewer pallas calls than
-        # a nothing-saveable backward.
-        import jax.ad_checkpoint as adc
-
+        # remat backward must contain STRICTLY fewer pallas calls (fwd +
+        # dq + dkv = 3) than a nothing-saveable backward (those + the
+        # fwd re-run = 4).  Renaming 'flash_o'/'flash_lse' on either
+        # side alone silently reverts to the recompute and fails here.
         txt_flash = jax.make_jaxpr(
             jax.grad(f_remat, argnums=(0, 1, 2)))(q, k, v).pretty_print()
         f_nothing = jax.checkpoint(
             f, policy=jax.checkpoint_policies.nothing_saveable)
         txt_nothing = jax.make_jaxpr(
             jax.grad(f_nothing, argnums=(0, 1, 2)))(q, k, v).pretty_print()
-        assert txt_flash.count("flash") <= txt_nothing.count("flash")
+        n_flash = txt_flash.count("pallas_call")
+        n_nothing = txt_nothing.count("pallas_call")
+        assert 0 < n_flash < n_nothing, (n_flash, n_nothing)
 
 
 class TestRingAttention:
