@@ -84,7 +84,11 @@ class LLMEngine:
         self.steps_per_sync = max(1, steps_per_sync)
         self.params = params if params is not None else llama.init_params(
             jax.random.PRNGKey(seed), cfg)
-        self.cache = llama.init_kv_cache(cfg, max_batch, self.max_len)
+        # Per-layer cache leaves: the stacked [L, ...] cache rode a
+        # lax.scan as xs/ys, which XLA cannot alias — every decode step
+        # copied the whole cache (llama.init_kv_cache_leaves).
+        self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
+                                                self.max_len)
         self._buckets = _buckets_for(self.max_len)
         self._rng = jax.random.PRNGKey(seed + 1)
 
@@ -92,8 +96,8 @@ class LLMEngine:
         def _decode_k(params, cache, tokens, temps, rng):
             def step(carry, key):
                 cache, toks = carry
-                logits, cache = llama.decode_step(params, cache, toks,
-                                                  cfg)
+                logits, cache = llama.decode_step_unrolled(params, cache,
+                                                           toks, cfg)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 sampled = jax.random.categorical(
                     key, logits / jnp.maximum(temps, 1e-6)[:, None]
@@ -120,18 +124,16 @@ class LLMEngine:
             W = tokens.shape[0]
             hidden, ks, vs = llama.prefill(params, tokens, cfg)
 
-            def write_one(carry, i):
-                k, v, pos = carry
-                k = jax.lax.dynamic_update_slice(
-                    k, ks[:, i][:, None], (0, slots[i], 0, 0, 0))
-                v = jax.lax.dynamic_update_slice(
-                    v, vs[:, i][:, None], (0, slots[i], 0, 0, 0))
-                pos = pos.at[slots[i]].set(true_lens[i])
-                return (k, v, pos), None
-
-            (k, v, pos), _ = jax.lax.scan(
-                write_one, (cache["k"], cache["v"], cache["pos"]),
-                jnp.arange(W))
+            # Scatter each wave member's prompt KV into its slot with ONE
+            # batched indexed write per layer leaf (duplicate padded slots
+            # carry identical rows, so scatter order is irrelevant; leaves
+            # update in place under donation — see init_kv_cache_leaves).
+            P = tokens.shape[1]
+            k = [cache["k"][li].at[slots, :P].set(ks[li])
+                 for li in range(cfg.n_layers)]
+            v = [cache["v"][li].at[slots, :P].set(vs[li])
+                 for li in range(cfg.n_layers)]
+            pos = cache["pos"].at[slots].set(true_lens)
             # Project only the W last-position rows through lm_head (the
             # full [W, P, vocab] logits tensor would be GBs at serving
             # shapes).

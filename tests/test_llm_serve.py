@@ -38,6 +38,32 @@ def _reference_greedy(params, cfg, prompt, n_new):
     return toks[len(prompt):]
 
 
+def test_decode_paths_agree(small):
+    """The scanned (compile-flat) and unrolled (in-place cache) decode
+    paths share one layer body and must produce identical logits and
+    cache states step for step."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    b, S = 2, 32
+    c_scan = llama.init_kv_cache(cfg, b, S)
+    c_unr = llama.init_kv_cache_leaves(cfg, b, S)
+    toks = jnp.asarray([3, 7], jnp.int32)
+    for _ in range(4):
+        l1, c_scan = llama.decode_step(params, c_scan, toks, cfg)
+        l2, c_unr = llama.decode_step_unrolled(params, c_unr, toks, cfg)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   atol=1e-5, rtol=1e-5)
+        for li in range(cfg.n_layers):
+            np.testing.assert_allclose(np.asarray(c_scan["k"][li]),
+                                       np.asarray(c_unr["k"][li]),
+                                       atol=1e-5, rtol=1e-5)
+        toks = jnp.argmax(l1, axis=-1).astype(jnp.int32)
+
+
 def test_engine_matches_full_forward_greedy(small):
     from ray_tpu.serve.llm import LLMEngine
 
