@@ -198,6 +198,21 @@ def _mlp_block(x, lp, cfg: LlamaConfig):
     return x + (h @ lp["w_down"])
 
 
+def dense_layer(x, lp, cfg: LlamaConfig, cos, sin):
+    """One dense decoder layer (attention + MLP) — the SINGLE definition
+    shared by forward() and pipelined_loss_fn so the two trunks cannot
+    diverge."""
+    return _mlp_block(_attention_block(x, lp, cfg, cos, sin), lp, cfg)
+
+
+def head_loss(params: dict, x: jnp.ndarray, targets: jnp.ndarray,
+              mask, cfg: LlamaConfig) -> jnp.ndarray:
+    """Shared trunk tail: final norm → lm_head (fp32) → cross entropy."""
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return cross_entropy(logits, targets, mask)
+
+
 def embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
                  dtype) -> jnp.ndarray:
     """Token-embedding lookup that stays efficient under a vocab-sharded
@@ -248,8 +263,7 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
             ) -> jnp.ndarray:
     """tokens [b, s] int32 → logits [b, s, vocab] float32."""
     def layer_fn(x, lp, cos, sin, aux):
-        y = _attention_block(x, lp, cfg, cos, sin)
-        return _mlp_block(y, lp, cfg), aux
+        return dense_layer(x, lp, cfg, cos, sin), aux
 
     logits, _ = run_trunk(params, tokens, cfg, layer_fn)
     return logits
@@ -278,6 +292,64 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> jnp.ndarray:
     inputs, targets = split_batch(batch)
     logits = forward(params, inputs, cfg)
     return cross_entropy(logits, targets, batch.get("mask"))
+
+
+def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
+                      mesh, n_micro: int | None = None) -> jnp.ndarray:
+    """loss_fn with the decoder trunk pipelined over the mesh's "stage"
+    axis (GPipe microbatching via parallel.pipeline.pipeline_apply).
+
+    The stacked [L, ...] layer params reshape to [n_stages, L/S, ...];
+    with the "layers" logical axis mapped to "stage" in the sharding
+    rules (train.step activates this automatically on stage-bearing
+    meshes) each stage holds exactly its contiguous layer block, so the
+    reshape moves no data.  Embed and lm_head/loss run outside the
+    pipeline (replicated over the stage axis, batch-parallel as usual);
+    microbatches keep the mb dim data-parallel INSIDE the pipeline
+    (batch_spec P(None, "data"))."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    n_stages = mesh.shape["stage"]
+    L = cfg.n_layers
+    if L % n_stages:
+        raise ValueError(f"n_layers {L} not divisible by stage={n_stages}")
+    inputs, targets = split_batch(batch)
+    b, s = inputs.shape
+    n_micro = n_micro or max(2, n_stages)
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    data_size = mesh.shape.get("data", 1)
+    if (b // n_micro) % data_size:
+        raise ValueError(
+            f"microbatch size {b // n_micro} not divisible by the data "
+            f"axis ({data_size}); choose n_micro so that "
+            "batch / n_micro % data == 0")
+    x = embed_lookup(params["embed"], inputs, cfg.dtype)
+    mb = x.reshape(n_micro, b // n_micro, s, x.shape[-1])
+    stage_layers = jax.tree.map(
+        lambda p: p.reshape(n_stages, L // n_stages, *p.shape[1:]),
+        params["layers"])
+
+    def stage_fn(lp_stage, act):
+        # rope tables fold to constants (static shapes); recomputed per
+        # stage rather than closed over (shard_map closure discipline).
+        cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+
+        def one(carry, lp):
+            return dense_layer(carry, lp, cfg, cos, sin), None
+
+        body = one
+        if cfg.remat:
+            body = jax.checkpoint(one, policy=remat_policy(cfg))
+        act, _ = lax.scan(body, act, lp_stage)
+        return act
+
+    out = pipeline_apply(stage_fn, stage_layers, mb, mesh, axis="stage",
+                         batch_spec=P(None, "data"))
+    x = out.reshape(b, s, x.shape[-1])
+    return head_loss(params, x, targets, batch.get("mask"), cfg)
 
 
 # ---------------------------------------------------------------- decode

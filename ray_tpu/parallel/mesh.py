@@ -6,9 +6,12 @@ framework's communication backend: axes map onto ICI dimensions so that
 tensor-parallel collectives ride the fastest links, fsdp next, data-parallel
 outermost (possibly spanning DCN between slices).
 
-Axis order (outer → inner): ("data", "fsdp", "seq", "expert", "tensor").
-"tensor" is innermost = most bandwidth-hungry (per-layer all-reduces),
-matching the scaling-book recipe of putting TP on the shortest ICI rings.
+Axis order (outer → inner): ("stage", "data", "fsdp", "seq", "expert",
+"tensor").  "tensor" is innermost = most bandwidth-hungry (per-layer
+all-reduces), matching the scaling-book recipe of putting TP on the
+shortest ICI rings; "stage" (pipeline parallelism) is outermost — stages
+exchange only activation boundaries, the lowest-bandwidth traffic, and
+often span slices/DCN.
 """
 from __future__ import annotations
 
@@ -18,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "seq", "expert", "tensor")
+AXES = ("stage", "data", "fsdp", "seq", "expert", "tensor")
 
 
 @dataclass
@@ -30,9 +33,11 @@ class MeshConfig:
     seq: int = 1
     expert: int = 1
     tensor: int = 1
+    stage: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
-        sizes = [self.data, self.fsdp, self.seq, self.expert, self.tensor]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = [self.stage, self.data, self.fsdp, self.seq, self.expert,
+                 self.tensor]
         fixed = 1
         wild = None
         for i, s in enumerate(sizes):

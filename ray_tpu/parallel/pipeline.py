@@ -28,12 +28,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
-                   mesh: Mesh, axis: str = "stage"):
+                   mesh: Mesh, axis: str = "stage",
+                   batch_spec: P | None = None):
     """Run microbatches through all pipeline stages (GPipe schedule).
 
     stage_fn(params_for_one_stage, x [mb, ...]) -> y [mb, ...] with the
     same shape (stages must preserve activation shape, as in a decoder
     trunk).  Returns [n_micro, mb, ...] outputs after the last stage.
+
+    batch_spec: PartitionSpec for the microbatch array (e.g.
+    P(None, "data") to keep the mb dim data-parallel INSIDE the pipeline
+    — PP composes with dp); default fully replicated.
 
     Total steps = n_micro + n_stages - 1 (the pipeline bubble); each step
     every stage computes one microbatch then shifts activations to the
@@ -83,10 +88,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         return outputs
 
     params_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    bspec = batch_spec if batch_spec is not None else P()
     fn = shard_map(
         per_stage, mesh=mesh,
-        in_specs=(params_spec, P()),          # microbatches replicated
-        out_specs=P(),
+        in_specs=(params_spec, bspec),
+        out_specs=bspec,
         check_vma=False)
     return fn(stage_params, microbatches)
 

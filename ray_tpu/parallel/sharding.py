@@ -126,7 +126,22 @@ def with_sharding_constraint(x, logical_axes: tuple[str | None, ...],
         mesh = current_abstract_mesh()
         if mesh is None:
             return x
+    if _manual_axes(mesh):
+        # Inside shard_map the named axes are manual: layout is already
+        # explicit per-shard and constraints are meaningless there.
+        return x
     spec = logical_spec(logical_axes, rules)
     spec = P(*[_prune(mesh, s) for s in spec])
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, spec) if isinstance(mesh, Mesh) else spec)
+
+
+def _manual_axes(mesh) -> set:
+    """Axis names currently in Manual (shard_map) mode."""
+    try:
+        from jax.sharding import AxisType
+
+        return {name for name, t in zip(mesh.axis_names, mesh.axis_types)
+                if t == AxisType.Manual}
+    except Exception:  # noqa: BLE001 - concrete Mesh / older API
+        return set()

@@ -87,6 +87,18 @@ def make_train_step(cfg: llama.LlamaConfig,
 
 
 # ------------------------------------------------------- sharded wrappers
+def _rules_for(mesh: Mesh) -> dict | None:
+    """Sharding rules for a mesh: on a stage-bearing (pipeline) mesh the
+    stacked "layers" param axis shards over "stage", so each stage holds
+    its contiguous layer block and pipelined_loss_fn's per-stage reshape
+    moves no data.  None = the default LOGICAL_RULES."""
+    if mesh.shape.get("stage", 1) > 1:
+        from ray_tpu.parallel.sharding import LOGICAL_RULES
+
+        return {**LOGICAL_RULES, "layers": "stage"}
+    return None
+
+
 def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
                     optimizer: optax.GradientTransformation):
     """NamedShardings for a TrainState: params follow the logical-axes
@@ -94,7 +106,7 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
     (matched by shape), scalars replicate."""
     model = model_module(cfg)
     axes = model.param_logical_axes(cfg)
-    p_sh = param_shardings(axes, mesh)
+    p_sh = param_shardings(axes, mesh, rules=_rules_for(mesh))
 
     params_shape = jax.eval_shape(
         lambda k: model.init_params(k, cfg), jax.random.PRNGKey(0))
@@ -137,9 +149,28 @@ def sharded_init(key: jax.Array, cfg: llama.LlamaConfig,
 
 def sharded_train_step(cfg: llama.LlamaConfig,
                        optimizer: optax.GradientTransformation,
-                       mesh: Mesh, loss_fn: Callable | None = None):
+                       mesh: Mesh, loss_fn: Callable | None = None,
+                       n_micro: int | None = None):
     """Jitted step with explicit state/batch shardings; donates the state
-    (params update in place in HBM)."""
+    (params update in place in HBM).  On a stage-bearing mesh the trunk
+    runs the GPipe pipeline (llama.pipelined_loss_fn) automatically."""
+    if loss_fn is None and mesh.shape.get("stage", 1) > 1:
+        unsupported = [a for a in ("fsdp", "tensor", "seq", "expert")
+                       if mesh.shape.get(a, 1) > 1]
+        if unsupported:
+            raise NotImplementedError(
+                f"pipeline meshes currently compose with 'data' only; "
+                f"axes {unsupported} > 1 would be silently un-sharded "
+                "inside the pipeline (params all-gathered per step)")
+
+        def loss_fn(params, batch, cfg_, _mesh=mesh, _nm=n_micro):
+            pl = getattr(model_module(cfg_), "pipelined_loss_fn", None)
+            if pl is None:
+                raise NotImplementedError(
+                    f"{model_module(cfg_).__name__} has no pipelined "
+                    "trunk; pipeline meshes (stage>1) currently support "
+                    "the llama family")
+            return pl(params, batch, cfg_, _mesh, _nm)
     st_sh = state_shardings(cfg, mesh, optimizer)
     b_sh = batch_shardings(mesh)
     step = make_train_step(cfg, optimizer, loss_fn)
