@@ -6,8 +6,8 @@ TPU-first: XLA collectives over ICI inside a slice, a zmq control/object
 plane over DCN between hosts, jax/pjit/Pallas for all device compute.
 """
 from ray_tpu.api import (available_resources, cancel, cluster_resources, get,
-                         get_actor, init, is_initialized, kill, nodes, put,
-                         remote, shutdown, timeline, wait)
+                         get_actor, init, is_initialized, kill, method,
+                         nodes, put, remote, shutdown, timeline, wait)
 from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 ObjectLostError, RayTpuError,
                                 TaskCancelledError, TaskError,
@@ -19,8 +19,8 @@ from ray_tpu.runtime_context import get_runtime_context
 __version__ = "0.1.0"
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "nodes", "timeline",
+    "init", "shutdown", "is_initialized", "remote", "method", "get",
+    "put", "wait", "kill", "cancel", "get_actor", "nodes", "timeline",
     "available_resources", "cluster_resources", "get_runtime_context",
     "profiling",
     "ObjectRef", "ObjectRefGenerator",

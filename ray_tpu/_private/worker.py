@@ -387,19 +387,87 @@ class ActorSubmitState:
 class ActorInstance:
     """Worker-side hosted actor with ordered per-caller execution."""
 
-    def __init__(self, actor_id: str, instance: Any, max_concurrency: int,
-                 is_async: bool, runtime_env: dict | None = None):
+    def __init__(self, actor_id: str, instance: Any,
+                 max_concurrency: int | None,
+                 is_async: bool, runtime_env: dict | None = None,
+                 concurrency_groups: dict | None = None,
+                 method_groups: dict | None = None):
         self.actor_id = actor_id
         self.instance = instance
         self.is_async = is_async
         self.runtime_env = runtime_env
+        # max_concurrency None = not set by the user.  The async DEFAULT
+        # group then gets ray's permissive 1000 bound — binding it to 1
+        # would deadlock previously-safe async self-calls the moment any
+        # named group is declared.
+        self._async_default_limit = max_concurrency or 1000
+        max_concurrency = max_concurrency or 1
         self.max_concurrency = max_concurrency
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_concurrency,
             thread_name_prefix=f"actor-{actor_id[:8]}")
+        # Named concurrency groups (ray: concurrency_group_manager.cc):
+        # each group gets its own executor (sync actors) / semaphore
+        # (async actors) so one saturated group never gates another.
+        # The default group is the base executor / max_concurrency.
+        self.concurrency_groups = dict(concurrency_groups or {})
+        self.method_groups = dict(method_groups or {})
+        self.group_executors: dict[str, Any] = {}
+        for name, limit in self.concurrency_groups.items():
+            self.group_executors[name] = \
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(1, int(limit)),
+                    thread_name_prefix=f"actor-{actor_id[:8]}-{name}")
+        # Async actors: per-group semaphores, created lazily ON the loop.
+        self._group_sems: dict[str, asyncio.Semaphore] = {}
         # Per-caller ordered delivery (ray: ActorSchedulingQueue seq_nos).
         self.next_seq: dict[str, int] = {}
         self.buffered: dict[str, dict[int, tuple]] = {}
+
+    def group_of(self, header: dict) -> str | None:
+        """Resolve the concurrency group for one call (per-call override
+        wins over the method's declared group)."""
+        return header.get("concurrency_group") \
+            or self.method_groups.get(header.get("method", ""))
+
+    def executor_for(self, group: str | None):
+        if group is None:
+            return self.executor
+        ex = self.group_executors.get(group)
+        if ex is None:
+            raise ValueError(
+                f"actor has no concurrency group {group!r}; declared: "
+                f"{sorted(self.concurrency_groups)}")
+        return ex
+
+    def semaphore_for(self, group: str | None) -> "asyncio.Semaphore | None":
+        """Async-actor concurrency bound for a NAMED group (the default
+        group is bounded by max_concurrency at the call sites)."""
+        if group is None:
+            return None
+        if group not in self.concurrency_groups:
+            raise ValueError(
+                f"actor has no concurrency group {group!r}; declared: "
+                f"{sorted(self.concurrency_groups)}")
+        sem = self._group_sems.get(group)
+        if sem is None:
+            sem = asyncio.Semaphore(
+                max(1, int(self.concurrency_groups[group])))
+            self._group_sems[group] = sem
+        return sem
+
+    def default_semaphore(self) -> "asyncio.Semaphore | None":
+        """Default-group bound for async actors — only once the actor
+        declares named groups (otherwise async concurrency keeps its
+        historical unbounded-by-default behavior).  The limit is the
+        user's explicit max_concurrency, or 1000 (ray's async default)."""
+        if not self.concurrency_groups:
+            return None
+        sem = self._group_sems.get("_default")
+        if sem is None:
+            sem = asyncio.Semaphore(max(1, self._async_default_limit))
+            self._group_sems["_default"] = sem
+        return sem
 
 
 class CoreWorker:
@@ -1884,25 +1952,32 @@ class CoreWorker:
             self._evict_untracked_args(h)
         return {"status": "ok", "streaming": True, "streamed": count}, []
 
-    async def _run_streaming_async(self, h: dict,
-                                   factory) -> tuple[dict, list]:
+    async def _run_streaming_async(self, h: dict, factory,
+                                   sem=None) -> tuple[dict, list]:
         """Async-actor streaming: factory() returns an async generator
         (iterated on the loop, items ship as yielded) or a coroutine
-        (awaited; its value streams as a single item)."""
+        (awaited; its value streams as a single item).  `sem` (the
+        concurrency-group bound) is held across the whole stream."""
         import inspect as _inspect
 
         ship = self._make_stream_shipper(h)
         count = 0
         try:
-            target = factory()
-            if _inspect.isasyncgen(target):
-                async for item in target:
+            if sem is not None:
+                await sem.acquire()
+            try:
+                target = factory()
+                if _inspect.isasyncgen(target):
+                    async for item in target:
+                        await ship(item, count)
+                        count += 1
+                else:
+                    item = await target
                     await ship(item, count)
                     count += 1
-            else:
-                item = await target
-                await ship(item, count)
-                count += 1
+            finally:
+                if sem is not None:
+                    sem.release()
         except BaseException as e:  # noqa: BLE001
             reply, rb = self._error_reply(e)
             reply["streaming"] = True
@@ -2118,8 +2193,10 @@ class CoreWorker:
                     self._default_executor, _construct)
             self.actors_hosted[h["actor_id"]] = ActorInstance(
                 h["actor_id"], instance,
-                max_concurrency=h.get("max_concurrency", 1),
-                is_async=is_async, runtime_env=renv_desc)
+                max_concurrency=h.get("max_concurrency"),
+                is_async=is_async, runtime_env=renv_desc,
+                concurrency_groups=h.get("concurrency_groups"),
+                method_groups=h.get("method_groups"))
             self.current_actor_id = h["actor_id"]
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
@@ -2137,7 +2214,8 @@ class CoreWorker:
         single-threaded actor (executor FIFO preserves call order across
         concurrent batches), contiguous in-order seqnos from one caller,
         no ref args / runtime_env / dynamic returns."""
-        if inst.is_async or inst.max_concurrency != 1 or inst.runtime_env:
+        if inst.is_async or inst.max_concurrency != 1 or inst.runtime_env \
+                or inst.concurrency_groups:
             return False
         caller = calls[0].get("caller")
         expected = inst.next_seq.get(caller, calls[0].get("seqno", 0))
@@ -2302,6 +2380,7 @@ class CoreWorker:
         task_id = bytes.fromhex(h["task_id"])
         self._record_event(h["task_id"], "RUNNING",
                            f"{type(inst.instance).__name__}.{h['method']}")
+        group = inst.group_of(h)   # named concurrency group (or None)
         if h.get("streaming"):
             import inspect as _inspect
 
@@ -2309,39 +2388,55 @@ class CoreWorker:
                     inst.is_async
                     and asyncio.iscoroutinefunction(method)):
                 # Async generator (or coroutine) method: iterate on the
-                # loop, shipping items as yielded.
+                # loop, shipping items as yielded; the group's semaphore
+                # is held for the stream's duration.
+                sem = inst.semaphore_for(group) if group \
+                    else inst.default_semaphore()
                 return self._run_streaming_async(
-                    h, lambda: method(*args, **kwargs))
+                    h, lambda: method(*args, **kwargs), sem)
 
             # Sync streaming generator method: items ship as produced; the
-            # generator runs on the actor's own executor (FIFO with its
-            # other calls).
+            # generator runs on the actor's (group's) own executor (FIFO
+            # with its other calls).
             def _gen_thunk():
                 from ray_tpu._private import runtime_env as renv
 
                 with renv.activate(inst.runtime_env, self):
                     return method(*args, **kwargs)
-            return self._run_streaming(h, _gen_thunk, inst.executor)
+            return self._run_streaming(h, _gen_thunk,
+                                       inst.executor_for(group))
         if inst.is_async and asyncio.iscoroutinefunction(method):
-            if inst.runtime_env:
+            # Concurrency bound: named group's semaphore, or the default
+            # group's (only active once the actor declares groups).
+            sem = inst.semaphore_for(group) if group \
+                else inst.default_semaphore()
+            if inst.runtime_env and inst.runtime_env.get("packages"):
+                # Packages must be on disk before activate runs on
+                # the loop thread (see runtime_env.prefetch).
                 from ray_tpu._private import runtime_env as renv
 
-                if inst.runtime_env.get("packages"):
-                    # Packages must be on disk before activate runs on
-                    # the loop thread (see runtime_env.prefetch).
-                    await self.loop.run_in_executor(
-                        None, renv.prefetch, inst.runtime_env, self)
+                await self.loop.run_in_executor(
+                    None, renv.prefetch, inst.runtime_env, self)
 
-                async def _run_async():
-                    # env_vars/working_dir stay active across awaits; with
-                    # concurrent async methods of differently-enved actors
-                    # this is best-effort (same documented limitation as
-                    # runtime_env.activate itself).
-                    with renv.activate(inst.runtime_env, self):
-                        return await method(*args, **kwargs)
-                atask = self.loop.create_task(_run_async())
-            else:
-                atask = self.loop.create_task(method(*args, **kwargs))
+            async def _run_async():
+                from ray_tpu._private import runtime_env as renv
+
+                async def _invoke():
+                    if inst.runtime_env:
+                        # env_vars/working_dir stay active across awaits;
+                        # with concurrent async methods of differently-
+                        # enved actors this is best-effort (same
+                        # documented limitation as runtime_env.activate).
+                        with renv.activate(inst.runtime_env, self):
+                            return await method(*args, **kwargs)
+                    return await method(*args, **kwargs)
+
+                if sem is None:
+                    return await _invoke()
+                async with sem:
+                    return await _invoke()
+
+            atask = self.loop.create_task(_run_async())
             self._running_async[task_id] = atask
         else:
             def _call():
@@ -2354,7 +2449,8 @@ class CoreWorker:
                         return method(*args, **kwargs)
                 finally:
                     self.current_task_id = prev
-            atask = self.loop.run_in_executor(inst.executor, _call)
+            atask = self.loop.run_in_executor(inst.executor_for(group),
+                                              _call)
 
         async def _finish():
             try:
@@ -2392,6 +2488,8 @@ class CoreWorker:
             task_id.binary(), "", args, kwargs, num_returns, {}, None, options)
         header.update({"actor_id": actor_id, "method": method,
                        "caller": self.worker_id})
+        if options.get("concurrency_group"):
+            header["concurrency_group"] = options["concurrency_group"]
         if options.get("streaming"):
             self._ret0_task_ids[return_ids[0]] = task_id.binary()
         with self._ref_lock:
@@ -2606,9 +2704,14 @@ class CoreWorker:
         header.update({
             "function_id": fid,
             "class_name": getattr(cls, "__name__", "?"),
-            "max_concurrency": options.get("max_concurrency", 1),
+            "max_concurrency": options.get("max_concurrency"),
             "is_async": bool(options.get("is_async")),
         })
+        if options.get("concurrency_groups"):
+            header["concurrency_groups"] = dict(
+                options["concurrency_groups"])
+            header["method_groups"] = dict(
+                options.get("method_groups") or {})
         try:
             reply, _ = self.call(
                 self.controller_addr, "create_actor",

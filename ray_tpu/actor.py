@@ -16,7 +16,7 @@ _ACTOR_OPTION_KEYS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "max_concurrency", "name", "namespace", "lifetime", "get_if_exists",
     "scheduling_strategy", "placement_group", "placement_group_bundle_index",
-    "runtime_env", "memory", "num_returns",
+    "runtime_env", "memory", "num_returns", "concurrency_groups",
 }
 
 
@@ -28,19 +28,29 @@ def _validate(opts: dict) -> None:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
+
+    def _call_opts(self) -> dict:
+        opts: dict = {"num_returns": self._num_returns}
+        if self._concurrency_group is not None:
+            opts["concurrency_group"] = self._concurrency_group
+        return opts
 
     def remote(self, *args, **kwargs):
         if self._num_returns == "streaming":
             from ray_tpu._private.worker import global_worker
 
+            opts = self._call_opts()
+            opts.pop("num_returns", None)
             return global_worker().submit_streaming_actor_task(
-                self._handle._actor_id, self._name, args, kwargs, {})
+                self._handle._actor_id, self._name, args, kwargs, opts)
         return self._handle._invoke(self._name, args, kwargs,
-                                    {"num_returns": self._num_returns})
+                                    self._call_opts())
 
     def options(self, **opts) -> "ActorMethod":
         nr = opts.get("num_returns", self._num_returns)
@@ -49,8 +59,9 @@ class ActorMethod:
                 'num_returns="dynamic" is only supported on task '
                 'functions; use num_returns="streaming" for actor '
                 "generator methods")
-        m = ActorMethod(self._handle, self._name, nr)
-        return m
+        return ActorMethod(
+            self._handle, self._name, nr,
+            opts.get("concurrency_group", self._concurrency_group))
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node for this actor method (ray: dag/class_node.py
@@ -66,9 +77,13 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: str, method_names: set[str] | None = None,
-                 owner: bool = False):
+                 owner: bool = False,
+                 method_opts: dict[str, dict] | None = None):
         self._actor_id = actor_id
         self._method_names = method_names or set()
+        # @ray_tpu.method(...) declarations per method (num_returns etc.;
+        # concurrency_group resolves worker-side via method_groups).
+        self._method_opts = method_opts or {}
         # The original handle owns the actor's lifetime: dropping it kills
         # the actor (ray: actor handle reference counting; non-detached
         # actors die when all handles go out of scope).  Deserialized copies
@@ -106,13 +121,16 @@ class ActorHandle:
             raise AttributeError(
                 f"actor has no method {name!r}; methods: "
                 f"{sorted(self._method_names)}")
-        return ActorMethod(self, name)
+        opts = self._method_opts.get(name, {})
+        return ActorMethod(self, name,
+                           num_returns=opts.get("num_returns", 1))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id[:12]}…)"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names))
+        return (ActorHandle, (self._actor_id, self._method_names, False,
+                              self._method_opts))
 
 
 class ActorClass:
@@ -143,6 +161,15 @@ class ActorClass:
 
         options = resolve_pg_options(opts)
         options["is_async"] = self._is_async
+        if options.get("concurrency_groups"):
+            # Map methods to their @ray_tpu.method(concurrency_group=...)
+            # declarations; the executing worker routes by this table.
+            options["method_groups"] = {
+                n: m.__ray_tpu_method_opts__["concurrency_group"]
+                for n, m in inspect.getmembers(self._cls,
+                                               inspect.isfunction)
+                if getattr(m, "__ray_tpu_method_opts__", {}).get(
+                    "concurrency_group")}
         core = global_worker()
         if "pg_id" in options:
             _wait_pg_ready(core, options["pg_id"])
@@ -156,7 +183,12 @@ class ActorClass:
         # handles, so named actors live until ray_tpu.kill / shutdown).
         owner = not (existing or options.get("name")
                      or options.get("lifetime") == "detached")
-        return ActorHandle(actor_id, self._method_names, owner=owner)
+        method_opts = {
+            n: dict(m.__ray_tpu_method_opts__)
+            for n, m in inspect.getmembers(self._cls, inspect.isfunction)
+            if getattr(m, "__ray_tpu_method_opts__", None)}
+        return ActorHandle(actor_id, self._method_names, owner=owner,
+                           method_opts=method_opts)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

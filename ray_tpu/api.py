@@ -200,6 +200,22 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def method(*, concurrency_group: str | None = None,
+           num_returns: int | str | None = None):
+    """@ray_tpu.method: per-method options on an actor class (ray:
+    @ray.method) — currently concurrency_group and num_returns."""
+    def wrap(fn):
+        opts = dict(getattr(fn, "__ray_tpu_method_opts__", {}))
+        if concurrency_group is not None:
+            opts["concurrency_group"] = concurrency_group
+        if num_returns is not None:
+            opts["num_returns"] = num_returns
+        fn.__ray_tpu_method_opts__ = opts
+        return fn
+
+    return wrap
+
+
 def remote(*args, **kwargs):
     """@ray_tpu.remote decorator for functions and classes
     (ray: worker.py:3171)."""

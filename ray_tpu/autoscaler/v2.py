@@ -1,0 +1,297 @@
+"""Autoscaler v2: instance-manager state machine + reconciler.
+
+Analog of ray: python/ray/autoscaler/v2/instance_manager/ (InstanceManager
+with validated instance-state transitions, instance_storage, and the
+Reconciler in v2/autoscaler.py) — redesigned around this runtime's
+controller instead of GCS RPC services:
+
+  - Every cloud node is tracked as an `Instance` moving through an
+    explicit lifecycle: QUEUED → REQUESTED → ALLOCATED → RAY_RUNNING →
+    (RAY_STOPPED | DRAINING) → TERMINATING → TERMINATED, with FAILED as
+    the from-anywhere error sink (ray: instance_manager.py transition
+    graph).
+  - The Reconciler periodically diffs three views of the world —
+    desired (target count), cloud (NodeProvider.non_terminated_nodes),
+    and cluster (controller membership) — and drives instances toward
+    the desired state, replacing failed nodes (ray: v2 Reconciler).
+  - Instance state persists in the controller KV, so a restarted head
+    resumes the same instance table and re-adopts live cloud nodes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+
+logger = logging.getLogger(__name__)
+
+# Lifecycle states (ray: v2 Instance.status values).
+QUEUED = "QUEUED"                  # wanted, not yet requested from cloud
+REQUESTED = "REQUESTED"            # create_node issued
+ALLOCATED = "ALLOCATED"            # cloud node exists, ray not yet up
+RAY_RUNNING = "RAY_RUNNING"        # registered in cluster membership
+DRAINING = "DRAINING"              # scale-down chosen, draining work
+TERMINATING = "TERMINATING"        # terminate_node issued
+TERMINATED = "TERMINATED"          # gone (terminal)
+FAILED = "FAILED"                  # crashed/lost (terminal; may replace)
+
+_TRANSITIONS: dict[str, set] = {
+    QUEUED: {REQUESTED, TERMINATED},
+    REQUESTED: {ALLOCATED, FAILED},
+    ALLOCATED: {RAY_RUNNING, FAILED, TERMINATING},
+    RAY_RUNNING: {DRAINING, FAILED, TERMINATING},
+    DRAINING: {TERMINATING, FAILED},
+    TERMINATING: {TERMINATED, FAILED},
+    TERMINATED: set(),
+    FAILED: set(),
+}
+
+KV_NS = "autoscaler_v2"
+KV_KEY = "instances"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_config: dict
+    state: str = QUEUED
+    provider_node_id: str | None = None
+    cluster_node_id: str | None = None
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    launch_attempts: int = 0
+    error: str = ""
+
+
+class InstanceManager:
+    """Validated-transition instance table (ray: v2 InstanceManager).
+
+    Thread-safe; every mutation goes through set_state so illegal jumps
+    raise instead of corrupting the table.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.instances: dict[str, Instance] = {}
+
+    def add(self, node_config: dict) -> Instance:
+        inst = Instance(instance_id=f"inst-{uuid.uuid4().hex[:12]}",
+                        node_config=dict(node_config))
+        with self._lock:
+            self.instances[inst.instance_id] = inst
+        return inst
+
+    def set_state(self, instance_id: str, state: str,
+                  error: str = "", **updates) -> Instance:
+        with self._lock:
+            inst = self.instances[instance_id]
+            if state != inst.state:
+                if state not in _TRANSITIONS[inst.state]:
+                    raise ValueError(
+                        f"illegal transition {inst.state} -> {state} "
+                        f"for {instance_id}")
+                inst.state = state
+            if error:
+                inst.error = error
+            for k, v in updates.items():
+                setattr(inst, k, v)
+            inst.updated_at = time.time()
+            return inst
+
+    def in_state(self, *states: str) -> list[Instance]:
+        with self._lock:
+            return [i for i in self.instances.values()
+                    if i.state in states]
+
+    def active(self) -> list[Instance]:
+        """Instances that count toward (current or imminent) capacity."""
+        return self.in_state(QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+
+    def to_json(self) -> bytes:
+        with self._lock:
+            return json.dumps(
+                {iid: asdict(i) for iid, i in self.instances.items()}
+            ).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "InstanceManager":
+        im = cls()
+        for iid, d in json.loads(blob.decode()).items():
+            im.instances[iid] = Instance(**d)
+        return im
+
+
+class Reconciler:
+    """Drive instances toward the target count; replace failures
+    (ray: autoscaler/v2/autoscaler.py Reconciler loop).
+
+    Views reconciled each tick:
+      desired  — target_count (set_target / demand hook)
+      cloud    — provider.non_terminated_nodes()
+      cluster  — controller membership (alive node ids)
+    """
+
+    def __init__(self, provider, controller_addr: str | None = None,
+                 node_config: dict | None = None,
+                 interval_s: float = 1.0, max_launch_retries: int = 3,
+                 launch_timeout_s: float = 120.0):
+        from ray_tpu._private.worker import global_worker
+
+        self.provider = provider
+        self.core = global_worker()
+        self.controller_addr = controller_addr or self.core.controller_addr
+        self.node_config = node_config or {"resources": {"CPU": 1}}
+        self.interval_s = interval_s
+        self.max_launch_retries = max_launch_retries
+        self.launch_timeout_s = launch_timeout_s
+        self.im = self._restore() or InstanceManager()
+        self.target_count = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ control
+    def set_target(self, n: int) -> None:
+        self.target_count = max(0, int(n))
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="autoscaler-v2",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("reconcile tick failed")
+            self._stop.wait(self.interval_s)
+
+    # -------------------------------------------------------- persistence
+    def _persist(self) -> None:
+        try:
+            self.core.call(self.controller_addr, "kv_put",
+                           {"ns": KV_NS, "key": KV_KEY},
+                           [self.im.to_json()], timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _restore(self) -> InstanceManager | None:
+        try:
+            reply, blobs = self.core.call(
+                self.controller_addr, "kv_get",
+                {"ns": KV_NS, "key": KV_KEY}, timeout=5.0)
+            if reply.get("found") and blobs:
+                return InstanceManager.from_json(blobs[0])
+        except Exception:  # noqa: BLE001
+            pass
+        return None
+
+    # -------------------------------------------------------- reconcile
+    def _alive_cluster_nodes(self) -> set[str]:
+        reply, _ = self.core.call(self.controller_addr, "list_nodes", {},
+                                  timeout=10.0)
+        return {n["node_id"] for n in reply.get("nodes", [])
+                if n.get("state") == "ALIVE"}
+
+    def reconcile_once(self) -> None:
+        cloud_alive = set(self.provider.non_terminated_nodes())
+        cluster_alive = self._alive_cluster_nodes()
+
+        # 0. Un-stick REQUESTED strays: a crash between create_node and
+        # the ALLOCATED transition (or a head restart restoring a
+        # persisted REQUESTED) would otherwise hold phantom capacity in
+        # active() forever.
+        now = time.time()
+        for inst in self.im.in_state(REQUESTED):
+            if now - inst.updated_at > self.launch_timeout_s:
+                self.im.set_state(inst.instance_id, FAILED,
+                                  error="launch timed out / interrupted")
+
+        # 1. Detect deaths: cloud node gone, or cluster membership lost.
+        for inst in self.im.in_state(ALLOCATED, RAY_RUNNING, DRAINING):
+            if inst.provider_node_id not in cloud_alive:
+                self.im.set_state(inst.instance_id, FAILED,
+                                  error="cloud node disappeared")
+                continue
+            if inst.state == ALLOCATED:
+                nid = getattr(self.provider, "node_id",
+                              lambda _p: None)(inst.provider_node_id)
+                if nid and nid in cluster_alive:
+                    self.im.set_state(inst.instance_id, RAY_RUNNING,
+                                      cluster_node_id=nid)
+            elif inst.state == RAY_RUNNING \
+                    and inst.cluster_node_id not in cluster_alive:
+                # Ray died on a live cloud node: reclaim the cloud node.
+                self.im.set_state(inst.instance_id, FAILED,
+                                  error="cluster membership lost")
+                try:
+                    self.provider.terminate_node(inst.provider_node_id)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # 2. Scale toward the target: queue replacements / drain excess.
+        active = self.im.active()
+        deficit = self.target_count - len(active)
+        for _ in range(max(0, deficit)):
+            self.im.add(self.node_config)
+        if deficit < 0:
+            excess = -deficit
+            # Cancel not-yet-launched instances first (QUEUED →
+            # TERMINATED is free — no cloud node exists yet) ...
+            for inst in self.im.in_state(QUEUED)[:excess]:
+                self.im.set_state(inst.instance_id, TERMINATED,
+                                  error="cancelled before launch")
+                excess -= 1
+            # ... then drain newest-first among RAY_RUNNING (ray:
+            # idle-first; load data lives in v1 — v2 keeps the policy
+            # pluggable).
+            if excess > 0:
+                running = sorted(self.im.in_state(RAY_RUNNING),
+                                 key=lambda i: i.created_at, reverse=True)
+                for inst in running[:excess]:
+                    self.im.set_state(inst.instance_id, DRAINING)
+
+        # 3. Launch QUEUED.
+        for inst in self.im.in_state(QUEUED):
+            self.im.set_state(inst.instance_id, REQUESTED)
+            try:
+                pids = self.provider.create_node(inst.node_config, 1)
+                self.im.set_state(inst.instance_id, ALLOCATED,
+                                  provider_node_id=pids[0],
+                                  launch_attempts=inst.launch_attempts + 1)
+            except Exception as e:  # noqa: BLE001
+                self.im.set_state(inst.instance_id, FAILED, error=str(e))
+                if inst.launch_attempts + 1 < self.max_launch_retries:
+                    replacement = self.im.add(inst.node_config)
+                    self.im.set_state(
+                        replacement.instance_id, QUEUED,
+                        launch_attempts=inst.launch_attempts + 1)
+
+        # 4. Tear down DRAINING.
+        for inst in self.im.in_state(DRAINING):
+            self.im.set_state(inst.instance_id, TERMINATING)
+            try:
+                self.provider.terminate_node(inst.provider_node_id)
+                self.im.set_state(inst.instance_id, TERMINATED)
+            except Exception as e:  # noqa: BLE001
+                self.im.set_state(inst.instance_id, FAILED, error=str(e))
+
+        self._persist()
+
+    def summary(self) -> dict:
+        out: dict[str, int] = {}
+        with self.im._lock:
+            for i in self.im.instances.values():
+                out[i.state] = out.get(i.state, 0) + 1
+        return out

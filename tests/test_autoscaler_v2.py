@@ -1,0 +1,243 @@
+"""Autoscaler v2: instance state machine, GCE TPU provider against a fake
+API, and the chaos reconcile loop (kill a node -> replaced -> pending PG
+schedules).  Reference analogs: ray autoscaler/v2/instance_manager tests +
+_private/gcp provider tests (mocked API).
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.v2 import (ALLOCATED, FAILED, QUEUED, RAY_RUNNING,
+                                   REQUESTED, TERMINATED, InstanceManager)
+
+
+class TestInstanceManager:
+    def test_lifecycle_transitions(self):
+        im = InstanceManager()
+        inst = im.add({"resources": {"CPU": 1}})
+        assert inst.state == QUEUED
+        im.set_state(inst.instance_id, REQUESTED)
+        im.set_state(inst.instance_id, ALLOCATED, provider_node_id="p1")
+        im.set_state(inst.instance_id, RAY_RUNNING, cluster_node_id="c1")
+        assert im.in_state(RAY_RUNNING)[0].provider_node_id == "p1"
+
+    def test_illegal_transition_rejected(self):
+        im = InstanceManager()
+        inst = im.add({})
+        with pytest.raises(ValueError, match="illegal transition"):
+            im.set_state(inst.instance_id, RAY_RUNNING)  # QUEUED -> RUNNING
+
+    def test_failed_is_terminal(self):
+        im = InstanceManager()
+        inst = im.add({})
+        im.set_state(inst.instance_id, REQUESTED)
+        im.set_state(inst.instance_id, FAILED, error="boom")
+        with pytest.raises(ValueError):
+            im.set_state(inst.instance_id, ALLOCATED)
+        assert im.in_state(FAILED)[0].error == "boom"
+
+    def test_json_roundtrip(self):
+        im = InstanceManager()
+        a = im.add({"resources": {"CPU": 2}})
+        im.set_state(a.instance_id, REQUESTED)
+        im2 = InstanceManager.from_json(im.to_json())
+        assert im2.instances[a.instance_id].state == REQUESTED
+        assert im2.instances[a.instance_id].node_config == {
+            "resources": {"CPU": 2}}
+
+
+class _FakeTPUAPI(http.server.BaseHTTPRequestHandler):
+    """Minimal Cloud-TPU-v2 + metadata-server stand-in."""
+
+    nodes: dict = {}      # class-level store: name -> node dict
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.endswith("/token"):
+            assert self.headers.get("Metadata-Flavor") == "Google"
+            self._send(200, {"access_token": "fake-token",
+                             "expires_in": 3600})
+            return
+        if self.path.endswith("/nodes"):
+            self._send(200, {"nodes": list(self.nodes.values())})
+            return
+        name = self.path.rsplit("/", 1)[-1]
+        if name in self.nodes:
+            self._send(200, self.nodes[name])
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        assert self.headers.get("Authorization") == "Bearer fake-token"
+        node_id = self.path.split("nodeId=")[-1]
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n)) if n else {}
+        self.nodes[node_id] = {
+            "name": f"projects/p/locations/z/nodes/{node_id}",
+            "state": "READY", **body}
+        self._send(200, {"name": f"operations/{node_id}"})
+
+    def do_DELETE(self):
+        name = self.path.rsplit("/", 1)[-1]
+        if self.nodes.pop(name, None) is not None:
+            self._send(200, {})
+        else:
+            self._send(404, {"error": "not found"})
+
+
+@pytest.fixture
+def fake_tpu_api():
+    _FakeTPUAPI.nodes = {}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeTPUAPI)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+class TestGCETPUProvider:
+    def test_create_list_terminate_roundtrip(self, fake_tpu_api):
+        from ray_tpu.autoscaler.gcp import GCETPUNodeProvider
+
+        p = GCETPUNodeProvider(
+            "proj", "us-central1-a", api_endpoint=fake_tpu_api,
+            metadata_endpoint=fake_tpu_api, cluster_name="rt")
+        ids = p.create_node({"accelerator_type": "v5litepod-8"}, 2)
+        assert len(ids) == 2
+        assert sorted(p.non_terminated_nodes()) == sorted(ids)
+        assert p.is_running(ids[0])
+        # Recorded request carries the slice shape + cluster label.
+        rec = _FakeTPUAPI.nodes[ids[0]]
+        assert rec["acceleratorType"] == "v5litepod-8"
+        assert rec["labels"]["ray-cluster"] == "rt"
+        p.terminate_node(ids[0])
+        assert p.non_terminated_nodes() == [ids[1]]
+        assert not p.is_running(ids[0])
+
+    def test_foreign_nodes_ignored(self, fake_tpu_api):
+        from ray_tpu.autoscaler.gcp import GCETPUNodeProvider
+
+        _FakeTPUAPI.nodes["other"] = {
+            "name": "projects/p/locations/z/nodes/other",
+            "state": "READY", "labels": {"ray-cluster": "not-ours"}}
+        p = GCETPUNodeProvider(
+            "proj", "z", api_endpoint=fake_tpu_api,
+            metadata_endpoint=fake_tpu_api, cluster_name="rt")
+        assert p.non_terminated_nodes() == []
+
+
+class TestReconcilerChaos:
+    def test_kill_node_replaced_and_pg_schedules(self, ray_shared):
+        """The VERDICT chaos scenario: a worker node dies; the reconciler
+        detects it (cloud view AND cluster view), replaces it, and a
+        pending placement group that needed that capacity schedules."""
+        import ray_tpu
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+        from ray_tpu.autoscaler.v2 import Reconciler
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker()
+        provider = LocalNodeProvider(core.controller_addr)
+        rec = Reconciler(provider, node_config={
+            "resources": {"CPU": 1, "chaosx": 1}})
+        try:
+            rec.set_target(2)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                rec.reconcile_once()
+                if len(rec.im.in_state(RAY_RUNNING)) == 2:
+                    break
+                time.sleep(0.5)
+            assert len(rec.im.in_state(RAY_RUNNING)) == 2, rec.summary()
+
+            # A PG needing BOTH special nodes becomes ready.
+            pg = placement_group([{"chaosx": 1}, {"chaosx": 1}],
+                                 strategy="SPREAD")
+            assert pg.ready(timeout=30.0)
+            remove_placement_group(pg)
+
+            # Kill one node out from under the reconciler (SIGKILL the
+            # agent process — the "cloud instance crashed" case).
+            victim = rec.im.in_state(RAY_RUNNING)[0]
+            provider.nodes[victim.provider_node_id]["proc"].kill()
+
+            # New PG is pending until the reconciler replaces capacity.
+            pg2 = placement_group([{"chaosx": 1}, {"chaosx": 1}],
+                                  strategy="SPREAD")
+            deadline = time.monotonic() + 90
+            ready = False
+            while time.monotonic() < deadline:
+                rec.reconcile_once()
+                if pg2.ready(timeout=1.0):
+                    ready = True
+                    break
+                time.sleep(0.5)
+            assert ready, (rec.summary(), "replacement never scheduled")
+            assert rec.im.in_state(FAILED), "death was never recorded"
+            remove_placement_group(pg2)
+        finally:
+            rec.set_target(0)
+            for _ in range(5):
+                rec.reconcile_once()
+                time.sleep(0.2)
+            for pid in list(provider.nodes):
+                provider.terminate_node(pid)
+
+
+class _RecordingProvider:
+    def __init__(self):
+        self.created = []
+
+    def create_node(self, node_config, count=1):
+        ids = [f"p{len(self.created) + i}" for i in range(count)]
+        self.created.extend(ids)
+        return ids
+
+    def terminate_node(self, pid):
+        pass
+
+    def non_terminated_nodes(self):
+        return list(self.created)
+
+
+class TestReconcilerEdgeCases:
+    def test_scale_down_cancels_queued_before_launch(self, ray_shared):
+        from ray_tpu.autoscaler.v2 import Reconciler, TERMINATED
+
+        provider = _RecordingProvider()
+        rec = Reconciler(provider)
+        rec.im = type(rec.im)()          # fresh table (ignore persisted)
+        for _ in range(3):
+            rec.im.add({})
+        rec.set_target(0)
+        rec.reconcile_once()
+        assert len(rec.im.in_state(TERMINATED)) == 3
+        assert provider.created == [], "cancelled instances were launched"
+
+    def test_stuck_requested_fails_out(self, ray_shared):
+        from ray_tpu.autoscaler.v2 import (FAILED, REQUESTED, Reconciler)
+
+        provider = _RecordingProvider()
+        rec = Reconciler(provider, launch_timeout_s=0.0)
+        rec.im = type(rec.im)()
+        inst = rec.im.add({})
+        rec.im.set_state(inst.instance_id, REQUESTED)
+        time.sleep(0.01)
+        rec.set_target(0)
+        rec.reconcile_once()
+        assert rec.im.in_state(FAILED), rec.summary()
