@@ -129,7 +129,35 @@ class DashboardHead:
         r.add_get("/api/jobs/{job_id}", self._jobs_get)
         r.add_post("/api/jobs/{job_id}/stop", self._jobs_stop)
         r.add_get("/api/jobs/{job_id}/logs", self._jobs_logs)
+        r.add_get("/api/serve/applications/", self._serve_get)
+        r.add_put("/api/serve/applications/", self._serve_apply)
         _ = web  # imported for side effects above
+
+    async def _serve_get(self, _req):
+        """Serve app status (ray: dashboard serve agent GET)."""
+        def _status():
+            from ray_tpu import serve
+
+            try:
+                return {"applications": serve.status()}
+            except Exception as e:  # noqa: BLE001
+                return {"applications": {}, "error": str(e)}
+        return _json(await self._call(_status))
+
+    async def _serve_apply(self, req):
+        """Declarative config apply (ray: PUT /api/serve/applications/
+        with a ServeDeploySchema payload — serve deploy's REST target)."""
+        body = await req.json()
+
+        def _apply():
+            from ray_tpu.serve.schema import apply_config
+
+            return apply_config(body)
+        try:
+            routes = await self._call(_apply)
+            return _json({"applied": routes})
+        except Exception as e:  # noqa: BLE001
+            return _json({"error": f"{type(e).__name__}: {e}"}, status=400)
 
     # Handlers call the (blocking, thread-safe) state API off this loop.
     async def _call(self, fn, *args):

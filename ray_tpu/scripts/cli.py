@@ -224,6 +224,27 @@ def cmd_job(args) -> None:
         print(json.dumps(client.list_jobs(), indent=2, default=str))
 
 
+def cmd_serve(args) -> None:
+    """ray: `serve deploy/status/shutdown` — declarative config apply."""
+    os.environ.setdefault("RAY_TPU_ADDRESS", _require_address(args))
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address="auto")
+    if args.serve_cmd == "deploy":
+        with open(args.config_file) as f:
+            config = json.load(f)
+        from ray_tpu.serve.schema import apply_config
+
+        routes = apply_config(config)
+        print(json.dumps({"applied": routes}, indent=2))
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -268,6 +289,14 @@ def main(argv: list[str] | None = None) -> None:
     sp.add_argument("job_id", nargs="?")
     sp.add_argument("entrypoint", nargs="*")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser(
+        "serve", usage="ray-tpu serve deploy <config.json> | "
+                       "ray-tpu serve status | ray-tpu serve shutdown")
+    sp.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
+    sp.add_argument("config_file", nargs="?")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -4,8 +4,9 @@ Controller reconcile loop + replica actors + power-of-two routing +
 stdlib HTTP proxy (SURVEY §2.3 / §3.5).
 """
 from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
-                               http_port, ingress, run, shutdown, start,
-                               status)
+                               grpc_port, http_port, ingress, list_proxies,
+                               proxy_ports, run, shutdown, start, status)
+from ray_tpu.serve.schema import apply_config
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
@@ -17,7 +18,8 @@ from ray_tpu.serve.proxy import Request
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "status", "delete", "get_app_handle", "get_deployment_handle",
-    "http_port", "ingress", "batch", "multiplexed",
+    "http_port", "grpc_port", "proxy_ports", "list_proxies",
+    "apply_config", "ingress", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "Request",
     "LLMEngine", "LLMServer",

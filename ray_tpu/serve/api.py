@@ -25,26 +25,41 @@ _proxy = None           # ActorHandle
 
 
 def start(http_options: dict | None = None, detached: bool = True):
-    """Ensure the Serve instance (controller + HTTP proxy) is running
-    (ray: serve.start)."""
-    global _controller, _proxy
+    """Ensure the Serve instance (controller + one proxy PER NODE) is
+    running (ray: serve.start; proxies are reconciled by the controller
+    like the reference's proxy_state machinery)."""
+    global _controller
     if not ray_tpu.is_initialized():
         ray_tpu.init()
+    import time as _time
+
     if _controller is None:
         _controller = ray_tpu.remote(ServeController).options(
             name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
             max_concurrency=32, num_cpus=0.1).remote()
-    if _proxy is None:
-        from ray_tpu.serve.proxy import ProxyActor
-
-        opts = http_options or {}
-        _proxy = ray_tpu.remote(ProxyActor).options(
-            name=PROXY_NAME, get_if_exists=True, lifetime="detached",
-            max_concurrency=64, num_cpus=0.1).remote(
-            _controller.actor_id, opts.get("host", "127.0.0.1"),
-            opts.get("port", 0))
-        ray_tpu.get(_proxy.ready.remote(), timeout=30.0)
-    return _controller
+    if http_options:
+        # Only explicit options overwrite the stored ones: a bare
+        # start() (e.g. from serve.run) must not reset a configured
+        # port back to defaults.
+        ray_tpu.get(_controller.set_http_options.remote(
+            http_options.get("host", "127.0.0.1"),
+            http_options.get("port", 0)), timeout=60.0)
+    # Wait for at least one proxy to come up (the controller's reconcile
+    # loop creates one per alive node).  Probe EVERY listed proxy — one
+    # stuck proxy must not mask a healthy one on another node.
+    deadline = _time.monotonic() + 60.0
+    while _time.monotonic() < deadline:
+        names = ray_tpu.get(_controller.list_proxies.remote(),
+                            timeout=30.0)
+        for name in names:
+            try:
+                h = ray_tpu.get_actor(name)
+                ray_tpu.get(h.ready.remote(), timeout=10.0)
+                return _controller
+            except Exception:  # noqa: BLE001 - proxy restarting
+                continue
+        _time.sleep(0.2)
+    raise TimeoutError("no serve proxy became ready in 60s")
 
 
 def _deployment_version(app_node: Application) -> str:
@@ -138,18 +153,64 @@ def delete(name: str, _blocking: bool = True) -> None:
             time.sleep(0.1)
 
 
+def list_proxies() -> list[str]:
+    """Names of the per-node proxy actors (SERVE_PROXY::<node_id>)."""
+    ctrl = _require_controller()
+    return ray_tpu.get(ctrl.list_proxies.remote(), timeout=30.0)
+
+
+def proxy_ports() -> list[int]:
+    """HTTP ports of every live per-node proxy."""
+    ports = []
+    for name in list_proxies():
+        try:
+            ports.append(ray_tpu.get(
+                ray_tpu.get_actor(name).get_port.remote(), timeout=30.0))
+        except Exception:  # noqa: BLE001 - proxy mid-restart
+            pass
+    return ports
+
+
 def http_port() -> int:
-    """Port the HTTP proxy is listening on (ephemeral by default)."""
-    if _proxy is None:
-        raise RuntimeError("serve is not started")
-    return ray_tpu.get(_proxy.get_port.remote())
+    """Port of one live HTTP proxy (ephemeral by default)."""
+    ports = proxy_ports()
+    if not ports:
+        raise RuntimeError("serve has no live proxy")
+    return ports[0]
+
+
+def grpc_port() -> int:
+    """Port of one live gRPC ingress."""
+    for name in list_proxies():
+        try:
+            port = ray_tpu.get(
+                ray_tpu.get_actor(name).get_grpc_port.remote(),
+                timeout=30.0)
+            if port:
+                return port
+        except Exception:  # noqa: BLE001
+            pass
+    raise RuntimeError("serve has no live gRPC ingress")
 
 
 def shutdown() -> None:
-    """Tear down all apps, the controller and the proxy (ray:
+    """Tear down all apps, the controller and every proxy (ray:
     serve.shutdown)."""
     global _controller, _proxy
+    if _controller is None:
+        # A fresh process (e.g. `ray-tpu serve shutdown`) must still be
+        # able to tear down a detached serve instance by name.
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 - nothing running
+            _controller = None
     if _controller is not None:
+        proxy_names: list[str] = []
+        try:
+            proxy_names = ray_tpu.get(_controller.list_proxies.remote(),
+                                      timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
         try:
             ray_tpu.get(_controller.graceful_shutdown.remote(), timeout=30.0)
             import time
@@ -166,12 +227,12 @@ def shutdown() -> None:
         except Exception:  # noqa: BLE001
             pass
         _controller = None
-    if _proxy is not None:
-        try:
-            ray_tpu.kill(_proxy)
-        except Exception:  # noqa: BLE001
-            pass
-        _proxy = None
+        for name in proxy_names:
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(name))
+            except Exception:  # noqa: BLE001
+                pass
+    _proxy = None
 
 
 def _require_controller():

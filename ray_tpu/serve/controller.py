@@ -69,6 +69,8 @@ class ServeController:
         self._lock = threading.RLock()
         # app -> {"route_prefix", "ingress", "deployments": {name: state}}
         self._apps: dict[str, dict] = {}
+        self._http_host = "127.0.0.1"
+        self._http_port = 0
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-ctrl")
@@ -237,6 +239,11 @@ class ServeController:
             time.sleep(RECONCILE_PERIOD_S)
 
     def _reconcile_once(self) -> None:
+        try:
+            self._reconcile_proxies()
+        except Exception:  # noqa: BLE001
+            logger.warning("proxy reconcile failed:\n%s",
+                           traceback.format_exc())
         with self._lock:
             states = [st for app in self._apps.values()
                       for st in app["deployments"].values()]
@@ -255,6 +262,72 @@ class ServeController:
                         del app["deployments"][name]
                 if not app["deployments"]:
                     del self._apps[app_name]
+
+    # --------------------------------------------------------- proxies
+    def _reconcile_proxies(self) -> None:
+        """One ProxyActor per ALIVE node, pinned by hard node affinity,
+        restarted when dead (ray: serve proxy_state.py reconciliation
+        driven by the serve controller).  Throttled: membership changes
+        rarely, and each sync costs two control-plane dumps."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_proxy_sync", 0.0) < 2.0:
+            return
+        self._last_proxy_sync = now
+        import ray_tpu
+        from ray_tpu.utils.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        from ray_tpu.utils.state import list_actors
+
+        alive_nodes = {n["node_id"] for n in ray_tpu.nodes()
+                       if n.get("state") == "ALIVE"}
+        live_proxies = {
+            a["name"]: a for a in list_actors()
+            if (a.get("name") or "").startswith("SERVE_PROXY::")
+            and a.get("state") == "ALIVE"}
+        from ray_tpu.serve.proxy import ProxyActor
+
+        for node_id in alive_nodes:
+            name = f"SERVE_PROXY::{node_id}"
+            if name in live_proxies:
+                continue
+            try:
+                ray_tpu.remote(ProxyActor).options(
+                    name=name, get_if_exists=True, lifetime="detached",
+                    max_concurrency=64, num_cpus=0,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id, soft=False),
+                ).remote(self._controller_self_id(),
+                         self._http_host, self._http_port)
+            except Exception:  # noqa: BLE001
+                logger.warning("proxy start on %s failed:\n%s",
+                               node_id[:8], traceback.format_exc())
+
+    def _controller_self_id(self) -> str:
+        from ray_tpu.runtime_context import get_runtime_context
+
+        return get_runtime_context().get_actor_id()
+
+    def set_http_options(self, host: str, port: int) -> None:
+        import ray_tpu
+
+        changed = (host, port) != (self._http_host, self._http_port)
+        self._http_host = host
+        self._http_port = port
+        if changed:
+            # Existing proxies hold the old bind options: kill them so
+            # the reconcile loop recreates them with the new ones.
+            for name in self.list_proxies():
+                try:
+                    ray_tpu.kill(ray_tpu.get_actor(name))
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def list_proxies(self) -> list[str]:
+        from ray_tpu.utils.state import list_actors
+
+        return sorted(a["name"] for a in list_actors()
+                      if (a.get("name") or "").startswith("SERVE_PROXY::")
+                      and a.get("state") == "ALIVE")
 
     def _autoscale(self, st: _DeploymentState) -> None:
         """Scale on total ongoing requests (ray: autoscaling_state.py;

@@ -1,0 +1,138 @@
+"""gRPC ingress for Serve (ray: serve/_private/proxy.py:540 gRPCProxy).
+
+A generic-handler gRPC server (no protoc codegen: method handlers are
+registered dynamically, payloads are JSON bytes) exposing the same routing
+the HTTP proxy offers:
+
+  /ray.serve.RayTpuServe/Predict       request  {"application": ...,
+                                                 "method"?: ...,
+                                                 "payload": ...}
+                                       response {"result": ...}
+  /ray.serve.RayTpuServe/ListApplications      -> {"applications": [...]}
+  /ray.serve.RayTpuServe/Healthz               -> {"status": "ok"}
+  /ray.serve.RayTpuServe/PredictStreaming      server-streaming variant:
+                                       one JSON message per item the
+                                       replica generator yields.
+
+The reference serves user-defined proto services through generated
+descriptors; this framework's wire format is JSON-over-gRPC — the routing,
+per-application dispatch, and streaming semantics match.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Callable
+
+import grpc
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ray.serve.RayTpuServe"
+
+
+def _bytes_codec(x: bytes) -> bytes:
+    return x
+
+
+class _GenericService(grpc.GenericRpcHandler):
+    def __init__(self, handlers: dict):
+        self._handlers = handlers
+
+    def service(self, handler_call_details):
+        return self._handlers.get(handler_call_details.method)
+
+
+class GRPCIngress:
+    """Async gRPC server routing to deployment handles.
+
+    handle_for(app_name) -> DeploymentHandle is supplied by the proxy,
+    which owns the route table and handle cache.
+    """
+
+    def __init__(self, handle_for: Callable[[str], Any],
+                 list_apps: Callable[[], list[str]],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handle_for = handle_for
+        self._list_apps = list_apps
+        self._server = grpc.aio.server()
+        handlers = {
+            f"/{SERVICE}/Predict": grpc.unary_unary_rpc_method_handler(
+                self._predict, request_deserializer=_bytes_codec,
+                response_serializer=_bytes_codec),
+            f"/{SERVICE}/PredictStreaming":
+                grpc.unary_stream_rpc_method_handler(
+                    self._predict_streaming,
+                    request_deserializer=_bytes_codec,
+                    response_serializer=_bytes_codec),
+            f"/{SERVICE}/ListApplications":
+                grpc.unary_unary_rpc_method_handler(
+                    self._list_applications,
+                    request_deserializer=_bytes_codec,
+                    response_serializer=_bytes_codec),
+            f"/{SERVICE}/Healthz": grpc.unary_unary_rpc_method_handler(
+                self._healthz, request_deserializer=_bytes_codec,
+                response_serializer=_bytes_codec),
+        }
+        self._server.add_generic_rpc_handlers(
+            (_GenericService(handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    async def start(self) -> None:
+        await self._server.start()
+
+    async def stop(self) -> None:
+        await self._server.stop(grace=1.0)
+
+    # ------------------------------------------------------------ methods
+    @staticmethod
+    def _parse(request: bytes, context) -> dict:
+        try:
+            req = json.loads(request.decode() or "{}")
+        except json.JSONDecodeError:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request body must be JSON")
+        if not isinstance(req, dict):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request body must be a JSON object")
+        return req
+
+    async def _predict(self, request: bytes, context) -> bytes:
+        req = self._parse(request, context)
+        app = req.get("application")
+        if not app:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          'missing "application"')
+        handle = self._handle_for(app, req.get("method"))
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no application {app!r}")
+        try:
+            result = await handle.remote(req.get("payload"))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        return json.dumps({"result": result}).encode()
+
+    async def _predict_streaming(self, request: bytes, context):
+        req = self._parse(request, context)
+        app = req.get("application")
+        handle = self._handle_for(app, req.get("method"),
+                                  stream=True) if app else None
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no application {app!r}")
+        gen = handle.remote(req.get("payload"))
+        try:
+            async for item in gen:
+                yield json.dumps({"result": item}).encode()
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    async def _list_applications(self, request: bytes, context) -> bytes:
+        return json.dumps({"applications": self._list_apps()}).encode()
+
+    async def _healthz(self, request: bytes, context) -> bytes:
+        return json.dumps({"status": "ok"}).encode()

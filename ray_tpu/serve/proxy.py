@@ -34,11 +34,13 @@ class Request:
 
 
 class ProxyActor:
-    """One per node in the reference (proxy.py:1130 ProxyActor); here one
-    per cluster, started by serve.start()."""
+    """One per NODE, like the reference (proxy.py:1130 ProxyActor) —
+    created and health-reconciled by the Serve controller, pinned to its
+    node with a hard NodeAffinity.  Serves HTTP/1.1 and a JSON-over-gRPC
+    ingress (serve/grpc_ingress.py)."""
 
     def __init__(self, controller_id: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, grpc_port: int = 0):
         from ray_tpu.serve.handle import DeploymentHandle
 
         self._controller_id = controller_id
@@ -46,16 +48,53 @@ class ProxyActor:
         self._routes: dict[str, tuple[str, str]] = {}
         self._handles: dict[str, "DeploymentHandle"] = {}
         self._port: int | None = None
+        self._grpc_port: int | None = None
+        self._grpc_requested_port = grpc_port
         self._server = None
+        self._grpc = None
+        self._error: str | None = None
         loop = asyncio.get_running_loop()
         self._ready = asyncio.Event()
         loop.create_task(self._start(host, port))
         loop.create_task(self._poll_routes())
 
+    def _app_handle(self, app: str, method: str | None = None,
+                    stream: bool = False):
+        """Cached ingress handle for an app (gRPC path).  Cached per
+        (app, method, stream): a fresh handle per request would leak its
+        router thread and reset the in-flight counts."""
+        for _prefix, (a, ingress) in self._routes.items():
+            if a == app:
+                key = f"{a}/{ingress}/{method or ''}/{int(stream)}"
+                handle = self._handles.get(key)
+                if handle is None:
+                    handle = self._handle_cls(
+                        ingress, a, self._controller_id,
+                        method_name=method or "__call__", stream=stream)
+                    self._handles[key] = handle
+                return handle
+        return None
+
     async def _start(self, host: str, port: int) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_conn, host, port)
-        self._port = self._server.sockets[0].getsockname()[1]
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_conn, host, port)
+            self._port = self._server.sockets[0].getsockname()[1]
+        except Exception as e:  # noqa: BLE001 - bind failure must surface
+            self._error = f"{type(e).__name__}: {e}"
+            self._ready.set()
+            return
+        try:
+            from ray_tpu.serve.grpc_ingress import GRPCIngress
+
+            self._grpc = GRPCIngress(
+                self._app_handle,
+                lambda: sorted({a for a, _i in self._routes.values()}),
+                host=host, port=self._grpc_requested_port)
+            await self._grpc.start()
+            self._grpc_port = self._grpc.port
+        except Exception:  # noqa: BLE001 - grpc unavailable: HTTP only
+            self._grpc = None
         self._ready.set()
 
     async def _poll_routes(self) -> None:
@@ -71,10 +110,18 @@ class ProxyActor:
 
     async def get_port(self) -> int:
         await self._ready.wait()
+        if self._port is None:
+            raise RuntimeError(f"proxy failed to bind: {self._error}")
         return self._port
+
+    async def get_grpc_port(self) -> int | None:
+        await self._ready.wait()
+        return self._grpc_port
 
     async def ready(self) -> bool:
         await self._ready.wait()
+        if self._port is None:
+            raise RuntimeError(f"proxy failed to bind: {self._error}")
         return True
 
     def _match(self, path: str) -> tuple[str, str, str] | None:

@@ -1,0 +1,151 @@
+"""Declarative Serve application config (serve build / serve deploy).
+
+Analog of ray: python/ray/serve/schema.py (ServeDeploySchema /
+ServeApplicationSchema / DeploymentSchema) — the config-as-data path: an
+application is described by an import path plus per-deployment overrides,
+applied idempotently via REST or `serve deploy`, instead of a Python
+driver calling serve.run.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any
+
+from ray_tpu.serve.deployment import Application
+
+
+@dataclasses.dataclass
+class DeploymentSchema:
+    """Per-deployment override block (ray: DeploymentSchema)."""
+
+    name: str
+    num_replicas: int | str | None = None
+    max_ongoing_requests: int | None = None
+    user_config: Any = None
+    autoscaling_config: dict | None = None
+    ray_actor_options: dict | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentSchema":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown deployment config keys {unknown}")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ApplicationSchema:
+    """One application (ray: ServeApplicationSchema)."""
+
+    name: str
+    import_path: str                      # "module.sub:app_or_builder"
+    route_prefix: str = "/"
+    args: dict = dataclasses.field(default_factory=dict)
+    deployments: list[DeploymentSchema] = dataclasses.field(
+        default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ApplicationSchema":
+        d = dict(d)
+        deps = [DeploymentSchema.from_dict(x)
+                for x in d.pop("deployments", [])]
+        known = {f.name for f in dataclasses.fields(cls)} - {"deployments"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown application config keys {unknown}")
+        return cls(deployments=deps, **d)
+
+    def load(self) -> Application:
+        """Resolve import_path to a bound Application and apply the
+        per-deployment overrides (ray: build_app + override_deployment).
+
+        The graph is COPIED before overriding: a module-level Application
+        is cached in sys.modules, and mutating it in place would leak
+        overrides across applies (and across apps sharing an
+        import_path)."""
+        mod_name, _, attr = self.import_path.partition(":")
+        if not attr:
+            raise ValueError(
+                f"import_path {self.import_path!r} must be 'module:attr'")
+        target = getattr(importlib.import_module(mod_name), attr)
+        if callable(target) and not isinstance(target, Application):
+            target = target(**self.args)   # app builder function
+        if not isinstance(target, Application):
+            raise TypeError(
+                f"{self.import_path} resolved to {type(target).__name__}, "
+                "expected a bound Application (Deployment.bind())")
+        target = _copy_app(target, {})
+        overrides = {d.name: d for d in self.deployments}
+        for node in target._walk({}):
+            ov = overrides.pop(node.deployment.name, None)
+            if ov is None:
+                continue
+            opts = {k: v for k, v in dataclasses.asdict(ov).items()
+                    if k != "name" and v is not None}
+            node.deployment = node.deployment.options(**opts)
+        if overrides:
+            raise ValueError(
+                f"config overrides for unknown deployments: "
+                f"{sorted(overrides)}")
+        return target
+
+
+def _copy_app(node: Application, memo: dict) -> Application:
+    """Structural copy of an Application graph (deployment objects are
+    shared — node.deployment is REPLACED, never mutated, on override)."""
+    if id(node) in memo:
+        return memo[id(node)]
+
+    def sub(v):
+        return _copy_app(v, memo) if isinstance(v, Application) else v
+
+    new = Application(node.deployment,
+                      tuple(sub(a) for a in node.init_args),
+                      {k: sub(v) for k, v in node.init_kwargs.items()})
+    memo[id(node)] = new
+    return new
+
+
+@dataclasses.dataclass
+class DeploySchema:
+    """Top-level multi-app config (ray: ServeDeploySchema — the payload
+    of `serve deploy` / PUT /api/serve/applications)."""
+
+    applications: list[ApplicationSchema]
+    http_options: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploySchema":
+        apps = [ApplicationSchema.from_dict(a)
+                for a in d.get("applications", [])]
+        names = [a.name for a in apps]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate application names in {names}")
+        prefixes = [a.route_prefix for a in apps]
+        if len(prefixes) != len(set(prefixes)):
+            raise ValueError(f"duplicate route prefixes in {prefixes}")
+        return cls(applications=apps,
+                   http_options=d.get("http_options", {}))
+
+
+def apply_config(config: dict) -> dict:
+    """Deploy a declarative config (idempotent; ray: serve deploy).
+
+    Returns {app_name: route_prefix}.  Apps present in the running serve
+    instance but absent from the config are DELETED (declarative
+    semantics, ray: ServeDeploySchema apply)."""
+    from ray_tpu import serve
+
+    schema = DeploySchema.from_dict(config)
+    serve.start(http_options=schema.http_options or None)
+    desired = {}
+    for app in schema.applications:
+        serve.run(app.load(), name=app.name,
+                  route_prefix=app.route_prefix, _blocking=False)
+        desired[app.name] = app.route_prefix
+    for existing in list(serve.status()):
+        if existing not in desired:
+            serve.delete(existing, _blocking=False)
+    return desired

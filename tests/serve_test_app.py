@@ -1,0 +1,24 @@
+"""Importable app builders for declarative-config tests (the classes are
+function-local so cloudpickle ships them by value to replicas)."""
+
+
+def build_app(multiplier: int = 2):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Mult:
+        def __call__(self, x):
+            return x * multiplier
+
+    return Mult.bind()
+
+
+def build_echo():
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=4)
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    return Echo.bind()

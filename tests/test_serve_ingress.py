@@ -1,0 +1,123 @@
+"""Serve ingress parity: per-node proxies, gRPC ingress, declarative
+config apply (reference: serve/_private/proxy.py gRPCProxy:540 +
+per-node ProxyActor:1130, serve/schema.py declarative deploy).
+"""
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_up():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+    serve.start()
+    yield serve
+    serve.shutdown()
+
+
+def _http_json(port, path, payload=None, method="GET"):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestDeclarativeConfig:
+    def test_apply_and_replace(self, serve_up):
+        from ray_tpu.serve.schema import apply_config
+
+        routes = apply_config({"applications": [
+            {"name": "mult", "import_path": "serve_test_app:build_app",
+             "route_prefix": "/mult", "args": {"multiplier": 3}},
+        ]})
+        assert routes == {"mult": "/mult"}
+        h = serve.get_app_handle("mult")
+        assert h.remote(14).result(timeout_s=60) == 42
+
+        # Re-apply with a different app set: old app deleted, new added.
+        apply_config({"applications": [
+            {"name": "echo", "import_path": "serve_test_app:build_echo",
+             "route_prefix": "/echo"},
+        ]})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = serve.status()
+            if "mult" not in st and "echo" in st:
+                break
+            time.sleep(0.2)
+        st = serve.status()
+        assert "mult" not in st and "echo" in st, st
+        h2 = serve.get_app_handle("echo")
+        assert h2.remote("hi").result(timeout_s=60) == {"echo": "hi"}
+        serve.delete("echo")
+
+    def test_deployment_overrides(self, serve_up):
+        from ray_tpu.serve.schema import ApplicationSchema
+
+        app = ApplicationSchema.from_dict(
+            {"name": "m", "import_path": "serve_test_app:build_app",
+             "deployments": [{"name": "Mult", "num_replicas": 2,
+                              "max_ongoing_requests": 16}]}).load()
+        d = app.deployment
+        assert d.config.num_replicas == 2
+        assert d.config.max_ongoing_requests == 16
+
+    def test_unknown_keys_rejected(self, serve_up):
+        from ray_tpu.serve.schema import DeploySchema
+
+        with pytest.raises(ValueError, match="unknown application"):
+            DeploySchema.from_dict({"applications": [
+                {"name": "x", "import_path": "a:b", "bogus": 1}]})
+
+
+class TestGRPCIngress:
+    def test_predict_and_streaming(self, serve_up):
+        import grpc
+
+        @serve.deployment
+        class G:
+            def __call__(self, x):
+                return {"doubled": x * 2}
+
+            def stream(self, n):
+                for i in range(int(n)):
+                    yield i * 10
+
+        serve.run(G.bind(), name="gapp", route_prefix="/gapp")
+        port = serve.grpc_port()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        predict = chan.unary_unary(
+            "/ray.serve.RayTpuServe/Predict",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        out = json.loads(predict(json.dumps(
+            {"application": "gapp", "payload": 21}).encode(), timeout=60))
+        assert out == {"result": {"doubled": 42}}
+
+        lister = chan.unary_unary(
+            "/ray.serve.RayTpuServe/ListApplications",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        apps = json.loads(lister(b"{}", timeout=30))
+        assert "gapp" in apps["applications"]
+
+        streamer = chan.unary_stream(
+            "/ray.serve.RayTpuServe/PredictStreaming",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        items = [json.loads(m)["result"] for m in streamer(
+            json.dumps({"application": "gapp", "method": "stream",
+                        "payload": 3}).encode(), timeout=60)]
+        assert items == [0, 10, 20]
+        chan.close()
+        serve.delete("gapp")

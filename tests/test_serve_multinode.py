@@ -1,0 +1,101 @@
+"""Per-node serve proxies on a multi-node cluster: one ProxyActor per
+node, requests route through any proxy, and a killed proxy is restored by
+the serve controller (reference: per-node ProxyActor proxy.py:1130 +
+proxy_state reconciliation).
+"""
+import json
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu import cluster_utils, serve
+
+
+def _http_json(port, path, payload=None, method="GET"):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        raw = resp.read().decode()
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw          # text/plain responses come back verbatim
+
+
+def test_proxy_per_node_and_failover():
+    if ray_tpu.is_initialized():
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+    cluster = cluster_utils.Cluster()
+    cluster.start_head()          # controller only — nodes come below
+    cluster.add_node(resources={"CPU": 2})
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(2)
+        serve.start()
+
+        @serve.deployment
+        class App:
+            def __call__(self, req):
+                return "served"
+
+        serve.run(App.bind(), name="fo", route_prefix="/")
+
+        # A proxy per node comes up (controller reconcile loop).
+        deadline = time.monotonic() + 90
+        ports = []
+        while time.monotonic() < deadline:
+            ports = serve.proxy_ports()
+            if len(ports) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(ports) >= 2, f"expected 2 proxies, got {ports}"
+        for port in ports:
+            # A fresh proxy's route table populates on its 0.5s poll —
+            # allow a grace period before requiring a routed response.
+            deadline2 = time.monotonic() + 30
+            while True:
+                try:
+                    if _http_json(port, "/", payload={},
+                                  method="POST") == "served":
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                assert time.monotonic() < deadline2, \
+                    f"proxy on {port} never served"
+                time.sleep(0.5)
+
+        # Kill one proxy actor; the controller must restore it and all
+        # proxies must serve again.
+        proxies = serve.list_proxies()
+        assert len(proxies) >= 2
+        ray_tpu.kill(ray_tpu.get_actor(proxies[0]))
+        deadline = time.monotonic() + 120
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                ports = serve.proxy_ports()
+                if len(ports) >= 2:
+                    outs = [_http_json(p, "/", payload={},
+                                       method="POST") for p in ports]
+                    if all(o == "served" for o in outs):
+                        ok = True
+                        break
+            except Exception:  # noqa: BLE001 - proxy mid-restart
+                pass
+            time.sleep(1.0)
+        assert ok, "killed proxy never recovered"
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+        cluster.shutdown()
