@@ -8,6 +8,7 @@ iter_jax_batches / streaming_split.
 from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
 from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa: F401,E501
                                   from_items, from_numpy, from_pandas,
-                                  range, read_csv, read_json, read_parquet,
-                                  read_text)
+                                  range, read_binary_files, read_csv,
+                                  read_images, read_json, read_parquet,
+                                  read_text, read_tfrecords)
 from ray_tpu.data.iterator import DataIterator  # noqa: F401

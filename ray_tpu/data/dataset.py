@@ -153,12 +153,26 @@ class Dataset:
         if self._materialized is not None:
             return iter(self._materialized)
         if getattr(self, "_union_sources", None):
+            self._executors = []
+
             def chain():
                 for p in self._union_sources:
-                    yield from StreamingExecutor(p).execute()
+                    ex = StreamingExecutor(p)
+                    self._executors.append(ex)
+                    yield from ex.execute()
 
             return chain()
-        return StreamingExecutor(self._plan).execute()
+        ex = StreamingExecutor(self._plan)
+        self._executors = [ex]
+        return ex.execute()
+
+    def stats(self) -> str:
+        """Per-operator execution stats of the most recent run (ray:
+        Dataset.stats() backed by data/_internal/stats.py)."""
+        exs = getattr(self, "_executors", None)
+        if not exs:
+            return "(dataset has not been executed yet)"
+        return "\n".join(ex.stats() for ex in exs)
 
     def iterator(self) -> DataIterator:
         return DataIterator(self._ref_iter)
@@ -288,6 +302,9 @@ class Dataset:
     def write_json(self, path: str) -> None:
         self._write(path, "json")
 
+    def write_tfrecords(self, path: str) -> None:
+        self._write(path, "tfrecord")
+
     def __repr__(self):
         if self._materialized is not None:
             return f"MaterializedDataset({len(self._materialized)} blocks)"
@@ -402,6 +419,27 @@ def read_json(paths, *, parallelism: int = 8) -> Dataset:
 
 def read_text(paths, *, parallelism: int = 8) -> Dataset:
     return _read(ds.text_tasks(paths, parallelism))
+
+
+def read_images(paths, *, parallelism: int = 8,
+                size: tuple | None = None,
+                mode: str | None = None) -> Dataset:
+    """Image files → {"image": [h,w,c] uint8, "path"} rows (ray:
+    read_images / image_datasource.py)."""
+    return _read(ds.image_tasks(paths, parallelism, size=size, mode=mode))
+
+
+def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+    """Whole files → {"bytes", "path"} rows (ray: read_binary_files)."""
+    return _read(ds.binary_tasks(paths, parallelism))
+
+
+def read_tfrecords(paths, *, parallelism: int = 8,
+                   verify: bool = False) -> Dataset:
+    """TFRecord files → {"record": bytes} rows (ray: read_tfrecords /
+    tfrecords_datasource.py).  verify=True additionally checks payload
+    CRCs (slower: pure-python crc32c)."""
+    return _read(ds.tfrecord_tasks(paths, parallelism, verify=verify))
 
 
 def from_generators(fns: list) -> Dataset:

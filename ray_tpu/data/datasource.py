@@ -135,6 +135,125 @@ def generator_tasks(fns: list[Callable[[], Iterable[Any]]]) -> list[ReadTask]:
     return [wrap(fn) for fn in fns]
 
 
+def image_tasks(paths, parallelism: int, size: tuple | None = None,
+                mode: str | None = None) -> list[ReadTask]:
+    """Image files → {"image": [h, w, c] uint8 ndarray, "path": str}
+    rows (ray: data/datasource/image_datasource.py; PIL decode)."""
+    files = _expand_paths(paths, None)
+    files = [f for f in files if f.lower().endswith(
+        (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tiff", ".webp"))] \
+        or files
+
+    def one(path: str) -> Iterator[Block]:
+        from PIL import Image
+
+        img = Image.open(path)
+        if mode:
+            img = img.convert(mode)
+        if size:
+            img = img.resize(size)
+        arr = np.asarray(img)
+        # Arrow blocks carry tensors as flattened fixed-size lists; the
+        # original shape rides alongside so consumers reshape exactly
+        # (np.asarray(row["image"], np.uint8).reshape(row["shape"])).
+        yield _to_table({"image": arr[None],
+                         "shape": [list(arr.shape)],
+                         "path": [path]})
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def binary_tasks(paths, parallelism: int) -> list[ReadTask]:
+    """Whole files as bytes → {"bytes", "path"} rows (ray:
+    data/datasource/binary_datasource.py)."""
+    files = _expand_paths(paths, None)
+
+    def one(path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": [data], "path": [path]})
+
+    return [lambda p=p: one(p) for p in files]
+
+
+# TFRecord framing: u64le length, u32le masked-crc32c(length), payload,
+# u32le masked-crc32c(payload).  crc32c implemented here (Castagnoli
+# polynomial, table-driven) — no tensorflow/crc32c wheel in the env.
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def tfrecord_tasks(paths, parallelism: int,
+                   verify: bool = False) -> list[ReadTask]:
+    """TFRecord files → one {"record": bytes} row per record (ray:
+    data/datasource/tfrecords_datasource.py; raw records — Example proto
+    parsing is the caller's map step, keeping TF out of the core).
+
+    Length-header CRCs are always checked (8 bytes each — cheap, and
+    they catch framing corruption).  verify=True also checks payload
+    CRCs; that runs the pure-Python crc32c over every byte, so it is
+    off by default (the reference skips payload verification too)."""
+    import struct as _struct
+
+    files = _expand_paths(paths, None)
+
+    def one(path: str) -> Iterator[Block]:
+        records = []
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    break
+                (length,) = _struct.unpack("<Q", head)
+                (len_crc,) = _struct.unpack("<I", f.read(4))
+                if len_crc != _masked_crc(head):
+                    raise ValueError(f"{path}: corrupt length crc")
+                payload = f.read(length)
+                (data_crc,) = _struct.unpack("<I", f.read(4))
+                if verify and data_crc != _masked_crc(payload):
+                    raise ValueError(f"{path}: corrupt record crc")
+                records.append(payload)
+        yield pa.table({"record": records})
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def _write_tfrecord(block: Block, out: str) -> None:
+    import struct as _struct
+
+    acc_cols = block.column_names
+    col = "record" if "record" in acc_cols else acc_cols[0]
+    with open(out, "wb") as f:
+        for v in block.column(col).to_pylist():
+            payload = v if isinstance(v, bytes) else str(v).encode()
+            head = _struct.pack("<Q", len(payload))
+            f.write(head)
+            f.write(_struct.pack("<I", _masked_crc(head)))
+            f.write(payload)
+            f.write(_struct.pack("<I", _masked_crc(payload)))
+
+
 # ----------------------------------------------------------------- writes
 def write_block(block: Block, path: str, fmt: str, index: int) -> str:
     os.makedirs(path, exist_ok=True)
@@ -149,6 +268,8 @@ def write_block(block: Block, path: str, fmt: str, index: int) -> str:
         pcsv.write_csv(block, out)
     elif fmt == "json":
         block.to_pandas().to_json(out, orient="records", lines=True)
+    elif fmt == "tfrecord":
+        _write_tfrecord(block, out)
     else:
         raise ValueError(f"unknown write format {fmt!r}")
     return out

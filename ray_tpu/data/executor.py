@@ -140,6 +140,11 @@ class PhysicalOp:
         self.in_done = False
         self.outq: collections.deque = collections.deque()
         self.inflight: dict[Any, Any] = {}
+        # Execution stats (ray: data/_internal/stats.py per-op metrics).
+        self.stat_launched = 0
+        self.stat_blocks_out = 0
+        self.stat_started: float | None = None
+        self.stat_finished: float | None = None
         # Launch-order emission: blocks leave each operator in the order
         # they entered it, so downstream sees dataset order (ray data's
         # default preserve_order streaming semantics; take(5) = first rows).
@@ -522,10 +527,13 @@ class StreamingExecutor:
                 before = len(op.outq)
                 op.harvest()
                 progressed |= len(op.outq) != before
+                if op.done and op.stat_finished is None:
+                    op.stat_finished = _t.monotonic()
                 if i + 1 < len(ops):
                     nxt = ops[i + 1]
                     while op.outq:
                         nxt.add_input(op.outq.popleft())
+                        op.stat_blocks_out += 1
                         progressed = True
                     if op.done and not nxt.in_done:
                         nxt.mark_input_done()
@@ -534,13 +542,39 @@ class StreamingExecutor:
             tail = ops[-1]
             while tail.outq:
                 progressed = True
+                tail.stat_blocks_out += 1
                 yield tail.outq.popleft()
             if tail.done:
+                if tail.stat_finished is None:
+                    tail.stat_finished = _t.monotonic()
                 return
             # 3. grant launches, most-downstream first (backpressure)
             for op in reversed(ops):
                 while op.can_launch():
+                    if op.stat_started is None:
+                        op.stat_started = _t.monotonic()
                     op.launch_one()
+                    op.stat_launched += 1
                     progressed = True
             if not progressed:
                 _t.sleep(0.005)
+
+    def stats(self) -> str:
+        """Per-operator summary of the last execute() (ray:
+        DatasetStats string — operator name, task count, blocks emitted,
+        wall clock from first launch to completion)."""
+        import time as _t
+
+        lines = []
+        for op in self.ops:
+            if op.stat_started is None:
+                wall = 0.0
+            else:
+                end = op.stat_finished if op.stat_finished is not None \
+                    else _t.monotonic()
+                wall = end - op.stat_started
+            lines.append(
+                f"{op.name}: tasks={op.stat_launched} "
+                f"blocks_out={op.stat_blocks_out} wall={wall:.3f}s "
+                f"{'done' if op.done else 'running'}")
+        return "\n".join(lines)

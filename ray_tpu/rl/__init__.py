@@ -5,11 +5,15 @@ EnvRunnerGroup (CPU sampling actors) + LearnerGroup (jitted TPU updates)
 """
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.bc import BC, BCConfig
+from ray_tpu.rl.cql import CQL, CQLConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.learner import Learner, LearnerGroup
+from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                                    MultiAgentPPO, MultiAgentPPOConfig,
+                                    MultiCartPole)
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.sac import SAC, SACConfig
@@ -17,6 +21,8 @@ from ray_tpu.rl.sac import SAC, SACConfig
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
+    "CQL", "CQLConfig", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiCartPole",
     "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
     "ReplayBuffer", "make_env", "register_env",
 ]

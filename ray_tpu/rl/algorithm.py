@@ -101,6 +101,16 @@ class AlgorithmConfig:
     build_algo = build
 
 
+def coerce_offline(data, keys: tuple) -> dict:
+    """Offline data → numpy column dict (accepts a ray_tpu.data Dataset
+    or a plain dict; shared by BC/CQL)."""
+    if hasattr(data, "to_numpy"):
+        data = data.to_numpy()
+    dtypes = {"actions": np.int64}
+    return {k: np.asarray(data[k], dtypes.get(k, np.float32))
+            for k in keys}
+
+
 class Algorithm(Trainable):
     """Base RL algorithm; subclasses define loss_builder() and
     training_step() (ray: algorithm.py:898 step / :1674 training_step)."""
@@ -155,6 +165,21 @@ class Algorithm(Trainable):
 
     def training_step(self) -> dict:
         raise NotImplementedError
+
+    def _greedy_eval(self, want: int, fragment: int = 200) -> None:
+        """Greedy (argmax) eval rollouts until `want` episodes complete —
+        the offline algorithms' metric source (BC/CQL; no training
+        data comes from these)."""
+        done = 0
+        for _ in range(max(1, want) * 4):
+            if done >= want:
+                break
+            frags = self.env_runner_group.sample(
+                self._params_np, fragment, epsilon=0.0)
+            for b in frags:
+                rets = b["episode_returns"].tolist()
+                done += len(rets)
+                self._episode_returns.extend(rets)
 
     def _collect(self, epsilon: float | None = None) -> dict:
         per = max(1, self.cfg["train_batch_size"]

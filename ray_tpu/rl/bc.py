@@ -53,13 +53,9 @@ class BC(Algorithm):
         if offline is None:
             raise ValueError("BC requires offline_data "
                              "(config.offline(offline_data=...))")
-        # Accept a ray_tpu.data Dataset or a plain column dict.
-        if hasattr(offline, "to_numpy"):
-            offline = offline.to_numpy()
-        batch = {
-            "obs": np.asarray(offline["obs"], np.float32),
-            "actions": np.asarray(offline["actions"], np.int64),
-        }
+        from ray_tpu.rl.algorithm import coerce_offline
+
+        batch = coerce_offline(offline, ("obs", "actions"))
         # Default ONE eval runner when eval is on (none when off), but an
         # explicit .env_runners() choice wins.
         cfg_eval = dict(config)
@@ -83,19 +79,7 @@ class BC(Algorithm):
             minibatch_size=self.cfg["minibatch_size"])
         self._params_np = self.learner_group.get_params_numpy()
         self._timesteps += self._n_offline
-        # Greedy eval rollouts (epsilon=0 → argmax) until the configured
-        # number of episodes completes.
-        want = self.cfg.get("eval_episodes", 2)
-        done = 0
-        for _ in range(max(1, want) * 4):
-            if done >= want:
-                break
-            frags = self.env_runner_group.sample(
-                self._params_np, 200, epsilon=0.0)
-            for b in frags:
-                rets = b["episode_returns"].tolist()
-                done += len(rets)
-                self._episode_returns.extend(rets)
+        self._greedy_eval(self.cfg.get("eval_episodes", 2))
         return metrics
 
 

@@ -1,0 +1,105 @@
+"""CQL: conservative Q-learning — offline RL on logged transitions.
+
+Analog of ray: rllib/algorithms/cql/ (CQL / CQLConfig, the SAC-derived
+offline algorithm: torch losses in cql_torch_policy.py add the
+conservative regularizer min_q_weight * (E_pi[logsumexp Q] - E_D[Q])).
+Discrete variant here: the log-sum-exp over the categorical action
+support is exact, no sampled actions needed.
+
+Training is fully offline (no env interaction); greedy eval rollouts
+measure the learned policy like BC does.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.sac import SAC, SACConfig, sac_post_update, sac_params_init
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 0        # offline: no sampling actors
+        self.offline_data = None        # dataset | column dict
+        self.cql_alpha = 1.0            # conservative-penalty weight
+        self.eval_episodes = 2
+        self.updates_per_step = 8
+
+    def offline(self, offline_data=None, **_kw) -> "CQLConfig":
+        if offline_data is not None:
+            self.offline_data = offline_data
+        return self
+
+    def training(self, *, cql_alpha=None, **kw) -> "CQLConfig":
+        if cql_alpha is not None:
+            self.cql_alpha = cql_alpha
+        super().training(**kw)
+        return self
+
+
+class CQL(SAC):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        sac_loss = SAC.loss_builder(config)
+        cql_alpha = config.get("cql_alpha", 1.0)
+
+        def loss_fn(params, batch):
+            total, metrics = sac_loss(params, batch)
+            # Conservative term per critic: push down out-of-distribution
+            # action values (logsumexp over ALL actions) while pushing up
+            # the logged actions' values.
+            a = batch["actions"][:, None]
+            penalty = 0.0
+            for qname in ("q1", "q2"):
+                q = models.mlp_apply(params[qname], batch["obs"], jnp)
+                lse = jax.scipy.special.logsumexp(q, axis=-1)
+                q_data = jnp.take_along_axis(q, a, axis=-1)[:, 0]
+                penalty = penalty + jnp.mean(lse - q_data)
+            total = total + cql_alpha * penalty
+            metrics["cql_penalty"] = penalty
+            return total, metrics
+
+        return loss_fn
+
+    def setup(self, config: dict) -> None:
+        config = dict(config or {})
+        offline = config.pop("offline_data", None)
+        if offline is None:
+            raise ValueError("CQL requires offline_data "
+                             "(config.offline(offline_data=...))")
+        from ray_tpu.rl.algorithm import coerce_offline
+
+        self._offline = coerce_offline(
+            offline, ("obs", "actions", "rewards", "next_obs", "dones"))
+        config.setdefault("params_builder", sac_params_init)
+        config.setdefault("post_update_builder", sac_post_update)
+        # One eval runner for greedy rollouts unless explicitly set.
+        if config.get("num_env_runners", 0) == 0 and \
+                config.get("eval_episodes", 2) > 0:
+            config["num_env_runners"] = 1
+        from ray_tpu.rl.algorithm import Algorithm
+
+        Algorithm.setup(self, config)
+        self._rng = np.random.default_rng(self.cfg["seed"])
+        self._n_offline = len(self._offline["obs"])
+
+    def training_step(self) -> dict:
+        metrics: dict = {}
+        bs = self.cfg["sgd_batch_size"]
+        for _ in range(self.cfg.get("updates_per_step", 8)):
+            idx = self._rng.integers(0, self._n_offline, bs)
+            sample = {k: v[idx] for k, v in self._offline.items()}
+            metrics = self.learner_group.update(sample, num_sgd_iter=1)
+        self._params_np = self.learner_group.get_params_numpy()
+        self._timesteps += bs * self.cfg.get("updates_per_step", 8)
+        self._greedy_eval(self.cfg.get("eval_episodes", 2))
+        return metrics
+
+
+CQL._default_config = CQLConfig()
+CQLConfig.algo_class = CQL

@@ -79,27 +79,33 @@ class EnvRunner:
         return batch
 
     def _gae(self, params: dict, batch: dict) -> dict:
-        """Generalized advantage estimation (rllib:
-        connectors/learner/general_advantage_estimation.py semantics)."""
-        v = models.value(params, batch["obs"])
-        v_next = models.value(params, batch["next_obs"])
-        n = len(v)
-        adv = np.zeros(n, np.float32)
-        last = 0.0
-        for t in range(n - 1, -1, -1):
-            nonterminal = 1.0 - batch["dones"][t]
-            # The lambda-carry must stop at ANY episode edge (terminal or
-            # truncation): the next buffer row belongs to a fresh episode.
-            boundary = max(batch["dones"][t], batch["truncs"][t])
-            delta = batch["rewards"][t] + \
-                self.gamma * v_next[t] * nonterminal - v[t]
-            last = delta + self.gamma * self.gae_lambda * \
-                (1.0 - boundary) * last
-            adv[t] = last
-        returns = adv + v
-        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        return {"advantages": adv.astype(np.float32),
-                "value_targets": returns.astype(np.float32)}
+        return compute_gae(params, batch, self.gamma, self.gae_lambda)
+
+
+def compute_gae(params: dict, batch: dict, gamma: float,
+                gae_lambda: float) -> dict:
+    """Generalized advantage estimation (rllib:
+    connectors/learner/general_advantage_estimation.py semantics) —
+    the ONE implementation shared by the single- and multi-agent
+    runners."""
+    v = models.value(params, batch["obs"])
+    v_next = models.value(params, batch["next_obs"])
+    n = len(v)
+    adv = np.zeros(n, np.float32)
+    last = 0.0
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - batch["dones"][t]
+        # The lambda-carry must stop at ANY episode edge (terminal or
+        # truncation): the next buffer row belongs to a fresh episode.
+        boundary = max(batch["dones"][t], batch["truncs"][t])
+        delta = batch["rewards"][t] + \
+            gamma * v_next[t] * nonterminal - v[t]
+        last = delta + gamma * gae_lambda * (1.0 - boundary) * last
+        adv[t] = last
+    returns = adv + v
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    return {"advantages": adv.astype(np.float32),
+            "value_targets": returns.astype(np.float32)}
 
 
 class EnvRunnerGroup:
