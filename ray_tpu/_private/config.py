@@ -52,6 +52,10 @@ class Config:
     prestart_workers: int = 2
     # Hard cap on worker processes per node agent.
     max_workers_per_node: int = 16
+    # Concurrent worker FORKS in flight (not total workers): an actor
+    # burst must queue spawns, not stampede N interpreters at once —
+    # under CPU contention every fork then misses its startup timeout.
+    max_concurrent_worker_spawns: int = 4
     # --- health / fault tolerance ---
     heartbeat_period_s: float = 0.5
     # Missed-heartbeat budget before a node is declared dead

@@ -111,6 +111,14 @@ class NodeAgent:
         self.bundles: dict[str, dict] = {}
         self._bg: list[asyncio.Task] = []
         self._device_worker_id: str | None = None
+        # Bounds concurrent ACTOR-placement forks (see Config
+        # .max_concurrent_worker_spawns): an actor burst must queue its
+        # worker spawns — N simultaneous interpreter forks on a small
+        # host all miss their startup timeouts.  Plain-task spawns stay
+        # bounded by max_workers_per_node instead; putting this wait on
+        # the task-lease hot path measurably regressed it.
+        self._actor_spawn_sem = asyncio.Semaphore(
+            max(1, config.max_concurrent_worker_spawns))
         self._closed = False
         self.store = None  # shared-memory store runner, attached in start()
         import tempfile
@@ -240,13 +248,36 @@ class NodeAgent:
         self._try_grant_pending()
         return {"ok": True}
 
-    async def _get_idle_worker(self) -> WorkerHandle | None:
+    async def _get_idle_worker(self, ignore_cap: bool = False,
+                               spawn_sem: "asyncio.Semaphore | None" = None
+                               ) -> WorkerHandle | None:
         for w in self.workers.values():
             if w.state == "idle" and not w.is_device_worker:
                 return w
         n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
-        if n_alive >= self.config.max_workers_per_node:
+        if not ignore_cap and \
+                n_alive >= self.config.max_workers_per_node:
+            # The cap bounds the PLAIN-task pool (fork storms on small
+            # hosts).  Actor placements pass ignore_cap: each actor is a
+            # dedicated process and the node's RESOURCES are its
+            # admission control — a hard worker cap would strand
+            # resource-feasible actors in PENDING forever (e.g. many
+            # fractional-CPU actors).
             return None
+        if spawn_sem is None:
+            return await self._spawn_and_wait()
+        # Only the FORK is gated (idle scans above need no permit): an
+        # actor burst queues its spawns 4-wide instead of stampeding N
+        # interpreters at once, which makes every fork miss its timeout.
+        async with spawn_sem:
+            # A spawn that completed while we queued may have freed an
+            # idle worker — take it instead of forking another.
+            for w in self.workers.values():
+                if w.state == "idle" and not w.is_device_worker:
+                    return w
+            return await self._spawn_and_wait()
+
+    async def _spawn_and_wait(self) -> WorkerHandle | None:
         w = self._spawn_worker()
         fut = self._starting.get(w.worker_id)
         if fut is not None:
@@ -601,13 +632,26 @@ class NodeAgent:
             return {"ok": False, "error": "infeasible"}
         if not self._resources_fit(lease_h):
             return {"ok": False}
-        if demand.get("TPU", 0) > 0:
-            w = await self._get_device_worker()
-        else:
-            w = await self._get_idle_worker()
+        # Reserve BEFORE any await (the _grant discipline): concurrent
+        # creations racing through a spawn wait must not double-book.
+        self._acquire(lease_h)
+        w = None
+        try:
+            if demand.get("TPU", 0) > 0:
+                w = await self._get_device_worker()
+            else:
+                # Zero-demand actors keep the worker-count cap: with no
+                # resources to admit them, ignore_cap would allow
+                # unbounded process forks.
+                has_demand = any(v > 0 for v in demand.values())
+                w = await self._get_idle_worker(
+                    ignore_cap=has_demand,
+                    spawn_sem=self._actor_spawn_sem)
+        finally:
+            if w is None or w.addr is None:
+                self._release(lease_h)
         if w is None or w.addr is None:
             return {"ok": False}
-        self._acquire(lease_h)
         if not w.is_device_worker:
             w.state = "actor"
         w.actor_ids.add(h["actor_id"])
@@ -622,6 +666,12 @@ class NodeAgent:
             self._release(lease_h)
             w.actor_ids.discard(h["actor_id"])
             w.actor_leases.pop(h["actor_id"], None)
+            if not w.is_device_worker and not w.actor_ids \
+                    and w.state == "actor":
+                # The live process must return to the pool, not leak as
+                # a zero-actor "actor" worker nothing can ever reuse.
+                w.state = "idle"
+                self._try_grant_pending()
             return {"ok": False, "error": None, "detail": str(e)}
         if reply.get("error"):
             self._release(lease_h)
