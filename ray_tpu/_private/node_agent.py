@@ -764,6 +764,9 @@ def _watch_parent() -> None:
 
 
 def main() -> None:
+    from ray_tpu._private.stack_dump import install as _install_stack
+
+    _install_stack('agent')
     import argparse
     import json as _json
     import signal

@@ -1,0 +1,82 @@
+"""Live stack dumps for every runtime process (`ray-tpu stack`).
+
+Analog of ray: `ray stack` (python/ray/scripts/scripts.py), which
+py-spy-attaches to local worker processes.  py-spy is not in this
+environment; instead every runtime process installs a SIGUSR1
+faulthandler at startup that appends all-thread stacks to a per-pid file
+under /tmp/ray_tpu_stacks/.  The CLI signals every live runtime process
+and prints the fresh dumps — the "what is everyone doing right now"
+debugging tool for hangs.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+STACK_DIR = "/tmp/ray_tpu_stacks"
+
+
+def install(role: str) -> None:
+    """Register a SIGUSR1 handler dumping all-thread stacks.  Called from
+    controller/agent/worker startup; idempotent."""
+    import faulthandler
+
+    try:
+        os.makedirs(STACK_DIR, exist_ok=True)
+        path = os.path.join(STACK_DIR, f"{os.getpid()}_{role}.txt")
+        # Truncate per process start; the collector only signals pids
+        # that HAVE a file here, so registration and signal eligibility
+        # stay atomic (a SIGUSR1 before registration would KILL the
+        # process — the default disposition).
+        f = open(path, "w", buffering=1)   # noqa: SIM115 - held for life
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+        f.write(f"# {role} pid={os.getpid()} argv={sys.argv[:3]}\n")
+    except (OSError, ValueError, AttributeError):
+        # Non-main-thread registration / exotic platform: best effort.
+        pass
+
+
+def collect(timeout_s: float = 3.0) -> str:
+    """Signal every REGISTERED runtime process and return the fresh
+    dumps (driver side of `ray-tpu stack`).  Only pids with a stack file
+    are signalled — a process that has not registered its handler yet
+    would be KILLED by SIGUSR1's default disposition."""
+    t_signal = time.time()
+    try:
+        names = sorted(os.listdir(STACK_DIR))
+    except OSError:
+        names = []
+    pids, live_names = [], []
+    for name in names:
+        try:
+            pid = int(name.split("_", 1)[0])
+        except ValueError:
+            continue
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            pids.append(pid)
+            live_names.append(name)
+        except (ProcessLookupError, PermissionError):
+            # Dead pid from an earlier session: clean its file up.
+            try:
+                os.unlink(os.path.join(STACK_DIR, name))
+            except OSError:
+                pass
+    time.sleep(min(timeout_s, 0.2 + 0.05 * len(pids)))
+    chunks = [f"signalled {len(pids)} runtime processes: {pids}"]
+    for name in live_names:
+        path = os.path.join(STACK_DIR, name)
+        try:
+            if os.path.getmtime(path) < t_signal - 1.0:
+                continue                      # no fresh dump arrived
+            size = os.path.getsize(path)
+            with open(path) as f:
+                if size > 8192:
+                    f.seek(size - 8192)
+                content = f.read()
+        except OSError:
+            continue
+        chunks.append(f"===== {name} =====\n" + content)
+    return "\n".join(chunks)

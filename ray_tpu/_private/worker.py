@@ -506,6 +506,10 @@ class CoreWorker:
         self.actor_states: dict[str, ActorSubmitState] = {}
         self.current_actor_id: str | None = None
         self.current_task_id: str | None = None
+        # Trace context of the currently-executing task (ray: OpenTelemetry
+        # propagation, util/tracing/tracing_helper.py): child submissions
+        # inherit trace_id, and task events / profiling spans carry it.
+        self.current_trace: dict | None = None
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
         # task_id -> StreamState for streaming-generator tasks this process
@@ -946,7 +950,8 @@ class CoreWorker:
             for c_oid, c_owner in prev_contained:
                 self._release_borrow(c_oid, c_owner)
             st.total = total
-            self._record_event(task.task_id.hex(), "FINISHED")
+            self._record_event(task.task_id.hex(), "FINISHED",
+                               trace=task.header.get("trace"))
         elif status == "cancelled":
             st.error = TaskCancelledError(task.task_id.hex())
             st.total = total
@@ -967,7 +972,8 @@ class CoreWorker:
             st.error = TaskError(exc or RuntimeError("task failed"), tb)
             st.total = total
             self._resolve_error(rid0, st.error)
-            self._record_event(task.task_id.hex(), "FAILED")
+            self._record_event(task.task_id.hex(), "FAILED",
+                               trace=task.header.get("trace"))
         st.event.set()
         if abandoned:
             # The state above was a transient re-creation (the consumer is
@@ -1004,12 +1010,21 @@ class CoreWorker:
                                 ref.owner_addr or self.address)
         for oid, owner in borrowed.items():
             self._add_borrow(oid, owner)
+        tc = self.current_trace
         header = {
             "task_id": task_id.hex(), "function_id": fid,
             "num_returns": num_returns, "resources": resources,
             "owner_addr": self.address, "arg_refs": arg_refs,
             "bundle_key": bundle_key,
             "name": options.get("name", ""),
+            # W3C-style propagation: a task submitted INSIDE a task
+            # continues its trace; a driver submission roots a new one
+            # (trace_id = root task id).  span_id = this task's id.
+            "trace": {
+                "trace_id": tc["trace_id"] if tc else task_id.hex(),
+                "parent_span": tc["span_id"] if tc else None,
+                "span_id": task_id.hex(),
+            },
         }
         if options.get("dynamic"):
             header["dynamic"] = True
@@ -1187,7 +1202,8 @@ class CoreWorker:
                         self.memory.put_locations(rid, rec.locations)
                 for c_oid, c_owner in prev_contained:
                     self._release_borrow(c_oid, c_owner)
-            self._record_event(task.task_id.hex(), "FINISHED")
+            self._record_event(task.task_id.hex(), "FINISHED",
+                               trace=task.header.get("trace"))
         elif status == "cancelled":
             err = TaskCancelledError(task.task_id.hex())
             for rid in task.return_ids:
@@ -1207,7 +1223,8 @@ class CoreWorker:
             err = TaskError(exc or RuntimeError("task failed"), tb)
             for rid in task.return_ids:
                 self._resolve_error(rid, err)
-            self._record_event(task.task_id.hex(), "FAILED")
+            self._record_event(task.task_id.hex(), "FAILED",
+                               trace=task.header.get("trace"))
 
     def _resolve_dynamic_return(self, task: PendingTask, rid: bytes,
                                 meta: dict, blobs: list,
@@ -1721,7 +1738,9 @@ class CoreWorker:
 
         rec = {"arg_contained": (), "svs": None, "err": None, "stored": ()}
         prev = self.current_task_id
+        prev_trace = self.current_trace
         self.current_task_id = th["task_id"]
+        self.current_trace = th.get("trace")
         self._record_event(th["task_id"], "RUNNING", th.get("name", ""))
         try:
             value, contained = deserialize_with_refs(frames)
@@ -1753,6 +1772,7 @@ class CoreWorker:
             rec["err"] = (payload, tb_str)
         finally:
             self.current_task_id = prev
+            self.current_trace = prev_trace
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -1863,7 +1883,8 @@ class CoreWorker:
             return {"status": "cancelled"}, []
         fn = await self._fetch_function(h["function_id"])
         args, kwargs = await self._resolve_args(h, blobs)
-        self._record_event(h["task_id"], "RUNNING", h.get("name", ""))
+        self._record_event(h["task_id"], "RUNNING", h.get("name", ""),
+                           trace=h.get("trace"))
 
         def _thunk():
             from ray_tpu._private import runtime_env as renv
@@ -1877,7 +1898,8 @@ class CoreWorker:
             finally:
                 self._evict_untracked_args(h)
         try:
-            result = await self._run_user_code(_thunk, task_id=task_id)
+            result = await self._run_user_code(_thunk, task_id=task_id,
+                                               trace=h.get("trace"))
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(e)
         finally:
@@ -1932,7 +1954,9 @@ class CoreWorker:
         def _producer():
             nonlocal count
             prev = self.current_task_id
+            prev_trace = self.current_trace
             self.current_task_id = h["task_id"]
+            self.current_trace = h.get("trace")
             try:
                 for item in thunk():
                     asyncio.run_coroutine_threadsafe(
@@ -1940,6 +1964,7 @@ class CoreWorker:
                     count += 1
             finally:
                 self.current_task_id = prev
+                self.current_trace = prev_trace
 
         try:
             await loop.run_in_executor(executor, _producer)
@@ -2012,14 +2037,18 @@ class CoreWorker:
         return tuple(args), kwargs
 
     async def _run_user_code(self, thunk, task_id: bytes | None = None,
-                             executor=None, instance_actor: str | None = None):
+                             executor=None, instance_actor: str | None = None,
+                             trace: dict | None = None):
         prev_task = self.current_task_id
+        prev_trace = self.current_trace
         self.current_task_id = task_id.hex() if task_id else None
+        self.current_trace = trace
         try:
             return await self.loop.run_in_executor(
                 executor or self._default_executor, thunk)
         finally:
             self.current_task_id = prev_task
+            self.current_trace = prev_trace
 
     def _error_reply(self, e: BaseException) -> tuple[dict, list]:
         import pickle
@@ -2379,7 +2408,8 @@ class CoreWorker:
         args, kwargs = await self._resolve_args(h, blobs)
         task_id = bytes.fromhex(h["task_id"])
         self._record_event(h["task_id"], "RUNNING",
-                           f"{type(inst.instance).__name__}.{h['method']}")
+                           f"{type(inst.instance).__name__}.{h['method']}",
+                           trace=h.get("trace"))
         group = inst.group_of(h)   # named concurrency group (or None)
         if h.get("streaming"):
             import inspect as _inspect
@@ -2443,12 +2473,15 @@ class CoreWorker:
                 from ray_tpu._private import runtime_env as renv
 
                 prev = self.current_task_id
+                prev_trace = self.current_trace
                 self.current_task_id = h["task_id"]
+                self.current_trace = h.get("trace")
                 try:
                     with renv.activate(inst.runtime_env, self):
                         return method(*args, **kwargs)
                 finally:
                     self.current_task_id = prev
+                    self.current_trace = prev_trace
             atask = self.loop.run_in_executor(inst.executor_for(group),
                                               _call)
 
@@ -2845,11 +2878,14 @@ class CoreWorker:
                 "actors": list(self.actors_hosted)}
 
     # ------------------------------------------------------------ telemetry
-    def _record_event(self, task_id: str, state: str, name: str = "") -> None:
+    def _record_event(self, task_id: str, state: str, name: str = "",
+                      trace: dict | None = None) -> None:
+        tc = trace or self.current_trace
         self._task_events.append(
             {"task_id": task_id, "state": state, "name": name,
              "t": time.time(), "worker": self.worker_id[:8],
-             "node": self.node_id[:8]})
+             "node": self.node_id[:8],
+             "trace_id": tc["trace_id"][:16] if tc else ""})
         if len(self._task_events) > self.config.task_event_buffer_size:
             self._task_events = self._task_events[-self.config.
                                                   task_event_buffer_size:]

@@ -61,6 +61,9 @@ def _pin_jax_platform() -> None:
 
 
 def main() -> None:
+    from ray_tpu._private.stack_dump import install as _install_stack
+
+    _install_stack('worker')
     _pin_jax_platform()
     _watch_parent()
     _extend_sys_path()

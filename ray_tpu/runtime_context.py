@@ -15,6 +15,7 @@ class RuntimeContext:
     actor_id: str | None
     task_id: str | None
     namespace: str
+    trace_context: dict | None = None
 
     def get_node_id(self) -> str:
         return self.node_id
@@ -31,6 +32,13 @@ class RuntimeContext:
     def get_worker_id(self) -> str:
         return self.worker_id
 
+    def get_trace_context(self) -> dict | None:
+        """The executing task's trace context — {"trace_id",
+        "parent_span", "span_id"} — propagated automatically through
+        nested task/actor submissions (ray: OpenTelemetry propagation,
+        util/tracing/tracing_helper.py); None on the driver."""
+        return self.trace_context
+
 
 def get_runtime_context() -> RuntimeContext:
     from ray_tpu._private.worker import global_worker
@@ -43,4 +51,5 @@ def get_runtime_context() -> RuntimeContext:
         actor_id=core.current_actor_id,
         task_id=core.current_task_id,
         namespace=core.namespace,
+        trace_context=core.current_trace,
     )

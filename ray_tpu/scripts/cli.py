@@ -181,6 +181,14 @@ def cmd_summary(args) -> None:
     print(json.dumps(fn(), indent=2))
 
 
+def cmd_stack(_args) -> None:
+    """ray: `ray stack` — dump all-thread stacks of every live runtime
+    process (controller/agents/workers) on this host."""
+    from ray_tpu._private.stack_dump import collect
+
+    print(collect())
+
+
 def cmd_timeline(args) -> None:
     """ray: `ray timeline` — Chrome trace JSON from task events."""
     rt = _attach(args)
@@ -289,6 +297,10 @@ def main(argv: list[str] | None = None) -> None:
     sp.add_argument("job_id", nargs="?")
     sp.add_argument("entrypoint", nargs="*")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser(
+        "stack", help="dump stacks of all live runtime processes")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser(
         "serve", usage="ray-tpu serve deploy <config.json> | "

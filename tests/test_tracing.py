@@ -1,0 +1,92 @@
+"""Trace propagation across task boundaries + `ray-tpu stack`
+(reference: ray util/tracing/tracing_helper.py OTel propagation; the
+`ray stack` py-spy tool in scripts.py).
+"""
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get([warm.remote() for _ in range(3)])
+    yield
+
+
+def test_trace_propagates_through_nested_tasks(cluster):
+    @ray_tpu.remote
+    def child():
+        return ray_tpu.get_runtime_context().get_trace_context()
+
+    @ray_tpu.remote
+    def parent():
+        tc = ray_tpu.get_runtime_context().get_trace_context()
+        sub = ray_tpu.get(child.remote())
+        return tc, sub
+
+    tc, sub = ray_tpu.get(parent.remote())
+    assert tc is not None and sub is not None
+    # Same trace end to end; the child's parent span is the parent task.
+    assert sub["trace_id"] == tc["trace_id"]
+    assert sub["parent_span"] == tc["span_id"]
+    assert sub["span_id"] != tc["span_id"]
+    # Sibling roots start distinct traces.
+    tc2, _ = ray_tpu.get(parent.remote())
+    assert tc2["trace_id"] != tc["trace_id"]
+
+
+def test_trace_propagates_into_actor_calls(cluster):
+    @ray_tpu.remote
+    class A:
+        def whoami(self):
+            return ray_tpu.get_runtime_context().get_trace_context()
+
+    @ray_tpu.remote
+    def via_actor():
+        a = A.remote()
+        tc = ray_tpu.get_runtime_context().get_trace_context()
+        sub = ray_tpu.get(a.whoami.remote())
+        ray_tpu.kill(a)
+        return tc, sub
+
+    tc, sub = ray_tpu.get(via_actor.remote())
+    assert sub["trace_id"] == tc["trace_id"]
+
+
+def test_timeline_events_carry_trace_id(cluster):
+    @ray_tpu.remote
+    def traced():
+        return ray_tpu.get_runtime_context().get_trace_context()
+
+    tc = ray_tpu.get(traced.remote())
+    import time
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        events = [e for e in ray_tpu.timeline()
+                  if e.get("trace_id") and
+                  tc["trace_id"].startswith(e["trace_id"])]
+        if events:
+            return
+        time.sleep(0.5)
+    raise AssertionError("no timeline event carried the trace id")
+
+
+def test_stack_dump_collects_runtime_stacks(cluster):
+    """`ray-tpu stack`: every runtime process dumps all-thread stacks on
+    SIGUSR1 and the collector gathers them."""
+    from ray_tpu._private.stack_dump import collect
+
+    out = collect()
+    assert "signalled" in out
+    # At least the controller/agent/worker processes responded with a
+    # thread dump.
+    assert out.count("=====") >= 2, out[:2000]
+    assert "Thread 0x" in out or "Current thread" in out, out[:2000]
