@@ -1,0 +1,9 @@
+"""ray_tpu.experimental — accelerated-DAG building blocks.
+
+Mutable shared-memory channels for repeated zero-allocation
+producer→consumer handoff (reference: ray experimental channels,
+src/ray/core_worker/experimental_mutable_object_manager.h).
+"""
+from ray_tpu.experimental.channel import Channel  # noqa: F401
+
+__all__ = ["Channel"]

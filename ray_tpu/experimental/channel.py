@@ -1,0 +1,241 @@
+"""Mutable shared-memory channels (accelerated-DAG edges).
+
+Analog of ray: src/ray/core_worker/experimental_mutable_object_manager.h
+(+ python/ray/experimental/channel/): a FIXED shm buffer per DAG edge
+that the producer rewrites in place every execution and the consumer
+reads zero-copy — no per-call object naming, allocation, or RPC.  This
+deliberately sits OUTSIDE the object-store arena: sealed arena objects
+are immutable by invariant (CLAUDE.md); channels are their own tiny
+/dev/shm segments (prefix `rtchan_`, disjoint from the arena sweep's
+`raytpu_*` namespace) with an explicit writer/reader handshake.
+
+Protocol (single writer, up to 64 registered readers, same host):
+
+    header:  u64 write_seq | u64 payload_len | u64 n_readers
+             | u64 claimed_mask | u64 acks[n_readers]
+
+  - Each reader CLAIMS a slot (serialized by flock on the segment fd)
+    on its first read; extra readers beyond n_readers fail loudly.
+  - read(): wait write_seq > last_seen, copy payload, store
+    acks[slot] = seq.  The per-slot store is a plain aligned u64 write
+    owned by exactly one process — no read-modify-write races.
+  - write(): wait until all n_readers slots are claimed AND every
+    ack >= current seq (so nobody is still copying), then rewrite the
+    payload in place, publish length, bump write_seq.
+
+The waits are micro-sleep polls (same-host latency; the reference uses
+named semaphores for the same role).
+"""
+from __future__ import annotations
+
+import fcntl
+import mmap
+import os
+import pickle
+import struct
+import time
+
+_FIXED = struct.Struct("<QQQQ")    # write_seq, len, n_readers, claimed
+_SHM_DIR = "/dev/shm"
+MAX_READERS = 64
+
+
+class ChannelError(RuntimeError):
+    pass
+
+
+class ChannelFull(ChannelError):
+    pass
+
+
+class ChannelClosed(ChannelError):
+    pass
+
+
+class Channel:
+    """Single-writer, fixed-N-reader mutable shm channel.
+
+        ch = Channel.create("edge0", max_size=1 << 20, n_readers=1)
+        ch.write(value)                      # producer, repeatedly
+        rd = Channel.open("edge0")
+        value = rd.read(timeout=5.0)         # consumer, repeatedly
+
+    Channels pickle by NAME (each process maps the same segment); a
+    deserialized handle that reads becomes one of the n_readers — the
+    reader SET is fixed, so ship exactly n_readers handles to readers.
+    """
+
+    def __init__(self, name: str, fd: int, mm: mmap.mmap, created: bool):
+        self.name = name
+        self._fd = fd
+        self._mm = mm
+        self._created = created
+        self._last_read_seq = 0
+        self._slot: int | None = None
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    @staticmethod
+    def _fname(name: str) -> str:
+        return f"rtchan_{name}"
+
+    @classmethod
+    def create(cls, name: str, max_size: int = 1 << 20,
+               n_readers: int = 1) -> "Channel":
+        if not 1 <= n_readers <= MAX_READERS:
+            raise ChannelError(f"n_readers must be 1..{MAX_READERS}")
+        path = os.path.join(_SHM_DIR, cls._fname(name))
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        except FileExistsError:
+            # Stale segment from a crashed owner: the creator owns the
+            # name, so supersede it (single-writer semantics).
+            os.unlink(path)
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        total = _FIXED.size + 8 * n_readers + max_size
+        os.ftruncate(fd, total)
+        mm = mmap.mmap(fd, total)
+        _FIXED.pack_into(mm, 0, 0, 0, n_readers, 0)
+        return cls(name, fd, mm, created=True)
+
+    @classmethod
+    def open(cls, name: str) -> "Channel":
+        path = os.path.join(_SHM_DIR, cls._fname(name))
+        fd = os.open(path, os.O_RDWR)
+        mm = mmap.mmap(fd, os.fstat(fd).st_size)
+        return cls(name, fd, mm, created=False)
+
+    @classmethod
+    def destroy(cls, name: str) -> None:
+        """Unlink the segment (live handles keep their mapping)."""
+        try:
+            os.unlink(os.path.join(_SHM_DIR, cls._fname(name)))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+            os.close(self._fd)
+        except (OSError, ValueError):
+            pass
+        if self._created:
+            self.destroy(self.name)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - teardown
+            pass
+
+    # ------------------------------------------------------------- plumbing
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ChannelClosed(f"channel {self.name} is closed")
+
+    def _hdr(self) -> tuple[int, int, int, int]:
+        try:
+            return _FIXED.unpack_from(self._mm, 0)
+        except ValueError as e:
+            raise ChannelClosed(f"channel {self.name}: {e}") from None
+
+    def _ack(self, slot: int) -> int:
+        return struct.unpack_from("<Q", self._mm,
+                                  _FIXED.size + 8 * slot)[0]
+
+    def _payload_off(self, n_readers: int) -> int:
+        return _FIXED.size + 8 * n_readers
+
+    @property
+    def max_size(self) -> int:
+        n = self._hdr()[2]
+        return len(self._mm) - self._payload_off(n)
+
+    def _claim_slot(self) -> int:
+        """First read registers this handle as one of the n_readers
+        (flock serializes claims across processes)."""
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        try:
+            seq, length, n_readers, claimed = self._hdr()
+            for i in range(n_readers):
+                if not claimed & (1 << i):
+                    struct.pack_into("<Q", self._mm, 24,
+                                     claimed | (1 << i))
+                    # A late claimer must not re-consume history: start
+                    # acked-up-to the current seq minus one pending read.
+                    struct.pack_into("<Q", self._mm,
+                                     _FIXED.size + 8 * i,
+                                     self._last_read_seq)
+                    return i
+            raise ChannelError(
+                f"channel {self.name}: all {n_readers} reader slots "
+                "claimed — the reader set is fixed at create()")
+        finally:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+
+    # ---------------------------------------------------------------- write
+    def write(self, value, timeout: float | None = 10.0) -> None:
+        """Serialize value into the channel in place.  Blocks until every
+        registered reader acked the previous value (and until all
+        n_readers have attached — the fixed-set handshake)."""
+        self._check_open()
+        payload = pickle.dumps(value, protocol=5)
+        if len(payload) > self.max_size:
+            raise ChannelFull(
+                f"payload {len(payload)}B > channel max_size "
+                f"{self.max_size}B")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        full_mask = None
+        while True:
+            seq, _len, n_readers, claimed = self._hdr()
+            if full_mask is None:
+                full_mask = (1 << n_readers) - 1
+            # The FIRST write may proceed before readers attach (nothing
+            # can be mid-copy yet; late claimers start at ack 0 and read
+            # it).  Every later write needs the full reader set attached
+            # AND every ack caught up — nobody is still copying.
+            acked = all(self._ack(i) >= seq for i in range(n_readers)
+                        if claimed >> i & 1)
+            if acked and (claimed == full_mask or seq == 0):
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel {self.name}: waiting on readers "
+                    f"(claimed={claimed:b}/{full_mask:b}, seq={seq})")
+            time.sleep(0.0002)
+        off = self._payload_off(n_readers)
+        self._mm[off:off + len(payload)] = payload
+        struct.pack_into("<Q", self._mm, 8, len(payload))   # length first
+        struct.pack_into("<Q", self._mm, 0, seq + 1)        # then publish
+
+    # ----------------------------------------------------------------- read
+    def read(self, timeout: float | None = 10.0):
+        """Blocking read of the NEXT value (each registered reader sees
+        every value exactly once); acks so the writer may overwrite."""
+        self._check_open()
+        if self._slot is None:
+            self._slot = self._claim_slot()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            seq, length, n_readers, _claimed = self._hdr()
+            if seq > self._last_read_seq:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel {self.name}: no write past seq "
+                    f"{self._last_read_seq}")
+            time.sleep(0.0002)
+        off = self._payload_off(n_readers)
+        value = pickle.loads(bytes(self._mm[off:off + length]))
+        self._last_read_seq = seq
+        # Ack AFTER copying out (plain store to OUR slot — atomic, no
+        # cross-reader read-modify-write): the writer may then rewrite.
+        struct.pack_into("<Q", self._mm, _FIXED.size + 8 * self._slot,
+                         seq)
+        return value
+
+    def __reduce__(self):
+        return (Channel.open, (self.name,))
