@@ -1702,10 +1702,8 @@ class CoreWorker:
         tasks = h["tasks"]
         fns = []
         for th in tasks:
-            fn = self.functions.get(th["function_id"])
-            if (fn is None or th.get("arg_refs") or th.get("runtime_env")
-                    or th.get("dynamic") or th.get("streaming")
-                    or bytes.fromhex(th["task_id"]) in self._cancelled):
+            fn = self._task_is_simple(th)
+            if fn is None:
                 fns = None
                 break
             fns.append(fn)
@@ -1729,6 +1727,18 @@ class CoreWorker:
             replies.append(reply)
             out_blobs.extend(rb)
         return {"replies": replies}, out_blobs
+
+    def _task_is_simple(self, th: dict):
+        """The one eligibility predicate for the one-executor-hop fast
+        path (single pushes AND batches): returns the cached function, or
+        None when the task needs the general path (ref args, runtime_env,
+        dynamic/streaming returns, cancellation, uncached function)."""
+        fn = self.functions.get(th.get("function_id", ""))
+        if (fn is None or th.get("arg_refs") or th.get("runtime_env")
+                or th.get("dynamic") or th.get("streaming")
+                or bytes.fromhex(th["task_id"]) in self._cancelled):
+            return None
+        return fn
 
     def _exec_simple_thread(self, th: dict, frames: list, fn) -> dict:
         """Executor-thread body of the fast path: deserialize args, run the
@@ -1852,15 +1862,31 @@ class CoreWorker:
         return {"replies": replies}, out_blobs
 
     async def rpc_push_task(self, h: dict, blobs: list) -> tuple[dict, list]:
+        fast = False
         try:
-            reply, rb = await self._execute_pushed_task(h, blobs)
+            fn = self._task_is_simple(h)
+            if fn is not None:
+                # Simple single task: same one-executor-hop fast path the
+                # batches use (3 thread round-trips per call otherwise —
+                # the sync-call latency cost).
+                fast = True
+                rec = await self.loop.run_in_executor(
+                    self._default_executor, self._exec_simple_thread,
+                    h, blobs, fn)
+                reply, rb = await self._finalize_simple(h, rec)
+            else:
+                reply, rb = await self._execute_pushed_task(h, blobs)
         except BaseException as e:  # noqa: BLE001
             reply, rb = self._error_reply(e)
-        if reply.get("status") == "error" and self.mode == "worker":
-            # Cache the error locally too: a same-batch consumer of this
-            # task's return must resolve it WITHOUT an owner round-trip —
-            # the owner only learns the error when the whole batch
-            # replies, which waits on that consumer (deadlock otherwise).
+            fast = False
+        if reply.get("status") == "error" and self.mode == "worker" \
+                and not fast:
+            # Cache the error locally (the fast path's _finalize_simple
+            # already did — don't double-fill the bounded return cache):
+            # a same-batch consumer of this task's return must resolve it
+            # WITHOUT an owner round-trip — the owner only learns the
+            # error when the whole batch replies, which waits on that
+            # consumer (deadlock otherwise).
             import pickle
 
             try:
