@@ -106,13 +106,21 @@ def test_channel_across_actor_processes():
     try:
         prod = Producer.remote("t_actors")
         cons = Consumer.remote("t_actors")
+        # Warm both actors first: under full-suite load actor workers can
+        # take tens of seconds to fork, which must not eat into the
+        # channel-handshake timeouts below.
+        ray_tpu.get([prod.produce.remote(0),
+                     cons.consume.remote(0)], timeout=180)
         got_ref = cons.consume.remote(8)
         sent_ref = prod.produce.remote(8)
-        assert ray_tpu.get(sent_ref, timeout=60) == 8
-        assert ray_tpu.get(got_ref, timeout=60) == [i * i for i in range(8)]
-        ray_tpu.kill(prod)
-        ray_tpu.kill(cons)
+        assert ray_tpu.get(sent_ref, timeout=120) == 8
+        assert ray_tpu.get(got_ref, timeout=120) == [i * i for i in range(8)]
     finally:
+        for a in (prod, cons):
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
         ch.close()
 
 
