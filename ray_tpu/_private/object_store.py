@@ -35,6 +35,21 @@ class MemoryEntry:
     frames: list[bytes] | None = None
     error: BaseException | None = None
     locations: list[str] = field(default_factory=list)  # node agent addrs
+    # Lazily-attached wakeup for SYNC getters waiting off the IO loop
+    # (worker.py _get_objects_fast): fill sites publish fields, then
+    # wake() both waiter kinds.  Plain threading.Event — safe to set
+    # from the loop thread, waitable from any.
+    t_event: "Any" = None
+
+    def resolved(self) -> bool:
+        return (self.has_value or self.error is not None
+                or self.frames is not None or bool(self.locations))
+
+    def wake(self) -> None:
+        self.event.set()
+        t = self.t_event
+        if t is not None:
+            t.set()
 
 
 class MemoryStore:
@@ -42,16 +57,24 @@ class MemoryStore:
 
     Futures-based: getters wait on the entry's event until the task that
     produces the object completes (ray: GetRequest in memory_store.cc).
+    Entry creation is thread-safe: the IO loop and sync caller threads
+    (put/get fast paths) both materialize entries.
     """
 
     def __init__(self) -> None:
+        import threading
+
         self._entries: dict[bytes, MemoryEntry] = {}
+        self._lock = threading.Lock()
 
     def entry(self, object_id: bytes) -> MemoryEntry:
         e = self._entries.get(object_id)
         if e is None:
-            e = MemoryEntry(event=asyncio.Event())
-            self._entries[object_id] = e
+            with self._lock:
+                e = self._entries.get(object_id)
+                if e is None:
+                    e = MemoryEntry(event=asyncio.Event())
+                    self._entries[object_id] = e
         return e
 
     def get_if_exists(self, object_id: bytes) -> MemoryEntry | None:
@@ -61,22 +84,22 @@ class MemoryStore:
         e = self.entry(object_id)
         e.has_value = True
         e.value = value
-        e.event.set()
+        e.wake()
 
     def put_frames(self, object_id: bytes, frames: list[bytes]) -> None:
         e = self.entry(object_id)
         e.frames = frames
-        e.event.set()
+        e.wake()
 
     def put_error(self, object_id: bytes, err: BaseException) -> None:
         e = self.entry(object_id)
         e.error = err
-        e.event.set()
+        e.wake()
 
     def put_locations(self, object_id: bytes, locations: list[str]) -> None:
         e = self.entry(object_id)
         e.locations = list(locations)
-        e.event.set()
+        e.wake()
 
     def ready(self, object_id: bytes) -> bool:
         e = self._entries.get(object_id)

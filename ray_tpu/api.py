@@ -237,6 +237,12 @@ def get(refs: ObjectRef | Sequence[ObjectRef],
         *, timeout: float | None = None) -> Any:
     from ray_tpu._private.worker import global_worker
 
+    # Compiled-DAG execution results (ray: ray.get on CompiledDAGRef reads
+    # the DAG's output channel, no object-store involvement).
+    from ray_tpu.dag.dag_node import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout)
     single = isinstance(refs, ObjectRef)
     ref_list = [refs] if single else list(refs)
     for r in ref_list:

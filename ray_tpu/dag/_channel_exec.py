@@ -1,0 +1,210 @@
+"""Worker-side execution loop for channel-compiled DAGs.
+
+Analog of ray: python/ray/dag/compiled_dag_node.py:149 (do_exec_tasks —
+the persistent loop each actor runs, reading input channels, executing
+its bound methods, writing output channels) driving the mutable-object
+channels of experimental_mutable_object_manager.h.  Here the loop is a
+plain function shipped through the generic ``__ray_call__`` dispatch
+(run-a-callable-on-the-actor, as in ray), so it rides the actor's own
+executor: while a compiled DAG is live the actor is occupied by its
+loop, exactly like the reference.
+
+The plan shipped to each actor:
+
+    {"steps": [{"node": id, "method": str,
+                "args": template, "kwargs": template,
+                "out": channel_name | None}, ...],   # topo order
+     "stop_outs": [channel_name, ...]}               # all out channels
+
+Templates embed ``ChanArg(node_id, channel)`` (read that producer's
+channel — once per iteration, lazily, so same-actor producer→consumer
+chains never deadlock on read ordering) and ``InputArg(key)`` (project
+the DAG input; key None = whole input).  Control values flow IN-BAND so
+every channel sees exactly one write per iteration (seq alignment):
+``DagStop`` tears the pipeline down; ``DagError`` forwards a failed
+upstream step without executing dependents.
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+
+from ray_tpu.experimental.channel import Channel
+
+LOOP_METHOD = "__ray_call__"
+
+
+@dataclass(frozen=True)
+class ChanArg:
+    node: int
+    channel: str
+
+
+@dataclass(frozen=True)
+class InputArg:
+    key: object   # None = whole input value
+
+
+class DagStop:
+    """In-band teardown sentinel (forwarded downstream, then exit)."""
+
+    def __reduce__(self):
+        return (DagStop, ())
+
+
+class DagError:
+    """In-band failed-step marker: dependents forward it instead of
+    executing; the driver raises it from CompiledDAGRef.get()."""
+
+    def __init__(self, err: BaseException):
+        try:
+            self.payload = pickle.dumps(err)
+        except Exception:  # noqa: BLE001 - unpicklable user exception
+            self.payload = pickle.dumps(RuntimeError(
+                f"{type(err).__name__}: {err!r} (original exception was "
+                "not picklable)"))
+
+    def unwrap(self) -> BaseException:
+        return pickle.loads(self.payload)
+
+
+def _resolve(template, ctx):
+    """Substitute ChanArg/InputArg placeholders (containers recursed)."""
+    if isinstance(template, ChanArg):
+        return ctx.chan_value(template)
+    if isinstance(template, InputArg):
+        v = ctx.input_value()
+        if isinstance(v, (DagStop, DagError)):
+            return v
+        if template.key is None:
+            return v
+        if isinstance(template.key, str) and not isinstance(v, dict):
+            return getattr(v, template.key)
+        return v[template.key]
+    if isinstance(template, list):
+        return [_resolve(t, ctx) for t in template]
+    if isinstance(template, tuple):
+        return tuple(_resolve(t, ctx) for t in template)
+    if isinstance(template, dict):
+        return {k: _resolve(t, ctx) for k, t in template.items()}
+    return template
+
+
+def _scan_control(value, found):
+    if isinstance(value, (DagStop, DagError)):
+        found.append(value)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _scan_control(v, found)
+    elif isinstance(value, dict):
+        for v in value.values():
+            _scan_control(v, found)
+
+
+class _IterCtx:
+    """One iteration's lazily-read channel values (each channel read at
+    most once per iteration; local step results short-circuit reads)."""
+
+    _MISSING = object()
+
+    def __init__(self, loop: "_DagLoop"):
+        self._loop = loop
+        self._vals: dict[int, object] = {}
+        self._input: object = self._MISSING
+
+    def set_local(self, node: int, value) -> None:
+        self._vals[node] = value
+
+    def chan_value(self, arg: ChanArg):
+        if arg.node not in self._vals:
+            ch = self._loop.reader(arg.channel)
+            self._vals[arg.node] = ch.read(timeout=None)
+        return self._vals[arg.node]
+
+    def input_value(self):
+        if self._input is self._MISSING:
+            ch = self._loop.reader(self._loop.input_channel)
+            self._input = ch.read(timeout=None)
+        return self._input
+
+
+class _DagLoop:
+    def __init__(self, instance, plan: dict):
+        self.instance = instance
+        self.plan = plan
+        self.input_channel: str | None = plan.get("input_channel")
+        self._readers: dict[str, Channel] = {}
+        self._writers: dict[str, Channel] = {}
+
+    def reader(self, name: str) -> Channel:
+        ch = self._readers.get(name)
+        if ch is None:
+            ch = self._readers[name] = Channel.open(name)
+        return ch
+
+    def writer(self, name: str) -> Channel:
+        ch = self._writers.get(name)
+        if ch is None:
+            ch = self._writers[name] = Channel.open(name)
+        return ch
+
+    def run(self) -> int:
+        iters = 0
+        try:
+            while self._run_one():
+                iters += 1
+        finally:
+            for ch in (*self._readers.values(), *self._writers.values()):
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+        return iters
+
+    def _run_one(self) -> bool:
+        ctx = _IterCtx(self)
+        stop = False
+        for step in self.plan["steps"]:
+            args = _resolve(step["args"], ctx)
+            kwargs = _resolve(step["kwargs"], ctx)
+            control: list = []
+            _scan_control(args, control)
+            _scan_control(kwargs, control)
+            stops = [c for c in control if isinstance(c, DagStop)]
+            errs = [c for c in control if isinstance(c, DagError)]
+            if stops:
+                out = stops[0]
+                stop = True
+            elif errs:
+                out = errs[0]
+            else:
+                try:
+                    out = getattr(self.instance, step["method"])(
+                        *args, **kwargs)
+                except BaseException as e:  # noqa: BLE001
+                    out = DagError(e)
+            ctx.set_local(step["node"], out)
+            if step["out"] is not None:
+                try:
+                    self.writer(step["out"]).write(out, timeout=None)
+                except Exception as e:  # noqa: BLE001
+                    # Value didn't fit / channel trouble: the channel is
+                    # still seq-consistent (write validates size before
+                    # mutating), so forward an in-band error instead of
+                    # killing the loop and wedging the whole DAG.
+                    err = DagError(e)
+                    ctx.set_local(step["node"], err)
+                    self.writer(step["out"]).write(err, timeout=None)
+        if stop:
+            # Channels this actor writes but whose steps ran BEFORE the
+            # stop was observed already carry a value this iteration;
+            # every written channel stays seq-aligned either way because
+            # steps run in plan order and forward the sentinel.
+            return False
+        return True
+
+
+def run_dag_loop(instance, plan: dict) -> int:
+    """Shipped via ``__ray_call__`` at experimental_compile; returns the
+    number of completed (non-stop) iterations."""
+    return _DagLoop(instance, plan).run()

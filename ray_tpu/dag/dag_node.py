@@ -67,9 +67,10 @@ class DAGNode:
         memo: dict[int, Any] = {}
         return _resolve(self, memo, input_args, input_kwargs)
 
-    def experimental_compile(self) -> "CompiledDAG":
+    def experimental_compile(self, _buffer_size_bytes: int = 1 << 20,
+                             ) -> "CompiledDAG":
         """ray: dag_node.py:129 experimental_compile."""
-        return CompiledDAG(self)
+        return CompiledDAG(self, buffer_size_bytes=_buffer_size_bytes)
 
     # -- sugar ------------------------------------------------------------
     def __getattr__(self, name: str):
@@ -86,6 +87,20 @@ def _resolve(node, memo: dict, input_args: tuple, input_kwargs: dict):
         input_args, input_kwargs)
     memo[id(node)] = value
     return value
+
+
+def _pack_input(input_args: tuple, input_kwargs: dict):
+    """DAG input semantics, shared by interpreted and channel-compiled
+    execution: positional XOR keyword; one positional passes through."""
+    if input_args and input_kwargs:
+        raise ValueError(
+            "dag.execute() takes positional OR keyword inputs, not "
+            "both (ray: InputNode mixed-input restriction)")
+    if input_kwargs:
+        return input_kwargs
+    if len(input_args) == 1:
+        return input_args[0]
+    return input_args
 
 
 class InputNode(DAGNode):
@@ -107,15 +122,7 @@ class InputNode(DAGNode):
         return InputAttributeNode(self, name)
 
     def _execute_impl(self, resolve, input_args, input_kwargs):
-        if input_args and input_kwargs:
-            raise ValueError(
-                "dag.execute() takes positional OR keyword inputs, not "
-                "both (ray: InputNode mixed-input restriction)")
-        if input_kwargs:
-            return input_kwargs
-        if len(input_args) == 1:
-            return input_args[0]
-        return input_args
+        return _pack_input(input_args, input_kwargs)
 
     def __repr__(self):
         return "InputNode()"
@@ -194,19 +201,84 @@ class MultiOutputNode(DAGNode):
         return f"MultiOutputNode(n={len(self._outputs)})"
 
 
-class CompiledDAG:
-    """Pre-scheduled DAG: topological order computed once
-    (ray: compiled_dag_node.py:479 CompiledDAG).
+class CompiledDAGRef:
+    """Handle to one compiled execution's output (ray:
+    compiled_dag_node.py CompiledDAGRef — ray.get()-able; here a .get()
+    method reading the DAG's output channels for this iteration)."""
 
-    `execute(value)` submits every stage in schedule order; stage N's
-    submission carries stage N-1's ObjectRef so workers stream results
-    worker→worker without the driver in the loop.  teardown() is a no-op
-    provided for API parity (the reference frees NCCL channels there).
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value: Any = None
+        self._read = False
+
+    def get(self, timeout: float | None = None):
+        self._ensure_read(timeout)
+        from ray_tpu.dag._channel_exec import DagError
+
+        if isinstance(self._value, DagError):
+            raise self._value.unwrap()
+        if isinstance(self._value, list):
+            for v in self._value:
+                if isinstance(v, DagError):
+                    raise v.unwrap()
+        return self._value
+
+    def _ensure_read(self, timeout: float | None = None) -> None:
+        """Consume this iteration's output channel values (exactly once;
+        later get() calls return the cache).  The driver MUST consume
+        iteration k before the channels can carry iteration k+1 — the
+        depth-1 backpressure of the mutable-channel design — so
+        execute() force-reads any outstanding ref."""
+        if self._read:
+            return
+        try:
+            vals = [ch.read(timeout=timeout)
+                    for ch in self._dag._out_readers]
+        except TimeoutError:
+            # Surface a dead execution loop's real error over an opaque
+            # channel timeout (a crashed loop resolves its call ref).
+            import ray_tpu
+            from ray_tpu.exceptions import GetTimeoutError
+
+            try:
+                ray_tpu.get(self._dag._loop_refs, timeout=0.2)
+            except GetTimeoutError:
+                pass
+            raise GetTimeoutError(
+                f"compiled DAG produced no output for iteration "
+                f"{self._seq} within {timeout}s") from None
+        self._value = vals if self._dag._multi_output else vals[0]
+        self._read = True
+
+    def __repr__(self):
+        return f"CompiledDAGRef(seq={self._seq})"
+
+
+class CompiledDAG:
+    """Channel-compiled DAG (ray: compiled_dag_node.py:479 CompiledDAG).
+
+    Compilation pre-allocates one mutable shm channel per DAG edge
+    (`experimental.Channel` — in-place rewrite, exactly-once reader
+    acks) and starts a persistent execution loop on every participating
+    actor (the reserved `__ray_dag_loop__` actor call; see
+    dag/_channel_exec.py).  `execute(value)` then writes the input
+    channel and returns a CompiledDAGRef reading the output channels:
+    ZERO per-call task submissions or RPCs — the reference's
+    accelerated-DAG property.
+
+    Graphs that contain non-actor nodes (fn.bind tasks) or nodes not
+    driven by the InputNode fall back to the pre-resolved topological
+    schedule submitting ordinary tasks per call (the round-2 behavior).
     """
 
-    def __init__(self, root: DAGNode):
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 1 << 20):
         self._root = root
+        self._buffer_size = buffer_size_bytes
         self._schedule: list[DAGNode] = []
+        self._torn_down = False
+        self._outstanding: CompiledDAGRef | None = None
+        self._seq = 0
         seen: set[int] = set()
 
         def topo(n: DAGNode):
@@ -218,12 +290,216 @@ class CompiledDAG:
             self._schedule.append(n)
         topo(root)
 
+        self._channel_mode = self._try_compile_channels()
+
+    # ---------------------------------------------------- channel compile
+    def _try_compile_channels(self) -> bool:
+        from ray_tpu.dag._channel_exec import (ChanArg, InputArg,
+                                               LOOP_METHOD)
+        from ray_tpu.experimental.channel import Channel
+
+        leaves = (self._root._outputs if isinstance(self._root,
+                                                    MultiOutputNode)
+                  else [self._root])
+        self._multi_output = isinstance(self._root, MultiOutputNode)
+        compute = [n for n in self._schedule
+                   if isinstance(n, ClassMethodNode)]
+        # Every non-structural node must be an actor method call driven
+        # (transitively) by the InputNode; anything else → legacy path.
+        for n in self._schedule:
+            if not isinstance(n, (ClassMethodNode, InputNode,
+                                  InputAttributeNode, MultiOutputNode)):
+                return False
+        if not compute or any(not isinstance(l, ClassMethodNode)
+                              for l in leaves):
+            return False
+        reaches_input: set[int] = set()
+
+        def _from_input(n: DAGNode) -> bool:
+            if id(n) in reaches_input:
+                return True
+            if isinstance(n, (InputNode, InputAttributeNode)):
+                reaches_input.add(id(n))
+                return True
+            if any(_from_input(c) for c in n._children()):
+                reaches_input.add(id(n))
+                return True
+            return False
+
+        if not all(_from_input(n) for n in compute):
+            return False
+
+        import os
+
+        dag_tag = f"dag{os.urandom(4).hex()}"
+        node_ids = {id(n): i for i, n in enumerate(self._schedule)}
+        actor_of = {}      # node -> actor_id
+        for n in compute:
+            actor_of[id(n)] = n._method._handle._actor_id
+
+        # Channel per produced edge: node → consumers (other-actor steps
+        # and/or the driver for output leaves).  Same-actor consumers use
+        # the loop-local value, no channel.
+        consumers: dict[int, set[str]] = {i: set() for i in actor_of}
+        driver_reads: set[int] = set()
+        input_readers: set[str] = set()
+        for n in compute:
+            nid = id(n)
+            for a in n._flat_args():
+                found: list[DAGNode] = []
+                _scan(a, found)
+                for dep in found:
+                    if isinstance(dep, (InputNode, InputAttributeNode)):
+                        input_readers.add(actor_of[nid])
+                    elif isinstance(dep, ClassMethodNode):
+                        if actor_of[id(dep)] != actor_of[nid]:
+                            consumers[id(dep)].add(actor_of[nid])
+        for l in leaves:
+            driver_reads.add(id(l))
+
+        chan_name: dict[int, str] = {}
+        self._channels: list[str] = []
+        # Created handles MUST stay alive: a creator handle unlinks its
+        # segment when garbage-collected (Channel.close on _created).
+        created: dict[str, Channel] = {}
+        for n in compute:
+            nid = id(n)
+            n_read = len(consumers[nid]) + (1 if nid in driver_reads
+                                            else 0)
+            if n_read == 0:
+                continue
+            name = f"{dag_tag}_n{node_ids[nid]}"
+            created[name] = Channel.create(
+                name, max_size=self._buffer_size, n_readers=n_read)
+            chan_name[nid] = name
+            self._channels.append(name)
+        self._input_chan_name = f"{dag_tag}_input"
+        if not input_readers:
+            for ch in created.values():
+                ch.close()
+            return False
+        created[self._input_chan_name] = Channel.create(
+            self._input_chan_name, max_size=self._buffer_size,
+            n_readers=len(input_readers))
+        self._channels.append(self._input_chan_name)
+        self._created_handles = created
+
+        def template(v):
+            if isinstance(v, (InputNode, InputAttributeNode)):
+                key = v._key if isinstance(v, InputAttributeNode) else None
+                return InputArg(key)
+            if isinstance(v, ClassMethodNode):
+                nid = id(v)
+                return ChanArg(node_ids[nid], chan_name.get(nid, ""))
+            if isinstance(v, list):
+                return [template(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(template(x) for x in v)
+            if isinstance(v, dict):
+                return {k: template(x) for k, x in v.items()}
+            return v
+
+        # Per-actor plans, steps in global topo order.
+        plans: dict[str, dict] = {}
+        for n in compute:
+            nid = id(n)
+            aid = actor_of[nid]
+            plan = plans.setdefault(
+                aid, {"steps": [], "input_channel": self._input_chan_name})
+            plan["steps"].append({
+                "node": node_ids[nid],
+                "method": n._method._name,
+                "args": template(n._bound_args),
+                "kwargs": {k: template(v)
+                           for k, v in n._bound_kwargs.items()},
+                "out": chan_name.get(nid),
+            })
+
+        # ChanArg templates for same-actor deps carry "" channels — the
+        # loop resolves those from its local per-iteration results, so
+        # patch only cross-actor reads with real names.  (A same-actor
+        # dep that ALSO has a channel — e.g. driver-read leaf feeding a
+        # same-actor step — resolves locally too: set_local runs first.)
+
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.dag._channel_exec import run_dag_loop
+
+        core = global_worker()
+        self._loop_refs = []
+        for aid, plan in plans.items():
+            [ref] = core.submit_actor_task(
+                aid, LOOP_METHOD, (run_dag_loop, plan), {},
+                {"num_returns": 1})
+            self._loop_refs.append(ref)
+        # The driver reads leaf channels / writes the input channel with
+        # the creator handles themselves (one reader slot per handle).
+        self._out_readers = [created[chan_name[id(l)]] for l in leaves]
+        self._input_writer = created[self._input_chan_name]
+        return True
+
+    # ------------------------------------------------------------ execute
     def execute(self, *input_args, **input_kwargs):
-        memo: dict[int, Any] = {}
-        out = None
-        for node in self._schedule:
-            out = _resolve(node, memo, input_args, input_kwargs)
-        return out
+        if not self._channel_mode:
+            memo: dict[int, Any] = {}
+            out = None
+            for node in self._schedule:
+                out = _resolve(node, memo, input_args, input_kwargs)
+            return out
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG was torn down")
+        if self._outstanding is not None:
+            self._outstanding._ensure_read()
+        value = _pack_input(input_args, input_kwargs)
+        self._input_writer.write(value, timeout=30.0)
+        self._seq += 1
+        ref = CompiledDAGRef(self, self._seq)
+        self._outstanding = ref
+        return ref
 
     def teardown(self) -> None:
+        if not self._channel_mode or self._torn_down:
+            return None
+        from ray_tpu.dag._channel_exec import DagStop
+        from ray_tpu.experimental.channel import Channel
+
+        self._torn_down = True
+        try:
+            if self._outstanding is not None:
+                try:
+                    self._outstanding._ensure_read(timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                # Best-effort like everything else here: a dead actor
+                # never acks the input channel.
+                self._input_writer.write(DagStop(), timeout=5.0)
+            except Exception:  # noqa: BLE001
+                pass
+            # Consume the sentinel wave so the final writes are acked and
+            # the loops' replies (iteration counts) resolve.
+            for ch in self._out_readers:
+                try:
+                    ch.read(timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+            import ray_tpu
+
+            try:
+                ray_tpu.get(self._loop_refs, timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            for ch in self._created_handles.values():
+                try:
+                    ch.close()   # creator close() unlinks the segment
+                except Exception:  # noqa: BLE001
+                    pass
+            for name in self._channels:
+                Channel.destroy(name)
         return None
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

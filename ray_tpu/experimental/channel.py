@@ -23,8 +23,10 @@ Protocol (single writer, up to 64 registered readers, same host):
     ack >= current seq (so nobody is still copying), then rewrite the
     payload in place, publish length, bump write_seq.
 
-The waits are micro-sleep polls (same-host latency; the reference uses
-named semaphores for the same role).
+The waits are adaptive polls (brief check-spin → sched_yield → 50µs
+sleeps; the reference uses named semaphores for the same role — the
+yield phase gives the peer process the core on small hosts while
+keeping reaction time in the tens of microseconds).
 """
 from __future__ import annotations
 
@@ -38,6 +40,25 @@ import time
 _FIXED = struct.Struct("<QQQQ")    # write_seq, len, n_readers, claimed
 _SHM_DIR = "/dev/shm"
 MAX_READERS = 64
+
+
+class _Waiter:
+    """Adaptive wait: a few raw re-checks, then sched_yield (lets the
+    peer run on shared cores with ~µs turnaround), then 50µs sleeps."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def pause(self) -> None:
+        self.n += 1
+        if self.n <= 8:
+            return
+        if self.n <= 512:
+            os.sched_yield()
+            return
+        time.sleep(0.00005)
 
 
 class ChannelError(RuntimeError):
@@ -189,6 +210,7 @@ class Channel:
                 f"{self.max_size}B")
         deadline = None if timeout is None else time.monotonic() + timeout
         full_mask = None
+        waiter = _Waiter()
         while True:
             seq, _len, n_readers, claimed = self._hdr()
             if full_mask is None:
@@ -205,7 +227,7 @@ class Channel:
                 raise TimeoutError(
                     f"channel {self.name}: waiting on readers "
                     f"(claimed={claimed:b}/{full_mask:b}, seq={seq})")
-            time.sleep(0.0002)
+            waiter.pause()
         off = self._payload_off(n_readers)
         self._mm[off:off + len(payload)] = payload
         struct.pack_into("<Q", self._mm, 8, len(payload))   # length first
@@ -219,6 +241,7 @@ class Channel:
         if self._slot is None:
             self._slot = self._claim_slot()
         deadline = None if timeout is None else time.monotonic() + timeout
+        waiter = _Waiter()
         while True:
             seq, length, n_readers, _claimed = self._hdr()
             if seq > self._last_read_seq:
@@ -227,7 +250,7 @@ class Channel:
                 raise TimeoutError(
                     f"channel {self.name}: no write past seq "
                     f"{self._last_read_seq}")
-            time.sleep(0.0002)
+            waiter.pause()
         off = self._payload_off(n_readers)
         value = pickle.loads(bytes(self._mm[off:off + length]))
         self._last_read_seq = seq
