@@ -376,6 +376,39 @@ def _with_timeout(fn, seconds: int):
         signal.signal(signal.SIGALRM, old)
 
 
+def _vs_previous_round(extra: dict) -> dict:
+    """Regression guard: compare this run's control-plane rows against the
+    newest BENCH_r*.json (driver-recorded).  Any higher-is-better metric
+    below 0.7x its previous value is flagged — the round-2 lesson
+    (get_small fell 5x while attention was on puts) was that silent
+    regressions survive a round unnoticed."""
+    import glob
+    import os
+
+    benches = sorted(glob.glob(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_r*.json")))
+    if not benches:
+        return {}
+    try:
+        with open(benches[-1]) as f:
+            doc = json.load(f)
+    except Exception:  # noqa: BLE001
+        return {}
+    # Driver files wrap the bench line as {"parsed": {...}}.
+    prev = doc.get("parsed", doc) if isinstance(doc, dict) else {}
+    prev_extra = prev.get("extra", prev) if isinstance(prev, dict) else {}
+    out = {}
+    for key, val in extra.items():
+        pv = prev_extra.get(key)
+        if (isinstance(val, (int, float)) and isinstance(pv, (int, float))
+                and pv > 0 and key.endswith(("_per_s", "_gib_per_s"))
+                and val < 0.7 * pv):
+            out[key] = {"prev": pv, "now": round(val, 1),
+                        "ratio": round(val / pv, 3)}
+    return out
+
+
 def main() -> None:
     extra = {}
     try:
@@ -397,6 +430,9 @@ def main() -> None:
         extra["serve_bench"] = _with_timeout(bench_serve_llm, 600)
     except Exception as e:  # noqa: BLE001
         extra["serve_bench"] = {"error": repr(e)}
+    regressions = _vs_previous_round(extra)
+    if regressions:
+        extra["regressions_vs_prev_round"] = regressions
     print(json.dumps({
         "metric": "single_client_tasks_async",
         "value": value,

@@ -263,11 +263,21 @@ void* rt_store_create(const char* name, uint64_t capacity) {
     if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
     total = static_cast<uint64_t>(st.st_size);
   }
-  // MAP_POPULATE pre-faults the whole arena at create time: without it the
-  // first large write eats one page fault per 4K page (~4x bandwidth loss).
+  // Write-prefault every page once at map time: lazy faulting costs
+  // ~1 GiB/s on the first bulk write vs ~7.5 GiB/s warm.  One pass
+  // only — MADV_POPULATE_WRITE where available (write-faults), else
+  // MAP_POPULATE (read-faults; write-protect faults remain but are
+  // cheaper than cold ones).
+#ifdef MADV_POPULATE_WRITE
   void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
-                   MAP_SHARED | (created ? MAP_POPULATE : 0), fd, 0);
+                   MAP_SHARED, fd, 0);
   if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  madvise(mem, total, MADV_POPULATE_WRITE);
+#else
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+#endif
   Handle* h = new Handle;
   h->base = static_cast<uint8_t*>(mem);
   h->hdr = reinterpret_cast<ArenaHeader*>(mem);
@@ -308,12 +318,19 @@ void* rt_store_open(const char* name) {
   if (fd < 0) return nullptr;
   struct stat st;
   if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
-  // No MAP_POPULATE here: the creator already faulted the pages in, so
-  // opener accesses are cheap minor faults — a full pre-population would
-  // stall every worker's first store access for the whole arena size.
+  // Write-prefault (see rt_store_create): opens are lazy (first arena
+  // use), so the one-time cost sits off the put/get hot path.
+#ifdef MADV_POPULATE_WRITE
   void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  madvise(mem, static_cast<size_t>(st.st_size), MADV_POPULATE_WRITE);
+#else
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED | MAP_POPULATE,
+                   fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+#endif
   Handle* h = new Handle;
   h->base = static_cast<uint8_t*>(mem);
   h->hdr = reinterpret_cast<ArenaHeader*>(mem);

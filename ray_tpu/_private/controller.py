@@ -715,6 +715,9 @@ def main() -> None:
     from ray_tpu._private.stack_dump import install as _install_stack
 
     _install_stack('controller')
+    from ray_tpu._private.config import tune_gc
+
+    tune_gc()
     import argparse
     import json as _json
     import sys

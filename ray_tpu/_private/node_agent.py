@@ -767,6 +767,9 @@ def main() -> None:
     from ray_tpu._private.stack_dump import install as _install_stack
 
     _install_stack('agent')
+    from ray_tpu._private.config import tune_gc
+
+    tune_gc()
     import argparse
     import json as _json
     import signal

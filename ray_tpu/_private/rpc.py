@@ -60,6 +60,23 @@ class ConnectionLost(RpcError):
     pass
 
 
+
+# pyzmq copy=False routes every frame through the zero-copy tracker
+# (pyzmq docs: higher per-message cost below ~64KB than just copying);
+# only large payloads are worth the tracker.  Choose per message.
+_ZC_MIN = 1 << 16
+
+
+def _send_flags(frames) -> bool:
+    """True => copy the frames (small message); False => zero-copy."""
+    total = 0
+    for f in frames:
+        total += len(f)
+        if total >= _ZC_MIN:
+            return False
+    return True
+
+
 class RpcServer:
     """ROUTER-socket server dispatching to registered async handlers."""
 
@@ -96,17 +113,20 @@ class RpcServer:
     async def _serve(self) -> None:
         while not self._closed:
             try:
-                frames = await self._sock.recv_multipart(copy=False)
+                # copy=True: Frame-object + tracker overhead exceeds the
+                # memcpy below ~64KB, and every consumer wants bytes anyway
+                # (the old copy=False path paid BOTH via .bytes).
+                frames = await self._sock.recv_multipart()
             except (asyncio.CancelledError, zmq.ZMQError):
                 return
             asyncio.get_running_loop().create_task(self._dispatch(frames))
 
     async def _dispatch(self, frames) -> None:
-        identity = frames[0].bytes
+        identity = frames[0]
         msgid, method = 0, "?"
         try:
-            msgid, method, header = msgpack.unpackb(frames[1].bytes, raw=False)
-            blobs = [f.bytes for f in frames[2:]]
+            msgid, method, header = msgpack.unpackb(frames[1], raw=False)
+            blobs = frames[2:]
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
@@ -119,9 +139,8 @@ class RpcServer:
                 rh, rb = result
             else:
                 rh, rb = result, []
-            await self._sock.send_multipart(
-                [identity, msgpack.packb([msgid, True, rh]), *rb],
-                copy=False)
+            out = [identity, msgpack.packb([msgid, True, rh]), *rb]
+            await self._sock.send_multipart(out, copy=_send_flags(out))
         except Exception as e:  # noqa: BLE001 - errors cross the wire
             if msgid == 0:
                 logger.exception("one-way handler %s failed", method)
@@ -160,18 +179,17 @@ class RpcClient:
     async def _recv_loop(self) -> None:
         while not self._closed:
             try:
-                frames = await self._sock.recv_multipart(copy=False)
+                frames = await self._sock.recv_multipart()
             except (asyncio.CancelledError, zmq.ZMQError):
                 break
-            msgid, ok, header = msgpack.unpackb(frames[0].bytes, raw=False)
+            msgid, ok, header = msgpack.unpackb(frames[0], raw=False)
             fut = self._pending.pop(msgid, None)
             if fut is None or fut.done():
                 continue
             if ok:
-                fut.set_result((header or {},
-                                [f.bytes for f in frames[1:]]))
+                fut.set_result((header or {}, frames[1:]))
             else:
-                exc, tb = pickle.loads(frames[1].bytes)
+                exc, tb = pickle.loads(frames[1])
                 fut.set_exception(RemoteError(getattr(fut, "_method", "?"), exc))
         for fut in self._pending.values():
             if not fut.done():
@@ -192,10 +210,8 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         fut._method = method
         self._pending[msgid] = fut
-        await self._sock.send_multipart(
-            [msgpack.packb([msgid, method, header]), *(blobs or [])],
-            copy=False,
-        )
+        out = [msgpack.packb([msgid, method, header]), *(blobs or [])]
+        await self._sock.send_multipart(out, copy=_send_flags(out))
         if timeout is None:
             return await fut
         try:
@@ -205,10 +221,8 @@ class RpcClient:
 
     async def notify(self, method: str, header: dict | None = None,
                      blobs: Blobs | None = None) -> None:
-        await self._sock.send_multipart(
-            [msgpack.packb([0, method, header]), *(blobs or [])],
-            copy=False,
-        )
+        out = [msgpack.packb([0, method, header]), *(blobs or [])]
+        await self._sock.send_multipart(out, copy=_send_flags(out))
 
     def close(self) -> None:
         self._closed = True

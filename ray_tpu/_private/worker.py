@@ -544,6 +544,7 @@ class CoreWorker:
         self.store_name: str = os.environ.get("RAY_TPU_STORE_NAME", "")
         self._arena = None
         self._arena_tried = False
+        self._arena_lock = threading.Lock()
         self.loop: asyncio.AbstractEventLoop = None  # set in start()
         self._default_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
@@ -565,6 +566,16 @@ class CoreWorker:
         if self.loop is None:
             raise RuntimeError("IO loop failed to start")
         set_release_hook(self._release_local_ref)
+        from ray_tpu._private.config import tune_gc
+
+        tune_gc(framework_process=(self.mode != "driver"))
+        if self.store_name:
+            # Map + write-prefault the arena off the hot path: the lazy
+            # first-use open costs ~250ms for a 512MB arena
+            # (MADV_POPULATE_WRITE), which would land inside the first
+            # big put otherwise.
+            threading.Thread(target=self.local_arena, daemon=True,
+                             name="raytpu-arena-warm").start()
 
     def _io_main(self, started: threading.Event) -> None:
         asyncio.run(self._io_async_main(started))
@@ -1316,16 +1327,21 @@ class CoreWorker:
     # ------------------------------------------------------------- get/put
     def local_arena(self):
         """The mmap'd local node store, or None (dict backend / remote
-        agent / native build unavailable)."""
+        agent / native build unavailable).  Serialized: the startup
+        warm thread and the first put/get race here, and a half-open
+        arena must never be visible (a losing racer would silently take
+        the agent-RPC slow path)."""
         if not self._arena_tried:
-            self._arena_tried = True
-            if self.store_name:
-                try:
-                    from ray_tpu._private.native_store import Arena
+            with self._arena_lock:
+                if not self._arena_tried:
+                    if self.store_name:
+                        try:
+                            from ray_tpu._private.native_store import Arena
 
-                    self._arena = Arena(self.store_name)
-                except Exception:  # noqa: BLE001 - fall back to agent RPC
-                    self._arena = None
+                            self._arena = Arena(self.store_name)
+                        except Exception:  # noqa: BLE001 - RPC fallback
+                            self._arena = None
+                    self._arena_tried = True
         return self._arena
 
     def _store_frames_local(self, oid: bytes, frames: list) -> bool:
@@ -2319,6 +2335,19 @@ class CoreWorker:
             self._evict_untracked_args(h)
 
     async def rpc_actor_call(self, h: dict, blobs: list) -> tuple[dict, list]:
+        inst = self.actors_hosted.get(h.get("actor_id", ""))
+        if inst is not None and self._actor_batch_simple(inst, [h]):
+            # Lone simple call: the same one-executor-hop treatment the
+            # batch fast path gets (deserialize→run→serialize in the
+            # thread) — this is the sync actor-call latency path, which
+            # otherwise pays 3 thread round-trips per call.  Delegate to
+            # the batch implementation (ONE copy of the seqno-advance /
+            # successor-wake / execute protocol) and unwrap.
+            reply, out_blobs = await self._actor_batch_fast(
+                inst, [{**h, "nframes": len(blobs)}], blobs)
+            single = reply["replies"][0]
+            single.pop("nblobs", None)
+            return single, out_blobs
         started = await self._actor_call_begin(h, blobs)
         return await started
 

@@ -135,6 +135,13 @@ def init(address: str | None = None,
             core.store_name = areply.get("store_name", "")
         except Exception:  # noqa: BLE001 - agent RPC fallback still works
             pass
+        if core.store_name:
+            # Map + write-prefault off the hot path (see CoreWorker.start;
+            # the driver only learns the store name here).
+            import threading
+
+            threading.Thread(target=core.local_arena, daemon=True,
+                             name="raytpu-arena-warm").start()
     # Fetch pub address + register the job.
     reply, _ = core.call(controller_addr, "ping", {}, timeout=30.0)
     if reply.get("pub_addr"):

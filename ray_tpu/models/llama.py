@@ -467,6 +467,93 @@ def decode_step_unrolled(params: dict, cache: dict, tokens: jnp.ndarray,
     return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
 
 
+def init_paged_kv_cache(cfg: LlamaConfig, batch: int, n_pages: int,
+                        page: int) -> dict:
+    """Shared page-pool KV cache (ops/paged_attention.py): per-layer
+    [n_pages, kvh, page, hd] leaves instead of dense per-slot windows.
+    Page 0 is the TRASH page — inactive slots' table rows point at it,
+    so their (ignored) decode writes land somewhere harmless.  HBM cost
+    scales with the page budget, not max_len x slots — the long-context
+    serving enabler (SURVEY §7 "bucketed shapes/paged KV via Pallas").
+    Layout is kv-head major (contiguous per-head page rows in VMEM)."""
+    shape = (n_pages, cfg.n_kv_heads, page, cfg.head_dim)
+    return {"k": [jnp.zeros(shape, cfg.dtype)
+                  for _ in range(cfg.n_layers)],
+            "v": [jnp.zeros(shape, cfg.dtype)
+                  for _ in range(cfg.n_layers)],
+            "pos": jnp.zeros((batch,), jnp.int32)}
+
+
+def scatter_prefill_pages(cache: dict, ks, vs, page_ids: jnp.ndarray,
+                          rows: jnp.ndarray, slots: jnp.ndarray,
+                          true_lens: jnp.ndarray) -> dict:
+    """Write a prefill wave's K/V into the page pool.
+
+    ks/vs: [L, W, P, kvh, hd] from prefill(); page_ids/rows: [W, P]
+    (page id + in-page row per token position; positions past a slot's
+    allocation point at the trash page).  Returns the updated cache.
+    Duplicate wave-padding rows write identical data, so scatter order
+    is irrelevant (same rule as the dense _prefill_wave).  The [W, P]
+    advanced indices straddle the pool's kvh axis, so numpy semantics
+    put them first — the value shape is exactly ks[li]'s [W,P,kvh,hd]."""
+    k = [cache["k"][li].at[page_ids, :, rows].set(ks[li])
+         for li in range(len(cache["k"]))]
+    v = [cache["v"][li].at[page_ids, :, rows].set(vs[li])
+         for li in range(len(cache["v"]))]
+    pos = cache["pos"].at[slots].set(true_lens)
+    return {"k": k, "v": v, "pos": pos}
+
+
+def decode_step_paged(params: dict, pages: dict, tails: dict,
+                      tokens: jnp.ndarray, pos: jnp.ndarray,
+                      tail_start: jnp.ndarray, j, page_table: jnp.ndarray,
+                      cfg: LlamaConfig) -> tuple[jnp.ndarray, dict]:
+    """One decode step over the paged cache + in-block tail.
+
+    pages {"k"/"v": [L x [n_pages, kvh, page, hd]]} are READ-ONLY here
+    (loop-invariant for the whole K-step block — any per-step write of
+    a scan-carried pool copies the entire buffer; see
+    ops/paged_attention.py).  New K/V rows land in tails
+    {"k"/"v": [L x [B, kvh, kt, hd]]} at the shared in-block column
+    `j` (a scalar: every slot's pos advances in lockstep, so
+    pos - tail_start is uniform).  After the block, the engine merges
+    tails into pages with ops.paged_attention.merge_tail_pages."""
+    from ray_tpu.ops.paged_attention import paged_decode_attention
+
+    b = tokens.shape[0]
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = embed_lookup(params["embed"], tokens[:, None], cfg.dtype)
+    # RoPE table covers the PAGED window (maxp * page), which may exceed
+    # cfg.max_seq — long-context serving is the point of this path.
+    max_len = page_table.shape[1] * pages["k"][0].shape[2]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len, cfg.rope_theta)
+
+    new_tk, new_tv = [], []
+    for lid in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[lid], params["layers"])
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=pos[:, None])
+        k = apply_rope(k, cos, sin, positions=pos[:, None])
+        qg = q.reshape(b, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        kn = k[:, 0].astype(cfg.dtype)[:, :, None, :]   # [B, kvh, 1, hd]
+        vn = v[:, 0].astype(cfg.dtype)[:, :, None, :]
+        tk = lax.dynamic_update_slice(tails["k"][lid], kn, (0, 0, j, 0))
+        tv = lax.dynamic_update_slice(tails["v"][lid], vn, (0, 0, j, 0))
+        o = paged_decode_attention(
+            qg.astype(cfg.dtype), pages["k"][lid], pages["v"][lid],
+            tk, tv, page_table, pos, tail_start)
+        new_tk.append(tk)
+        new_tv.append(tv)
+        x = x + (o.reshape(b, 1, cfg.n_heads * cfg.head_dim) @ lp["wo"])
+        x = _mlp_block(x, lp, cfg)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_tk, "v": new_tv}
+
+
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),

@@ -56,6 +56,8 @@ class _Request:
     # Optional thread-safe sink for token streaming: every decoded token
     # is pushed as produced; None marks end-of-stream.
     token_queue: Any = None
+    # KV pages owned by this request (paged engine); freed at finish.
+    pages: list[int] = field(default_factory=list)
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -67,7 +69,8 @@ class LLMEngine:
 
     def __init__(self, cfg, params=None, *, max_batch: int = 8,
                  max_len: int | None = None, seed: int = 0,
-                 steps_per_sync: int = 8):
+                 steps_per_sync: int = 8, paged: bool = True,
+                 page_size: int = 512, kv_pages: int | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -84,32 +87,89 @@ class LLMEngine:
         self.steps_per_sync = max(1, steps_per_sync)
         self.params = params if params is not None else llama.init_params(
             jax.random.PRNGKey(seed), cfg)
-        # Per-layer cache leaves: the stacked [L, ...] cache rode a
-        # lax.scan as xs/ys, which XLA cannot alias — every decode step
-        # copied the whole cache (llama.init_kv_cache_leaves).
-        self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
-                                                self.max_len)
+        self.paged = paged
+        if paged:
+            # Shared page pool (ops/paged_attention.py): HBM holds the
+            # page budget, NOT max_len x slots — max_len can be 32k+
+            # while the pool is sized to the expected live footprint.
+            # Page 0 is the trash page (idle slots point at it).
+            self.page = page_size
+            self._maxp = -(-self.max_len // page_size)
+            if kv_pages is None:
+                kv_pages = 1 + max_batch * (
+                    -(-min(self.max_len, 4096) // page_size))
+            self.n_pages = kv_pages
+            self.cache = llama.init_paged_kv_cache(cfg, max_batch,
+                                                   kv_pages, page_size)
+            self._free_pages = list(range(1, kv_pages))
+            self._table = np.zeros((max_batch, self._maxp), np.int32)
+        else:
+            # Dense per-layer cache leaves: the stacked [L, ...] cache
+            # rode a lax.scan as xs/ys, which XLA cannot alias — every
+            # decode step copied the whole cache.
+            self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
+                                                    self.max_len)
         self._buckets = _buckets_for(self.max_len)
         self._rng = jax.random.PRNGKey(seed + 1)
 
         # One compiled K-step decode program; cache donated (in-place).
-        def _decode_k(params, cache, tokens, temps, rng):
+        def _sample(logits, temps, key):
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key, logits / jnp.maximum(temps, 1e-6)[:, None]
+            ).astype(jnp.int32)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        def _decode_k_dense(params, cache, tokens, temps, rng, table):
             def step(carry, key):
                 cache, toks = carry
-                logits, cache = llama.decode_step_unrolled(params, cache,
-                                                           toks, cfg)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                sampled = jax.random.categorical(
-                    key, logits / jnp.maximum(temps, 1e-6)[:, None]
-                ).astype(jnp.int32)
-                nxt = jnp.where(temps > 0, sampled, greedy)
+                logits, cache = llama.decode_step_unrolled(
+                    params, cache, toks, cfg)
+                nxt = _sample(logits, temps, key)
                 return (cache, nxt), nxt
 
             keys = jax.random.split(rng, self.steps_per_sync)
             (cache, last), seq = jax.lax.scan(step, (cache, tokens), keys)
             return seq, last, cache   # seq [K, B]
 
-        self._decode = jax.jit(_decode_k, donate_argnums=(1,))
+        def _decode_k_paged(params, cache, tokens, temps, rng, table):
+            """Pages stay OUT of the scan carry (read-only during the
+            block; a carried write would copy the whole pool every
+            step); new rows ride a small dense tail, merged into the
+            pages once at block end (ops/paged_attention.py)."""
+            from ray_tpu.ops.paged_attention import merge_tail_pages
+
+            K = self.steps_per_sync
+            ts = cache["pos"]
+            pages = {"k": cache["k"], "v": cache["v"]}
+            tshape = (max_batch, cfg.n_kv_heads, K, cfg.head_dim)
+            tails = {"k": [jnp.zeros(tshape, cfg.dtype)
+                           for _ in range(cfg.n_layers)],
+                     "v": [jnp.zeros(tshape, cfg.dtype)
+                           for _ in range(cfg.n_layers)]}
+
+            def step(carry, xs):
+                tails, pos, toks = carry
+                key, j = xs
+                logits, tails = llama.decode_step_paged(
+                    params, pages, tails, toks, pos, ts, j, table, cfg)
+                nxt = _sample(logits, temps, key)
+                return (tails, pos + 1, nxt), nxt
+
+            keys = jax.random.split(rng, K)
+            (tails, pos, last), seq = jax.lax.scan(
+                step, (tails, ts, tokens), (keys, jnp.arange(K)))
+            new_k = [merge_tail_pages(pages["k"][li], tails["k"][li],
+                                      table, ts, K)
+                     for li in range(cfg.n_layers)]
+            new_v = [merge_tail_pages(pages["v"][li], tails["v"][li],
+                                      table, ts, K)
+                     for li in range(cfg.n_layers)]
+            return seq, last, {"k": new_k, "v": new_v, "pos": pos}
+
+        self._decode = jax.jit(
+            _decode_k_paged if paged else _decode_k_dense,
+            donate_argnums=(1,))
 
         # Wave prefill: ONE compiled program admits a whole wave of
         # requests — computes all their prompt KV and scatter-writes each
@@ -154,12 +214,42 @@ class LLMEngine:
 
         self._prefill = jax.jit(_prefill_wave, donate_argnums=(1,))
 
+        # Paged prefill: same wave semantics, but the prompt K/V scatters
+        # into page-pool leaves via (page_id, row) coordinates computed
+        # host-side from the slot page tables.
+        def _prefill_wave_paged(params, cache, tokens, true_lens, slots,
+                                temps, rng, page_ids, rows):
+            W = tokens.shape[0]
+            hidden, ks, vs = llama.prefill(params, tokens, cfg)
+            cache = llama.scatter_prefill_pages(cache, ks, vs, page_ids,
+                                                rows, slots, true_lens)
+            last_h = hidden[jnp.arange(W), true_lens - 1]
+            last = (last_h @ params["lm_head"]).astype(jnp.float32)
+            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            keys = jax.vmap(lambda s: jax.random.fold_in(rng, s))(slots)
+            sampled = jax.vmap(
+                lambda k_, l_, t_: jax.random.categorical(
+                    k_, l_ / jnp.maximum(t_, 1e-6)))(
+                        keys, last, temps).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return nxt, cache
+
+        self._prefill_paged = jax.jit(_prefill_wave_paged,
+                                      donate_argnums=(1,))
+
         # Slot state.  Current tokens live ON DEVICE between blocks: the
         # decode output feeds the next decode input directly, so the only
         # device→host sync per block is the token-sequence fetch.
         self._slots: list[_Request | None] = [None] * max_batch
         self._cur_dev = jnp.zeros((max_batch,), jnp.int32)
         self._temps = np.zeros((max_batch,), np.float32)
+        # Device copy of the page table, refreshed only when admission or
+        # completion changed it (dense mode passes a constant dummy).
+        self._table_dev = jnp.zeros((1, 1), jnp.int32)
+        self._table_dirty = paged
+        # FIFO backpressure slot: a request whose pages don't fit yet
+        # (re-admitted first, never skipped past).
+        self._head_of_line: _Request | None = None
         self._set_slots = jax.jit(
             lambda cur, slots, toks: cur.at[slots].set(toks))
         self._waiting: queue.Queue[_Request] = queue.Queue()
@@ -186,6 +276,13 @@ class LLMEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}; "
                 "decode past the cache end would corrupt output")
+        if self.paged:
+            need = -(-(len(prompt) + max_new_tokens) // self.page)
+            if need > self.n_pages - 1:
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool holds "
+                    f"{self.n_pages - 1}; raise kv_pages (admission "
+                    "would otherwise block forever)")
         if self._error is not None:
             raise RuntimeError(
                 "LLM engine is dead after an earlier failure") \
@@ -239,10 +336,28 @@ class LLMEngine:
                          if s is None), None)
             if free is None:
                 break
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
-                break
+            if self._head_of_line is not None:
+                req, self._head_of_line = self._head_of_line, None
+            else:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+            if self.paged:
+                # Allocate the request's full page span up front (prompt
+                # + max_new_tokens) — no mid-decode growth, and the pool
+                # is the admission control: FIFO blocks when it's dry
+                # (vLLM-style KV backpressure).
+                need = -(-(len(req.prompt) + req.max_new_tokens)
+                         // self.page)
+                if len(self._free_pages) < need:
+                    self._head_of_line = req
+                    break
+                req.pages = [self._free_pages.pop()
+                             for _ in range(need)]
+                self._table[free, :] = 0
+                self._table[free, :need] = req.pages
+                self._table_dirty = True
             req.slot = free
             self._slots[free] = req
             self._temps[free] = req.temperature
@@ -268,10 +383,20 @@ class LLMEngine:
             temps[j] = req.temperature
         self._rng, sub = jax.random.split(self._rng)
         slots_dev = jnp.asarray(slots)
-        nxt, self.cache = self._prefill(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(true_lens), slots_dev,
-            jnp.asarray(temps), sub)
+        if self.paged:
+            cols = np.arange(bucket) // self.page
+            page_ids = self._table[slots][:, cols]     # [padded_w, bkt]
+            rows = np.tile(np.arange(bucket, dtype=np.int32) % self.page,
+                           (padded_w, 1))
+            nxt, self.cache = self._prefill_paged(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(true_lens), slots_dev, jnp.asarray(temps),
+                sub, jnp.asarray(page_ids), jnp.asarray(rows))
+        else:
+            nxt, self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(true_lens), slots_dev,
+                jnp.asarray(temps), sub)
         # Duplicate padding rows target the same slot with the same token.
         self._cur_dev = self._set_slots(self._cur_dev, slots_dev, nxt)
         firsts = np.asarray(nxt)[:W]
@@ -292,6 +417,15 @@ class LLMEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self.completed += 1
+        if self.paged and req.pages:
+            # The freed slot's future (garbage) decode writes go to the
+            # trash page once the zeroed table row reaches the device
+            # (next _admit or dirty refresh — both before the pages can
+            # be re-issued to a new request).
+            self._free_pages.extend(req.pages)
+            req.pages = []
+            self._table[slot, :] = 0
+            self._table_dirty = True
         now = time.perf_counter()
         req.emit(None)
         if not req.future.done():
@@ -309,6 +443,11 @@ class LLMEngine:
             # death would hang their futures forever, and the donated
             # cache is invalid after a failed call anyway.
             self._error = e
+            if self._head_of_line is not None:
+                req, self._head_of_line = self._head_of_line, None
+                req.emit(None)
+                if not req.future.done():
+                    req.future.set_exception(e)
             for i, req in enumerate(self._slots):
                 if req is not None:
                     req.emit(None)
@@ -339,9 +478,13 @@ class LLMEngine:
                 self._wake.clear()
                 continue
             self._rng, sub = jax.random.split(self._rng)
+            if self._table_dirty:
+                self._table_dev = jnp.asarray(self._table) if self.paged \
+                    else jnp.zeros((1, 1), jnp.int32)
+                self._table_dirty = False
             seq, last, self.cache = self._decode(
                 self.params, self.cache, self._cur_dev,
-                jnp.asarray(self._temps), sub)
+                jnp.asarray(self._temps), sub, self._table_dev)
             self._cur_dev = last                # stays on device
             seq = np.asarray(seq)               # the ONE sync per block
             for i in active:
@@ -371,13 +514,15 @@ class LLMServer:
 
     def __init__(self, model: str = "debug", *, max_batch: int = 8,
                  max_len: int | None = None, params=None, seed: int = 0,
-                 warmup: bool = False):
+                 warmup: bool = False, paged: bool = True,
+                 page_size: int = 512, kv_pages: int | None = None):
         from ray_tpu.models import llama
 
         cfg = llama.llama_configs()[model] if isinstance(model, str) \
             else model
         self.engine = LLMEngine(cfg, params, max_batch=max_batch,
-                                max_len=max_len, seed=seed)
+                                max_len=max_len, seed=seed, paged=paged,
+                                page_size=page_size, kv_pages=kv_pages)
         self.engine.start()
         if warmup:
             self.engine.warmup()

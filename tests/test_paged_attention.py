@@ -1,0 +1,161 @@
+"""Paged-KV decode: kernel numerics, engine equivalence, long context.
+
+The serving-side answer to SURVEY §7's "bucketed shapes/paged KV via
+Pallas" hard part (reference analog: vLLM paged attention under ray
+Serve; ray itself has no attention op).  Kernel runs in interpret mode
+on CPU — same code path as the TPU build.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def test_kernel_matches_reference_across_page_counts():
+    from ray_tpu.ops.paged_attention import (paged_decode_attention,
+                                             paged_decode_reference)
+
+    rng = np.random.default_rng(0)
+    B, kvh, rep, hd, kt = 4, 2, 2, 32, 4
+    page, n_pages, maxp = 8, 20, 4
+    q = jnp.asarray(rng.normal(size=(B, kvh, rep, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_pages, kvh, page, hd)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_pages, kvh, page, hd)),
+                     jnp.float32)
+    ktail = jnp.asarray(rng.normal(size=(B, kvh, kt, hd)), jnp.float32)
+    vtail = jnp.asarray(rng.normal(size=(B, kvh, kt, hd)), jnp.float32)
+    table = np.zeros((B, maxp), np.int32)
+    ids = iter(range(1, n_pages))
+    for b in range(B):
+        for p in range(maxp):
+            table[b, p] = next(ids)
+    table = jnp.asarray(table)
+    # Block starts spanning 0..4 pages incl. boundaries; pos = ts + j.
+    ts = jnp.asarray([0, 7, 8, 27], jnp.int32)
+    pos = ts + 2
+    args = (q, kp, vp, ktail, vtail, table, pos, ts)
+    o_ref = paged_decode_reference(*args)
+    o = paged_decode_attention(*args)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=1e-5)
+
+
+def test_merge_tail_roundtrip():
+    """merge_tail_pages + a fresh-block attend == attending the same
+    rows from the tail (the block-boundary invariant)."""
+    from ray_tpu.ops.paged_attention import (merge_tail_pages,
+                                             paged_decode_attention)
+
+    rng = np.random.default_rng(1)
+    B, kvh, rep, hd, kt = 2, 2, 1, 16, 4
+    page, n_pages, maxp = 8, 10, 2
+    q = jnp.asarray(rng.normal(size=(B, kvh, rep, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_pages, kvh, page, hd)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_pages, kvh, page, hd)),
+                     jnp.float32)
+    ktail = jnp.asarray(rng.normal(size=(B, kvh, kt, hd)), jnp.float32)
+    vtail = jnp.asarray(rng.normal(size=(B, kvh, kt, hd)), jnp.float32)
+    table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    ts = jnp.asarray([3, 6], jnp.int32)
+    pos = ts + (kt - 1)
+    o_in_block = paged_decode_attention(q, kp, vp, ktail, vtail, table,
+                                        pos, ts)
+    # Merge the block, start a new one at ts' = pos + 1 with empty tail.
+    kp2 = merge_tail_pages(kp, ktail, table, ts, kt)
+    vp2 = merge_tail_pages(vp, vtail, table, ts, kt)
+    empty = jnp.zeros_like(ktail)
+    o_next = paged_decode_attention(q, kp2, vp2, empty, empty, table,
+                                    pos, pos + 1)
+    np.testing.assert_allclose(np.asarray(o_in_block),
+                               np.asarray(o_next), atol=1e-5)
+
+
+def test_kernel_clamps_runaway_idle_pos():
+    """An idle slot's pos keeps advancing between reuses; the kernel must
+    clamp rather than index past the table."""
+    from ray_tpu.ops.paged_attention import paged_decode_attention
+
+    B, kvh, rep, hd, kt = 2, 1, 1, 16, 2
+    page, n_pages, maxp = 8, 4, 2
+    q = jnp.ones((B, kvh, rep, hd), jnp.float32)
+    kp = jnp.zeros((n_pages, kvh, page, hd), jnp.float32)
+    vp = jnp.zeros((n_pages, kvh, page, hd), jnp.float32)
+    ktail = jnp.ones((B, kvh, kt, hd), jnp.float32)
+    vtail = jnp.ones((B, kvh, kt, hd), jnp.float32)
+    table = jnp.zeros((B, maxp), jnp.int32)
+    ts = jnp.asarray([3, 10_000], jnp.int32)   # slot 1 ran away
+    o = paged_decode_attention(q, kp, vp, ktail, vtail, table, ts + 1,
+                               ts)
+    assert np.all(np.isfinite(np.asarray(o)))
+
+
+def _engine(paged: bool, **kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = llama.llama_configs()["debug"]
+    eng = LLMEngine(cfg, max_batch=4, max_len=kw.pop("max_len", 128),
+                    seed=0, paged=paged, **kw)
+    eng.start()
+    return eng
+
+
+def test_paged_engine_matches_dense_greedy():
+    dense = _engine(False)
+    paged = _engine(True, page_size=16)
+    try:
+        prompts = [[1, 2, 3, 4, 5], [7, 8, 9],
+                   [11, 12, 13, 14, 15, 16, 17], [2, 4]]
+        fd = [dense.submit(p, max_new_tokens=12) for p in prompts]
+        fp = [paged.submit(p, max_new_tokens=12) for p in prompts]
+        for a, b in zip(fd, fp):
+            assert a.result(timeout=120)["tokens"] == \
+                b.result(timeout=120)["tokens"]
+    finally:
+        dense.stop()
+        paged.stop()
+
+
+def test_paged_pool_backpressure():
+    """More concurrent requests than the page pool holds: admission
+    blocks FIFO on the pool and every request still completes."""
+    eng = _engine(True, page_size=16, kv_pages=5)   # 4 usable pages
+    try:
+        futs = [eng.submit([1, 2, 3], max_new_tokens=10)
+                for _ in range(6)]
+        res = [f.result(timeout=180)["tokens"] for f in futs]
+        assert all(len(r) == 10 for r in res)
+    finally:
+        eng.stop()
+
+
+def test_long_context_engine_no_dense_prealloc():
+    """max_len=32768 with a small page pool: the engine must NOT
+    preallocate dense per-slot windows (VERDICT round-2 item 1's done
+    condition), and a request whose span crosses several pages decodes
+    correctly."""
+    from ray_tpu.models import llama
+
+    cfg = llama.llama_configs()["debug"]
+    eng = _engine(True, max_len=32768, page_size=64, kv_pages=9)
+    try:
+        # Pool memory is 9 pages x 64 rows — NOT slots x 32768:
+        pool_rows = eng.cache["k"][0].shape[0] * eng.cache["k"][0].shape[2]
+        assert pool_rows < 4 * 32768 // 10, pool_rows
+        prompt = list(np.arange(1, 150) % (cfg.vocab_size - 1) + 1)
+        out = eng.submit(prompt, max_new_tokens=40).result(timeout=300)
+        assert len(out["tokens"]) == 40
+        # Same prompt through a dense engine at a window that fits it —
+        # greedy tokens must agree (the paged path is not approximate).
+        dense = _engine(False, max_len=256)
+        try:
+            ref = dense.submit(prompt,
+                               max_new_tokens=40).result(timeout=300)
+        finally:
+            dense.stop()
+        assert out["tokens"] == ref["tokens"]
+    finally:
+        eng.stop()
