@@ -304,12 +304,13 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
     rules (train.step activates this automatically on stage-bearing
     meshes) each stage holds exactly its contiguous layer block, so the
     reshape moves no data.  Embed and lm_head/loss run outside the
-    pipeline (replicated over the stage axis, batch-parallel as usual);
-    microbatches keep the mb dim data-parallel INSIDE the pipeline
-    (batch_spec P(None, "data"))."""
-    from jax.sharding import PartitionSpec as P
-
+    pipeline (replicated over the stage axis, batch-parallel as usual).
+    Inside the pipeline only "stage" is manual (pipeline_apply); the
+    microbatch dim stays data-parallel and per-stage params stay
+    fsdp/tensor-sharded under plain GSPMD — PP composes with dp, fsdp
+    and tp as pure layout."""
     from ray_tpu.parallel.pipeline import pipeline_apply
+    from ray_tpu.parallel.sharding import logical_axis_size
 
     n_stages = mesh.shape["stage"]
     L = cfg.n_layers
@@ -320,14 +321,15 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
     n_micro = n_micro or max(2, n_stages)
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
-    data_size = mesh.shape.get("data", 1)
-    if (b // n_micro) % data_size:
+    batch_shards = logical_axis_size("batch", mesh)
+    if (b // n_micro) % batch_shards:
         raise ValueError(
-            f"microbatch size {b // n_micro} not divisible by the data "
-            f"axis ({data_size}); choose n_micro so that "
-            "batch / n_micro % data == 0")
+            f"microbatch size {b // n_micro} not divisible by the batch "
+            f"sharding (data x fsdp = {batch_shards}); choose n_micro so "
+            "that batch / n_micro % (data * fsdp) == 0")
     x = embed_lookup(params["embed"], inputs, cfg.dtype)
     mb = x.reshape(n_micro, b // n_micro, s, x.shape[-1])
+    mb = with_sharding_constraint(mb, (None, "batch", "seq", None), mesh)
     stage_layers = jax.tree.map(
         lambda p: p.reshape(n_stages, L // n_stages, *p.shape[1:]),
         params["layers"])
@@ -346,8 +348,7 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
         act, _ = lax.scan(body, act, lp_stage)
         return act
 
-    out = pipeline_apply(stage_fn, stage_layers, mb, mesh, axis="stage",
-                         batch_spec=P(None, "data"))
+    out = pipeline_apply(stage_fn, stage_layers, mb, mesh, axis="stage")
     x = out.reshape(b, s, x.shape[-1])
     return head_loss(params, x, targets, batch.get("mask"), cfg)
 

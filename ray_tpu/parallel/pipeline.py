@@ -28,17 +28,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
-                   mesh: Mesh, axis: str = "stage",
-                   batch_spec: P | None = None):
+                   mesh: Mesh, axis: str = "stage"):
     """Run microbatches through all pipeline stages (GPipe schedule).
 
     stage_fn(params_for_one_stage, x [mb, ...]) -> y [mb, ...] with the
     same shape (stages must preserve activation shape, as in a decoder
     trunk).  Returns [n_micro, mb, ...] outputs after the last stage.
 
-    batch_spec: PartitionSpec for the microbatch array (e.g.
-    P(None, "data") to keep the mb dim data-parallel INSIDE the pipeline
-    — PP composes with dp); default fully replicated.
+    The shard_map is *partially manual*: only the stage axis is manual
+    (lax.ppermute needs explicit neighbor sends); every other mesh axis
+    (data/fsdp/tensor/seq) stays automatic, so GSPMD shards the in-stage
+    compute exactly as it would outside the pipeline — fsdp all-gathers
+    the per-stage params, tensor inserts the Megatron all-reduces, the
+    microbatch dim stays data-parallel.  That is how PP composes with
+    every other strategy without this file knowing about any of them.
 
     Total steps = n_micro + n_stages - 1 (the pipeline bubble); each step
     every stage computes one microbatch then shifts activations to the
@@ -49,7 +52,21 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     n_micro = microbatches.shape[0]
     steps = n_micro + n_stages - 1
 
+    # XLA's CPU backend (the 8-device virtual test platform) crashes
+    # promoting the bf16 all-reduce that shard_map's transpose inserts
+    # over the manual axis for the replicated-in microbatch cotangent
+    # ("Invalid binary instruction opcode copy" in AllReducePromotion).
+    # Trampoline the microbatches through f32 at the boundary there so
+    # that psum is f32; compute inside stays in the model dtype.  TPU
+    # all-reduces bf16 natively — no trampoline, no cost.
+    mb_dtype = microbatches.dtype
+    f32_boundary = (mb_dtype == jnp.bfloat16
+                    and jax.devices()[0].platform == "cpu")
+    if f32_boundary:
+        microbatches = microbatches.astype(jnp.float32)
+
     def per_stage(params, mb):        # runs with a LOCAL stage view
+        mb = mb.astype(mb_dtype)
         # params leading axis is the local stage shard: [1, ...] → drop it
         params = jax.tree.map(lambda p: p[0], params)
         stage_idx = lax.axis_index(axis)
@@ -82,17 +99,23 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         (_, outputs), _ = lax.scan(step, (state, outputs),
                                    jnp.arange(steps))
         # only the last stage holds real outputs; broadcast them so every
-        # shard returns identically (psum over one-hot mask)
-        mask = (stage_idx == n_stages - 1).astype(outputs.dtype)
-        outputs = lax.psum(outputs * mask, axis)
+        # shard returns identically (psum over one-hot mask).  f32: XLA's
+        # CPU backend crashes promoting bf16 all-reduces produced inside
+        # partial-manual regions (AllReducePromotion check failure), and
+        # on TPU the widened all-reduce is one per pipeline call — noise.
+        mask = (stage_idx == n_stages - 1).astype(jnp.float32)
+        outputs = lax.psum(outputs.astype(jnp.float32) * mask,
+                           axis).astype(outputs.dtype)
         return outputs
 
+    # Specs name only the manual axis; sharding over the auto axes rides
+    # through on the arrays' own (GSPMD) shardings.
     params_spec = jax.tree.map(lambda _: P(axis), stage_params)
-    bspec = batch_spec if batch_spec is not None else P()
     fn = shard_map(
         per_stage, mesh=mesh,
-        in_specs=(params_spec, bspec),
-        out_specs=bspec,
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
         check_vma=False)
     return fn(stage_params, microbatches)
 

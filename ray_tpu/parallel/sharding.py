@@ -92,14 +92,16 @@ def logical_axis_size(logical: str, mesh=None,
     return size
 
 
-def _prune(mesh: Mesh, entry):
+def _prune(mesh: Mesh, entry, exclude: set | frozenset = frozenset()):
     """Remove axes not present in the mesh (lets one rules table serve
-    meshes with fewer axes)."""
+    meshes with fewer axes) or in `exclude` (manual shard_map axes)."""
     if entry is None:
         return None
     if isinstance(entry, str):
-        return entry if entry in mesh.axis_names else None
-    kept = tuple(a for a in entry if a in mesh.axis_names)
+        return entry if entry in mesh.axis_names and entry not in exclude \
+            else None
+    kept = tuple(a for a in entry
+                 if a in mesh.axis_names and a not in exclude)
     return kept if kept else None
 
 
@@ -126,12 +128,16 @@ def with_sharding_constraint(x, logical_axes: tuple[str | None, ...],
         mesh = current_abstract_mesh()
         if mesh is None:
             return x
-    if _manual_axes(mesh):
-        # Inside shard_map the named axes are manual: layout is already
-        # explicit per-shard and constraints are meaningless there.
+    manual = _manual_axes(mesh)
+    if manual and set(mesh.axis_names) <= manual:
+        # Fully-manual shard_map: layout is already explicit per-shard
+        # and constraints are meaningless there.
         return x
     spec = logical_spec(logical_axes, rules)
-    spec = P(*[_prune(mesh, s) for s in spec])
+    # Inside a *partially* manual shard_map (e.g. the pipeline: "stage"
+    # manual, the rest auto) constraints still steer GSPMD over the auto
+    # axes — just strip the manual ones from the spec.
+    spec = P(*[_prune(mesh, s, exclude=manual) for s in spec])
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, spec) if isinstance(mesh, Mesh) else spec)
 

@@ -305,10 +305,17 @@ class LLMEngine:
     def warmup(self, buckets: list[int] | None = None) -> None:
         """Pre-compile the decode program and prefill buckets so the first
         real request doesn't pay XLA compile time in its TTFT (the
-        standard TPU-serving warmup discipline)."""
+        standard TPU-serving warmup discipline).  Warmup prompts are
+        capped by the paged pool's capacity — a pool sized below one
+        full max_len span (the very configurations paging enables) must
+        not make warmup trip its own admission check."""
+        cap = self.max_len - 1
+        if getattr(self, "page", None):
+            cap = min(cap, (self.n_pages - 1) * self.page - 1)
         for b in buckets or self._buckets:
-            self.generate(list(range(1, min(b, self.max_len - 1) + 1)),
-                          max_new_tokens=1)
+            n = min(b, cap)
+            if n >= 1:
+                self.generate(list(range(1, n + 1)), max_new_tokens=1)
 
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():

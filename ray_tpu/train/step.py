@@ -155,13 +155,17 @@ def sharded_train_step(cfg: llama.LlamaConfig,
     (params update in place in HBM).  On a stage-bearing mesh the trunk
     runs the GPipe pipeline (llama.pipelined_loss_fn) automatically."""
     if loss_fn is None and mesh.shape.get("stage", 1) > 1:
-        unsupported = [a for a in ("fsdp", "tensor", "seq", "expert")
+        # fsdp/tensor/data compose with the pipeline (only "stage" is
+        # manual inside pipeline_apply; GSPMD shards the in-stage compute
+        # over the auto axes).  seq (ring attention nests its own
+        # shard_map) and expert (no pipelined MoE trunk) do not yet.
+        unsupported = [a for a in ("seq", "expert")
                        if mesh.shape.get(a, 1) > 1]
         if unsupported:
             raise NotImplementedError(
-                f"pipeline meshes currently compose with 'data' only; "
-                f"axes {unsupported} > 1 would be silently un-sharded "
-                "inside the pipeline (params all-gathered per step)")
+                f"pipeline meshes compose with data/fsdp/tensor; axes "
+                f"{unsupported} > 1 are not supported inside the "
+                "pipelined trunk yet")
 
         def loss_fn(params, batch, cfg_, _mesh=mesh, _nm=n_micro):
             pl = getattr(model_module(cfg_), "pipelined_loss_fn", None)

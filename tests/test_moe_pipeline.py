@@ -163,6 +163,80 @@ def test_pipelined_llama_loss_matches_sequential():
         assert rel < 1e-4, f"{ka}: grad rel err {rel}"
 
 
+@pytest.mark.parametrize("mesh_kw", [
+    dict(stage=2, fsdp=2, data=2),      # PP x FSDP x DP
+    dict(stage=2, data=2, tensor=2),    # PP x DP x TP
+    dict(stage=2, fsdp=2, tensor=2),    # PP x FSDP x TP
+])
+def test_pipelined_loss_composes_with_fsdp_tensor(mesh_kw):
+    """pipelined_loss_fn on meshes that shard params within each stage
+    (fsdp/tensor) must reproduce the sequential numerics — loss AND
+    grads.  Only "stage" is manual inside the pipeline; GSPMD shards the
+    in-stage compute (VERDICT r2 item 4; SURVEY §2.4 PP row)."""
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.train import step as ts
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=32, remat=False, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(3), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 33), 0, 128,
+                                jnp.int32)
+    batch = {"tokens": tokens}
+    ref_loss = float(llama.loss_fn(params, batch, cfg))
+    g_ref = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    mesh = create_mesh(MeshConfig(**mesh_kw))
+    # Shard the params exactly as sharded_train_step would (per-stage
+    # layer blocks + fsdp/tensor within each stage).
+    axes = llama.param_logical_axes(cfg)
+    from ray_tpu.parallel.sharding import shard_params
+    sharded = shard_params(params, axes, mesh,
+                           rules=ts._rules_for(mesh))
+    with jax.set_mesh(mesh):
+        pp_loss = float(jax.jit(
+            lambda p, b: llama.pipelined_loss_fn(p, b, cfg, mesh,
+                                                 n_micro=2))(sharded, batch))
+        g_pp = jax.jit(jax.grad(
+            lambda p: llama.pipelined_loss_fn(p, batch, cfg, mesh,
+                                              n_micro=2)))(sharded)
+    np.testing.assert_allclose(pp_loss, ref_loss, rtol=1e-5)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_ref),
+            jax.tree_util.tree_leaves_with_path(g_pp)):
+        rel = np.abs(np.asarray(a) - np.asarray(b)).max() / \
+            (np.abs(np.asarray(a)).max() + 1e-9)
+        assert rel < 1e-4, f"{ka}: grad rel err {rel}"
+
+
+def test_train_step_composes_pp_fsdp():
+    """Full sharded_train_step on {stage:2, fsdp:2, data:2}: the loss
+    decreases and no NotImplementedError fires (the lifted
+    train/step.py gate)."""
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+    from ray_tpu.train import step as train_step
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        ffn_dim=64, max_seq=16, remat=False, dtype=jnp.float32)
+    mesh = create_mesh(MeshConfig(stage=2, fsdp=2, data=2))
+    opt = train_step.default_optimizer(lr=1e-2, warmup=1, total_steps=20)
+    state = train_step.sharded_init(jax.random.PRNGKey(0), cfg, opt, mesh)
+    step = train_step.sharded_train_step(cfg, opt, mesh, n_micro=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 64,
+                                jnp.int32)
+    b_sh = train_step.batch_shardings(mesh)
+    batch = {"tokens": jax.device_put(tokens, b_sh)}
+    losses = []
+    with jax.set_mesh(mesh):
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
 def test_train_step_uses_pipeline_on_stage_mesh():
     """sharded_train_step on a stage-bearing mesh wires the GPipe trunk
     automatically and the loss decreases over steps."""
