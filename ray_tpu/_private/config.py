@@ -56,6 +56,11 @@ class Config:
     # burst must queue spawns, not stampede N interpreters at once —
     # under CPU contention every fork then misses its startup timeout.
     max_concurrent_worker_spawns: int = 4
+    # Fork plain workers from a pre-warmed zygote process (~ms per worker
+    # instead of ~2s of cold interpreter imports; see _private/zygote.py).
+    # Device workers always cold-spawn.  Any zygote failure falls back to
+    # classic spawning automatically.
+    worker_zygote: bool = True
     # --- health / fault tolerance ---
     heartbeat_period_s: float = 0.5
     # Missed-heartbeat budget before a node is declared dead

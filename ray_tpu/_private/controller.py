@@ -494,6 +494,13 @@ class Controller:
         else:
             await self._fail_actor(actor, cause)
 
+    async def rpc_report_worker_death(self, h: dict, _b: list) -> dict:
+        """Broadcast a dead worker ADDRESS so every process gates its
+        sends/resolutions (ray: GCS WORKER_FAILURE pubsub)."""
+        await self.publisher.publish(
+            "worker", {"event": "dead", "addr": h.get("addr", "")})
+        return {}
+
     async def rpc_report_actor_death(self, h: dict, _b: list) -> dict:
         actor = self.actors.get(h["actor_id"])
         if actor:

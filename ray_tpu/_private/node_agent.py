@@ -121,6 +121,15 @@ class NodeAgent:
             max(1, config.max_concurrent_worker_spawns))
         self._closed = False
         self.store = None  # shared-memory store runner, attached in start()
+        # Warm zygote spawner: plain workers fork in ~ms instead of ~2s
+        # of cold imports (see _private/zygote.py).  Boots in the
+        # background; until ready (or on any failure) spawns go classic.
+        self._zygote = None
+        if config.worker_zygote and not os.environ.get(
+                "RAY_TPU_WORKER_LOGS"):
+            from ray_tpu._private.zygote import ZygoteSpawner
+
+            self._zygote = ZygoteSpawner(config.temp_dir)
         import tempfile
 
         self._log_dir = os.path.join(
@@ -162,6 +171,8 @@ class NodeAgent:
         for w in self.workers.values():
             if w.proc and w.proc.poll() is None:
                 w.proc.terminate()
+        if self._zygote is not None:
+            self._zygote.close()
         if self.store:
             self.store.close()
         self.server.close()
@@ -212,23 +223,36 @@ class NodeAgent:
             # Plain workers must never grab the TPU chip
             # (ray analog: CUDA_VISIBLE_DEVICES isolation in worker_pool).
             env["JAX_PLATFORMS"] = "cpu"
-        if os.environ.get("RAY_TPU_WORKER_LOGS"):
-            stdout = stderr = None          # inherit (debugging)
-        else:
+        # Zygote-forked children watch the AGENT's liveness, not their
+        # direct parent (the zygote).
+        env["RAY_TPU_AGENT_PID"] = str(os.getpid())
+        stdout_path = stderr_path = None
+        if not os.environ.get("RAY_TPU_WORKER_LOGS"):
             # Per-worker log files; the agent tails them and forwards new
             # lines to drivers (ray: worker logs in the session dir +
             # log_monitor.py streaming driver-bound logs via GCS pubsub).
             os.makedirs(self._log_dir, exist_ok=True)
-            stdout = open(os.path.join(
-                self._log_dir, f"worker-{worker_id[:12]}.out"), "ab")
-            stderr = open(os.path.join(
-                self._log_dir, f"worker-{worker_id[:12]}.err"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=stdout, stderr=stderr)
-        if stdout is not None:
-            stdout.close()
-            stderr.close()
+            stdout_path = os.path.join(
+                self._log_dir, f"worker-{worker_id[:12]}.out")
+            stderr_path = os.path.join(
+                self._log_dir, f"worker-{worker_id[:12]}.err")
+        proc = None
+        if not device_worker and self._zygote is not None \
+                and self._zygote._ready.is_set():
+            # ~ms warm fork; None on any zygote trouble → cold spawn.
+            proc = self._zygote.spawn(env, stdout_path, stderr_path)
+        if proc is None:
+            if stdout_path is not None:
+                stdout = open(stdout_path, "ab")
+                stderr = open(stderr_path, "ab")
+            else:
+                stdout = stderr = None      # inherit (debugging)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=env, stdout=stdout, stderr=stderr)
+            if stdout is not None:
+                stdout.close()
+                stderr.close()
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               is_device_worker=device_worker)
         self.workers[worker_id] = handle
@@ -469,6 +493,16 @@ class NodeAgent:
                     "worker_died", {"worker_addr": w.addr,
                                     "lease_id": w.lease_id,
                                     "oom": w.oom_killed})
+            except Exception:  # noqa: BLE001
+                pass
+        # Cluster-wide dead-address broadcast: borrowers resolving objects
+        # through this (owner) address must fail fast, not hang on a zmq
+        # DEALER that silently reconnects forever (ray: WORKER_FAILURE
+        # pubsub gating gets the same way).
+        if w.addr:
+            try:
+                await self.clients.get(self.controller_addr).notify(
+                    "report_worker_death", {"addr": w.addr})
             except Exception:  # noqa: BLE001
                 pass
         self.workers.pop(w.worker_id, None)

@@ -567,9 +567,16 @@ class StoreRunner:
         return False
 
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:
-        return {**self.backend.stats(),
-                "spilled_objects": len(self.spilled),
-                "spilled_bytes": self.spilled_bytes}
+        out = {**self.backend.stats(),
+               "spilled_objects": len(self.spilled),
+               "spilled_bytes": self.spilled_bytes}
+        if h.get("sweep"):
+            # Chaos-test hook: reclaim + report pins of crash-killed
+            # processes right now (the reaper also does this on a 5s
+            # cadence).  0 == nothing was leaked at call time.
+            sweep = getattr(self.backend, "sweep_dead", None)
+            out["swept_dead_pins"] = int(sweep()) if sweep else 0
+        return out
 
     def close(self) -> None:
         self.backend.close()

@@ -53,7 +53,15 @@ class RemoteError(RpcError):
 
     def __init__(self, method: str, cause: BaseException | str):
         super().__init__(f"remote call {method!r} failed: {cause!r}")
+        self.method = method
         self.cause = cause
+
+    def __reduce__(self):
+        # Default exception pickling replays args=(message,) into the
+        # two-arg __init__ and explodes at UNPICKLE time — which kills
+        # whatever recv loop touches the frame.  Rebuild from the real
+        # fields (relay chains pickle these: proxy → client).
+        return (RemoteError, (self.method, self.cause))
 
 
 class ConnectionLost(RpcError):
@@ -182,14 +190,24 @@ class RpcClient:
                 frames = await self._sock.recv_multipart()
             except (asyncio.CancelledError, zmq.ZMQError):
                 break
-            msgid, ok, header = msgpack.unpackb(frames[0], raw=False)
+            # A malformed or unpicklable reply must fail ITS caller, not
+            # kill the recv loop (which would hang every pending call).
+            try:
+                msgid, ok, header = msgpack.unpackb(frames[0], raw=False)
+            except Exception:  # noqa: BLE001
+                logger.warning("dropping malformed reply frame from %s",
+                               self.address)
+                continue
             fut = self._pending.pop(msgid, None)
             if fut is None or fut.done():
                 continue
             if ok:
                 fut.set_result((header or {}, frames[1:]))
             else:
-                exc, tb = pickle.loads(frames[1])
+                try:
+                    exc, tb = pickle.loads(frames[1])
+                except Exception as e:  # noqa: BLE001 - unpicklable error
+                    exc = RpcError(f"remote error (unpicklable): {e!r}")
                 fut.set_exception(RemoteError(getattr(fut, "_method", "?"), exc))
         for fut in self._pending.values():
             if not fut.done():

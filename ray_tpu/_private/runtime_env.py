@@ -1,20 +1,26 @@
-"""Runtime environments: per-task/actor env_vars + code shipping.
+"""Runtime environments: per-task/actor env_vars, code shipping, pip envs.
 
 Analog of ray: python/ray/_private/runtime_env/ (working_dir.py,
-py_modules.py, plugin architecture; provisioning agent under
-runtime_env/agent/) and python/ray/runtime_env/runtime_env.py (the user
-API).  Collapsed for this runtime: the driver packages working_dir /
-py_modules into a content-addressed zip in the controller KV; workers
-fetch + extract once per digest and activate (sys.path + cwd + env vars)
-around execution.  Conda/pip provisioning is intentionally out of scope
-in this environment (no installs) — a plugin can add it via the same
-descriptor mechanism.
+py_modules.py, pip.py; provisioning agent under runtime_env/agent/) and
+python/ray/runtime_env/runtime_env.py (the user API).  Collapsed for this
+runtime: the driver packages working_dir / py_modules into a
+content-addressed zip in the controller KV; workers fetch + extract once
+per digest and activate (sys.path + cwd + env vars) around execution.
+
+pip envs are OFFLINE-capable (this machine has no egress): packages
+resolve from a local wheel directory via `pip install --no-index
+--find-links <wheel_dir> --target <env>` into a per-hash site directory,
+built once per node under a file lock and cached (ray: pip.py builds a
+per-hash virtualenv; the --target site-dir is the no-network equivalent
+— activation prepends it to sys.path and deactivation evicts the modules
+it provided, so pooled workers stay reusable).
 """
 from __future__ import annotations
 
 import contextlib
 import hashlib
 import io
+import json
 import os
 import sys
 import zipfile
@@ -28,14 +34,16 @@ class RuntimeEnv(dict):
     """User-facing descriptor (ray: runtime_env/runtime_env.py RuntimeEnv).
 
     Supported keys: env_vars (dict), working_dir (path), py_modules
-    (list of paths).
+    (list of paths), pip (list of requirements, or
+    {"packages": [...], "wheel_dir": path} for offline resolution).
     """
 
-    _KEYS = {"env_vars", "working_dir", "py_modules"}
+    _KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
 
     def __init__(self, env_vars: dict | None = None,
                  working_dir: str | None = None,
-                 py_modules: list | None = None, **kwargs):
+                 py_modules: list | None = None,
+                 pip: list | dict | None = None, **kwargs):
         unknown = set(kwargs) - self._KEYS
         if unknown:
             raise ValueError(
@@ -48,6 +56,8 @@ class RuntimeEnv(dict):
             self["working_dir"] = working_dir
         if py_modules:
             self["py_modules"] = list(py_modules)
+        if pip:
+            self["pip"] = pip
         self.update(kwargs)
 
 
@@ -93,7 +103,74 @@ def prepare(runtime_env: dict | None, core) -> dict | None:
                          "name": os.path.basename(os.path.abspath(p))})
     if packages:
         desc["packages"] = packages
+    pip_spec = runtime_env.get("pip")
+    if pip_spec:
+        if isinstance(pip_spec, dict):
+            reqs = sorted(pip_spec.get("packages", ()))
+            wheel_dir = pip_spec.get("wheel_dir")
+        else:
+            reqs = sorted(pip_spec)
+            wheel_dir = None
+        wheel_dir = wheel_dir or os.environ.get("RAY_TPU_WHEEL_DIR")
+        if not wheel_dir:
+            raise ValueError(
+                "pip runtime_env needs a local wheel source (no egress): "
+                'pass {"pip": {"packages": [...], "wheel_dir": ...}} or '
+                "set RAY_TPU_WHEEL_DIR")
+        desc["pip"] = {"packages": reqs,
+                       "wheel_dir": os.path.abspath(wheel_dir)}
     return desc or None
+
+
+def _pip_env_hash(pip_desc: dict) -> str:
+    return hashlib.blake2b(
+        json.dumps(pip_desc, sort_keys=True).encode(),
+        digest_size=16).hexdigest()
+
+
+def _ensure_pip_env(pip_desc: dict) -> str:
+    """Node-local build-once per env hash (ray: pip.py _install_pip
+    building the per-hash virtualenv, keyed and locked the same way).
+    Offline: --no-index --find-links only."""
+    import fcntl
+    import subprocess
+
+    h = _pip_env_hash(pip_desc)
+    target = os.path.join(_EXTRACT_ROOT, "pip", h)
+    marker = os.path.join(target, ".ready")
+    if os.path.exists(marker):
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    lock_path = target + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):      # built while we waited
+                return target
+            # Build into a scratch dir + atomic rename: a crash-killed
+            # build must never leave a half-copied target that a later
+            # `pip install --target` would skip over (pip refuses to
+            # replace an existing dir without --upgrade).
+            import shutil
+
+            tmp = target + ".build"
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.rmtree(target, ignore_errors=True)
+            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+                   "--no-index", "--find-links", pip_desc["wheel_dir"],
+                   "--target", tmp, *pip_desc["packages"]]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"pip runtime_env build failed: {proc.stderr[-2000:]}")
+            with open(os.path.join(tmp, ".ready"), "w") as f:
+                f.write("ok")
+            os.rename(tmp, target)
+            return target
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def _fetch_package(digest: str, core) -> str:
@@ -116,12 +193,15 @@ def _fetch_package(digest: str, core) -> str:
 
 
 def prefetch(desc: dict | None, core) -> None:
-    """Blocking fetch of every package in the descriptor.  MUST be called
-    off the event loop (run_in_executor) before activating a runtime env
-    on the loop thread (async actors): _fetch_package's core.call blocks
-    on the loop, so calling it from the loop deadlocks the worker."""
+    """Blocking fetch/build of everything in the descriptor.  MUST be
+    called off the event loop (run_in_executor) before activating a
+    runtime env on the loop thread (async actors): _fetch_package's
+    core.call blocks on the loop, so calling it from the loop deadlocks
+    the worker.  (pip builds also run subprocesses — same rule.)"""
     for pkg in (desc or {}).get("packages", ()):
         _fetch_package(pkg["digest"], core)
+    if (desc or {}).get("pip"):
+        _ensure_pip_env(desc["pip"])
 
 
 @contextlib.contextmanager
@@ -137,6 +217,8 @@ def activate(desc: dict | None, core):
     saved_env: dict[str, str | None] = {}
     added_paths: list[str] = []
     saved_cwd = os.getcwd()
+    pip_path: str | None = None
+    mods_before: set[str] | None = None
     try:
         for k, v in (desc.get("env_vars") or {}).items():
             saved_env[k] = os.environ.get(k)
@@ -147,12 +229,33 @@ def activate(desc: dict | None, core):
             added_paths.append(path)
             if pkg["kind"] == "working_dir":
                 os.chdir(path)
+        if desc.get("pip"):
+            pip_path = _ensure_pip_env(desc["pip"])
+            sys.path.insert(0, pip_path)
+            added_paths.append(pip_path)
+            mods_before = set(sys.modules)
+            import importlib
+
+            importlib.invalidate_caches()
         yield
     finally:
         os.chdir(saved_cwd)
         for p in added_paths:
             with contextlib.suppress(ValueError):
                 sys.path.remove(p)
+        if pip_path is not None and mods_before is not None:
+            # Evict modules the pip env provided so the NEXT task in this
+            # pooled worker doesn't see them (the reference instead keys
+            # dedicated workers by runtime env — worker_pool.h:159; this
+            # keeps pool reuse while preserving the isolation semantics).
+            for name in list(set(sys.modules) - mods_before):
+                mod = sys.modules.get(name)
+                origin = getattr(mod, "__file__", "") or ""
+                if origin.startswith(pip_path):
+                    del sys.modules[name]
+            import importlib
+
+            importlib.invalidate_caches()
         for k, old in saved_env.items():
             if old is None:
                 os.environ.pop(k, None)

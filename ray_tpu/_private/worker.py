@@ -86,6 +86,9 @@ class OwnedObject:
     state: str = "pending"           # pending | inline | stored | error
     frames: list[bytes] | None = None
     locations: list[str] = field(default_factory=list)
+    # Serialized payload size, learned at fulfillment (ray: object size in
+    # the owner's reference table; feeds Data's resource manager).
+    size: int = 0
     error: BaseException | None = None
     local_refs: int = 0
     borrowers: int = 0
@@ -628,8 +631,33 @@ class CoreWorker:
         self.pub_addr = pub_addr
         self.subscriber = Subscriber(self.ctx, pub_addr)
         self.subscriber.subscribe("actor", self._on_actor_event)
+        self.subscriber.subscribe("worker", self._on_worker_event)
         if self.mode == "driver" and getattr(self, "log_to_driver", False):
             self.subscriber.subscribe("logs", self._on_log_lines)
+
+    async def _on_worker_event(self, _topic: str, payload: dict) -> None:
+        """Cluster-wide worker-death broadcast: mark the address dead and
+        drop its client NOW — every pending call to it (e.g. a borrower's
+        resolve_object against a dead owner) fails instead of waiting on
+        a zmq DEALER that reconnects forever."""
+        if payload.get("event") != "dead":
+            return
+        addr = payload.get("addr")
+        if not addr or addr == self.address:
+            return
+        self._mark_addr_dead(addr)
+        self.clients.drop(addr)
+
+    def _mark_addr_dead(self, addr: str) -> None:
+        """The ONE bookkeeping site for the dead-address registry (the
+        eviction ring must never hold duplicate entries, or popping an
+        old duplicate would un-mark a currently-dead address)."""
+        if addr in self._dead_worker_addrs:
+            return
+        self._dead_worker_addrs.add(addr)
+        self._dead_addr_order.append(addr)
+        while len(self._dead_addr_order) > 1024:
+            self._dead_worker_addrs.discard(self._dead_addr_order.pop(0))
 
     async def _on_log_lines(self, _topic: str, payload: dict) -> None:
         """Print streamed worker logs on the driver console
@@ -899,6 +927,7 @@ class CoreWorker:
             if rec0 is not None:
                 irec.submit_spec = rec0.submit_spec
                 irec.retries_left = rec0.retries_left
+            irec.size = h.get("size", 0)
             if h.get("inline"):
                 irec.state = "inline"
                 irec.frames = list(blobs)
@@ -1203,6 +1232,7 @@ class CoreWorker:
                     prev_contained, rec.contained = rec.contained, [
                         (bytes.fromhex(c[0]), c[1])
                         for c in meta.get("contained", ())]
+                    rec.size = meta.get("size", 0)
                     if meta["inline"]:
                         rec.state = "inline"
                         rec.frames = frames
@@ -1273,6 +1303,7 @@ class CoreWorker:
                 if rec is not None:
                     irec.submit_spec = rec.submit_spec
                     irec.retries_left = rec.retries_left
+                irec.size = im.get("size", 0)
                 if im["inline"]:
                     n = im["nframes"]
                     irec.state = "inline"
@@ -1363,6 +1394,7 @@ class CoreWorker:
         with self._ref_lock:
             rec = self.owned.setdefault(oid, OwnedObject())
             rec.local_refs += 1
+            rec.size = sv.total_bytes
             # Contained pins for refs nested in the value (released when
             # this object is freed).  Fire-and-forget notify suffices here
             # (unlike _pack_returns): this process's later remove_borrow
@@ -1521,6 +1553,12 @@ class CoreWorker:
 
     async def _get_from_owner(self, ref: ObjectRef,
                               deadline: float | None) -> Any:
+        if ref.owner_addr in self._dead_worker_addrs:
+            # Known-dead owner: resolving would hang on a reconnecting
+            # DEALER; the object is lost with its owner (put objects
+            # have no lineage; task returns resubmit via their OWN owner).
+            return ObjectLostError(
+                f"{ref.hex()[:12]} (owner {ref.owner_addr} died)")
         remaining = None if deadline is None \
             else max(0.0, deadline - time.monotonic())
         try:
@@ -1648,6 +1686,20 @@ class CoreWorker:
             p.cancel()
         not_done = [r for r in refs if r not in done_refs]
         return done_refs, not_done
+
+    def object_sizes(self, refs: list[ObjectRef]) -> list[int | None]:
+        """Owner-table payload sizes for locally-owned refs (None when
+        unknown/pending/not owned here).  Cheap: no payload fetch.  Feeds
+        Data's resource-aware backpressure (ray: reference table sizes →
+        data/_internal/execution/resource_manager.py)."""
+        out: list[int | None] = []
+        with self._ref_lock:
+            for r in refs:
+                rec = self.owned.get(r.binary())
+                out.append(rec.size if rec is not None
+                           and rec.state in ("inline", "stored")
+                           and rec.size > 0 else None)
+        return out
 
     def ref_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -1886,6 +1938,7 @@ class CoreWorker:
             rid = ObjectID.for_return(tid, i).binary()
             if rec["stored"][i] is None:       # inline-sized
                 returns.append({"inline": True, "nframes": len(sv.frames),
+                                "size": sv.total_bytes,
                                 "contained": contained})
                 rb.extend(sv.frames)
                 if self.mode == "worker":
@@ -1896,6 +1949,7 @@ class CoreWorker:
                         "store_put", {"object_id": rid.hex()}, sv.frames)
                 returns.append({"inline": False,
                                 "location": self.agent_addr,
+                                "size": sv.total_bytes,
                                 "contained": contained})
                 if self.mode == "worker":
                     self._cache_local_return(rid,
@@ -2021,7 +2075,7 @@ class CoreWorker:
             contained = await self._pin_contained_refs(sv)
             iid = ObjectID.for_return(tid, idx + 1).binary()
             hdr = {"task_id": h["task_id"], "index": idx,
-                   "contained": contained}
+                   "size": sv.total_bytes, "contained": contained}
             if sv.total_bytes <= inline_max:
                 hdr["inline"] = True
                 if self.mode == "worker":
@@ -2179,6 +2233,7 @@ class CoreWorker:
             rid = ObjectID.for_return(TaskID(task_id), i).binary()
             if sv.total_bytes <= self.config.max_inline_object_size:
                 returns.append({"inline": True, "nframes": len(sv.frames),
+                                "size": sv.total_bytes,
                                 "contained": contained})
                 out_blobs.extend(sv.frames)
                 if self.mode == "worker":
@@ -2191,6 +2246,7 @@ class CoreWorker:
                         "store_put", {"object_id": rid.hex()}, sv.frames)
                 returns.append({"inline": False,
                                 "location": self.agent_addr,
+                                "size": sv.total_bytes,
                                 "contained": contained})
                 if self.mode == "worker":
                     self._cache_local_return(
@@ -2275,6 +2331,7 @@ class CoreWorker:
             rid = ObjectID.for_return(TaskID(task_id), i + 1).binary()
             if sv.total_bytes <= self.config.max_inline_object_size:
                 metas.append({"inline": True, "nframes": len(sv.frames),
+                              "size": sv.total_bytes,
                               "contained": contained})
                 out_blobs.extend(sv.frames)
                 if self.mode == "worker":
@@ -2287,6 +2344,7 @@ class CoreWorker:
                         "store_put", {"object_id": rid.hex()}, sv.frames)
                 metas.append({"inline": False,
                               "location": self.agent_addr,
+                              "size": sv.total_bytes,
                               "contained": contained})
                 if self.mode == "worker":
                     self._cache_local_return(
@@ -2309,9 +2367,10 @@ class CoreWorker:
                 with renv.activate(renv_desc, self):
                     return cls(*args, **kwargs)
             if is_async:
-                if renv_desc and renv_desc.get("packages"):
-                    # Packages must be on disk before activate runs on the
-                    # loop thread (see runtime_env.prefetch).
+                if renv_desc and (renv_desc.get("packages")
+                                  or renv_desc.get("pip")):
+                    # Packages/pip envs must be on disk before activate
+                    # runs on the loop thread (see runtime_env.prefetch).
                     from ray_tpu._private import runtime_env as renv
 
                     await self.loop.run_in_executor(
@@ -2562,9 +2621,10 @@ class CoreWorker:
             # group's (only active once the actor declares groups).
             sem = inst.semaphore_for(group) if group \
                 else inst.default_semaphore()
-            if inst.runtime_env and inst.runtime_env.get("packages"):
-                # Packages must be on disk before activate runs on
-                # the loop thread (see runtime_env.prefetch).
+            if inst.runtime_env and (inst.runtime_env.get("packages")
+                                     or inst.runtime_env.get("pip")):
+                # Packages/pip envs must be on disk before activate runs
+                # on the loop thread (see runtime_env.prefetch).
                 from ray_tpu._private import runtime_env as renv
 
                 await self.loop.run_in_executor(
@@ -2972,21 +3032,21 @@ class CoreWorker:
         # a LATER send to this address would create a fresh silently-
         # hanging connection.  Sends check this set first (ray: worker
         # failure pubsub gates the submitter the same way).
-        if addr and addr not in self._dead_worker_addrs:
-            self._dead_worker_addrs.add(addr)
-            self._dead_addr_order.append(addr)
-            while len(self._dead_addr_order) > 1024:
-                self._dead_worker_addrs.discard(
-                    self._dead_addr_order.pop(0))
+        if addr:
+            self._mark_addr_dead(addr)
         self.clients.drop(addr)
         return {}
 
     def _revive_addr(self, addr: str) -> None:
         """A live worker provably exists at this address now (lease
         granted on it / actor alive there): clear stale death marks so a
-        reused ephemeral port isn't treated as dead forever."""
+        reused ephemeral port isn't treated as dead forever.  Purge the
+        eviction ring too — a stale ring entry would later pop and
+        un-mark the address if it dies AGAIN in the meantime."""
         self._dead_worker_addrs.discard(addr)
         self._oom_worker_addrs.discard(addr)
+        if addr in self._dead_addr_order:
+            self._dead_addr_order.remove(addr)
 
     async def rpc_exit_worker(self, h: dict, _b: list) -> dict:
         logger.info("worker exiting: %s", h.get("reason"))

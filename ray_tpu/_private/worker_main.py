@@ -12,13 +12,30 @@ import os
 
 
 def _watch_parent() -> None:
-    """Exit when the owning agent dies — workers must never outlive it."""
+    """Exit when the owning agent dies — workers must never outlive it.
+
+    Zygote-forked workers (see _private/zygote.py) watch the AGENT's pid
+    from RAY_TPU_AGENT_PID: their direct parent is the zygote, and a
+    zygote restart must not take live actors down with it."""
     import threading
     import time
 
+    agent_pid = int(os.environ.get("RAY_TPU_AGENT_PID") or 0)
+
+    def _alive() -> bool:
+        if agent_pid:
+            try:
+                os.kill(agent_pid, 0)
+                return True
+            except ProcessLookupError:
+                return False
+            except PermissionError:
+                return True
+        return os.getppid() > 1
+
     def _loop():
         while True:
-            if os.getppid() <= 1:
+            if not _alive():
                 os._exit(0)
             time.sleep(1.0)
 

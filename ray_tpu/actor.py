@@ -78,12 +78,17 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: str, method_names: set[str] | None = None,
                  owner: bool = False,
-                 method_opts: dict[str, dict] | None = None):
+                 method_opts: dict[str, dict] | None = None,
+                 max_task_retries: int = 0):
         self._actor_id = actor_id
         self._method_names = method_names or set()
         # @ray_tpu.method(...) declarations per method (num_returns etc.;
         # concurrency_group resolves worker-side via method_groups).
         self._method_opts = method_opts or {}
+        # Actor-level retry budget for calls caught mid-death (ray:
+        # max_task_retries declared on the class); rides the handle so
+        # every call site — including deserialized copies — applies it.
+        self._max_task_retries = max_task_retries
         # The original handle owns the actor's lifetime: dropping it kills
         # the actor (ray: actor handle reference counting; non-detached
         # actors die when all handles go out of scope).  Deserialized copies
@@ -108,6 +113,9 @@ class ActorHandle:
     def _invoke(self, method: str, args: tuple, kwargs: dict, opts: dict):
         from ray_tpu._private.worker import global_worker
 
+        if getattr(self, "_max_task_retries", 0) \
+                and "max_task_retries" not in opts:
+            opts = {**opts, "max_task_retries": self._max_task_retries}
         core = global_worker()
         refs = core.submit_actor_task(self._actor_id, method, args, kwargs,
                                       opts)
@@ -130,7 +138,8 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._method_names, False,
-                              self._method_opts))
+                              self._method_opts,
+                              getattr(self, "_max_task_retries", 0)))
 
 
 class ActorClass:
@@ -156,9 +165,13 @@ class ActorClass:
         return clone
 
     def _remote(self, args: tuple, kwargs: dict, opts: dict) -> ActorHandle:
+        from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
         from ray_tpu.remote_function import _wait_pg_ready
 
+        if client_mod._ctx is not None:
+            return client_mod._ctx.create_actor(self._cls, args, kwargs,
+                                                opts)
         options = resolve_pg_options(opts)
         options["is_async"] = self._is_async
         if options.get("concurrency_groups"):
@@ -188,7 +201,9 @@ class ActorClass:
             for n, m in inspect.getmembers(self._cls, inspect.isfunction)
             if getattr(m, "__ray_tpu_method_opts__", None)}
         return ActorHandle(actor_id, self._method_names, owner=owner,
-                           method_opts=method_opts)
+                           method_opts=method_opts,
+                           max_task_retries=int(
+                               opts.get("max_task_retries") or 0))
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -82,13 +82,26 @@ def init(address: str | None = None,
                 "address='auto' but no running cluster found "
                 "(RAY_TPU_ADDRESS unset)")
     if address:
-        # Client-mode URI (ray: ray.init("ray://host:port") proxies the
-        # API to a cluster; here the driver IS a first-class cluster
-        # client over DCN, so the scheme just strips to host:port).
+        # `ray://host:port`: if the endpoint is a client proxy
+        # (ray_tpu.client.server), enter client mode — the API routes
+        # through a per-client host driver and this process never joins
+        # the cluster trust domain (ray: ray.init("ray://...") → client
+        # server).  Otherwise (or with `ray-tpu://`) the scheme strips
+        # and the driver attaches directly over DCN.
+        is_ray_scheme = address.startswith("ray://")
         for scheme in ("ray-tpu://", "ray://"):
             if address.startswith(scheme):
                 address = address[len(scheme):]
                 break
+        if is_ray_scheme:
+            from ray_tpu import client as client_mod
+
+            if client_mod.probe(address):
+                client_mod.connect(address, namespace=namespace)
+                _initialized = True
+                atexit.register(shutdown)
+                return {"controller_address": address,
+                        "client_mode": True}
     config = Config().override(_system_config)
     if object_store_memory:
         config.object_store_memory = object_store_memory
@@ -183,8 +196,11 @@ def _pick_agent(controller_addr: str, timeout: float = 30.0) -> tuple[str, str]:
 
 def shutdown() -> None:
     global _initialized
+    from ray_tpu import client as client_mod
     from ray_tpu._private import worker as worker_mod
 
+    if client_mod._ctx is not None:
+        client_mod._ctx.disconnect()
     if worker_mod._global_worker is not None:
         try:
             worker_mod._global_worker.shutdown()
@@ -242,8 +258,11 @@ def remote(*args, **kwargs):
 
 def get(refs: ObjectRef | Sequence[ObjectRef],
         *, timeout: float | None = None) -> Any:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        return client_mod._ctx.get(refs, timeout)
     # Compiled-DAG execution results (ray: ray.get on CompiledDAGRef reads
     # the DAG's output channel, no object-store involvement).
     from ray_tpu.dag.dag_node import CompiledDAGRef
@@ -260,8 +279,11 @@ def get(refs: ObjectRef | Sequence[ObjectRef],
 
 
 def put(value: Any) -> ObjectRef:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        return client_mod._ctx.put(value)
     if isinstance(value, ObjectRef):
         raise TypeError("calling put() on an ObjectRef is not allowed")
     return global_worker().put_object(value)
@@ -270,17 +292,23 @@ def put(value: Any) -> ObjectRef:
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: float | None = None,
          fetch_local: bool = True) -> tuple[list[ObjectRef], list[ObjectRef]]:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
     refs = list(refs)
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds the number of refs")
+    if client_mod._ctx is not None:
+        return client_mod._ctx.wait(refs, num_returns, timeout)
     return global_worker().wait(refs, num_returns, timeout)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        return client_mod._ctx.kill(actor)
     global_worker().kill_actor(actor.actor_id, no_restart)
 
 
@@ -291,8 +319,11 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 
 
 def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        return client_mod._ctx.get_actor(name, namespace)
     core = global_worker()
     reply, _ = core.call(
         core.controller_addr, "get_actor_by_name",

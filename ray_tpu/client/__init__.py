@@ -1,0 +1,226 @@
+"""ray_tpu.client — client mode over the proxy server.
+
+Analog of ray: python/ray/util/client/__init__.py (RayAPIStub.connect)
++ worker.py (the client-side API shim).  `ray_tpu.init("ray://host:port")`
+lands here when the endpoint is a `ray_tpu.client.server` proxy: the
+public API (remote/get/put/wait/actors) is transparently routed through
+the per-client host driver the proxy spawned, so user code is unchanged
+while the client process never joins the cluster trust domain.
+
+Not supported in client mode (use direct attach): placement groups,
+streaming generators, DAGs.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Sequence
+
+from ray_tpu.client.common import ClientActorHandle, ClientObjectRef
+
+# Module-global active context; the public API checks this first.
+_ctx: "ClientContext | None" = None
+
+
+def _cloudpickle_dumps(value) -> bytes:
+    import cloudpickle
+
+    return cloudpickle.dumps(value)
+
+
+class ClientContext:
+    """One connection to a proxy = one dedicated host driver."""
+
+    def __init__(self, proxy_addr: str, namespace: str = "default"):
+        self.proxy_addr = proxy_addr
+        self.namespace = namespace
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True,
+            name="raytpu-client-io")
+        self._thread.start()
+        self._cli = self._run(self._make_client())
+        reply, _ = self._call_proxy("client_connect",
+                                    {"namespace": namespace})
+        self.client_id = reply["client_id"]
+        self._closed = False
+
+    async def _make_client(self):
+        import zmq.asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        self._zctx = zmq.asyncio.Context()
+        return RpcClient(self._zctx, self.proxy_addr)
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def _call_proxy(self, method: str, header: dict,
+                    blobs: list | None = None, timeout: float = 600.0):
+        return self._run(self._cli.call(method, header, blobs or [],
+                                        timeout=timeout))
+
+    def _req(self, op: str, header: dict, blobs: list | None = None,
+             timeout: float = 600.0):
+        """One API op, relayed through the proxy to this client's host.
+        Remote exceptions unwrap to their original cause."""
+        from ray_tpu._private.rpc import RemoteError
+
+        try:
+            return self._call_proxy(
+                "client_req",
+                {"client_id": self.client_id, "op": op, "header": header,
+                 "timeout": timeout},
+                blobs, timeout=timeout + 30.0)
+        except RemoteError as e:
+            cause = e.cause
+            while isinstance(cause, RemoteError):
+                cause = cause.cause
+            if isinstance(cause, BaseException):
+                raise cause from None
+            raise
+
+    # ------------------------------------------------------------- API
+    def put(self, value: Any) -> ClientObjectRef:
+        reply, _ = self._req("put", {}, [_cloudpickle_dumps(value)])
+        return ClientObjectRef(reply["ref"], self)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        import pickle
+
+        reply, blobs = self._req(
+            "get", {"refs": [r.hex for r in ref_list], "timeout": timeout})
+        values = pickle.loads(blobs[0])
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], num_returns: int,
+             timeout: float | None):
+        by_hex = {r.hex: r for r in refs}
+        reply, _ = self._req("wait", {"refs": list(by_hex),
+                                      "num_returns": num_returns,
+                                      "timeout": timeout})
+        return ([by_hex[x] for x in reply["done"]],
+                [by_hex[x] for x in reply["not_done"]])
+
+    def submit_function(self, fn, args: tuple, kwargs: dict, opts: dict):
+        reply, _ = self._req(
+            "task", {"opts": _plain_opts(opts)},
+            [_cloudpickle_dumps((fn, args, kwargs))])
+        refs = [ClientObjectRef(x, self) for x in reply["refs"]]
+        return refs[0] if len(refs) == 1 else refs
+
+    def create_actor(self, cls, args: tuple, kwargs: dict,
+                     opts: dict) -> ClientActorHandle:
+        reply, _ = self._req(
+            "create_actor", {"opts": _plain_opts(opts)},
+            [_cloudpickle_dumps((cls, args, kwargs))])
+        return ClientActorHandle(reply["actor_id"], self)
+
+    def actor_call(self, actor_id: str, method: str, args: tuple,
+                   kwargs: dict, opts: dict):
+        reply, _ = self._req(
+            "actor_call",
+            {"actor_id": actor_id, "method": method,
+             "opts": _plain_opts(opts)},
+            [_cloudpickle_dumps((args, kwargs))])
+        refs = [ClientObjectRef(x, self) for x in reply["refs"]]
+        return refs[0] if len(refs) == 1 else refs
+
+    def get_actor(self, name: str,
+                  namespace: str | None = None) -> ClientActorHandle:
+        reply, _ = self._req("get_actor",
+                             {"name": name, "namespace": namespace})
+        return ClientActorHandle(reply["actor_id"], self)
+
+    def kill(self, handle: ClientActorHandle) -> None:
+        self._req("kill_actor", {"actor_id": handle.actor_id})
+
+    def cluster_resources(self) -> dict:
+        reply, _ = self._req("cluster_info", {})
+        return reply["resources"]
+
+    def _release(self, ref_hexes: list[str]) -> None:
+        """Fire-and-forget: __del__ may run on ANY thread — including the
+        client IO loop thread (GC during a callback), where a blocking
+        .result() would deadlock the loop on itself.  Best-effort GC
+        needs no reply anyway."""
+        if self._closed:
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._cli.call(
+                    "client_req",
+                    {"client_id": self.client_id, "op": "release",
+                     "header": {"refs": ref_hexes}, "timeout": 10.0},
+                    [], timeout=10.0),
+                self._loop).add_done_callback(
+                    lambda f: f.exception())   # consume, never raise
+        except Exception:  # noqa: BLE001 - teardown
+            pass
+
+    def disconnect(self) -> None:
+        global _ctx
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._call_proxy("client_disconnect",
+                             {"client_id": self.client_id}, timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        self._run(self._close_async())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if _ctx is self:
+            _ctx = None
+
+    async def _close_async(self):
+        self._cli.close()
+        self._zctx.term()
+
+
+def _plain_opts(opts: dict) -> dict:
+    """Only msgpack-able option values cross the wire."""
+    out = {}
+    for k, v in (opts or {}).items():
+        if isinstance(v, (str, int, float, bool, type(None))):
+            out[k] = v
+        elif isinstance(v, dict) and all(
+                isinstance(x, (str, int, float, bool)) for x in v.values()):
+            out[k] = v
+        else:
+            raise ValueError(
+                f"option {k!r} is not supported in client mode "
+                "(placement groups / strategy objects need direct attach)")
+    return out
+
+
+def connect(proxy_addr: str, namespace: str = "default") -> ClientContext:
+    """Connect to a client proxy; returns the active context."""
+    global _ctx
+    ctx = ClientContext(proxy_addr, namespace)
+    _ctx = ctx
+    return ctx
+
+
+def probe(addr: str, timeout: float = 3.0) -> bool:
+    """True iff addr is a client proxy (vs a controller)."""
+    async def _go():
+        import zmq.asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        zctx = zmq.asyncio.Context()
+        cli = RpcClient(zctx, addr)
+        try:
+            reply, _ = await cli.call("client_ping", {}, timeout=timeout)
+            return reply.get("role") == "client_proxy"
+        except Exception:  # noqa: BLE001 - not a proxy / unreachable
+            return False
+        finally:
+            cli.close()
+            zctx.term()
+
+    return asyncio.run(_go())

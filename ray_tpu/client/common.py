@@ -1,0 +1,94 @@
+"""Client-mode proxies for ObjectRef / ActorHandle.
+
+Analog of ray: python/ray/util/client/common.py (ClientObjectRef:108,
+ClientActorHandle:345).  These are pure handles: the real ObjectRef /
+ActorHandle lives pinned in the per-client host process
+(`ray_tpu.client.host`), and pickling a client handle into task args
+resolves back to the real object host-side via `_resolve_ref` /
+`_resolve_actor`.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+class ClientObjectRef:
+    """Handle to an object owned by this client's host driver."""
+
+    __slots__ = ("_id", "_ctx", "__weakref__")
+
+    def __init__(self, id_hex: str, ctx):
+        self._id = id_hex
+        self._ctx = ctx
+
+    @property
+    def hex(self) -> str:
+        return self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._id[:16]}…)"
+
+    def __reduce__(self):
+        # Pickled into task args: the host substitutes its pinned real ref.
+        from ray_tpu.client.host import _resolve_ref
+
+        return (_resolve_ref, (self._id,))
+
+    def __del__(self):
+        ctx = self._ctx
+        if ctx is not None:
+            try:
+                ctx._release([self._id])
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+
+
+class ClientActorMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str,
+                 opts: dict | None = None):
+        self._handle = handle
+        self._name = name
+        self._opts = opts or {}
+
+    def remote(self, *args, **kwargs):
+        return self._handle._ctx.actor_call(
+            self._handle._actor_id, self._name, args, kwargs, self._opts)
+
+    def options(self, **opts) -> "ClientActorMethod":
+        return ClientActorMethod(self._handle, self._name,
+                                 {**self._opts, **opts})
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("actor methods cannot be called directly; "
+                        f"use {self._name}.remote()")
+
+
+class ClientActorHandle:
+    """Handle to an actor created via (and pinned by) the client host."""
+
+    def __init__(self, actor_id: str, ctx):
+        self._actor_id = actor_id
+        self._ctx = ctx
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ClientActorHandle({self._actor_id[:12]}…)"
+
+    def __reduce__(self):
+        from ray_tpu.client.host import _resolve_actor
+
+        return (_resolve_actor, (self._actor_id,))

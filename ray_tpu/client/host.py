@@ -1,0 +1,183 @@
+"""Per-client host driver (the isolation boundary of the client proxy).
+
+Analog of ray's "SpecificServer" — the dedicated per-client server the
+proxy spawns so each client gets its own driver, namespace, and object
+ownership (ray: python/ray/util/client/server/proxier.py:133 SpecificServer,
+server.py RayletServicer).  One subprocess per connected client: it
+attaches to the cluster as a normal driver in the client's namespace and
+executes API calls shipped over RPC.  All objects/actors a client sees are
+owned HERE — two clients share nothing but the cluster itself, and a
+disconnect tears the whole trust domain down with the process.
+
+Run: python -m ray_tpu.client.host --cluster HOST:PORT --namespace NS
+Announces {"host_addr": ...} on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import ray_tpu
+
+# Process-global: _resolve_ref/_resolve_actor (reached from unpickling
+# client payloads) need the host instance.
+_HOST: "ClientHost | None" = None
+
+
+def _resolve_ref(id_hex: str):
+    """Unpickle hook: a ClientObjectRef in task args becomes the real
+    pinned ObjectRef of this host."""
+    ref = _HOST.objects.get(id_hex) if _HOST else None
+    if ref is None:
+        raise ValueError(f"client ref {id_hex[:16]} is not pinned on "
+                         "this client host (released or foreign client)")
+    return ref
+
+
+def _resolve_actor(actor_id: str):
+    handle = _HOST.actors.get(actor_id) if _HOST else None
+    if handle is None:
+        raise ValueError(f"client actor {actor_id[:12]} is not pinned on "
+                         "this client host")
+    return handle
+
+
+class ClientHost:
+    """RPC surface mirroring the public core API, one client's worth."""
+
+    def __init__(self) -> None:
+        self.objects: dict[str, ray_tpu.ObjectRef] = {}
+        self.actors: dict[str, object] = {}
+
+    def _pin(self, ref) -> str:
+        h = ref.hex()
+        self.objects[h] = ref
+        return h
+
+    @staticmethod
+    def _loads(blob: bytes):
+        import pickle
+
+        return pickle.loads(blob)
+
+    @staticmethod
+    def _dumps(value) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps(value)
+
+    # ------------------------------------------------------------- ops
+    async def rpc_put(self, h: dict, blobs: list):
+        value = self._loads(blobs[0])
+        ref = await asyncio.to_thread(ray_tpu.put, value)
+        return {"ref": self._pin(ref)}
+
+    async def rpc_get(self, h: dict, blobs: list):
+        refs = [self.objects[x] for x in h["refs"]]
+        values = await asyncio.to_thread(
+            ray_tpu.get, refs, timeout=h.get("timeout"))
+        return {}, [self._dumps(values)]
+
+    async def rpc_task(self, h: dict, blobs: list):
+        fn, args, kwargs = self._loads(blobs[0])
+        opts = h.get("opts") or {}
+        remote_fn = ray_tpu.remote(fn) if not opts \
+            else ray_tpu.remote(fn).options(**opts)
+        refs = await asyncio.to_thread(
+            lambda: remote_fn.remote(*args, **kwargs))
+        refs = refs if isinstance(refs, list) else [refs]
+        return {"refs": [self._pin(r) for r in refs]}
+
+    async def rpc_create_actor(self, h: dict, blobs: list):
+        cls, args, kwargs = self._loads(blobs[0])
+        opts = h.get("opts") or {}
+        actor_cls = ray_tpu.remote(cls) if not opts \
+            else ray_tpu.remote(cls).options(**opts)
+        handle = await asyncio.to_thread(
+            lambda: actor_cls.remote(*args, **kwargs))
+        self.actors[handle.actor_id] = handle
+        return {"actor_id": handle.actor_id}
+
+    async def rpc_actor_call(self, h: dict, blobs: list):
+        args, kwargs = self._loads(blobs[0])
+        handle = self.actors[h["actor_id"]]
+        method = getattr(handle, h["method"])
+        if h.get("opts"):
+            method = method.options(**h["opts"])
+        refs = await asyncio.to_thread(
+            lambda: method.remote(*args, **kwargs))
+        refs = refs if isinstance(refs, list) else [refs]
+        return {"refs": [self._pin(r) for r in refs]}
+
+    async def rpc_get_actor(self, h: dict, blobs: list):
+        handle = await asyncio.to_thread(
+            ray_tpu.get_actor, h["name"], h.get("namespace"))
+        self.actors[handle.actor_id] = handle
+        return {"actor_id": handle.actor_id}
+
+    async def rpc_kill_actor(self, h: dict, blobs: list):
+        handle = self.actors.get(h["actor_id"])
+        if handle is not None:
+            await asyncio.to_thread(ray_tpu.kill, handle)
+        return {}
+
+    async def rpc_wait(self, h: dict, blobs: list):
+        refs = [self.objects[x] for x in h["refs"]]
+        done, not_done = await asyncio.to_thread(
+            lambda: ray_tpu.wait(refs, num_returns=h["num_returns"],
+                                 timeout=h.get("timeout")))
+        return {"done": [r.hex() for r in done],
+                "not_done": [r.hex() for r in not_done]}
+
+    async def rpc_release(self, h: dict, blobs: list):
+        for x in h.get("refs", ()):
+            self.objects.pop(x, None)
+        for a in h.get("actors", ()):
+            self.actors.pop(a, None)
+        return {}
+
+    async def rpc_cluster_info(self, h: dict, blobs: list):
+        return {"resources": await asyncio.to_thread(
+            ray_tpu.cluster_resources)}
+
+
+async def _serve() -> None:
+    import zmq.asyncio
+
+    from ray_tpu._private.rpc import RpcServer
+
+    ctx = zmq.asyncio.Context()
+    server = RpcServer(ctx)
+    server.register_all(_HOST)
+    server.start()
+    print(json.dumps({"host_addr": server.address}), flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    global _HOST
+    from ray_tpu._private.stack_dump import install as _install_stack
+
+    _install_stack("client-host")
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster", required=True)
+    p.add_argument("--namespace", default="default")
+    args = p.parse_args(sys.argv[1:])
+    # init() before the serve loop: it drives its own asyncio.run
+    # internally (attach/agent discovery), which cannot nest in a
+    # running loop.
+    ray_tpu.init(address=args.cluster, namespace=args.namespace)
+    _HOST = ClientHost()
+    # `python -m` runs this file as __main__, but unpickling client
+    # payloads resolves _resolve_ref through the canonical import path —
+    # the canonical module object must see the same host instance.
+    from ray_tpu.client import host as _canonical
+
+    _canonical._HOST = _HOST
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Client proxy server: the standalone `ray://` entry point.
+
+Analog of ray: python/ray/util/client/server/proxier.py (ProxyManager
+:108) + server.py (serve:1000).  Clients connect here instead of joining
+the cluster trust domain; for each client the proxy spawns a dedicated
+host driver (`ray_tpu.client.host`) in the client's namespace and relays
+that client's requests to it.  Per-client isolation is process-level:
+object/actor pins, pickles, and namespace all live in the per-client
+host, so clients cannot reach each other's state through the proxy.
+
+Run: python -m ray_tpu.client.server --cluster HOST:PORT [--port N]
+Announces {"proxy_addr": ...} on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import subprocess
+import sys
+import time
+import uuid
+
+
+class ProxyServer:
+    def __init__(self, cluster_addr: str) -> None:
+        self.cluster_addr = cluster_addr
+        # client_id -> (subprocess, RpcClient to its host)
+        self.hosts: dict[str, tuple[subprocess.Popen, object]] = {}
+        self._pool = None   # set in serve()
+
+    async def rpc_client_ping(self, h: dict, blobs: list):
+        return {"role": "client_proxy", "cluster": self.cluster_addr}
+
+    async def rpc_client_connect(self, h: dict, blobs: list):
+        namespace = h.get("namespace") or "default"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.client.host",
+             "--cluster", self.cluster_addr, "--namespace", namespace],
+            stdout=subprocess.PIPE)
+        host_addr = await asyncio.to_thread(self._read_announce, proc)
+        client_id = uuid.uuid4().hex
+        self.hosts[client_id] = (proc, self._pool.get(host_addr))
+        return {"client_id": client_id}
+
+    @staticmethod
+    def _read_announce(proc: subprocess.Popen, timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"client host exited with {proc.returncode}")
+                time.sleep(0.01)
+                continue
+            line = line.strip()
+            if line.startswith(b"{"):
+                return json.loads(line)["host_addr"]
+        raise TimeoutError("client host did not announce")
+
+    async def rpc_client_req(self, h: dict, blobs: list):
+        entry = self.hosts.get(h["client_id"])
+        if entry is None:
+            raise ConnectionError("unknown or disconnected client_id")
+        _, cli = entry
+        return await cli.call(h["op"], h.get("header") or {}, blobs,
+                              timeout=h.get("timeout", 600.0))
+
+    async def rpc_client_disconnect(self, h: dict, blobs: list):
+        entry = self.hosts.pop(h["client_id"], None)
+        if entry is not None:
+            proc, _cli = entry
+            proc.terminate()
+        return {}
+
+    def shutdown(self) -> None:
+        for proc, _ in self.hosts.values():
+            proc.terminate()
+        self.hosts.clear()
+
+
+async def _main(argv: list[str]) -> None:
+    import zmq.asyncio
+
+    from ray_tpu._private.rpc import ClientPool, RpcServer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster", required=True)
+    p.add_argument("--port", type=int, default=None)
+    args = p.parse_args(argv)
+    ctx = zmq.asyncio.Context()
+    proxy = ProxyServer(args.cluster)
+    proxy._pool = ClientPool(ctx)
+    server = RpcServer(ctx, port=args.port)
+    server.register_all(proxy)
+    server.start()
+    print(json.dumps({"proxy_addr": server.address}), flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        proxy.shutdown()
+
+
+def main() -> None:
+    from ray_tpu._private.stack_dump import install as _install_stack
+
+    _install_stack("client-proxy")
+    asyncio.run(_main(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,13 @@ class TaskError(RayTpuError):
         self.remote_tb = remote_tb
         super().__init__(f"{type(cause).__name__}: {cause}\n{remote_tb}")
 
+    def __reduce__(self):
+        # Multi-field exceptions MUST override reduce: the default
+        # replays args=(message,) into __init__, which mangles the
+        # fields at every process hop (errors are routinely pickled —
+        # cached returns, relay chains, actor death fan-out).
+        return (TaskError, (self.cause, self.remote_tb))
+
 
 class ActorError(RayTpuError):
     """Actor call failed because the actor is dead or died mid-call
@@ -27,6 +34,9 @@ class ActorError(RayTpuError):
         self.actor_id = actor_id
         self.cause = cause
         super().__init__(f"actor {actor_id[:8]} unavailable: {cause}")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.cause))
 
 
 class ActorDiedError(ActorError):
@@ -40,6 +50,9 @@ class ObjectLostError(RayTpuError):
     def __init__(self, object_id: str = ""):
         self.object_id = object_id
         super().__init__(f"object {object_id[:12]} lost")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id,))
 
 
 class WorkerCrashedError(RayTpuError):

@@ -6,4 +6,15 @@ src/ray/core_worker/experimental_mutable_object_manager.h).
 """
 from ray_tpu.experimental.channel import Channel  # noqa: F401
 
-__all__ = ["Channel"]
+
+def object_sizes(refs) -> "list[int | None]":
+    """Owner-table payload sizes for locally-owned refs, None when
+    unknown (ray: ray.experimental reference-table introspection).
+    Cheap — no payload fetch; Data's resource manager budgets with it.
+    """
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().object_sizes(list(refs))
+
+
+__all__ = ["Channel", "object_sizes"]

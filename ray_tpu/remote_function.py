@@ -68,8 +68,12 @@ class RemoteFunction:
         return clone
 
     def _remote(self, args: tuple, kwargs: dict, opts: dict):
+        from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
 
+        if client_mod._ctx is not None:
+            return client_mod._ctx.submit_function(self._function, args,
+                                                   kwargs, opts)
         options = resolve_pg_options(opts)
         if options.get("placement_group") == "default":
             options.pop("placement_group")

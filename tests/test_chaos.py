@@ -18,19 +18,30 @@ import ray_tpu
 
 def _worker_pids() -> list[int]:
     """Workers of THIS cluster only: children of our spawned agent (a
-    machine-wide grep could kill another test session's workers)."""
+    machine-wide grep could kill another test session's workers).
+    Zygote-forked workers keep the zygote's argv (fork doesn't rewrite
+    it), so they are found as children OF the zygote instead."""
     from ray_tpu import api as _api
 
     agent_pids = {str(p.pid) for p in _api._head_processes}
     out = subprocess.run(["ps", "-eo", "pid,ppid,args"],
                          capture_output=True, text=True).stdout
-    pids = []
+    rows = []
     for line in out.splitlines():
         parts = line.split(None, 2)
-        if (len(parts) == 3 and parts[1] in agent_pids
-                and "ray_tpu._private.worker_main" in parts[2]):
+        if len(parts) == 3:
+            rows.append(parts)
+    zygote_pids = {pid for pid, ppid, args in rows
+                   if ppid in agent_pids
+                   and "ray_tpu._private.zygote" in args}
+    pids = []
+    for pid, ppid, args in rows:
+        cold = (ppid in agent_pids
+                and "ray_tpu._private.worker_main" in args)
+        warm = ppid in zygote_pids
+        if cold or warm:
             try:
-                pids.append(int(parts[0]))
+                pids.append(int(pid))
             except ValueError:
                 pass
     return pids
