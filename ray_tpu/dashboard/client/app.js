@@ -1,0 +1,142 @@
+/* ray-tpu dashboard SPA: tabs over the JSON API (see head.py routes).
+   No framework — the environment ships no npm; fetch + innerHTML keep it
+   auditable and dependency-free. */
+"use strict";
+
+const TABS = ["overview", "nodes", "actors", "tasks", "placement groups",
+              "jobs", "serve", "objects", "metrics"];
+let current = "overview";
+let timer = null;
+
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+
+async function getJSON(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+  return r.json();
+}
+
+function table(headers, rows) {
+  const h = headers.map((x) => `<th>${esc(x)}</th>`).join("");
+  const body = rows.length
+    ? rows.map((r) => `<tr>${r.map((c) => `<td>${c}</td>`).join("")}</tr>`)
+        .join("")
+    : `<tr><td colspan="${headers.length}" class="muted">none</td></tr>`;
+  return `<table><tr>${h}</tr>${body}</table>`;
+}
+
+const state = (s) => `<span class="${esc(s)}">${esc(s)}</span>`;
+const short = (s) => `<span title="${esc(s)}">${esc(String(s).slice(0, 12))}</span>`;
+const fmtRes = (r) => esc(Object.entries(r || {})
+  .map(([k, v]) => `${k}:${Math.round(v * 100) / 100}`).join(" "));
+
+const render = {
+  async overview() {
+    const [nodes, actors, status] = await Promise.all([
+      getJSON("/api/v0/nodes"), getJSON("/api/v0/actors"),
+      getJSON("/api/cluster_status")]);
+    const alive = nodes.filter((n) => n.state === "ALIVE");
+    const cards = [
+      ["nodes alive", alive.length],
+      ["actors alive", actors.filter((a) => a.state === "ALIVE").length],
+      ["cpus", fmtCap(alive, "CPU")],
+      ["tpus", fmtCap(alive, "TPU")],
+    ].map(([k, v]) =>
+      `<div class="card"><div class="k">${k}</div><div class="v">${v}</div></div>`
+    ).join("");
+    return `<div class="cards">${cards}</div>` +
+      `<pre>${esc(JSON.stringify(status, null, 2))}</pre>`;
+  },
+  async nodes() {
+    const nodes = await getJSON("/api/v0/nodes");
+    return table(["node", "state", "agent", "resources", "available"],
+      nodes.map((n) => [short(n.node_id), state(n.state),
+                        esc(n.agent_addr || ""), fmtRes(n.resources),
+                        fmtRes(n.available)]));
+  },
+  async actors() {
+    const actors = await getJSON("/api/v0/actors");
+    return table(["actor", "name", "state", "class", "node", "restarts"],
+      actors.map((a) => [short(a.actor_id), esc(a.name || ""),
+                         state(a.state), esc(a.class_name || ""),
+                         short(a.node_id || ""), esc(a.num_restarts ?? 0)]));
+  },
+  async tasks() {
+    const [summary, tasks] = await Promise.all([
+      getJSON("/api/v0/tasks/summarize"), getJSON("/api/v0/tasks?limit=200")]);
+    const cards = Object.entries(summary.by_state || summary || {})
+      .map(([k, v]) =>
+        `<div class="card"><div class="k">${esc(k)}</div><div class="v">${esc(v)}</div></div>`)
+      .join("");
+    const rows = (Array.isArray(tasks) ? tasks : tasks.tasks || [])
+      .slice(-200).reverse().map((t) => [
+        short(t.task_id || ""), esc(t.name || ""), state(t.state || ""),
+        esc(t.func_or_class_name || ""), short(t.node_id || "")]);
+    return `<div class="cards">${cards}</div>` +
+      table(["task", "name", "state", "func", "node"], rows);
+  },
+  async "placement groups"() {
+    const pgs = await getJSON("/api/v0/placement_groups");
+    return table(["pg", "state", "strategy", "bundles"],
+      pgs.map((p) => [short(p.pg_id || p.placement_group_id || ""),
+                      state(p.state), esc(p.strategy || ""),
+                      esc(JSON.stringify(p.bundles || []))]));
+  },
+  async jobs() {
+    const jobs = await getJSON("/api/jobs/");
+    return table(["job", "status", "entrypoint", "start", "end"],
+      (Array.isArray(jobs) ? jobs : []).map((j) => [
+        short(j.submission_id || j.job_id || ""), state(j.status || ""),
+        esc((j.entrypoint || "").slice(0, 80)),
+        fmtTime(j.start_time), fmtTime(j.end_time)]));
+  },
+  async serve() {
+    const s = await getJSON("/api/serve/applications/");
+    return `<pre>${esc(JSON.stringify(s, null, 2))}</pre>`;
+  },
+  async objects() {
+    const o = await getJSON("/api/v0/objects");
+    return `<pre>${esc(JSON.stringify(o, null, 2))}</pre>`;
+  },
+  async metrics() {
+    const r = await fetch("/metrics");
+    return `<pre>${esc(await r.text())}</pre>`;
+  },
+};
+
+function fmtCap(nodes, key) {
+  const total = nodes.reduce((a, n) => a + (n.resources?.[key] || 0), 0);
+  const avail = nodes.reduce((a, n) => a + (n.available?.[key] || 0), 0);
+  return total ? `${Math.round((total - avail) * 10) / 10}/${total}` : "0";
+}
+const fmtTime = (t) => t ? esc(new Date(t * 1000).toLocaleTimeString()) : "";
+
+async function refresh() {
+  try {
+    $("main").innerHTML = await render[current]();
+    $("error").style.display = "none";
+    $("refreshed").textContent =
+      `updated ${new Date().toLocaleTimeString()}`;
+  } catch (e) {
+    $("error").textContent = String(e);
+    $("error").style.display = "block";
+  }
+}
+
+function select(tab) {
+  current = tab;
+  document.querySelectorAll("nav button").forEach((b) =>
+    b.classList.toggle("active", b.dataset.tab === tab));
+  refresh();
+}
+
+window.addEventListener("DOMContentLoaded", () => {
+  $("tabs").innerHTML = TABS.map((t) =>
+    `<button data-tab="${t}">${t}</button>`).join("");
+  document.querySelectorAll("nav button").forEach((b) =>
+    b.addEventListener("click", () => select(b.dataset.tab)));
+  select("overview");
+  timer = setInterval(refresh, 3000);
+});

@@ -110,7 +110,11 @@ class DashboardHead:
         from aiohttp import web
 
         r = app.router
-        r.add_get("/", self._index)
+        # Frontend SPA (ray: dashboard/client React build → static files;
+        # here a dependency-free vanilla-JS page over the same API).
+        r.add_get("/", self._static_index)
+        r.add_get("/app.js", self._static_appjs)
+        r.add_get("/legacy", self._index)
         r.add_get("/api/version", self._version)
         r.add_get("/api/healthz", self._healthz)
         r.add_get("/api/gcs_healthz", self._healthz)
@@ -163,6 +167,21 @@ class DashboardHead:
     async def _call(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
             None, fn, *args)
+
+    async def _static_index(self, _req):
+        return self._static_file("index.html", "text/html")
+
+    async def _static_appjs(self, _req):
+        return self._static_file("app.js", "application/javascript")
+
+    def _static_file(self, name: str, ctype: str):
+        import os
+
+        from aiohttp import web
+
+        path = os.path.join(os.path.dirname(__file__), "client", name)
+        with open(path, encoding="utf-8") as f:
+            return web.Response(text=f.read(), content_type=ctype)
 
     async def _index(self, _req):
         from aiohttp import web

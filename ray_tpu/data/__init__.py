@@ -6,6 +6,7 @@ executor on ray_tpu tasks/actors; device-ready sharded batches via
 iter_jax_batches / streaming_split.
 """
 from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa: F401,E501
                                   from_items, from_numpy, from_pandas,
                                   range, read_binary_files, read_csv,

@@ -1,0 +1,26 @@
+"""DataContext: per-process execution knobs (ray:
+python/ray/data/context.py DataContext.get_current).
+
+Holds the streaming executor's resource limits; tests and users tune
+these without threading parameters through every Dataset call.
+"""
+from __future__ import annotations
+
+DEFAULT_MEMORY_BUDGET = 256 * 1024 * 1024
+DEFAULT_MAX_TASKS = 8
+
+
+class DataContext:
+    _current: "DataContext | None" = None
+
+    def __init__(self) -> None:
+        # Byte budget the resource manager splits across live operators.
+        self.memory_budget: int = DEFAULT_MEMORY_BUDGET
+        # Per-operator concurrent task cap.
+        self.max_tasks_per_op: int = DEFAULT_MAX_TASKS
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = DataContext()
+        return cls._current

@@ -382,16 +382,76 @@ def _concat_blocks(*parts):
 
 
 @ray_tpu.remote
-def _sort_block(block, key, desc):
-    import pyarrow.compute as pc  # noqa: F401
+def _sample_block(block, key, k):
+    """Sample up to k key values from one block (ray: SortTaskSpec
+    sample_boundaries, sort_task_spec.py:91)."""
+    import numpy as np
 
-    return block.sort_by([(key, "descending" if desc else "ascending")])
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    if n == 0:
+        return np.array([])
+    col = np.asarray(acc.to_numpy()[key])
+    if n <= k:
+        return col
+    idx = np.linspace(0, n - 1, k).astype(np.int64)
+    return col[idx]
+
+
+@ray_tpu.remote
+def _range_partition(block, key, desc, boundaries):
+    """Map side of the distributed sort: sort one block, then cut it at
+    the sampled boundaries into len(boundaries)+1 runs (ray:
+    sort_task_spec.py:149 map phase)."""
+    import numpy as np
+
+    srt = block.sort_by([(key, "descending" if desc else "ascending")])
+    n_parts = len(boundaries) + 1
+    acc = BlockAccessor.for_block(srt)
+    rows = acc.num_rows()
+    if rows == 0:
+        return [srt] * n_parts
+    col = np.asarray(acc.to_numpy()[key])
+    if desc:
+        # col is descending; boundaries ascending.  Partition j holds the
+        # j-th range from the TOP; works for any sortable dtype (no
+        # negation trick, so strings partition too).
+        asc = col[::-1]
+        cuts = [rows - int(np.searchsorted(asc, b, side="right"))
+                for b in boundaries[::-1]]
+    else:
+        cuts = [int(np.searchsorted(col, b, side="left"))
+                for b in boundaries]
+    out, prev = [], 0
+    for c in list(cuts) + [rows]:
+        c = int(c)
+        out.append(srt.slice(prev, c - prev))
+        prev = c
+    return out
 
 
 @ray_tpu.remote
 def _merge_sorted(key, desc, *blocks):
     merged = BlockAccessor.concat(list(blocks))
     return merged.sort_by([(key, "descending" if desc else "ascending")])
+
+
+@ray_tpu.remote
+def _hash_partition_rows(block, keys, n):
+    """Partition one block n ways by a deterministic hash of the key
+    columns (process-independent, unlike builtin hash)."""
+    import numpy as np
+    import pandas as pd
+
+    acc = BlockAccessor.for_block(block)
+    if acc.num_rows() == 0:
+        return [block] * n
+    df = acc.to_pandas()
+    h = pd.util.hash_pandas_object(df[keys].astype(str).agg("\0".join,
+                                                            axis=1),
+                                   index=False).to_numpy()
+    part = (h % n).astype(np.int64)
+    return [block.take(np.nonzero(part == i)[0]) for i in range(n)]
 
 
 @ray_tpu.remote
@@ -473,18 +533,142 @@ def _all_to_all(op: L.LogicalOp, refs: list) -> list:
         cols = list(zip(*[p if isinstance(p, list) else [p] for p in parts]))
         return [_concat_blocks.remote(*col) for col in cols]
     if isinstance(op, L.Sort):
+        # Distributed range-partitioned sort (ray: sort_task_spec.py:91
+        # sample_boundaries, :149 map/reduce): sample each block's keys,
+        # cut the key space into len(refs) ranges at the sampled
+        # quantiles, partition every block per range, merge each range
+        # independently.  No single O(dataset) merge task.
+        import numpy as np
+
         if not refs:
             return []
-        sorted_refs = [_sort_block.remote(r, op.key, op.descending)
-                       for r in refs]
-        return [_merge_sorted.remote(op.key, op.descending, *sorted_refs)]
+        n = len(refs)
+        if n == 1:
+            return [_merge_sorted.remote(op.key, op.descending, refs[0])]
+        samples = ray_tpu.get(
+            [_sample_block.remote(r, op.key, 64) for r in refs])
+        allv = np.sort(np.concatenate([s for s in samples if len(s)])
+                       if any(len(s) for s in samples) else np.array([0]))
+        qs = np.linspace(0, 1, n + 1)[1:-1]
+        # Positional quantiles: dtype-agnostic (strings sort too).
+        boundaries = list(allv[(qs * (len(allv) - 1)).astype(int)])
+        parts = [_range_partition.options(num_returns=n).remote(
+            r, op.key, op.descending, boundaries) for r in refs]
+        cols = list(zip(*[p if isinstance(p, list) else [p]
+                          for p in parts]))
+        return [_merge_sorted.remote(op.key, op.descending, *col)
+                for col in cols]
     if isinstance(op, L.Aggregate):
         partials = [_partial_agg.remote(r, op.keys, op.aggs) for r in refs]
-        return [_final_agg.remote(op.keys, op.aggs, *partials)]
+        if not op.keys or len(refs) <= 1:
+            # Global (keyless) aggregate: partials are single rows —
+            # one tiny combine.
+            return [_final_agg.remote(op.keys, op.aggs, *partials)]
+        # Keyed groupby: hash-partition the partials by key so each
+        # reducer combines only its key range — no single task holds the
+        # whole key space (ray: hash shuffle in push-based aggregate).
+        n = len(refs)
+        parts = [_hash_partition_rows.options(num_returns=n).remote(
+            p, op.keys, n) for p in partials]
+        cols = list(zip(*[p if isinstance(p, list) else [p]
+                          for p in parts]))
+        return [_final_agg.remote(op.keys, op.aggs, *col) for col in cols]
     raise TypeError(f"unknown all-to-all op {op}")
 
 
 # ------------------------------------------------------------- executor
+class _ResourceManager:
+    """Bytes-aware backpressure for the grant loop (ray:
+    data/_internal/execution/resource_manager.py:25 reservation scheme +
+    concurrency_cap_backpressure_policy.py).
+
+    Block sizes come free from the owner table (`CoreWorker.object_sizes`
+    — learned at task fulfillment, no payload fetch).  Each live
+    streaming operator is reserved an equal share of the memory budget;
+    an operator may not launch while its pending footprint (downstream
+    input queue it feeds + an average-size estimate for its in-flight
+    tasks) exceeds its share.  A progress escape hatch always admits an
+    operator whose downstream queue is empty and which has nothing in
+    flight, so a single block larger than the share cannot wedge the
+    pipeline."""
+
+    def __init__(self, ops: list[PhysicalOp], budget: int):
+        self.ops = ops
+        self.budget = budget
+        self.sizes: dict[Any, int] = {}
+        self.avg: dict[int, float] = {}
+        self._counts: dict[int, int] = {}
+        # Per-op input-queue byte high-water mark (observability + tests).
+        self.hwm: dict[int, int] = {}
+
+    def refresh(self) -> None:
+        from ray_tpu.experimental import object_sizes
+        from ray_tpu.object_ref import ObjectRef
+
+        live: dict[Any, int] = {}
+        unknown: list = []
+        for i, op in enumerate(self.ops):
+            for q in (op.outq, op.inq):
+                for r in q:
+                    if not isinstance(r, ObjectRef):
+                        continue
+                    if r in self.sizes:
+                        live[r] = self.sizes[r]
+                    else:
+                        unknown.append((i, r))
+        if unknown:
+            try:
+                got = object_sizes([r for _, r in unknown])
+            except Exception:  # noqa: BLE001 - not initialized
+                return
+            for (i, r), sz in zip(unknown, got):
+                if sz is None:
+                    continue
+                live[r] = sz
+                # i-th op's inq blocks were produced by op i-1.
+                prod = i - 1 if r in self.ops[i].inq else i
+                if prod >= 0:
+                    c = self._counts.get(prod, 0)
+                    self.avg[prod] = (self.avg.get(prod, 0.0) * c + sz) \
+                        / (c + 1)
+                    self._counts[prod] = c + 1
+        self.sizes = live
+        for i, op in enumerate(self.ops):
+            b = self._queue_bytes(op)
+            if b > self.hwm.get(i, 0):
+                self.hwm[i] = b
+
+    def _queue_bytes(self, op: PhysicalOp) -> int:
+        return sum(self.sizes.get(r, 0) for r in op.inq)
+
+    def admit(self, idx: int) -> bool:
+        op = self.ops[idx]
+        if isinstance(op, (AllToAllOp, LimitOp)):
+            return True          # barriers/limits: memory is inherent
+        n_live = sum(1 for o in self.ops
+                     if not o.done and not isinstance(o, (AllToAllOp,
+                                                          LimitOp))) or 1
+        share = self.budget / n_live
+        nxt = self.ops[idx + 1] if idx + 1 < len(self.ops) else None
+        downstream = self._queue_bytes(nxt) if nxt is not None else 0
+        if idx not in self._counts:
+            # No output-size knowledge yet: conservative ramp (ray:
+            # concurrency caps start low and grow) — the first completed
+            # block teaches the average and lifts this.
+            return len(op.inflight) < 2
+        est = self.avg.get(idx, 0.0)
+        pending = downstream + len(op.inflight) * est
+        if pending + est <= share:
+            return True
+        return not op.inflight and downstream == 0
+
+    def pending_bytes(self, idx: int) -> int:
+        nxt = self.ops[idx + 1] if idx + 1 < len(self.ops) else None
+        return int((self._queue_bytes(nxt) if nxt is not None else 0)
+                   + len(self.ops[idx].inflight)
+                   * self.avg.get(idx, 0.0))
+
+
 def plan_physical(plan: L.ExecutionPlan,
                   max_tasks: int = DEFAULT_MAX_TASKS) -> list[PhysicalOp]:
     ops = L.fuse_row_ops(plan.ops)
@@ -510,8 +694,16 @@ def plan_physical(plan: L.ExecutionPlan,
 
 class StreamingExecutor:
     def __init__(self, plan: L.ExecutionPlan,
-                 max_tasks: int = DEFAULT_MAX_TASKS):
-        self.ops = plan_physical(plan, max_tasks)
+                 max_tasks: int | None = None,
+                 memory_budget: int | None = None):
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        self.ops = plan_physical(
+            plan, ctx.max_tasks_per_op if max_tasks is None else max_tasks)
+        self.rm = _ResourceManager(
+            self.ops,
+            ctx.memory_budget if memory_budget is None else memory_budget)
 
     def execute(self) -> Iterator[Any]:
         """Yield output block refs as they become available."""
@@ -548,9 +740,12 @@ class StreamingExecutor:
                 if tail.stat_finished is None:
                     tail.stat_finished = _t.monotonic()
                 return
-            # 3. grant launches, most-downstream first (backpressure)
-            for op in reversed(ops):
-                while op.can_launch():
+            # 3. grant launches, most-downstream first (backpressure);
+            #    the resource manager gates on per-operator memory share.
+            self.rm.refresh()
+            for i in reversed(range(len(ops))):
+                op = ops[i]
+                while op.can_launch() and self.rm.admit(i):
                     if op.stat_started is None:
                         op.stat_started = _t.monotonic()
                     op.launch_one()
@@ -576,5 +771,6 @@ class StreamingExecutor:
             lines.append(
                 f"{op.name}: tasks={op.stat_launched} "
                 f"blocks_out={op.stat_blocks_out} wall={wall:.3f}s "
+                f"pending={self.rm.pending_bytes(self.ops.index(op))}B "
                 f"{'done' if op.done else 'running'}")
         return "\n".join(lines)

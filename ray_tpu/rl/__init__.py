@@ -4,13 +4,18 @@ EnvRunnerGroup (CPU sampling actors) + LearnerGroup (jitted TPU updates)
 + Algorithm-as-Trainable, with PPO and DQN (ray: rllib/algorithms/).
 """
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
+from ray_tpu.rl.connectors import (ConnectorCtx, ConnectorPipelineV2,
+                                   ConnectorV2)
 from ray_tpu.rl.cql import CQL, CQLConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rl.env import make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.learner import Learner, LearnerGroup
+from ray_tpu.rl.marwil import MARWIL, MARWILConfig
 from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                                     MultiAgentPPO, MultiAgentPPOConfig,
                                     MultiCartPole)
@@ -19,9 +24,13 @@ from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "APPO", "APPOConfig",
+    "ConnectorCtx", "ConnectorPipelineV2", "ConnectorV2",
+    "PPO", "PPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
-    "CQL", "CQLConfig", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "CQL", "CQLConfig", "MARWIL", "MARWILConfig",
+    "DreamerV3", "DreamerV3Config",
+    "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiCartPole",
     "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
     "ReplayBuffer", "make_env", "register_env",

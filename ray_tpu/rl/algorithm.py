@@ -144,6 +144,18 @@ class Algorithm(Trainable):
         self._params_np = self.learner_group.get_params_numpy()
         self._timesteps = 0
         self._episode_returns: list[float] = []
+        # Env→learner connector pipeline (ray: connector_v2.py:29);
+        # subclasses override build_env_to_learner_pipeline() to change
+        # the batch layout (e.g. V-trace [B,T] stacking).
+        self.env_to_learner = self.build_env_to_learner_pipeline()
+
+    def build_env_to_learner_pipeline(self):
+        from ray_tpu.rl.connectors import (ConcatFragments,
+                                           ConnectorPipelineV2,
+                                           RecordEpisodeMetrics)
+
+        return ConnectorPipelineV2(RecordEpisodeMetrics(),
+                                   ConcatFragments())
 
     def step(self) -> dict:
         t0 = time.perf_counter()
@@ -181,16 +193,15 @@ class Algorithm(Trainable):
                 done += len(rets)
                 self._episode_returns.extend(rets)
 
-    def _collect(self, epsilon: float | None = None) -> dict:
+    def _collect(self, epsilon: float | None = None,
+                 with_gae: bool = True) -> dict:
+        from ray_tpu.rl.connectors import ConnectorCtx
+
         per = max(1, self.cfg["train_batch_size"]
                   // self.cfg["num_env_runners"])
         batches = self.env_runner_group.sample(
-            self._params_np, per, epsilon=epsilon)
-        for b in batches:
-            self._episode_returns.extend(b.pop("episode_returns").tolist())
-            self._timesteps += len(b["obs"])
-        return {k: np.concatenate([b[k] for b in batches])
-                for k in batches[0]}
+            self._params_np, per, epsilon=epsilon, with_gae=with_gae)
+        return self.env_to_learner(batches, ConnectorCtx(self))
 
     def save_checkpoint(self, checkpoint_dir: str) -> None:
         state = self.learner_group.get_state()

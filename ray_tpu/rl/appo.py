@@ -1,0 +1,142 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner trunk.
+
+Analog of ray: rllib/algorithms/appo/appo.py:277 (APPO / APPOConfig) and
+appo_torch_learner.py — the clipped PPO surrogate driven by V-trace
+corrected advantages, with a target network (polyak-synced inside the
+jitted update, like SAC's) supplying the KL anchor: the learner keeps
+updating while env runners sample with stale params, and the KL term
+keeps the online policy from racing away from the one that collected
+the data.
+
+TPU shape: same one-XLA-program update as IMPALA (V-trace recursion is
+a lax.scan); the target sync is composed into the compiled step via the
+learner's post_update hook rather than a separate torch-style
+update_target() call.
+"""
+from __future__ import annotations
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, vtrace_returns
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.4            # rllib appo.py default
+        self.use_kl_loss = True
+        self.kl_coeff = 0.2
+        self.tau = 0.05                  # polyak rate of the target net
+        self.num_sgd_iter = 1
+
+    def training(self, *, clip_param=None, use_kl_loss=None,
+                 kl_coeff=None, tau=None, **kw) -> "APPOConfig":
+        for name, v in [("clip_param", clip_param),
+                        ("use_kl_loss", use_kl_loss),
+                        ("kl_coeff", kl_coeff), ("tau", tau)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+def appo_params_init(rng, obs_dim: int, n_actions: int,
+                     hidden: int = 64) -> dict:
+    """Online pi/vf + target copies (flat tree so the env runners'
+    models.policy_logits(params) finds "pi" unchanged)."""
+    from ray_tpu.rl import models
+
+    p = models.policy_value_init(rng, obs_dim, n_actions, hidden=hidden)
+    return {"pi": p["pi"], "vf": p["vf"],
+            "pi_t": {k: v for k, v in p["pi"].items()},
+            "vf_t": {k: v for k, v in p["vf"].items()}}
+
+
+def appo_post_update(config: dict):
+    """Polyak target sync fused into the jitted update step (rllib:
+    APPO target_network_update_freq; SAC-style tau here)."""
+    tau = config.get("tau", 0.05)
+
+    def post(params):
+        import jax
+
+        new_pi_t = jax.tree.map(lambda o, t: tau * o + (1 - tau) * t,
+                                params["pi"], params["pi_t"])
+        new_vf_t = jax.tree.map(lambda o, t: tau * o + (1 - tau) * t,
+                                params["vf"], params["vf_t"])
+        return {**params, "pi_t": new_pi_t, "vf_t": new_vf_t}
+
+    return post
+
+
+class APPO(IMPALA):
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        gamma = config.get("gamma", 0.99)
+        rho_bar = config.get("vtrace_clip_rho", 1.0)
+        pg_rho_bar = config.get("vtrace_clip_pg_rho", 1.0)
+        lam = config.get("vtrace_lambda", 1.0)
+        vf_coeff = config.get("vf_loss_coeff", 0.5)
+        ent_coeff = config.get("entropy_coeff", 0.01)
+        clip = config.get("clip_param", 0.4)
+        use_kl = config.get("use_kl_loss", True)
+        kl_coeff = config.get("kl_coeff", 0.2)
+
+        def loss_fn(params, batch):
+            obs = batch["obs"]                      # [B,T,obs]
+            B, T = obs.shape[:2]
+            flat = lambda a: a.reshape((B * T,) + a.shape[2:])  # noqa: E731
+            logits = models.policy_logits(params, flat(obs), jnp)
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
+            actions = flat(batch["actions"])
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=-1)[:, 0].reshape(B, T)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+
+            values = models.value(params, flat(obs), jnp).reshape(B, T)
+            v_next = models.value(
+                params, flat(batch["next_obs"]), jnp).reshape(B, T)
+
+            # Importance ratios vs the BEHAVIOUR policy that sampled.
+            rhos = jnp.exp(logp - batch["logp"])
+            vs, pg_adv = vtrace_returns(
+                jax, jnp, batch, values, v_next,
+                jax.lax.stop_gradient(rhos), gamma, rho_bar, pg_rho_bar,
+                lam)
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+            # Clipped PPO surrogate on the V-trace advantages
+            # (appo_torch_learner.py).
+            surrogate = jnp.minimum(
+                rhos * adv, jnp.clip(rhos, 1.0 - clip, 1.0 + clip) * adv)
+            pi_loss = -jnp.mean(surrogate)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+
+            # KL(target || online): anchors the update to the slow net.
+            target_logp_all = jax.nn.log_softmax(models.mlp_apply(
+                params["pi_t"], flat(obs), jnp), axis=-1)
+            kl = jnp.mean(jnp.sum(
+                jnp.exp(target_logp_all) * (target_logp_all - logp_all),
+                axis=-1))
+            if use_kl:
+                total = total + kl_coeff * kl
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy, "mean_kl": kl,
+                           "mean_rho": jnp.mean(rhos)}
+        return loss_fn
+
+    def setup(self, config: dict) -> None:
+        config = dict(config or {})
+        config.setdefault("params_builder", appo_params_init)
+        config.setdefault("post_update_builder", appo_post_update)
+        super().setup(config)
+
+
+APPO._default_config = APPOConfig()
+APPOConfig.algo_class = APPO

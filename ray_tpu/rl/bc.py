@@ -28,6 +28,9 @@ class BCConfig(AlgorithmConfig):
 
 
 class BC(Algorithm):
+    # Offline columns the loss consumes (MARWIL adds "returns").
+    _offline_keys: tuple = ("obs", "actions")
+
     @staticmethod
     def loss_builder(config: dict):
         import jax
@@ -55,7 +58,7 @@ class BC(Algorithm):
                              "(config.offline(offline_data=...))")
         from ray_tpu.rl.algorithm import coerce_offline
 
-        batch = coerce_offline(offline, ("obs", "actions"))
+        batch = coerce_offline(offline, type(self)._offline_keys)
         # Default ONE eval runner when eval is on (none when off), but an
         # explicit .env_runners() choice wins.
         cfg_eval = dict(config)

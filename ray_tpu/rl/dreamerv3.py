@@ -1,0 +1,454 @@
+"""DreamerV3: model-based RL — learn a world model, act in imagination.
+
+Analog of ray: rllib/algorithms/dreamerv3/ (dreamerv3.py, torch RSSM in
+dreamerv3_torch_model.py) — compacted to the discrete-action core and
+re-shaped for XLA: the RSSM rollout, the imagination rollout, and both
+optimizer steps are single jitted programs built on `lax.scan` (no
+Python step loops under jit; static [B,T]/[H] shapes).
+
+Kept from the paper: categorical latents (groups × classes) with
+straight-through gradients, KL balancing with free bits (dyn 0.5 /
+rep 0.1, 1 nat), reward/continue heads, imagination-trained actor-critic
+with λ-returns and entropy regularization.  Simplified vs the reference
+(documented, CartPole-scale): plain MSE decoder/reward (no
+symlog/twohot), no critic-EMA regularizer, shared Adam per module
+group.  rllib: dreamerv3.py:292 training_step drives the same
+world-model → imagine → actor/critic cadence.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import make_env
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.actor_lr = 1e-4
+        self.critic_lr = 1e-4
+        self.deter = 64                 # GRU state
+        self.groups = 4                 # latent groups
+        self.classes = 4                # classes per group
+        self.hidden = 64
+        self.batch_length = 16          # T per training sequence
+        self.batch_rows = 8             # B sequences per update
+        self.horizon = 10               # imagination steps
+        self.gamma = 0.997
+        self.gae_lambda = 0.95
+        self.entropy_coeff = 3e-3
+        self.free_bits = 1.0
+        self.replay_capacity = 20000
+        self.updates_per_step = 4
+        self.train_batch_size = 256     # env steps collected per step()
+
+    def training(self, *, horizon=None, batch_length=None,
+                 updates_per_step=None, entropy_coeff=None, **kw):
+        for name, v in [("horizon", horizon),
+                        ("batch_length", batch_length),
+                        ("updates_per_step", updates_per_step),
+                        ("entropy_coeff", entropy_coeff)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+def _mlp(rng, sizes):
+    from ray_tpu.rl.models import mlp_init
+
+    return mlp_init(rng, sizes)
+
+
+def dreamer_params_init(rng, obs_dim: int, n_actions: int, cfg: dict):
+    import jax
+
+    deter = cfg["deter"]
+    stoch = cfg["groups"] * cfg["classes"]
+    hid = cfg["hidden"]
+    embed = hid
+    ks = jax.random.split(rng, 9)
+    import jax.numpy as jnp
+
+    return {
+        "enc": _mlp(ks[0], [obs_dim, hid, embed]),
+        # GRU: input [z + one-hot action] with state h → candidate/gates.
+        "gru_w": jax.random.normal(
+            ks[1], (stoch + n_actions + deter, 3 * deter),
+            jnp.float32) * 0.02,
+        "gru_b": jnp.zeros((3 * deter,), jnp.float32),
+        "prior": _mlp(ks[2], [deter, hid, stoch]),
+        "post": _mlp(ks[3], [deter + embed, hid, stoch]),
+        "dec": _mlp(ks[4], [deter + stoch, hid, obs_dim]),
+        "rew": _mlp(ks[5], [deter + stoch, hid, 1]),
+        "cont": _mlp(ks[6], [deter + stoch, hid, 1]),
+        "actor": _mlp(ks[7], [deter + stoch, hid, n_actions]),
+        "critic": _mlp(ks[8], [deter + stoch, hid, 1]),
+    }
+
+
+class DreamerV3(Algorithm):
+    """Compact DreamerV3 (see module docstring for scope)."""
+
+    def setup(self, config: dict) -> None:
+        import jax
+        import optax
+
+        defaults = type(self).get_default_config().to_dict()
+        defaults.update(config or {})
+        self.cfg = defaults
+        probe = make_env(self.cfg["env"], seed=0)
+        self.obs_dim = probe.obs_dim
+        self.n_actions = probe.n_actions
+        # Collection runs in-process (the recurrent policy isn't a flat
+        # param dict shippable to EnvRunner actors; rllib's DreamerV3
+        # drives its own EnvRunner subclass the same way).
+        from ray_tpu.rl.replay import ReplayBuffer
+
+        self.replay = ReplayBuffer(self.cfg["replay_capacity"],
+                                   seed=self.cfg["seed"])
+        rng = jax.random.PRNGKey(self.cfg["seed"])
+        self.params = dreamer_params_init(rng, self.obs_dim,
+                                          self.n_actions, self.cfg)
+        self._rng = jax.random.PRNGKey(self.cfg["seed"] + 1)
+        wm_keys = ("enc", "gru_w", "gru_b", "prior", "post", "dec",
+                   "rew", "cont")
+        self._wm_keys = wm_keys
+        self.tx_wm = optax.adam(self.cfg["lr"])
+        self.tx_actor = optax.adam(self.cfg["actor_lr"])
+        self.tx_critic = optax.adam(self.cfg["critic_lr"])
+        self.opt_wm = self.tx_wm.init({k: self.params[k] for k in wm_keys})
+        self.opt_actor = self.tx_actor.init(self.params["actor"])
+        self.opt_critic = self.tx_critic.init(self.params["critic"])
+        self._update = self._build_update()
+        self._params_np = None           # env runners use _policy below
+        self._timesteps = 0
+        self._episode_returns: list[float] = []
+
+    # ------------------------------------------------------------ jit core
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.models import mlp_apply
+
+        cfg = self.cfg
+        G, C = cfg["groups"], cfg["classes"]
+        deter = cfg["deter"]
+        stoch = G * C
+        n_act = self.n_actions
+        gamma, lam = cfg["gamma"], cfg["gae_lambda"]
+        H = cfg["horizon"]
+        ent_coeff = cfg["entropy_coeff"]
+        free = cfg["free_bits"]
+        wm_keys = self._wm_keys
+
+        def gru(p, h, x):
+            # Light GRU (fused [x,h] projection; candidate gated by r
+            # multiplicatively — one matmul per step keeps the scan MXU-
+            # friendly).
+            gates = jnp.concatenate([x, h], -1) @ p["gru_w"] + p["gru_b"]
+            r, u, c = jnp.split(gates, 3, axis=-1)
+            r, u = jax.nn.sigmoid(r), jax.nn.sigmoid(u)
+            cand = jnp.tanh(r * c)
+            return u * h + (1 - u) * cand
+
+        def latent_dist(logits):
+            lg = logits.reshape(logits.shape[:-1] + (G, C))
+            return jax.nn.log_softmax(lg, axis=-1)
+
+        def sample_latent(rng, logits):
+            """Straight-through categorical sample per group → flat."""
+            logp = latent_dist(logits)
+            g = jax.random.gumbel(rng, logp.shape)
+            idx = jnp.argmax(logp + g, axis=-1)
+            onehot = jax.nn.one_hot(idx, C)
+            probs = jnp.exp(logp)
+            st = onehot + probs - jax.lax.stop_gradient(probs)
+            return st.reshape(st.shape[:-2] + (stoch,))
+
+        def kl(lp_a, lp_b):
+            """KL over the grouped categoricals, summed across groups."""
+            return jnp.sum(jnp.exp(lp_a) * (lp_a - lp_b), axis=(-2, -1))
+
+        def wm_loss(wm, batch, rng):
+            """Posterior rollout over [B,T]; recon+reward+cont+KL."""
+            obs = batch["obs"]                         # [B,T,obs]
+            B, T = obs.shape[:2]
+            act = jax.nn.one_hot(batch["actions"], n_act)
+            embed = mlp_apply(wm["enc"], obs, jnp)     # [B,T,embed]
+            resets = jnp.maximum(batch["dones"], batch["truncs"])
+
+            def step(carry, xs):
+                h, z, rng_c = carry
+                emb_t, act_prev, reset_prev = xs
+                # Episode edges cut the recurrence inside a sequence.
+                keep = (1.0 - reset_prev)[:, None]
+                h = h * keep
+                z = z * keep
+                h = gru(wm, h, jnp.concatenate([z, act_prev], -1))
+                prior_logits = mlp_apply(wm["prior"], h, jnp)
+                post_logits = mlp_apply(
+                    wm["post"], jnp.concatenate([h, emb_t], -1), jnp)
+                rng_c, k = jax.random.split(rng_c)
+                z = sample_latent(k, post_logits)
+                return (h, z, rng_c), (h, z, prior_logits, post_logits)
+
+            h0 = jnp.zeros((B, deter))
+            z0 = jnp.zeros((B, stoch))
+            act_prev = jnp.concatenate(
+                [jnp.zeros_like(act[:, :1]), act[:, :-1]], 1)
+            reset_prev = jnp.concatenate(
+                [jnp.zeros_like(resets[:, :1]), resets[:, :-1]], 1)
+            (_, _, _), (hs, zs, priors, posts) = jax.lax.scan(
+                step, (h0, z0, rng),
+                (embed.transpose(1, 0, 2), act_prev.transpose(1, 0, 2),
+                 reset_prev.T))
+            hs = hs.transpose(1, 0, 2)                 # [B,T,deter]
+            zs = zs.transpose(1, 0, 2)
+            priors = priors.transpose(1, 0, 2)
+            posts = posts.transpose(1, 0, 2)
+            feat = jnp.concatenate([hs, zs], -1)
+            recon = mlp_apply(wm["dec"], feat, jnp)
+            rew = mlp_apply(wm["rew"], feat, jnp)[..., 0]
+            cont = mlp_apply(wm["cont"], feat, jnp)[..., 0]
+            lp_prior, lp_post = latent_dist(priors), latent_dist(posts)
+            dyn = jnp.maximum(
+                kl(jax.lax.stop_gradient(lp_post), lp_prior), free)
+            rep = jnp.maximum(
+                kl(lp_post, jax.lax.stop_gradient(lp_prior)), free)
+            recon_loss = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
+            rew_loss = jnp.mean((rew - batch["rewards"]) ** 2)
+            cont_target = 1.0 - batch["dones"]
+            cont_loss = jnp.mean(
+                optax_sigmoid_ce(cont, cont_target))
+            kl_loss = jnp.mean(0.5 * dyn + 0.1 * rep)
+            total = recon_loss + rew_loss + cont_loss + kl_loss
+            aux = {"recon": recon_loss, "reward_loss": rew_loss,
+                   "cont_loss": cont_loss, "kl": kl_loss,
+                   "feat": feat}
+            return total, aux
+
+        def optax_sigmoid_ce(logits, labels):
+            return jnp.maximum(logits, 0) - logits * labels + \
+                jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+        def imagine(wm, actor, feat0, rng):
+            """Roll the PRIOR forward H steps under the actor."""
+            B = feat0.shape[0]
+            h0 = feat0[:, :deter]
+            z0 = feat0[:, deter:]
+
+            def step(carry, _):
+                h, z, rng_c = carry
+                logits = mlp_apply(actor, jnp.concatenate([h, z], -1),
+                                   jnp)
+                rng_c, k1, k2 = jax.random.split(rng_c, 3)
+                a_idx = jax.random.categorical(k1, logits)
+                a = jax.nn.one_hot(a_idx, n_act)
+                logp_a = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, -1), a_idx[:, None],
+                    -1)[:, 0]
+                ent = -jnp.sum(jax.nn.softmax(logits, -1) *
+                               jax.nn.log_softmax(logits, -1), -1)
+                h = gru(wm, h, jnp.concatenate([z, a], -1))
+                z = sample_latent(k2, mlp_apply(wm["prior"], h, jnp))
+                return (h, z, rng_c), (h, z, logp_a, ent)
+
+            (_, _, _), (hs, zs, logps, ents) = jax.lax.scan(
+                step, (h0, z0, rng), None, length=H)
+            feat = jnp.concatenate([hs, zs], -1)       # [H,B,feat]
+            return feat, logps, ents
+
+        def ac_loss(actor_critic, wm, feat0, rng):
+            actor, critic = actor_critic
+            feat, logps, ents = imagine(
+                jax.lax.stop_gradient(wm), actor, feat0, rng)
+            feat_sg = jax.lax.stop_gradient(feat)
+            rew = mlp_apply(wm["rew"], feat_sg, jnp)[..., 0]   # [H,B]
+            cont = jax.nn.sigmoid(
+                mlp_apply(wm["cont"], feat_sg, jnp)[..., 0])
+            v = mlp_apply(critic, feat_sg, jnp)[..., 0]        # [H,B]
+            disc = gamma * cont
+
+            def bwd(acc, xs):
+                r_t, d_t, v_next = xs
+                ret = r_t + d_t * ((1 - lam) * v_next + lam * acc)
+                return ret, ret
+
+            v_last = v[-1]
+            _, rets = jax.lax.scan(
+                bwd, v_last,
+                (rew[:-1][::-1], disc[:-1][::-1], v[1:][::-1]))
+            rets = rets[::-1]                                  # [H-1,B]
+            adv = jax.lax.stop_gradient(rets - v[:-1])
+            adv_n = (adv - adv.mean()) / (adv.std() + 1e-8)
+            actor_loss = -jnp.mean(logps[:-1] * adv_n) \
+                - ent_coeff * jnp.mean(ents)
+            critic_loss = jnp.mean(
+                (v[:-1] - jax.lax.stop_gradient(rets)) ** 2)
+            total = actor_loss + critic_loss
+            return total, {"actor_loss": actor_loss,
+                           "critic_loss": critic_loss,
+                           "entropy": jnp.mean(ents),
+                           "value_mean": jnp.mean(v)}
+
+        tx_wm, tx_actor, tx_critic = (self.tx_wm, self.tx_actor,
+                                      self.tx_critic)
+
+        def update(params, opts, batch, rng):
+            opt_wm, opt_actor, opt_critic = opts
+            wm = {k: params[k] for k in wm_keys}
+            rng, k1, k2 = jax.random.split(rng, 3)
+            (wl, aux), gw = jax.value_and_grad(
+                wm_loss, has_aux=True)(wm, batch, k1)
+            upd, opt_wm = tx_wm.update(gw, opt_wm, wm)
+            import optax as _optax
+
+            wm = _optax.apply_updates(wm, upd)
+            params = {**params, **wm}
+            feat0 = jax.lax.stop_gradient(
+                aux.pop("feat").reshape(-1, deter + stoch))
+            (al, aaux), (ga, gc) = jax.value_and_grad(
+                ac_loss, has_aux=True)(
+                    (params["actor"], params["critic"]), wm, feat0, k2)
+            upd_a, opt_actor = tx_actor.update(ga, opt_actor,
+                                               params["actor"])
+            upd_c, opt_critic = tx_critic.update(gc, opt_critic,
+                                                 params["critic"])
+            params = {**params,
+                      "actor": _optax.apply_updates(params["actor"],
+                                                    upd_a),
+                      "critic": _optax.apply_updates(params["critic"],
+                                                     upd_c)}
+            metrics = {"wm_loss": wl, "ac_loss": al, **aux, **aaux}
+            return params, (opt_wm, opt_actor, opt_critic), metrics
+
+        return jax.jit(update)
+
+    # -------------------------------------------------------- acting glue
+    def _policy_logits_fn(self):
+        """Feedforward acting slice of the recurrent model: actor over
+        [h=0, z=mode(post(h=0, enc(obs)))].  CartPole-scale envs are
+        fully observed, so the posterior features carry the state — this
+        keeps collection simple while exercising the exact heads the
+        imagination trains."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.models import mlp_apply
+
+        p = self.params
+
+        def logits_fn(obs_np):
+            obs = jnp.asarray(obs_np, jnp.float32)
+            single = obs.ndim == 1
+            if single:
+                obs = obs[None]
+            emb = mlp_apply(p["enc"], obs, jnp)
+            h = jnp.zeros((obs.shape[0], self.cfg["deter"]))
+            post = mlp_apply(p["post"],
+                             jnp.concatenate([h, emb], -1), jnp)
+            G, C = self.cfg["groups"], self.cfg["classes"]
+            lg = post.reshape(post.shape[:-1] + (G, C))
+            mode = jax.nn.one_hot(jnp.argmax(lg, -1), C)
+            z = mode.reshape(mode.shape[:-2] + (G * C,))
+            out = mlp_apply(p["actor"], jnp.concatenate([h, z], -1),
+                            jnp)
+            return np.asarray(out[0] if single else out)
+
+        return logits_fn
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        per = max(1, self.cfg["train_batch_size"]
+                  // self.cfg["num_env_runners"])
+        logits_fn = self._policy_logits_fn()
+        fragments = self._sample_with(logits_fn, per)
+        for b in fragments:
+            self._episode_returns.extend(b.pop("episode_returns").tolist())
+            self._timesteps += len(b["obs"])
+            self.replay.add_batch(b)
+        if len(self.replay) < self.cfg["batch_rows"] * \
+                self.cfg["batch_length"]:
+            return {"buffer": float(len(self.replay))}
+        metrics = {}
+        for _ in range(self.cfg["updates_per_step"]):
+            batch = self._sample_sequences()
+            self._rng, k = jax.random.split(self._rng)
+            self.params, opts, m = self._update(
+                self.params,
+                (self.opt_wm, self.opt_actor, self.opt_critic),
+                {k2: jnp.asarray(v) for k2, v in batch.items()}, k)
+            self.opt_wm, self.opt_actor, self.opt_critic = opts
+            metrics = {k2: float(v) for k2, v in m.items()}
+        return metrics
+
+    def _sample_with(self, logits_fn, per: int) -> list[dict]:
+        """Local (driver-side) sampling with the composed policy: the
+        recurrent model's policy isn't a flat param dict, so collection
+        runs the envs in-process (CartPole-scale; rllib's DreamerV3 also
+        drives its own EnvRunner subclass)."""
+        if not hasattr(self, "_local_envs"):
+            self._local_envs = [
+                make_env(self.cfg["env"], seed=1000 + 7919 * i)
+                for i in range(self.cfg["num_env_runners"])]
+            self._local_obs = [e.reset() for e in self._local_envs]
+            self._local_rng = np.random.default_rng(self.cfg["seed"] + 5)
+            self._local_ret = [0.0] * len(self._local_envs)
+        out = []
+        for ei, env in enumerate(self._local_envs):
+            cols = {k: [] for k in ("obs", "actions", "rewards", "dones",
+                                    "truncs")}
+            rets = []
+            obs = self._local_obs[ei]
+            for _ in range(per):
+                logits = logits_fn(obs)
+                z = logits - logits.max()
+                prob = np.exp(z) / np.exp(z).sum()
+                a = int(self._local_rng.choice(len(prob), p=prob))
+                nxt, r, term, trunc = env.step(a)
+                cols["obs"].append(np.asarray(obs, np.float32))
+                cols["actions"].append(a)
+                cols["rewards"].append(r)
+                cols["dones"].append(float(term))
+                cols["truncs"].append(float(trunc and not term))
+                self._local_ret[ei] += r
+                if term or trunc:
+                    rets.append(self._local_ret[ei])
+                    self._local_ret[ei] = 0.0
+                    obs = env.reset()
+                else:
+                    obs = nxt
+            self._local_obs[ei] = obs
+            out.append({
+                "obs": np.stack(cols["obs"]),
+                "actions": np.asarray(cols["actions"], np.int64),
+                "rewards": np.asarray(cols["rewards"], np.float32),
+                "dones": np.asarray(cols["dones"], np.float32),
+                "truncs": np.asarray(cols["truncs"], np.float32),
+                "episode_returns": np.asarray(rets, np.float32),
+            })
+        return out
+
+    def _sample_sequences(self) -> dict:
+        """[B,T] contiguous windows from the replay's flat storage."""
+        B, T = self.cfg["batch_rows"], self.cfg["batch_length"]
+        data = self.replay.storage()
+        n = len(data["obs"])
+        rng = np.random.default_rng(int(self._timesteps) + 13)
+        starts = rng.integers(0, max(1, n - T), size=B)
+        return {k: np.stack([v[s:s + T] for s in starts])
+                for k, v in data.items()
+                if k in ("obs", "actions", "rewards", "dones", "truncs")}
+
+    def cleanup(self) -> None:
+        pass
+
+
+DreamerV3._default_config = DreamerV3Config()
+DreamerV3Config.algo_class = DreamerV3

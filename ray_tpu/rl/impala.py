@@ -42,6 +42,47 @@ class IMPALAConfig(AlgorithmConfig):
         return self
 
 
+def vtrace_returns(jax, jnp, batch, values, v_next, rhos, gamma,
+                   rho_bar, pg_rho_bar, lam):
+    """V-trace corrected value targets + policy-gradient advantages
+    (ray: vtrace_torch.py semantics as one lax.scan over time).
+
+    Shapes [B,T]; returns (vs, pg_adv), both stop-gradient.  Shared by
+    IMPALA (plain PG surrogate) and APPO (clipped PPO surrogate).
+    """
+    clipped_rho = jnp.minimum(rho_bar, rhos)
+    cs = lam * jnp.minimum(1.0, rhos)
+    discounts = gamma * (1.0 - batch["dones"])     # [B,T]
+    # Any episode edge (terminal OR truncation) stops the correction
+    # carry — the recursion must not couple episodes.
+    carry = (1.0 - jnp.maximum(batch["dones"], batch["truncs"]))
+
+    deltas = clipped_rho * (
+        batch["rewards"] + discounts * v_next - values)
+
+    # Backward recursion: acc_t = delta_t + disc_t*c_t*carry*acc_{t+1}
+    def bwd(acc, xs):
+        delta_t, disc_t, c_t, k_t = xs
+        acc = delta_t + disc_t * c_t * k_t * acc
+        return acc, acc
+
+    B = values.shape[0]
+    _, vs_minus_v_rev = jax.lax.scan(
+        bwd, jnp.zeros((B,), values.dtype),
+        (deltas.T[::-1], discounts.T[::-1], cs.T[::-1], carry.T[::-1]))
+    vs = values + vs_minus_v_rev[::-1].T           # [B,T]
+
+    # vs_{t+1}: the next row's corrected value within an episode, the
+    # raw bootstrap V(next_obs) at edges / the fragment end.
+    vs_shift = jnp.concatenate([vs[:, 1:], v_next[:, -1:]], axis=1)
+    vs_tp1 = carry * vs_shift + (1.0 - carry) * v_next
+    vs_tp1 = vs_tp1.at[:, -1].set(v_next[:, -1])
+    pg_adv = jax.lax.stop_gradient(
+        jnp.minimum(pg_rho_bar, rhos) *
+        (batch["rewards"] + discounts * vs_tp1 - values))
+    return jax.lax.stop_gradient(vs), pg_adv
+
+
 class IMPALA(Algorithm):
     @staticmethod
     def loss_builder(config: dict):
@@ -79,58 +120,28 @@ class IMPALA(Algorithm):
                 params, flat(batch["next_obs"]), jnp).reshape(B, T)
 
             rhos = jnp.exp(logp - batch["logp"])           # [B,T]
-            clipped_rho = jnp.minimum(rho_bar, rhos)
-            cs = lam * jnp.minimum(1.0, rhos)
-            discounts = gamma * (1.0 - batch["dones"])     # [B,T]
-            # Any episode edge (terminal OR truncation) stops the
-            # correction carry — the recursion must not couple episodes.
-            carry = (1.0 - jnp.maximum(batch["dones"],
-                                       batch["truncs"]))   # [B,T]
-
-            deltas = clipped_rho * (
-                batch["rewards"] + discounts * v_next - values)
-
-            # Backward recursion: acc_t = delta_t + disc_t*c_t*carry*acc_{t+1}
-            def bwd(acc, xs):
-                delta_t, disc_t, c_t, k_t = xs
-                acc = delta_t + disc_t * c_t * k_t * acc
-                return acc, acc
-
-            _, vs_minus_v_rev = jax.lax.scan(
-                bwd, jnp.zeros((B,), values.dtype),
-                (deltas.T[::-1], discounts.T[::-1], cs.T[::-1],
-                 carry.T[::-1]))
-            vs = values + vs_minus_v_rev[::-1].T           # [B,T]
-
-            # vs_{t+1}: the next row's corrected value within an episode,
-            # the raw bootstrap V(next_obs) at edges / the fragment end.
-            vs_shift = jnp.concatenate(
-                [vs[:, 1:], v_next[:, -1:]], axis=1)
-            vs_tp1 = carry * vs_shift + (1.0 - carry) * v_next
-            vs_tp1 = vs_tp1.at[:, -1].set(v_next[:, -1])
-            pg_adv = jax.lax.stop_gradient(
-                jnp.minimum(pg_rho_bar, rhos) *
-                (batch["rewards"] + discounts * vs_tp1 - values))
+            vs, pg_adv = vtrace_returns(jax, jnp, batch, values, v_next,
+                                        rhos, gamma, rho_bar, pg_rho_bar,
+                                        lam)
             pi_loss = -jnp.mean(logp * pg_adv)
-            vf_loss = 0.5 * jnp.mean(
-                (jax.lax.stop_gradient(vs) - values) ** 2)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
             total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy,
                            "mean_rho": jnp.mean(rhos)}
         return loss_fn
 
+    def build_env_to_learner_pipeline(self):
+        # V-trace wants the [B,T] fragment layout, not flattened rows.
+        from ray_tpu.rl.connectors import (ConnectorPipelineV2,
+                                           RecordEpisodeMetrics,
+                                           StackFragments)
+
+        return ConnectorPipelineV2(RecordEpisodeMetrics(),
+                                   StackFragments())
+
     def training_step(self) -> dict:
-        per = max(1, self.cfg["train_batch_size"]
-                  // self.cfg["num_env_runners"])
-        fragments = self.env_runner_group.sample(
-            self._params_np, per, with_gae=False)
-        for b in fragments:
-            self._episode_returns.extend(b.pop("episode_returns").tolist())
-            self._timesteps += len(b["obs"])
-        # Stack to [B,T,...]: each runner fragment is one time-ordered row.
-        batch = {k: np.stack([b[k] for b in fragments])
-                 for k in fragments[0]}
+        batch = self._collect(with_gae=False)
         metrics = self.learner_group.update(
             batch, num_sgd_iter=self.cfg.get("num_sgd_iter", 1))
         self._params_np = self.learner_group.get_params_numpy()

@@ -1,0 +1,102 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Analog of ray: rllib/algorithms/marwil/marwil.py (MARWIL / MARWILConfig,
+torch loss in marwil_torch_learner.py) — offline policy learning that
+upgrades BC with exponential advantage weighting: actions that
+outperformed the logged value estimate get up-weighted
+(w = exp(beta * A / c)), beta=0 reduces exactly to BC.  The value head
+trains on monte-carlo returns from the logged episodes.
+
+Offline batches need (obs, actions) plus either "returns" or
+(rewards, dones) to derive discounted returns-to-go.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rl.bc import BC, BCConfig
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0             # 0 => plain BC
+        self.vf_coeff = 1.0
+        self.w_clip = 20.0          # cap on the exp advantage weight
+
+    def training(self, *, beta=None, vf_coeff=None, w_clip=None,
+                 **kw) -> "MARWILConfig":
+        for name, v in [("beta", beta), ("vf_coeff", vf_coeff),
+                        ("w_clip", w_clip)]:
+            if v is not None:
+                setattr(self, name, v)
+        super().training(**kw)
+        return self
+
+
+def discounted_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-step discounted returns-to-go, resetting at episode ends."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * (1.0 - dones[t]) * acc
+        out[t] = acc
+    return out
+
+
+class MARWIL(BC):
+    _offline_keys = ("obs", "actions", "returns")
+
+    @staticmethod
+    def loss_builder(config: dict):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rl import models
+
+        beta = config.get("beta", 1.0)
+        vf_coeff = config.get("vf_coeff", 1.0)
+        w_clip = config.get("w_clip", 20.0)
+
+        def loss_fn(params, batch):
+            logits = models.policy_logits(params, batch["obs"], jnp)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, batch["actions"][:, None], axis=-1)[:, 0]
+            v = models.value(params, batch["obs"], jnp)
+            adv = batch["returns"] - v
+            vf_loss = jnp.mean(adv ** 2)
+            # Weight by exp(beta * normalized advantage); stop-grad so
+            # the policy term never trains the critic through the weight
+            # (marwil_torch_learner.py).
+            adv_sg = jax.lax.stop_gradient(adv)
+            norm = jnp.sqrt(jnp.mean(adv_sg ** 2) + 1e-8)
+            w = jnp.minimum(jnp.exp(beta * adv_sg / norm), w_clip)
+            pi_loss = jnp.mean(w * nll)
+            total = pi_loss + vf_coeff * vf_loss
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == batch["actions"])
+                .astype(jnp.float32))
+            return total, {"marwil_loss": pi_loss, "vf_loss": vf_loss,
+                           "mean_weight": jnp.mean(w),
+                           "action_accuracy": acc}
+        return loss_fn
+
+    def setup(self, config: dict) -> None:
+        config = dict(config or {})
+        offline = config.get("offline_data")
+        if offline is not None and not hasattr(offline, "to_numpy") \
+                and "returns" not in offline:
+            # Derive returns-to-go from logged rewards/dones.
+            gamma = config.get("gamma", 0.99)
+            offline = dict(offline)
+            offline["returns"] = discounted_returns(
+                np.asarray(offline["rewards"], np.float32),
+                np.asarray(offline["dones"], np.float32), gamma)
+            config["offline_data"] = offline
+        super().setup(config)
+
+
+MARWIL._default_config = MARWILConfig()
+MARWILConfig.algo_class = MARWIL

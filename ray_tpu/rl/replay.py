@@ -19,9 +19,12 @@ class ReplayBuffer:
     def add_batch(self, batch: dict) -> None:
         n = len(batch["obs"])
         if not self._storage:
-            for k in ("obs", "actions", "rewards", "dones", "next_obs"):
-                shape = (self.capacity,) + tuple(batch[k].shape[1:])
-                self._storage[k] = np.zeros(shape, batch[k].dtype)
+            # Schema follows the first batch (algorithms differ: DQN/SAC
+            # store next_obs, DreamerV3 stores sequence flags instead).
+            for k, v in batch.items():
+                v = np.asarray(v)
+                shape = (self.capacity,) + tuple(v.shape[1:])
+                self._storage[k] = np.zeros(shape, v.dtype)
         for i in range(n):
             j = self._next
             for k, arr in self._storage.items():
@@ -32,6 +35,11 @@ class ReplayBuffer:
     def sample(self, batch_size: int) -> dict:
         idx = self.rng.integers(0, self._size, size=batch_size)
         return {k: arr[idx] for k, arr in self._storage.items()}
+
+    def storage(self) -> dict:
+        """Time-ordered view of the live region (sequence samplers slice
+        contiguous windows from this; valid until the ring wraps)."""
+        return {k: arr[:self._size] for k, arr in self._storage.items()}
 
     def __len__(self) -> int:
         return self._size

@@ -10,8 +10,9 @@ Layers:
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
                                   RunConfig, ScalingConfig)
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
-                                   report)
+                                   get_dataset_shard, report)
 from ray_tpu.train.step import (TrainState, create_train_state,  # noqa: F401
                                 make_train_step, sharded_init,
                                 sharded_train_step)

@@ -95,11 +95,21 @@ class BackendExecutor:
             local_ranks.append(seen.get(nid, 0))
             seen[nid] = local_ranks[-1] + 1
         self.backend.on_training_start(wg)
+        # Dataset shards: one streaming_split iterator per worker per
+        # dataset (ray: DataParallelTrainer wiring train.get_dataset_shard
+        # through the data StreamSplitDataIterator).
+        shards_per_worker: list[dict] = [{} for _ in range(n)]
+        for name, ds in (config.get("_datasets") or {}).items():
+            its = ds.streaming_split(n)
+            for i in range(n):
+                shards_per_worker[i][name] = its[i]
+        config = {k: v for k, v in config.items() if k != "_datasets"}
         ray_tpu.get([
             w.start_train_fn.remote(
                 train_fn, config, world_rank=i, world_size=n,
                 local_rank=local_ranks[i], trial_name=self.trial_name,
-                checkpoint=resume_checkpoint)
+                checkpoint=resume_checkpoint,
+                dataset_shards=shards_per_worker[i])
             for i, w in enumerate(wg.workers)
         ])
 

@@ -1,0 +1,97 @@
+"""XGBoost / LightGBM trainers: distributed GBDT over the worker group.
+
+Analog of ray: python/ray/train/xgboost/xgboost_trainer.py and
+lightgbm/lightgbm_trainer.py (both thin layers over the data-parallel
+trainer: shard the dataset across workers, run the library's own
+collective-aware training inside each, report metrics/checkpoints).
+
+This environment ships neither xgboost nor lightgbm (and nothing may be
+installed), so the library call is GATED: the trainer builds the full
+data-parallel plumbing (worker group, shards, report loop) and raises a
+clear ImportError from the workers only when the library itself is
+absent.  With the library present the loop is the reference's shape:
+rank 0 is authoritative, every rank trains on its shard.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+def _make_gbdt_loop(lib_name: str, params: dict, dmatrix_kwargs: dict,
+                    num_boost_round: int, label_column: str) -> Callable:
+    def train_loop(config: dict) -> None:
+        from ray_tpu.train import session
+
+        try:
+            if lib_name == "xgboost":
+                import xgboost as lib
+            else:
+                import lightgbm as lib
+        except ImportError as e:
+            raise ImportError(
+                f"{lib_name} is not installed; {lib_name.title()}Trainer "
+                "needs it on every worker (offline env: provide a local "
+                'wheel via runtime_env {"pip": {...}})') from e
+        shard = session.get_dataset_shard("train")
+        import numpy as np
+
+        batches = list(shard.iter_batches(batch_size=None)) if shard \
+            else []
+        if not batches:
+            session.report({"error": "empty shard"})
+            return
+        X = np.concatenate(
+            [np.column_stack([b[k] for k in sorted(b) if k != label_column])
+             for b in batches])
+        y = np.concatenate([b[label_column] for b in batches])
+        if lib_name == "xgboost":
+            dtrain = lib.DMatrix(X, label=y, **dmatrix_kwargs)
+            evals_result: dict = {}
+            booster = lib.train(params, dtrain,
+                                num_boost_round=num_boost_round,
+                                evals=[(dtrain, "train")],
+                                evals_result=evals_result)
+            metric = {k: v[-1] for k, v in
+                      evals_result.get("train", {}).items()}
+        else:
+            dtrain = lib.Dataset(X, label=y)
+            booster = lib.train(params, dtrain,
+                                num_boost_round=num_boost_round)
+            metric = {}
+        import tempfile
+
+        ckpt_dir = tempfile.mkdtemp(prefix=f"{lib_name}_ckpt_")
+        booster.save_model(f"{ckpt_dir}/model.{lib_name}")
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        session.report({"boost_rounds": num_boost_round, **metric},
+                       checkpoint=Checkpoint.from_directory(ckpt_dir))
+
+    return train_loop
+
+
+class XGBoostTrainer(DataParallelTrainer):
+    """ray: XGBoostTrainer(params=..., label_column=..., datasets=...)."""
+
+    def __init__(self, *, params: dict | None = None,
+                 label_column: str = "label",
+                 num_boost_round: int = 10,
+                 dmatrix_kwargs: dict | None = None,
+                 **kwargs: Any):
+        super().__init__(
+            _make_gbdt_loop("xgboost", params or {}, dmatrix_kwargs or {},
+                            num_boost_round, label_column), **kwargs)
+
+
+class LightGBMTrainer(DataParallelTrainer):
+    """ray: LightGBMTrainer — same surface, lightgbm backend."""
+
+    def __init__(self, *, params: dict | None = None,
+                 label_column: str = "label",
+                 num_boost_round: int = 10,
+                 **kwargs: Any):
+        super().__init__(
+            _make_gbdt_loop("lightgbm", params or {}, {},
+                            num_boost_round, label_column), **kwargs)

@@ -20,7 +20,8 @@ _session_lock = threading.Lock()
 class _Session:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  node_id: str, trial_name: str,
-                 checkpoint: Checkpoint | None, config: dict):
+                 checkpoint: Checkpoint | None, config: dict,
+                 dataset_shards: dict | None = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -28,6 +29,7 @@ class _Session:
         self.trial_name = trial_name
         self.loaded_checkpoint = checkpoint
         self.config = config
+        self.dataset_shards = dataset_shards or {}
         self.out: queue.Queue = queue.Queue(maxsize=8)
         self.stop_event = threading.Event()
 
@@ -69,6 +71,12 @@ def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
 def get_checkpoint() -> Checkpoint | None:
     """Checkpoint to resume from, if any (ray: train.get_checkpoint)."""
     return get_session().loaded_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of the trainer's dataset (ray:
+    train.get_dataset_shard — a DataIterator fed by streaming_split)."""
+    return get_session().dataset_shards.get(name)
 
 
 class TrainContext:

@@ -55,7 +55,8 @@ class TrainWorker:
     # ---------------------------------------------------------- execution
     def start_train_fn(self, fn: Callable, config: dict, *,
                        world_rank: int, world_size: int, local_rank: int,
-                       trial_name: str, checkpoint=None) -> bool:
+                       trial_name: str, checkpoint=None,
+                       dataset_shards: dict | None = None) -> bool:
         self._finished = False
         self._error = None
         self._result = None
@@ -63,7 +64,8 @@ class TrainWorker:
             world_rank=world_rank, world_size=world_size,
             local_rank=local_rank,
             node_id=ray_tpu.get_runtime_context().get_node_id(),
-            trial_name=trial_name, checkpoint=checkpoint, config=config)
+            trial_name=trial_name, checkpoint=checkpoint, config=config,
+            dataset_shards=dataset_shards)
 
         def run():
             try:

@@ -1,0 +1,111 @@
+"""Dask-on-ray_tpu scheduler: execute dask graphs as cluster tasks.
+
+Analog of ray: python/ray/util/dask/scheduler.py (ray_dask_get:41 —
+a dask scheduler that submits one Ray task per graph key and lets refs
+flow as task arguments).  The dask graph format is plain data
+({key: task_tuple_or_literal}), so the scheduler works — and is tested —
+without dask installed; `enable_dask_on_ray_tpu()` additionally registers
+it as dask's default scheduler when dask IS importable.
+
+Semantics mirrored from the reference: one task per key, upstream
+results travel as ObjectRefs (never through the driver), nested task
+tuples execute inside the worker, `get(dsk, keys)` accepts dask's
+(possibly nested) key lists.
+"""
+from __future__ import annotations
+
+from typing import Any, Hashable
+
+import ray_tpu
+
+
+def _ishashable(x) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def _is_task(expr) -> bool:
+    """dask task convention: a tuple whose head is callable."""
+    return isinstance(expr, tuple) and bool(expr) and callable(expr[0])
+
+
+def _find_deps(expr, dsk, out: set) -> None:
+    """Collect graph keys referenced by a task expression."""
+    if _is_task(expr):
+        for a in expr[1:]:
+            _find_deps(a, dsk, out)
+    elif isinstance(expr, list):
+        for a in expr:
+            _find_deps(a, dsk, out)
+    elif _ishashable(expr) and expr in dsk:
+        out.add(expr)
+
+
+def _rebuild(expr, deps: dict):
+    """Worker-side evaluation of one task expression: keys substitute
+    their upstream values, nested task tuples execute depth-first."""
+    if _is_task(expr):
+        fn = expr[0]
+        return fn(*[_rebuild(a, deps) for a in expr[1:]])
+    if isinstance(expr, list):
+        return [_rebuild(a, deps) for a in expr]
+    if _ishashable(expr) and expr in deps:
+        return deps[expr]
+    return expr
+
+
+@ray_tpu.remote
+def _dask_task(expr, dep_keys, *dep_vals):
+    return _rebuild(expr, dict(zip(dep_keys, dep_vals)))
+
+
+def get(dsk: dict, keys, **_kwargs) -> Any:
+    """The dask scheduler entry point (ray: ray_dask_get).
+
+    Submits one ray_tpu task per graph key reachable from `keys`
+    (dependency refs passed as task args, so the cluster pipelines the
+    graph), then materializes the requested keys.
+    """
+    refs: dict[Hashable, Any] = {}
+
+    def submit(key) -> Any:
+        if key in refs:
+            return refs[key]
+        expr = dsk[key]
+        deps: set = set()
+        _find_deps(expr, dsk, deps)
+        dep_keys = sorted(deps, key=str)
+        dep_refs = [submit(k) for k in dep_keys]
+        refs[key] = _dask_task.remote(expr, dep_keys, *dep_refs)
+        return refs[key]
+
+    def walk(k):
+        if isinstance(k, list):
+            return [walk(x) for x in k]
+        return submit(k)
+
+    ref_tree = walk(keys)
+
+    def materialize(t):
+        if isinstance(t, list):
+            return [materialize(x) for x in t]
+        return ray_tpu.get(t)
+
+    return materialize(ref_tree)
+
+
+def enable_dask_on_ray_tpu() -> None:
+    """Make this scheduler dask's default (ray: enable_dask_on_ray).
+    Requires dask; the raw `get` works without it."""
+    import dask
+
+    dask.config.set(scheduler=get)
+
+
+def disable_dask_on_ray_tpu() -> None:
+    import dask
+
+    dask.config.set(scheduler=None)

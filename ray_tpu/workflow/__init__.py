@@ -7,8 +7,8 @@ storage as it completes, so `resume` re-runs only the steps that never
 finished (ray: step checkpoint + deterministic replay).
 """
 from ray_tpu.workflow.execution import (cancel, delete, get_output,
-                                        get_status, list_all, resume, run,
-                                        run_async)
+                                        get_status, list_all, list_events,
+                                        resume, run, run_async)
 
 __all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "cancel", "delete"]
+           "list_all", "list_events", "cancel", "delete"]

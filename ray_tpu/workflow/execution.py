@@ -94,48 +94,176 @@ class _Execution:
             pickle.dump(value, f)
         os.replace(tmp, p)   # atomic: a crash never leaves a torn step
 
-    def execute(self, dag: DAGNode, args: tuple, kwargs: dict) -> Any:
-        """Walk the DAG; checkpoint every step result as it completes.
-        Steps found checkpointed are NOT re-run (ray: workflow replay)."""
+    # ----------------------------------------------------------- events
+    def emit(self, event: str, step: str, **extra) -> None:
+        """Append one event to the workflow's durable event log (ray:
+        workflow events / WorkflowExecutionEvent)."""
+        rec = {"ts": time.time(), "event": event, "step": step, **extra}
+        with open(os.path.join(self.dir, "events.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if self.on_event is not None:
+            try:
+                self.on_event(rec)
+            except Exception:  # noqa: BLE001 - listener bugs never kill runs
+                pass
+
+    on_event = None
+
+    def execute(self, dag: DAGNode, args: tuple, kwargs: dict, *,
+                step_max_retries: int = 0,
+                step_timeout_s: float | None = None,
+                max_concurrent_steps: int | None = None) -> Any:
+        """Drive the DAG with bounded parallelism; checkpoint every step
+        result as it completes.  Steps found checkpointed are NOT re-run
+        (ray: workflow replay); failed steps retry with backoff up to
+        step_max_retries (ray: workflow step max_retries) and are bounded
+        by step_timeout_s; at most max_concurrent_steps run at once
+        (ray: workflow executor concurrency limits)."""
         # Structural paths give every node a stable step key across runs.
         paths: dict[int, str] = {}
+        nodes: dict[int, DAGNode] = {}
 
         def assign(node: DAGNode, path: str) -> None:
             if id(node) in paths:
                 return
             paths[id(node)] = path
+            nodes[id(node)] = node
             for i, c in enumerate(node._children()):
                 assign(c, f"{path}/{i}")
 
         assign(dag, "root")
+        # Dependency bookkeeping for the ready-queue scheduler.
+        dependents: dict[int, list[int]] = {i: [] for i in nodes}
+        missing: dict[int, int] = {}
+        for nid, node in nodes.items():
+            deps = {id(c) for c in node._children()}
+            missing[nid] = len(deps)
+            for d in deps:
+                dependents[d].append(nid)
+
         memo: dict[int, Any] = {}
 
         def resolve(node: DAGNode):
-            if id(node) in memo:
-                return memo[id(node)]
-            if isinstance(node, (InputNode, InputAttributeNode,
-                                 MultiOutputNode)):
-                value = node._execute_impl(resolve, args, kwargs)
-            else:
-                key = _step_key(node, paths[id(node)])
-                done, value = self.load_step(key)
-                if not done:
-                    ref = node._execute_impl(resolve, args, kwargs)
-                    value = ray_tpu.get(ref) if hasattr(ref, "binary") \
-                        else ref
-                    self.save_step(key, value)
-            memo[id(node)] = value
-            return value
+            return memo[id(node)]
 
-        return resolve(dag)
+        def is_step(node: DAGNode) -> bool:
+            return isinstance(node, (FunctionNode, ClassMethodNode))
+
+        limit = max(1, max_concurrent_steps) if max_concurrent_steps \
+            else float("inf")
+        ready = [nid for nid, m in missing.items() if m == 0]
+        # ref -> (nid, key, attempt, deadline)
+        running: dict[Any, tuple] = {}
+        # Retry backoff as not-before timestamps — a blocking sleep here
+        # would stall completion handling and timeout enforcement for
+        # every OTHER in-flight step.
+        backoff: list[tuple[float, int, int]] = []   # (when, nid, attempt)
+
+        def finish(nid: int, value: Any) -> None:
+            memo[nid] = value
+            for dep in dependents[nid]:
+                missing[dep] -= 1
+                if missing[dep] == 0:
+                    ready.append(dep)
+
+        def submit(nid: int, attempt: int) -> None:
+            node = nodes[nid]
+            key = _step_key(node, paths[nid])
+            ref = node._execute_impl(resolve, args, kwargs)
+            if not hasattr(ref, "binary"):     # synchronous result
+                self.save_step(key, ref)
+                self.emit("completed", key, attempt=attempt)
+                finish(nid, ref)
+                return
+            deadline = None if step_timeout_s is None \
+                else time.monotonic() + step_timeout_s
+            running[ref] = (nid, key, attempt, deadline)
+            self.emit("submitted" if attempt == 0 else "retry", key,
+                      attempt=attempt)
+
+        while ready or running or backoff:
+            # Backed-off retries whose time has come re-enter the window.
+            now0 = time.monotonic()
+            due = [b for b in backoff if b[0] <= now0]
+            if due:
+                backoff = [b for b in backoff if b[0] > now0]
+                for _when, nid, attempt in due:
+                    if len(running) < limit:
+                        submit(nid, attempt)
+                    else:
+                        backoff.append((now0, nid, attempt))
+            # Fill the window: plain nodes evaluate inline, steps submit.
+            while ready and len(running) < limit:
+                nid = ready.pop(0)
+                node = nodes[nid]
+                if not is_step(node):
+                    finish(nid, node._execute_impl(resolve, args, kwargs))
+                    continue
+                key = _step_key(node, paths[nid])
+                done, value = self.load_step(key)
+                if done:
+                    self.emit("replayed", key)
+                    finish(nid, value)
+                else:
+                    submit(nid, 0)
+            if not running:
+                if backoff:
+                    next_due = min(b[0] for b in backoff)
+                    time.sleep(max(0.0, min(0.05,
+                                            next_due - time.monotonic())))
+                continue
+            done_refs, _ = ray_tpu.wait(list(running),
+                                        num_returns=1, timeout=0.2)
+            now = time.monotonic()
+            for ref in done_refs or []:
+                nid, key, attempt, _dl = running.pop(ref)
+                try:
+                    value = ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001 - step failure
+                    self.emit("failed", key, attempt=attempt,
+                              error=repr(e))
+                    if attempt < step_max_retries:
+                        backoff.append((
+                            time.monotonic()
+                            + min(2.0, 0.2 * (2 ** attempt)),
+                            nid, attempt + 1))
+                        continue
+                    raise
+                self.save_step(key, value)
+                self.emit("completed", key, attempt=attempt)
+                finish(nid, value)
+            # Step timeouts: cancel + fail/retry overdue refs.
+            for ref, (nid, key, attempt, dl) in list(running.items()):
+                if dl is not None and now > dl:
+                    running.pop(ref)
+                    try:
+                        ray_tpu.cancel(ref)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.emit("timeout", key, attempt=attempt)
+                    if attempt < step_max_retries:
+                        submit(nid, attempt + 1)
+                    else:
+                        raise TimeoutError(
+                            f"workflow step {key} exceeded "
+                            f"{step_timeout_s}s (attempt {attempt})")
+
+        return memo[id(dag)]
 
 
 def run(dag: DAGNode, *args, workflow_id: str | None = None,
-        storage: str | None = None, **kwargs) -> Any:
+        storage: str | None = None, step_max_retries: int = 0,
+        step_timeout_s: float | None = None,
+        max_concurrent_steps: int | None = None,
+        on_event=None, **kwargs) -> Any:
     """Execute a DAG durably; returns the final result (ray:
-    workflow.run)."""
+    workflow.run).  step_max_retries / step_timeout_s /
+    max_concurrent_steps bound each step's retries, wall-clock, and the
+    number of steps in flight; on_event observes the durable event
+    stream (see _Execution.emit)."""
     workflow_id = workflow_id or f"wf-{int(time.time() * 1000):x}"
     ex = _Execution(workflow_id, storage)
+    ex.on_event = on_event
     meta = {"workflow_id": workflow_id, "status": RUNNING,
             "start": time.time(),
             "dag": None}
@@ -145,7 +273,10 @@ def run(dag: DAGNode, *args, workflow_id: str | None = None,
         pass
     _write_meta(ex.dir, meta)
     try:
-        result = ex.execute(dag, args, kwargs)
+        result = ex.execute(dag, args, kwargs,
+                            step_max_retries=step_max_retries,
+                            step_timeout_s=step_timeout_s,
+                            max_concurrent_steps=max_concurrent_steps)
     except Exception:
         meta["status"] = FAILED
         _write_meta(ex.dir, meta)
@@ -221,3 +352,14 @@ def delete(workflow_id: str, storage: str | None = None) -> None:
 
     shutil.rmtree(os.path.join(_root(storage), workflow_id),
                   ignore_errors=True)
+
+
+def list_events(workflow_id: str,
+                storage: str | None = None) -> list[dict]:
+    """The workflow's durable event stream (ray: workflow events)."""
+    path = os.path.join(_root(storage), workflow_id, "events.jsonl")
+    try:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        return []

@@ -182,6 +182,58 @@ def test_runtime_env_working_dir(rt, tmp_path):
     assert ray_tpu.get(ref) == "from-working-dir"
 
 
+def _make_wheel(wheel_dir, name: str, version: str, source: str) -> None:
+    """Hand-roll a minimal pure-python wheel (no build backend needed —
+    a wheel is a zip with dist-info metadata)."""
+    import zipfile
+
+    tag = f"{name}-{version}"
+    whl = wheel_dir / f"{tag}-py3-none-any.whl"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", source)
+        zf.writestr(f"{tag}.dist-info/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{tag}.dist-info/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\n"
+                    "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{tag}.dist-info/RECORD", "")
+
+
+def test_runtime_env_pip_offline(rt, tmp_path):
+    """pip runtime env from a local wheel dir (ray: runtime_env/pip.py
+    minus the network): the env's task imports the package; a plain task
+    on the same pooled worker must NOT see it."""
+    wheel_dir = tmp_path / "wheels"
+    wheel_dir.mkdir()
+    _make_wheel(wheel_dir, "envtestpkg", "1.0", "VALUE = 42\n")
+
+    @ray_tpu.remote
+    def with_pkg():
+        import envtestpkg
+
+        return envtestpkg.VALUE
+
+    @ray_tpu.remote
+    def without_pkg():
+        try:
+            import envtestpkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    env = {"pip": {"packages": ["envtestpkg"],
+                   "wheel_dir": str(wheel_dir)}}
+    assert ray_tpu.get(with_pkg.options(runtime_env=env).remote()) == 42
+    assert ray_tpu.get(without_pkg.remote()) == "isolated"
+    # Version pinning resolves from the same local dir.
+    _make_wheel(wheel_dir, "envtestpkg", "2.0", "VALUE = 43\n")
+    env2 = {"pip": {"packages": ["envtestpkg==2.0"],
+                    "wheel_dir": str(wheel_dir)}}
+    assert ray_tpu.get(with_pkg.options(runtime_env=env2).remote()) == 43
+
+
 def test_cli_status_and_list(rt):
     """Smoke the CLI code paths in-process (full subprocess CLI covered by
     job submission)."""
@@ -211,3 +263,83 @@ def test_cli_status_and_memory(rt):
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr[-1000:]
         assert expect in out.stdout, out.stdout
+
+
+def test_workflow_retries_timeout_events(rt, tmp_path):
+    """Workflow hardening (ray: workflow_executor.py): per-step retries
+    with a durable event stream, step timeouts, and bounded concurrency."""
+    from ray_tpu import workflow
+    from ray_tpu.dag.dag_node import InputNode
+
+    storage = str(tmp_path / "wf")
+    flaky_marker = tmp_path / "flaky"
+    flaky_marker.write_text("0")
+
+    @ray_tpu.remote
+    def flaky(x, marker):
+        n = int(open(marker).read()) + 1
+        open(marker, "w").write(str(n))
+        if n < 3:
+            raise RuntimeError(f"attempt {n} fails")
+        return x + 100
+
+    with InputNode() as inp:
+        dag = flaky.bind(inp, str(flaky_marker))
+
+    events = []
+    out = workflow.run(dag, 1, workflow_id="wf-retry", storage=storage,
+                       step_max_retries=3, on_event=events.append)
+    assert out == 101
+    kinds = [e["event"] for e in events]
+    assert kinds.count("failed") == 2 and kinds.count("retry") == 2
+    assert kinds[-1] == "completed"
+    # The durable stream matches what the listener saw.
+    stored = workflow.list_events("wf-retry", storage=storage)
+    assert [e["event"] for e in stored] == kinds
+
+    # Step timeout surfaces as TimeoutError after exhausting retries.
+    @ray_tpu.remote
+    def sleepy():
+        import time as _t
+
+        _t.sleep(30)
+        return "late"
+
+    with InputNode() as inp2:
+        dag2 = sleepy.bind()
+
+    with pytest.raises((TimeoutError, Exception)):
+        workflow.run(dag2, workflow_id="wf-timeout", storage=storage,
+                     step_timeout_s=1.0)
+
+
+def test_workflow_concurrency_limit(rt, tmp_path):
+    """max_concurrent_steps bounds in-flight steps: with limit 1, step
+    wall-clocks never overlap."""
+    import json as _json
+
+    from ray_tpu import workflow
+    from ray_tpu.dag.dag_node import InputNode, MultiOutputNode
+
+    storage = str(tmp_path / "wf")
+    log = tmp_path / "spans.jsonl"
+
+    @ray_tpu.remote
+    def span(i, path):
+        import time as _t
+
+        t0 = _t.time()
+        _t.sleep(0.3)
+        with open(path, "a") as f:
+            f.write(_json.dumps([t0, _t.time()]) + "\n")
+        return i
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([span.bind(i, str(log)) for i in range(3)])
+
+    out = workflow.run(dag, None, workflow_id="wf-conc", storage=storage,
+                       max_concurrent_steps=1)
+    assert sorted(out) == [0, 1, 2]
+    spans = sorted(_json.loads(x) for x in log.read_text().splitlines())
+    for (s0, e0), (s1, _e1) in zip(spans, spans[1:]):
+        assert s1 >= e0 - 0.05, f"steps overlapped: {spans}"

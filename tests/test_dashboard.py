@@ -81,6 +81,12 @@ def test_tasks_and_summary(dash):
 def test_index_metrics_timeline(dash):
     body, ctype = _get(dash.url + "/")
     assert "ray-tpu" in body and "text/html" in ctype
+    # The SPA frontend (ray: dashboard/client) + its script load.
+    assert 'src="app.js"' in body
+    js, jstype = _get(dash.url + "/app.js")
+    assert "javascript" in jstype and "/api/v0/nodes" in js
+    legacy, _ = _get(dash.url + "/legacy")
+    assert "ray-tpu" in legacy
     body, ctype = _get(dash.url + "/metrics")
     assert "ray_tpu_cluster_alive_nodes" in body
     body, _ = _get(dash.url + "/api/v0/timeline")

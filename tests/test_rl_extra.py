@@ -139,3 +139,153 @@ def test_multi_agent_distinct_policies(rt):
         assert not np.allclose(before[pid], after[pid]["pi"]["w0"]), \
             f"policy {pid} never updated"
     algo.cleanup()
+
+
+def test_appo_vtrace_clip_learns(rt):
+    """APPO (rllib: algorithms/appo/appo.py:277): clipped surrogate on
+    V-trace advantages + target-net KL.  Seeded threshold like IMPALA's."""
+    from ray_tpu.rl import APPOConfig
+
+    config = (APPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2)
+              .training(lr=2e-3, train_batch_size=512,
+                        entropy_coeff=0.01, clip_param=0.4,
+                        kl_coeff=0.2, tau=0.05)
+              .debugging(seed=0))
+    algo = config.build()
+    first, best = None, -1.0
+    for _ in range(10):
+        result = algo.step()
+        ret = result["episode_return_mean"]
+        if first is None and ret == ret:
+            first = ret
+        if ret == ret:
+            best = max(best, ret)
+        assert "learner/mean_kl" in result
+        if best >= 100.0:
+            break
+    algo.cleanup()
+    assert first is not None, "no episodes completed"
+    assert best >= 40.0, f"APPO failed to improve: best={best:.1f}"
+
+
+def test_connector_pipeline_surgery(rt):
+    """ConnectorV2 pipelines (rllib: connectors/connector_v2.py:29):
+    composition, list surgery, and the shared env->learner pieces."""
+    from ray_tpu.rl.connectors import (ConcatFragments, ConnectorCtx,
+                                       ConnectorPipelineV2, FnConnector,
+                                       RecordEpisodeMetrics,
+                                       StackFragments)
+
+    frags = [
+        {"obs": np.ones((4, 3), np.float32),
+         "episode_returns": np.array([10.0], np.float32)},
+        {"obs": np.zeros((4, 3), np.float32),
+         "episode_returns": np.array([], np.float32)},
+    ]
+
+    class Sink:
+        _episode_returns = []
+        _timesteps = 0
+
+    ctx = ConnectorCtx(Sink)
+    pipe = ConnectorPipelineV2(RecordEpisodeMetrics(), ConcatFragments())
+    out = pipe([dict(f) for f in frags], ctx)
+    assert out["obs"].shape == (8, 3)
+    assert Sink._episode_returns == [10.0] and Sink._timesteps == 8
+
+    # Stacked layout for the V-trace family.
+    pipe2 = ConnectorPipelineV2(StackFragments())
+    stacked = pipe2([{"obs": f["obs"]} for f in frags], ConnectorCtx())
+    assert stacked["obs"].shape == (2, 4, 3)
+
+    # Surgery: insert a normalizer before concat, remove it again.
+    norm = FnConnector(lambda d, c: d, name="Norm")
+    pipe.insert_before("ConcatFragments", norm)
+    assert [p.name for p in pipe.pieces] == [
+        "RecordEpisodeMetrics", "Norm", "ConcatFragments"]
+    pipe.remove("Norm").append(norm).prepend(
+        FnConnector(lambda d, c: d, name="First"))
+    assert pipe.pieces[0].name == "First"
+    assert pipe.pieces[-1].name == "Norm"
+    with pytest.raises(ValueError):
+        pipe.insert_after("Missing", norm)
+
+
+def test_marwil_offline_learns(rt):
+    """MARWIL (rllib: algorithms/marwil/marwil.py): advantage-weighted
+    cloning beats the random baseline from logged transitions only, and
+    the exp-weights actually spread (beta>0 is not plain BC)."""
+    from ray_tpu.rl import MARWILConfig
+
+    config = (MARWILConfig()
+              .environment("CartPole-v1")
+              .training(lr=2e-3, beta=1.0, num_sgd_iter=8,
+                        minibatch_size=256)
+              .offline(offline_data=_expert_transitions(2000))
+              .debugging(seed=0))
+    algo = config.build()
+    result = {}
+    for _ in range(8):
+        result = algo.step()
+    ret = result["episode_return_mean"]
+    assert result["learner/mean_weight"] > 0
+    assert result["learner/action_accuracy"] > 0.7
+    algo.cleanup()
+    assert ret > 45, f"MARWIL offline policy too weak: return={ret:.1f}"
+
+
+def test_marwil_beta_zero_is_bc(rt):
+    """beta=0 collapses the weight to 1: loss equals plain BC's NLL."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.bc import BC
+    from ray_tpu.rl.marwil import MARWIL, discounted_returns
+
+    data = _expert_transitions(256)
+    returns = discounted_returns(data["rewards"], data["dones"], 0.99)
+    batch = {"obs": jnp.asarray(data["obs"]),
+             "actions": jnp.asarray(data["actions"]),
+             "returns": jnp.asarray(returns)}
+    import jax
+
+    from ray_tpu.rl import models
+
+    params = models.policy_value_init(jax.random.PRNGKey(0), 4, 2)
+    cfg = {"beta": 0.0, "vf_coeff": 0.0}
+    m_loss, m_aux = MARWIL.loss_builder(cfg)(params, batch)
+    b_loss, _ = BC.loss_builder({})(params, batch)
+    assert abs(float(m_loss) - float(b_loss)) < 1e-5
+    assert abs(float(m_aux["mean_weight"]) - 1.0) < 1e-6
+
+
+def test_dreamerv3_machinery(rt):
+    """DreamerV3 (rllib: algorithms/dreamerv3): RSSM world model +
+    imagination-trained actor-critic.  Machinery test in the style of
+    SAC/DQN's: the world model demonstrably learns (reconstruction +
+    reward losses drop), imagination losses stay finite, episodes
+    complete under the learned policy."""
+    from ray_tpu.rl import DreamerV3Config
+
+    config = (DreamerV3Config()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=1)
+              .training(train_batch_size=256, updates_per_step=3)
+              .debugging(seed=0))
+    algo = config.build()
+    first, last = None, None
+    for _ in range(6):
+        m = algo.step()
+        wm = m.get("learner/wm_loss")
+        if wm is not None and wm == wm:
+            if first is None:
+                first = wm
+            last = wm
+            for key in ("learner/actor_loss", "learner/critic_loss",
+                        "learner/entropy"):
+                assert m[key] == m[key], f"{key} is NaN"
+    algo.cleanup()
+    assert first is not None, "world model never trained"
+    assert last < first, f"world-model loss did not drop: {first}->{last}"
+    assert len(algo._episode_returns) > 0, "no episodes completed"
