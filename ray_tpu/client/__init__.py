@@ -29,11 +29,18 @@ def _cloudpickle_dumps(value) -> bytes:
 
 
 class ClientContext:
-    """One connection to a proxy = one dedicated host driver."""
+    """One connection to a proxy = one dedicated host driver.
 
-    def __init__(self, proxy_addr: str, namespace: str = "default"):
+    op_timeout bounds every API round-trip: a dead proxy/host is a
+    silently-reconnecting zmq DEALER, so without a bound one orphaned
+    call stalls the caller indefinitely (and sequential callers stack).
+    """
+
+    def __init__(self, proxy_addr: str, namespace: str = "default",
+                 op_timeout: float = 120.0):
         self.proxy_addr = proxy_addr
         self.namespace = namespace
+        self.op_timeout = op_timeout
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, daemon=True,
@@ -62,11 +69,13 @@ class ClientContext:
                                         timeout=timeout))
 
     def _req(self, op: str, header: dict, blobs: list | None = None,
-             timeout: float = 600.0):
+             timeout: float | None = None):
         """One API op, relayed through the proxy to this client's host.
         Remote exceptions unwrap to their original cause."""
         from ray_tpu._private.rpc import RemoteError
 
+        if timeout is None:
+            timeout = self.op_timeout
         try:
             return self._call_proxy(
                 "client_req",
