@@ -334,11 +334,16 @@ class NodeAgent:
     async def _reaper_loop(self) -> None:
         """Detect dead worker processes; fail leases/actors accordingly."""
         last_sweep = 0.0
+        last_probe = 0.0
         while not self._closed:
             await asyncio.sleep(0.2)
             for w in list(self.workers.values()):
                 if w.state != "dead" and w.proc and w.proc.poll() is not None:
                     await self._on_worker_dead(w)
+            nowp = time.monotonic()
+            if nowp - last_probe >= 5.0:
+                last_probe = nowp
+                await self._probe_lease_submitters()
             # Reclaim arena pins held by crash-killed readers (any process
             # that mmap'd the store and died without releasing; the
             # reference's plasma does this on client-socket close).
@@ -358,6 +363,45 @@ class NodeAgent:
                     self.store.retry_deletes()
                 except Exception:  # noqa: BLE001
                     pass
+
+    async def _probe_lease_submitters(self) -> None:
+        """Reap leases whose SUBMITTER (driver/worker) died without
+        returning them — zmq never surfaces peer death, so a crashed or
+        terminated client (e.g. a client-proxy host driver) would
+        otherwise hold its leased workers' resources forever (ray: the
+        raylet returns workers when the owner's connection drops;
+        leases here are connectionless, so liveness is probed).  Three
+        consecutive failed pings (~15s) reap."""
+        by_submitter: dict[str, list[WorkerHandle]] = {}
+        for w in self.workers.values():
+            if w.state == "leased" and w.submitter:
+                by_submitter.setdefault(w.submitter, []).append(w)
+        if not hasattr(self, "_submitter_fails"):
+            self._submitter_fails: dict[str, int] = {}
+        self._submitter_fails = {
+            a: c for a, c in self._submitter_fails.items()
+            if a in by_submitter}
+        for addr, workers in by_submitter.items():
+            try:
+                await self.clients.get(addr).call("ping", {}, timeout=3.0)
+                self._submitter_fails.pop(addr, None)
+                continue
+            except Exception:  # noqa: BLE001 - unreachable
+                n = self._submitter_fails.get(addr, 0) + 1
+                self._submitter_fails[addr] = n
+                if n < 3:
+                    continue
+            logger.warning(
+                "lease submitter %s unreachable; reaping %d lease(s)",
+                addr, len(workers))
+            self.clients.drop(addr)
+            for w in workers:
+                if w.state == "leased" and w.submitter == addr:
+                    self._release_lease_resources(w)
+                    if not w.is_device_worker:
+                        w.state = "idle"
+            self._submitter_fails.pop(addr, None)
+            self._try_grant_pending()
 
     async def _log_tail_loop(self) -> None:
         """Tail worker log files; forward new lines to the controller,

@@ -50,6 +50,21 @@ class ClientHost:
     def __init__(self) -> None:
         self.objects: dict[str, ray_tpu.ObjectRef] = {}
         self.actors: dict[str, object] = {}
+        # Actors CREATED by this client (vs merely looked up): killed at
+        # disconnect, like the reference tears down a client's state with
+        # its SpecificServer (named actors included — they belong to this
+        # client's session; a lingering named actor would hold its CPU
+        # lease forever).
+        self.created: set[str] = set()
+
+    def cleanup(self) -> None:
+        for actor_id in list(self.created):
+            handle = self.actors.get(actor_id)
+            if handle is not None:
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
 
     def _pin(self, ref) -> str:
         h = ref.hex()
@@ -98,6 +113,7 @@ class ClientHost:
         handle = await asyncio.to_thread(
             lambda: actor_cls.remote(*args, **kwargs))
         self.actors[handle.actor_id] = handle
+        self.created.add(handle.actor_id)
         return {"actor_id": handle.actor_id}
 
     async def rpc_actor_call(self, h: dict, blobs: list):
@@ -144,6 +160,8 @@ class ClientHost:
 
 
 async def _serve() -> None:
+    import signal
+
     import zmq.asyncio
 
     from ray_tpu._private.rpc import RpcServer
@@ -153,7 +171,27 @@ async def _serve() -> None:
     server.register_all(_HOST)
     server.start()
     print(json.dumps({"host_addr": server.address}), flush=True)
-    await asyncio.Event().wait()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+
+    async def _watch_proxy():
+        # The proxy is this host's parent; its death orphans us (ppid
+        # becomes 1/init) — exit rather than hold leases/actors forever.
+        while not stop.is_set():
+            if os.getppid() <= 1:
+                stop.set()
+                return
+            await asyncio.sleep(1.0)
+
+    watcher = loop.create_task(_watch_proxy())
+    await stop.wait()
+    watcher.cancel()
+    # Graceful teardown: this client's actors die with its session, and
+    # ray_tpu.shutdown returns our leases before the process exits.
+    await asyncio.to_thread(_HOST.cleanup)
+    await asyncio.to_thread(ray_tpu.shutdown)
 
 
 def main() -> None:

@@ -89,6 +89,8 @@ async def _main(argv: list[str]) -> None:
     p.add_argument("--cluster", required=True)
     p.add_argument("--port", type=int, default=None)
     args = p.parse_args(argv)
+    import os
+
     ctx = zmq.asyncio.Context()
     proxy = ProxyServer(args.cluster)
     proxy._pool = ClientPool(ctx)
@@ -97,7 +99,11 @@ async def _main(argv: list[str]) -> None:
     server.start()
     print(json.dumps({"proxy_addr": server.address}), flush=True)
     try:
-        await asyncio.Event().wait()
+        # Exit when orphaned (spawner died without terminate): a leaked
+        # proxy would keep its per-client hosts — and their leases —
+        # alive forever.
+        while os.getppid() > 1:
+            await asyncio.sleep(1.0)
     finally:
         proxy.shutdown()
 
