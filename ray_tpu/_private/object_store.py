@@ -80,6 +80,18 @@ class MemoryStore:
     def get_if_exists(self, object_id: bytes) -> MemoryEntry | None:
         return self._entries.get(object_id)
 
+    def reset(self, object_id: bytes) -> MemoryEntry:
+        """Clear an entry for re-resolution (lineage reconstruction)
+        WITHOUT replacing the object: existing waiters keep their
+        reference and wake on the refill."""
+        e = self.entry(object_id)
+        e.has_value, e.value, e.frames, e.error = False, None, None, None
+        e.locations = []
+        e.event.clear()
+        if e.t_event is not None:
+            e.t_event.clear()
+        return e
+
     def put_value(self, object_id: bytes, value: Any) -> None:
         e = self.entry(object_id)
         e.has_value = True

@@ -1477,9 +1477,20 @@ class CoreWorker:
                 # Re-check AFTER publishing t_event: a fill between our
                 # check and the attach would have missed it.
                 if not e.resolved():
-                    remaining = None if deadline is None \
-                        else max(0.0, deadline - time.monotonic())
-                    if not e.t_event.wait(remaining):
+                    if deadline is None:
+                        # NEVER wait unbounded here: the fast path has no
+                        # failure-event machinery, so any lost fill (actor
+                        # death races, reconstruction) would hang the
+                        # caller forever.  After a grace period, hand the
+                        # wait to the async path, which resolves through
+                        # owners and observes death/lineage events.
+                        if not e.t_event.wait(5.0):
+                            logger.warning(
+                                "sync get slow for %s; falling back to "
+                                "the async resolution path", r.hex()[:12])
+                            return MISS
+                    elif not e.t_event.wait(
+                            max(0.0, deadline - time.monotonic())):
                         raise GetTimeoutError(
                             f"get() timed out waiting for "
                             f"{r.hex()[:12]}")
@@ -1635,8 +1646,10 @@ class CoreWorker:
             fid, header, blobs_, key = rec.submit_spec
             logger.warning("reconstructing %s via lineage", ref.hex()[:12])
             rec.state = "pending"
-            self.memory.delete(ref.binary())
-            self.memory.entry(ref.binary())
+            # Reset IN PLACE: delete+recreate would orphan any waiter
+            # holding the old entry object (its event would never fire
+            # again — a permanent hang for sync fast-path getters).
+            self.memory.reset(ref.binary())
             task = PendingTask(
                 task_id=bytes.fromhex(header["task_id"]), header=header,
                 blobs=blobs_, return_ids=[ref.binary()],
