@@ -26,6 +26,30 @@ except RuntimeError:
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Per-test watchdog (pytest-timeout isn't in this image): SIGALRM
+    interrupts a wedged main-thread wait, failing THAT test with a live
+    stack instead of hanging the whole suite — distributed-runtime bugs
+    here historically manifest as infinite gets."""
+    import signal
+
+    budget = int(os.environ.get("RAY_TPU_TEST_TIMEOUT_S", "900"))
+
+    def _fire(signum, frame):
+        raise TimeoutError(
+            f"watchdog: {item.nodeid} exceeded {budget}s "
+            f"(frame: {frame.f_code.co_filename}:{frame.f_lineno})")
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(budget)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 @pytest.fixture
 def ray_shared():
     """Shared local cluster (4 CPUs): initialized on first use, re-created
