@@ -1,0 +1,362 @@
+// raytpu C ABI — the C/C++ language frontend (ray analog: cpp/src/ray/api.cc
+// + the C++ worker, src/ray/core_worker/core_worker.cc C++ task execution).
+//
+// Design: the control plane is Python (drivers/workers are Python
+// processes; device compute is jax/XLA), so a C++ *driver* embeds CPython
+// and drives the same runtime every Python driver uses — no second
+// protocol implementation to drift.  C++ *task execution* is native: a
+// submitted task names a function registered (RAYTPU_REMOTE) inside a
+// user shared library; the executing worker dlopens that library and
+// calls the function through raytpu_cpp_invoke without touching the
+// interpreter for the user's compute.
+//
+// Two halves in one .so:
+//   driver half  — raytpu_init/put/get/submit/wait/shutdown (embed CPython)
+//   worker half  — raytpu_register / raytpu_cpp_invoke (pure C++, called
+//                  by ray_tpu/_private/cpp_runtime.py via ctypes)
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+extern "C" {
+
+typedef int (*raytpu_task_fn)(const uint8_t* in, uint64_t in_len,
+                              uint8_t** out, uint64_t* out_len);
+
+// ---------------------------------------------------------------- errors
+static thread_local std::string g_last_error;
+
+const char* raytpu_last_error(void) { return g_last_error.c_str(); }
+
+static void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// ------------------------------------------------------- native registry
+static std::mutex g_reg_mu;
+static std::map<std::string, raytpu_task_fn>& registry() {
+  static std::map<std::string, raytpu_task_fn> r;
+  return r;
+}
+
+int raytpu_register(const char* name, raytpu_task_fn fn) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  registry()[name] = fn;
+  return 0;
+}
+
+int raytpu_cpp_invoke(const char* name, const uint8_t* in, uint64_t in_len,
+                      uint8_t** out, uint64_t* out_len) {
+  raytpu_task_fn fn = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = registry().find(name);
+    if (it == registry().end()) {
+      g_last_error = std::string("no registered C++ task named '") + name +
+                     "' (is RAYTPU_REMOTE in the dlopened library?)";
+      return 1;
+    }
+    fn = it->second;
+  }
+  return fn(in, in_len, out, out_len);
+}
+
+void raytpu_buf_free(void* p) { free(p); }
+
+// C++ actors (ray analog: cpp/include/ray/api.h ray::Actor + the C++
+// worker's actor-instance table).  The instance lives as a raw pointer
+// inside the hosting worker process; the Python CppActor shim holds the
+// handle and routes method calls through raytpu_cpp_actor_invoke.
+typedef void* (*raytpu_actor_ctor)(const uint8_t* in, uint64_t in_len);
+typedef void (*raytpu_actor_dtor)(void* self);
+typedef int (*raytpu_method_fn)(void* self, const uint8_t* in,
+                                uint64_t in_len, uint8_t** out,
+                                uint64_t* out_len);
+
+struct ActorType {
+  raytpu_actor_ctor ctor;
+  raytpu_actor_dtor dtor;
+  std::map<std::string, raytpu_method_fn> methods;
+};
+
+static std::map<std::string, ActorType>& actor_types() {
+  static std::map<std::string, ActorType> r;
+  return r;
+}
+
+int raytpu_register_actor(const char* type_name, raytpu_actor_ctor ctor,
+                          raytpu_actor_dtor dtor) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  auto& t = actor_types()[type_name];
+  t.ctor = ctor;
+  t.dtor = dtor;
+  return 0;
+}
+
+int raytpu_register_method(const char* type_name, const char* method,
+                           raytpu_method_fn fn) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  actor_types()[type_name].methods[method] = fn;
+  return 0;
+}
+
+uint64_t raytpu_cpp_actor_new(const char* type_name, const uint8_t* in,
+                              uint64_t in_len) {
+  raytpu_actor_ctor ctor = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = actor_types().find(type_name);
+    if (it == actor_types().end() || !it->second.ctor) {
+      g_last_error = std::string("no registered C++ actor type '") +
+                     type_name + "'";
+      return 0;
+    }
+    ctor = it->second.ctor;
+  }
+  void* self = ctor(in, in_len);
+  if (!self) {
+    g_last_error = std::string("C++ actor ctor for '") + type_name +
+                   "' returned null";
+    return 0;
+  }
+  return (uint64_t)(uintptr_t)self;
+}
+
+int raytpu_cpp_actor_invoke(uint64_t handle, const char* type_name,
+                            const char* method, const uint8_t* in,
+                            uint64_t in_len, uint8_t** out,
+                            uint64_t* out_len) {
+  raytpu_method_fn fn = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = actor_types().find(type_name);
+    if (it != actor_types().end()) {
+      auto mit = it->second.methods.find(method);
+      if (mit != it->second.methods.end()) fn = mit->second;
+    }
+  }
+  if (!fn) {
+    g_last_error = std::string("no method '") + method + "' on C++ actor '" +
+                   type_name + "'";
+    return 1;
+  }
+  return fn((void*)(uintptr_t)handle, in, in_len, out, out_len);
+}
+
+void raytpu_cpp_actor_del(uint64_t handle, const char* type_name) {
+  raytpu_actor_dtor dtor = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    auto it = actor_types().find(type_name);
+    if (it != actor_types().end()) dtor = it->second.dtor;
+  }
+  if (dtor && handle) dtor((void*)(uintptr_t)handle);
+}
+
+// --------------------------------------------------------- driver bridge
+// All Python state lives in ray_tpu/_private/capi_bridge.py; this half
+// only marshals bytes across the ABI.
+static PyObject* g_bridge = nullptr;  // the capi_bridge module
+static PyThreadState* g_main_ts = nullptr;
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+int raytpu_init(const char* address) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_main_ts = PyEval_SaveThread();  // release the GIL for Gil{} users
+  }
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("ray_tpu._private.capi_bridge");
+  if (!mod) {
+    set_error_from_python();
+    return 1;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "capi_init", "z", address);
+  if (!r) {
+    set_error_from_python();
+    Py_DECREF(mod);
+    return 1;
+  }
+  Py_DECREF(r);
+  g_bridge = mod;  // keep the reference for the process lifetime
+  return 0;
+}
+
+static int copy_out_bytes(PyObject* b, void** out, uint64_t* out_len) {
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(b, &buf, &len) != 0) {
+    set_error_from_python();
+    return 1;
+  }
+  *out = malloc(len > 0 ? (size_t)len : 1);
+  memcpy(*out, buf, (size_t)len);
+  *out_len = (uint64_t)len;
+  return 0;
+}
+
+static int copy_out_hex(PyObject* s, char ref_hex[64]) {
+  const char* c = PyUnicode_AsUTF8(s);
+  if (!c) {
+    set_error_from_python();
+    return 1;
+  }
+  snprintf(ref_hex, 64, "%s", c);
+  return 0;
+}
+
+int raytpu_put(const void* data, uint64_t len, char ref_hex[64]) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_put", "y#", (const char*)data,
+                                    (Py_ssize_t)len);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  int rc = copy_out_hex(r, ref_hex);
+  Py_DECREF(r);
+  return rc;
+}
+
+int raytpu_get(const char* ref_hex, double timeout_s, void** out,
+               uint64_t* out_len) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_get", "sd", ref_hex,
+                                    timeout_s);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  int rc = copy_out_bytes(r, out, out_len);
+  Py_DECREF(r);
+  return rc;
+}
+
+int raytpu_submit(const char* lib_path, const char* fn_name, const void* args,
+                  uint64_t args_len, char ref_hex[64]) {
+  Gil gil;
+  PyObject* r =
+      PyObject_CallMethod(g_bridge, "capi_submit", "ssy#", lib_path, fn_name,
+                          (const char*)args, (Py_ssize_t)args_len);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  int rc = copy_out_hex(r, ref_hex);
+  Py_DECREF(r);
+  return rc;
+}
+
+// ready_mask[i] = 1 iff ref i completed within the timeout.
+int raytpu_wait(const char** ref_hexes, int n, int num_returns,
+                double timeout_s, int* ready_mask) {
+  Gil gil;
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; i++)
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(ref_hexes[i]));
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_wait", "Oid", lst,
+                                    num_returns, timeout_s);
+  Py_DECREF(lst);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  for (int i = 0; i < n && i < (int)PyList_GET_SIZE(r); i++)
+    ready_mask[i] = (int)PyLong_AsLong(PyList_GET_ITEM(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+int raytpu_create_actor(const char* lib_path, const char* type_name,
+                        const void* args, uint64_t args_len,
+                        char actor_id[64]) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_create_actor", "ssy#",
+                                    lib_path, type_name, (const char*)args,
+                                    (Py_ssize_t)args_len);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  int rc = copy_out_hex(r, actor_id);
+  Py_DECREF(r);
+  return rc;
+}
+
+int raytpu_actor_call(const char* actor_id, const char* method,
+                      const void* args, uint64_t args_len,
+                      char ref_hex[64]) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_actor_call", "ssy#",
+                                    actor_id, method, (const char*)args,
+                                    (Py_ssize_t)args_len);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  int rc = copy_out_hex(r, ref_hex);
+  Py_DECREF(r);
+  return rc;
+}
+
+int raytpu_kill_actor(const char* actor_id) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_kill_actor", "s",
+                                    actor_id);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int raytpu_release(const char* ref_hex) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_release", "s", ref_hex);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int raytpu_shutdown(void) {
+  if (!g_bridge) return 0;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_bridge, "capi_shutdown", nullptr);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,244 @@
+// raytpu C++ API — header over the C ABI (native/capi.cc).
+// Ray analog: cpp/include/ray/api.h (ray::Init, RAY_REMOTE, ray::Task).
+//
+//   #include "raytpu_api.h"
+//   int Add(const uint8_t* in, uint64_t n, uint8_t** out, uint64_t* m) {...}
+//   RAYTPU_REMOTE(Add)
+//   int main() {
+//     raytpu::Init("10.0.0.1:6379");
+//     auto ref = raytpu::Submit("Add", payload);   // runs in a worker
+//     std::string result = raytpu::Get(ref);
+//   }
+//
+// Task functions take a byte buffer and return a malloc'd byte buffer
+// (0 = ok, nonzero = error).  raytpu::Writer/Reader give a tiny portable
+// archive for PODs + strings so call sites don't hand-pack bytes.
+#pragma once
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef int (*raytpu_task_fn)(const uint8_t*, uint64_t, uint8_t**,
+                              uint64_t*);
+typedef void* (*raytpu_actor_ctor)(const uint8_t*, uint64_t);
+typedef void (*raytpu_actor_dtor)(void*);
+typedef int (*raytpu_method_fn)(void*, const uint8_t*, uint64_t, uint8_t**,
+                                uint64_t*);
+int raytpu_init(const char* address);
+int raytpu_shutdown(void);
+int raytpu_put(const void* data, uint64_t len, char ref_hex[64]);
+int raytpu_get(const char* ref_hex, double timeout_s, void** out,
+               uint64_t* out_len);
+int raytpu_submit(const char* lib_path, const char* fn_name,
+                  const void* args, uint64_t args_len, char ref_hex[64]);
+int raytpu_wait(const char** ref_hexes, int n, int num_returns,
+                double timeout_s, int* ready_mask);
+int raytpu_release(const char* ref_hex);
+int raytpu_register(const char* name, raytpu_task_fn fn);
+int raytpu_register_actor(const char* type_name, raytpu_actor_ctor ctor,
+                          raytpu_actor_dtor dtor);
+int raytpu_register_method(const char* type_name, const char* method,
+                           raytpu_method_fn fn);
+int raytpu_create_actor(const char* lib_path, const char* type_name,
+                        const void* args, uint64_t args_len,
+                        char actor_id[64]);
+int raytpu_actor_call(const char* actor_id, const char* method,
+                      const void* args, uint64_t args_len,
+                      char ref_hex[64]);
+int raytpu_kill_actor(const char* actor_id);
+const char* raytpu_last_error(void);
+void raytpu_buf_free(void* p);
+}
+
+#define RAYTPU_REMOTE(fn)                                     \
+  namespace {                                                 \
+  struct RaytpuReg_##fn {                                     \
+    RaytpuReg_##fn() { raytpu_register(#fn, fn); }            \
+  } raytpu_reg_instance_##fn;                                 \
+  }
+
+// Actor type: Type must have  static void* New(const uint8_t*, uint64_t)
+// and a virtual-free destructor reachable via delete (Type*).
+#define RAYTPU_ACTOR(Type)                                              \
+  namespace {                                                           \
+  void raytpu_dtor_##Type(void* p) { delete (Type*)p; }                 \
+  struct RaytpuActorReg_##Type {                                        \
+    RaytpuActorReg_##Type() {                                           \
+      raytpu_register_actor(#Type, &Type::New, raytpu_dtor_##Type);     \
+    }                                                                   \
+  } raytpu_actor_reg_##Type;                                            \
+  }
+
+// Method wrapper: MethodName must be  int Type::MethodName(const uint8_t*,
+// uint64_t, uint8_t**, uint64_t*).
+#define RAYTPU_METHOD(Type, MethodName)                                  \
+  namespace {                                                            \
+  int raytpu_m_##Type##_##MethodName(void* self, const uint8_t* in,      \
+                                     uint64_t n, uint8_t** out,          \
+                                     uint64_t* m) {                      \
+    return ((Type*)self)->MethodName(in, n, out, m);                     \
+  }                                                                      \
+  struct RaytpuMethodReg_##Type##_##MethodName {                         \
+    RaytpuMethodReg_##Type##_##MethodName() {                            \
+      raytpu_register_method(#Type, #MethodName,                         \
+                             raytpu_m_##Type##_##MethodName);            \
+    }                                                                    \
+  } raytpu_method_reg_##Type##_##MethodName;                             \
+  }
+
+namespace raytpu {
+
+inline void ThrowLast(const char* what) {
+  throw std::runtime_error(std::string(what) + ": " + raytpu_last_error());
+}
+
+inline void Init(const char* address = nullptr) {
+  if (raytpu_init(address) != 0) ThrowLast("raytpu::Init");
+}
+
+inline void Shutdown() { raytpu_shutdown(); }
+
+struct ObjectRef {
+  std::string hex;
+};
+
+inline ObjectRef Put(const std::string& bytes) {
+  char ref[64];
+  if (raytpu_put(bytes.data(), bytes.size(), ref) != 0)
+    ThrowLast("raytpu::Put");
+  return ObjectRef{ref};
+}
+
+inline std::string Get(const ObjectRef& ref, double timeout_s = 120.0) {
+  void* out = nullptr;
+  uint64_t len = 0;
+  if (raytpu_get(ref.hex.c_str(), timeout_s, &out, &len) != 0)
+    ThrowLast("raytpu::Get");
+  std::string s((const char*)out, (size_t)len);
+  raytpu_buf_free(out);
+  return s;
+}
+
+// The library that holds the registered task functions — found via the
+// address of any RAYTPU_REMOTE'd symbol, so callers never hardcode paths
+// (workers dlopen this same file).
+inline std::string SelfLibrary(const void* any_fn_in_lib) {
+  Dl_info info;
+  if (dladdr(any_fn_in_lib, &info) == 0 || !info.dli_fname)
+    throw std::runtime_error("raytpu::SelfLibrary: dladdr failed "
+                             "(task functions must live in a shared lib)");
+  return info.dli_fname;
+}
+
+inline ObjectRef Submit(const std::string& lib, const std::string& fn,
+                        const std::string& payload) {
+  char ref[64];
+  if (raytpu_submit(lib.c_str(), fn.c_str(), payload.data(), payload.size(),
+                    ref) != 0)
+    ThrowLast("raytpu::Submit");
+  return ObjectRef{ref};
+}
+
+struct ActorHandle {
+  std::string id;
+};
+
+inline ActorHandle CreateActor(const std::string& lib,
+                               const std::string& type,
+                               const std::string& ctor_payload) {
+  char aid[64];
+  if (raytpu_create_actor(lib.c_str(), type.c_str(), ctor_payload.data(),
+                          ctor_payload.size(), aid) != 0)
+    ThrowLast("raytpu::CreateActor");
+  return ActorHandle{aid};
+}
+
+inline ObjectRef Call(const ActorHandle& actor, const std::string& method,
+                      const std::string& payload) {
+  char ref[64];
+  if (raytpu_actor_call(actor.id.c_str(), method.c_str(), payload.data(),
+                        payload.size(), ref) != 0)
+    ThrowLast("raytpu::Call");
+  return ObjectRef{ref};
+}
+
+inline void KillActor(const ActorHandle& actor) {
+  if (raytpu_kill_actor(actor.id.c_str()) != 0)
+    ThrowLast("raytpu::KillActor");
+}
+
+inline std::vector<int> Wait(const std::vector<ObjectRef>& refs,
+                             int num_returns, double timeout_s) {
+  std::vector<const char*> hexes;
+  hexes.reserve(refs.size());
+  for (auto& r : refs) hexes.push_back(r.hex.c_str());
+  std::vector<int> mask(refs.size(), 0);
+  if (raytpu_wait(hexes.data(), (int)refs.size(), num_returns, timeout_s,
+                  mask.data()) != 0)
+    ThrowLast("raytpu::Wait");
+  return mask;
+}
+
+// ------------------------------------------------------- byte archive
+class Writer {
+ public:
+  template <typename T>
+  Writer& Pod(const T& v) {
+    static_assert(std::is_trivially_copyable<T>::value, "POD only");
+    const auto* p = (const uint8_t*)&v;
+    buf_.insert(buf_.end(), p, p + sizeof(T));
+    return *this;
+  }
+  Writer& Str(const std::string& s) {
+    Pod<uint64_t>(s.size());
+    buf_.insert(buf_.end(), s.begin(), s.end());
+    return *this;
+  }
+  std::string Bytes() const { return {buf_.begin(), buf_.end()}; }
+  // Hand the buffer back through the task ABI (malloc'd copy).
+  int Out(uint8_t** out, uint64_t* out_len) const {
+    *out = (uint8_t*)malloc(buf_.empty() ? 1 : buf_.size());
+    memcpy(*out, buf_.data(), buf_.size());
+    *out_len = buf_.size();
+    return 0;
+  }
+
+ private:
+  std::vector<uint8_t> buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, uint64_t len) : p_(data), end_(data + len) {}
+  explicit Reader(const std::string& s)
+      : Reader((const uint8_t*)s.data(), s.size()) {}
+  template <typename T>
+  T Pod() {
+    static_assert(std::is_trivially_copyable<T>::value, "POD only");
+    if (p_ + sizeof(T) > end_) throw std::runtime_error("short read");
+    T v;
+    memcpy(&v, p_, sizeof(T));
+    p_ += sizeof(T);
+    return v;
+  }
+  std::string Str() {
+    auto n = Pod<uint64_t>();
+    if (p_ + n > end_) throw std::runtime_error("short read");
+    std::string s((const char*)p_, (size_t)n);
+    p_ += n;
+    return s;
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,92 @@
+"""Python half of the C ABI driver bridge (native/capi.cc).
+
+A C/C++ driver embeds CPython and calls these through the ABI; every
+object it holds is pinned here by hex so the C side only ever sees
+strings and byte buffers (ray analog: the C++ worker's CoreWorkerProcess
+bridge, src/ray/core_worker/core_worker_process.cc — ours rides the
+Python runtime instead of a second native protocol stack).
+"""
+from __future__ import annotations
+
+_refs: dict[str, object] = {}
+
+
+def _pin(ref) -> str:
+    h = ref.hex()
+    _refs[h] = ref
+    return h
+
+
+def capi_init(address: str | None) -> None:
+    import ray_tpu
+
+    if address:
+        ray_tpu.init(address=address)
+    else:
+        ray_tpu.init()
+
+
+def capi_put(data: bytes) -> str:
+    import ray_tpu
+
+    return _pin(ray_tpu.put(bytes(data)))
+
+
+def capi_get(ref_hex: str, timeout_s: float) -> bytes:
+    import ray_tpu
+
+    value = ray_tpu.get(_refs[ref_hex],
+                        timeout=None if timeout_s <= 0 else timeout_s)
+    return bytes(value)
+
+
+def capi_submit(lib_path: str, fn_name: str, payload: bytes) -> str:
+    from ray_tpu._private.cpp_runtime import cpp_task
+
+    return _pin(cpp_task.remote(lib_path, fn_name, bytes(payload)))
+
+
+def capi_wait(ref_hexes: list[str], num_returns: int,
+              timeout_s: float) -> list[int]:
+    import ray_tpu
+
+    refs = [_refs[h] for h in ref_hexes]
+    done, _ = ray_tpu.wait(refs, num_returns=num_returns,
+                           timeout=None if timeout_s <= 0 else timeout_s)
+    done_ids = {r.hex() for r in done}
+    return [1 if h in done_ids else 0 for h in ref_hexes]
+
+
+_actors: dict[str, object] = {}
+
+
+def capi_create_actor(lib_path: str, type_name: str, payload: bytes) -> str:
+    from ray_tpu._private.cpp_runtime import CppActor
+
+    handle = CppActor.remote(lib_path, type_name, bytes(payload))
+    _actors[handle.actor_id] = handle
+    return handle.actor_id
+
+
+def capi_actor_call(actor_id: str, method: str, payload: bytes) -> str:
+    handle = _actors[actor_id]
+    return _pin(handle.call.remote(method, bytes(payload)))
+
+
+def capi_kill_actor(actor_id: str) -> None:
+    import ray_tpu
+
+    handle = _actors.pop(actor_id, None)
+    if handle is not None:
+        ray_tpu.kill(handle)
+
+
+def capi_release(ref_hex: str) -> None:
+    _refs.pop(ref_hex, None)
+
+
+def capi_shutdown() -> None:
+    import ray_tpu
+
+    _refs.clear()
+    ray_tpu.shutdown()

@@ -1,0 +1,132 @@
+"""Worker-side C++ task execution (ray analog: the C++ worker's task
+execution loop, cpp/src/ray/runtime/task/task_executor.cc).
+
+A C++ driver submits `cpp_task(lib_path, fn_name, payload)`; the worker
+dlopens the user's shared library ONCE (its RAYTPU_REMOTE static
+registrars populate the in-library registry) and calls the named function
+through the raytpu_cpp_invoke ABI.  The user's compute runs native — the
+interpreter only moves the byte buffers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import sysconfig
+
+import ray_tpu
+
+_libs: dict[str, ctypes.CDLL] = {}
+
+_NATIVE_DIR = os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..", "native"))
+CAPI_SO = os.path.join(_NATIVE_DIR, "build", "libraytpu_capi.so")
+CAPI_SRC = os.path.join(_NATIVE_DIR, "capi.cc")
+CAPI_HEADER = os.path.join(_NATIVE_DIR, "raytpu_api.h")
+
+
+def capi_lib_path() -> str:
+    """Build (shared mtime-gated flock'd recipe) and return the C ABI
+    library path."""
+    from ray_tpu._private.native_store import build_native_lib
+
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION")
+    return build_native_lib(
+        CAPI_SRC, CAPI_SO,
+        [f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+         f"-lpython{pyver}", "-ldl", "-lpthread"])
+
+
+def _load(lib_path: str) -> ctypes.CDLL:
+    lib = _libs.get(lib_path)
+    if lib is None:
+        if not os.path.exists(lib_path):
+            raise FileNotFoundError(
+                f"C++ task library not found on this node: {lib_path} "
+                "(ship it via runtime_env working_dir or a shared mount)")
+        # RTLD_GLOBAL so the user lib's dependency on libraytpu_capi.so
+        # shares one registry with any other user lib in this worker.
+        lib = ctypes.CDLL(lib_path, mode=ctypes.RTLD_GLOBAL)
+        lib.raytpu_cpp_invoke.restype = ctypes.c_int
+        lib.raytpu_cpp_invoke.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+        lib.raytpu_last_error.restype = ctypes.c_char_p
+        lib.raytpu_buf_free.argtypes = [ctypes.c_void_p]
+        lib.raytpu_cpp_actor_new.restype = ctypes.c_uint64
+        lib.raytpu_cpp_actor_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.raytpu_cpp_actor_invoke.restype = ctypes.c_int
+        lib.raytpu_cpp_actor_invoke.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+        lib.raytpu_cpp_actor_del.argtypes = [ctypes.c_uint64,
+                                             ctypes.c_char_p]
+        _libs[lib_path] = lib
+    return lib
+
+
+def invoke_native(lib_path: str, fn_name: str, payload: bytes) -> bytes:
+    lib = _load(lib_path)
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    rc = lib.raytpu_cpp_invoke(fn_name.encode(), payload,
+                               len(payload), ctypes.byref(out),
+                               ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError(
+            f"C++ task {fn_name!r} failed: "
+            f"{lib.raytpu_last_error().decode(errors='replace')}")
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.raytpu_buf_free(out)
+
+
+@ray_tpu.remote
+def cpp_task(lib_path: str, fn_name: str, payload: bytes) -> bytes:
+    return invoke_native(lib_path, fn_name, payload)
+
+
+@ray_tpu.remote
+class CppActor:
+    """Hosts one native actor instance (ray analog: the C++ worker's
+    actor-instance table).  State lives behind a raw pointer inside this
+    worker; methods route through raytpu_cpp_actor_invoke.  The ordered
+    actor queue gives C++ methods the same one-at-a-time semantics
+    Python actors have."""
+
+    def __init__(self, lib_path: str, type_name: str, payload: bytes):
+        self._lib = _load(lib_path)
+        self._type = type_name.encode()
+        self._handle = self._lib.raytpu_cpp_actor_new(
+            self._type, payload, len(payload))
+        if not self._handle:
+            raise RuntimeError(
+                f"C++ actor {type_name!r} construction failed: "
+                f"{self._lib.raytpu_last_error().decode(errors='replace')}")
+
+    def call(self, method: str, payload: bytes) -> bytes:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.raytpu_cpp_actor_invoke(
+            self._handle, self._type, method.encode(), payload,
+            len(payload), ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(
+                f"C++ actor method {method!r} failed: "
+                f"{self._lib.raytpu_last_error().decode(errors='replace')}")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.raytpu_buf_free(out)
+
+    def __del__(self):
+        if getattr(self, "_handle", 0):
+            try:
+                self._lib.raytpu_cpp_actor_del(self._handle, self._type)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+            self._handle = 0

@@ -30,18 +30,24 @@ _CC_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "store.cc"))
 _lib = None
 
 
-def _build_lib() -> None:
-    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-    lock_path = _SO_PATH + ".lock"
-    with open(lock_path, "w") as lock:
+def build_native_lib(src: str, out: str, extra_flags: list[str]) -> str:
+    """Shared mtime-gated, flock'd g++ build for the in-tree native libs
+    (the shm store and the C ABI frontend use the same recipe)."""
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out + ".lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
-        if (os.path.exists(_SO_PATH)
-                and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_CC_PATH)):
-            return
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-               "-o", _SO_PATH + ".tmp", _CC_PATH, "-lpthread", "-lrt"]
+               "-o", out + ".tmp", src, *extra_flags]
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(_SO_PATH + ".tmp", _SO_PATH)
+        os.replace(out + ".tmp", out)
+    return out
+
+
+def _build_lib() -> None:
+    build_native_lib(_CC_PATH, _SO_PATH, ["-lpthread", "-lrt"])
 
 
 def load_lib():
