@@ -7,8 +7,9 @@ public API (remote/get/put/wait/actors) is transparently routed through
 the per-client host driver the proxy spawned, so user code is unchanged
 while the client process never joins the cluster trust domain.
 
-Not supported in client mode (use direct attach): placement groups,
-streaming generators, DAGs.
+Supported in client mode: tasks/actors/objects, placement groups (+PG
+scheduling options), streaming and dynamic generators.  Not supported
+(use direct attach): compiled DAGs, experimental channels.
 """
 from __future__ import annotations
 
@@ -16,7 +17,9 @@ import asyncio
 import threading
 from typing import Any, Sequence
 
-from ray_tpu.client.common import ClientActorHandle, ClientObjectRef
+from ray_tpu.client.common import (ClientActorHandle, ClientDynRefs,
+                                   ClientObjectRef,
+                                   ClientObjectRefGenerator)
 
 # Module-global active context; the public API checks this first.
 _ctx: "ClientContext | None" = None
@@ -102,8 +105,16 @@ class ClientContext:
 
         reply, blobs = self._req(
             "get", {"refs": [r.hex for r in ref_list], "timeout": timeout})
-        values = pickle.loads(blobs[0])
+        values = [self._decode_value(v) for v in pickle.loads(blobs[0])]
         return values[0] if single else values
+
+    def _decode_value(self, v):
+        # A dynamic-generator result arrives as a pinned-hex marker; it
+        # reads back as a list of item refs (the iteration surface of
+        # ObjectRefGenerator).
+        if isinstance(v, ClientDynRefs):
+            return [ClientObjectRef(h, self) for h in v.hexes]
+        return v
 
     def wait(self, refs: Sequence[ClientObjectRef], num_returns: int,
              timeout: float | None):
@@ -115,6 +126,11 @@ class ClientContext:
                 [by_hex[x] for x in reply["not_done"]])
 
     def submit_function(self, fn, args: tuple, kwargs: dict, opts: dict):
+        if (opts or {}).get("num_returns") == "streaming":
+            reply, _ = self._req(
+                "stream_task", {"opts": _plain_opts(opts)},
+                [_cloudpickle_dumps((fn, args, kwargs))])
+            return ClientObjectRefGenerator(reply["stream_id"], self)
         reply, _ = self._req(
             "task", {"opts": _plain_opts(opts)},
             [_cloudpickle_dumps((fn, args, kwargs))])
@@ -151,24 +167,81 @@ class ClientContext:
         reply, _ = self._req("cluster_info", {})
         return reply["resources"]
 
-    def _release(self, ref_hexes: list[str]) -> None:
-        """Fire-and-forget: __del__ may run on ANY thread — including the
-        client IO loop thread (GC during a callback), where a blocking
-        .result() would deadlock the loop on itself.  Best-effort GC
-        needs no reply anyway."""
+    # ------------------------------------------------- placement groups
+    def pg_create(self, bundles, strategy: str, name: str | None) -> str:
+        reply, _ = self._req(
+            "pg_create", {"bundles": [dict(b) for b in bundles],
+                          "strategy": strategy, "name": name})
+        return reply["pg_id"]
+
+    def pg_ready(self, pg_id: str, timeout: float) -> bool:
+        reply, _ = self._req("pg_ready",
+                             {"pg_id": pg_id, "timeout": timeout},
+                             timeout=timeout + 30.0)
+        return bool(reply["ready"])
+
+    def pg_remove(self, pg_id: str) -> None:
+        self._req("pg_remove", {"pg_id": pg_id})
+
+    def pg_locations(self, pg_id: str) -> dict:
+        reply, _ = self._req("pg_locations", {"pg_id": pg_id})
+        return {int(k): v for k, v in reply.get("bundle_nodes", {}).items()}
+
+    def pg_table(self) -> list:
+        reply, _ = self._req("pg_table", {})
+        return reply["pgs"]
+
+    # ------------------------------------------------ streaming tasks
+    def actor_stream(self, actor_id: str, method: str, args: tuple,
+                     kwargs: dict, opts: dict) -> ClientObjectRefGenerator:
+        reply, _ = self._req(
+            "stream_task",
+            {"actor_id": actor_id, "method": method,
+             "opts": _plain_opts(opts)},
+            [_cloudpickle_dumps((args, kwargs))])
+        return ClientObjectRefGenerator(reply["stream_id"], self)
+
+    def stream_next(self, stream_id: str) -> ClientObjectRef | None:
+        """Long-polls the host for the next item; None = stream end.
+        A task error raises here, after all successfully produced items.
+        Each poll is BOUNDED host-side (the host replies "pending"
+        without consuming when the item isn't ready), so an item that
+        takes minutes to produce neither times out the RPC nor gets
+        dropped by one."""
+        poll_s = 30.0
+        while True:
+            reply, _ = self._req(
+                "stream_next", {"stream_id": stream_id, "poll_s": poll_s},
+                timeout=poll_s + 30.0)
+            if reply.get("pending"):
+                continue
+            if reply.get("done"):
+                return None
+            return ClientObjectRef(reply["ref"], self)
+
+    def _fire_and_forget(self, op: str, header: dict) -> None:
+        """Best-effort notify: __del__ may run on ANY thread — including
+        the client IO loop thread (GC during a callback), where a
+        blocking .result() would deadlock the loop on itself."""
         if self._closed:
             return
         try:
             asyncio.run_coroutine_threadsafe(
                 self._cli.call(
                     "client_req",
-                    {"client_id": self.client_id, "op": "release",
-                     "header": {"refs": ref_hexes}, "timeout": 10.0},
+                    {"client_id": self.client_id, "op": op,
+                     "header": header, "timeout": 10.0},
                     [], timeout=10.0),
                 self._loop).add_done_callback(
                     lambda f: f.exception())   # consume, never raise
         except Exception:  # noqa: BLE001 - teardown
             pass
+
+    def _drop_stream(self, stream_id: str) -> None:
+        self._fire_and_forget("stream_drop", {"stream_id": stream_id})
+
+    def _release(self, ref_hexes: list[str]) -> None:
+        self._fire_and_forget("release", {"refs": ref_hexes})
 
     def disconnect(self) -> None:
         global _ctx
@@ -191,9 +264,35 @@ class ClientContext:
 
 
 def _plain_opts(opts: dict) -> dict:
-    """Only msgpack-able option values cross the wire."""
+    """Flatten option values to msgpack-able wire form.  PG handles and
+    scheduling-strategy objects are lowered to tagged dicts the host's
+    _decode_opts rebuilds (the host owns the real PlacementGroup)."""
+    from ray_tpu.utils.placement_group import PlacementGroup
+    from ray_tpu.utils.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+    items = dict(opts or {})
     out = {}
-    for k, v in (opts or {}).items():
+    strat = items.pop("scheduling_strategy", None)
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        items["placement_group"] = strat.placement_group
+        items.setdefault("placement_group_bundle_index",
+                         strat.placement_group_bundle_index)
+    elif isinstance(strat, NodeAffinitySchedulingStrategy):
+        out["__node_affinity__"] = {"node_id": strat.node_id,
+                                    "soft": bool(strat.soft)}
+    elif strat is not None:
+        raise ValueError(f"scheduling_strategy {strat!r} is not supported "
+                         "in client mode")
+    pg = items.pop("placement_group", None)
+    if isinstance(pg, PlacementGroup):
+        out["__pg__"] = {"id": pg.id, "bundles": pg.bundles,
+                         "strategy": pg.strategy}
+        out["placement_group_bundle_index"] = int(
+            items.pop("placement_group_bundle_index", -1))
+    elif pg is not None:
+        items["placement_group"] = pg   # e.g. the "default" sentinel
+    for k, v in items.items():
         if isinstance(v, (str, int, float, bool, type(None))):
             out[k] = v
         elif isinstance(v, dict) and all(
@@ -201,8 +300,7 @@ def _plain_opts(opts: dict) -> dict:
             out[k] = v
         else:
             raise ValueError(
-                f"option {k!r} is not supported in client mode "
-                "(placement groups / strategy objects need direct attach)")
+                f"option {k!r}={v!r} is not supported in client mode")
     return out
 
 

@@ -49,6 +49,60 @@ class ClientObjectRef:
                 pass
 
 
+class ClientObjectRefGenerator:
+    """Client-mode streaming generator (analog of ray's client-side
+    ObjectRefGenerator): each `next()` long-polls the host for the next
+    item ref as the remote task produces it.  The real
+    StreamingObjectRefGenerator lives pinned in the client host; task
+    errors surface here on the `next()` after the last good item."""
+
+    def __init__(self, stream_id: str, ctx):
+        self._stream_id = stream_id
+        self._ctx = ctx
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ClientObjectRef":
+        if self._done:
+            raise StopIteration
+        try:
+            ref = self._ctx.stream_next(self._stream_id)
+        except BaseException:
+            self._done = True
+            raise
+        if ref is None:
+            self._done = True
+            raise StopIteration
+        return ref
+
+    def __repr__(self):
+        return f"ClientObjectRefGenerator({self._stream_id[:12]}…)"
+
+    def __del__(self):
+        if not self._done:
+            try:
+                self._ctx._drop_stream(self._stream_id)
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+
+
+class ClientDynRefs:
+    """Wire marker for a num_returns="dynamic" result crossing the proxy:
+    the host pins each item ref and ships the hex list; the client's get()
+    rebuilds ClientObjectRefs.  Defined here (importable on both sides
+    without a worker) so it pickles across the boundary."""
+
+    __slots__ = ("hexes",)
+
+    def __init__(self, hexes: list):
+        self.hexes = list(hexes)
+
+    def __reduce__(self):
+        return (ClientDynRefs, (self.hexes,))
+
+
 class ClientActorMethod:
     def __init__(self, handle: "ClientActorHandle", name: str,
                  opts: dict | None = None):
@@ -57,6 +111,10 @@ class ClientActorMethod:
         self._opts = opts or {}
 
     def remote(self, *args, **kwargs):
+        if self._opts.get("num_returns") == "streaming":
+            return self._handle._ctx.actor_stream(
+                self._handle._actor_id, self._name, args, kwargs,
+                self._opts)
         return self._handle._ctx.actor_call(
             self._handle._actor_id, self._name, args, kwargs, self._opts)
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 
 import ray_tpu
@@ -56,6 +57,13 @@ class ClientHost:
         # client's session; a lingering named actor would hold its CPU
         # lease forever).
         self.created: set[str] = set()
+        # Placement groups created via this client: their reservations are
+        # session state too — released at disconnect.
+        self.pgs: dict[str, object] = {}
+        self.pg_created: set[str] = set()
+        # stream_id -> live StreamingObjectRefGenerator (client iterates
+        # remotely via stream_next).
+        self.streams: dict[str, object] = {}
 
     def cleanup(self) -> None:
         for actor_id in list(self.created):
@@ -65,6 +73,17 @@ class ClientHost:
                     ray_tpu.kill(handle)
                 except Exception:  # noqa: BLE001 - teardown
                     pass
+        from ray_tpu.utils.placement_group import remove_placement_group
+
+        for pg_id in list(self.pg_created):
+            pg = self.pgs.get(pg_id)
+            if pg is not None:
+                try:
+                    remove_placement_group(pg)
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+        for sid in list(self.streams):
+            self._drop_stream_state(sid)
 
     def _pin(self, ref) -> str:
         h = ref.hex()
@@ -83,6 +102,25 @@ class ClientHost:
 
         return cloudpickle.dumps(value)
 
+    def _decode_opts(self, opts: dict | None) -> dict:
+        """Rebuild option objects the client lowered to tagged dicts."""
+        opts = dict(opts or {})
+        pg_desc = opts.pop("__pg__", None)
+        if pg_desc:
+            from ray_tpu.utils.placement_group import PlacementGroup
+
+            pg = self.pgs.get(pg_desc["id"]) or PlacementGroup(
+                pg_desc["id"], pg_desc["bundles"], pg_desc["strategy"])
+            opts["placement_group"] = pg
+        na = opts.pop("__node_affinity__", None)
+        if na:
+            from ray_tpu.utils.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy)
+
+            opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                na["node_id"], bool(na.get("soft")))
+        return opts
+
     # ------------------------------------------------------------- ops
     async def rpc_put(self, h: dict, blobs: list):
         value = self._loads(blobs[0])
@@ -90,14 +128,22 @@ class ClientHost:
         return {"ref": self._pin(ref)}
 
     async def rpc_get(self, h: dict, blobs: list):
+        from ray_tpu.client.common import ClientDynRefs
+        from ray_tpu.object_ref import ObjectRefGenerator
+
         refs = [self.objects[x] for x in h["refs"]]
         values = await asyncio.to_thread(
             ray_tpu.get, refs, timeout=h.get("timeout"))
+        # Dynamic-generator values carry real ObjectRefs the client can't
+        # hold; pin each item here and ship the hexes.
+        values = [ClientDynRefs([self._pin(r) for r in v])
+                  if isinstance(v, ObjectRefGenerator) else v
+                  for v in values]
         return {}, [self._dumps(values)]
 
     async def rpc_task(self, h: dict, blobs: list):
         fn, args, kwargs = self._loads(blobs[0])
-        opts = h.get("opts") or {}
+        opts = self._decode_opts(h.get("opts"))
         remote_fn = ray_tpu.remote(fn) if not opts \
             else ray_tpu.remote(fn).options(**opts)
         refs = await asyncio.to_thread(
@@ -107,7 +153,7 @@ class ClientHost:
 
     async def rpc_create_actor(self, h: dict, blobs: list):
         cls, args, kwargs = self._loads(blobs[0])
-        opts = h.get("opts") or {}
+        opts = self._decode_opts(h.get("opts"))
         actor_cls = ray_tpu.remote(cls) if not opts \
             else ray_tpu.remote(cls).options(**opts)
         handle = await asyncio.to_thread(
@@ -121,7 +167,7 @@ class ClientHost:
         handle = self.actors[h["actor_id"]]
         method = getattr(handle, h["method"])
         if h.get("opts"):
-            method = method.options(**h["opts"])
+            method = method.options(**self._decode_opts(h["opts"]))
         refs = await asyncio.to_thread(
             lambda: method.remote(*args, **kwargs))
         refs = refs if isinstance(refs, list) else [refs]
@@ -157,6 +203,124 @@ class ClientHost:
     async def rpc_cluster_info(self, h: dict, blobs: list):
         return {"resources": await asyncio.to_thread(
             ray_tpu.cluster_resources)}
+
+    # ------------------------------------------------- placement groups
+    async def rpc_pg_create(self, h: dict, blobs: list):
+        from ray_tpu.utils.placement_group import placement_group
+
+        pg = await asyncio.to_thread(
+            placement_group, h["bundles"], h.get("strategy") or "PACK",
+            h.get("name"))
+        self.pgs[pg.id] = pg
+        self.pg_created.add(pg.id)
+        return {"pg_id": pg.id}
+
+    def _pg(self, pg_id: str):
+        from ray_tpu.utils.placement_group import PlacementGroup
+
+        return self.pgs.get(pg_id) or PlacementGroup(pg_id, [], "PACK")
+
+    async def rpc_pg_ready(self, h: dict, blobs: list):
+        ok = await asyncio.to_thread(
+            self._pg(h["pg_id"]).ready, h.get("timeout") or 60.0)
+        return {"ready": bool(ok)}
+
+    async def rpc_pg_remove(self, h: dict, blobs: list):
+        from ray_tpu.utils.placement_group import remove_placement_group
+
+        await asyncio.to_thread(remove_placement_group,
+                                self._pg(h["pg_id"]))
+        self.pgs.pop(h["pg_id"], None)
+        self.pg_created.discard(h["pg_id"])
+        return {}
+
+    async def rpc_pg_locations(self, h: dict, blobs: list):
+        locs = await asyncio.to_thread(
+            self._pg(h["pg_id"]).bundle_locations)
+        return {"bundle_nodes": {str(k): v for k, v in locs.items()}}
+
+    async def rpc_pg_table(self, h: dict, blobs: list):
+        from ray_tpu.utils.placement_group import placement_group_table
+
+        return {"pgs": await asyncio.to_thread(placement_group_table)}
+
+    # ------------------------------------------------ streaming tasks
+    async def rpc_stream_task(self, h: dict, blobs: list):
+        import uuid as _uuid
+
+        opts = self._decode_opts(h.get("opts"))
+        opts["num_returns"] = "streaming"
+        if h.get("actor_id"):
+            args, kwargs = self._loads(blobs[0])
+            handle = self.actors[h["actor_id"]]
+            method = getattr(handle, h["method"]).options(**opts)
+            gen = await asyncio.to_thread(
+                lambda: method.remote(*args, **kwargs))
+        else:
+            fn, args, kwargs = self._loads(blobs[0])
+            remote_fn = ray_tpu.remote(fn).options(**opts)
+            gen = await asyncio.to_thread(
+                lambda: remote_fn.remote(*args, **kwargs))
+        sid = _uuid.uuid4().hex
+        # One DEDICATED thread per stream: a blocking next(gen) can run
+        # for minutes (that's the feature), and parking it in asyncio's
+        # shared default executor would starve every other to_thread op
+        # on this host once a handful of slow streams are in flight.
+        import concurrent.futures
+
+        self.streams[sid] = {
+            "gen": gen, "pending": None,
+            "exec": concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"stream-{sid[:8]}")}
+        return {"stream_id": sid}
+
+    def _drop_stream_state(self, sid: str) -> None:
+        st = self.streams.pop(sid, None)
+        if st is not None:
+            st["exec"].shutdown(wait=False)
+
+    async def rpc_stream_next(self, h: dict, blobs: list):
+        """Bounded long-poll: wait up to poll_s for the next item, else
+        reply {"pending": True} WITHOUT consuming it — the in-flight
+        next() keeps running and its result is picked up by the client's
+        re-poll.  An item that takes minutes to produce (LLM prefill,
+        slow batch) must neither time out the client RPC nor be dropped
+        by one."""
+        st = self.streams.get(h["stream_id"])
+        if st is None:
+            return {"done": True}
+        if st["pending"] is None:
+            gen = st["gen"]
+
+            def _next():
+                # StopIteration cannot cross an asyncio Future boundary —
+                # lower it to a sentinel in the thread.
+                try:
+                    return next(gen)
+                except StopIteration:
+                    return None
+
+            st["pending"] = asyncio.get_running_loop().run_in_executor(
+                st["exec"], _next)
+        try:
+            ref = await asyncio.wait_for(
+                asyncio.shield(st["pending"]), h.get("poll_s", 30.0))
+        except asyncio.TimeoutError:
+            return {"pending": True}
+        except BaseException:
+            # Task error: the stream is finished — drop the pinned
+            # generator state so an erroring stream cannot leak.
+            self._drop_stream_state(h["stream_id"])
+            raise
+        st["pending"] = None
+        if ref is None:
+            self._drop_stream_state(h["stream_id"])
+            return {"done": True}
+        return {"ref": self._pin(ref)}
+
+    async def rpc_stream_drop(self, h: dict, blobs: list):
+        self._drop_stream_state(h["stream_id"])
+        return {}
 
 
 async def _serve() -> None:

@@ -152,15 +152,9 @@ class SAC(Algorithm):
                                    seed=self.cfg["seed"])
 
     def training_step(self) -> dict:
-        per = max(1, self.cfg["train_batch_size"]
-                  // self.cfg["num_env_runners"])
-        fragments = self.env_runner_group.sample(
-            self._params_np, per, with_gae=False)
-        for b in fragments:
-            self._episode_returns.extend(b.pop("episode_returns").tolist())
-            self._timesteps += len(b["obs"])
-        batch = {k: np.concatenate([b[k] for b in fragments])
-                 for k in fragments[0]}
+        # Collection rides the shared env→learner connector pipeline
+        # (RecordEpisodeMetrics + ConcatFragments), like PPO/DQN/IMPALA.
+        batch = self._collect(with_gae=False)
         self.replay.add_batch(batch)
         if len(self.replay) < self.cfg["learning_starts"]:
             return {"buffer_size": float(len(self.replay))}

@@ -26,8 +26,11 @@ class PlacementGroup:
 
     def ready(self, timeout: float = 60.0) -> bool:
         """Block until all bundles are reserved (ray: pg.ready())."""
+        from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
 
+        if client_mod._ctx is not None:
+            return client_mod._ctx.pg_ready(self.id, timeout)
         core = global_worker()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -43,8 +46,11 @@ class PlacementGroup:
         return False
 
     def bundle_locations(self) -> dict[int, str]:
+        from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
 
+        if client_mod._ctx is not None:
+            return client_mod._ctx.pg_locations(self.id)
         core = global_worker()
         reply, _ = core.call(core.controller_addr, "pg_ready",
                              {"pg_id": self.id}, timeout=30.0)
@@ -65,9 +71,13 @@ def placement_group(bundles: Sequence[dict[str, float]],
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
             raise ValueError(f"invalid bundle {b!r}")
+    from ray_tpu import client as client_mod
     from ray_tpu._private.ids import PlacementGroupID
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        pg_id = client_mod._ctx.pg_create(bundles, strategy, name)
+        return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
     core = global_worker()
     pg_id = PlacementGroupID.from_random().hex()
     core.call(core.controller_addr, "create_pg",
@@ -77,16 +87,23 @@ def placement_group(bundles: Sequence[dict[str, float]],
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        client_mod._ctx.pg_remove(pg.id)
+        return
     core = global_worker()
     core.call(core.controller_addr, "remove_pg", {"pg_id": pg.id},
               timeout=30.0)
 
 
 def placement_group_table() -> list[dict]:
+    from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
+    if client_mod._ctx is not None:
+        return client_mod._ctx.pg_table()
     core = global_worker()
     reply, _ = core.call(core.controller_addr, "list_pgs", timeout=30.0)
     return reply["pgs"]

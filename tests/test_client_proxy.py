@@ -94,3 +94,96 @@ def test_client_proxy_end_to_end(ray_shared):
                 c.disconnect()
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_client_proxy_pg_and_generators(ray_shared):
+    """PGs + streaming/dynamic generators work in client mode (ray:
+    client mode supports the full core API surface — python/ray/util/
+    client/worker.py)."""
+    import ray_tpu.client as client_mod
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.client import ClientContext
+
+    controller = worker_mod._global_worker.controller_addr
+    proc, addr = _spawn_proxy(controller)
+    c = None
+    try:
+        c = ClientContext(addr, namespace="nspg")
+        client_mod._ctx = c   # public API routes through the client
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+        from ray_tpu.utils.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy)
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=60.0)
+        locs = pg.bundle_locations()
+        assert 0 in locs
+
+        def where():
+            import ray_tpu
+
+            return ray_tpu.get_runtime_context().node_id
+
+        # PG handle in plain options.
+        ref = c.submit_function(
+            where, (), {}, {"placement_group": pg,
+                            "placement_group_bundle_index": 0,
+                            "num_cpus": 1})
+        assert c.get(ref) == locs[0]
+        # ...and via the strategy-object form.
+        ref2 = c.submit_function(
+            where, (), {},
+            {"scheduling_strategy": PlacementGroupSchedulingStrategy(pg, 0),
+             "num_cpus": 1})
+        assert c.get(ref2) == locs[0]
+        remove_placement_group(pg)
+
+        # Streaming generator: items arrive as produced.
+        def squares(n):
+            for i in range(n):
+                yield i * i
+
+        gen = c.submit_function(squares, (4,), {},
+                                {"num_returns": "streaming"})
+        assert [c.get(r) for r in gen] == [0, 1, 4, 9]
+
+        # The task's error surfaces after its good items.
+        def broken():
+            yield 1
+            raise ValueError("boom")
+
+        gen2 = c.submit_function(broken, (), {},
+                                 {"num_returns": "streaming"})
+        assert c.get(next(gen2)) == 1
+        # Same convention as direct attach: the task error (TaskError
+        # wrapping the cause) raises from next() after the good items.
+        with pytest.raises(Exception, match="boom"):
+            for _ in range(3):
+                next(gen2)
+
+        # Dynamic generator: the result ref resolves to item refs.
+        def tens(n):
+            for i in range(n):
+                yield i + 10
+
+        dyn_ref = c.submit_function(tens, (3,), {},
+                                    {"num_returns": "dynamic"})
+        items = c.get(dyn_ref)
+        assert [c.get(r) for r in items] == [10, 11, 12]
+
+        # Actor-method streaming.
+        class Streamer:
+            def tokens(self, n):
+                for i in range(n):
+                    yield f"t{i}"
+
+        h = c.create_actor(Streamer, (), {}, {})
+        gen3 = h.tokens.options(num_returns="streaming").remote(3)
+        assert [c.get(r) for r in gen3] == ["t0", "t1", "t2"]
+    finally:
+        client_mod._ctx = None
+        if c is not None:
+            c.disconnect()
+        proc.terminate()
+        proc.wait(timeout=10)
