@@ -345,11 +345,11 @@ class LeaseManager:
         if task.retries_left > 0:
             task.retries_left -= 1
             logger.warning("task %s worker died; retrying (%d left)",
-                           task.task_id.hex()[:8], task.retries_left)
+                           task.task_id.hex()[:12], task.retries_left)
             self.submit(task)
         else:
             err = WorkerCrashedError(
-                f"worker died executing task {task.task_id.hex()[:8]}: {exc}")
+                f"worker died executing task {task.task_id.hex()[:12]}: {exc}")
             for rid in task.return_ids:
                 self.core._resolve_error(rid, err)
             self.core._release_task_borrows(task)
@@ -408,7 +408,7 @@ class ActorInstance:
         self.max_concurrency = max_concurrency
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_concurrency,
-            thread_name_prefix=f"actor-{actor_id[:8]}")
+            thread_name_prefix=f"actor-{actor_id[:12]}")
         # Named concurrency groups (ray: concurrency_group_manager.cc):
         # each group gets its own executor (sync actors) / semaphore
         # (async actors) so one saturated group never gates another.
@@ -420,7 +420,7 @@ class ActorInstance:
             self.group_executors[name] = \
                 concurrent.futures.ThreadPoolExecutor(
                     max_workers=max(1, int(limit)),
-                    thread_name_prefix=f"actor-{actor_id[:8]}-{name}")
+                    thread_name_prefix=f"actor-{actor_id[:12]}-{name}")
         # Async actors: per-group semaphores, created lazily ON the loop.
         self._group_sems: dict[str, asyncio.Semaphore] = {}
         # Per-caller ordered delivery (ray: ActorSchedulingQueue seq_nos).
@@ -729,8 +729,64 @@ class CoreWorker:
                 "ray_tpu blocking API called from the runtime IO thread "
                 "(e.g. inside a deserialization hook); move the call into "
                 "task/actor code")
-        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return fut.result(timeout)
+        # Hand-rolled bridge instead of run_coroutine_threadsafe: that
+        # helper chains the Task to the concurrent Future with closures
+        # that keep BOTH alive in a reference cycle, and each retains the
+        # coroutine's exception.  Re-raising here then grows that
+        # exception's traceback with the caller's frames, closing a cycle
+        # (exc.tb → caller frame → future → Task → exc) that only a
+        # CYCLIC gc pass reclaims — minutes away under tune_gc()'s raised
+        # thresholds.  Everything the caller's frames reference (actor
+        # handles, stream generators, arrays) is pinned that whole
+        # window; a delayed ActorHandle.__del__ kill once starved a test
+        # cluster of CPU leases and wedged the suite.  Here the exception
+        # travels as a RESULT tuple: the Task keeps no payload and dies
+        # by refcount the moment its done-callback returns, so liveness
+        # never waits on the collector.
+        cfut: concurrent.futures.Future = concurrent.futures.Future()
+        loop = self.loop
+
+        def _start():
+            task = loop.create_task(coro)
+
+            def _done(t):
+                try:
+                    payload = (True, t.result())
+                except BaseException as e:  # noqa: BLE001
+                    # Strip THIS frame from the traceback: with it, the
+                    # exception would reference a frame whose locals
+                    # reference the exception back — a refcount-immune
+                    # cycle pinning the payload until a gc pass.
+                    tb = e.__traceback__
+                    if tb is not None:
+                        e.__traceback__ = tb.tb_next
+                    del tb   # else: frame-local ↔ frame self-cycle
+                    payload = (False, e)
+                try:
+                    cfut.set_result(payload)
+                except concurrent.futures.InvalidStateError:
+                    pass
+
+            task.add_done_callback(_done)
+
+        loop.call_soon_threadsafe(_start)
+        try:
+            ok, val = cfut.result(timeout)
+        finally:
+            if cfut.done():
+                cfut._result = None
+            else:
+                # Timed out: let the eventual payload free itself.
+                cfut.add_done_callback(
+                    lambda f: setattr(f, "_result", None))
+        if ok:
+            return val
+        try:
+            raise val
+        finally:
+            # raise grew val.__traceback__ to include THIS frame; the
+            # frame-local `val` would close the cycle — drop it.
+            del val
 
     async def acall(self, addr: str, method: str, header: dict | None = None,
                     blobs: list | None = None,
@@ -884,7 +940,10 @@ class CoreWorker:
                 return st.refs[index]
             if st.total is not None and index >= st.total:
                 if st.error is not None:
-                    raise st.error
+                    # Copy: re-raising the STORED exception would grow its
+                    # traceback in place and pin this caller's frames for
+                    # the stream state's lifetime (see _copy_error).
+                    raise _copy_error(st.error)
                 raise StopAsyncIteration
             st.event.clear()
             await st.event.wait()
@@ -2531,7 +2590,7 @@ class CoreWorker:
         seq = h.get("seqno", 0)
         if os.environ.get("RAY_TPU_ACTOR_TRACE"):
             logger.info("actor_call %s seq=%s nxt=%s method=%s",
-                        h["actor_id"][:8], seq,
+                        h["actor_id"][:12], seq,
                         inst.next_seq.get(caller), h.get("method"))
         # First seqno seen from a caller is its baseline: a restarted actor
         # incarnation accepts the caller's continuing sequence without a
@@ -3078,8 +3137,8 @@ class CoreWorker:
         tc = trace or self.current_trace
         self._task_events.append(
             {"task_id": task_id, "state": state, "name": name,
-             "t": time.time(), "worker": self.worker_id[:8],
-             "node": self.node_id[:8],
+             "t": time.time(), "worker": self.worker_id[:12],
+             "node": self.node_id[:12],
              "trace_id": tc["trace_id"][:16] if tc else ""})
         if len(self._task_events) > self.config.task_event_buffer_size:
             self._task_events = self._task_events[-self.config.

@@ -37,6 +37,13 @@ def pytest_runtest_call(item):
     budget = int(os.environ.get("RAY_TPU_TEST_TIMEOUT_S", "900"))
 
     def _fire(signum, frame):
+        # All-thread dump first: the main-thread frame usually shows only
+        # a queue/future wait — the THE interesting stack (executor
+        # threads, IO loop) is elsewhere.
+        import faulthandler
+        import sys
+
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         raise TimeoutError(
             f"watchdog: {item.nodeid} exceeded {budget}s "
             f"(frame: {frame.f_code.co_filename}:{frame.f_lineno})")
