@@ -118,6 +118,7 @@ class Controller:
         self.snapshot_path = snapshot_path
         self._restored_at: float | None = None
         self._last_snapshot_blob: bytes | None = None
+        self._probing: set[str] = set()
 
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
@@ -274,7 +275,7 @@ class Controller:
         await self.publisher.publish(
             "node", {"event": "alive", "node_id": node.node_id,
                      "agent_addr": node.agent_addr})
-        logger.info("node %s registered: %s", node.node_id[:8], node.resources)
+        logger.info("node %s registered: %s", node.node_id[:12], node.resources)
         return {"config": self.config.to_json(),
                 "pub_addr": self.publisher.address}
 
@@ -288,14 +289,31 @@ class Controller:
         return {"ok": True}
 
     async def _health_loop(self) -> None:
+        last_tick = time.monotonic()
         while True:
             await asyncio.sleep(self.config.heartbeat_period_s)
             now = time.monotonic()
+            # If OUR loop stalled (snapshot write, CPU starvation on a
+            # loaded box), agents' heartbeats are queued BEHIND this timer
+            # callback — judging staleness now would declare live nodes
+            # dead.  Skip a round so the queued heartbeats land first.
+            stalled = (now - last_tick) > 4 * self.config.heartbeat_period_s
+            last_tick = now
+            if stalled:
+                continue
             for node in list(self.nodes.values()):
                 if (node.state == "ALIVE"
                         and now - node.last_heartbeat
-                        > self.config.node_death_timeout_s):
-                    await self._on_node_dead(node)
+                        > self.config.node_death_timeout_s
+                        and node.node_id not in self._probing):
+                    # Silence may be load, not death (the agent's loop can
+                    # be starved on a saturated host).  Probe directly off
+                    # the health loop — only an agent that also fails the
+                    # probe is declared dead (GCS-pull analog of ray's
+                    # health checks).
+                    self._probing.add(node.node_id)
+                    asyncio.get_running_loop().create_task(
+                        self._probe_node(node))
             # Post-restore reconciliation: restored ALIVE actors whose
             # node never re-registered (it died during the controller
             # outage) would otherwise stay ALIVE forever — their node is
@@ -310,9 +328,20 @@ class Controller:
                         await self._on_actor_dead(
                             actor, "node lost during controller outage")
 
+    async def _probe_node(self, node: NodeInfo) -> None:
+        try:
+            await self.clients.get(node.agent_addr).call(
+                "ping", {}, timeout=self.config.node_death_timeout_s)
+            node.last_heartbeat = time.monotonic()
+        except Exception:  # noqa: BLE001 - unreachable: genuinely dead
+            if node.state == "ALIVE":
+                await self._on_node_dead(node)
+        finally:
+            self._probing.discard(node.node_id)
+
     async def _on_node_dead(self, node: NodeInfo) -> None:
         node.state = "DEAD"
-        logger.warning("node %s declared dead", node.node_id[:8])
+        logger.warning("node %s declared dead", node.node_id[:12])
         await self.publisher.publish(
             "node", {"event": "dead", "node_id": node.node_id,
                      "agent_addr": node.agent_addr})
@@ -326,7 +355,7 @@ class Controller:
         # Restart or fail actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state == ALIVE:
-                await self._on_actor_dead(actor, f"node {node.node_id[:8]} died")
+                await self._on_actor_dead(actor, f"node {node.node_id[:12]} died")
 
     # ----------------------------------------------------------- resources
     def _cluster_view(self) -> dict:
@@ -446,7 +475,7 @@ class Controller:
                     actor.creation_spec, timeout=60.0)
             except Exception as e:  # noqa: BLE001
                 logger.warning("actor %s placement on %s failed: %s",
-                               actor.actor_id[:8], node_id[:8], e)
+                               actor.actor_id[:12], node_id[:12], e)
                 await asyncio.sleep(delay)
                 continue
             if reply.get("ok"):

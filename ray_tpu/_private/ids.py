@@ -40,7 +40,16 @@ os.register_at_fork(after_in_child=_reseed)
 
 
 def random_id() -> bytes:
-    return _prefix + next(_counter).to_bytes(8, "little")
+    # Counter FIRST: log lines and reprs truncate to the leading hex
+    # chars, and a leading shared prefix made every id minted by one
+    # process display identically ("actor 5023caf8" named three distinct
+    # entities in one debugging session).  4 counter bytes (big-endian,
+    # mint-ordered) then the process prefix, so a 12-char truncation
+    # shows BOTH which-id and which-process; counter bits ≥2^32 spill
+    # into the tail.
+    n = next(_counter)
+    return ((n & 0xFFFFFFFF).to_bytes(4, "big") + _prefix
+            + (n >> 32).to_bytes(4, "big"))
 
 
 def hex_id(b: bytes) -> str:

@@ -162,7 +162,7 @@ class NodeAgent:
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info("agent %s up at %s resources=%s",
-                    self.node_id[:8], self.server.address, self.resources)
+                    self.node_id[:12], self.server.address, self.resources)
 
     def close(self) -> None:
         self._closed = True
@@ -179,6 +179,13 @@ class NodeAgent:
         self.clients.close()
 
     async def _heartbeat_loop(self) -> None:
+        # Opt-in suicide on lost head (RAY_TPU_EXIT_ON_HEAD_LOSS=<secs>):
+        # launchers that cannot guarantee a kill path for the agent (the
+        # Spark shim — a cancelled barrier task may die by SIGKILL with
+        # the agent detached in its own session) set this so a torn-down
+        # cluster cannot leave orphan agents running on every executor.
+        exit_after = float(os.environ.get("RAY_TPU_EXIT_ON_HEAD_LOSS", 0))
+        last_ok = time.monotonic()
         while not self._closed:
             try:
                 reply, _ = await self.clients.get(self.controller_addr).call(
@@ -192,8 +199,15 @@ class NodeAgent:
                         {"node_id": self.node_id,
                          "agent_addr": self.server.address,
                          "resources": self.resources}, timeout=30.0)
+                last_ok = time.monotonic()
             except Exception:  # noqa: BLE001
-                pass
+                if (exit_after > 0
+                        and time.monotonic() - last_ok > exit_after):
+                    logger.error(
+                        "controller unreachable for %.0fs and "
+                        "RAY_TPU_EXIT_ON_HEAD_LOSS is set; exiting",
+                        time.monotonic() - last_ok)
+                    os._exit(1)
             await asyncio.sleep(self.config.heartbeat_period_s)
 
     async def _on_resource_view(self, _topic: str, payload: dict) -> None:
@@ -417,7 +431,7 @@ class NodeAgent:
                 continue
             try:
                 await self.clients.get(self.controller_addr).notify(
-                    "push_logs", {"node_id": self.node_id[:8],
+                    "push_logs", {"node_id": self.node_id[:12],
                                   "lines": lines})
             except Exception:  # noqa: BLE001
                 pass
@@ -500,7 +514,7 @@ class NodeAgent:
                 logger.warning(
                     "memory above %.0f%%: OOM-killing worker %s (%s)",
                     self.config.memory_usage_threshold * 100,
-                    victim.worker_id[:8], victim.state)
+                    victim.worker_id[:12], victim.state)
                 victim.oom_killed = True
                 victim.proc.kill()
             except Exception:  # noqa: BLE001
@@ -526,7 +540,7 @@ class NodeAgent:
                     {"actor_id": actor_id,
                      "cause": ("OOM-killed by the node memory monitor"
                                if w.oom_killed else
-                               f"worker process {w.worker_id[:8]} exited "
+                               f"worker process {w.worker_id[:12]} exited "
                                f"(code {w.proc.returncode if w.proc else '?'})")},
                     timeout=10.0)
             except Exception:  # noqa: BLE001
@@ -655,7 +669,7 @@ class NodeAgent:
             fut = asyncio.get_running_loop().create_future()
             self._pending.append(PendingLease(h, fut))
             return await fut
-        lease_id = f"{self.node_id[:8]}-{next(self._lease_seq)}"
+        lease_id = f"{self.node_id}-{next(self._lease_seq)}"
         if not w.is_device_worker:
             w.state = "leased"
         w.lease_id = lease_id

@@ -33,7 +33,7 @@ class ActorError(RayTpuError):
     def __init__(self, actor_id: str = "", cause: str = ""):
         self.actor_id = actor_id
         self.cause = cause
-        super().__init__(f"actor {actor_id[:8]} unavailable: {cause}")
+        super().__init__(f"actor {actor_id[:12]} unavailable: {cause}")
 
     def __reduce__(self):
         return (type(self), (self.actor_id, self.cause))

@@ -118,5 +118,5 @@ def _wait_pg_ready(core, pg_id: str) -> None:
         core.controller_addr, "pg_ready",
         {"pg_id": pg_id, "wait": True, "timeout": 120.0}, timeout=150.0)
     if reply.get("state") != "CREATED":
-        raise RuntimeError(f"placement group {pg_id[:8]} not ready: "
+        raise RuntimeError(f"placement group {pg_id[:12]} not ready: "
                            f"{reply.get('state')}")

@@ -198,8 +198,8 @@ def cmd_timeline(args) -> None:
         trace.append({"name": ev.get("name") or ev.get("state", "?"),
                       "ph": "i",
                       "ts": ev.get("ts", 0) * 1e6,
-                      "pid": ev.get("worker_id", "")[:8],
-                      "tid": ev.get("task_id", "")[:8],
+                      "pid": ev.get("worker_id", "")[:12],
+                      "tid": ev.get("task_id", "")[:12],
                       "args": ev})
     out = args.out or "ray-tpu-timeline.json"
     with open(out, "w") as f:

@@ -300,7 +300,7 @@ class ServeController:
                          self._http_host, self._http_port)
             except Exception:  # noqa: BLE001
                 logger.warning("proxy start on %s failed:\n%s",
-                               node_id[:8], traceback.format_exc())
+                               node_id[:12], traceback.format_exc())
 
     def _controller_self_id(self) -> str:
         from ray_tpu.runtime_context import get_runtime_context
@@ -434,7 +434,7 @@ class ServeController:
                                  traceback.format_exc())
                     self._remove_replica(st, rid, drain=False)
             elif time.monotonic() > rec["init_deadline"]:
-                logger.error("replica %s init timed out", rid[:8])
+                logger.error("replica %s init timed out", rid[:12])
                 self._remove_replica(st, rid, drain=False)
 
     def _poll_health(self, st: _DeploymentState) -> None:
@@ -457,11 +457,11 @@ class ServeController:
                     except Exception:  # noqa: BLE001
                         logger.warning(
                             "replica %s failed health check; replacing",
-                            rid[:8])
+                            rid[:12])
                         self._remove_replica(st, rid, drain=False)
                 elif time.monotonic() > rec["health_deadline"]:
                     logger.warning("replica %s health check timed out",
-                                   rid[:8])
+                                   rid[:12])
                     self._remove_replica(st, rid, drain=False)
             elif time.monotonic() - rec.get("last_health", 0) \
                     >= st.config.health_check_period_s:

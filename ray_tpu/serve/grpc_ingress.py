@@ -86,50 +86,54 @@ class GRPCIngress:
         await self._server.stop(grace=1.0)
 
     # ------------------------------------------------------------ methods
+    # NOTE: grpc.aio's ServicerContext.abort is a COROUTINE — an unawaited
+    # abort is a silent no-op and control falls through the error branch
+    # (surfaced as an UnboundLocalError when a dead-actor error hit the
+    # _predict except path).  Every abort below must stay awaited.
     @staticmethod
-    def _parse(request: bytes, context) -> dict:
+    async def _parse(request: bytes, context) -> dict:
         try:
             req = json.loads(request.decode() or "{}")
         except json.JSONDecodeError:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                          "request body must be JSON")
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "request body must be JSON")
         if not isinstance(req, dict):
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                          "request body must be a JSON object")
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "request body must be a JSON object")
         return req
 
     async def _predict(self, request: bytes, context) -> bytes:
-        req = self._parse(request, context)
+        req = await self._parse(request, context)
         app = req.get("application")
         if not app:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                          'missing "application"')
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                'missing "application"')
         handle = self._handle_for(app, req.get("method"))
         if handle is None:
-            context.abort(grpc.StatusCode.NOT_FOUND,
-                          f"no application {app!r}")
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application {app!r}")
         try:
             result = await handle.remote(req.get("payload"))
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
         return json.dumps({"result": result}).encode()
 
     async def _predict_streaming(self, request: bytes, context):
-        req = self._parse(request, context)
+        req = await self._parse(request, context)
         app = req.get("application")
         handle = self._handle_for(app, req.get("method"),
                                   stream=True) if app else None
         if handle is None:
-            context.abort(grpc.StatusCode.NOT_FOUND,
-                          f"no application {app!r}")
-        gen = handle.remote(req.get("payload"))
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application {app!r}")
         try:
+            gen = handle.remote(req.get("payload"))
             async for item in gen:
                 yield json.dumps({"result": item}).encode()
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
 
     async def _list_applications(self, request: bytes, context) -> bytes:
         return json.dumps({"applications": self._list_apps()}).encode()

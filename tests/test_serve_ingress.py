@@ -22,6 +22,25 @@ def serve_up():
     serve.shutdown()
 
 
+def _grpc_retry_routed(call, payload, timeout_s=30.0):
+    """Invoke a gRPC unary call, retrying while the app is NOT_FOUND:
+    per-node proxies learn routes from a poll loop, so a just-deployed
+    app is briefly unrouted (the HTTP tests get the same grace via the
+    serve controller's status wait)."""
+    import grpc
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return call(payload, timeout=30)
+        except grpc.RpcError as e:
+            if (e.code() == grpc.StatusCode.NOT_FOUND
+                    and time.monotonic() < deadline):
+                time.sleep(0.3)
+                continue
+            raise
+
+
 def _http_json(port, path, payload=None, method="GET"):
     url = f"http://127.0.0.1:{port}{path}"
     data = json.dumps(payload).encode() if payload is not None else None
@@ -100,8 +119,8 @@ class TestGRPCIngress:
             "/ray.serve.RayTpuServe/Predict",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
-        out = json.loads(predict(json.dumps(
-            {"application": "gapp", "payload": 21}).encode(), timeout=60))
+        out = json.loads(_grpc_retry_routed(predict, json.dumps(
+            {"application": "gapp", "payload": 21}).encode()))
         assert out == {"result": {"doubled": 42}}
 
         lister = chan.unary_unary(
@@ -121,3 +140,41 @@ class TestGRPCIngress:
         assert items == [0, 10, 20]
         chan.close()
         serve.delete("gapp")
+
+    def test_error_paths_clean_status(self, serve_up):
+        """Error branches must surface as gRPC statuses.  Regression:
+        grpc.aio's context.abort is a coroutine — an unawaited abort was
+        a silent no-op and errors fell through to an UnboundLocalError
+        (StatusCode.UNKNOWN) instead of the intended status."""
+        import grpc
+        import pytest
+
+        @serve.deployment
+        class Erring:
+            def __call__(self, x):
+                raise ValueError("bad payload")
+
+        serve.run(Erring.bind(), name="errapp", route_prefix="/errapp")
+        port = serve.grpc_port()
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        predict = chan.unary_unary(
+            "/ray.serve.RayTpuServe/Predict",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+
+        with pytest.raises(grpc.RpcError) as ei:
+            predict(json.dumps({"application": "nope"}).encode(),
+                    timeout=30)
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+        with pytest.raises(grpc.RpcError) as ei:
+            predict(b"not json", timeout=30)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        with pytest.raises(grpc.RpcError) as ei:
+            _grpc_retry_routed(predict, json.dumps(
+                {"application": "errapp", "payload": 1}).encode())
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
+        assert "ValueError" in ei.value.details()
+        chan.close()
+        serve.delete("errapp")
