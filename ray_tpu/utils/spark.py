@@ -1,0 +1,298 @@
+"""ray_tpu-on-Spark: launch a ray_tpu cluster on a Spark cluster.
+
+Analog of ray: python/ray/util/spark/cluster_init.py
+(setup_ray_cluster:895, RayClusterOnSpark, _setup_ray_cluster:462) +
+start_ray_node.py (the per-executor node babysitter).  The head
+(controller + head node agent) starts on the Spark driver host; each
+worker node is one long-running barrier-stage task on an executor that
+babysits a node agent until the Spark job is cancelled.
+
+The Spark surface is a small injected interface (SparkJobRunner), so the
+orchestration — head startup, per-executor agent launch, readiness wait,
+cancellation teardown — is real, tested code without pyspark in the
+image; when pyspark IS importable, PySparkJobRunner submits the genuine
+background barrier job (reference: cluster_init.py `_start_ray_worker_nodes`
+job-group pattern).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable
+
+_active_cluster: "RayTpuClusterOnSpark | None" = None
+
+
+def _call_controller(addr: str, method: str, header: dict | None = None,
+                     timeout: float = 15.0):
+    """One-shot controller RPC without joining the cluster as a driver."""
+    import asyncio
+
+    async def _go():
+        import zmq.asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        ctx = zmq.asyncio.Context()
+        cli = RpcClient(ctx, addr)
+        try:
+            reply, _ = await cli.call(method, header or {},
+                                      timeout=timeout)
+            return reply
+        finally:
+            cli.close()
+            ctx.term()
+
+    return asyncio.run(_go())
+
+
+def _worker_node_main(head_addr: str, resources: dict | None,
+                      check_cancelled: Callable[[], bool]) -> None:
+    """Per-executor body (reference: start_ray_node.py — spawn the node
+    process, then babysit until the Spark task is cancelled/killed)."""
+    from ray_tpu.api import _read_json_line
+
+    args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+            "--controller", head_addr]
+    if resources:
+        args += ["--resources-json", json.dumps(resources)]
+    # Three layered kill paths for the agent (a cancelled Spark task can
+    # die by SIGKILL before the finally below runs, and the agent lives
+    # in its own session): (1) this babysitter's finally, (2) the agent's
+    # parent-watch (exits if the Spark python worker dies), (3) suicide
+    # when the head stays unreachable after cluster shutdown.
+    env = {**os.environ, "RAY_TPU_EXIT_ON_HEAD_LOSS": "60"}
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            start_new_session=True, env=env)
+    _read_json_line(proc)
+    try:
+        while not check_cancelled() and proc.poll() is None:
+            time.sleep(0.5)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+class SparkJobRunner:
+    """How worker-node tasks reach executors.  `run_on_executors` starts
+    fn(partition_index, check_cancelled) on n executors WITHOUT blocking;
+    `cancel` stops them all (the agents' babysitters see it and exit)."""
+
+    def run_on_executors(self, fn: Callable, n: int):
+        raise NotImplementedError
+
+    def cancel(self, handle) -> None:
+        raise NotImplementedError
+
+
+class PySparkJobRunner(SparkJobRunner):
+    """Real Spark backend: one background barrier-stage job in its own
+    job group (reference: cluster_init.py spark job-group + barrier mode
+    so all worker nodes schedule together or not at all)."""
+
+    def __init__(self, spark=None):
+        if spark is None:
+            from pyspark.sql import SparkSession
+
+            spark = SparkSession.getActiveSession()
+        if spark is None:
+            raise RuntimeError("no active SparkSession; pass spark=")
+        self.spark = spark
+
+    def run_on_executors(self, fn: Callable, n: int):
+        sc = self.spark.sparkContext
+        group = f"raytpu-cluster-{os.getpid()}-{time.time():.0f}"
+
+        def _partition(it):
+            from pyspark import BarrierTaskContext
+
+            ctx = BarrierTaskContext.get()
+            idx = next(iter(it))
+            # Spark cancellation kills the task thread; the babysitter's
+            # finally-terminate runs via the interruption exception.
+            fn(idx, lambda: False)
+            yield 0
+
+        def _job():
+            sc.setJobGroup(group, "ray_tpu worker nodes",
+                           interruptOnCancel=True)
+            try:
+                sc.parallelize(range(n), n).barrier() \
+                    .mapPartitions(_partition).collect()
+            except Exception:  # noqa: BLE001 - cancelled at shutdown
+                pass
+
+        thread = threading.Thread(target=_job, daemon=True,
+                                  name="raytpu-on-spark")
+        thread.start()
+        return (group, thread)
+
+    def cancel(self, handle) -> None:
+        group, thread = handle
+        self.spark.sparkContext.cancelJobGroup(group)
+        thread.join(timeout=30)
+
+
+class LocalProcessJobRunner(SparkJobRunner):
+    """Executor stand-in: each "executor" is a local thread driving the
+    same per-node body.  This is what the shim's tests use (the reference
+    tests against a local-mode Spark; the image has no pyspark)."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def run_on_executors(self, fn: Callable, n: int):
+        for i in range(n):
+            t = threading.Thread(target=fn,
+                                 args=(i, self._stop.is_set),
+                                 daemon=True, name=f"raytpu-exec-{i}")
+            t.start()
+            self._threads.append(t)
+        return self._threads
+
+    def cancel(self, handle) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+
+class RayTpuClusterOnSpark:
+    """Handle to a running cluster (reference: RayClusterOnSpark —
+    connect/disconnect/shutdown + context manager)."""
+
+    def __init__(self, address: str, head_procs: list, runner: SparkJobRunner,
+                 job_handle, num_worker_nodes: int):
+        self.address = address
+        self._head_procs = head_procs
+        self._runner = runner
+        self._job_handle = job_handle
+        self.num_worker_nodes = num_worker_nodes
+        self._shut = False
+
+    def wait_until_ready(self, timeout: float = 120.0) -> None:
+        """Block until every worker node registered with the head."""
+        want = self.num_worker_nodes + 1   # + the head node
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                nodes = _call_controller(self.address, "list_nodes")["nodes"]
+                if sum(1 for nd in nodes
+                       if nd.get("state") == "ALIVE") >= want:
+                    return
+            except Exception:  # noqa: BLE001 - head still starting
+                pass
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"spark worker nodes did not all join within {timeout}s")
+
+    def connect(self):
+        import ray_tpu
+
+        ray_tpu.init(address=self.address)
+        return ray_tpu
+
+    def disconnect(self) -> None:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+
+    def shutdown(self) -> None:
+        global _active_cluster
+        if self._shut:
+            return
+        self._shut = True
+        self.disconnect()
+        try:
+            self._runner.cancel(self._job_handle)
+        except Exception:  # noqa: BLE001 - teardown
+            pass
+        for p in self._head_procs:
+            p.terminate()
+        for p in self._head_procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if _active_cluster is self:
+            _active_cluster = None
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+def setup_ray_tpu_cluster(*, max_worker_nodes: int,
+                          num_cpus_worker_node: int | None = None,
+                          num_cpus_head_node: int = 0,
+                          resources_worker_node: dict | None = None,
+                          spark=None,
+                          job_runner: SparkJobRunner | None = None,
+                          timeout: float = 120.0):
+    """Start a ray_tpu cluster across Spark executors; returns
+    (address, cluster).  Reference: setup_ray_cluster (cluster_init.py:895)
+    returns (address, remote_connection_address)."""
+    global _active_cluster
+    if _active_cluster is not None:
+        raise RuntimeError("a ray_tpu-on-spark cluster is already active; "
+                           "call shutdown_ray_tpu_cluster() first")
+    from ray_tpu._private.config import Config
+    from ray_tpu.api import _read_json_line
+
+    config = Config()
+    denv = {**os.environ, "RAY_TPU_DAEMONIZE": "1"}
+    head_procs = []
+    controller = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.controller",
+         "--config-json", config.to_json()],
+        stdout=subprocess.PIPE, start_new_session=True, env=denv)
+    head_procs.append(controller)
+    address = _read_json_line(controller)["controller_addr"]
+    # Head-node agent: CPU=0 by default so user tasks land on the worker
+    # nodes (reference: num_cpus_head_node defaults keep the driver light).
+    head_agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--controller", address,
+         "--resources-json", json.dumps({"CPU": num_cpus_head_node}),
+         "--config-json", config.to_json()],
+        stdout=subprocess.PIPE, start_new_session=True, env=denv)
+    head_procs.append(head_agent)
+    _read_json_line(head_agent)
+
+    resources = dict(resources_worker_node or {})
+    if num_cpus_worker_node is not None:
+        resources.setdefault("CPU", num_cpus_worker_node)
+
+    if job_runner is None:
+        job_runner = PySparkJobRunner(spark)
+
+    def _node(idx: int, check_cancelled: Callable[[], bool]) -> None:
+        _worker_node_main(address, resources or None, check_cancelled)
+
+    handle = job_runner.run_on_executors(_node, max_worker_nodes)
+    cluster = RayTpuClusterOnSpark(address, head_procs, job_runner, handle,
+                                   max_worker_nodes)
+    try:
+        cluster.wait_until_ready(timeout=timeout)
+    except Exception:
+        cluster.shutdown()
+        raise
+    _active_cluster = cluster
+    return address, cluster
+
+
+def shutdown_ray_tpu_cluster() -> None:
+    """Reference: shutdown_ray_cluster (cluster_init.py)."""
+    if _active_cluster is not None:
+        _active_cluster.shutdown()

@@ -1,0 +1,70 @@
+"""ray_tpu-on-Spark launcher (ray: python/ray/util/spark/cluster_init.py).
+
+The Spark surface is the injectable SparkJobRunner; these tests drive the
+real orchestration — head startup, per-executor node-agent babysitting,
+readiness wait, cancellation teardown — through LocalProcessJobRunner
+(the image has no pyspark, matching the reference's local-mode tests).
+
+Runs its own cluster (not ray_shared): the launcher owns head processes.
+"""
+import ray_tpu
+
+
+def test_spark_cluster_lifecycle():
+    from ray_tpu.utils.spark import (LocalProcessJobRunner,
+                                     setup_ray_tpu_cluster,
+                                     shutdown_ray_tpu_cluster)
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+    runner = LocalProcessJobRunner()
+    address, cluster = setup_ray_tpu_cluster(
+        max_worker_nodes=2, num_cpus_worker_node=1, num_cpus_head_node=0,
+        job_runner=runner, timeout=120.0)
+    try:
+        rt = cluster.connect()
+        # Worker CPUs only: the head node contributes none.
+        assert rt.cluster_resources().get("CPU", 0) == 2
+
+        @rt.remote(num_cpus=1)
+        def where():
+            import ray_tpu
+
+            return ray_tpu.get_runtime_context().node_id
+
+        nodes = set(rt.get([where.remote() for _ in range(6)], timeout=120))
+        # All tasks land on the two Spark "executor" nodes.
+        assert 1 <= len(nodes) <= 2
+    finally:
+        cluster.shutdown()
+
+    # Teardown cancelled the executor job: babysitter threads exited and
+    # their node agents were terminated.
+    for t in runner._threads:
+        t.join(timeout=15)
+        assert not t.is_alive()
+
+    # Idempotent + global-registry path.
+    shutdown_ray_tpu_cluster()
+    assert not ray_tpu.is_initialized()
+
+
+def test_spark_double_setup_rejected():
+    from ray_tpu.utils import spark as spark_mod
+    from ray_tpu.utils.spark import (LocalProcessJobRunner,
+                                     RayTpuClusterOnSpark,
+                                     setup_ray_tpu_cluster)
+
+    sentinel = RayTpuClusterOnSpark("addr", [], LocalProcessJobRunner(),
+                                    None, 0)
+    spark_mod._active_cluster = sentinel
+    try:
+        try:
+            setup_ray_tpu_cluster(max_worker_nodes=1,
+                                  job_runner=LocalProcessJobRunner())
+            raise AssertionError("second setup should be rejected")
+        except RuntimeError as e:
+            assert "already active" in str(e)
+    finally:
+        spark_mod._active_cluster = None
