@@ -237,6 +237,78 @@ import os; os._exit(0)
     return out
 
 
+def bench_ray_client() -> dict:
+    """Actor calls through the `ray://` client proxy (reference:
+    client__1_1_actor_calls_sync 520/s, _async 963/s — the isolating
+    proxy costs one extra hop per call by design)."""
+    import os
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(resources={"CPU": 8})
+    proxy = None
+    out = {}
+    try:
+        addr = global_worker().controller_addr
+        repo_dir = os.path.abspath(os.path.dirname(__file__) or ".")
+        proxy = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.client.server",
+             "--cluster", addr],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=repo_dir)
+        announce = json.loads(proxy.stdout.readline())
+        proxy_addr = announce["proxy_addr"]
+        script = f"""
+import sys, time, json
+sys.path.insert(0, {repo_dir!r})
+import ray_tpu
+ray_tpu.init("ray://{proxy_addr}")
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.v = 0
+    def inc(self):
+        self.v += 1
+        return self.v
+
+c = Counter.remote()
+ray_tpu.get(c.inc.remote())
+n = 200
+t0 = time.perf_counter()
+for _ in range(n):
+    ray_tpu.get(c.inc.remote())
+sync = n / (time.perf_counter() - t0)
+n = 1000
+t0 = time.perf_counter()
+ray_tpu.get([c.inc.remote() for _ in range(n)])
+asy = n / (time.perf_counter() - t0)
+print(json.dumps({{"sync": sync, "async": asy}}), flush=True)
+ray_tpu.shutdown()
+import os; os._exit(0)
+"""
+        res = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=300)
+        for line in res.stdout.splitlines():
+            try:
+                d = json.loads(line)
+                out["client_actor_calls_sync_per_s"] = round(d["sync"], 1)
+                out["client_actor_calls_async_per_s"] = round(d["async"], 1)
+                break
+            except json.JSONDecodeError:
+                continue
+        if not out:
+            out["client_bench_error"] = (res.stderr or "no output")[-500:]
+    finally:
+        if proxy is not None:
+            proxy.terminate()
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_model() -> dict:
     import jax
     import jax.numpy as jnp
@@ -422,6 +494,10 @@ def main() -> None:
         extra.update(_with_timeout(bench_multi_client, 300))
     except Exception as e:  # noqa: BLE001
         extra["multi_client_error"] = repr(e)
+    try:
+        extra.update(_with_timeout(bench_ray_client, 300))
+    except Exception as e:  # noqa: BLE001
+        extra["ray_client_error"] = repr(e)
     try:
         extra["model_bench"] = _with_timeout(bench_model, 900)
     except Exception as e:  # noqa: BLE001
