@@ -85,6 +85,9 @@ class PlacementGroupInfo:
     # bundle index -> node_id
     bundle_nodes: dict[int, str] = field(default_factory=dict)
     waiters: list[asyncio.Future] = field(default_factory=list)
+    # Resolved after the scheduler's FIRST full reservation pass (whether
+    # it succeeded or not) so create_pg can report the outcome inline.
+    first_attempt: asyncio.Future | None = None
 
 
 class Controller:
@@ -119,6 +122,8 @@ class Controller:
         self._restored_at: float | None = None
         self._last_snapshot_blob: bytes | None = None
         self._probing: set[str] = set()
+        # Wakes pending PG schedulers when bundle releases free capacity.
+        self._pg_retry = asyncio.Event()
 
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
@@ -579,12 +584,41 @@ class Controller:
 
     # ----------------------------------------------------- placement groups
     async def rpc_create_pg(self, h: dict, _b: list) -> dict:
+        loop = asyncio.get_running_loop()
         pg = PlacementGroupInfo(
             pg_id=h["pg_id"], name=h.get("name"), strategy=h["strategy"],
             bundles=[dict(b) for b in h["bundles"]])
+        pg.first_attempt = loop.create_future()
         self.pgs[pg.pg_id] = pg
-        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
-        return {"pg_id": pg.pg_id}
+        loop.create_task(self._schedule_pg(pg))
+        if h.get("wait"):
+            # Report the first reservation pass inline: a satisfiable PG
+            # resolves in ONE controller→agent round trip, so the caller's
+            # ready() can skip its own RPC entirely; an unsatisfiable one
+            # resolves immediately with state PENDING (no stall here).
+            try:
+                await asyncio.wait_for(asyncio.shield(pg.first_attempt),
+                                       10.0)
+            except asyncio.TimeoutError:
+                pass
+        return {"pg_id": pg.pg_id, "state": pg.state,
+                "bundle_nodes": {str(k): v
+                                 for k, v in pg.bundle_nodes.items()}}
+
+    def _pg_attempt_done(self, pg: PlacementGroupInfo) -> None:
+        if pg.first_attempt is not None and not pg.first_attempt.done():
+            pg.first_attempt.set_result(None)
+
+    async def _pg_retry_wait(self) -> None:
+        """Sleep until the next scheduling opportunity: a bundle release
+        wakes all pending PG schedulers immediately (churn workloads
+        re-place within one event-loop turn instead of a heartbeat)."""
+        self._pg_retry.clear()
+        try:
+            await asyncio.wait_for(self._pg_retry.wait(),
+                                   self.config.heartbeat_period_s)
+        except asyncio.TimeoutError:
+            pass
 
     async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
         """Reserve bundles on agents per strategy (ray: GcsPlacementGroupScheduler
@@ -597,29 +631,42 @@ class Controller:
             placement = sched.place_bundles(
                 view, [pg.bundles[i] for i in pending], pg.strategy, self.config)
             if placement is None:
-                await asyncio.sleep(self.config.heartbeat_period_s)
+                self._pg_attempt_done(pg)
+                await self._pg_retry_wait()
                 continue
-            ok = True
-            reserved: list[tuple[int, str]] = []
-            for idx, node_id in zip(pending, placement):
-                node = self.nodes[node_id]
+            async def _reserve(idx: int, node_id: str) -> bool:
                 try:
-                    reply, _ = await self.clients.get(node.agent_addr).call(
+                    reply, _ = await self.clients.get(
+                        self.nodes[node_id].agent_addr).call(
                         "reserve_bundle",
                         {"pg_id": pg.pg_id, "bundle_index": idx,
                          "resources": pg.bundles[idx]}, timeout=10.0)
+                    return bool(reply.get("ok"))
                 except Exception:  # noqa: BLE001
-                    reply = {"ok": False}
-                if reply.get("ok"):
-                    reserved.append((idx, node_id))
-                else:
-                    ok = False
-                    break
+                    return False
+
+            # One parallel reserve wave: bundle count must not multiply
+            # the agent RTT (ray's 2PC also prepares bundles in parallel,
+            # gcs_placement_group_scheduler.cc ReserveResourceFromNodes).
+            grants = await asyncio.gather(
+                *[_reserve(i, n) for i, n in zip(pending, placement)])
+            reserved = [(i, n) for (i, n), g
+                        in zip(zip(pending, placement), grants) if g]
+            if pg.state != "PENDING":
+                # Removed (or node-death-reset) while the wave was in
+                # flight: recording these grants would resurrect a
+                # REMOVED group and leak its agent reservations forever.
+                if reserved:
+                    asyncio.get_running_loop().create_task(
+                        self._release_pg_bundles(pg.pg_id, reserved))
+                break
+            ok = all(grants)
             if ok:
                 for idx, node_id in reserved:
                     pg.bundle_nodes[idx] = node_id
                 if len(pg.bundle_nodes) == len(pg.bundles):
                     pg.state = "CREATED"
+                    self._pg_attempt_done(pg)
                     for fut in pg.waiters:
                         if not fut.done():
                             fut.set_result(None)
@@ -639,7 +686,9 @@ class Controller:
                                 timeout=10.0)
                         except Exception:  # noqa: BLE001
                             pass
-                await asyncio.sleep(self.config.heartbeat_period_s)
+                self._pg_attempt_done(pg)
+                await self._pg_retry_wait()
+        self._pg_attempt_done(pg)
 
     async def rpc_pg_ready(self, h: dict, _b: list) -> dict:
         pg = self.pgs.get(h["pg_id"])
@@ -665,17 +714,28 @@ class Controller:
             if not fut.done():
                 fut.set_result(None)
         pg.waiters.clear()
-        for idx, node_id in pg.bundle_nodes.items():
+        bundles = list(pg.bundle_nodes.items())
+        pg.bundle_nodes.clear()
+        if bundles:
+            # Release off the reply path: the remover doesn't need to wait
+            # on agent round trips, and release completion wakes pending
+            # PG schedulers (see _pg_retry_wait).
+            asyncio.get_running_loop().create_task(
+                self._release_pg_bundles(pg.pg_id, bundles))
+        return {}
+
+    async def _release_pg_bundles(self, pg_id: str,
+                                  bundles: list[tuple[int, str]]) -> None:
+        for idx, node_id in bundles:
             node = self.nodes.get(node_id)
             if node and node.state == "ALIVE":
                 try:
                     await self.clients.get(node.agent_addr).call(
                         "release_bundle",
-                        {"pg_id": pg.pg_id, "bundle_index": idx}, timeout=10.0)
+                        {"pg_id": pg_id, "bundle_index": idx}, timeout=10.0)
                 except Exception:  # noqa: BLE001
                     pass
-        pg.bundle_nodes.clear()
-        return {}
+        self._pg_retry.set()
 
     # ------------------------------------------------------------ state API
     async def rpc_list_nodes(self, h: dict, _b: list) -> dict:

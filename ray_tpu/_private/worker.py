@@ -556,6 +556,10 @@ class CoreWorker:
         # per second dominates the submit path.  One wakeup drains many.
         self._post_pending: list = []
         self._post_scheduled = False
+        # Outstanding call_nowait RPC tasks: flushed at shutdown so a
+        # fire-and-forget notification posted right before exit (e.g.
+        # remove_placement_group) still reaches the wire.
+        self._nowait_tasks: set = set()
         self._post_mutex = threading.Lock()
 
     # ---------------------------------------------------------------- setup
@@ -673,6 +677,13 @@ class CoreWorker:
 
     def shutdown(self) -> None:
         set_release_hook(None)
+        # Flush fire-and-forget notifications first: a remove_pg posted
+        # just before exit must reach the wire or its reservation leaks
+        # cluster-wide (nobody else reaps this driver's PGs).
+        try:
+            self.run(self._drain_nowait(), timeout=3.0)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
         self._shutdown.set()
         ev = getattr(self, "_shutdown_async", None)
         if ev is not None and self.loop is not None:
@@ -800,6 +811,34 @@ class CoreWorker:
         """Thread-safe RPC from user threads; client sockets are created on
         the IO loop (zmq asyncio sockets are loop-bound)."""
         return self.run(self.acall(addr, method, header, blobs, timeout))
+
+    def call_nowait(self, addr: str, method: str,
+                    header: dict | None = None, blobs: list | None = None,
+                    timeout: float = 30.0) -> None:
+        """Fire an RPC without blocking on its reply (errors are logged,
+        not raised).  For notifications whose effect the caller never
+        reads back directly — e.g. remove_placement_group, where the
+        reference's GCS also tears down asynchronously.  Per-connection
+        zmq ordering still serializes it before the caller's NEXT call to
+        the same peer."""
+        def _go():
+            async def _run():
+                try:
+                    await self.clients.get(addr).call(
+                        method, header, blobs, timeout=timeout)
+                except Exception:  # noqa: BLE001 - fire-and-forget
+                    logger.warning("call_nowait %s to %s failed", method,
+                                   addr)
+            t = self.loop.create_task(_run())
+            self._nowait_tasks.add(t)
+            t.add_done_callback(self._nowait_tasks.discard)
+
+        self._post_to_loop(_go)
+
+    async def _drain_nowait(self) -> None:
+        pending = [t for t in self._nowait_tasks if not t.done()]
+        if pending:
+            await asyncio.wait(pending, timeout=2.0)
 
     # ------------------------------------------------------------ functions
     def export_function(self, fn: Any) -> str:

@@ -19,6 +19,10 @@ class PlacementGroup:
         self.id = pg_id
         self.bundles = bundles
         self.strategy = strategy
+        # Set when create_pg reported CREATED inline (the controller
+        # waits for the first reservation pass): ready() then needs no
+        # RPC at all.  Deserialized handles re-ask the controller.
+        self._created = False
 
     @property
     def bundle_count(self) -> int:
@@ -29,6 +33,8 @@ class PlacementGroup:
         from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
 
+        if self._created:
+            return True
         if client_mod._ctx is not None:
             return client_mod._ctx.pg_ready(self.id, timeout)
         core = global_worker()
@@ -40,6 +46,7 @@ class PlacementGroup:
                  "timeout": max(0.1, deadline - time.monotonic())},
                 timeout=timeout + 10)
             if reply.get("state") == "CREATED":
+                self._created = True
                 return True
             if reply.get("state") == "REMOVED":
                 return False
@@ -80,13 +87,20 @@ def placement_group(bundles: Sequence[dict[str, float]],
         return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
     core = global_worker()
     pg_id = PlacementGroupID.from_random().hex()
-    core.call(core.controller_addr, "create_pg",
-              {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
-               "strategy": strategy, "name": name}, timeout=30.0)
-    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+    reply, _ = core.call(
+        core.controller_addr, "create_pg",
+        {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
+         "strategy": strategy, "name": name, "wait": True}, timeout=30.0)
+    pg = PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+    pg._created = reply.get("state") == "CREATED"
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
+    """Posted, not awaited (ray: remove_placement_group returns once the
+    GCS accepts the removal; actual bundle teardown is asynchronous
+    there too).  Per-connection ordering still puts the removal before
+    any later controller call from this process."""
     from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker
 
@@ -94,8 +108,8 @@ def remove_placement_group(pg: PlacementGroup) -> None:
         client_mod._ctx.pg_remove(pg.id)
         return
     core = global_worker()
-    core.call(core.controller_addr, "remove_pg", {"pg_id": pg.id},
-              timeout=30.0)
+    pg._created = False
+    core.call_nowait(core.controller_addr, "remove_pg", {"pg_id": pg.id})
 
 
 def placement_group_table() -> list[dict]:

@@ -75,6 +75,73 @@ def test_pg_infeasible_pending(ray_shared):
     remove_placement_group(pg)
 
 
+def test_pg_create_reports_ready_inline(ray_shared):
+    """create_pg waits for the first reservation pass server-side, so a
+    satisfiable PG's ready() needs no further RPC (the PG-churn fast
+    path: create+remove is two driver round trips total)."""
+    from ray_tpu.utils import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg._created          # reported CREATED in the create reply
+    assert pg.ready(timeout=0.001)   # no RPC, no wait
+    remove_placement_group(pg)
+
+
+def test_pg_async_release_frees_capacity(ray_shared):
+    """remove is posted (not awaited) and bundle release happens off the
+    controller's reply path; a release must still wake pending
+    schedulers promptly — back-to-back full-capacity churn would hang
+    (or crawl at one heartbeat per cycle) if the retry event regressed."""
+    from ray_tpu.utils import placement_group, remove_placement_group
+
+    for _ in range(10):
+        pg = placement_group([{"CPU": 4}], strategy="PACK")  # whole node
+        assert pg.ready(timeout=30), "capacity from removed PG not freed"
+        remove_placement_group(pg)
+
+
+def test_pg_remove_flushed_at_driver_exit(ray_shared):
+    """A remove_placement_group immediately before shutdown/exit must
+    reach the controller (call_nowait is flushed at shutdown) — a
+    dropped removal would leak the reservation cluster-wide forever."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.utils import placement_group_table
+
+    addr = global_worker().controller_addr
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = f"""
+import sys
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu.utils import placement_group, remove_placement_group
+ray_tpu.init(address={addr!r})
+pg = placement_group([{{"CPU": 1}}], strategy="PACK")
+assert pg.ready(timeout=30)
+print(pg.id, flush=True)
+remove_placement_group(pg)
+ray_tpu.shutdown()
+"""
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    pg_id = out.stdout.split()[-1]
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        states = {p["pg_id"]: p["state"] for p in placement_group_table()}
+        if states.get(pg_id, "REMOVED") == "REMOVED":
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"PG {pg_id} still {states.get(pg_id)} after "
+                         "driver exit: the posted remove was dropped")
+
+
 def test_node_affinity(ray_shared):
     import ray_tpu
     from ray_tpu.utils import NodeAffinitySchedulingStrategy
