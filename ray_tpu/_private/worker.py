@@ -113,6 +113,22 @@ class _UntrackedRef(ObjectRef):
         pass
 
 
+_EMPTY_ARGS_FRAMES: list | None = None
+
+
+def _empty_args_frames() -> list:
+    """Cached pickle of ((), {}) — the payload of every no-arg call.
+    Frames are immutable bytes; a shallow list copy keeps per-task blob
+    lists independent."""
+    global _EMPTY_ARGS_FRAMES
+    if _EMPTY_ARGS_FRAMES is None:
+        sv = serialize(((), {}))
+        _EMPTY_ARGS_FRAMES = [
+            f.tobytes() if isinstance(f, memoryview) else f
+            for f in sv.frames]
+    return list(_EMPTY_ARGS_FRAMES)
+
+
 def _copy_error(e: BaseException) -> BaseException:
     """Shallow-copy a cached error before raising it: raising the cached
     instance would attach the caller's traceback to it, pinning every frame
@@ -541,6 +557,7 @@ class CoreWorker:
         self._running_async: dict[bytes, asyncio.Task] = {}
         self._shutdown = threading.Event()
         self._task_events: list[dict] = []
+        self._event_tag: tuple[str, str] | None = None
         # Direct mapping of the local node store (plasma-client analog,
         # ray: plasma/client.cc mmaps store memory into the worker): puts
         # and gets of node-store objects bypass the agent RPC entirely.
@@ -1125,29 +1142,35 @@ class CoreWorker:
         # Top-level ObjectRef args are resolved to values worker-side before
         # execution (ray: DependencyResolver; nested refs stay refs).
         arg_refs: list[dict] = []
-        plain_args: list[Any] = []
         borrowed: dict[bytes, str] = {}    # deduped per task
-        for i, a in enumerate(args):
-            if isinstance(a, ObjectRef):
-                arg_refs.append({"pos": i, "id": a.hex(),
-                                 "owner": a.owner_addr or self.address})
-                plain_args.append(None)
-                borrowed.setdefault(a.binary(),
-                                    a.owner_addr or self.address)
-            else:
-                plain_args.append(a)
-        sv = serialize((tuple(plain_args), kwargs))
-        # Snapshot zero-copy view frames: the push happens later on the IO
-        # loop (and again on retry / lineage resubmit), so args must have
-        # submission-time semantics — a caller mutating its array after
-        # .remote() must not corrupt the task (ray: by-value arg copies).
-        sv.frames = [f.tobytes() if isinstance(f, memoryview) else f
-                     for f in sv.frames]
-        for ref in sv.contained_refs:
-            borrowed.setdefault(ref.binary(),
-                                ref.owner_addr or self.address)
-        for oid, owner in borrowed.items():
-            self._add_borrow(oid, owner)
+        if not args and not kwargs:
+            # No-arg calls dominate ping/poll-style actor traffic; their
+            # pickled payload is a constant — skip the serializer.
+            frames = _empty_args_frames()
+        else:
+            plain_args: list[Any] = []
+            for i, a in enumerate(args):
+                if isinstance(a, ObjectRef):
+                    arg_refs.append({"pos": i, "id": a.hex(),
+                                     "owner": a.owner_addr or self.address})
+                    plain_args.append(None)
+                    borrowed.setdefault(a.binary(),
+                                        a.owner_addr or self.address)
+                else:
+                    plain_args.append(a)
+            sv = serialize((tuple(plain_args), kwargs))
+            # Snapshot zero-copy view frames: the push happens later on the
+            # IO loop (and again on retry / lineage resubmit), so args must
+            # have submission-time semantics — a caller mutating its array
+            # after .remote() must not corrupt the task (ray: by-value arg
+            # copies).
+            frames = [f.tobytes() if isinstance(f, memoryview) else f
+                      for f in sv.frames]
+            for ref in sv.contained_refs:
+                borrowed.setdefault(ref.binary(),
+                                    ref.owner_addr or self.address)
+            for oid, owner in borrowed.items():
+                self._add_borrow(oid, owner)
         tc = self.current_trace
         header = {
             "task_id": task_id.hex(), "function_id": fid,
@@ -1176,7 +1199,7 @@ class CoreWorker:
         if options.get("affinity_node_id"):
             header["affinity_node_id"] = options["affinity_node_id"]
             header["affinity_soft"] = options.get("affinity_soft", False)
-        return header, sv.frames, list(borrowed.items())
+        return header, frames, list(borrowed.items())
 
     def _add_borrow(self, oid: bytes, owner_addr: str) -> None:
         if owner_addr == self.address or not owner_addr:
@@ -3174,10 +3197,15 @@ class CoreWorker:
     def _record_event(self, task_id: str, state: str, name: str = "",
                       trace: dict | None = None) -> None:
         tc = trace or self.current_trace
+        tag = self._event_tag
+        if tag is None:
+            # worker/node ids are fixed after start; slice them once
+            # (this runs twice per task on the submit hot path).
+            tag = self._event_tag = (self.worker_id[:12],
+                                     self.node_id[:12])
         self._task_events.append(
             {"task_id": task_id, "state": state, "name": name,
-             "t": time.time(), "worker": self.worker_id[:12],
-             "node": self.node_id[:12],
+             "t": time.time(), "worker": tag[0], "node": tag[1],
              "trace_id": tc["trace_id"][:16] if tc else ""})
         if len(self._task_events) > self.config.task_event_buffer_size:
             self._task_events = self._task_events[-self.config.
