@@ -14,6 +14,7 @@ scheduling options), streaming and dynamic generators.  Not supported
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 from typing import Any, Sequence
 
@@ -22,6 +23,8 @@ from ray_tpu.client.common import (ClientActorHandle, ClientDynRefs,
                                    ClientObjectRefGenerator)
 
 # Module-global active context; the public API checks this first.
+logger = logging.getLogger(__name__)
+
 _ctx: "ClientContext | None" = None
 
 
@@ -93,6 +96,27 @@ class ClientContext:
                 raise cause from None
             raise
 
+    def _req_pipelined(self, op: str, header: dict,
+                       blobs: list | None = None) -> None:
+        """Submission without waiting on the proxy round trip: the ref /
+        actor ids in `header` are CLIENT-assigned, the host parks
+        placeholders under them before any await, and zmq per-connection
+        ordering guarantees any later get/wait from this client finds
+        them.  Host-side submission errors are delivered through the
+        refs; transport errors surface as unknown-ref failures there."""
+        async def _go():
+            try:
+                await self._cli.call(
+                    "client_req",
+                    {"client_id": self.client_id, "op": op,
+                     "header": header, "timeout": self.op_timeout},
+                    blobs or [], timeout=self.op_timeout + 30.0)
+            except Exception:  # noqa: BLE001
+                logger.warning("pipelined client op %s failed", op,
+                               exc_info=True)
+
+        asyncio.run_coroutine_threadsafe(_go(), self._loop)
+
     # ------------------------------------------------------------- API
     def put(self, value: Any) -> ClientObjectRef:
         reply, _ = self._req("put", {}, [_cloudpickle_dumps(value)])
@@ -125,33 +149,47 @@ class ClientContext:
         return ([by_hex[x] for x in reply["done"]],
                 [by_hex[x] for x in reply["not_done"]])
 
+    @staticmethod
+    def _new_ref_ids(opts: dict) -> list[str]:
+        import uuid
+
+        n = (opts or {}).get("num_returns", 1)
+        return [uuid.uuid4().hex for _ in range(n if isinstance(n, int)
+                                                else 1)]
+
     def submit_function(self, fn, args: tuple, kwargs: dict, opts: dict):
         if (opts or {}).get("num_returns") == "streaming":
             reply, _ = self._req(
                 "stream_task", {"opts": _plain_opts(opts)},
                 [_cloudpickle_dumps((fn, args, kwargs))])
             return ClientObjectRefGenerator(reply["stream_id"], self)
-        reply, _ = self._req(
-            "task", {"opts": _plain_opts(opts)},
+        ref_ids = self._new_ref_ids(opts)
+        self._req_pipelined(
+            "task", {"opts": _plain_opts(opts), "ref_ids": ref_ids},
             [_cloudpickle_dumps((fn, args, kwargs))])
-        refs = [ClientObjectRef(x, self) for x in reply["refs"]]
+        refs = [ClientObjectRef(x, self) for x in ref_ids]
         return refs[0] if len(refs) == 1 else refs
 
     def create_actor(self, cls, args: tuple, kwargs: dict,
                      opts: dict) -> ClientActorHandle:
-        reply, _ = self._req(
-            "create_actor", {"opts": _plain_opts(opts)},
+        import uuid
+
+        actor_key = uuid.uuid4().hex
+        self._req_pipelined(
+            "create_actor", {"opts": _plain_opts(opts),
+                             "actor_key": actor_key},
             [_cloudpickle_dumps((cls, args, kwargs))])
-        return ClientActorHandle(reply["actor_id"], self)
+        return ClientActorHandle(actor_key, self)
 
     def actor_call(self, actor_id: str, method: str, args: tuple,
                    kwargs: dict, opts: dict):
-        reply, _ = self._req(
+        ref_ids = self._new_ref_ids(opts)
+        self._req_pipelined(
             "actor_call",
             {"actor_id": actor_id, "method": method,
-             "opts": _plain_opts(opts)},
+             "opts": _plain_opts(opts), "ref_ids": ref_ids},
             [_cloudpickle_dumps((args, kwargs))])
-        refs = [ClientObjectRef(x, self) for x in reply["refs"]]
+        refs = [ClientObjectRef(x, self) for x in ref_ids]
         return refs[0] if len(refs) == 1 else refs
 
     def get_actor(self, name: str,

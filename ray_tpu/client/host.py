@@ -27,10 +27,32 @@ import ray_tpu
 _HOST: "ClientHost | None" = None
 
 
+async def _unwrap(obj, timeout: float = 120.0):
+    """Resolve a pipelined placeholder: await the in-flight submission's
+    future and re-raise a submission that failed.  THE one place the
+    pending-resolution rule lives."""
+    if isinstance(obj, asyncio.Future):
+        obj = await asyncio.wait_for(asyncio.shield(obj), timeout)
+    if isinstance(obj, BaseException):
+        raise obj
+    return obj
+
+
+def _await_pending(obj):
+    """Block an EXECUTOR thread (payload unpickling runs off-loop) until
+    a pipelined submission's placeholder resolves on the host loop."""
+    return asyncio.run_coroutine_threadsafe(_unwrap(obj),
+                                            _HOST.loop).result(125.0)
+
+
 def _resolve_ref(id_hex: str):
     """Unpickle hook: a ClientObjectRef in task args becomes the real
-    pinned ObjectRef of this host."""
+    pinned ObjectRef of this host.  A pipelined ref still in flight
+    resolves through its placeholder (its submission was sent earlier on
+    the same connection, so the placeholder is always registered)."""
     ref = _HOST.objects.get(id_hex) if _HOST else None
+    if isinstance(ref, (asyncio.Future, BaseException)):
+        ref = _await_pending(ref)
     if ref is None:
         raise ValueError(f"client ref {id_hex[:16]} is not pinned on "
                          "this client host (released or foreign client)")
@@ -39,10 +61,41 @@ def _resolve_ref(id_hex: str):
 
 def _resolve_actor(actor_id: str):
     handle = _HOST.actors.get(actor_id) if _HOST else None
+    if isinstance(handle, (asyncio.Future, BaseException)):
+        handle = _await_pending(handle)
     if handle is None:
         raise ValueError(f"client actor {actor_id[:12]} is not pinned on "
                          "this client host")
     return handle
+
+
+class _SubmitSequencer:
+    """Replays pipelined actor calls in ARRIVAL order: handlers take a
+    ticket in their synchronous prefix (before any await reorders them)
+    and submit only at their turn, preserving the per-caller actor-call
+    ordering the core runtime guarantees."""
+
+    def __init__(self) -> None:
+        self.next_ticket = 0
+        self.current = 0
+        self.waiters: dict[int, asyncio.Future] = {}
+
+    def take(self) -> int:
+        t = self.next_ticket
+        self.next_ticket += 1
+        return t
+
+    async def turn(self, ticket: int) -> None:
+        if self.current != ticket:
+            fut = asyncio.get_running_loop().create_future()
+            self.waiters[ticket] = fut
+            await fut
+
+    def done(self, ticket: int) -> None:
+        self.current = ticket + 1
+        fut = self.waiters.pop(self.current, None)
+        if fut is not None and not fut.done():
+            fut.set_result(None)
 
 
 class ClientHost:
@@ -51,6 +104,9 @@ class ClientHost:
     def __init__(self) -> None:
         self.objects: dict[str, ray_tpu.ObjectRef] = {}
         self.actors: dict[str, object] = {}
+        self._actor_seq: dict[str, _SubmitSequencer] = {}
+        # name -> in-flight pipelined creation (get_actor ordering).
+        self._pending_names: dict[str, asyncio.Future] = {}
         # Actors CREATED by this client (vs merely looked up): killed at
         # disconnect, like the reference tears down a client's state with
         # its SpecificServer (named actors included — they belong to this
@@ -90,6 +146,35 @@ class ClientHost:
         self.objects[h] = ref
         return h
 
+    def _register_pending(self, ref_ids: list[str]) -> dict:
+        """Synchronously (NO await before this in the handler) park a
+        future under each client-assigned ref id: the client fires
+        submissions without waiting, and zmq per-connection ordering
+        only helps if the id is visible by the time a later get/wait
+        handler starts."""
+        loop = asyncio.get_running_loop()
+        pends = {}
+        for rid in ref_ids:
+            fut = loop.create_future()
+            self.objects[rid] = fut
+            pends[rid] = fut
+        return pends
+
+    def _fill_pending(self, pends: dict, values: list) -> None:
+        for (rid, fut), val in zip(pends.items(), values):
+            if rid in self.objects:
+                # Guard against re-pinning a ref the client already
+                # released while this submission was in flight.
+                self.objects[rid] = val
+            if not fut.done():
+                fut.set_result(val)
+
+    async def _resolve(self, hexes: list) -> list:
+        """Ref ids → real ObjectRefs, awaiting in-flight submissions and
+        re-raising ones that failed (the error reaches the client at its
+        first get/wait on the ref, like a failed task's would)."""
+        return [await _unwrap(self.objects[x]) for x in hexes]
+
     @staticmethod
     def _loads(blob: bytes):
         import pickle
@@ -123,7 +208,7 @@ class ClientHost:
 
     # ------------------------------------------------------------- ops
     async def rpc_put(self, h: dict, blobs: list):
-        value = self._loads(blobs[0])
+        value = await asyncio.to_thread(self._loads, blobs[0])
         ref = await asyncio.to_thread(ray_tpu.put, value)
         return {"ref": self._pin(ref)}
 
@@ -131,7 +216,7 @@ class ClientHost:
         from ray_tpu.client.common import ClientDynRefs
         from ray_tpu.object_ref import ObjectRefGenerator
 
-        refs = [self.objects[x] for x in h["refs"]]
+        refs = await self._resolve(h["refs"])
         values = await asyncio.to_thread(
             ray_tpu.get, refs, timeout=h.get("timeout"))
         # Dynamic-generator values carry real ObjectRefs the client can't
@@ -142,62 +227,152 @@ class ClientHost:
         return {}, [self._dumps(values)]
 
     async def rpc_task(self, h: dict, blobs: list):
-        fn, args, kwargs = self._loads(blobs[0])
-        opts = self._decode_opts(h.get("opts"))
-        remote_fn = ray_tpu.remote(fn) if not opts \
-            else ray_tpu.remote(fn).options(**opts)
-        refs = await asyncio.to_thread(
-            lambda: remote_fn.remote(*args, **kwargs))
-        refs = refs if isinstance(refs, list) else [refs]
+        pends = self._register_pending(h.get("ref_ids") or [])
+        try:
+            fn, args, kwargs = await asyncio.to_thread(
+                self._loads, blobs[0])
+            opts = self._decode_opts(h.get("opts"))
+            remote_fn = ray_tpu.remote(fn) if not opts \
+                else ray_tpu.remote(fn).options(**opts)
+            # Submit ON the loop: .remote() only posts to the driver's IO
+            # thread, and a to_thread hop here can deadlock — _loads
+            # threads block in _await_pending waiting for exactly this
+            # submission's refs, exhausting the shared executor.
+            refs = remote_fn.remote(*args, **kwargs)
+            refs = refs if isinstance(refs, list) else [refs]
+        except BaseException as e:
+            if pends:
+                # Pipelined submission: deliver the failure through the
+                # refs (first get/wait raises it), like a task error.
+                self._fill_pending(pends, [e] * len(pends))
+                return {}
+            raise
+        if pends:
+            self._fill_pending(pends, refs)
+            return {}
         return {"refs": [self._pin(r) for r in refs]}
 
+    async def _actor(self, key: str):
+        """Handle lookup, awaiting a pipelined creation still in flight
+        and re-raising one that failed."""
+        return await _unwrap(self.actors[key])
+
     async def rpc_create_actor(self, h: dict, blobs: list):
-        cls, args, kwargs = self._loads(blobs[0])
-        opts = self._decode_opts(h.get("opts"))
-        actor_cls = ray_tpu.remote(cls) if not opts \
-            else ray_tpu.remote(cls).options(**opts)
-        handle = await asyncio.to_thread(
-            lambda: actor_cls.remote(*args, **kwargs))
+        key = h.get("actor_key")
+        name = (h.get("opts") or {}).get("name")
+        fut = None
+        if key:
+            fut = asyncio.get_running_loop().create_future()
+            self.actors[key] = fut
+            if name:
+                # get_actor(name) must order behind this creation.
+                self._pending_names[name] = fut
+        try:
+            cls, args, kwargs = await asyncio.to_thread(
+                self._loads, blobs[0])
+            opts = self._decode_opts(h.get("opts"))
+            actor_cls = ray_tpu.remote(cls) if not opts \
+                else ray_tpu.remote(cls).options(**opts)
+            handle = actor_cls.remote(*args, **kwargs)   # on-loop submit
+        except BaseException as e:
+            if key:
+                if key in self.actors:
+                    self.actors[key] = e
+                fut.set_result(e)
+                return {}
+            raise
+        finally:
+            if name and self._pending_names.get(name) is fut:
+                del self._pending_names[name]
+        # Real id always registered too: cleanup() kills by real id.
         self.actors[handle.actor_id] = handle
         self.created.add(handle.actor_id)
+        if key:
+            if key in self.actors:
+                self.actors[key] = handle
+            fut.set_result(handle)
+            return {}
         return {"actor_id": handle.actor_id}
 
     async def rpc_actor_call(self, h: dict, blobs: list):
-        args, kwargs = self._loads(blobs[0])
-        handle = self.actors[h["actor_id"]]
-        method = getattr(handle, h["method"])
-        if h.get("opts"):
-            method = method.options(**self._decode_opts(h["opts"]))
-        refs = await asyncio.to_thread(
-            lambda: method.remote(*args, **kwargs))
-        refs = refs if isinstance(refs, list) else [refs]
+        # Sync prefix: ticket + placeholders BEFORE any await.
+        seq = self._actor_seq.setdefault(h["actor_id"],
+                                         _SubmitSequencer())
+        ticket = seq.take()
+        pends = self._register_pending(h.get("ref_ids") or [])
+        err = method = args = kwargs = None
+        try:
+            args, kwargs = await asyncio.to_thread(
+                self._loads, blobs[0])
+            handle = await self._actor(h["actor_id"])
+            method = getattr(handle, h["method"])
+            if h.get("opts"):
+                method = method.options(**self._decode_opts(h["opts"]))
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        # Submit AT OUR TURN, on the loop (.remote() is nonblocking):
+        # thread-pool completion order must not reorder actor calls.
+        await seq.turn(ticket)
+        try:
+            if err is None:
+                refs = method.remote(*args, **kwargs)
+                refs = refs if isinstance(refs, list) else [refs]
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        finally:
+            seq.done(ticket)
+        if err is not None:
+            if pends:
+                self._fill_pending(pends, [err] * len(pends))
+                return {}
+            raise err
+        if pends:
+            self._fill_pending(pends, refs)
+            return {}
         return {"refs": [self._pin(r) for r in refs]}
 
     async def rpc_get_actor(self, h: dict, blobs: list):
+        pending = self._pending_names.get(h["name"])
+        if pending is not None:
+            # A pipelined creation with this name is in flight; its
+            # controller registration must land before the lookup.
+            try:
+                await asyncio.wait_for(asyncio.shield(pending), 120.0)
+            except Exception:  # noqa: BLE001 - lookup decides below
+                pass
         handle = await asyncio.to_thread(
             ray_tpu.get_actor, h["name"], h.get("namespace"))
         self.actors[handle.actor_id] = handle
         return {"actor_id": handle.actor_id}
 
     async def rpc_kill_actor(self, h: dict, blobs: list):
-        handle = self.actors.get(h["actor_id"])
+        handle = None
+        if h["actor_id"] in self.actors:
+            try:
+                handle = await self._actor(h["actor_id"])
+            except BaseException:  # noqa: BLE001 - creation had failed
+                handle = None
         if handle is not None:
             await asyncio.to_thread(ray_tpu.kill, handle)
         return {}
 
     async def rpc_wait(self, h: dict, blobs: list):
-        refs = [self.objects[x] for x in h["refs"]]
+        refs = await self._resolve(h["refs"])
+        # Answer in the CLIENT's id space: pipelined refs carry
+        # client-assigned ids that differ from the real ref hexes.
+        back = {r.hex(): x for x, r in zip(h["refs"], refs)}
         done, not_done = await asyncio.to_thread(
             lambda: ray_tpu.wait(refs, num_returns=h["num_returns"],
                                  timeout=h.get("timeout")))
-        return {"done": [r.hex() for r in done],
-                "not_done": [r.hex() for r in not_done]}
+        return {"done": [back[r.hex()] for r in done],
+                "not_done": [back[r.hex()] for r in not_done]}
 
     async def rpc_release(self, h: dict, blobs: list):
         for x in h.get("refs", ()):
             self.objects.pop(x, None)
         for a in h.get("actors", ()):
             self.actors.pop(a, None)
+            self._actor_seq.pop(a, None)
         return {}
 
     async def rpc_cluster_info(self, h: dict, blobs: list):
@@ -248,19 +423,37 @@ class ClientHost:
     async def rpc_stream_task(self, h: dict, blobs: list):
         import uuid as _uuid
 
-        opts = self._decode_opts(h.get("opts"))
-        opts["num_returns"] = "streaming"
         if h.get("actor_id"):
-            args, kwargs = self._loads(blobs[0])
-            handle = self.actors[h["actor_id"]]
-            method = getattr(handle, h["method"]).options(**opts)
-            gen = await asyncio.to_thread(
-                lambda: method.remote(*args, **kwargs))
+            # Ordered with the actor's pipelined calls (same guarantee
+            # as direct attach: per-caller submission order).  EVERY exit
+            # path after take() must pass through turn+done or the
+            # sequencer wedges the actor forever.
+            seq = self._actor_seq.setdefault(h["actor_id"],
+                                             _SubmitSequencer())
+            ticket = seq.take()
+            try:
+                opts = self._decode_opts(h.get("opts"))
+                opts["num_returns"] = "streaming"
+                args, kwargs = await asyncio.to_thread(
+                    self._loads, blobs[0])
+                handle = await self._actor(h["actor_id"])
+                method = getattr(handle, h["method"]).options(**opts)
+            except BaseException:
+                await seq.turn(ticket)
+                seq.done(ticket)
+                raise
+            await seq.turn(ticket)
+            try:
+                gen = method.remote(*args, **kwargs)
+            finally:
+                seq.done(ticket)
         else:
-            fn, args, kwargs = self._loads(blobs[0])
+            opts = self._decode_opts(h.get("opts"))
+            opts["num_returns"] = "streaming"
+            fn, args, kwargs = await asyncio.to_thread(
+                self._loads, blobs[0])
             remote_fn = ray_tpu.remote(fn).options(**opts)
-            gen = await asyncio.to_thread(
-                lambda: remote_fn.remote(*args, **kwargs))
+            gen = remote_fn.remote(*args, **kwargs)   # on-loop submit
         sid = _uuid.uuid4().hex
         # One DEDICATED thread per stream: a blocking next(gen) can run
         # for minutes (that's the feature), and parking it in asyncio's
@@ -332,6 +525,7 @@ async def _serve() -> None:
 
     ctx = zmq.asyncio.Context()
     server = RpcServer(ctx)
+    _HOST.loop = asyncio.get_running_loop()
     server.register_all(_HOST)
     server.start()
     print(json.dumps({"host_addr": server.address}), flush=True)

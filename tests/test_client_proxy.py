@@ -187,3 +187,56 @@ def test_client_proxy_pg_and_generators(ray_shared):
             c.disconnect()
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_client_pipelined_submissions(ray_shared):
+    """.remote() through the client does NOT wait on the proxy round
+    trip (ray: the client worker streams submissions over its data
+    channel).  Ref/actor ids are client-assigned; the host parks
+    placeholders so later get/wait/arg-resolution find them; submission
+    errors surface at the first get, like a task error would."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.client import ClientContext
+
+    controller = worker_mod._global_worker.controller_addr
+    proc, addr = _spawn_proxy(controller)
+    c = None
+    try:
+        c = ClientContext(addr, namespace="nspipe")
+
+        class Counter:
+            def __init__(self):
+                self.v = 0
+
+            def incr(self, by=1):
+                self.v += by
+                return self.v
+
+        # Actor creation + a burst of calls, none waiting on the proxy:
+        # order must hold (per-connection ordering + host placeholders).
+        h = c.create_actor(Counter, (), {}, {})
+        refs = [h.incr.remote() for _ in range(50)]
+        assert c.get(refs) == list(range(1, 51))
+
+        # A pipelined ref used as an ARG of the next pipelined call
+        # resolves through its placeholder host-side.
+        def double(x):
+            return x * 2
+
+        a = c.submit_function(double, (21,), {}, {})
+        b = c.submit_function(double, (a,), {}, {})
+        assert c.get(b) == 84
+
+        # wait() answers in the client's id space.
+        done, not_done = c.wait([a, b], 2, 30.0)
+        assert {r.hex for r in done} == {a.hex, b.hex} and not not_done
+
+        # Submission-time failure (no such method) surfaces at get.
+        bad = h.nope.remote()
+        with pytest.raises(Exception):
+            c.get(bad, timeout=30)
+    finally:
+        if c is not None:
+            c.disconnect()
+        proc.terminate()
+        proc.wait(timeout=10)
