@@ -88,6 +88,10 @@ class PlacementGroupInfo:
     # Resolved after the scheduler's FIRST full reservation pass (whether
     # it succeeded or not) so create_pg can report the outcome inline.
     first_attempt: asyncio.Future | None = None
+    # Creating driver's rpc address: non-detached PGs are reaped when the
+    # owner stops answering pings (ray ties PG lifetime to the job).
+    owner: str | None = None
+    detached: bool = False
 
 
 class Controller:
@@ -141,6 +145,7 @@ class Controller:
         loop = asyncio.get_running_loop()
         self._bg.append(loop.create_task(self._health_loop()))
         self._bg.append(loop.create_task(self._resource_broadcast_loop()))
+        self._bg.append(loop.create_task(self._pg_owner_reaper_loop()))
         if self.snapshot_path:
             # Write an initial snapshot NOW: a kill before the first
             # periodic write would otherwise restart with no pub-port
@@ -200,6 +205,7 @@ class Controller:
                       "strategy": p.strategy,
                       "bundles": copy.deepcopy(p.bundles),
                       "state": p.state,
+                      "owner": p.owner, "detached": p.detached,
                       "bundle_nodes": dict(p.bundle_nodes)}
                 for pid, p in self.pgs.items()},
             "kv": {ns: dict(d) for ns, d in self.kv.items()},
@@ -220,6 +226,7 @@ class Controller:
             self.pgs[pid] = PlacementGroupInfo(
                 pg_id=p["pg_id"], name=p["name"], strategy=p["strategy"],
                 bundles=p["bundles"], state=p["state"],
+                owner=p.get("owner"), detached=p.get("detached", False),
                 bundle_nodes=p["bundle_nodes"])
         self.kv = snap["kv"]
         self.jobs = snap["jobs"]
@@ -587,7 +594,8 @@ class Controller:
         loop = asyncio.get_running_loop()
         pg = PlacementGroupInfo(
             pg_id=h["pg_id"], name=h.get("name"), strategy=h["strategy"],
-            bundles=[dict(b) for b in h["bundles"]])
+            bundles=[dict(b) for b in h["bundles"]],
+            owner=h.get("owner"), detached=bool(h.get("detached")))
         pg.first_attempt = loop.create_future()
         self.pgs[pg.pg_id] = pg
         loop.create_task(self._schedule_pg(pg))
@@ -706,8 +714,11 @@ class Controller:
 
     async def rpc_remove_pg(self, h: dict, _b: list) -> dict:
         pg = self.pgs.get(h["pg_id"])
-        if pg is None:
-            return {}
+        if pg is not None:
+            self._remove_pg(pg)
+        return {}
+
+    def _remove_pg(self, pg: PlacementGroupInfo) -> None:
         pg.state = "REMOVED"
         # Wake ready()-blocked clients promptly: they re-read state=REMOVED.
         for fut in pg.waiters:
@@ -722,7 +733,31 @@ class Controller:
             # PG schedulers (see _pg_retry_wait).
             asyncio.get_running_loop().create_task(
                 self._release_pg_bundles(pg.pg_id, bundles))
-        return {}
+
+    async def _pg_owner_reaper_loop(self) -> None:
+        """Reap non-detached PGs whose owning driver died: zmq never
+        surfaces peer death, so a SIGKILLed driver would hold its
+        reservations forever (ray ties PG lifetime to the creating job;
+        lifetime="detached" opts out).  Same probe discipline as the
+        agents' lease-submitter reaper: three failed pings reap."""
+        from ray_tpu._private.rpc import probe_dead_peers
+
+        fails: dict[str, int] = {}
+
+        async def _reap(addr: str, pgs: list) -> None:
+            logger.warning("PG owner %s unreachable; removing %d "
+                           "placement group(s)", addr, len(pgs))
+            for pg in pgs:
+                self._remove_pg(pg)
+
+        while True:
+            await asyncio.sleep(10 * self.config.heartbeat_period_s)
+            by_owner: dict[str, list[PlacementGroupInfo]] = {}
+            for pg in self.pgs.values():
+                if (pg.state != "REMOVED" and not pg.detached
+                        and pg.owner):
+                    by_owner.setdefault(pg.owner, []).append(pg)
+            await probe_dead_peers(self.clients, by_owner, fails, _reap)
 
     async def _release_pg_bundles(self, pg_id: str,
                                   bundles: list[tuple[int, str]]) -> None:

@@ -386,36 +386,28 @@ class NodeAgent:
         raylet returns workers when the owner's connection drops;
         leases here are connectionless, so liveness is probed).  Three
         consecutive failed pings (~15s) reap."""
+        from ray_tpu._private.rpc import probe_dead_peers
+
         by_submitter: dict[str, list[WorkerHandle]] = {}
         for w in self.workers.values():
             if w.state == "leased" and w.submitter:
                 by_submitter.setdefault(w.submitter, []).append(w)
         if not hasattr(self, "_submitter_fails"):
             self._submitter_fails: dict[str, int] = {}
-        self._submitter_fails = {
-            a: c for a, c in self._submitter_fails.items()
-            if a in by_submitter}
-        for addr, workers in by_submitter.items():
-            try:
-                await self.clients.get(addr).call("ping", {}, timeout=3.0)
-                self._submitter_fails.pop(addr, None)
-                continue
-            except Exception:  # noqa: BLE001 - unreachable
-                n = self._submitter_fails.get(addr, 0) + 1
-                self._submitter_fails[addr] = n
-                if n < 3:
-                    continue
+
+        async def _reap(addr: str, workers: list) -> None:
             logger.warning(
                 "lease submitter %s unreachable; reaping %d lease(s)",
                 addr, len(workers))
-            self.clients.drop(addr)
             for w in workers:
                 if w.state == "leased" and w.submitter == addr:
                     self._release_lease_resources(w)
                     if not w.is_device_worker:
                         w.state = "idle"
-            self._submitter_fails.pop(addr, None)
             self._try_grant_pending()
+
+        await probe_dead_peers(self.clients, by_submitter,
+                               self._submitter_fails, _reap)
 
     async def _log_tail_loop(self) -> None:
         """Tail worker log files; forward new lines to the controller,

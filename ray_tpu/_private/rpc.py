@@ -171,6 +171,35 @@ class RpcServer:
         self._sock.close(0)
 
 
+async def probe_dead_peers(clients: "ClientPool",
+                           by_addr: dict[str, list],
+                           fails: dict[str, int],
+                           on_dead,
+                           strikes: int = 3,
+                           timeout: float = 3.0) -> None:
+    """Shared liveness-probe discipline (zmq never surfaces peer death):
+    ping each address holding resources; after `strikes` consecutive
+    failures, drop its client and hand its items to on_dead(addr, items).
+    Used by the agents' lease-submitter reaper and the controller's
+    PG-owner reaper — tune it here, not in copies."""
+    for addr in list(fails):
+        if addr not in by_addr:
+            del fails[addr]
+    for addr, items in by_addr.items():
+        try:
+            await clients.get(addr).call("ping", {}, timeout=timeout)
+            fails.pop(addr, None)
+            continue
+        except Exception:  # noqa: BLE001 - unreachable peer
+            n = fails.get(addr, 0) + 1
+            fails[addr] = n
+            if n < strikes:
+                continue
+        clients.drop(addr)
+        await on_dead(addr, items)
+        fails.pop(addr, None)
+
+
 class RpcClient:
     """One DEALER connection to a peer; call() returns (header, blobs)."""
 

@@ -206,10 +206,12 @@ class ClientContext:
         return reply["resources"]
 
     # ------------------------------------------------- placement groups
-    def pg_create(self, bundles, strategy: str, name: str | None) -> str:
+    def pg_create(self, bundles, strategy: str, name: str | None,
+                  lifetime: str | None = None) -> str:
         reply, _ = self._req(
             "pg_create", {"bundles": [dict(b) for b in bundles],
-                          "strategy": strategy, "name": name})
+                          "strategy": strategy, "name": name,
+                          "lifetime": lifetime})
         return reply["pg_id"]
 
     def pg_ready(self, pg_id: str, timeout: float) -> bool:

@@ -385,9 +385,10 @@ class ClientHost:
 
         pg = await asyncio.to_thread(
             placement_group, h["bundles"], h.get("strategy") or "PACK",
-            h.get("name"))
+            h.get("name"), h.get("lifetime"))
         self.pgs[pg.id] = pg
-        self.pg_created.add(pg.id)
+        if h.get("lifetime") != "detached":
+            self.pg_created.add(pg.id)   # detached PGs outlive the client
         return {"pg_id": pg.id}
 
     def _pg(self, pg_id: str):

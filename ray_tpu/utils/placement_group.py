@@ -69,10 +69,16 @@ class PlacementGroup:
 
 def placement_group(bundles: Sequence[dict[str, float]],
                     strategy: str = "PACK",
-                    name: str | None = None) -> PlacementGroup:
+                    name: str | None = None,
+                    lifetime: str | None = None) -> PlacementGroup:
+    """lifetime=None ties the PG to this driver — the controller reaps
+    its reservations if the driver dies without removing it (ray:
+    job-scoped PG lifetime); lifetime="detached" opts out."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(
             f"invalid strategy {strategy!r}; valid: {VALID_STRATEGIES}")
+    if lifetime not in (None, "detached"):
+        raise ValueError(f"invalid lifetime {lifetime!r}")
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
     for b in bundles:
@@ -83,14 +89,17 @@ def placement_group(bundles: Sequence[dict[str, float]],
     from ray_tpu._private.worker import global_worker
 
     if client_mod._ctx is not None:
-        pg_id = client_mod._ctx.pg_create(bundles, strategy, name)
+        pg_id = client_mod._ctx.pg_create(bundles, strategy, name,
+                                          lifetime)
         return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
     core = global_worker()
     pg_id = PlacementGroupID.from_random().hex()
     reply, _ = core.call(
         core.controller_addr, "create_pg",
         {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
-         "strategy": strategy, "name": name, "wait": True}, timeout=30.0)
+         "strategy": strategy, "name": name, "wait": True,
+         "owner": core.address,
+         "detached": lifetime == "detached"}, timeout=30.0)
     pg = PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
     pg._created = reply.get("state") == "CREATED"
     return pg

@@ -142,6 +142,62 @@ ray_tpu.shutdown()
                          "driver exit: the posted remove was dropped")
 
 
+def test_pg_owner_reaped_on_driver_kill(ray_shared):
+    """Non-detached PGs die with their driver: a SIGKILLed driver can't
+    run its remove, so the controller probes PG owners and reaps (ray:
+    job-scoped PG lifetime).  A lifetime="detached" PG survives."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.utils import placement_group_table
+
+    addr = global_worker().controller_addr
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = f"""
+import sys, os, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu.utils import placement_group
+ray_tpu.init(address={addr!r})
+owned = placement_group([{{"CPU": 0.5}}], strategy="PACK")
+det = placement_group([{{"CPU": 0.5}}], strategy="PACK",
+                      lifetime="detached")
+assert owned.ready(timeout=30) and det.ready(timeout=30)
+print(owned.id, det.id, flush=True)
+time.sleep(600)   # hold until killed
+"""
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        owned_id, det_id = proc.stdout.readline().split()
+    except ValueError:
+        proc.kill()
+        raise AssertionError("driver subprocess failed to create PGs")
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        states = {p["pg_id"]: p["state"] for p in placement_group_table()}
+        if states.get(owned_id) == "REMOVED":
+            break
+        time.sleep(1)
+    states = {p["pg_id"]: p["state"] for p in placement_group_table()}
+    assert states.get(owned_id) == "REMOVED", \
+        f"owned PG not reaped after driver kill: {states.get(owned_id)}"
+    assert states.get(det_id) == "CREATED", \
+        f"detached PG should survive: {states.get(det_id)}"
+    from ray_tpu.utils import remove_placement_group
+    from ray_tpu.utils.placement_group import PlacementGroup
+
+    remove_placement_group(PlacementGroup(det_id, [], "PACK"))
+
+
 def test_node_affinity(ray_shared):
     import ray_tpu
     from ray_tpu.utils import NodeAffinitySchedulingStrategy
