@@ -401,6 +401,9 @@ class ActorSubmitState:
     send_sem: Any = None
     # Consecutive sends skipped because the resolved address is dead.
     stale_spins: int = 0
+    # Seqnos currently inside _send_actor_batch (unacked): min() is the
+    # seq_floor stamped on outgoing batches — the receiver's baseline.
+    inflight_seqs: set = field(default_factory=set)
 
 
 class ActorInstance:
@@ -442,6 +445,38 @@ class ActorInstance:
         # Per-caller ordered delivery (ray: ActorSchedulingQueue seq_nos).
         self.next_seq: dict[str, int] = {}
         self.buffered: dict[str, dict[int, tuple]] = {}
+        # (caller, seqno) -> shared reply task: a retransmitted call
+        # (reply lost / retry raced the original) returns the ORIGINAL
+        # execution's reply instead of re-executing — stateful methods
+        # must not run twice because the transport retried.  Bounded
+        # window; a resend older than the window re-executes (the
+        # documented at-least-once fallback).
+        import collections
+
+        self.reply_cache: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
+
+    def cache_reply(self, key: tuple, task) -> None:
+        # Window ≥ the max inflight depth (batch_size × inflight batches
+        # = 1024): a retransmit always targets calls that were in
+        # flight.  Large replies evict on completion — memory stays
+        # bounded and big results fall back to at-least-once.
+        self.reply_cache[key] = task
+        while len(self.reply_cache) > 1024:
+            self.reply_cache.popitem(last=False)
+
+        def _trim(t):
+            try:
+                r = t.result()
+            except BaseException:  # noqa: BLE001 - incl. cancellation
+                return
+            if isinstance(r, tuple) and len(r) == 2 and sum(
+                    len(b) for b in r[1]
+                    if isinstance(b, (bytes, bytearray, memoryview))
+                    ) > 65536:
+                self.reply_cache.pop(key, None)
+
+        task.add_done_callback(_trim)
 
     def group_of(self, header: dict) -> str | None:
         """Resolve the concurrency group for one call (per-call override
@@ -2553,7 +2588,8 @@ class CoreWorker:
                 or inst.concurrency_groups:
             return False
         caller = calls[0].get("caller")
-        expected = inst.next_seq.get(caller, calls[0].get("seqno", 0))
+        expected = inst.next_seq.get(
+            caller, calls[0].get("seq_floor", calls[0].get("seqno", 0)))
         for ch in calls:
             if (ch.get("arg_refs") or ch.get("dynamic")
                     or ch.get("streaming")
@@ -2579,6 +2615,13 @@ class CoreWorker:
         nxt_fut = buf.pop(last_seq + 1, None)
         if nxt_fut and not nxt_fut.done():
             nxt_fut.set_result(None)
+        # Dedupe entries BEFORE execution: a retransmit racing this batch
+        # must share these replies, not re-run the methods.
+        shared = {}
+        for ch in calls:
+            fut = self.loop.create_future()
+            shared[ch.get("seqno", 0)] = fut
+            inst.cache_reply((caller, ch.get("seqno", 0)), fut)
 
         methods = [getattr(inst.instance, ch["method"]) for ch in calls]
 
@@ -2592,17 +2635,28 @@ class CoreWorker:
                 offset += n
             return recs
 
-        recs = await self.loop.run_in_executor(inst.executor, _run_all)
-        replies, out_blobs = [], []
-        for ch, rec in zip(calls, recs):
-            try:
-                reply, rb = await self._finalize_simple(ch, rec)
-            except BaseException as e:  # noqa: BLE001
-                reply, rb = self._error_reply(e)
-            reply["nblobs"] = len(rb)
-            replies.append(reply)
-            out_blobs.extend(rb)
-        return {"replies": replies}, out_blobs
+        try:
+            recs = await self.loop.run_in_executor(inst.executor, _run_all)
+            replies, out_blobs = [], []
+            for ch, rec in zip(calls, recs):
+                try:
+                    reply, rb = await self._finalize_simple(ch, rec)
+                except BaseException as e:  # noqa: BLE001
+                    reply, rb = self._error_reply(e)
+                fut = shared.get(ch.get("seqno", 0))
+                if fut is not None and not fut.done():
+                    fut.set_result((dict(reply), rb))  # pre-"nblobs" copy
+                reply["nblobs"] = len(rb)
+                replies.append(reply)
+                out_blobs.extend(rb)
+            return {"replies": replies}, out_blobs
+        except BaseException as e:
+            # Never leave a dedupe future pending: a resend awaiting it
+            # would hang forever.
+            for fut in shared.values():
+                if not fut.done():
+                    fut.set_result(self._error_reply(e))
+            raise
 
     async def rpc_actor_call_batch(self, h: dict,
                                    blobs: list) -> tuple[dict, list]:
@@ -2654,15 +2708,35 @@ class CoreWorker:
             logger.info("actor_call %s seq=%s nxt=%s method=%s",
                         h["actor_id"][:12], seq,
                         inst.next_seq.get(caller), h.get("method"))
-        # First seqno seen from a caller is its baseline: a restarted actor
-        # incarnation accepts the caller's continuing sequence without a
-        # handshake (ray: seq_no reset on actor restart via num_restarts).
-        nxt = inst.next_seq.setdefault(caller, seq)
+        # The caller's seq_floor (lowest unacked seqno at send time) is
+        # the baseline for a first-contact caller — NOT this call's own
+        # seqno: a reordered first batch would otherwise set the baseline
+        # past its preceding calls, demoting them to "stale retries"
+        # executed out of order.  A restarted actor incarnation still
+        # accepts the caller's continuing sequence (floor > 0 after acks).
+        floor = h.get("seq_floor")
+        nxt = inst.next_seq.setdefault(
+            caller, seq if floor is None else floor)
+        if floor is not None and floor > nxt:
+            # Seqnos [nxt, floor) were acked or terminally failed
+            # submitter-side and will never arrive; without this advance
+            # every later call parks forever behind the gap.
+            inst.next_seq[caller] = nxt = floor
+            gap_fut = inst.buffered.get(caller, {}).pop(floor, None)
+            if gap_fut and not gap_fut.done():
+                gap_fut.set_result(None)
         if seq < nxt:
             # Stale seqno: a retry resend after connection loss (the reply
-            # was lost, possibly after execution).  Execute immediately and
-            # out of order — at-least-once retry semantics, never park (a
-            # parked stale seq would never be woken: completions only pop
+            # was lost, OR the retry raced an execution still in flight).
+            # Share the ORIGINAL execution's reply — re-running would
+            # double-apply stateful methods (a counter once advanced by a
+            # retransmitted batch whose originals were mid-execution).
+            hit = inst.reply_cache.get((caller, seq))
+            if hit is not None:
+                return self._share_reply(hit)
+            # Beyond the dedupe window: execute out of order — the
+            # documented at-least-once fallback, never park (a parked
+            # stale seq would never be woken: completions only pop
             # upward).
             try:
                 started = await self._start_actor_method(inst, h, blobs)
@@ -2684,14 +2758,32 @@ class CoreWorker:
         try:
             started = await self._start_actor_method(inst, h, blobs)
         except BaseException as e:  # noqa: BLE001
-            return self._immediate_reply(self._error_reply(e))
+            err = self.loop.create_future()
+            err.set_result(self._error_reply(e))
+            inst.cache_reply((caller, seq), err)
+            return self._share_reply(err)
         finally:
             inst.next_seq[caller] = seq + 1
             buf = inst.buffered.get(caller, {})
             nxt_fut = buf.pop(seq + 1, None)
             if nxt_fut and not nxt_fut.done():
                 nxt_fut.set_result(None)
-        return started
+        shared = self.loop.create_task(self._await_reply(started))
+        inst.cache_reply((caller, seq), shared)
+        return self._share_reply(shared)
+
+    @staticmethod
+    async def _await_reply(started):
+        return await started
+
+    @staticmethod
+    def _share_reply(fut):
+        """Awaitable over a SHARED reply future: shielded, so one
+        consumer's cancellation (connection close mid-reply) cannot kill
+        the execution other resends share."""
+        async def _get():
+            return await asyncio.shield(fut)
+        return _get()
 
     @staticmethod
     def _immediate_reply(reply: tuple):
@@ -2920,6 +3012,15 @@ class CoreWorker:
                                 batch: list) -> None:
         """Deliver one batch (retrying per-call budgets on connection
         loss); returns once every call has a reply or a terminal error."""
+        seqs = [t.header.get("seqno", 0) for t, _ in batch]
+        st.inflight_seqs.update(seqs)
+        try:
+            await self._send_actor_batch_inner(st, batch)
+        finally:
+            st.inflight_seqs.difference_update(seqs)
+
+    async def _send_actor_batch_inner(self, st: ActorSubmitState,
+                                      batch: list) -> None:
         while True:
             if st.dead:
                 err = ActorDiedError(st.actor_id, st.death_cause)
@@ -2931,19 +3032,40 @@ class CoreWorker:
                 continue    # loops back; st.dead set or address refreshed
             if addr in self._dead_worker_addrs:
                 # Known-dead worker: zmq would hang on a fresh connection.
-                # Nothing was SENT, so no retry budget burns — wait for
-                # the death/restart events to update the actor state.
-                st.address = None
-                st.stale_spins += 1
-                if st.stale_spins > 150:   # ~30s of stale ALIVE replies
-                    for task, _ in batch:
-                        self._fail_actor_call(task, ActorError(
-                            st.actor_id,
-                            "actor worker is dead (no restart observed)"))
-                    return
-                await asyncio.sleep(0.2)
-                continue
+                # BUT the OS recycles ports — a stale death broadcast can
+                # name the address a NEW live worker now occupies.  Probe:
+                # if the current occupant hosts OUR actor, unmark and send.
+                try:
+                    reply, _ = await self.clients.get(addr).call(
+                        "ping", {}, timeout=2.0)
+                    if st.actor_id not in (reply or {}).get("actors", []):
+                        raise ConnectionLost(addr)
+                    self._dead_worker_addrs.discard(addr)
+                except Exception:  # noqa: BLE001 - genuinely dead
+                    # NO clients.drop here: the pooled connection may be
+                    # carrying another actor's live traffic to a recycled
+                    # port; dropping it would fail those calls.
+                    st.address = None
+                    st.stale_spins += 1
+                    if st.stale_spins > 10:   # ~30s of stale ALIVE replies
+                        for task, _ in batch:
+                            self._fail_actor_call(task, ActorError(
+                                st.actor_id,
+                                "actor worker is dead (no restart "
+                                "observed)"))
+                        return
+                    await asyncio.sleep(1.0)
+                    continue
             st.stale_spins = 0
+            # seq_floor: the lowest UNACKED seqno — the receiver's
+            # baseline for a first-contact caller, and its fast-forward
+            # point past seqnos that will never arrive (terminally failed
+            # calls).  Without it, a reordered FIRST batch (socket
+            # recreate mid-burst) set the baseline at its own seqnos and
+            # earlier calls were executed as if they were stale retries.
+            floor = min(st.inflight_seqs) if st.inflight_seqs else 0
+            for t, _ in batch:
+                t.header["seq_floor"] = floor
             try:
                 if len(batch) == 1:
                     task, _ = batch[0]
@@ -2971,6 +3093,12 @@ class CoreWorker:
                     else:
                         self._fail_actor_call(task, ActorError(
                             st.actor_id, "actor worker connection lost"))
+                        # A dead seqno must leave the floor NOW: resent
+                        # survivors stamped with a floor that includes it
+                        # would park at the receiver forever behind a gap
+                        # that never fills.
+                        st.inflight_seqs.discard(
+                            task.header.get("seqno", 0))
                 if not still:
                     return
                 batch = still

@@ -178,3 +178,46 @@ def test_threaded_actor_max_concurrency(ray_shared):
     t0 = time.monotonic()
     assert sum(ray_tpu.get([s.work.remote() for _ in range(4)])) == 4
     assert time.monotonic() - t0 < 1.1
+
+
+def test_retransmitted_call_does_not_reexecute(ray_shared):
+    """Transport retries must not double-apply stateful methods: a
+    resend of an already-executed seqno is answered from the receiver's
+    reply cache (exactly-once observable effects; ray: sequence-number
+    dedup in the actor scheduling queue).  Regression: a retried batch
+    whose originals were mid-flight re-ran four incr() calls and shifted
+    every later result."""
+    import ray_tpu
+    from ray_tpu._private.ids import TaskID
+    from ray_tpu._private.worker import _empty_args_frames, global_worker
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def inc(self):
+            self.v += 1
+            return self.v
+
+    c = Counter.remote()
+    assert ray_tpu.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+
+    core = global_worker()
+    st = core._actor_state(c._actor_id)
+    assert st.address, "actor address should be resolved after calls"
+
+    # Hand-craft a retransmit of seqno 0 (what _send_actor_batch does
+    # after a connection flap: same caller, same seqno, fresh task id).
+    header = {"task_id": TaskID.from_random().hex(),
+              "function_id": "", "num_returns": 1, "resources": {},
+              "owner_addr": core.address, "arg_refs": [],
+              "bundle_key": None, "name": "",
+              "actor_id": c._actor_id, "method": "inc",
+              "caller": core.worker_id, "seqno": 0}
+    reply, _ = core.call(st.address, "actor_call", header,
+                         _empty_args_frames(), timeout=30.0)
+    assert reply.get("status") != "error", reply
+
+    # The counter must NOT have advanced: next real call returns 4.
+    assert ray_tpu.get(c.inc.remote()) == 4
