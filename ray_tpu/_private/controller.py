@@ -26,7 +26,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-import zmq.asyncio
 
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private.config import Config
@@ -73,6 +72,8 @@ class ActorInfo:
     bundle_index: int = -1
     affinity_node_id: str | None = None
     affinity_soft: bool = False
+    label_hard: dict | None = None
+    label_soft: dict | None = None
 
 
 @dataclass
@@ -100,15 +101,14 @@ class Controller:
                  snapshot_path: str | None = None):
         self.config = config
         self.host = host
-        self.ctx = zmq.asyncio.Context.instance()
-        self.server = RpcServer(self.ctx, host, port=port)
+        self.server = RpcServer(host=host, port=port)
         # Created in start(): a restarted controller must rebind the
         # publisher at the SNAPSHOTTED port, or every subscribed agent
         # and driver goes silently dark (SUB sockets reconnect to the
         # old endpoint underneath).
         self.publisher: Publisher | None = None
         self._restored_pub_port: int | None = None
-        self.clients = ClientPool(self.ctx)
+        self.clients = ClientPool()
         self.nodes: dict[str, NodeInfo] = {}
         self.actors: dict[str, ActorInfo] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
@@ -138,7 +138,7 @@ class Controller:
                 restored = True
             except Exception:  # noqa: BLE001
                 logger.exception("snapshot restore failed; starting fresh")
-        self.publisher = Publisher(self.ctx, self.host,
+        self.publisher = Publisher(host=self.host,
                                    port=self._restored_pub_port)
         self.server.register_all(self)
         self.server.start()
@@ -293,7 +293,7 @@ class Controller:
 
     async def rpc_heartbeat(self, h: dict, _b: list) -> dict:
         node = self.nodes.get(h["node_id"])
-        if node is None or node.state != "ALIVE":
+        if node is None or node.state not in ("ALIVE", "DRAINING"):
             return {"ok": False}          # stale node: tell it to re-register
         node.last_heartbeat = time.monotonic()
         node.available = dict(h["available"])
@@ -314,7 +314,10 @@ class Controller:
             if stalled:
                 continue
             for node in list(self.nodes.values()):
-                if (node.state == "ALIVE"
+                # DRAINING nodes keep heartbeating and must keep death
+                # DETECTION too — a drained agent that crashes still has
+                # actors to fail over and bundles to release.
+                if (node.state in ("ALIVE", "DRAINING")
                         and now - node.last_heartbeat
                         > self.config.node_death_timeout_s
                         and node.node_id not in self._probing):
@@ -346,7 +349,7 @@ class Controller:
                 "ping", {}, timeout=self.config.node_death_timeout_s)
             node.last_heartbeat = time.monotonic()
         except Exception:  # noqa: BLE001 - unreachable: genuinely dead
-            if node.state == "ALIVE":
+            if node.state in ("ALIVE", "DRAINING"):
                 await self._on_node_dead(node)
         finally:
             self._probing.discard(node.node_id)
@@ -391,6 +394,33 @@ class Controller:
 
     async def rpc_get_cluster_view(self, h: dict, _b: list) -> dict:
         return {"view": self._cluster_view()}
+
+    async def rpc_drain_node(self, h: dict, _b: list) -> dict:
+        """Graceful drain (ray: `ray drain-node` / DrainNode RPC): the
+        node leaves the scheduling view (no new actors, bundles, or
+        spillbacks land there), its agent stops granting leases, and
+        running work finishes normally.  The agent keeps heartbeating —
+        a drain is not a death."""
+        node = self.nodes.get(h["node_id"])
+        if node is None:
+            return {"ok": False, "error": "unknown node"}
+        if node.state == "ALIVE":
+            node.state = "DRAINING"
+            try:
+                await self.clients.get(node.agent_addr).call(
+                    "drain", {}, timeout=10.0)
+            except Exception:  # noqa: BLE001 - agent will also observe
+                pass           # exclusion via the broadcast view
+            await self.publisher.publish(
+                "resources", {"view": self._cluster_view()})
+        busy = 0
+        try:
+            reply, _ = await self.clients.get(node.agent_addr).call(
+                "drain_status", {}, timeout=10.0)
+            busy = int(reply.get("busy", 0))
+        except Exception:  # noqa: BLE001
+            pass
+        return {"ok": True, "state": node.state, "busy": busy}
 
     async def rpc_push_logs(self, h: dict, _b: list) -> dict:
         """Worker log lines from a node agent → "logs" topic (drivers
@@ -446,6 +476,8 @@ class Controller:
         )
         actor.affinity_node_id = h.get("affinity_node_id")
         actor.affinity_soft = h.get("affinity_soft", False)
+        actor.label_hard = h.get("label_hard")
+        actor.label_soft = h.get("label_soft")
         self.actors[actor.actor_id] = actor
         if name:
             self.named_actors[(namespace, name)] = actor.actor_id
@@ -472,7 +504,9 @@ class Controller:
                 strategy = sched.NodeAffinity(actor.affinity_node_id,
                                               soft=actor.affinity_soft)
             node_id = sched.pick_node(view, actor.resources, self.config,
-                                      strategy=strategy)
+                                      strategy=strategy,
+                                      label_hard=actor.label_hard,
+                                      label_soft=actor.label_soft)
             if node_id is None:
                 await asyncio.sleep(delay)   # infeasible now; retry
                 continue

@@ -29,7 +29,6 @@ import sys
 import time
 from dataclasses import dataclass, field
 
-import zmq.asyncio
 
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private.config import Config
@@ -37,6 +36,24 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.rpc import ClientPool, RpcServer, Subscriber
 
 logger = logging.getLogger(__name__)
+
+
+def detect_labels() -> dict[str, str]:
+    """Auto-label the node with its accelerator identity (ray:
+    accelerator labels; on TPU the generation/topology are what
+    schedulers actually constrain on — v5e vs v6e, slice shape)."""
+    labels: dict[str, str] = {}
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if accel:
+        # e.g. "v5litepod-8" -> generation "v5litepod", topology "8".
+        labels["ray_tpu.io/accelerator-type"] = accel
+        gen, _, topo = accel.rpartition("-")
+        if gen:
+            labels["ray_tpu.io/tpu-generation"] = gen
+            labels["ray_tpu.io/tpu-topology"] = topo
+    if os.environ.get("TPU_WORKER_ID"):
+        labels["ray_tpu.io/tpu-worker-id"] = os.environ["TPU_WORKER_ID"]
+    return labels
 
 
 def detect_resources() -> dict[str, float]:
@@ -89,16 +106,18 @@ class NodeAgent:
                  resources: dict[str, float] | None = None,
                  host: str = "127.0.0.1",
                  node_id: str | None = None,
-                 env: dict[str, str] | None = None):
+                 env: dict[str, str] | None = None,
+                 labels: dict[str, str] | None = None):
         self.config = config
         self.controller_addr = controller_addr
         self.node_id = node_id or NodeID.from_random().hex()
         self.host = host
         self.resources = dict(resources) if resources else detect_resources()
+        self.labels = {**detect_labels(), **(labels or {}),
+                       "ray_tpu.io/node-id": self.node_id}
         self.available = dict(self.resources)
-        self.ctx = zmq.asyncio.Context.instance()
-        self.server = RpcServer(self.ctx, host)
-        self.clients = ClientPool(self.ctx)
+        self.server = RpcServer(host=host)
+        self.clients = ClientPool()
         self.workers: dict[str, WorkerHandle] = {}
         self._worker_env = dict(env or {})
         self._starting: dict[str, asyncio.Future] = {}
@@ -119,7 +138,17 @@ class NodeAgent:
         # the task-lease hot path measurably regressed it.
         self._actor_spawn_sem = asyncio.Semaphore(
             max(1, config.max_concurrent_worker_spawns))
+        # Wider gate for zygote-backed bursts: a warm fork costs ~20ms,
+        # so the cold-spawn bound (sized for 2s interpreter boots) was
+        # serializing 24-actor waves to a crawl (round-3 verdict:
+        # many_actors_ready 3.2/s).  Cold spawns keep the narrow gate.
+        self._actor_spawn_sem_warm = asyncio.Semaphore(
+            max(4 * config.max_concurrent_worker_spawns,
+                config.max_concurrent_worker_spawns))
         self._closed = False
+        # Draining: no NEW leases or actor placements; running work
+        # finishes (set by the controller's drain_node RPC).
+        self._draining = False
         self.store = None  # shared-memory store runner, attached in start()
         # Warm zygote spawner: plain workers fork in ~ms instead of ~2s
         # of cold imports (see _private/zygote.py).  Boots in the
@@ -149,9 +178,10 @@ class NodeAgent:
         reply, _ = await self.clients.get(self.controller_addr).call(
             "register_node",
             {"node_id": self.node_id, "agent_addr": self.server.address,
-             "resources": self.resources}, timeout=30.0)
+             "resources": self.resources, "labels": self.labels},
+            timeout=30.0)
         self.pub_addr = reply["pub_addr"]
-        self.subscriber = Subscriber(self.ctx, self.pub_addr)
+        self.subscriber = Subscriber(address=self.pub_addr)
         self.subscriber.subscribe("resources", self._on_resource_view)
         self.subscriber.subscribe("node", self._on_node_event)
         loop = asyncio.get_running_loop()
@@ -603,6 +633,31 @@ class NodeAgent:
         demand = h.get("resources", {})
         affinity = h.get("affinity_node_id")
         soft = h.get("affinity_soft", False)
+        label_hard = h.get("label_hard")
+        label_soft = h.get("label_soft")
+        if self._draining and not h.get("bundle_key"):
+            # Plain leases leave a draining node; bundle leases stay —
+            # their PG is still placed HERE and spilling them to a node
+            # without the bundle would park them forever.
+            view = {nid: v for nid, v in self.cluster_view.items()
+                    if nid != self.node_id}
+            target = sched.pick_node(view, demand, self.config,
+                                     label_hard=label_hard,
+                                     label_soft=label_soft)
+            if target is not None:
+                return {"spill_to": self.cluster_view[target]["agent_addr"]}
+            return {"unfeasible": True}
+        if label_hard and not sched.labels_match(self.labels, label_hard):
+            # This node is excluded by label: route to a matching node
+            # (ray: NodeLabelSchedulingStrategy is a filter, never soft).
+            view = {nid: v for nid, v in self.cluster_view.items()
+                    if nid != self.node_id}
+            target = sched.pick_node(view, demand, self.config,
+                                     label_hard=label_hard,
+                                     label_soft=label_soft)
+            if target is not None:
+                return {"spill_to": self.cluster_view[target]["agent_addr"]}
+            return {"unfeasible": True}
         if affinity and affinity != self.node_id:
             # Route to the pinned node only if it could ever run the task
             # (feasible by totals); it queues locally when merely busy.
@@ -622,7 +677,9 @@ class NodeAgent:
             # Infeasible here: spill to any feasible node (ray: Spillback).
             view = {nid: v for nid, v in self.cluster_view.items()
                     if nid != self.node_id}
-            target = sched.pick_node(view, demand, self.config)
+            target = sched.pick_node(view, demand, self.config,
+                                     label_hard=label_hard,
+                                     label_soft=label_soft)
             if target is not None:
                 return {"spill_to": self.cluster_view[target]["agent_addr"]}
             return {"unfeasible": True}
@@ -633,7 +690,9 @@ class NodeAgent:
         view = {nid: v for nid, v in self.cluster_view.items()
                 if nid != self.node_id}
         if not h.get("bundle_key") and not affinity:
-            target = sched.pick_node(view, demand, self.config)
+            target = sched.pick_node(view, demand, self.config,
+                                     label_hard=label_hard,
+                                     label_soft=label_soft)
             if target is not None and h.get("allow_spill", True):
                 return {"spill_to": self.cluster_view[target]["agent_addr"]}
         fut = asyncio.get_running_loop().create_future()
@@ -707,8 +766,45 @@ class NodeAgent:
             p.fut.set_result(reply)
 
     # --------------------------------------------------------------- actors
+    async def rpc_drain(self, h: dict, _b: list) -> dict:
+        self._draining = True
+        # Flush queued PLAIN leases through the spill path now: a lease
+        # parked before the drain must not be granted after it (bundle
+        # leases stay — their PG is still placed here, and PG-targeted
+        # work is part of "running work finishes").
+        still_pending = []
+        for p in self._pending:
+            if p.header.get("bundle_key") or p.fut.done():
+                if not p.fut.done():
+                    still_pending.append(p)
+                continue
+            view = {nid: v for nid, v in self.cluster_view.items()
+                    if nid != self.node_id}
+            target = sched.pick_node(view, p.header.get("resources", {}),
+                                     self.config,
+                                     label_hard=p.header.get("label_hard"),
+                                     label_soft=p.header.get("label_soft"))
+            if target is not None:
+                p.fut.set_result(
+                    {"spill_to": self.cluster_view[target]["agent_addr"]})
+            else:
+                p.fut.set_result({"unfeasible": True})
+        self._pending = still_pending
+        return {"ok": True}
+
+    async def rpc_drain_status(self, h: dict, _b: list) -> dict:
+        busy = len(self._leases) + len(self._pending) + sum(
+            len(w.actor_ids) for w in self.workers.values()
+            if w.state != "dead")
+        return {"draining": self._draining, "busy": busy}
+
     async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
         """Place an actor into a worker process (controller-initiated)."""
+        if self._draining:
+            # ok=False WITHOUT "error": the controller's scheduler treats
+            # a bare refusal as retriable and re-picks a node (an "error"
+            # reply is terminal and would kill the actor for good).
+            return {"ok": False}
         demand = dict(h.get("resources", {}))
         lease_h = {"resources": demand, "submitter": None,
                    "bundle_key": h.get("creation_header", {}).get("bundle_key")}
@@ -728,9 +824,12 @@ class NodeAgent:
                 # resources to admit them, ignore_cap would allow
                 # unbounded process forks.
                 has_demand = any(v > 0 for v in demand.values())
+                warm = (self._zygote is not None
+                        and self._zygote._ready.is_set())
                 w = await self._get_idle_worker(
                     ignore_cap=has_demand,
-                    spawn_sem=self._actor_spawn_sem)
+                    spawn_sem=(self._actor_spawn_sem_warm if warm
+                               else self._actor_spawn_sem))
         finally:
             if w is None or w.addr is None:
                 self._release(lease_h)
@@ -862,6 +961,7 @@ def main() -> None:
     p.add_argument("--controller", required=True)
     p.add_argument("--config-json", default="{}")
     p.add_argument("--resources-json", default="")
+    p.add_argument("--labels-json", default="")
     p.add_argument("--node-id", default="")
     args = p.parse_args()
     logging.basicConfig(
@@ -869,12 +969,13 @@ def main() -> None:
         format="%(asctime)s %(levelname)s agent: %(message)s")
     config = Config().override(_json.loads(args.config_json))
     resources = _json.loads(args.resources_json) if args.resources_json else None
+    labels = _json.loads(args.labels_json) if args.labels_json else None
 
     _watch_parent()
 
     async def _run():
         agent = NodeAgent(config, args.controller, resources=resources,
-                          node_id=args.node_id or None)
+                          node_id=args.node_id or None, labels=labels)
         await agent.start()
 
         def _term(*_a):

@@ -40,7 +40,6 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any
 
-import zmq.asyncio
 
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
@@ -75,6 +74,10 @@ def set_global_worker(w: "CoreWorker | None") -> None:
 def _freeze(v):
     if isinstance(v, dict):
         return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        # Label constraints nest value lists; a raw list would make the
+        # scheduling key unhashable.
+        return tuple(_freeze(x) for x in v)
     return v
 
 
@@ -180,6 +183,8 @@ class LeaseManager:
             "bundle_key": task.header.get("bundle_key"),
             "affinity_node_id": task.header.get("affinity_node_id"),
             "affinity_soft": task.header.get("affinity_soft", False),
+            "label_hard": task.header.get("label_hard"),
+            "label_soft": task.header.get("label_soft"),
             "submitter": self.core.address,
         }
         ev = self.arrivals.get(task.scheduling_key)
@@ -564,6 +569,9 @@ class CoreWorker:
         # propagation, util/tracing/tracing_helper.py): child submissions
         # inherit trace_id, and task events / profiling spans carry it.
         self.current_trace: dict | None = None
+        # Driver address of the job whose task is currently executing
+        # (propagated in task headers like `trace`); None outside tasks.
+        self.current_driver_addr: str | None = None
         self._put_seq = itertools.count()
         self._cancelled: set[bytes] = set()
         # task_id -> StreamState for streaming-generator tasks this process
@@ -636,14 +644,26 @@ class CoreWorker:
             threading.Thread(target=self.local_arena, daemon=True,
                              name="raytpu-arena-warm").start()
 
+    @property
+    def driver_addr(self) -> str:
+        """The owning job's driver address: this process for drivers,
+        the submitting job's driver inside task/actor execution (falls
+        back to this process for detached contexts)."""
+        if self.mode == "driver":
+            return self.address
+        return self.current_driver_addr or self.address
+
     def _io_main(self, started: threading.Event) -> None:
         asyncio.run(self._io_async_main(started))
 
     async def _io_async_main(self, started: threading.Event) -> None:
         self.loop = asyncio.get_running_loop()
-        self.ctx = zmq.asyncio.Context()
-        self.server = RpcServer(self.ctx)
-        self.clients = ClientPool(self.ctx)
+        # Transport sockets live on the process-wide rpc IO thread; this
+        # component only closes ITS server/clients/subscriber on the way
+        # out (the shared context is never terminated — in-process
+        # cluster nodes coexist on it).
+        self.server = RpcServer()
+        self.clients = ClientPool()
         self.server.register_all(self)
         self.server.start()
         self.address = self.server.address
@@ -674,18 +694,11 @@ class CoreWorker:
                 sub.close()
             self.server.close()
             self.clients.close()
-            # Terminate the context here, with every socket closed and
-            # LINGER 0 — a leaked live socket makes Context.__del__ block
-            # the whole interpreter at GC time.
-            try:
-                self.ctx.destroy(linger=0)
-            except Exception:  # noqa: BLE001
-                pass
 
     def _subscribe_events(self, pub_addr: str) -> None:
         """Subscribe to controller events (must run on the IO loop)."""
         self.pub_addr = pub_addr
-        self.subscriber = Subscriber(self.ctx, pub_addr)
+        self.subscriber = Subscriber(address=pub_addr)
         self.subscriber.subscribe("actor", self._on_actor_event)
         self.subscriber.subscribe("worker", self._on_worker_event)
         if self.mode == "driver" and getattr(self, "log_to_driver", False):
@@ -953,7 +966,9 @@ class CoreWorker:
                               self.config.default_task_max_retries)
         scheduling_key = (fid, _freeze(resources), bundle_key,
                           options.get("affinity_node_id"),
-                          options.get("affinity_soft", False))
+                          options.get("affinity_soft", False),
+                          _freeze(options.get("label_hard") or {}),
+                          _freeze(options.get("label_soft") or {}))
         task = PendingTask(
             task_id=task_id.binary(), header=header, blobs=blobs,
             return_ids=return_ids, retries_left=max(0, retries),
@@ -1213,6 +1228,12 @@ class CoreWorker:
             "owner_addr": self.address, "arg_refs": arg_refs,
             "bundle_key": bundle_key,
             "name": options.get("name", ""),
+            # Job context: the driver's address travels with every task
+            # (transitively through nested submissions), so driver-scoped
+            # resources created INSIDE workers — placement groups above
+            # all — are owned by the job's driver, not by a pooled worker
+            # process whose exit would reap them (ray: PGs are job-scoped).
+            "driver_addr": self.driver_addr,
             # W3C-style propagation: a task submitted INSIDE a task
             # continues its trace; a driver submission roots a new one
             # (trace_id = root task id).  span_id = this task's id.
@@ -1234,6 +1255,10 @@ class CoreWorker:
         if options.get("affinity_node_id"):
             header["affinity_node_id"] = options["affinity_node_id"]
             header["affinity_soft"] = options.get("affinity_soft", False)
+        if options.get("label_hard"):
+            header["label_hard"] = options["label_hard"]
+        if options.get("label_soft"):
+            header["label_soft"] = options["label_soft"]
         return header, frames, list(borrowed.items())
 
     def _add_borrow(self, oid: bytes, owner_addr: str) -> None:
@@ -1568,7 +1593,11 @@ class CoreWorker:
             e = self.memory.entry(oid)
             e.has_value, e.value = True, value
             e.frames = sv.frames
-            self.loop.call_soon_threadsafe(e.wake)
+            # Coalesced wake: a burst of puts costs ONE self-pipe write
+            # (call_soon_threadsafe per put made the loop thread do a
+            # pipe read + GIL trade per object — the dominant cost of
+            # put-heavy loops).
+            self._post_to_loop(e.wake)
         elif self._store_frames_local(oid, sv.frames):
             # Zero-RPC path: wrote straight into the mmap'd arena from the
             # caller's thread.
@@ -1576,7 +1605,7 @@ class CoreWorker:
             rec.locations = [self.agent_addr]
             e = self.memory.entry(oid)
             e.has_value, e.value = True, value
-            self.loop.call_soon_threadsafe(e.wake)
+            self._post_to_loop(e.wake)
         else:
             async def _store():
                 reply, _ = await self.clients.get(self.agent_addr).call(
@@ -2044,8 +2073,10 @@ class CoreWorker:
         rec = {"arg_contained": (), "svs": None, "err": None, "stored": ()}
         prev = self.current_task_id
         prev_trace = self.current_trace
+        prev_driver = self.current_driver_addr
         self.current_task_id = th["task_id"]
         self.current_trace = th.get("trace")
+        self.current_driver_addr = th.get("driver_addr") or prev_driver
         self._record_event(th["task_id"], "RUNNING", th.get("name", ""))
         try:
             value, contained = deserialize_with_refs(frames)
@@ -2078,6 +2109,7 @@ class CoreWorker:
         finally:
             self.current_task_id = prev
             self.current_trace = prev_trace
+            self.current_driver_addr = prev_driver
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -2221,8 +2253,9 @@ class CoreWorker:
             finally:
                 self._evict_untracked_args(h)
         try:
-            result = await self._run_user_code(_thunk, task_id=task_id,
-                                               trace=h.get("trace"))
+            result = await self._run_user_code(
+                _thunk, task_id=task_id, trace=h.get("trace"),
+                driver_addr=h.get("driver_addr"))
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(e)
         finally:
@@ -2278,8 +2311,10 @@ class CoreWorker:
             nonlocal count
             prev = self.current_task_id
             prev_trace = self.current_trace
+            prev_driver = self.current_driver_addr
             self.current_task_id = h["task_id"]
             self.current_trace = h.get("trace")
+            self.current_driver_addr = h.get("driver_addr") or prev_driver
             try:
                 for item in thunk():
                     asyncio.run_coroutine_threadsafe(
@@ -2288,6 +2323,7 @@ class CoreWorker:
             finally:
                 self.current_task_id = prev
                 self.current_trace = prev_trace
+                self.current_driver_addr = prev_driver
 
         try:
             await loop.run_in_executor(executor, _producer)
@@ -2361,17 +2397,21 @@ class CoreWorker:
 
     async def _run_user_code(self, thunk, task_id: bytes | None = None,
                              executor=None, instance_actor: str | None = None,
-                             trace: dict | None = None):
+                             trace: dict | None = None,
+                             driver_addr: str | None = None):
         prev_task = self.current_task_id
         prev_trace = self.current_trace
+        prev_driver = self.current_driver_addr
         self.current_task_id = task_id.hex() if task_id else None
         self.current_trace = trace
+        self.current_driver_addr = driver_addr or prev_driver
         try:
             return await self.loop.run_in_executor(
                 executor or self._default_executor, thunk)
         finally:
             self.current_task_id = prev_task
             self.current_trace = prev_trace
+            self.current_driver_addr = prev_driver
 
     def _error_reply(self, e: BaseException) -> tuple[dict, list]:
         import pickle
@@ -2720,11 +2760,19 @@ class CoreWorker:
         if floor is not None and floor > nxt:
             # Seqnos [nxt, floor) were acked or terminally failed
             # submitter-side and will never arrive; without this advance
-            # every later call parks forever behind the gap.
+            # every later call parks forever behind the gap.  Wake EVERY
+            # parked call at or below the floor, not just buffered[floor]:
+            # a call delivered before its predecessors terminally failed
+            # would otherwise wait on a future nobody resolves (leaking
+            # its dispatch task and arg blobs).  Woken stale entries
+            # (seq < floor) re-check on resume and take the reply-cache /
+            # at-least-once path.
             inst.next_seq[caller] = nxt = floor
-            gap_fut = inst.buffered.get(caller, {}).pop(floor, None)
-            if gap_fut and not gap_fut.done():
-                gap_fut.set_result(None)
+            buf = inst.buffered.get(caller, {})
+            for s in sorted(s for s in buf if s <= floor):
+                gap_fut = buf.pop(s)
+                if gap_fut and not gap_fut.done():
+                    gap_fut.set_result(None)
         if seq < nxt:
             # Stale seqno: a retry resend after connection loss (the reply
             # was lost, OR the retry raced an execution still in flight).
@@ -2749,6 +2797,22 @@ class CoreWorker:
             fut = self.loop.create_future()
             inst.buffered.setdefault(caller, {})[seq] = fut
             await fut
+            # A seq_floor fast-forward may have woken us STALE (our
+            # predecessors terminally failed and the floor moved past
+            # us): serve the original reply if cached, else execute out
+            # of order (at-least-once fallback) WITHOUT touching
+            # next_seq — the in-order epilogue below would rewind it
+            # past the floor and re-demote every later call.
+            if seq < inst.next_seq.get(caller, 0):
+                hit = inst.reply_cache.get((caller, seq))
+                if hit is not None:
+                    return self._share_reply(hit)
+                try:
+                    started = await self._start_actor_method(inst, h,
+                                                             blobs)
+                except BaseException as e:  # noqa: BLE001
+                    return self._immediate_reply(self._error_reply(e))
+                return started
         # In-order start, possibly-concurrent execution: async actors and
         # threaded actors (max_concurrency > 1) overlap; the default
         # single-thread executor serializes (ray: fiber.h vs ordered queue).
@@ -2882,14 +2946,18 @@ class CoreWorker:
 
                 prev = self.current_task_id
                 prev_trace = self.current_trace
+                prev_driver = self.current_driver_addr
                 self.current_task_id = h["task_id"]
                 self.current_trace = h.get("trace")
+                self.current_driver_addr = (h.get("driver_addr")
+                                            or prev_driver)
                 try:
                     with renv.activate(inst.runtime_env, self):
                         return method(*args, **kwargs)
                 finally:
                     self.current_task_id = prev
                     self.current_trace = prev_trace
+                    self.current_driver_addr = prev_driver
             atask = self.loop.run_in_executor(inst.executor_for(group),
                                               _call)
 
@@ -3202,6 +3270,8 @@ class CoreWorker:
                  "pg_id": options.get("pg_id"),
                  "bundle_index": options.get("bundle_index", -1),
                  "affinity_node_id": options.get("affinity_node_id"),
+                 "label_hard": options.get("label_hard"),
+                 "label_soft": options.get("label_soft"),
                  "affinity_soft": options.get("affinity_soft", False)},
                 blobs, timeout=120.0)
             if reply.get("error"):

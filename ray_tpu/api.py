@@ -171,13 +171,10 @@ def _pick_agent(controller_addr: str, timeout: float = 30.0) -> tuple[str, str]:
     """Attach to an existing cluster: wait for an alive node and use its agent."""
     import asyncio
 
-    import zmq.asyncio
-
     from ray_tpu._private.rpc import RpcClient
 
     async def _go():
-        ctx = zmq.asyncio.Context()
-        cli = RpcClient(ctx, controller_addr)
+        cli = RpcClient(address=controller_addr)
         deadline = time.monotonic() + timeout
         try:
             while time.monotonic() < deadline:
@@ -189,7 +186,6 @@ def _pick_agent(controller_addr: str, timeout: float = 30.0) -> tuple[str, str]:
             raise TimeoutError("no alive nodes in cluster")
         finally:
             cli.close()
-            ctx.term()
 
     return asyncio.run(_go())
 

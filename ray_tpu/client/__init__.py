@@ -57,14 +57,17 @@ class ClientContext:
                                     {"namespace": namespace})
         self.client_id = reply["client_id"]
         self._closed = False
+        # Pipelined-submission failures, keyed by the CLIENT-assigned
+        # ref/actor ids the caller already holds: the next get/wait/call
+        # touching one raises the real submission error instead of an
+        # opaque unknown-ref failure from the host (or a long _unwrap
+        # stall on an actor that never existed).
+        self._pipeline_errors: dict[str, BaseException] = {}
 
     async def _make_client(self):
-        import zmq.asyncio
-
         from ray_tpu._private.rpc import RpcClient
 
-        self._zctx = zmq.asyncio.Context()
-        return RpcClient(self._zctx, self.proxy_addr)
+        return RpcClient(address=self.proxy_addr)
 
     def _run(self, coro):
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
@@ -97,13 +100,15 @@ class ClientContext:
             raise
 
     def _req_pipelined(self, op: str, header: dict,
-                       blobs: list | None = None) -> None:
+                       blobs: list | None = None,
+                       ids: Sequence[str] = ()) -> None:
         """Submission without waiting on the proxy round trip: the ref /
         actor ids in `header` are CLIENT-assigned, the host parks
         placeholders under them before any await, and zmq per-connection
         ordering guarantees any later get/wait from this client finds
         them.  Host-side submission errors are delivered through the
-        refs; transport errors surface as unknown-ref failures there."""
+        refs; a TRANSPORT failure is recorded under the assigned `ids`
+        and raised from the next API call that touches them."""
         async def _go():
             try:
                 await self._cli.call(
@@ -111,11 +116,21 @@ class ClientContext:
                     {"client_id": self.client_id, "op": op,
                      "header": header, "timeout": self.op_timeout},
                     blobs or [], timeout=self.op_timeout + 30.0)
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 logger.warning("pipelined client op %s failed", op,
                                exc_info=True)
+                for i in ids:
+                    self._pipeline_errors[i] = e
 
         asyncio.run_coroutine_threadsafe(_go(), self._loop)
+
+    def _check_pipeline_errors(self, ids) -> None:
+        for i in ids:
+            err = self._pipeline_errors.get(i)
+            if err is not None:
+                raise RuntimeError(
+                    f"pipelined client submission failed for {i[:12]}: "
+                    f"{err!r}") from err
 
     # ------------------------------------------------------------- API
     def put(self, value: Any) -> ClientObjectRef:
@@ -127,6 +142,7 @@ class ClientContext:
         ref_list = [refs] if single else list(refs)
         import pickle
 
+        self._check_pipeline_errors([r.hex for r in ref_list])
         reply, blobs = self._req(
             "get", {"refs": [r.hex for r in ref_list], "timeout": timeout})
         values = [self._decode_value(v) for v in pickle.loads(blobs[0])]
@@ -143,6 +159,7 @@ class ClientContext:
     def wait(self, refs: Sequence[ClientObjectRef], num_returns: int,
              timeout: float | None):
         by_hex = {r.hex: r for r in refs}
+        self._check_pipeline_errors(by_hex)
         reply, _ = self._req("wait", {"refs": list(by_hex),
                                       "num_returns": num_returns,
                                       "timeout": timeout})
@@ -166,7 +183,7 @@ class ClientContext:
         ref_ids = self._new_ref_ids(opts)
         self._req_pipelined(
             "task", {"opts": _plain_opts(opts), "ref_ids": ref_ids},
-            [_cloudpickle_dumps((fn, args, kwargs))])
+            [_cloudpickle_dumps((fn, args, kwargs))], ids=ref_ids)
         refs = [ClientObjectRef(x, self) for x in ref_ids]
         return refs[0] if len(refs) == 1 else refs
 
@@ -178,17 +195,18 @@ class ClientContext:
         self._req_pipelined(
             "create_actor", {"opts": _plain_opts(opts),
                              "actor_key": actor_key},
-            [_cloudpickle_dumps((cls, args, kwargs))])
+            [_cloudpickle_dumps((cls, args, kwargs))], ids=[actor_key])
         return ClientActorHandle(actor_key, self)
 
     def actor_call(self, actor_id: str, method: str, args: tuple,
                    kwargs: dict, opts: dict):
+        self._check_pipeline_errors([actor_id])
         ref_ids = self._new_ref_ids(opts)
         self._req_pipelined(
             "actor_call",
             {"actor_id": actor_id, "method": method,
              "opts": _plain_opts(opts), "ref_ids": ref_ids},
-            [_cloudpickle_dumps((args, kwargs))])
+            [_cloudpickle_dumps((args, kwargs))], ids=ref_ids)
         refs = [ClientObjectRef(x, self) for x in ref_ids]
         return refs[0] if len(refs) == 1 else refs
 
@@ -281,6 +299,8 @@ class ClientContext:
         self._fire_and_forget("stream_drop", {"stream_id": stream_id})
 
     def _release(self, ref_hexes: list[str]) -> None:
+        for h in ref_hexes:
+            self._pipeline_errors.pop(h, None)
         self._fire_and_forget("release", {"refs": ref_hexes})
 
     def disconnect(self) -> None:
@@ -300,7 +320,6 @@ class ClientContext:
 
     async def _close_async(self):
         self._cli.close()
-        self._zctx.term()
 
 
 def _plain_opts(opts: dict) -> dict:
@@ -355,12 +374,9 @@ def connect(proxy_addr: str, namespace: str = "default") -> ClientContext:
 def probe(addr: str, timeout: float = 3.0) -> bool:
     """True iff addr is a client proxy (vs a controller)."""
     async def _go():
-        import zmq.asyncio
-
         from ray_tpu._private.rpc import RpcClient
 
-        zctx = zmq.asyncio.Context()
-        cli = RpcClient(zctx, addr)
+        cli = RpcClient(address=addr)
         try:
             reply, _ = await cli.call("client_ping", {}, timeout=timeout)
             return reply.get("role") == "client_proxy"
@@ -368,6 +384,5 @@ def probe(addr: str, timeout: float = 3.0) -> bool:
             return False
         finally:
             cli.close()
-            zctx.term()
 
     return asyncio.run(_go())

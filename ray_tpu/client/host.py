@@ -520,12 +520,10 @@ class ClientHost:
 async def _serve() -> None:
     import signal
 
-    import zmq.asyncio
 
     from ray_tpu._private.rpc import RpcServer
 
-    ctx = zmq.asyncio.Context()
-    server = RpcServer(ctx)
+    server = RpcServer()
     _HOST.loop = asyncio.get_running_loop()
     server.register_all(_HOST)
     server.start()

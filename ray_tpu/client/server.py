@@ -81,7 +81,6 @@ class ProxyServer:
 
 
 async def _main(argv: list[str]) -> None:
-    import zmq.asyncio
 
     from ray_tpu._private.rpc import ClientPool, RpcServer
 
@@ -91,10 +90,9 @@ async def _main(argv: list[str]) -> None:
     args = p.parse_args(argv)
     import os
 
-    ctx = zmq.asyncio.Context()
     proxy = ProxyServer(args.cluster)
-    proxy._pool = ClientPool(ctx)
-    server = RpcServer(ctx, port=args.port)
+    proxy._pool = ClientPool()
+    server = RpcServer(port=args.port)
     server.register_all(proxy)
     server.start()
     print(json.dumps({"proxy_addr": server.address}), flush=True)

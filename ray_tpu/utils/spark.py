@@ -33,19 +33,16 @@ def _call_controller(addr: str, method: str, header: dict | None = None,
     import asyncio
 
     async def _go():
-        import zmq.asyncio
 
         from ray_tpu._private.rpc import RpcClient
 
-        ctx = zmq.asyncio.Context()
-        cli = RpcClient(ctx, addr)
+        cli = RpcClient(address=addr)
         try:
             reply, _ = await cli.call(method, header or {},
                                       timeout=timeout)
             return reply
         finally:
             cli.close()
-            ctx.term()
 
     return asyncio.run(_go())
 
