@@ -207,6 +207,17 @@ int raytpu_init(const char* address) {
   return 0;
 }
 
+
+// A driver-half call before (or after a failed) raytpu_init must return
+// an error through raytpu_last_error, not segfault inside CPython.
+#define RAYTPU_REQUIRE_BRIDGE()                        \
+  do {                                                 \
+    if (!g_bridge) {                                   \
+      g_last_error = "raytpu_init not called";         \
+      return 1;                                        \
+    }                                                  \
+  } while (0)
+
 static int copy_out_bytes(PyObject* b, void** out, uint64_t* out_len) {
   char* buf = nullptr;
   Py_ssize_t len = 0;
@@ -231,6 +242,7 @@ static int copy_out_hex(PyObject* s, char ref_hex[64]) {
 }
 
 int raytpu_put(const void* data, uint64_t len, char ref_hex[64]) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_put", "y#", (const char*)data,
                                     (Py_ssize_t)len);
@@ -245,6 +257,7 @@ int raytpu_put(const void* data, uint64_t len, char ref_hex[64]) {
 
 int raytpu_get(const char* ref_hex, double timeout_s, void** out,
                uint64_t* out_len) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_get", "sd", ref_hex,
                                     timeout_s);
@@ -259,6 +272,7 @@ int raytpu_get(const char* ref_hex, double timeout_s, void** out,
 
 int raytpu_submit(const char* lib_path, const char* fn_name, const void* args,
                   uint64_t args_len, char ref_hex[64]) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r =
       PyObject_CallMethod(g_bridge, "capi_submit", "ssy#", lib_path, fn_name,
@@ -275,6 +289,7 @@ int raytpu_submit(const char* lib_path, const char* fn_name, const void* args,
 // ready_mask[i] = 1 iff ref i completed within the timeout.
 int raytpu_wait(const char** ref_hexes, int n, int num_returns,
                 double timeout_s, int* ready_mask) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* lst = PyList_New(n);
   for (int i = 0; i < n; i++)
@@ -295,6 +310,7 @@ int raytpu_wait(const char** ref_hexes, int n, int num_returns,
 int raytpu_create_actor(const char* lib_path, const char* type_name,
                         const void* args, uint64_t args_len,
                         char actor_id[64]) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_create_actor", "ssy#",
                                     lib_path, type_name, (const char*)args,
@@ -311,6 +327,7 @@ int raytpu_create_actor(const char* lib_path, const char* type_name,
 int raytpu_actor_call(const char* actor_id, const char* method,
                       const void* args, uint64_t args_len,
                       char ref_hex[64]) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_actor_call", "ssy#",
                                     actor_id, method, (const char*)args,
@@ -325,6 +342,7 @@ int raytpu_actor_call(const char* actor_id, const char* method,
 }
 
 int raytpu_kill_actor(const char* actor_id) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_kill_actor", "s",
                                     actor_id);
@@ -337,6 +355,7 @@ int raytpu_kill_actor(const char* actor_id) {
 }
 
 int raytpu_release(const char* ref_hex) {
+  RAYTPU_REQUIRE_BRIDGE();
   Gil gil;
   PyObject* r = PyObject_CallMethod(g_bridge, "capi_release", "s", ref_hex);
   if (!r) {

@@ -98,7 +98,12 @@ def placement_group(bundles: Sequence[dict[str, float]],
         core.controller_addr, "create_pg",
         {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
          "strategy": strategy, "name": name, "wait": True,
-         "owner": core.address,
+         # Owner = the JOB's driver, not this process: a PG created
+         # inside a task/actor must survive its worker being pooled,
+         # recycled, or OOM-killed while the job lives (ray ties PG
+         # lifetime to the job; the controller's owner reaper probes
+         # this address).
+         "owner": core.driver_addr,
          "detached": lifetime == "detached"}, timeout=30.0)
     pg = PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
     pg._created = reply.get("state") == "CREATED"
