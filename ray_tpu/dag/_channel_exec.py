@@ -136,16 +136,34 @@ class _DagLoop:
         self._readers: dict[str, Channel] = {}
         self._writers: dict[str, Channel] = {}
 
-    def reader(self, name: str) -> Channel:
-        ch = self._readers.get(name)
+    def reader(self, desc):
+        """desc: shm channel name (str) or a NetChannelReader handle the
+        compiler shipped in the plan (cross-node edge)."""
+        key = desc if isinstance(desc, str) else desc.name
+        ch = self._readers.get(key)
         if ch is None:
-            ch = self._readers[name] = Channel.open(name)
+            ch = Channel.open(desc) if isinstance(desc, str) else desc
+            self._readers[key] = ch
         return ch
 
-    def writer(self, name: str) -> Channel:
-        ch = self._writers.get(name)
+    def writer(self, desc):
+        """desc: shm channel name (str) or ("net", name) — the writer end
+        of a cross-node edge was bound in THIS process at compile time
+        (net_channel.serve via __ray_call__)."""
+        key = desc if isinstance(desc, str) else desc[1]
+        ch = self._writers.get(key)
         if ch is None:
-            ch = self._writers[name] = Channel.open(name)
+            if isinstance(desc, str):
+                ch = Channel.open(desc)
+            else:
+                from ray_tpu.experimental.net_channel import served_writer
+
+                ch = served_writer(desc[1])
+                if ch is None:
+                    raise RuntimeError(
+                        f"net channel {desc[1]} was not served in this "
+                        "process (compile-time serve missing?)")
+            self._writers[key] = ch
         return ch
 
     def run(self) -> int:
@@ -154,11 +172,17 @@ class _DagLoop:
             while self._run_one():
                 iters += 1
         finally:
+            from ray_tpu.experimental.net_channel import unserve
+
             for ch in (*self._readers.values(), *self._writers.values()):
                 try:
                     ch.close()
                 except Exception:  # noqa: BLE001 - teardown
                     pass
+            for step in self.plan["steps"]:
+                out = step.get("out")
+                if isinstance(out, tuple):
+                    unserve(out[1])
         return iters
 
     def _run_one(self) -> bool:

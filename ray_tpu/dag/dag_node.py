@@ -357,10 +357,34 @@ class CompiledDAG:
         for l in leaves:
             driver_reads.add(id(l))
 
-        chan_name: dict[int, str] = {}
-        self._channels: list[str] = []
-        # Created handles MUST stay alive: a creator handle unlinks its
-        # segment when garbage-collected (Channel.close on _created).
+        if not input_readers:
+            return False
+
+        # Transport per edge (ray: compiled DAGs pick NCCL channels for
+        # cross-worker GPU tensors, torch_tensor_nccl_channel.py:191;
+        # here the cross-NODE analog is a DCN net channel): shm when the
+        # writer, every reader, and the driver share this node; a
+        # zmq-backed NetChannel bound in the WRITER's process otherwise.
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.experimental.net_channel import (
+            NetChannelReader, serve_on_actor as _net_serve)
+
+        core = global_worker()
+        driver_node = core.node_id
+        actor_node: dict[str, str] = {}
+        for aid in set(actor_of.values()):
+            reply, _ = core.call(
+                core.controller_addr, "get_actor_info",
+                {"actor_id": aid, "wait": True, "timeout": 60.0},
+                timeout=70.0)
+            actor_node[aid] = reply.get("node_id") or ""
+
+        chan_name: dict[int, str] = {}      # edge -> channel name
+        net_addr: dict[int, str] = {}       # edge -> endpoint (net edges)
+        self._channels: list[str] = []      # shm names (driver destroys)
+        # Created shm handles MUST stay alive: a creator handle unlinks
+        # its segment when garbage-collected (Channel.close on _created).
         created: dict[str, Channel] = {}
         for n in compute:
             nid = id(n)
@@ -369,20 +393,56 @@ class CompiledDAG:
             if n_read == 0:
                 continue
             name = f"{dag_tag}_n{node_ids[nid]}"
-            created[name] = Channel.create(
-                name, max_size=self._buffer_size, n_readers=n_read)
             chan_name[nid] = name
-            self._channels.append(name)
+            writer_aid = actor_of[nid]
+            participants = {actor_node[a] for a in consumers[nid]}
+            participants.add(actor_node[writer_aid])
+            if nid in driver_reads:
+                participants.add(driver_node)
+            if participants == {driver_node}:
+                created[name] = Channel.create(
+                    name, max_size=self._buffer_size, n_readers=n_read)
+                self._channels.append(name)
+            else:
+                # Bind the writer end inside the writer's process.
+                [ref] = core.submit_actor_task(
+                    writer_aid, "__ray_call__",
+                    (_net_serve, name, self._buffer_size, n_read), {},
+                    {"num_returns": 1})
+                net_addr[nid] = ray_tpu.get(ref)
+
         self._input_chan_name = f"{dag_tag}_input"
-        if not input_readers:
-            for ch in created.values():
-                ch.close()
-            return False
-        created[self._input_chan_name] = Channel.create(
-            self._input_chan_name, max_size=self._buffer_size,
-            n_readers=len(input_readers))
-        self._channels.append(self._input_chan_name)
+        in_nodes = {actor_node[a] for a in input_readers}
+        in_nodes.add(driver_node)
+        self._input_net = in_nodes != {driver_node}
+        if self._input_net:
+            from ray_tpu.experimental.net_channel import NetChannelWriter
+
+            host = core.address.rsplit(":", 1)[0]
+            self._input_writer = NetChannelWriter(
+                self._input_chan_name, host, max_size=self._buffer_size,
+                n_readers=len(input_readers))
+            input_addr = self._input_writer.address
+        else:
+            created[self._input_chan_name] = Channel.create(
+                self._input_chan_name, max_size=self._buffer_size,
+                n_readers=len(input_readers))
+            self._channels.append(self._input_chan_name)
         self._created_handles = created
+        # Observable transport split (tests/debugging): how many edges
+        # ride DCN vs shm.
+        self._net_edges = len(net_addr) + (1 if self._input_net else 0)
+
+        def chan_desc(nid: int):
+            """Reader-side descriptor for an edge (shipped in plans)."""
+            if nid in net_addr:
+                return NetChannelReader(chan_name[nid], net_addr[nid])
+            return chan_name.get(nid, "")
+
+        def out_desc(nid: int):
+            if nid in net_addr:
+                return ("net", chan_name[nid])
+            return chan_name.get(nid)
 
         def template(v):
             if isinstance(v, (InputNode, InputAttributeNode)):
@@ -390,7 +450,7 @@ class CompiledDAG:
                 return InputArg(key)
             if isinstance(v, ClassMethodNode):
                 nid = id(v)
-                return ChanArg(node_ids[nid], chan_name.get(nid, ""))
+                return ChanArg(node_ids[nid], chan_desc(nid))
             if isinstance(v, list):
                 return [template(x) for x in v]
             if isinstance(v, tuple):
@@ -399,20 +459,27 @@ class CompiledDAG:
                 return {k: template(x) for k, x in v.items()}
             return v
 
-        # Per-actor plans, steps in global topo order.
+        # Per-actor plans, steps in global topo order.  Each actor's plan
+        # carries its OWN input-channel descriptor (a net handle is one
+        # reader slot; sharing an instance across plans would alias it).
+        def input_desc():
+            if self._input_net:
+                return NetChannelReader(self._input_chan_name, input_addr)
+            return self._input_chan_name
+
         plans: dict[str, dict] = {}
         for n in compute:
             nid = id(n)
             aid = actor_of[nid]
             plan = plans.setdefault(
-                aid, {"steps": [], "input_channel": self._input_chan_name})
+                aid, {"steps": [], "input_channel": input_desc()})
             plan["steps"].append({
                 "node": node_ids[nid],
                 "method": n._method._name,
                 "args": template(n._bound_args),
                 "kwargs": {k: template(v)
                            for k, v in n._bound_kwargs.items()},
-                "out": chan_name.get(nid),
+                "out": out_desc(nid),
             })
 
         # ChanArg templates for same-actor deps carry "" channels — the
@@ -432,9 +499,14 @@ class CompiledDAG:
                 {"num_returns": 1})
             self._loop_refs.append(ref)
         # The driver reads leaf channels / writes the input channel with
-        # the creator handles themselves (one reader slot per handle).
-        self._out_readers = [created[chan_name[id(l)]] for l in leaves]
-        self._input_writer = created[self._input_chan_name]
+        # the creator handles (shm: one reader slot per handle) or net
+        # reader handles attached to the writer actors' endpoints.
+        self._out_readers = [
+            NetChannelReader(chan_name[id(l)], net_addr[id(l)])
+            if id(l) in net_addr else created[chan_name[id(l)]]
+            for l in leaves]
+        if not self._input_net:
+            self._input_writer = created[self._input_chan_name]
         return True
 
     # ------------------------------------------------------------ execute
@@ -492,6 +564,13 @@ class CompiledDAG:
             for ch in self._created_handles.values():
                 try:
                     ch.close()   # creator close() unlinks the segment
+                except Exception:  # noqa: BLE001
+                    pass
+            # Net handles the driver holds (cross-node edges): the writer
+            # ends on the actors close with their DAG loops.
+            for ch in (*self._out_readers, self._input_writer):
+                try:
+                    ch.close()
                 except Exception:  # noqa: BLE001
                     pass
             for name in self._channels:
