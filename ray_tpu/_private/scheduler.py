@@ -34,6 +34,27 @@ class Spread:
     pass
 
 
+def labels_match(node_labels: dict | None, constraints: dict | None) -> bool:
+    """constraints: {key: {"op": "in"|"notin"|"exists"|"absent",
+    "values": [...]}} (lowered by NodeLabelSchedulingStrategy)."""
+    if not constraints:
+        return True
+    labels = node_labels or {}
+    for key, c in constraints.items():
+        op = c.get("op", "in")
+        has = key in labels
+        val = labels.get(key)
+        if op == "in" and (not has or val not in c.get("values", [])):
+            return False
+        if op == "notin" and has and val in c.get("values", []):
+            return False
+        if op == "exists" and not has:
+            return False
+        if op == "absent" and has:
+            return False
+    return True
+
+
 def feasible(total: dict[str, float], demand: dict[str, float]) -> bool:
     return all(total.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
 
@@ -49,7 +70,8 @@ def _utilization(node: dict) -> float:
 
 
 def pick_node(view: View, demand: dict[str, float], config,
-              strategy=None) -> str | None:
+              strategy=None, label_hard: dict | None = None,
+              label_soft: dict | None = None) -> str | None:
     """Pick the best node for one resource demand; None if nothing fits now.
 
     Default hybrid policy (ray: hybrid_scheduling_policy.h:50): prefer the
@@ -68,9 +90,17 @@ def pick_node(view: View, demand: dict[str, float], config,
     candidates = [
         (nid, n) for nid, n in sorted(view.items())
         if feasible(n["total"], demand) and available(n["available"], demand)
+        and labels_match(n.get("labels"), label_hard)
     ]
     if not candidates:
         return None
+    if label_soft:
+        # Prefer soft-matching nodes; fall back to the rest (ray: soft
+        # label constraints bias, never exclude).
+        preferred = [(nid, n) for nid, n in candidates
+                     if labels_match(n.get("labels"), label_soft)]
+        if preferred:
+            candidates = preferred
     if isinstance(strategy, Spread):
         return min(candidates, key=lambda kv: (_utilization(kv[1]), kv[0]))[0]
     threshold = config.scheduler_spread_threshold

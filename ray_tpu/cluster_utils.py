@@ -62,13 +62,16 @@ class Cluster:
         return self.address
 
     def add_node(self, resources: dict[str, float] | None = None,
-                 node_id: str | None = None) -> dict:
+                 node_id: str | None = None,
+                 labels: dict[str, str] | None = None) -> dict:
         if self.address is None:
             self.start_head()
         args = ["ray_tpu._private.node_agent", "--controller", self.address,
                 "--config-json", self._config_json]
         if resources is not None:
             args += ["--resources-json", json.dumps(resources)]
+        if labels is not None:
+            args += ["--labels-json", json.dumps(labels)]
         if node_id:
             args += ["--node-id", node_id]
         info = self._spawn(args)

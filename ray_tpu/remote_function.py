@@ -43,6 +43,12 @@ def resolve_pg_options(opts: dict) -> dict:
     elif strategy is not None and hasattr(strategy, "node_id"):
         out["affinity_node_id"] = strategy.node_id
         out["affinity_soft"] = bool(getattr(strategy, "soft", False))
+    elif strategy is not None and hasattr(strategy, "hard"):
+        # NodeLabelSchedulingStrategy (constraints already lowered).
+        if strategy.hard:
+            out["label_hard"] = strategy.hard
+        if strategy.soft:
+            out["label_soft"] = strategy.soft
     if pg is not None:
         out["pg_id"] = pg.id
         out["bundle_index"] = idx

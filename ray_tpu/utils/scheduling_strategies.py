@@ -20,3 +20,69 @@ class PlacementGroupSchedulingStrategy:
 class NodeAffinitySchedulingStrategy:
     node_id: str
     soft: bool = False
+
+
+class In:
+    """Label value must be one of `values`."""
+
+    def __init__(self, *values: str):
+        self.values = [str(v) for v in values]
+
+    def _lower(self) -> dict:
+        return {"op": "in", "values": self.values}
+
+
+class NotIn:
+    def __init__(self, *values: str):
+        self.values = [str(v) for v in values]
+
+    def _lower(self) -> dict:
+        return {"op": "notin", "values": self.values}
+
+
+class Exists:
+    def _lower(self) -> dict:
+        return {"op": "exists"}
+
+
+class DoesNotExist:
+    def _lower(self) -> dict:
+        return {"op": "absent"}
+
+
+def _lower_constraints(d: dict | None) -> dict:
+    """Operator objects -> plain msgpack-able dicts (a bare string or
+    list is sugar for In)."""
+    out = {}
+    for k, v in (d or {}).items():
+        if hasattr(v, "_lower"):
+            out[str(k)] = v._lower()
+        elif isinstance(v, (list, tuple)):
+            out[str(k)] = {"op": "in", "values": [str(x) for x in v]}
+        else:
+            out[str(k)] = {"op": "in", "values": [str(v)]}
+    return out
+
+
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes by label (ray: util/scheduling_strategies.py
+    :135 NodeLabelSchedulingStrategy).  On TPU this is the natural
+    vehicle for accelerator-generation / slice-topology constraints —
+    agents auto-label nodes with `ray_tpu.io/accelerator-type` and
+    `ray_tpu.io/tpu-generation` (node_agent.detect_labels).
+
+        NodeLabelSchedulingStrategy(
+            hard={"ray_tpu.io/tpu-generation": In("v5e", "v6e")},
+            soft={"zone": In("us-central2-b")})
+
+    `hard` filters candidate nodes; `soft` prefers matching ones.
+    """
+
+    def __init__(self, hard: dict | None = None,
+                 soft: dict | None = None):
+        if not hard and not soft:
+            raise ValueError(
+                "NodeLabelSchedulingStrategy needs hard or soft "
+                "constraints")
+        self.hard = _lower_constraints(hard)
+        self.soft = _lower_constraints(soft)

@@ -1,0 +1,117 @@
+"""Label-based scheduling (ray: util/scheduling_strategies.py:135
+NodeLabelSchedulingStrategy + node labels).
+
+On TPU the labels that matter are accelerator generation / slice
+topology — agents auto-label from TPU_ACCELERATOR_TYPE
+(node_agent.detect_labels) and users add their own via
+Cluster.add_node(labels=...) / --labels-json.
+"""
+import pytest
+
+import ray_tpu
+from ray_tpu.utils.scheduling_strategies import (DoesNotExist, Exists, In,
+                                                 NodeLabelSchedulingStrategy,
+                                                 NotIn)
+
+
+@pytest.fixture(scope="module")
+def label_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    n_v5 = cluster.add_node(
+        resources={"CPU": 2},
+        labels={"tpu-generation": "v5e", "zone": "us-a"})
+    n_v6 = cluster.add_node(
+        resources={"CPU": 2},
+        labels={"tpu-generation": "v6e", "zone": "us-b"})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes(2)
+    yield cluster, n_v5, n_v6
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0.1)
+def where():
+    return ray_tpu.get_runtime_context().node_id
+
+
+def test_hard_label_in(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+    strat = NodeLabelSchedulingStrategy(
+        hard={"tpu-generation": In("v6e")})
+    nid = ray_tpu.get(where.options(
+        scheduling_strategy=strat).remote(), timeout=60)
+    assert nid == n_v6["node_id"]
+
+
+def test_hard_label_notin_and_values_list_sugar(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+    strat = NodeLabelSchedulingStrategy(
+        hard={"tpu-generation": NotIn("v6e")})
+    nid = ray_tpu.get(where.options(
+        scheduling_strategy=strat).remote(), timeout=60)
+    assert nid == n_v5["node_id"]
+    # Bare list sugar == In.
+    strat2 = NodeLabelSchedulingStrategy(hard={"zone": ["us-b"]})
+    nid2 = ray_tpu.get(where.options(
+        scheduling_strategy=strat2).remote(), timeout=60)
+    assert nid2 == n_v6["node_id"]
+
+
+def test_soft_label_prefers_but_falls_back(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+    # Soft preference for a label nobody has: still schedules somewhere.
+    strat = NodeLabelSchedulingStrategy(
+        soft={"tpu-generation": In("v99")})
+    nid = ray_tpu.get(where.options(
+        scheduling_strategy=strat).remote(), timeout=60)
+    assert nid in (n_v5["node_id"], n_v6["node_id"])
+    # Soft preference that IS satisfiable lands on the matching node.
+    strat2 = NodeLabelSchedulingStrategy(soft={"zone": In("us-a")})
+    nid2 = ray_tpu.get(where.options(
+        scheduling_strategy=strat2).remote(), timeout=60)
+    assert nid2 == n_v5["node_id"]
+
+
+def test_exists_and_absent(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+    strat = NodeLabelSchedulingStrategy(hard={"zone": Exists()})
+    nid = ray_tpu.get(where.options(
+        scheduling_strategy=strat).remote(), timeout=60)
+    assert nid in (n_v5["node_id"], n_v6["node_id"])
+    # Every node carries the auto node-id label; requiring its absence
+    # on a user key that exists nowhere passes trivially.
+    strat2 = NodeLabelSchedulingStrategy(
+        hard={"no-such-label": DoesNotExist()})
+    assert ray_tpu.get(where.options(
+        scheduling_strategy=strat2).remote(), timeout=60)
+
+
+def test_actor_hard_label(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class Pin:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    a = Pin.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"tpu-generation": In("v5e")})).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == n_v5["node_id"]
+    ray_tpu.kill(a)
+
+
+def test_auto_node_id_label(label_cluster):
+    cluster, n_v5, n_v6 = label_cluster
+    # The agent stamps ray_tpu.io/node-id automatically — usable as an
+    # affinity-by-label without knowing agent addresses.
+    strat = NodeLabelSchedulingStrategy(
+        hard={"ray_tpu.io/node-id": In(n_v6["node_id"])})
+    nid = ray_tpu.get(where.options(
+        scheduling_strategy=strat).remote(), timeout=60)
+    assert nid == n_v6["node_id"]
