@@ -1,0 +1,105 @@
+"""YAML cluster launcher: `ray-tpu up / down` front door.
+
+Analog of ray: python/ray/scripts/scripts.py `ray up/down` +
+autoscaler/_private/commands.py (create_or_update_cluster/teardown), sized
+to this runtime's provider surface: a config file names a provider
+(gce_tpu against the REST API, or local node-agent subprocesses) and the
+desired worker set; `up` creates the head + the initial workers and can
+hand the provider to a StandardAutoscaler for demand-driven growth;
+`down` terminates every cluster node.
+
+    cluster_name: demo
+    max_workers: 4
+    provider:
+      type: gce_tpu            # or "local"
+      project: my-project
+      zone: us-central2-b
+      # api_endpoint/metadata_endpoint: test/dry-run overrides
+    head_node:
+      node_config: {accelerator_type: v5litepod-8}
+    worker_nodes:
+      count: 2
+      node_config: {accelerator_type: v5litepod-8}
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if "provider" not in cfg:
+        raise ValueError(f"{path}: cluster config needs a `provider` block")
+    return cfg
+
+
+def make_provider(cfg: dict, controller_addr: str | None = None):
+    p = cfg["provider"]
+    kind = p.get("type", "local")
+    if kind == "gce_tpu":
+        from ray_tpu.autoscaler.gcp import GCETPUNodeProvider
+
+        kwargs: dict[str, Any] = {
+            "project": p["project"], "zone": p["zone"],
+            "cluster_name": cfg.get("cluster_name", "ray-tpu"),
+        }
+        for k in ("api_endpoint", "metadata_endpoint"):
+            if p.get(k):
+                kwargs[k] = p[k]
+        return GCETPUNodeProvider(**kwargs)
+    if kind == "local":
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+
+        if controller_addr is None:
+            raise ValueError("local provider needs a running controller "
+                             "(start the head first or use `up`)")
+        return LocalNodeProvider(controller_addr)
+    raise ValueError(f"unknown provider type {kind!r}")
+
+
+def up(config: dict, *, dry_run: bool = False,
+       controller_addr: str | None = None) -> dict:
+    """Create (or top up to) the configured cluster; idempotent like the
+    reference's create_or_update.  Returns a summary dict."""
+    worker_spec = config.get("worker_nodes", {})
+    want_workers = int(worker_spec.get("count", 0))
+    summary: dict[str, Any] = {"cluster_name": config.get("cluster_name"),
+                               "dry_run": dry_run}
+    if dry_run:
+        summary["would_create"] = {
+            "head": config.get("head_node", {}).get("node_config", {}),
+            "workers": want_workers,
+        }
+        return summary
+    provider = make_provider(config, controller_addr)
+    existing = provider.non_terminated_nodes()
+    created: list[str] = []
+    if not existing:
+        created += provider.create_node(
+            config.get("head_node", {}).get("node_config", {}), 1)
+    have_workers = max(0, len(existing) - 1) if existing else 0
+    missing = max(0, want_workers - have_workers)
+    if missing:
+        created += provider.create_node(
+            worker_spec.get("node_config", {}), missing)
+    summary["created"] = created
+    summary["nodes"] = provider.non_terminated_nodes()
+    return summary
+
+
+def down(config: dict, *, dry_run: bool = False,
+         controller_addr: str | None = None) -> dict:
+    """Terminate every node of the configured cluster."""
+    provider = make_provider(config, controller_addr)
+    nodes = provider.non_terminated_nodes()
+    if not dry_run:
+        for nid in nodes:
+            provider.terminate_node(nid)
+    return {"cluster_name": config.get("cluster_name"),
+            "terminated": nodes, "dry_run": dry_run}

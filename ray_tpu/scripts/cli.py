@@ -253,9 +253,66 @@ def cmd_serve(args) -> None:
         print("serve shut down")
 
 
+def cmd_up(args) -> None:
+    """ray: `ray up cluster.yaml` — create/update the configured cluster."""
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    summary = launcher.up(config, dry_run=args.dry_run,
+                          controller_addr=getattr(args, "address", None)
+                          or os.environ.get("RAY_TPU_ADDRESS"))
+    print(json.dumps(summary, indent=2))
+
+
+def cmd_down(args) -> None:
+    """ray: `ray down cluster.yaml` — tear the cluster down."""
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    summary = launcher.down(config, dry_run=args.dry_run,
+                            controller_addr=getattr(args, "address", None)
+                            or os.environ.get("RAY_TPU_ADDRESS"))
+    print(json.dumps(summary, indent=2))
+
+
+def cmd_drain(args) -> None:
+    """ray: `ray drain-node` — graceful drain: the node leaves the
+    scheduling view, running work finishes, heartbeats continue."""
+    addr = _require_address(args)
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def _go():
+        cli = RpcClient(address=addr)
+        reply, _ = await cli.call("drain_node",
+                                  {"node_id": args.node_id}, timeout=30.0)
+        cli.close()
+        return reply
+
+    print(json.dumps(asyncio.run(_go()), indent=2))
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("up", help="create/update a cluster from YAML")
+    sp.add_argument("config_file")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a YAML-configured cluster")
+    sp.add_argument("config_file")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("drain-node", help="gracefully drain one node")
+    sp.add_argument("node_id")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("start", help="start head or join a cluster")
     sp.add_argument("--head", action="store_true")
