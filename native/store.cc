@@ -18,10 +18,12 @@
 // Exposed as a C ABI consumed from Python via ctypes
 // (ray_tpu/_private/native_store.py).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
 #include <cerrno>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -97,12 +99,19 @@ uint32_t hash_id(const uint8_t* id) {
   return static_cast<uint32_t>(h ^ (h >> 32));
 }
 
+struct Handle;
+void recover_arena(Handle* h);
+
+// Robust lock: when a previous holder died INSIDE the critical section
+// (EOWNERDEAD), pthread_mutex_consistent alone is not enough — the
+// victim may have torn the free list (mid alloc/free list edit) or the
+// pin protocol (pins++ published, pin record not yet written: a pin the
+// crash sweep can never find — found by the TSAN hammer, store_hammer.cc).
+// The new owner REBUILDS derived state from the object index, the single
+// source of truth, before proceeding.
 class MutexGuard {
  public:
-  explicit MutexGuard(pthread_mutex_t* m) : m_(m) {
-    int rc = pthread_mutex_lock(m_);
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(m_);
-  }
+  explicit MutexGuard(Handle* h);
   ~MutexGuard() { pthread_mutex_unlock(m_); }
  private:
   pthread_mutex_t* m_;
@@ -258,6 +267,114 @@ void free_block(Handle* h, uint64_t data_off) {
   }
 }
 
+// Drop pin records of dead pids (few records; kill(pid, 0) is cheap),
+// then — when no pin ever overflowed the table — make every SEALED
+// entry's pin count equal its live-record count.  The pin table and the
+// counts can only disagree after a crash tore the get/release critical
+// section; the records (written by live processes, dead ones removed
+// here) are the recoverable truth.  Creating-state entries keep their
+// creator pin (never in the table).
+int reconcile_pins(ArenaHeader* hdr) {
+  int fixed = 0;
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    PinRecord* r = &hdr->pin_records[i];
+    if (r->pid > 0 && kill(r->pid, 0) != 0 && errno == ESRCH) {
+      IndexEntry* e = find_slot(hdr, r->id, false);
+      if (e && e->pins > 0) e->pins--;
+      r->pid = -1;
+      fixed++;
+    }
+  }
+  if (hdr->pin_overflow != 0) return fixed;   // untracked pins exist
+  std::vector<uint32_t> counts(kIndexSlots, 0);
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    PinRecord* r = &hdr->pin_records[i];
+    if (r->pid <= 0) continue;
+    IndexEntry* e = find_slot(hdr, r->id, false);
+    if (e) counts[e - hdr->index]++;
+  }
+  for (uint32_t i = 0; i < kIndexSlots; i++) {
+    IndexEntry* e = &hdr->index[i];
+    if (e->state == 2 && e->pins != counts[i]) {
+      e->pins = counts[i];
+      fixed++;
+    }
+  }
+  return fixed;
+}
+
+// Crash recovery after EOWNERDEAD: the victim may have died mid list
+// edit.  The object INDEX is the single source of truth (rt_store_alloc
+// publishes the index entry only after the block ops complete, so an
+// entry always points at a consistent block header); everything derived
+// — the free list, used_bytes, num_objects, the pin counts — rebuilds
+// from it.  Space a victim carved but never published simply returns to
+// the free list.
+void recover_arena(Handle* h) {
+  ArenaHeader* hdr = h->hdr;
+  std::vector<uint64_t> blocks;        // block-header offsets, live objects
+  uint64_t used = 0, nobj = 0;
+  for (uint32_t i = 0; i < kIndexSlots; i++) {
+    IndexEntry* e = &hdr->index[i];
+    if (e->state == 1 || e->state == 2) {
+      blocks.push_back(e->offset - sizeof(BlockHeader));
+      nobj++;
+    }
+  }
+  std::sort(blocks.begin(), blocks.end());
+  hdr->free_head = 0;
+  uint64_t cursor = hdr->data_start;
+  uint64_t prev_free = 0;
+  uint64_t prev_alloc = 0;             // last allocated block offset
+  auto lay_free = [&](uint64_t off, uint64_t end_off) {
+    uint64_t gap = end_off - off;
+    if (gap >= sizeof(BlockHeader) + kAlign) {
+      BlockHeader* f = block_at(h, off);
+      f->size = gap - sizeof(BlockHeader);
+      f->is_free = 1;
+      f->next_free = 0;
+      if (prev_free) block_at(h, prev_free)->next_free = off;
+      else hdr->free_head = off;
+      prev_free = off;
+    } else if (gap > 0 && prev_alloc) {
+      // Sub-block sliver: absorb into the preceding allocated block so
+      // no byte goes permanently unreachable.
+      block_at(h, prev_alloc)->size += gap;
+      used += gap;
+    }
+  };
+  for (uint64_t boff : blocks) {
+    lay_free(cursor, boff);
+    BlockHeader* b = block_at(h, boff);
+    b->is_free = 0;
+    b->next_free = 0;
+    used += b->size + sizeof(BlockHeader);
+    prev_alloc = boff;
+    cursor = boff + sizeof(BlockHeader) + b->size;
+  }
+  lay_free(cursor, h->mapped_size);
+  hdr->used_bytes = used;
+  hdr->num_objects = nobj;
+  // Pin table: compact live records into a fresh layout (a victim could
+  // die mid tombstone-compaction, breaking probe chains), then heal the
+  // counts.
+  std::vector<PinRecord> saved(hdr->pin_records,
+                               hdr->pin_records + kPinSlots);
+  std::memset(hdr->pin_records, 0, sizeof(hdr->pin_records));
+  for (uint32_t i = 0; i < kPinSlots; i++) {
+    if (saved[i].pid > 0) pin_record_add(hdr, saved[i].id, saved[i].pid);
+  }
+  reconcile_pins(hdr);
+}
+
+MutexGuard::MutexGuard(Handle* h) : m_(&h->hdr->mutex) {
+  int rc = pthread_mutex_lock(m_);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(m_);
+    recover_arena(h);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -344,7 +461,7 @@ void* rt_store_open(const char* name) {
 // Object is left in "creating" state until rt_store_seal.
 uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* existing = find_slot(h->hdr, id, false);
   if (existing && existing->state != 3) return 0;  // already present
   // No implicit eviction: every sealed object is referenced (owners
@@ -371,7 +488,7 @@ uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
 // block and tombstone the entry.
 int rt_store_abort(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state != 1) return -1;
   free_block(h, e->offset);
@@ -382,7 +499,7 @@ int rt_store_abort(void* hv, const uint8_t* id) {
 
 int rt_store_seal(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state != 1) return -1;
   e->state = 2;
@@ -394,7 +511,7 @@ int rt_store_seal(void* hv, const uint8_t* id) {
 int rt_store_get(void* hv, const uint8_t* id, uint64_t* offset,
                  uint64_t* size) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state != 2) return 0;
   e->pins++;
@@ -410,7 +527,7 @@ int rt_store_get(void* hv, const uint8_t* id, uint64_t* offset,
 int rt_store_peek(void* hv, const uint8_t* id, uint64_t* offset,
                   uint64_t* size) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state != 1) return 0;
   *offset = e->offset;
@@ -420,14 +537,14 @@ int rt_store_peek(void* hv, const uint8_t* id, uint64_t* offset,
 
 int rt_store_contains(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   return (e && e->state == 2) ? 1 : 0;
 }
 
 void rt_store_release(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (e && e->pins > 0) e->pins--;
   pin_record_remove(h->hdr, id, static_cast<int32_t>(getpid()));
@@ -437,20 +554,11 @@ void rt_store_release(void* hv, const uint8_t* id) {
 // periodically by the node agent; returns the number of pins reclaimed.
 int rt_store_sweep_dead(void* hv) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
-  int reclaimed = 0;
-  for (uint32_t i = 0; i < kPinSlots; i++) {
-    PinRecord* r = &h->hdr->pin_records[i];
-    if (r->pid <= 0) continue;
-    if (kill(r->pid, 0) != 0 && errno == ESRCH) {
-      IndexEntry* e = find_slot(h->hdr, r->id, false);
-      if (e && e->pins > 0) e->pins--;
-      // Tombstone (not free): this slot may sit mid-probe-chain for a
-      // colliding live record.
-      r->pid = -1;
-      reclaimed++;
-    }
-  }
+  MutexGuard g(h);
+  // Dead-pid record removal + count healing (shared with EOWNERDEAD
+  // recovery): also repairs pins whose holder died INSIDE the get
+  // critical section before writing its record.
+  int reclaimed = reconcile_pins(h->hdr);
   for (uint32_t i = 0; i < kIndexSlots; i++) {
     IndexEntry* e = &h->hdr->index[i];
     if (e->state == 1 && e->creator_pid > 0 &&
@@ -466,7 +574,7 @@ int rt_store_sweep_dead(void* hv) {
 
 int rt_store_delete(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* e = find_slot(h->hdr, id, false);
   if (!e || e->state == 3) return 0;
   if (e->pins > 0) return -1;  // pinned: caller retries later
@@ -480,7 +588,7 @@ int rt_store_delete(void* hv, const uint8_t* id) {
 // or 0 if none.  The caller copies it out (get+release) then deletes.
 int rt_store_oldest(void* hv, uint8_t* out_id) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   IndexEntry* victim = nullptr;
   for (uint32_t i = 0; i < kIndexSlots; i++) {
     IndexEntry* e = &h->hdr->index[i];
@@ -497,7 +605,7 @@ int rt_store_oldest(void* hv, uint8_t* out_id) {
 void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
                     uint64_t* num_objects) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   *used = h->hdr->used_bytes;
   *capacity = h->hdr->capacity;
   *num_objects = h->hdr->num_objects;
@@ -505,7 +613,7 @@ void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
 
 uint64_t rt_store_pin_overflow(void* hv) {
   Handle* h = static_cast<Handle*>(hv);
-  MutexGuard g(&h->hdr->mutex);
+  MutexGuard g(h);
   return h->hdr->pin_overflow;
 }
 

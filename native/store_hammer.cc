@@ -1,0 +1,249 @@
+// Multi-process / multi-thread hammer for the shm arena (native/store.cc),
+// built under TSAN/ASAN by tests/test_store_sanitize.py.
+//
+// The reference leans on TSAN CI for its plasma store (SURVEY §5 race
+// row); this serverless arena's equivalent risk surface is the in-arena
+// robust mutex, the pin table, and the crash sweep.  The hammer drives
+// exactly those paths:
+//   - N writer processes x T threads: alloc → fill pattern → seal (or
+//     abort), then delete old generations (retrying while pinned).
+//   - N reader processes x T threads: get random live ids, VERIFY the
+//     fill pattern while pinned (a delete/overwrite racing a pin would
+//     corrupt it), release.
+//   - The orchestrator SIGKILLs readers mid-pin and fork-replaces them,
+//     sweeping dead pins concurrently (rt_store_sweep_dead).
+// Exit 0 = clean; 65 = data corruption; TSAN/ASAN report exits with the
+// sanitizer's own exitcode (the test sets exitcode=66).
+//
+// usage: store_hammer orchestrate <shm> <writers> <readers> <seconds>
+//        store_hammer writer <shm> <widx> <seconds>
+//        store_hammer reader <shm> <nwriters> <seconds>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+void* rt_store_create(const char* name, uint64_t capacity);
+void* rt_store_open(const char* name);
+uint64_t rt_store_alloc(void* h, const uint8_t* id, uint64_t size);
+int rt_store_seal(void* h, const uint8_t* id);
+int rt_store_abort(void* h, const uint8_t* id);
+int rt_store_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size);
+int rt_store_contains(void* h, const uint8_t* id);
+void rt_store_release(void* h, const uint8_t* id);
+int rt_store_delete(void* h, const uint8_t* id);
+int rt_store_sweep_dead(void* h);
+int rt_store_oldest(void* h, uint8_t* out_id);
+void rt_store_stats(void* h, uint64_t* used, uint64_t* cap, uint64_t* n);
+uint8_t* rt_store_base(void* h);
+void rt_store_close(void* h);
+int rt_store_unlink(const char* name);
+}
+
+namespace {
+
+constexpr uint64_t kCapacity = 32ull << 20;
+constexpr int kGenerations = 8;     // live ids per (writer, thread)
+constexpr int kThreads = 3;
+
+// id = [writer_idx, thread_idx, generation, 0.., tag] — deterministic so
+// readers can guess live ids without any side channel.
+void make_id(uint8_t id[16], int widx, int tidx, int gen) {
+  std::memset(id, 0, 16);
+  id[0] = static_cast<uint8_t>(widx + 1);
+  id[1] = static_cast<uint8_t>(tidx + 1);
+  id[2] = static_cast<uint8_t>(gen + 1);
+  id[15] = 0x5a;
+}
+
+uint8_t fill_byte(const uint8_t id[16], uint64_t pos) {
+  return static_cast<uint8_t>(id[0] * 31 + id[1] * 17 + id[2] * 7 + pos);
+}
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+void writer_thread(void* h, int widx, int tidx, double deadline,
+                   std::atomic<int>* failures) {
+  unsigned seed = widx * 1000 + tidx;
+  int gen = 0;
+  while (now_s() < deadline) {
+    uint8_t id[16];
+    make_id(id, widx, tidx, gen % kGenerations);
+    // Delete the previous occupant of this generation slot (may be
+    // pinned by a reader — retry bounded, then move on; the pin either
+    // releases or its holder gets SIGKILLed and swept).
+    for (int tries = 0; tries < 50; tries++) {
+      int rc = rt_store_delete(h, id);
+      if (rc == 0) break;
+      usleep(1000);
+    }
+    uint64_t size = 256 + (rand_r(&seed) % 4096);
+    uint64_t off = rt_store_alloc(h, id, size);
+    if (off == 0) { gen++; continue; }   // full or still present
+    uint8_t* base = rt_store_base(h);
+    for (uint64_t i = 0; i < size; i++) base[off + i] = fill_byte(id, i);
+    if (rand_r(&seed) % 16 == 0) {
+      rt_store_abort(h, id);
+    } else if (rt_store_seal(h, id) != 0) {
+      failures->fetch_add(1);
+    }
+    gen++;
+  }
+}
+
+void reader_thread(void* h, int nwriters, int tidx, double deadline,
+                   std::atomic<int>* failures) {
+  unsigned seed = getpid() * 7 + tidx;
+  while (now_s() < deadline) {
+    uint8_t id[16];
+    make_id(id, rand_r(&seed) % nwriters, rand_r(&seed) % kThreads,
+            rand_r(&seed) % kGenerations);
+    uint64_t off = 0, size = 0;
+    if (!rt_store_get(h, id, &off, &size)) continue;
+    // While pinned the pattern must hold even as writers churn other
+    // generations and deletes retry against THIS one.
+    uint8_t* base = rt_store_base(h);
+    for (uint64_t i = 0; i < size; i += 97) {
+      if (base[off + i] != fill_byte(id, i)) {
+        failures->fetch_add(1);
+        break;
+      }
+    }
+    usleep(rand_r(&seed) % 2000);   // hold the pin across writer churn
+    rt_store_release(h, id);
+  }
+}
+
+int run_writer(const char* shm, int widx, double seconds) {
+  void* h = rt_store_open(shm);
+  if (!h) return 64;
+  std::atomic<int> failures{0};
+  double deadline = now_s() + seconds;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++)
+    ts.emplace_back(writer_thread, h, widx, t, deadline, &failures);
+  for (auto& t : ts) t.join();
+  rt_store_close(h);
+  return failures.load() ? 65 : 0;
+}
+
+int run_reader(const char* shm, int nwriters, double seconds) {
+  void* h = rt_store_open(shm);
+  if (!h) return 64;
+  std::atomic<int> failures{0};
+  double deadline = now_s() + seconds;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++)
+    ts.emplace_back(reader_thread, h, nwriters, t, deadline, &failures);
+  for (auto& t : ts) t.join();
+  rt_store_close(h);
+  return failures.load() ? 65 : 0;
+}
+
+pid_t spawn(const char* self, const char* mode, const char* shm,
+            int arg, double seconds) {
+  pid_t pid = fork();
+  if (pid == 0) {
+    char a[32], s[32];
+    snprintf(a, sizeof a, "%d", arg);
+    snprintf(s, sizeof s, "%.1f", seconds);
+    execl(self, self, mode, shm, a, s, (char*)nullptr);
+    _exit(63);
+  }
+  return pid;
+}
+
+int run_orchestrate(const char* self, const char* shm, int writers,
+                    int readers, double seconds) {
+  rt_store_unlink(shm);
+  void* h = rt_store_create(shm, kCapacity);
+  if (!h) return 64;
+  std::vector<pid_t> wpids, rpids;
+  for (int w = 0; w < writers; w++)
+    wpids.push_back(spawn(self, "writer", shm, w, seconds));
+  for (int r = 0; r < readers; r++)
+    rpids.push_back(spawn(self, "reader", shm, writers, seconds));
+
+  // Chaos + sweep loop: SIGKILL a reader mid-pin, fork a replacement,
+  // sweep the dead pid's pins.  This is the crash-sweep path that a torn
+  // pin table would corrupt for every later reader on the host.
+  unsigned seed = 42;
+  double deadline = now_s() + seconds;
+  while (now_s() < deadline) {
+    usleep(200 * 1000);
+    int victim = rand_r(&seed) % rpids.size();
+    kill(rpids[victim], SIGKILL);
+    waitpid(rpids[victim], nullptr, 0);
+    rt_store_sweep_dead(h);
+    rpids[victim] = spawn(self, "reader", shm, writers,
+                          deadline - now_s() + 0.1);
+  }
+
+  int rc = 0;
+  for (pid_t p : wpids) {
+    int st = 0;
+    waitpid(p, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0)
+      rc = WIFEXITED(st) ? WEXITSTATUS(st) : 65;
+  }
+  for (pid_t p : rpids) {
+    int st = 0;
+    waitpid(p, &st, 0);
+    if (WIFEXITED(st) && WEXITSTATUS(st) != 0 && rc == 0)
+      rc = WEXITSTATUS(st);
+  }
+  // Everyone is gone: after a final sweep, every object must be
+  // deletable (no stranded pins) and the arena must drain to empty.
+  rt_store_sweep_dead(h);
+  for (int w = 0; w < writers; w++)
+    for (int t = 0; t < kThreads; t++)
+      for (int g = 0; g < kGenerations; g++) {
+        uint8_t id[16];
+        make_id(id, w, t, g);
+        if (rt_store_contains(h, id) && rt_store_delete(h, id) != 0) {
+          fprintf(stderr, "stranded pin on %d/%d/%d\n", w, t, g);
+          if (rc == 0) rc = 65;
+        }
+      }
+  uint64_t used = 0, cap = 0, n = 0;
+  rt_store_stats(h, &used, &cap, &n);
+  if (n != 0) {
+    fprintf(stderr, "arena not drained: %llu objects, %llu bytes\n",
+            (unsigned long long)n, (unsigned long long)used);
+    if (rc == 0) rc = 65;
+  }
+  rt_store_close(h);
+  rt_store_unlink(shm);
+  return rc;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) return 62;
+  std::string mode = argv[1];
+  const char* shm = argv[2];
+  if (mode == "orchestrate" && argc >= 6)
+    return run_orchestrate(argv[0], shm, atoi(argv[3]), atoi(argv[4]),
+                           atof(argv[5]));
+  if (mode == "writer" && argc >= 5)
+    return run_writer(shm, atoi(argv[3]), atof(argv[4]));
+  if (mode == "reader" && argc >= 5)
+    return run_reader(shm, atoi(argv[3]), atof(argv[4]));
+  return 62;
+}

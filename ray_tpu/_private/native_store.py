@@ -23,8 +23,10 @@ import weakref
 logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "build",
-                                        "libraytpustore.so"))
+_SAN = os.environ.get("RAYTPU_STORE_SANITIZE", "")
+_SO_PATH = os.path.abspath(os.path.join(
+    _NATIVE_DIR, "build",
+    f"libraytpustore_{_SAN}.so" if _SAN else "libraytpustore.so"))
 _CC_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "store.cc"))
 
 _lib = None
@@ -46,8 +48,22 @@ def build_native_lib(src: str, out: str, extra_flags: list[str]) -> str:
     return out
 
 
+SANITIZE_FLAGS = {
+    # -O1 keeps stacks honest for reports; the robust-mutex/pin-table
+    # code is where silent races would live (SURVEY §5 sanitizer row).
+    "tsan": ["-fsanitize=thread", "-O1", "-g"],
+    "asan": ["-fsanitize=address", "-O1", "-g"],
+}
+
+
 def _build_lib() -> None:
-    build_native_lib(_CC_PATH, _SO_PATH, ["-lpthread", "-lrt"])
+    """RAYTPU_STORE_SANITIZE=tsan|asan builds an instrumented variant to
+    a separate path (tests/test_store_sanitize.py builds the standalone
+    hammer binary the same way — a sanitized .so inside an uninstrumented
+    python is not a supported TSAN mode, so the hammer is the real
+    sanitizer entry point; this knob exists for ad-hoc ASAN runs)."""
+    flags = SANITIZE_FLAGS.get(_SAN, [])
+    build_native_lib(_CC_PATH, _SO_PATH, [*flags, "-lpthread", "-lrt"])
 
 
 def load_lib():

@@ -1,0 +1,78 @@
+"""Sanitizer hammer for the shm arena (native/store.cc).
+
+The reference's plasma/raylet concurrency is guarded by TSAN CI (SURVEY
+§5); this arena's equivalent risk surface — the in-arena robust mutex,
+the pid-attributed pin table, and the crash sweep — is exercised here by
+a standalone hammer binary (native/store_hammer.cc) compiled WHOLE with
+-fsanitize=thread: writers churn generations while readers verify fill
+patterns under pins, and the orchestrator SIGKILLs readers (sometimes
+mid-mutex — the EOWNERDEAD/consistent path) and sweeps their pins.
+A sanitizer report fails the run via exitcode=66; pattern corruption or
+a stranded pin exits 65.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+def _build_hammer(san: str) -> str | None:
+    from ray_tpu._private.native_store import SANITIZE_FLAGS
+
+    out = os.path.join(_NATIVE, "build", f"store_hammer_{san}")
+    src = os.path.join(_NATIVE, "store_hammer.cc")
+    store = os.path.join(_NATIVE, "store.cc")
+    try:
+        # Not a shared lib: the hammer links store.cc directly so every
+        # frame is instrumented (a sanitized .so dlopen'd into plain
+        # python is not a supported TSAN configuration).
+        import fcntl
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            newest = max(os.path.getmtime(src), os.path.getmtime(store))
+            if not (os.path.exists(out)
+                    and os.path.getmtime(out) >= newest):
+                cmd = ["g++", "-std=c++17", *SANITIZE_FLAGS[san],
+                       "-o", out + ".tmp", src, store,
+                       "-lpthread", "-lrt"]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=300)
+                os.replace(out + ".tmp", out)
+        return out
+    except (subprocess.CalledProcessError, OSError):
+        return None
+
+
+def _run_hammer(san: str, env_extra: dict) -> None:
+    binary = _build_hammer(san)
+    if binary is None:
+        pytest.skip(f"toolchain cannot build -fsanitize={san}")
+    shm = f"rthammer_{san}_{os.getpid()}"
+    env = {**os.environ, **env_extra}
+    try:
+        proc = subprocess.run(
+            [binary, "orchestrate", shm, "2", "3", "6"],
+            capture_output=True, text=True, timeout=240, env=env)
+    finally:
+        try:
+            os.unlink(f"/dev/shm/{shm}")
+        except OSError:
+            pass
+    assert proc.returncode == 0, (
+        f"hammer rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-4000:]}")
+
+
+def test_hammer_tsan():
+    _run_hammer("tsan", {
+        "TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
+
+
+def test_hammer_asan():
+    _run_hammer("asan", {
+        "ASAN_OPTIONS": "exitcode=66 abort_on_error=0"})
