@@ -110,6 +110,8 @@ class LLMEngine:
             self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
                                                     self.max_len)
         self._buckets = _buckets_for(self.max_len)
+        self._width_buckets = sorted({w for w in (1, 8, max_batch)
+                                      if w <= max_batch})
         self._rng = jax.random.PRNGKey(seed + 1)
 
         # One compiled K-step decode program; cache donated (in-place).
@@ -375,9 +377,12 @@ class LLMEngine:
         bucket = next(b for b in self._buckets
                       if b >= max(len(r.prompt) for _, r in wave))
         # Pad the wave by duplicating the last row: the duplicate writes
-        # the same slot with the same data, so correctness is unaffected
-        # and the wave size stays a single compiled shape.
-        padded_w = self.max_batch
+        # the same slot with the same data, so correctness is unaffected.
+        # Width is BUCKETED (1 / 8 / max_batch), not always max_batch: an
+        # idle single request padded to a 64-wide wave paid 64x the
+        # prefill FLOPs it needed — the round-3 idle-TTFT regression.
+        # Few widths × few length buckets keeps the compile count small.
+        padded_w = next(w for w in self._width_buckets if w >= W)
         tokens = np.zeros((padded_w, bucket), np.int32)
         true_lens = np.ones((padded_w,), np.int32)
         slots = np.zeros((padded_w,), np.int32)
