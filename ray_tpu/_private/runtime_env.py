@@ -1,19 +1,30 @@
-"""Runtime environments: per-task/actor env_vars, code shipping, pip envs.
+"""Runtime environments: a plugin registry of env kinds.
 
-Analog of ray: python/ray/_private/runtime_env/ (working_dir.py,
-py_modules.py, pip.py; provisioning agent under runtime_env/agent/) and
-python/ray/runtime_env/runtime_env.py (the user API).  Collapsed for this
-runtime: the driver packages working_dir / py_modules into a
-content-addressed zip in the controller KV; workers fetch + extract once
-per digest and activate (sys.path + cwd + env vars) around execution.
+Analog of ray: python/ray/_private/runtime_env/plugin.py (every env kind
+— working_dir, py_modules, pip, conda, containers — is a plugin with
+create/modify-context hooks and a per-node URI cache) and
+python/ray/runtime_env/runtime_env.py (the user API).
 
-pip envs are OFFLINE-capable (this machine has no egress): packages
-resolve from a local wheel directory via `pip install --no-index
---find-links <wheel_dir> --target <env>` into a per-hash site directory,
-built once per node under a file lock and cached (ray: pip.py builds a
-per-hash virtualenv; the --target site-dir is the no-network equivalent
-— activation prepends it to sys.path and deactivation evicts the modules
-it provided, so pooled workers stay reusable).
+Each kind is a `RuntimeEnvPlugin`:
+  - `prepare(value, core)`  driver-side: upload/validate, return the
+    msgpack-able wire value carried in task/actor headers;
+  - `fetch(wire, core)`     worker-side, BLOCKING, called off the event
+    loop (prefetch): download/build into the node-local cache;
+  - `activate/deactivate(wire, core, ctx)` around execution: reversible
+    (workers are pooled — the reference instead keys dedicated workers
+    by runtime env, worker_pool.h:159; reversible activation keeps pool
+    reuse with the same isolation semantics).
+
+Built-ins: env_vars, working_dir, py_modules (content-addressed zips in
+the controller KV), pip (OFFLINE: `pip install --no-index --find-links
+<wheel_dir> --target <hash-dir>`, built once per node under flock).
+conda and containers stay absent — this environment has neither a conda
+installation nor a container runtime; the plugin seam is where they
+would land.
+
+Custom kinds ship BY VALUE: `runtime_env={"plugins": [MyPlugin(...)]}`
+cloudpickles the instances into the descriptor, so a plugin defined in
+the driver program works without any worker-side registration.
 """
 from __future__ import annotations
 
@@ -30,35 +41,51 @@ _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
-class RuntimeEnv(dict):
-    """User-facing descriptor (ray: runtime_env/runtime_env.py RuntimeEnv).
+# ------------------------------------------------------------- plugin API
+class RuntimeEnvPlugin:
+    """One environment kind (ray: runtime_env/plugin.py RuntimeEnvPlugin).
 
-    Supported keys: env_vars (dict), working_dir (path), py_modules
-    (list of paths), pip (list of requirements, or
-    {"packages": [...], "wheel_dir": path} for offline resolution).
-    """
+    `name` is the runtime_env dict key the plugin owns; `priority` orders
+    activation (lower first — code paths before env vars, like the
+    reference's plugin priorities)."""
 
-    _KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+    name: str = ""
+    priority: int = 10
 
-    def __init__(self, env_vars: dict | None = None,
-                 working_dir: str | None = None,
-                 py_modules: list | None = None,
-                 pip: list | dict | None = None, **kwargs):
-        unknown = set(kwargs) - self._KEYS
-        if unknown:
-            raise ValueError(
-                f"unsupported runtime_env keys {sorted(unknown)}; "
-                f"supported: {sorted(self._KEYS)}")
-        super().__init__()
-        if env_vars:
-            self["env_vars"] = dict(env_vars)
-        if working_dir:
-            self["working_dir"] = working_dir
-        if py_modules:
-            self["py_modules"] = list(py_modules)
-        if pip:
-            self["pip"] = pip
-        self.update(kwargs)
+    def prepare(self, value, core):
+        """Driver-side: validate/upload; return the wire value."""
+        return value
+
+    def fetch(self, wire, core) -> None:
+        """Worker-side blocking build/download (off the event loop)."""
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        """Set up around execution; stash undo state in ctx."""
+
+    def deactivate(self, wire, core, ctx: dict) -> None:
+        """Undo activate (pooled workers must come back clean)."""
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 20         # after code paths: values may reference them
+
+    def prepare(self, value, core):
+        return {str(k): str(v) for k, v in (value or {}).items()}
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        saved: dict[str, str | None] = {}
+        for k, v in (wire or {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        ctx["saved_env"] = saved
+
+    def deactivate(self, wire, core, ctx: dict) -> None:
+        for k, old in ctx.get("saved_env", {}).items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
 
 
 def _zip_dir(path: str) -> bytes:
@@ -79,47 +106,81 @@ def _zip_dir(path: str) -> bytes:
     return buf.getvalue()
 
 
-def prepare(runtime_env: dict | None, core) -> dict | None:
-    """Driver-side: upload code packages, return the wire descriptor
-    (ray: runtime-env URI creation + GCS package upload)."""
-    if not runtime_env:
-        return None
-    desc: dict = {}
-    if runtime_env.get("env_vars"):
-        desc["env_vars"] = {str(k): str(v)
-                            for k, v in runtime_env["env_vars"].items()}
-    packages = []
-    paths = []
-    if runtime_env.get("working_dir"):
-        paths.append(("working_dir", runtime_env["working_dir"]))
-    for p in runtime_env.get("py_modules", ()):
-        paths.append(("py_module", p))
-    for kind, p in paths:
-        blob = _zip_dir(p)
-        digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
-        core.call(core.controller_addr, "kv_put",
-                  {"ns": "pkg", "key": digest}, [blob], timeout=120.0)
-        packages.append({"kind": kind, "digest": digest,
-                         "name": os.path.basename(os.path.abspath(p))})
-    if packages:
-        desc["packages"] = packages
-    pip_spec = runtime_env.get("pip")
-    if pip_spec:
-        if isinstance(pip_spec, dict):
-            reqs = sorted(pip_spec.get("packages", ()))
-            wheel_dir = pip_spec.get("wheel_dir")
-        else:
-            reqs = sorted(pip_spec)
-            wheel_dir = None
-        wheel_dir = wheel_dir or os.environ.get("RAY_TPU_WHEEL_DIR")
-        if not wheel_dir:
-            raise ValueError(
-                "pip runtime_env needs a local wheel source (no egress): "
-                'pass {"pip": {"packages": [...], "wheel_dir": ...}} or '
-                "set RAY_TPU_WHEEL_DIR")
-        desc["pip"] = {"packages": reqs,
-                       "wheel_dir": os.path.abspath(wheel_dir)}
-    return desc or None
+def _upload_dir(kind: str, path: str, core) -> dict:
+    """Content-addressed zip into the controller KV; returns the package
+    record (the URI-cache key is the digest — ray: uri_cache.py)."""
+    blob = _zip_dir(path)
+    digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
+    core.call(core.controller_addr, "kv_put",
+              {"ns": "pkg", "key": digest}, [blob], timeout=120.0)
+    return {"kind": kind, "digest": digest,
+            "name": os.path.basename(os.path.abspath(path))}
+
+
+def _fetch_package(digest: str, core) -> str:
+    """Worker-side: content-addressed fetch + extract (idempotent; ray:
+    per-node runtime-env agent cache)."""
+    target = os.path.join(_EXTRACT_ROOT, digest)
+    marker = os.path.join(target, ".ready")
+    if os.path.exists(marker):
+        return target
+    reply, blobs = core.call(core.controller_addr, "kv_get",
+                             {"ns": "pkg", "key": digest}, timeout=120.0)
+    if not blobs:
+        raise RuntimeError(f"runtime_env package {digest} missing from KV")
+    os.makedirs(target, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(bytes(blobs[0]))) as zf:
+        zf.extractall(target)
+    with open(marker, "w") as f:
+        f.write("ok")
+    return target
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 5
+
+    def prepare(self, value, core):
+        return _upload_dir("working_dir", value, core)
+
+    def fetch(self, wire, core) -> None:
+        _fetch_package(wire["digest"], core)
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        path = _fetch_package(wire["digest"], core)
+        ctx["saved_cwd"] = os.getcwd()
+        sys.path.insert(0, path)
+        ctx.setdefault("added_paths", []).append(path)
+        os.chdir(path)
+
+    def deactivate(self, wire, core, ctx: dict) -> None:
+        os.chdir(ctx.get("saved_cwd", os.getcwd()))
+        for p in ctx.get("added_paths", ()):
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 5
+
+    def prepare(self, value, core):
+        return [_upload_dir("py_module", p, core) for p in (value or ())]
+
+    def fetch(self, wire, core) -> None:
+        for pkg in wire or ():
+            _fetch_package(pkg["digest"], core)
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        for pkg in wire or ():
+            path = _fetch_package(pkg["digest"], core)
+            sys.path.insert(0, path)
+            ctx.setdefault("added_paths", []).append(path)
+
+    def deactivate(self, wire, core, ctx: dict) -> None:
+        for p in ctx.get("added_paths", ()):
+            with contextlib.suppress(ValueError):
+                sys.path.remove(p)
 
 
 def _pip_env_hash(pip_desc: dict) -> str:
@@ -173,91 +234,197 @@ def _ensure_pip_env(pip_desc: dict) -> str:
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-def _fetch_package(digest: str, core) -> str:
-    """Worker-side: content-addressed fetch + extract (idempotent; ray:
-    per-node runtime-env agent cache)."""
-    target = os.path.join(_EXTRACT_ROOT, digest)
-    marker = os.path.join(target, ".ready")
-    if os.path.exists(marker):
-        return target
-    reply, blobs = core.call(core.controller_addr, "kv_get",
-                             {"ns": "pkg", "key": digest}, timeout=120.0)
-    if not blobs:
-        raise RuntimeError(f"runtime_env package {digest} missing from KV")
-    os.makedirs(target, exist_ok=True)
-    with zipfile.ZipFile(io.BytesIO(bytes(blobs[0]))) as zf:
-        zf.extractall(target)
-    with open(marker, "w") as f:
-        f.write("ok")
-    return target
+class PipPlugin(RuntimeEnvPlugin):
+    name = "pip"
+    priority = 8          # before env_vars, after code dirs
+
+    def prepare(self, value, core):
+        if isinstance(value, dict):
+            reqs = sorted(value.get("packages", ()))
+            wheel_dir = value.get("wheel_dir")
+        else:
+            reqs = sorted(value)
+            wheel_dir = None
+        wheel_dir = wheel_dir or os.environ.get("RAY_TPU_WHEEL_DIR")
+        if not wheel_dir:
+            raise ValueError(
+                "pip runtime_env needs a local wheel source (no egress): "
+                'pass {"pip": {"packages": [...], "wheel_dir": ...}} or '
+                "set RAY_TPU_WHEEL_DIR")
+        return {"packages": reqs, "wheel_dir": os.path.abspath(wheel_dir)}
+
+    def fetch(self, wire, core) -> None:
+        _ensure_pip_env(wire)
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        path = _ensure_pip_env(wire)
+        sys.path.insert(0, path)
+        ctx["pip_path"] = path
+        ctx["mods_before"] = set(sys.modules)
+        import importlib
+
+        importlib.invalidate_caches()
+
+    def deactivate(self, wire, core, ctx: dict) -> None:
+        path = ctx.get("pip_path")
+        if path is None:
+            return
+        with contextlib.suppress(ValueError):
+            sys.path.remove(path)
+        # Evict modules the pip env provided so the NEXT task in this
+        # pooled worker doesn't see them.
+        for name in list(set(sys.modules) - ctx.get("mods_before", set())):
+            mod = sys.modules.get(name)
+            origin = getattr(mod, "__file__", "") or ""
+            if origin.startswith(path):
+                del sys.modules[name]
+        import importlib
+
+        importlib.invalidate_caches()
+
+
+_BUILTINS: dict[str, RuntimeEnvPlugin] = {
+    p.name: p for p in (EnvVarsPlugin(), WorkingDirPlugin(),
+                        PyModulesPlugin(), PipPlugin())
+}
+
+# Driver-side registry for additional kinds usable by dict key
+# (ray: RAY_RUNTIME_ENV_PLUGINS class-path registration).
+_registered: dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name or plugin.name in _BUILTINS:
+        raise ValueError(f"invalid plugin name {plugin.name!r}")
+    _registered[plugin.name] = plugin
+
+
+class RuntimeEnv(dict):
+    """User-facing descriptor (ray: runtime_env/runtime_env.py RuntimeEnv).
+
+    Built-in keys: env_vars (dict), working_dir (path), py_modules (list
+    of paths), pip (list of requirements, or {"packages": [...],
+    "wheel_dir": path} for offline resolution).  `plugins` takes a list
+    of RuntimeEnvPlugin INSTANCES; registered plugin names are accepted
+    as extra keys."""
+
+    def __init__(self, env_vars: dict | None = None,
+                 working_dir: str | None = None,
+                 py_modules: list | None = None,
+                 pip: list | dict | None = None,
+                 plugins: list | None = None, **kwargs):
+        unknown = set(kwargs) - set(_registered)
+        if unknown:
+            raise ValueError(
+                f"unsupported runtime_env keys {sorted(unknown)}; "
+                f"supported: {sorted(set(_BUILTINS) | set(_registered))} "
+                "+ plugins=[...]")
+        super().__init__()
+        if env_vars:
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        if pip:
+            self["pip"] = pip
+        if plugins:
+            self["plugins"] = list(plugins)
+        self.update(kwargs)
+
+
+# ---------------------------------------------------------- entry points
+def prepare(runtime_env: dict | None, core) -> dict | None:
+    """Driver-side: run every kind's plugin, return the wire descriptor
+    (ray: runtime-env URI creation + GCS package upload).  Wire format:
+    built-ins keep their own keys ("packages" merges working_dir +
+    py_modules for back-compat); instance plugins ride "__plugins__" as
+    cloudpickle blobs — defined-in-driver plugins work with no
+    worker-side registration."""
+    if not runtime_env:
+        return None
+    desc: dict = {}
+    packages: list[dict] = []
+    for key, value in runtime_env.items():
+        if key == "plugins":
+            continue
+        plugin = _BUILTINS.get(key) or _registered.get(key)
+        if plugin is None:
+            raise ValueError(f"unsupported runtime_env key {key!r}")
+        wire = plugin.prepare(value, core)
+        if key == "working_dir":
+            packages.append(wire)
+        elif key == "py_modules":
+            packages.extend(wire)
+        elif key in _BUILTINS:
+            if wire:
+                desc[key] = wire
+        else:
+            # Registered-by-name plugin: ship instance + wire by value.
+            import cloudpickle
+
+            desc.setdefault("__plugins__", []).append(
+                cloudpickle.dumps((plugin, wire)))
+    if packages:
+        desc["packages"] = packages
+    for plugin in runtime_env.get("plugins", ()):
+        import cloudpickle
+
+        wire = plugin.prepare(runtime_env.get(plugin.name), core)
+        desc.setdefault("__plugins__", []).append(
+            cloudpickle.dumps((plugin, wire)))
+    return desc or None
+
+
+def _desc_plugins(desc: dict) -> list[tuple[RuntimeEnvPlugin, object]]:
+    """(plugin, wire) pairs for one descriptor, activation-ordered."""
+    out: list[tuple[RuntimeEnvPlugin, object]] = []
+    for pkg in desc.get("packages", ()):
+        plugin = _BUILTINS["working_dir" if pkg["kind"] == "working_dir"
+                           else "py_modules"]
+        wire = pkg if pkg["kind"] == "working_dir" else [pkg]
+        out.append((plugin, wire))
+    for key in ("pip", "env_vars"):
+        if desc.get(key) is not None:
+            out.append((_BUILTINS[key], desc[key]))
+    for blob in desc.get("__plugins__", ()):
+        import pickle
+
+        plugin, wire = pickle.loads(blob)
+        out.append((plugin, wire))
+    out.sort(key=lambda pw: pw[0].priority)
+    return out
 
 
 def prefetch(desc: dict | None, core) -> None:
     """Blocking fetch/build of everything in the descriptor.  MUST be
     called off the event loop (run_in_executor) before activating a
-    runtime env on the loop thread (async actors): _fetch_package's
-    core.call blocks on the loop, so calling it from the loop deadlocks
-    the worker.  (pip builds also run subprocesses — same rule.)"""
-    for pkg in (desc or {}).get("packages", ()):
-        _fetch_package(pkg["digest"], core)
-    if (desc or {}).get("pip"):
-        _ensure_pip_env(desc["pip"])
+    runtime env on the loop thread (async actors): fetches block on
+    controller RPCs and pip builds run subprocesses."""
+    for plugin, wire in _desc_plugins(desc or {}):
+        plugin.fetch(wire, core)
 
 
 @contextlib.contextmanager
 def activate(desc: dict | None, core):
-    """Worker-side activation around execution: env vars set/restored,
-    packages on sys.path (working_dir also becomes cwd).  Worker processes
-    are pooled, so activation must be reversible (the reference instead
-    dedicates workers per runtime env — worker_pool.h:159 runtime-env-keyed
-    pooling; that isolation level is a TODO here)."""
+    """Worker-side activation around execution, reversible in LIFO order
+    (workers are pooled)."""
     if not desc:
         yield
         return
-    saved_env: dict[str, str | None] = {}
-    added_paths: list[str] = []
-    saved_cwd = os.getcwd()
-    pip_path: str | None = None
-    mods_before: set[str] | None = None
+    done: list[tuple[RuntimeEnvPlugin, object, dict]] = []
     try:
-        for k, v in (desc.get("env_vars") or {}).items():
-            saved_env[k] = os.environ.get(k)
-            os.environ[k] = v
-        for pkg in desc.get("packages", ()):
-            path = _fetch_package(pkg["digest"], core)
-            sys.path.insert(0, path)
-            added_paths.append(path)
-            if pkg["kind"] == "working_dir":
-                os.chdir(path)
-        if desc.get("pip"):
-            pip_path = _ensure_pip_env(desc["pip"])
-            sys.path.insert(0, pip_path)
-            added_paths.append(pip_path)
-            mods_before = set(sys.modules)
-            import importlib
-
-            importlib.invalidate_caches()
+        for plugin, wire in _desc_plugins(desc):
+            ctx: dict = {}
+            plugin.activate(wire, core, ctx)
+            done.append((plugin, wire, ctx))
         yield
     finally:
-        os.chdir(saved_cwd)
-        for p in added_paths:
-            with contextlib.suppress(ValueError):
-                sys.path.remove(p)
-        if pip_path is not None and mods_before is not None:
-            # Evict modules the pip env provided so the NEXT task in this
-            # pooled worker doesn't see them (the reference instead keys
-            # dedicated workers by runtime env — worker_pool.h:159; this
-            # keeps pool reuse while preserving the isolation semantics).
-            for name in list(set(sys.modules) - mods_before):
-                mod = sys.modules.get(name)
-                origin = getattr(mod, "__file__", "") or ""
-                if origin.startswith(pip_path):
-                    del sys.modules[name]
-            import importlib
+        for plugin, wire, ctx in reversed(done):
+            try:
+                plugin.deactivate(wire, core, ctx)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                import logging
 
-            importlib.invalidate_caches()
-        for k, old in saved_env.items():
-            if old is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = old
+                logging.getLogger(__name__).exception(
+                    "runtime_env deactivate failed for %s", plugin.name)

@@ -1,4 +1,6 @@
-"""Public runtime-env API (ray: python/ray/runtime_env/runtime_env.py)."""
-from ray_tpu._private.runtime_env import RuntimeEnv
+"""Public runtime-env API (ray: python/ray/runtime_env/runtime_env.py
++ runtime_env/plugin.py RuntimeEnvPlugin)."""
+from ray_tpu._private.runtime_env import (RuntimeEnv, RuntimeEnvPlugin,
+                                          register_plugin)
 
-__all__ = ["RuntimeEnv"]
+__all__ = ["RuntimeEnv", "RuntimeEnvPlugin", "register_plugin"]
