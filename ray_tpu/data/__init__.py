@@ -8,8 +8,10 @@ iter_jax_batches / streaming_split.
 from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa: F401,E501
-                                  from_items, from_numpy, from_pandas,
-                                  range, read_binary_files, read_csv,
+                                  from_huggingface, from_items,
+                                  from_numpy, from_pandas, range,
+                                  read_avro, read_binary_files, read_csv,
                                   read_images, read_json, read_parquet,
-                                  read_text, read_tfrecords)
+                                  read_sql, read_text, read_tfrecords,
+                                  read_webdataset)
 from ray_tpu.data.iterator import DataIterator  # noqa: F401

@@ -444,3 +444,28 @@ def read_tfrecords(paths, *, parallelism: int = 8,
 
 def from_generators(fns: list) -> Dataset:
     return _read(ds.generator_tasks(fns))
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1
+             ) -> Dataset:
+    """DB-API query → Dataset (ray: read_sql; sqlite3 works out of the
+    box, any DB-API connection factory is accepted)."""
+    return _read(ds.sql_tasks(sql, connection_factory, parallelism))
+
+
+def read_avro(paths, *, parallelism: int = 8) -> Dataset:
+    """Avro object-container files → one row per record (ray:
+    read_avro; pure-python codec — see datasource.avro_tasks)."""
+    return _read(ds.avro_tasks(paths, parallelism))
+
+
+def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
+    """WebDataset tar shards → one row per sample with a bytes column
+    per extension (ray: read_webdataset)."""
+    return _read(ds.webdataset_tasks(paths, parallelism))
+
+
+def from_huggingface(dataset, *, parallelism: int = 8) -> Dataset:
+    """A `datasets.Dataset` (local/in-memory) → Dataset via its arrow
+    table (ray: from_huggingface)."""
+    return _read(ds.huggingface_tasks(dataset, parallelism))

@@ -273,3 +273,285 @@ def write_block(block: Block, path: str, fmt: str, index: int) -> str:
     else:
         raise ValueError(f"unknown write format {fmt!r}")
     return out
+
+
+# ------------------------------------------------------------------- sql
+def sql_tasks(sql: str, connection_factory: Callable[[], Any],
+              parallelism: int = 1) -> list[ReadTask]:
+    """DB-API query → rows (ray: data/_internal/datasource/sql_datasource
+    .py — one task runs the query through a user connection factory;
+    sqlite3 is the stdlib instance, any DB-API driver works)."""
+    def read() -> Iterator[Block]:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        yield _rows_to_table([dict(zip(cols, r)) for r in rows]) if rows \
+            else pa.table({c: [] for c in cols})
+
+    return [read]
+
+
+def write_sql(block: Block, table: str,
+              connection_factory: Callable[[], Any]) -> int:
+    """INSERT one block (ray: Dataset.write_sql)."""
+    cols = block.column_names
+    rows = [tuple(r[c] for c in cols) for r in block.to_pylist()]
+    conn = connection_factory()
+    try:
+        ph = ", ".join(["?"] * len(cols))
+        conn.cursor().executemany(
+            f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})", rows)
+        conn.commit()
+    finally:
+        conn.close()
+    return len(rows)
+
+
+# ------------------------------------------------------------------ avro
+# Minimal Avro Object Container File codec (spec: avro 1.11 binary
+# encoding).  Pure python — no fastavro wheel in this environment; the
+# reference wraps fastavro (data/_internal/datasource/avro_datasource.py)
+# but the container format itself is ~100 lines: zigzag varints, a JSON
+# schema in the header, deflate/null codecs, sync-marker-delimited blocks.
+_AVRO_MAGIC = b"Obj\x01"
+
+
+def _zz_read(buf, pos: int) -> tuple[int, int]:
+    shift = acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+def _zz_write(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_decode(schema, buf, pos: int):
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(schema, list):                      # union
+        idx, pos = _zz_read(buf, pos)
+        return _avro_decode(schema[idx], buf, pos)
+    if t in ("int", "long"):
+        return _zz_read(buf, pos)
+    if t == "null":
+        return None, pos
+    if t == "boolean":
+        return bool(buf[pos]), pos + 1
+    if t == "float":
+        import struct as _s
+        return _s.unpack_from("<f", buf, pos)[0], pos + 4
+    if t == "double":
+        import struct as _s
+        return _s.unpack_from("<d", buf, pos)[0], pos + 8
+    if t in ("bytes", "string"):
+        n, pos = _zz_read(buf, pos)
+        raw = bytes(buf[pos:pos + n])
+        return (raw.decode() if t == "string" else raw), pos + n
+    if t == "fixed":
+        n = schema["size"]
+        return bytes(buf[pos:pos + n]), pos + n
+    if t == "enum":
+        idx, pos = _zz_read(buf, pos)
+        return schema["symbols"][idx], pos
+    if t == "record":
+        out = {}
+        for f in schema["fields"]:
+            out[f["name"]], pos = _avro_decode(f["type"], buf, pos)
+        return out, pos
+    if t == "array":
+        items = []
+        while True:
+            n, pos = _zz_read(buf, pos)
+            if n == 0:
+                return items, pos
+            if n < 0:                  # block with byte size prefix
+                n = -n
+                _, pos = _zz_read(buf, pos)
+            for _ in range(n):
+                v, pos = _avro_decode(schema["items"], buf, pos)
+                items.append(v)
+    if t == "map":
+        out = {}
+        while True:
+            n, pos = _zz_read(buf, pos)
+            if n == 0:
+                return out, pos
+            if n < 0:
+                n = -n
+                _, pos = _zz_read(buf, pos)
+            for _ in range(n):
+                k, pos = _avro_decode("string", buf, pos)
+                out[k], pos = _avro_decode(schema["values"], buf, pos)
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _avro_encode(schema, value) -> bytes:
+    t = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(schema, list):
+        for i, s in enumerate(schema):
+            st = s["type"] if isinstance(s, dict) else s
+            if (value is None) == (st == "null"):
+                return _zz_write(i) + _avro_encode(s, value)
+        raise ValueError("no union branch matched")
+    if t in ("int", "long"):
+        return _zz_write(int(value))
+    if t == "null":
+        return b""
+    if t == "boolean":
+        return bytes([1 if value else 0])
+    if t == "float":
+        import struct as _s
+        return _s.pack("<f", value)
+    if t == "double":
+        import struct as _s
+        return _s.pack("<d", value)
+    if t == "string":
+        raw = value.encode()
+        return _zz_write(len(raw)) + raw
+    if t == "bytes":
+        return _zz_write(len(value)) + bytes(value)
+    if t == "record":
+        return b"".join(_avro_encode(f["type"], value[f["name"]])
+                        for f in schema["fields"])
+    if t == "array":
+        out = b""
+        if value:
+            out += _zz_write(len(value))
+            out += b"".join(_avro_encode(schema["items"], v)
+                            for v in value)
+        return out + _zz_write(0)
+    raise ValueError(f"unsupported avro type for write {t!r}")
+
+
+def avro_tasks(paths, parallelism: int) -> list[ReadTask]:
+    """Avro container files → one row per record."""
+    import json as _json
+    import zlib
+
+    files = _expand_paths(paths, ".avro")
+
+    def one(path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            buf = f.read()
+        if buf[:4] != _AVRO_MAGIC:
+            raise ValueError(f"{path}: not an avro container file")
+        meta, pos = _avro_decode(
+            {"type": "map", "values": "bytes"}, buf, 4)
+        schema = _json.loads(meta["avro.schema"])
+        codec = meta.get("avro.codec", b"null").decode()
+        sync = buf[pos:pos + 16]
+        pos += 16
+        rows = []
+        while pos < len(buf):
+            count, pos = _zz_read(buf, pos)
+            size, pos = _zz_read(buf, pos)
+            body = buf[pos:pos + size]
+            pos += size
+            if buf[pos:pos + 16] != sync:
+                raise ValueError(f"{path}: sync marker mismatch")
+            pos += 16
+            if codec == "deflate":
+                body = zlib.decompress(body, -15)
+            elif codec != "null":
+                raise ValueError(f"{path}: unsupported codec {codec!r}")
+            bpos = 0
+            for _ in range(count):
+                v, bpos = _avro_decode(schema, body, bpos)
+                rows.append(v)
+        yield _rows_to_table(rows)
+
+    return [lambda p=p: one(p) for p in files]
+
+
+def write_avro(rows: list[dict], schema: dict, path: str) -> None:
+    """Write one Avro container file (test/round-trip support)."""
+    import json as _json
+    import os as _os
+
+    sync = _os.urandom(16)
+    body = b"".join(_avro_encode(schema, r) for r in rows)
+    meta = {"avro.schema": _json.dumps(schema).encode(),
+            "avro.codec": b"null"}
+    with open(path, "wb") as f:
+        f.write(_AVRO_MAGIC)
+        f.write(_zz_write(len(meta)))
+        for k, v in meta.items():
+            kk = k.encode()
+            f.write(_zz_write(len(kk)) + kk)
+            f.write(_zz_write(len(v)) + v)
+        f.write(_zz_write(0))
+        f.write(sync)
+        f.write(_zz_write(len(rows)))
+        f.write(_zz_write(len(body)))
+        f.write(body)
+        f.write(sync)
+
+
+# ------------------------------------------------------------ webdataset
+def webdataset_tasks(paths, parallelism: int) -> list[ReadTask]:
+    """WebDataset tar shards → one row per sample (ray:
+    data/_internal/datasource/webdataset_datasource.py).  Files sharing
+    a basename form one sample; each extension becomes a bytes column
+    ("__key__" carries the basename)."""
+    import tarfile
+
+    files = _expand_paths(paths, ".tar")
+
+    def one(path: str) -> Iterator[Block]:
+        samples: dict[str, dict] = {}
+        order: list[str] = []
+        with tarfile.open(path) as tf:
+            for m in tf:
+                if not m.isfile():
+                    continue
+                base, _, ext = m.name.partition(".")
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                samples[base][ext] = tf.extractfile(m).read()
+        yield _rows_to_table([samples[k] for k in order])
+
+    return [lambda p=p: one(p) for p in files]
+
+
+# ----------------------------------------------------------- huggingface
+def huggingface_tasks(dataset, parallelism: int = 8) -> list[ReadTask]:
+    """An in-memory/local `datasets.Dataset` → blocks via its arrow data
+    (ray: data/_internal/datasource/huggingface_datasource.py; works
+    fully offline on locally built/saved datasets — this box has no
+    egress for hub downloads)."""
+    table = dataset.data.table if hasattr(dataset.data, "table") \
+        else dataset.data
+    table = table.combine_chunks()
+    n = max(1, min(parallelism, table.num_rows or 1))
+    chunk = (table.num_rows + n - 1) // n
+    slices = [table.slice(i, chunk)
+              for i in range(0, table.num_rows, chunk)] or [table]
+
+    def mk(t):
+        def read() -> Iterator[Block]:
+            yield t
+
+        return read
+
+    return [mk(t) for t in slices]
