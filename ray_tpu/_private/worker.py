@@ -55,6 +55,11 @@ from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 WorkerCrashedError)
 from ray_tpu.object_ref import ObjectRef, set_release_hook
 
+from ray_tpu._private.actor_state import (ActorInstance,
+                                          ActorSubmitState,
+                                          StreamState)
+from ray_tpu._private.lease_manager import LeaseManager, PendingTask
+
 logger = logging.getLogger(__name__)
 
 _global_worker: "CoreWorker | None" = None
@@ -145,388 +150,6 @@ def _copy_error(e: BaseException) -> BaseException:
         return err
     except Exception:  # noqa: BLE001 - uncopyable exception
         return e
-
-
-@dataclass
-class PendingTask:
-    task_id: bytes
-    header: dict
-    blobs: list[bytes]
-    return_ids: list[bytes]
-    retries_left: int
-    retry_exceptions: bool
-    scheduling_key: tuple
-    # (object_id, owner_addr) pins added at submission for every ref shipped
-    # in the args; released when the reply arrives unless the executing
-    # worker reports the ref still held (ray: reference_count.cc borrows).
-    borrowed: list = field(default_factory=list)
-
-
-class LeaseManager:
-    """Leases workers from node agents and pushes queued tasks to them
-    (ray: NormalTaskSubmitter; lease reuse + rate limiting
-    normal_task_submitter.h:53-72)."""
-
-    def __init__(self, core: "CoreWorker"):
-        self.core = core
-        # scheduling_key -> state
-        self.queues: dict[tuple, list[PendingTask]] = {}
-        self.pushers: dict[tuple, int] = {}
-        self.headers: dict[tuple, dict] = {}
-        self.arrivals: dict[tuple, asyncio.Event] = {}
-
-    def submit(self, task: PendingTask) -> None:
-        q = self.queues.setdefault(task.scheduling_key, [])
-        q.append(task)
-        self.headers[task.scheduling_key] = {
-            "resources": task.header.get("resources", {}),
-            "bundle_key": task.header.get("bundle_key"),
-            "affinity_node_id": task.header.get("affinity_node_id"),
-            "affinity_soft": task.header.get("affinity_soft", False),
-            "label_hard": task.header.get("label_hard"),
-            "label_soft": task.header.get("label_soft"),
-            "submitter": self.core.address,
-        }
-        ev = self.arrivals.get(task.scheduling_key)
-        if ev is not None:
-            ev.set()
-        self._maybe_start_pusher(task.scheduling_key)
-
-    def _maybe_start_pusher(self, key: tuple) -> None:
-        active = self.pushers.get(key, 0)
-        qlen = len(self.queues.get(key, []))
-        limit = self.core.config.max_leases_per_scheduling_key
-        if qlen > 0 and active < min(limit, qlen):
-            self.pushers[key] = active + 1
-            self.core.loop.create_task(self._pusher(key))
-
-    async def _pusher(self, key: tuple) -> None:
-        """One pusher = one lease lifetime: acquire worker, drain queue, and
-        hold the lease briefly when idle so steady task streams reuse the
-        same worker (ray: lease reuse + worker idle timeout)."""
-        lease = None
-        try:
-            lease = await self._acquire_lease(key)
-            if lease is None:
-                return
-            q = self.queues.get(key, [])
-            depth = self.core.config.task_push_pipeline_depth
-            while True:
-                while q:
-                    # Pipeline pushes onto one leased worker to hide the RPC
-                    # round-trip — but never take more than this pusher's
-                    # fair share of the queue, or a fast lease would hoard
-                    # tasks other idle workers could run in parallel (ray:
-                    # NormalTaskSubmitter pipelines per lease with the same
-                    # constraint).
-                    active = max(1, self.pushers.get(key, 1))
-                    fair = -(-len(q) // active)          # ceil division
-                    batch = [q.pop(0)
-                             for _ in range(min(depth, fair, len(q)))]
-                    # One RPC for a whole batch of dependency-free tasks:
-                    # per-message zmq + event-loop overhead is the
-                    # control-plane cost, so coalescing amortizes it N×.
-                    # Tasks WITH top-level ref args never join a batch —
-                    # their arg resolution may need an earlier batch
-                    # member's reply, which only ships when the whole
-                    # batch finishes (deadlock).
-                    def _solo(t):
-                        # Streaming tasks also go solo: their reply waits
-                        # on the LAST item, which would gate every batch
-                        # sibling's reply behind the stream.
-                        return (t.header.get("arg_refs")
-                                or t.header.get("streaming"))
-                    plain = [t for t in batch if not _solo(t)]
-                    dep = [t for t in batch if _solo(t)]
-                    ops = []
-                    if len(plain) == 1:
-                        ops.append(self._push_one(plain[0], lease))
-                    elif plain:
-                        ops.append(self._push_batch(plain, lease))
-                    ops.extend(self._push_one(t, lease) for t in dep)
-                    if len(ops) == 1:
-                        oks = [await ops[0]]
-                    else:
-                        oks = await asyncio.gather(*ops)
-                    if not all(oks):
-                        # Dead lease: abandon it — failed tasks already
-                        # re-queued and will ride a fresh lease (the
-                        # finally block restarts a pusher).
-                        return
-                # Queue drained: only the last surviving pusher lingers.
-                if self.pushers.get(key, 0) > 1:
-                    break
-                ev = self.arrivals.setdefault(key, asyncio.Event())
-                ev.clear()
-                try:
-                    await asyncio.wait_for(
-                        ev.wait(), self.core.config.lease_idle_timeout_s)
-                except asyncio.TimeoutError:
-                    break
-                if not q:
-                    break
-        finally:
-            self.pushers[key] = self.pushers.get(key, 1) - 1
-            if lease is not None:
-                await self._release_lease(lease)
-            # Re-check: tasks may have arrived while we were releasing.
-            self._maybe_start_pusher(key)
-
-    async def _acquire_lease(self, key: tuple) -> dict | None:
-        header = self.headers[key]
-        addr = self.core.agent_addr
-        for _hop in range(8):
-            try:
-                reply, _ = await self.core.clients.get(addr).call(
-                    "request_lease", header, timeout=300.0)
-            except Exception as e:  # noqa: BLE001
-                logger.warning("lease request to %s failed: %s", addr, e)
-                return None
-            if reply.get("granted"):
-                # The agent vouches a live worker holds this address.
-                self.core._revive_addr(reply["worker_addr"])
-                return reply
-            if reply.get("spill_to"):
-                addr = reply["spill_to"]
-                continue
-            if reply.get("unfeasible"):
-                # No node can ever run this with current membership; park the
-                # queue and retry on a timer (cluster may grow).
-                await asyncio.sleep(1.0)
-                addr = self.core.agent_addr
-                continue
-        return None
-
-    async def _release_lease(self, lease: dict) -> None:
-        try:
-            agent = lease.get("agent_addr") or self.core.agent_addr
-            await self.core.clients.get(agent).call(
-                "return_lease", {"lease_id": lease["lease_id"]}, timeout=10.0)
-        except Exception:  # noqa: BLE001
-            pass
-
-    def _dead_addr_error(self, worker_addr: str) -> ConnectionLost | None:
-        """A send to a known-dead worker must fail NOW: zmq would happily
-        open a fresh connection to the dead address and hang forever."""
-        if worker_addr in self.core._oom_worker_addrs:
-            return ConnectionLost(
-                f"{worker_addr}: OOM-killed by the node memory monitor")
-        if worker_addr in self.core._dead_worker_addrs:
-            return ConnectionLost(f"{worker_addr}: worker is dead")
-        return None
-
-    async def _push_one(self, task: PendingTask, lease: dict) -> bool:
-        """Returns False when the lease's worker failed (the caller must
-        abandon the lease — retried tasks re-queue onto a fresh one)."""
-        worker_addr = lease["worker_addr"]
-        err = self._dead_addr_error(worker_addr)
-        if err is None:
-            try:
-                reply, blobs = await self.core.clients.get(
-                    worker_addr).call("push_task", task.header, task.blobs)
-            except (ConnectionLost, RemoteError) as e:
-                err = self._dead_addr_error(worker_addr) or e
-        if err is not None:
-            await self._on_push_failure(task, err)
-            return False
-        self.core._on_task_reply(task, reply, blobs)
-        return True
-
-    async def _push_batch(self, batch: list, lease: dict) -> bool:
-        """Push N tasks in one RPC (worker executes them in order and
-        replies once with all results).  False = dead lease."""
-        worker_addr = lease["worker_addr"]
-        err = self._dead_addr_error(worker_addr)
-        if err is None:
-            blobs: list = []
-            headers = []
-            for t in batch:
-                headers.append({**t.header, "nframes": len(t.blobs)})
-                blobs.extend(t.blobs)
-            try:
-                reply, rblobs = await self.core.clients.get(
-                    worker_addr).call("push_task_batch",
-                                      {"tasks": headers}, blobs)
-            except (ConnectionLost, RemoteError) as e:
-                err = self._dead_addr_error(worker_addr) or e
-        if err is not None:
-            for t in batch:
-                await self._on_push_failure(t, err)
-            return False
-        offset = 0
-        for t, tr in zip(batch, reply["replies"]):
-            n = tr.pop("nblobs")
-            self.core._on_task_reply(t, tr, rblobs[offset:offset + n])
-            offset += n
-        return True
-
-    async def _on_push_failure(self, task: PendingTask, exc: Exception) -> None:
-        """Worker died mid-task: retry if budget remains
-        (ray: TaskManager::FailOrRetryPendingTask task_manager.h:48)."""
-        if task.retries_left > 0:
-            task.retries_left -= 1
-            logger.warning("task %s worker died; retrying (%d left)",
-                           task.task_id.hex()[:12], task.retries_left)
-            self.submit(task)
-        else:
-            err = WorkerCrashedError(
-                f"worker died executing task {task.task_id.hex()[:12]}: {exc}")
-            for rid in task.return_ids:
-                self.core._resolve_error(rid, err)
-            self.core._release_task_borrows(task)
-
-
-@dataclass
-class StreamState:
-    """Owner-side state of one streaming-generator task (ray:
-    ObjectRefGenerator streaming reports, _raylet.pyx:277,1103): item refs
-    appear here as the executing worker ships them, long before the task's
-    final reply."""
-
-    refs: list = field(default_factory=list)      # minted item ObjectRefs
-    total: int | None = None                      # set by the final reply
-    error: BaseException | None = None
-    event: asyncio.Event = field(default_factory=asyncio.Event)
-
-
-@dataclass
-class ActorSubmitState:
-    """Caller-side state for one remote actor (per ActorHandle target)."""
-
-    actor_id: str
-    address: str | None = None
-    seqno: int = 0
-    resolving: asyncio.Future | None = None
-    dead: bool = False
-    death_cause: str = ""
-    # Coalescing outbox: queued calls drain in seqno order, many per RPC.
-    outbox: list = field(default_factory=list)
-    draining: bool = False
-    # Bounds concurrent in-flight batches (created lazily on the loop).
-    send_sem: Any = None
-    # Consecutive sends skipped because the resolved address is dead.
-    stale_spins: int = 0
-    # Seqnos currently inside _send_actor_batch (unacked): min() is the
-    # seq_floor stamped on outgoing batches — the receiver's baseline.
-    inflight_seqs: set = field(default_factory=set)
-
-
-class ActorInstance:
-    """Worker-side hosted actor with ordered per-caller execution."""
-
-    def __init__(self, actor_id: str, instance: Any,
-                 max_concurrency: int | None,
-                 is_async: bool, runtime_env: dict | None = None,
-                 concurrency_groups: dict | None = None,
-                 method_groups: dict | None = None):
-        self.actor_id = actor_id
-        self.instance = instance
-        self.is_async = is_async
-        self.runtime_env = runtime_env
-        # max_concurrency None = not set by the user.  The async DEFAULT
-        # group then gets ray's permissive 1000 bound — binding it to 1
-        # would deadlock previously-safe async self-calls the moment any
-        # named group is declared.
-        self._async_default_limit = max_concurrency or 1000
-        max_concurrency = max_concurrency or 1
-        self.max_concurrency = max_concurrency
-        self.executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max_concurrency,
-            thread_name_prefix=f"actor-{actor_id[:12]}")
-        # Named concurrency groups (ray: concurrency_group_manager.cc):
-        # each group gets its own executor (sync actors) / semaphore
-        # (async actors) so one saturated group never gates another.
-        # The default group is the base executor / max_concurrency.
-        self.concurrency_groups = dict(concurrency_groups or {})
-        self.method_groups = dict(method_groups or {})
-        self.group_executors: dict[str, Any] = {}
-        for name, limit in self.concurrency_groups.items():
-            self.group_executors[name] = \
-                concurrent.futures.ThreadPoolExecutor(
-                    max_workers=max(1, int(limit)),
-                    thread_name_prefix=f"actor-{actor_id[:12]}-{name}")
-        # Async actors: per-group semaphores, created lazily ON the loop.
-        self._group_sems: dict[str, asyncio.Semaphore] = {}
-        # Per-caller ordered delivery (ray: ActorSchedulingQueue seq_nos).
-        self.next_seq: dict[str, int] = {}
-        self.buffered: dict[str, dict[int, tuple]] = {}
-        # (caller, seqno) -> shared reply task: a retransmitted call
-        # (reply lost / retry raced the original) returns the ORIGINAL
-        # execution's reply instead of re-executing — stateful methods
-        # must not run twice because the transport retried.  Bounded
-        # window; a resend older than the window re-executes (the
-        # documented at-least-once fallback).
-        import collections
-
-        self.reply_cache: "collections.OrderedDict[tuple, Any]" = \
-            collections.OrderedDict()
-
-    def cache_reply(self, key: tuple, task) -> None:
-        # Window ≥ the max inflight depth (batch_size × inflight batches
-        # = 1024): a retransmit always targets calls that were in
-        # flight.  Large replies evict on completion — memory stays
-        # bounded and big results fall back to at-least-once.
-        self.reply_cache[key] = task
-        while len(self.reply_cache) > 1024:
-            self.reply_cache.popitem(last=False)
-
-        def _trim(t):
-            try:
-                r = t.result()
-            except BaseException:  # noqa: BLE001 - incl. cancellation
-                return
-            if isinstance(r, tuple) and len(r) == 2 and sum(
-                    len(b) for b in r[1]
-                    if isinstance(b, (bytes, bytearray, memoryview))
-                    ) > 65536:
-                self.reply_cache.pop(key, None)
-
-        task.add_done_callback(_trim)
-
-    def group_of(self, header: dict) -> str | None:
-        """Resolve the concurrency group for one call (per-call override
-        wins over the method's declared group)."""
-        return header.get("concurrency_group") \
-            or self.method_groups.get(header.get("method", ""))
-
-    def executor_for(self, group: str | None):
-        if group is None:
-            return self.executor
-        ex = self.group_executors.get(group)
-        if ex is None:
-            raise ValueError(
-                f"actor has no concurrency group {group!r}; declared: "
-                f"{sorted(self.concurrency_groups)}")
-        return ex
-
-    def semaphore_for(self, group: str | None) -> "asyncio.Semaphore | None":
-        """Async-actor concurrency bound for a NAMED group (the default
-        group is bounded by max_concurrency at the call sites)."""
-        if group is None:
-            return None
-        if group not in self.concurrency_groups:
-            raise ValueError(
-                f"actor has no concurrency group {group!r}; declared: "
-                f"{sorted(self.concurrency_groups)}")
-        sem = self._group_sems.get(group)
-        if sem is None:
-            sem = asyncio.Semaphore(
-                max(1, int(self.concurrency_groups[group])))
-            self._group_sems[group] = sem
-        return sem
-
-    def default_semaphore(self) -> "asyncio.Semaphore | None":
-        """Default-group bound for async actors — only once the actor
-        declares named groups (otherwise async concurrency keeps its
-        historical unbounded-by-default behavior).  The limit is the
-        user's explicit max_concurrency, or 1000 (ray's async default)."""
-        if not self.concurrency_groups:
-            return None
-        sem = self._group_sems.get("_default")
-        if sem is None:
-            sem = asyncio.Semaphore(max(1, self._async_default_limit))
-            self._group_sems["_default"] = sem
-        return sem
 
 
 class CoreWorker:
