@@ -453,8 +453,12 @@ def bench_model() -> dict:
         # 4x the sequence length, flash fwd+bwd streaming KV blocks.
         import dataclasses
 
-        lcfg = dataclasses.replace(cfg, max_seq=8192)
-        lb, ls = 4, 8192
+        # 16k doubles the round-3 point (same token count per step at
+        # half the batch): flash fwd+bwd streams KV blocks, so memory
+        # stays flat while the quadratic attention share grows — the
+        # honest long-context stressor.
+        lcfg = dataclasses.replace(cfg, max_seq=16384)
+        lb, ls = 2, 16384
         lstate = train_step.sharded_init(jax.random.PRNGKey(0), lcfg,
                                          optimizer, mesh)
         lstep = train_step.sharded_train_step(lcfg, optimizer, mesh)

@@ -95,6 +95,73 @@ class PlacementGroupInfo:
     detached: bool = False
 
 
+
+# ------------------------------------------------------- snapshot storage
+class SnapshotStorage:
+    """Where controller snapshots live (ray: the GCS Redis-persistence
+    analog, gcs_server.cc:41-78 StorageType::REDIS_PERSIST).  The
+    default is a local file; deployments that need head-node-loss
+    durability register a scheme whose backend writes somewhere that
+    survives the host (an object-store bucket, a DB).  Redis itself and
+    cloud SDKs are absent from this environment — the seam is the
+    deliverable."""
+
+    def read(self) -> bytes | None:
+        raise NotImplementedError
+
+    def write(self, blob: bytes) -> None:
+        raise NotImplementedError
+
+
+class FileSnapshotStorage(SnapshotStorage):
+    def __init__(self, path: str):
+        self.path = path
+
+    def read(self) -> bytes | None:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            return f.read()
+
+    def write(self, blob: bytes) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.path)
+
+
+_snapshot_schemes: dict = {}
+
+
+def register_snapshot_storage(scheme: str, factory) -> None:
+    """factory(uri) -> SnapshotStorage for `scheme://...` paths.  Also
+    reachable across process boundaries via
+    RAY_TPU_SNAPSHOT_STORAGE_FACTORY=module:attr (the controller runs
+    as its own process; a registration made in a driver would not
+    exist there)."""
+    _snapshot_schemes[scheme] = factory
+
+
+def make_snapshot_storage(uri: str) -> SnapshotStorage:
+    scheme, sep, _rest = uri.partition("://")
+    if not sep or scheme == "file":
+        return FileSnapshotStorage(uri[len("file://"):] if sep else uri)
+    if scheme not in _snapshot_schemes:
+        hook = os.environ.get("RAY_TPU_SNAPSHOT_STORAGE_FACTORY")
+        if hook:
+            import importlib
+
+            mod, _, attr = hook.partition(":")
+            getattr(importlib.import_module(mod), attr)()
+    factory = _snapshot_schemes.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"no snapshot storage registered for scheme {scheme!r} "
+            "(register_snapshot_storage, or set "
+            "RAY_TPU_SNAPSHOT_STORAGE_FACTORY=module:attr)")
+    return factory(uri)
+
+
 class Controller:
     def __init__(self, config: Config, host: str = "127.0.0.1",
                  port: int | None = None,
@@ -123,6 +190,9 @@ class Controller:
         # at the same port restores them, agents re-register via the
         # heartbeat not-ok path, and live actor addresses keep working.
         self.snapshot_path = snapshot_path
+        self.snapshot_storage: SnapshotStorage | None = (
+            make_snapshot_storage(snapshot_path) if snapshot_path
+            else None)
         self._restored_at: float | None = None
         self._last_snapshot_blob: bytes | None = None
         self._probing: set[str] = set()
@@ -132,10 +202,12 @@ class Controller:
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
         restored = False
-        if self.snapshot_path and os.path.exists(self.snapshot_path):
+        if self.snapshot_storage is not None:
             try:
-                self._restore_snapshot()
-                restored = True
+                blob = self.snapshot_storage.read()
+                if blob is not None:
+                    self._restore_snapshot(blob)
+                    restored = True
             except Exception:  # noqa: BLE001
                 logger.exception("snapshot restore failed; starting fresh")
         self.publisher = Publisher(host=self.host,
@@ -210,14 +282,14 @@ class Controller:
                 for pid, p in self.pgs.items()},
             "kv": {ns: dict(d) for ns, d in self.kv.items()},
             "jobs": copy.deepcopy(self.jobs),
-            "pub_port": int(self.publisher.address.rsplit(":", 1)[1]),
+            "pub_port": (int(self.publisher.address.rsplit(":", 1)[1])
+                         if self.publisher is not None else None),
         }
 
-    def _restore_snapshot(self) -> None:
+    def _restore_snapshot(self, blob: bytes) -> None:
         import pickle
 
-        with open(self.snapshot_path, "rb") as f:
-            snap = pickle.loads(f.read())
+        snap = pickle.loads(blob)
         for aid, a in snap["actors"].items():
             self.actors[aid] = ActorInfo(**a)
         self.named_actors = {tuple(k) if not isinstance(k, tuple) else k: v
@@ -241,11 +313,8 @@ class Controller:
 
     def _write_snapshot(self, blob: bytes) -> None:
         if blob == self._last_snapshot_blob:
-            return              # unchanged: skip the disk write
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self.snapshot_path)
+            return              # unchanged: skip the write
+        self.snapshot_storage.write(blob)
         self._last_snapshot_blob = blob
 
     async def _snapshot_loop(self) -> None:
