@@ -88,6 +88,33 @@ def bench_control_plane() -> dict:
         out["actor_calls_sync_per_s"] = best_of(_sync_actor, 300)
         mark("actor_sync")
 
+        # Async actor (coroutine methods ride the worker's event loop;
+        # reference "1_1_async_actor_calls_async" 4,457/s bar) and a
+        # threaded concurrent actor (max_concurrency > 1; reference
+        # "1_1_actor_calls_concurrent" 5,168/s bar).
+        @ray_tpu.remote
+        class AsyncCounter:
+            def __init__(self):
+                self.v = 0
+
+            async def inc(self):
+                self.v += 1
+                return self.v
+
+        ac = AsyncCounter.remote()
+        ray_tpu.get(ac.inc.remote())
+        out["async_actor_calls_async_per_s"] = best_of(
+            lambda n: ray_tpu.get([ac.inc.remote() for _ in range(n)]),
+            2000)
+        ray_tpu.kill(ac)
+        cc = Counter.options(max_concurrency=4).remote()
+        ray_tpu.get(cc.inc.remote())
+        out["actor_calls_concurrent_per_s"] = best_of(
+            lambda n: ray_tpu.get([cc.inc.remote() for _ in range(n)]),
+            2000)
+        ray_tpu.kill(cc)
+        mark("actor_async_modes")
+
         # n:n — several actors, calls fanned across all of them
         # (reference "n_n_actor_calls_async").
         actors = [Counter.remote() for _ in range(4)]
