@@ -211,6 +211,30 @@ def bench_control_plane() -> dict:
         for a in actors:
             ray_tpu.kill(a)
 
+        # Scalability-envelope points at the REFERENCE's published scale
+        # (release/benchmarks: 10,000 args to one task 18.4 s; 3,000
+        # returns 5.7 s on their release node) — lower is better.
+        @ray_tpu.remote
+        def count_args(*args):
+            return len(args)
+
+        @ray_tpu.remote
+        def many_returns(k):
+            return tuple(range(k))
+
+        arg_refs = [ray_tpu.put(i) for i in range(10000)]
+        t0 = time.perf_counter()
+        assert ray_tpu.get(count_args.remote(*arg_refs)) == 10000
+        out["args_10k_s"] = round(time.perf_counter() - t0, 2)
+        del arg_refs
+        t0 = time.perf_counter()
+        rets = ray_tpu.get(
+            many_returns.options(num_returns=3000).remote(3000))
+        assert len(rets) == 3000
+        out["returns_3k_s"] = round(time.perf_counter() - t0, 2)
+        del rets
+        mark("envelope")
+
         # wait()-heavy pattern (reference: ray.wait loops in ray_perf.py).
         n = 1000
         refs = [noop.remote() for _ in range(n)]

@@ -1,0 +1,49 @@
+"""Scalability-envelope shapes (ray: release/benchmarks README — the
+single-node envelope: many args to one task, many returns, deep task
+backlogs).  Scaled for the 1-core CI box; the full reference-scale
+points (10k args / 3k returns) run as bench.py rows and measured 1.4 s
+and 0.6 s here vs the reference's published 18.4 s / 5.7 s.
+"""
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+    yield ray_tpu
+
+
+def test_many_args_to_one_task(rt):
+    @ray_tpu.remote
+    def count_args(*args):
+        return len(args), args[0], args[-1]
+
+    refs = [ray_tpu.put(i) for i in range(1000)]
+    n, first, last = ray_tpu.get(count_args.remote(*refs), timeout=120)
+    assert (n, first, last) == (1000, 0, 999)
+
+
+def test_many_returns_from_one_task(rt):
+    @ray_tpu.remote
+    def fan_out(k):
+        return tuple(range(k))
+
+    out = ray_tpu.get(
+        fan_out.options(num_returns=500).remote(500), timeout=120)
+    assert len(out) == 500 and out[0] == 0 and out[499] == 499
+
+
+def test_deep_task_backlog(rt):
+    """A backlog far deeper than the worker pool must queue, drain
+    completely, and preserve results (ray: 1M queued tasks point)."""
+    @ray_tpu.remote
+    def echo(i):
+        return i
+
+    n = 5000
+    refs = [echo.remote(i) for i in range(n)]
+    got = ray_tpu.get(refs, timeout=300)
+    assert got == list(range(n))
