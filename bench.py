@@ -249,7 +249,10 @@ def bench_control_plane() -> dict:
         out["_section_s"] = sections
     finally:
         ray_tpu.shutdown()
-    return {k: (v if isinstance(v, dict) else round(v, 1))
+    # Wall-time rows (args_10k_s, ...) keep 2 decimals — sub-second values
+    # would alias at 1-decimal resolution; throughput rows round to 1.
+    return {k: (v if isinstance(v, dict)
+                else round(v, 2) if k.endswith("_s") else round(v, 1))
             for k, v in out.items()}
 
 
@@ -637,12 +640,17 @@ def _vs_previous_round(extra: dict) -> dict:
     out = {}
     for key, val in extra.items():
         pv = prev_extra.get(key)
-        if (key not in changed
-                and isinstance(val, (int, float))
-                and isinstance(pv, (int, float))
-                and pv > 0 and key.endswith(("_per_s", "_gib_per_s"))
-                and val < 0.7 * pv):
-            out[key] = {"prev": pv, "now": round(val, 1),
+        if (key in changed or not isinstance(val, (int, float))
+                or not isinstance(pv, (int, float)) or pv <= 0 or val <= 0):
+            continue
+        if key.endswith(("_per_s", "_gib_per_s")):
+            worse = val < 0.7 * pv          # throughput: higher is better
+        elif key.endswith("_s"):
+            worse = val > pv / 0.7          # wall-time rows: lower is better
+        else:
+            continue
+        if worse:
+            out[key] = {"prev": pv, "now": round(val, 2),
                         "ratio": round(val / pv, 3)}
     return out
 

@@ -328,7 +328,19 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
             f"sharding (data x fsdp = {batch_shards}); choose n_micro so "
             "that batch / n_micro % (data * fsdp) == 0")
     x = embed_lookup(params["embed"], inputs, cfg.dtype)
-    mb = x.reshape(n_micro, b // n_micro, s, x.shape[-1])
+    x = with_sharding_constraint(x, ("batch", "seq", None), mesh)
+    # Row r -> (microbatch r % n_micro, slot r // n_micro): the INTERLEAVED
+    # assignment, not the block-contiguous one.  With the flat batch dim
+    # contiguously sharded over data x fsdp, splitting it micro-major
+    # ([n_micro, b/n_micro]) would need a strided device layout on the mb
+    # dim that GSPMD cannot express — it replicates + repartitions instead
+    # ("[SPMD] Involuntary full rematerialization", fwd and again in the
+    # grad transpose).  Splitting slot-major then swapping axes keeps each
+    # device's rows in place: [b] -> [b/n_micro, n_micro] is a contiguous
+    # split of the sharded dim, and the swap only relabels dims.  Which
+    # rows share a microbatch is semantically irrelevant (the pipeline is
+    # row-wise; the inverse swap below restores row order for the loss).
+    mb = x.reshape(b // n_micro, n_micro, s, x.shape[-1]).swapaxes(0, 1)
     mb = with_sharding_constraint(mb, (None, "batch", "seq", None), mesh)
     stage_layers = jax.tree.map(
         lambda p: p.reshape(n_stages, L // n_stages, *p.shape[1:]),
@@ -349,7 +361,8 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
         return act
 
     out = pipeline_apply(stage_fn, stage_layers, mb, mesh, axis="stage")
-    x = out.reshape(b, s, x.shape[-1])
+    x = out.swapaxes(0, 1).reshape(b, s, x.shape[-1])
+    x = with_sharding_constraint(x, ("batch", "seq", None), mesh)
     return head_loss(params, x, targets, batch.get("mask"), cfg)
 
 
