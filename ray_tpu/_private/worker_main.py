@@ -106,8 +106,14 @@ def main() -> None:
         node_id=os.environ.get("RAY_TPU_NODE_ID", ""),
         pub_addr=os.environ.get("RAY_TPU_PUB_ADDR", ""),
     )
-    core.start()
+    # Publish the global BEFORE start(): start() registers with the agent,
+    # and a queued lease can push a task that runs user code immediately —
+    # user code that calls back into the API (handle.method.remote(),
+    # ray_tpu.get) resolves the worker through global_worker().  Setting
+    # it after start() left a window where that raised "not initialized"
+    # (seen as a flaky test_handle_passing under heavy box load).
     set_global_worker(core)
+    core.start()
     try:
         core._shutdown.wait()
     except KeyboardInterrupt:

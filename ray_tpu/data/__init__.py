@@ -15,3 +15,6 @@ from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa:
                                   read_sql, read_text, read_tfrecords,
                                   read_webdataset)
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.preprocessor import (Preprocessor,  # noqa: F401
+                                       PreprocessorNotFittedException)
+from ray_tpu.data import preprocessors  # noqa: F401

@@ -1,0 +1,94 @@
+"""Preprocessor base: fit statistics over a Dataset, transform anywhere.
+
+Analog of ray: python/ray/data/preprocessor.py (Preprocessor.fit :88,
+transform :137, transform_batch :161; subclasses implement _fit and a
+per-batch transform).  Design difference: the reference fits through
+Dataset.aggregate (its own Arrow aggregate layer); here fitting is a
+map_batches over blocks emitting pickled per-block partials that the
+driver folds — the same two-phase tree the executor already parallelizes,
+with no extra aggregate machinery.  Batches are numpy dicts end-to-end
+(the device-feed format of iter_jax/torch_batches).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable
+
+import numpy as np
+
+
+class PreprocessorNotFittedException(RuntimeError):
+    """transform() called before fit() on a stateful preprocessor."""
+
+
+class Preprocessor:
+    """Fit once against a Dataset, then transform Datasets or batches.
+
+    Subclasses override `_fit(ds)` (compute and store `self.stats_`;
+    stateless preprocessors leave the default no-op) and
+    `_transform_batch(batch: dict[str, np.ndarray]) -> dict`.
+    """
+
+    _is_fittable = True
+
+    # ------------------------------------------------------------ public
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        self._check_fitted()
+        return ds.map_batches(self._transform_batch, batch_format="numpy")
+
+    def transform_batch(self, batch: dict) -> dict:
+        self._check_fitted()
+        return self._transform_batch(
+            {k: np.asarray(v) for k, v in batch.items()})
+
+    # --------------------------------------------------------- overrides
+    def _fit(self, ds) -> None:  # noqa: B027 - optional hook
+        pass
+
+    def _transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- helpers
+    def _check_fitted(self) -> None:
+        if self._is_fittable and not getattr(self, "_fitted", False):
+            raise PreprocessorNotFittedException(
+                f"{type(self).__name__} must be fit before transform; "
+                "call .fit(ds) or .fit_transform(ds)")
+
+    def __repr__(self):
+        state = "" if not self._is_fittable else (
+            " (fitted)" if getattr(self, "_fitted", False)
+            else " (not fitted)")
+        return f"{type(self).__name__}{state}"
+
+
+def aggregate_blocks(ds, partial_fn: Callable[[dict], Any],
+                     combine_fn: Callable[[Any, Any], Any]) -> Any:
+    """Two-phase fit: map each block to a partial statistic (runs as
+    distributed tasks), fold the partials on the driver.
+
+    Partials cross the object store pickled inside a binary column, so a
+    partial can be any picklable value (dicts of Counters, numpy
+    moments, ...) without needing an Arrow representation.
+    """
+
+    def per_block(batch: dict) -> dict:
+        return {"partial": np.array([pickle.dumps(partial_fn(batch))],
+                                    dtype=object)}
+
+    rows = ds.map_batches(per_block, batch_format="numpy").take_all()
+    partials = [pickle.loads(r["partial"]) for r in rows]
+    if not partials:
+        raise ValueError("cannot fit a preprocessor on an empty dataset")
+    acc = partials[0]
+    for p in partials[1:]:
+        acc = combine_fn(acc, p)
+    return acc

@@ -1,0 +1,577 @@
+"""Standard preprocessors (ray: python/ray/data/preprocessors/).
+
+Same public surface as the reference's __init__ exports — scalers
+(Standard/MinMax/MaxAbs/Robust), encoders (OneHot/MultiHot/Ordinal/
+Label), SimpleImputer, discretizers, Normalizer, Tokenizer, vectorizers,
+FeatureHasher, PowerTransformer, Concatenator, Chain — re-implemented on
+the two-phase aggregate_blocks fit (preprocessor.py) and numpy batches.
+TorchVisionPreprocessor is intentionally absent (no torchvision in the
+image; jax image pipelines use map_batches directly).
+"""
+from __future__ import annotations
+
+import collections
+import zlib
+from typing import Callable
+
+import numpy as np
+
+from ray_tpu.data.preprocessor import Preprocessor, aggregate_blocks
+
+__all__ = [
+    "Chain", "Concatenator", "CountVectorizer", "CustomKBinsDiscretizer",
+    "FeatureHasher", "HashingVectorizer", "LabelEncoder", "MaxAbsScaler",
+    "MinMaxScaler", "MultiHotEncoder", "Normalizer", "OneHotEncoder",
+    "OrdinalEncoder", "PowerTransformer", "RobustScaler", "SimpleImputer",
+    "StandardScaler", "Tokenizer", "UniformKBinsDiscretizer",
+]
+
+
+# ---------------------------------------------------------------- moments
+def _moment_partial(columns):
+    def partial(batch):
+        out = {}
+        for c in columns:
+            v = np.asarray(batch[c], dtype=np.float64)
+            m = v[~np.isnan(v)]
+            out[c] = (m.size, m.sum(), (m * m).sum(),
+                      m.min() if m.size else np.inf,
+                      m.max() if m.size else -np.inf,
+                      np.abs(m).max() if m.size else 0.0)
+        return out
+
+    return partial
+
+
+def _moment_combine(a, b):
+    return {c: (a[c][0] + b[c][0], a[c][1] + b[c][1], a[c][2] + b[c][2],
+                min(a[c][3], b[c][3]), max(a[c][4], b[c][4]),
+                max(a[c][5], b[c][5]))
+            for c in a}
+
+
+class _MomentFitMixin:
+    """Shared fit: per-column (count, sum, sumsq, min, max, absmax)."""
+
+    def _fit(self, ds) -> None:
+        stats = aggregate_blocks(ds, _moment_partial(self.columns),
+                                 _moment_combine)
+        self.stats_ = {}
+        for c, (n, s, ss, mn, mx, am) in stats.items():
+            mean = s / n if n else 0.0
+            var = max(ss / n - mean * mean, 0.0) if n else 0.0
+            self.stats_[c] = {"count": n, "mean": mean,
+                              "std": float(np.sqrt(var)),
+                              "min": mn, "max": mx, "abs_max": am}
+
+
+class StandardScaler(_MomentFitMixin, Preprocessor):
+    """x -> (x - mean) / std (ray: preprocessors/scaler.py StandardScaler)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            denom = st["std"] or 1.0
+            batch[c] = (np.asarray(batch[c], np.float64) - st["mean"]) / denom
+        return batch
+
+
+class MinMaxScaler(_MomentFitMixin, Preprocessor):
+    """x -> (x - min) / (max - min) (ray: scaler.py MinMaxScaler)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            span = (st["max"] - st["min"]) or 1.0
+            batch[c] = (np.asarray(batch[c], np.float64) - st["min"]) / span
+        return batch
+
+
+class MaxAbsScaler(_MomentFitMixin, Preprocessor):
+    """x -> x / max|x| (ray: scaler.py MaxAbsScaler)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            batch[c] = (np.asarray(batch[c], np.float64)
+                        / (self.stats_[c]["abs_max"] or 1.0))
+        return batch
+
+
+class RobustScaler(Preprocessor):
+    """x -> (x - median) / IQR (ray: scaler.py RobustScaler).
+
+    Quantiles are exact: the fit pulls ONLY the scaled columns to the
+    driver (a [n_rows] float per column) — fine at preprocessor-fit
+    scale; the reference approximates through its aggregate layer.
+    """
+
+    def __init__(self, columns: list[str],
+                 quantile_range: tuple[float, float] = (0.25, 0.75)):
+        self.columns = list(columns)
+        self.quantile_range = quantile_range
+
+    def _fit(self, ds) -> None:
+        lo_q, hi_q = self.quantile_range
+        arrs = ds.select_columns(self.columns).to_numpy()
+        self.stats_ = {}
+        for c in self.columns:
+            v = np.asarray(arrs[c], np.float64)
+            v = v[~np.isnan(v)]
+            lo, med, hi = np.quantile(v, [lo_q, 0.5, hi_q])
+            self.stats_[c] = {"median": med, "iqr": hi - lo}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            batch[c] = ((np.asarray(batch[c], np.float64) - st["median"])
+                        / (st["iqr"] or 1.0))
+        return batch
+
+
+# ----------------------------------------------------------- value counts
+def _value_counts(columns):
+    def partial(batch):
+        out = {}
+        for c in columns:
+            v = np.asarray(batch[c])
+            if v.dtype.kind == "f":
+                # Drop NaNs: nan != nan, so each one would count as its
+                # OWN category (hash(nan) is id-based) — a 10%-missing
+                # float column would bloat the vocabulary by one entry
+                # per missing row.
+                v = v[~np.isnan(v)]
+            out[c] = collections.Counter(
+                x for x in v.tolist() if x is not None)
+        return out
+
+    return partial
+
+
+def _counts_combine(a, b):
+    return {c: a[c] + b[c] for c in a}
+
+
+def _sorted_uniques(counter) -> list:
+    return sorted(counter.keys(), key=lambda v: (str(type(v)), v))
+
+
+class OrdinalEncoder(Preprocessor):
+    """Category -> its rank among the sorted fitted values (ray:
+    encoder.py OrdinalEncoder).  Unseen values encode as -1."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        counts = aggregate_blocks(ds, _value_counts(self.columns),
+                                  _counts_combine)
+        self.stats_ = {c: {v: i for i, v in
+                           enumerate(_sorted_uniques(counts[c]))}
+                       for c in self.columns}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            table = self.stats_[c]
+            batch[c] = np.array([table.get(v, -1)
+                                 for v in np.asarray(batch[c]).tolist()],
+                                np.int64)
+        return batch
+
+
+class LabelEncoder(OrdinalEncoder):
+    """OrdinalEncoder for the single label column (ray: encoder.py
+    LabelEncoder)."""
+
+    def __init__(self, label_column: str):
+        super().__init__([label_column])
+        self.label_column = label_column
+
+    def inverse_transform_batch(self, batch):
+        inv = {i: v for v, i in self.stats_[self.label_column].items()}
+        batch = dict(batch)
+        batch[self.label_column] = np.array(
+            [inv.get(int(i)) for i in np.asarray(batch[self.label_column])])
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Category column -> one 0/1 column per category, named
+    `{column}_{value}`; the source column is dropped (ray: encoder.py
+    OneHotEncoder semantics)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        counts = aggregate_blocks(ds, _value_counts(self.columns),
+                                  _counts_combine)
+        self.stats_ = {c: _sorted_uniques(counts[c]) for c in self.columns}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            vals = np.asarray(batch.pop(c)).tolist()
+            for cat in self.stats_[c]:
+                batch[f"{c}_{cat}"] = np.array(
+                    [1 if v == cat else 0 for v in vals], np.int8)
+        return batch
+
+
+class MultiHotEncoder(Preprocessor):
+    """List column -> multi-hot count vector over the fitted vocabulary
+    (ray: encoder.py MultiHotEncoder).  Output is a [n, n_categories]
+    tensor column under the same name."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+
+    def _fit(self, ds) -> None:
+        def partial(batch):
+            return {c: collections.Counter(
+                v for row in np.asarray(batch[c], dtype=object)
+                for v in row) for c in self.columns}
+
+        counts = aggregate_blocks(ds, partial, _counts_combine)
+        self.stats_ = {c: {v: i for i, v in
+                           enumerate(_sorted_uniques(counts[c]))}
+                       for c in self.columns}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            table = self.stats_[c]
+            rows = np.asarray(batch[c], dtype=object)
+            out = np.zeros((len(rows), len(table)), np.int64)
+            for i, row in enumerate(rows):
+                for v in row:
+                    j = table.get(v)
+                    if j is not None:
+                        out[i, j] += 1
+            batch[c] = out
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values: strategy mean | most_frequent | constant
+    (ray: imputer.py SimpleImputer)."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value=None):
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self._is_fittable = strategy != "constant"
+
+    def _fit(self, ds) -> None:
+        if self.strategy == "constant":
+            return          # nothing to learn; fill_value is the state
+        if self.strategy == "mean":
+            stats = aggregate_blocks(ds, _moment_partial(self.columns),
+                                     _moment_combine)
+            self.stats_ = {c: (s[1] / s[0] if s[0] else 0.0)
+                           for c, s in stats.items()}
+        else:  # most_frequent
+            counts = aggregate_blocks(ds, _value_counts(self.columns),
+                                      _counts_combine)
+            for c in self.columns:
+                if not counts[c]:
+                    raise ValueError(
+                        f"column {c!r} has no non-missing values; "
+                        "most_frequent cannot be fit (use "
+                        "strategy='constant')")
+            self.stats_ = {c: counts[c].most_common(1)[0][0]
+                           for c in self.columns}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[c])
+            v = np.asarray(batch[c])
+            if v.dtype.kind == "f":
+                batch[c] = np.where(np.isnan(v), fill, v)
+            else:
+                batch[c] = np.array(
+                    [fill if x is None else x for x in v.tolist()])
+        return batch
+
+
+# ------------------------------------------------------------ discretize
+class UniformKBinsDiscretizer(_MomentFitMixin, Preprocessor):
+    """Equal-width binning over the fitted [min, max] (ray:
+    discretizer.py UniformKBinsDiscretizer)."""
+
+    def __init__(self, columns: list[str], bins: int):
+        self.columns = list(columns)
+        self.bins = bins
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            edges = np.linspace(st["min"], st["max"], self.bins + 1)
+            batch[c] = np.clip(
+                np.digitize(np.asarray(batch[c], np.float64),
+                            edges[1:-1]), 0, self.bins - 1).astype(np.int64)
+        return batch
+
+
+class CustomKBinsDiscretizer(Preprocessor):
+    """Binning with caller-provided edges (ray: discretizer.py
+    CustomKBinsDiscretizer) — stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], bin_edges: dict[str, list]):
+        self.columns = list(columns)
+        self.bin_edges = bin_edges
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            edges = np.asarray(self.bin_edges[c], np.float64)
+            batch[c] = np.digitize(np.asarray(batch[c], np.float64),
+                                   edges[1:-1]).astype(np.int64)
+        return batch
+
+
+# ------------------------------------------------------------- stateless
+class Normalizer(Preprocessor):
+    """Row-wise vector normalization of tensor columns: l1 | l2 | max
+    (ray: normalizer.py)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], norm: str = "l2"):
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = list(columns)
+        self.norm = norm
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            v = np.asarray(batch[c], np.float64)
+            if self.norm == "l1":
+                d = np.abs(v).sum(axis=-1, keepdims=True)
+            elif self.norm == "l2":
+                d = np.sqrt((v * v).sum(axis=-1, keepdims=True))
+            else:
+                d = np.abs(v).max(axis=-1, keepdims=True)
+            batch[c] = v / np.where(d == 0, 1.0, d)
+        return batch
+
+
+class PowerTransformer(Preprocessor):
+    """Box-Cox / Yeo-Johnson with a caller-chosen power (ray:
+    transformer.py PowerTransformer — also takes `power` explicitly)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], power: float,
+                 method: str = "yeo-johnson"):
+        if method not in ("yeo-johnson", "box-cox"):
+            raise ValueError(f"unknown method {method!r}")
+        self.columns = list(columns)
+        self.power = power
+        self.method = method
+
+    def _transform_batch(self, batch):
+        lam = self.power
+        for c in self.columns:
+            v = np.asarray(batch[c], np.float64)
+            if self.method == "box-cox":
+                batch[c] = (np.log(v) if lam == 0
+                            else (np.power(v, lam) - 1) / lam)
+            else:
+                pos = v >= 0
+                if lam == 0:
+                    a = np.log1p(np.where(pos, v, 0))
+                else:
+                    a = (np.power(np.where(pos, v, 0) + 1, lam) - 1) / lam
+                if lam == 2:
+                    b = -np.log1p(np.where(pos, 0, -v))
+                else:
+                    b = -((np.power(np.where(pos, 0, -v) + 1, 2 - lam) - 1)
+                          / (2 - lam))
+                batch[c] = np.where(pos, a, b)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one [n, d] tensor column (ray:
+    concatenator.py) — the device-feed shape for jax/torch batches."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str],
+                 output_column_name: str = "concat",
+                 dtype=np.float32, drop: bool = True):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+        self.drop = drop
+
+    def _transform_batch(self, batch):
+        parts = []
+        for c in self.columns:
+            v = np.asarray(batch[c], self.dtype)
+            parts.append(v[:, None] if v.ndim == 1 else
+                         v.reshape(v.shape[0], -1))
+            if self.drop:
+                batch.pop(c)
+        batch[self.output_column_name] = np.concatenate(parts, axis=1)
+        return batch
+
+
+class Tokenizer(Preprocessor):
+    """String column -> list-of-tokens column (ray: tokenizer.py);
+    default tokenization is whitespace split."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str],
+                 tokenization_fn: Callable[[str], list] | None = None):
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or str.split
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            batch[c] = np.array(
+                [self.tokenization_fn(str(v))
+                 for v in np.asarray(batch[c]).tolist()], dtype=object)
+        return batch
+
+
+def _stable_hash(token: str, mod: int) -> int:
+    """Deterministic across processes (unlike builtin str hash, which is
+    salted per interpreter — workers would disagree)."""
+    return zlib.crc32(token.encode()) % mod
+
+
+class FeatureHasher(Preprocessor):
+    """Hash token-count dict columns into a fixed-width vector (ray:
+    hasher.py FeatureHasher): input columns hold {token: count} dicts or
+    token lists; output is one [n, num_features] tensor column."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], num_features: int,
+                 output_column_name: str = "hashed_features"):
+        self.columns = list(columns)
+        self.num_features = num_features
+        self.output_column_name = output_column_name
+
+    def _transform_batch(self, batch):
+        n = len(next(iter(batch.values())))
+        out = np.zeros((n, self.num_features), np.float64)
+        for c in self.columns:
+            rows = np.asarray(batch.pop(c), dtype=object)
+            for i, row in enumerate(rows):
+                items = (row.items() if isinstance(row, dict)
+                         else ((t, 1) for t in row))
+                for tok, cnt in items:
+                    out[i, _stable_hash(str(tok), self.num_features)] += cnt
+        batch[self.output_column_name] = out
+        return batch
+
+
+class HashingVectorizer(Preprocessor):
+    """Stateless bag-of-words: tokenize + hash each string column into a
+    [n, num_features] count vector under the same name (ray:
+    vectorizer.py HashingVectorizer)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], num_features: int,
+                 tokenization_fn: Callable[[str], list] | None = None):
+        self.columns = list(columns)
+        self.num_features = num_features
+        self.tokenization_fn = tokenization_fn or str.split
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            vals = np.asarray(batch[c]).tolist()
+            out = np.zeros((len(vals), self.num_features), np.int64)
+            for i, v in enumerate(vals):
+                for tok in self.tokenization_fn(str(v)):
+                    out[i, _stable_hash(tok, self.num_features)] += 1
+            batch[c] = out
+        return batch
+
+
+class CountVectorizer(Preprocessor):
+    """Bag-of-words over a fitted vocabulary; optional max_features keeps
+    the most frequent tokens (ray: vectorizer.py CountVectorizer)."""
+
+    def __init__(self, columns: list[str],
+                 tokenization_fn: Callable[[str], list] | None = None,
+                 max_features: int | None = None):
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or str.split
+        self.max_features = max_features
+
+    def _fit(self, ds) -> None:
+        fn = self.tokenization_fn
+
+        def partial(batch):
+            return {c: collections.Counter(
+                tok for v in np.asarray(batch[c]).tolist()
+                for tok in fn(str(v))) for c in self.columns}
+
+        counts = aggregate_blocks(ds, partial, _counts_combine)
+        self.stats_ = {}
+        for c in self.columns:
+            items = counts[c].most_common(self.max_features)
+            self.stats_[c] = {tok: i for i, (tok, _) in
+                              enumerate(sorted(items))}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            vocab = self.stats_[c]
+            vals = np.asarray(batch[c]).tolist()
+            out = np.zeros((len(vals), len(vocab)), np.int64)
+            for i, v in enumerate(vals):
+                for tok in self.tokenization_fn(str(v)):
+                    j = vocab.get(tok)
+                    if j is not None:
+                        out[i, j] += 1
+            batch[c] = out
+        return batch
+
+
+class Chain(Preprocessor):
+    """Sequential composition; fit runs left to right, each stage fitting
+    on the previous stages' transform (ray: chain.py Chain)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def _fit(self, ds) -> None:
+        for p in self.preprocessors[:-1]:
+            ds = p.fit_transform(ds)
+        if self.preprocessors:
+            self.preprocessors[-1].fit(ds)
+
+    def transform(self, ds):
+        self._check_fitted()
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch: dict) -> dict:
+        self._check_fitted()
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch
+
+    def _check_fitted(self) -> None:
+        for p in self.preprocessors:
+            p._check_fitted()

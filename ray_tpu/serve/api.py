@@ -114,6 +114,22 @@ def run(app: Application, *, name: str = "default",
             raise TimeoutError(
                 f"app {name!r} did not become ready in {timeout_s}s: "
                 f"{status()}")
+        # Ready means replicas are up; proxies learn routes on a poll.
+        # Block until every live proxy routes this app so an HTTP request
+        # issued right after run() cannot 404 (best effort: a proxy that
+        # appears later catches up on its own poll).
+        waits = []
+        for pname in ray_tpu.get(ctrl.list_proxies.remote(), timeout=30.0):
+            try:
+                waits.append(ray_tpu.get_actor(pname)
+                             .wait_for_route.remote(route_prefix, name))
+            except Exception:  # noqa: BLE001 - proxy died; reconcile redoes
+                pass
+        if waits:
+            try:
+                ray_tpu.get(waits, timeout=15.0)
+            except Exception:  # noqa: BLE001 - don't fail run() on a proxy
+                pass
     return DeploymentHandle(app.deployment.name, name, ctrl.actor_id)
 
 

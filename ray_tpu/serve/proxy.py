@@ -108,6 +108,22 @@ class ProxyActor:
                 pass
             await asyncio.sleep(0.5)
 
+    async def wait_for_route(self, prefix: str, app: str,
+                             timeout: float = 10.0) -> bool:
+        """Block until this proxy's route table maps `prefix` to `app`
+        (serve.run calls this on every live proxy so its return means
+        "the app is routable", not just "deployed" — the reference gets
+        the same guarantee from long-poll config push)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            got = self._routes.get(prefix)
+            if got is not None and got[0] == app:
+                return True
+            if loop.time() >= deadline:
+                return False
+            await asyncio.sleep(0.05)
+
     async def get_port(self) -> int:
         await self._ready.wait()
         if self._port is None:

@@ -93,6 +93,22 @@ class Checkpoint:
         leaves = [data[str(i)] for i in range(len(data.files))]
         return jax.tree.unflatten(treedef, leaves)
 
+    EPHEMERAL_MARKER = ".raytpu-ephemeral"
+
+    @classmethod
+    def mark_ephemeral(cls, path: str) -> None:
+        """Flag a checkpoint directory as a one-shot handoff: the first
+        CheckpointManager.register() that copies it into run storage
+        also deletes it.  Producers that write into a temp dir (e.g. the
+        HF report callback) use this so per-save snapshots don't pile up
+        under /tmp."""
+        with open(os.path.join(path, cls.EPHEMERAL_MARKER), "w"):
+            pass
+
+    def is_ephemeral(self) -> bool:
+        return os.path.exists(os.path.join(self.path,
+                                           self.EPHEMERAL_MARKER))
+
     def __repr__(self):
         return f"Checkpoint({self.path})"
 
@@ -127,6 +143,12 @@ class CheckpointManager:
             if os.path.exists(dest):
                 shutil.rmtree(dest)
             shutil.copytree(checkpoint.path, dest)
+            marker = os.path.join(dest, Checkpoint.EPHEMERAL_MARKER)
+            if os.path.exists(marker):
+                # Ephemeral handoff: consume (delete) the producer's
+                # temp copy now that storage owns the data.
+                os.unlink(marker)
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
         tracked = _TrackedCheckpoint(Checkpoint(dest), dict(metrics),
                                      self._index)
         self._index += 1

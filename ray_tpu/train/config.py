@@ -38,8 +38,13 @@ class ScalingConfig:
             b["TPU"] = self.num_tpus_per_worker or 1.0
         if self.topology:
             b[f"tpu-slice:{self.topology}"] = 1.0
-        for k, v in (self.resources_per_worker or {}).items():
-            b[k] = b.get(k, 0.0) + v
+        # CPU/TPU in resources_per_worker OVERRIDE the defaults (the
+        # reference's ScalingConfig semantics); anything else is an extra
+        # custom resource.  Summing CPU here once double-reserved every
+        # bundle ({"CPU": 1} -> 2.0), and a worker group that grabs the
+        # whole cluster deadlocks any train loop that also consumes a
+        # streaming dataset (the data tasks have nowhere to run).
+        b.update(self.resources_per_worker or {})
         return b
 
     def bundles(self) -> list[dict[str, float]]:

@@ -1,0 +1,153 @@
+"""HuggingFace Transformers integration for ray_tpu.train.
+
+Analog of ray: python/ray/train/huggingface/transformers/
+(_transformers_utils.py: RayTrainReportCallback.on_save copies the last
+HF checkpoint into a Ray Train Checkpoint and reports log_history
+metrics; prepare_trainer overrides get_train/eval_dataloader to feed Ray
+Data iterables into transformers.Trainer).
+
+Design differences from the reference:
+- Ray wraps an already-created iterator object (its
+  `_IterableFromIterator`); one epoch exhausts it.  Here the user passes
+  the ray_tpu `DataIterator` itself as `train_dataset` and every epoch
+  opens a FRESH `iter_torch_batches()` stream, so multi-epoch runs work
+  without re-calling prepare.
+- The checkpoint directory is copied to a persistent temp dir (our
+  `Checkpoint` is a live path handle on the shared filesystem, not an
+  uploaded artifact), and the batch size for Ray-fed loaders comes from
+  `TrainingArguments.per_device_train_batch_size` instead of being fixed
+  upstream.
+
+Usage inside a TorchTrainer train loop::
+
+    from ray_tpu.train.huggingface import (RayTrainReportCallback,
+                                           prepare_trainer)
+    trainer = transformers.Trainer(model, args,
+                                   train_dataset=ray_data_iterator, ...)
+    trainer.add_callback(RayTrainReportCallback())
+    trainer = prepare_trainer(trainer)
+    trainer.train()
+
+With a `DataIterator` train_dataset (an IterableDataset under the hood),
+set `TrainingArguments.max_steps` — transformers cannot derive epoch
+length from a stream.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import report
+
+try:  # transformers is an optional integration (baked into this env)
+    from transformers.trainer_callback import TrainerCallback
+except ImportError:  # pragma: no cover - env always has transformers
+    TrainerCallback = object
+
+
+class RayTrainReportCallback(TrainerCallback):
+    """Report transformers checkpoints + metrics to ray_tpu.train.
+
+    Fires after each `Trainer` checkpoint save: aggregates every dict in
+    `TrainerState.log_history` (later entries win), copies the newest HF
+    checkpoint directory into a ray_tpu `Checkpoint`, and calls
+    `train.report(metrics, checkpoint)` — from a worker that lands in
+    the worker group's result queue exactly like a hand-written loop's
+    report (ray: RayTrainReportCallback.on_save).
+    """
+
+    CHECKPOINT_NAME = "checkpoint"
+
+    def on_save(self, args, state, control, **kwargs):
+        metrics = {}
+        for log in state.log_history:
+            metrics.update(log)
+        checkpoint = None
+        src = _last_checkpoint_dir(args.output_dir)
+        if src is not None:
+            # Persistent dir, not a context-managed one: the Checkpoint
+            # handle stays valid after this callback returns.  The
+            # ephemeral marker hands ownership to CheckpointManager,
+            # which deletes this source copy once it lands in the run's
+            # storage dir — without it every save would leak a full
+            # model snapshot under /tmp.
+            dst = tempfile.mkdtemp(prefix="raytpu-hf-ckpt-")
+            shutil.copytree(src, os.path.join(dst, self.CHECKPOINT_NAME))
+            Checkpoint.mark_ephemeral(dst)
+            checkpoint = Checkpoint.from_directory(dst)
+        report(metrics, checkpoint=checkpoint)
+
+
+def _last_checkpoint_dir(output_dir: str) -> str | None:
+    """Newest `checkpoint-<step>` subdirectory, None if none exist."""
+    try:
+        candidates = [
+            d for d in os.listdir(output_dir)
+            if d.startswith("checkpoint-")
+            and d.split("-")[-1].isdigit()
+            and os.path.isdir(os.path.join(output_dir, d))
+        ]
+    except FileNotFoundError:
+        return None
+    if not candidates:
+        return None
+    newest = max(candidates, key=lambda d: int(d.split("-")[-1]))
+    return os.path.join(output_dir, newest)
+
+
+def prepare_trainer(trainer):
+    """Wire ray_tpu Data iterators into a transformers.Trainer.
+
+    When `train_dataset` / `eval_dataset` is a ray_tpu `DataIterator`,
+    the returned trainer's dataloaders pull batches from
+    `iter_torch_batches(batch_size=per_device_train_batch_size)` — a
+    fresh stream per epoch — instead of torch's sampler machinery
+    (which needs a map-style dataset).  Anything else falls through to
+    the stock transformers dataloaders untouched.
+    """
+    try:
+        import transformers  # noqa: F401
+        from torch.utils.data import DataLoader, IterableDataset
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "prepare_trainer requires transformers and torch") from e
+
+    class _RayStream(IterableDataset):
+        """Re-iterable view: each epoch opens a fresh batch stream."""
+
+        def __init__(self, it: DataIterator, batch_size: int):
+            self._it = it
+            self._batch_size = batch_size
+
+        def __iter__(self):
+            return iter(self._it.iter_torch_batches(
+                batch_size=self._batch_size))
+
+    base = trainer.__class__
+
+    class _RayTransformersTrainer(base):
+        def get_train_dataloader(self):
+            if isinstance(self.train_dataset, DataIterator):
+                stream = _RayStream(
+                    self.train_dataset,
+                    self.args.per_device_train_batch_size)
+                # Batches arrive pre-collated from iter_torch_batches.
+                return DataLoader(stream, batch_size=1,
+                                  collate_fn=lambda x: x[0])
+            return super().get_train_dataloader()
+
+        def get_eval_dataloader(self, eval_dataset=None):
+            ds = eval_dataset if eval_dataset is not None \
+                else self.eval_dataset
+            if isinstance(ds, DataIterator):
+                stream = _RayStream(
+                    ds, self.args.per_device_eval_batch_size)
+                return DataLoader(stream, batch_size=1,
+                                  collate_fn=lambda x: x[0])
+            return super().get_eval_dataloader(eval_dataset)
+
+    trainer.__class__ = _RayTransformersTrainer
+    return trainer

@@ -20,11 +20,26 @@ from ray_tpu.train.trainer import DataParallelTrainer
 
 
 def _torch_pg_init(master_addr: str, master_port: int, world_size: int,
-                   rank: int) -> bool:
+                   rank: int, local_rank: int = 0,
+                   local_world_size: int = 1) -> bool:
     """Runs inside each TrainWorker (ray: _setup_torch_process_group,
-    torch/config.py:65)."""
+    torch/config.py:65).  Also exports the torchrun-style env vars: the
+    torch ecosystem (transformers/accelerate) decides "am I
+    distributed?" from RANK/WORLD_SIZE env, not from the live process
+    group — without them an HF Trainer on 2 workers thinks both are
+    process zero (no DDP, double checkpoint saves)."""
+    import os
+
     import torch.distributed as dist
 
+    os.environ.update({
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world_size),
+        "LOCAL_RANK": str(local_rank),
+        "LOCAL_WORLD_SIZE": str(local_world_size),
+    })
     if dist.is_initialized():
         return True
     dist.init_process_group(
@@ -52,8 +67,18 @@ class TorchBackend(Backend):
         import ray_tpu
 
         ip, port = worker_group.execute_single(0, "get_address")
+        # Local ranks: position within each node's worker list (same
+        # derivation as BackendExecutor._run_once session wiring).
+        node_ids = worker_group.execute("get_node_id")
+        seen: dict[str, int] = {}
+        local_ranks = []
+        for nid in node_ids:
+            local_ranks.append(seen.get(nid, 0))
+            seen[nid] = local_ranks[-1] + 1
+        local_sizes = [seen[nid] for nid in node_ids]
         ray_tpu.get([
-            w.run_fn.remote(_torch_pg_init, ip, port, n, rank)
+            w.run_fn.remote(_torch_pg_init, ip, port, n, rank,
+                            local_ranks[rank], local_sizes[rank])
             for rank, w in enumerate(worker_group.workers)
         ])
 

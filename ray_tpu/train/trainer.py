@@ -91,6 +91,15 @@ class BaseTrainer:
                          if m.get("checkpoint")), None)
             if ckpt is not None:
                 manager.register(ckpt, last_metrics)
+            # Only one checkpoint per round is kept; other ranks'
+            # EPHEMERAL ones (temp handoff dirs, Checkpoint.mark_
+            # ephemeral) would otherwise leak under /tmp forever.
+            import shutil
+
+            for m in round_msgs:
+                c = m.get("checkpoint")
+                if c is not None and c is not ckpt and c.is_ephemeral():
+                    shutil.rmtree(c.path, ignore_errors=True)
             for key, bound in stop_criteria.items():
                 v = last_metrics.get(key)
                 if v is not None and v >= bound:
