@@ -124,3 +124,39 @@ class GCETPUNodeProvider(NodeProvider):
         except RuntimeError:
             return False
         return node.get("state") == "READY"
+
+    def node_ip(self, provider_node_id: str) -> str | None:
+        """First worker VM's address of the slice (TPU-VM API shape:
+        networkEndpoints[].ipAddress / accessConfig.externalIp)."""
+        try:
+            node = self._call(
+                "GET", f"{self._parent()}/nodes/{provider_node_id}")
+        except RuntimeError:
+            return None
+        for ep in node.get("networkEndpoints", []):
+            ext = (ep.get("accessConfig") or {}).get("externalIp")
+            if ext:
+                return ext
+            if ep.get("ipAddress"):
+                return ep["ipAddress"]
+        return None
+
+    def head_node(self) -> str | None:
+        """Head = the LIVE node labelled ray-node-type=head (launcher.up
+        tags it).  The first-listed-node fallback applies only to
+        clusters with no role labels at all (hand-made); when workers
+        are labelled but no head is alive — e.g. the head slice was
+        preempted — this returns None so `up` recreates a head and
+        attach/exec refuse rather than silently targeting a worker.
+        State filter matters: GCE deletes are async, and a DELETING
+        head must not be handed out as an address."""
+        alive = ("CREATING", "READY", "RESTARTING", "STARTING")
+        nodes = [n for n in self._list_nodes() if n.get("state") in alive]
+        labelled = [n for n in nodes
+                    if n.get("labels", {}).get("ray-node-type")]
+        for n in labelled:
+            if n["labels"]["ray-node-type"] == "head":
+                return n["name"].rsplit("/", 1)[-1]
+        if not labelled and nodes:
+            return nodes[0]["name"].rsplit("/", 1)[-1]
+        return None

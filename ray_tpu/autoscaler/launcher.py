@@ -80,17 +80,31 @@ def up(config: dict, *, dry_run: bool = False,
     provider = make_provider(config, controller_addr)
     existing = provider.non_terminated_nodes()
     created: list[str] = []
-    if not existing:
+    # Head presence is judged by role, not by len(existing): after a head
+    # preemption with workers still alive, `up` must RECREATE the head
+    # (and not count a worker as it).
+    head = provider.head_node()
+    if head is None or head not in existing:
         created += provider.create_node(
-            config.get("head_node", {}).get("node_config", {}), 1)
-    have_workers = max(0, len(existing) - 1) if existing else 0
+            _tagged(config.get("head_node", {}).get("node_config", {}),
+                    "head"), 1)
+        head = None
+    have_workers = max(0, len(existing) - (1 if head is not None else 0))
     missing = max(0, want_workers - have_workers)
     if missing:
         created += provider.create_node(
-            worker_spec.get("node_config", {}), missing)
+            _tagged(worker_spec.get("node_config", {}), "worker"), missing)
     summary["created"] = created
     summary["nodes"] = provider.non_terminated_nodes()
     return summary
+
+
+def _tagged(node_config: dict, role: str) -> dict:
+    """node_config + a ray-node-type label so attach/exec can find the
+    head later (ray: TAG_RAY_NODE_KIND provider tags)."""
+    cfg = dict(node_config)
+    cfg["labels"] = {**cfg.get("labels", {}), "ray-node-type": role}
+    return cfg
 
 
 def down(config: dict, *, dry_run: bool = False,
@@ -103,3 +117,73 @@ def down(config: dict, *, dry_run: bool = False,
             provider.terminate_node(nid)
     return {"cluster_name": config.get("cluster_name"),
             "terminated": nodes, "dry_run": dry_run}
+
+
+# ------------------------------------------------- ssh front door
+# ray: `ray attach / exec / submit / get-head-ip` (scripts.py commands →
+# autoscaler/_private/commands.py attach_cluster/exec_cluster).  The YAML
+# `auth:` block carries ssh_user/ssh_private_key exactly like the
+# reference's cluster configs.
+
+def get_head_ip(config: dict, *, controller_addr: str | None = None) -> str:
+    provider = make_provider(config, controller_addr)
+    head = provider.head_node()
+    if head is None:
+        nodes = provider.non_terminated_nodes()
+        if nodes:
+            raise RuntimeError(
+                f"cluster {config.get('cluster_name')!r} has "
+                f"{len(nodes)} node(s) but no live head — run "
+                "`ray-tpu up` to recreate it")
+        raise RuntimeError(
+            f"cluster {config.get('cluster_name')!r} has no nodes "
+            "(run `ray-tpu up` first)")
+    ip = provider.node_ip(head)
+    if not ip:
+        raise RuntimeError(f"head node {head!r} has no address yet")
+    return ip
+
+
+def _ssh_base(config: dict) -> tuple[list[str], str]:
+    auth = config.get("auth", {})
+    base = ["ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
+    if auth.get("ssh_private_key"):
+        base += ["-i", auth["ssh_private_key"]]
+    return base, auth.get("ssh_user", "ray")
+
+
+def attach_command(config: dict, *,
+                   controller_addr: str | None = None) -> list[str]:
+    """argv for an interactive shell on the head (`ray-tpu attach`)."""
+    base, user = _ssh_base(config)
+    return [*base, "-tt", f"{user}@{get_head_ip(config, controller_addr=controller_addr)}"]
+
+
+def exec_command(config: dict, cmd: str, *,
+                 controller_addr: str | None = None) -> list[str]:
+    """argv running `cmd` on the head (`ray-tpu exec`)."""
+    base, user = _ssh_base(config)
+    ip = get_head_ip(config, controller_addr=controller_addr)
+    return [*base, f"{user}@{ip}", cmd]
+
+
+def submit_commands(config: dict, script: str, args: list[str] | None
+                    = None, *, controller_addr: str | None = None,
+                    ) -> list[list[str]]:
+    """argvs for `ray-tpu submit`: scp the script to the head, then run
+    it there with the cluster address in the environment."""
+    import os
+    import shlex
+
+    base, user = _ssh_base(config)
+    ip = get_head_ip(config, controller_addr=controller_addr)
+    remote = f"/tmp/{os.path.basename(script)}"
+    # scp remote paths pass through the remote shell: quote, or a script
+    # name with spaces word-splits on the far side.
+    scp = ["scp", *base[1:], script,
+           f"{user}@{ip}:{shlex.quote(remote)}"]
+    run = [*base, f"{user}@{ip}",
+           "RAY_TPU_ADDRESS=auto python " + shlex.join(
+               [remote, *(args or [])])]
+    return [scp, run]

@@ -33,6 +33,18 @@ class NodeProvider:
     def is_running(self, provider_node_id: str) -> bool:
         raise NotImplementedError
 
+    def node_ip(self, provider_node_id: str) -> str | None:
+        """Reachable address of a node (ray: NodeProvider.external_ip /
+        internal_ip); None when the provider has no address notion."""
+        return None
+
+    def head_node(self) -> str | None:
+        """The cluster's head node id (`ray-tpu up` creates one when this
+        is None; attach/exec/submit target it).  Default: the first
+        live node — providers with a real head notion override."""
+        nodes = self.non_terminated_nodes()
+        return nodes[0] if nodes else None
+
 
 class LocalNodeProvider(NodeProvider):
     """Nodes = node_agent subprocesses joined to a running controller
@@ -103,3 +115,6 @@ class LocalNodeProvider(NodeProvider):
     def is_running(self, provider_node_id: str) -> bool:
         rec = self.nodes.get(provider_node_id)
         return rec is not None and rec["proc"].poll() is None
+
+    def node_ip(self, provider_node_id: str) -> str | None:
+        return "127.0.0.1"      # local agents share the host

@@ -264,6 +264,64 @@ def cmd_up(args) -> None:
     print(json.dumps(summary, indent=2))
 
 
+def cmd_attach(args) -> None:
+    """ray: `ray attach cluster.yaml` — interactive shell on the head."""
+    import subprocess
+
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    argv = launcher.attach_command(
+        config, controller_addr=getattr(args, "address", None))
+    if args.dry_run:
+        print(json.dumps({"argv": argv}))
+        return
+    raise SystemExit(subprocess.call(argv))
+
+
+def cmd_exec(args) -> None:
+    """ray: `ray exec cluster.yaml 'cmd'` — run a command on the head."""
+    import subprocess
+
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    argv = launcher.exec_command(
+        config, args.command, controller_addr=getattr(args, "address", None))
+    if args.dry_run:
+        print(json.dumps({"argv": argv}))
+        return
+    raise SystemExit(subprocess.call(argv))
+
+
+def cmd_submit(args) -> None:
+    """ray: `ray submit cluster.yaml script.py args...` — copy + run."""
+    import subprocess
+
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    argvs = launcher.submit_commands(
+        config, args.script, args.script_args,
+        controller_addr=getattr(args, "address", None))
+    if args.dry_run:
+        print(json.dumps({"argvs": argvs}))
+        return
+    for argv in argvs:
+        rc = subprocess.call(argv)
+        if rc:
+            raise SystemExit(rc)
+
+
+def cmd_get_head_ip(args) -> None:
+    """ray: `ray get-head-ip cluster.yaml`."""
+    from ray_tpu.autoscaler import launcher
+
+    config = launcher.load_config(args.config_file)
+    print(launcher.get_head_ip(
+        config, controller_addr=getattr(args, "address", None)))
+
+
 def cmd_down(args) -> None:
     """ray: `ray down cluster.yaml` — tear the cluster down."""
     from ray_tpu.autoscaler import launcher
@@ -308,6 +366,35 @@ def main(argv: list[str] | None = None) -> None:
     sp.add_argument("--dry-run", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("attach", help="interactive ssh to the head node")
+    sp.add_argument("config_file")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_attach)
+
+    sp = sub.add_parser("exec", help="run a shell command on the head")
+    sp.add_argument("config_file")
+    sp.add_argument("command")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("submit",
+                        help="copy a script to the head and run it")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("config_file")
+    sp.add_argument("script")
+    # REMAINDER: everything after the script belongs to the script —
+    # plain nargs="*" would reject dash-prefixed args (`job.py --n 2`).
+    sp.add_argument("script_args", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("get-head-ip", help="print the head node address")
+    sp.add_argument("config_file")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_get_head_ip)
 
     sp = sub.add_parser("drain-node", help="gracefully drain one node")
     sp.add_argument("node_id")

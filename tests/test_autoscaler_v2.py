@@ -87,7 +87,10 @@ class _FakeTPUAPI(http.server.BaseHTTPRequestHandler):
         body = json.loads(self.rfile.read(n)) if n else {}
         self.nodes[node_id] = {
             "name": f"projects/p/locations/z/nodes/{node_id}",
-            "state": "READY", **body}
+            "state": "READY",
+            "networkEndpoints": [
+                {"ipAddress": f"10.0.0.{len(self.nodes) + 1}"}],
+            **body}
         self._send(200, {"name": f"operations/{node_id}"})
 
     def do_DELETE(self):

@@ -35,6 +35,7 @@ def _write_config(tmp_path, endpoint) -> str:
         "provider": {"type": "gce_tpu", "project": "proj",
                      "zone": "us-central2-b", "api_endpoint": endpoint,
                      "metadata_endpoint": endpoint},
+        "auth": {"ssh_user": "tpuuser", "ssh_private_key": "/k.pem"},
         "head_node": {"node_config": {"accelerator_type": "v5litepod-8"}},
         "worker_nodes": {"count": 2,
                          "node_config": {"accelerator_type":
@@ -63,6 +64,79 @@ def test_up_down_against_fake_gce(fake_tpu_api, tmp_path):
     downed = launcher.down(cfg)
     assert len(downed["terminated"]) == 3
     assert launcher.make_provider(cfg).non_terminated_nodes() == []
+
+
+def test_attach_exec_submit_commands(fake_tpu_api, tmp_path):
+    """`ray-tpu attach/exec/submit/get-head-ip` build the right ssh
+    argvs against the labelled head (ray: scripts.py attach/exec/submit
+    via commands.py; auth block = the reference's YAML ssh fields)."""
+    from ray_tpu.autoscaler import launcher
+
+    cfg = launcher.load_config(_write_config(tmp_path, fake_tpu_api))
+    launcher.up(cfg)
+    ip = launcher.get_head_ip(cfg)
+    assert ip.startswith("10.0.0.")
+
+    at = launcher.attach_command(cfg)
+    assert at[0] == "ssh" and at[-1] == f"tpuuser@{ip}" and "-i" in at
+    assert at[at.index("-i") + 1] == "/k.pem"
+
+    ex = launcher.exec_command(cfg, "ray-tpu status")
+    assert ex[-2] == f"tpuuser@{ip}" and ex[-1] == "ray-tpu status"
+
+    scp, run = launcher.submit_commands(cfg, "/tmp/job.py", ["--n", "2"])
+    assert scp[0] == "scp" and scp[-1] == f"tpuuser@{ip}:/tmp/job.py"
+    assert run[-1].endswith("/tmp/job.py --n 2")
+    launcher.down(cfg)
+
+
+def test_cli_ssh_front_door_dry_run(fake_tpu_api, tmp_path):
+    path = _write_config(tmp_path, fake_tpu_api)
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "up", path],
+        capture_output=True, text=True, timeout=60, check=True)
+
+    def cli(*args):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr[-1000:]
+        return out.stdout
+
+    argv = json.loads(cli("exec", path, "hostname", "--dry-run"))["argv"]
+    assert argv[0] == "ssh" and argv[-1] == "hostname"
+
+    argv = json.loads(cli("attach", path, "--dry-run"))["argv"]
+    assert argv[0] == "ssh" and "-tt" in argv
+
+    # Dash-prefixed script args must pass through to the script.
+    scp, run = json.loads(cli("submit", path, "--dry-run",
+                              "job.py", "--n", "2"))["argvs"]
+    assert scp[0] == "scp"
+    assert run[-1].endswith("/tmp/job.py --n 2")
+
+    ip = cli("get-head-ip", path).strip()
+    assert ip.startswith("10.0.0.")
+
+
+def test_head_recreated_after_preemption(fake_tpu_api, tmp_path):
+    """A dead head with live labelled workers: head_node() is None (no
+    silent worker fallback) and `up` recreates exactly one head."""
+    from ray_tpu.autoscaler import launcher
+
+    cfg = launcher.load_config(_write_config(tmp_path, fake_tpu_api))
+    launcher.up(cfg)
+    provider = launcher.make_provider(cfg)
+    head = provider.head_node()
+    provider.terminate_node(head)       # "preempted"
+    assert provider.head_node() is None
+    with pytest.raises(RuntimeError, match="no live head"):
+        launcher.get_head_ip(cfg)
+    again = launcher.up(cfg)
+    assert len(again["created"]) == 1
+    new_head = provider.head_node()
+    assert new_head is not None and new_head != head
+    launcher.down(cfg)
 
 
 def test_cli_up_down(fake_tpu_api, tmp_path):
