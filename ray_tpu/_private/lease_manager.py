@@ -57,6 +57,7 @@ class LeaseManager:
             "affinity_soft": task.header.get("affinity_soft", False),
             "label_hard": task.header.get("label_hard"),
             "label_soft": task.header.get("label_soft"),
+            "venv": (task.header.get("runtime_env") or {}).get("venv"),
             "submitter": self.core.address,
         }
         ev = self.arrivals.get(task.scheduling_key)

@@ -21,6 +21,7 @@ reference never needed for GPUs but TPU requires (SURVEY §7 hard parts).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 import logging
 import os
@@ -84,10 +85,15 @@ class WorkerHandle:
     worker_id: str
     proc: subprocess.Popen | None
     addr: str | None = None
-    state: str = "starting"        # starting | idle | leased | actor | dead
+    # starting | idle | leased | actor | stopping (evicted, awaiting
+    # reaper) | dead
+    state: str = "starting"
     lease_id: str | None = None
     submitter: str | None = None   # rpc addr of current lease holder
     is_device_worker: bool = False
+    # Isolated-interpreter workers are keyed by their venv hash and only
+    # serve leases with the same key (ray: runtime-env-keyed WorkerPool).
+    venv_key: str | None = None
     actor_ids: set[str] = field(default_factory=set)
     # actor_id -> lease header whose resources it holds
     actor_leases: dict = field(default_factory=dict)
@@ -248,7 +254,9 @@ class NodeAgent:
             self.cluster_view.pop(payload["node_id"], None)
 
     # ---------------------------------------------------------- worker pool
-    def _spawn_worker(self, device_worker: bool = False) -> WorkerHandle:
+    def _spawn_worker(self, device_worker: bool = False,
+                      python_exe: str | None = None,
+                      venv_key: str | None = None) -> WorkerHandle:
         from ray_tpu._private.ids import WorkerID
 
         worker_id = WorkerID.from_random().hex()
@@ -270,6 +278,9 @@ class NodeAgent:
         # Zygote-forked children watch the AGENT's liveness, not their
         # direct parent (the zygote).
         env["RAY_TPU_AGENT_PID"] = str(os.getpid())
+        # venv interpreters resolve ray_tpu via the .pth _ensure_venv
+        # writes into the env's site-packages (NOT PYTHONPATH, which
+        # would shadow the venv's own packages and break isolation).
         stdout_path = stderr_path = None
         if not os.environ.get("RAY_TPU_WORKER_LOGS"):
             # Per-worker log files; the agent tails them and forwards new
@@ -281,9 +292,12 @@ class NodeAgent:
             stderr_path = os.path.join(
                 self._log_dir, f"worker-{worker_id[:12]}.err")
         proc = None
-        if not device_worker and self._zygote is not None \
+        if not device_worker and python_exe is None \
+                and self._zygote is not None \
                 and self._zygote._ready.is_set():
             # ~ms warm fork; None on any zygote trouble → cold spawn.
+            # venv workers never fork from the zygote — the whole point
+            # is a DIFFERENT interpreter.
             proc = self._zygote.spawn(env, stdout_path, stderr_path)
         if proc is None:
             if stdout_path is not None:
@@ -292,13 +306,15 @@ class NodeAgent:
             else:
                 stdout = stderr = None      # inherit (debugging)
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                [python_exe or sys.executable, "-m",
+                 "ray_tpu._private.worker_main"],
                 env=env, stdout=stdout, stderr=stderr)
             if stdout is not None:
                 stdout.close()
                 stderr.close()
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
-                              is_device_worker=device_worker)
+                              is_device_worker=device_worker,
+                              venv_key=venv_key)
         self.workers[worker_id] = handle
         self._starting[worker_id] = asyncio.get_running_loop().create_future()
         return handle
@@ -317,11 +333,25 @@ class NodeAgent:
         return {"ok": True}
 
     async def _get_idle_worker(self, ignore_cap: bool = False,
-                               spawn_sem: "asyncio.Semaphore | None" = None
+                               spawn_sem: "asyncio.Semaphore | None" = None,
+                               venv: dict | None = None,
                                ) -> WorkerHandle | None:
-        for w in self.workers.values():
-            if w.state == "idle" and not w.is_device_worker:
-                return w
+        from ray_tpu._private import runtime_env as renv
+
+        vkey = renv.venv_key({"venv": venv}) if venv else None
+
+        def idle_match() -> WorkerHandle | None:
+            # venv workers serve ONLY matching-key leases and plain
+            # leases never land on them (the interpreter differs).
+            for w in self.workers.values():
+                if w.state == "idle" and not w.is_device_worker \
+                        and w.venv_key == vkey:
+                    return w
+            return None
+
+        w = idle_match()
+        if w is not None:
+            return w
         n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
         if not ignore_cap and \
                 n_alive >= self.config.max_workers_per_node:
@@ -331,22 +361,47 @@ class NodeAgent:
             # admission control — a hard worker cap would strand
             # resource-feasible actors in PENDING forever (e.g. many
             # fractional-CPU actors).
-            return None
+            # Keyed pools must not deadlock each other at the cap: a
+            # venv lease facing a pool of idle PLAIN workers (or vice
+            # versa, or a stale venv hash hogging slots) would pend
+            # forever — nothing ever returns a lease when everyone is
+            # idle.  Evict ONE idle cross-key worker to free its slot.
+            victim = next(
+                (w for w in self.workers.values()
+                 if w.state == "idle" and not w.is_device_worker
+                 and w.venv_key != vkey), None)
+            if victim is None:
+                return None
+            # "stopping": out of every idle scan, but NOT "dead" — the
+            # reaper must still run _on_worker_dead (workers-dict
+            # removal + dead-address broadcast) when the process exits.
+            victim.state = "stopping"
+            with contextlib.suppress(Exception):
+                victim.proc.terminate()
         if spawn_sem is None:
-            return await self._spawn_and_wait()
+            return await self._spawn_and_wait(venv, vkey)
         # Only the FORK is gated (idle scans above need no permit): an
         # actor burst queues its spawns 4-wide instead of stampeding N
         # interpreters at once, which makes every fork miss its timeout.
         async with spawn_sem:
             # A spawn that completed while we queued may have freed an
             # idle worker — take it instead of forking another.
-            for w in self.workers.values():
-                if w.state == "idle" and not w.is_device_worker:
-                    return w
-            return await self._spawn_and_wait()
+            w = idle_match()
+            if w is not None:
+                return w
+            return await self._spawn_and_wait(venv, vkey)
 
-    async def _spawn_and_wait(self) -> WorkerHandle | None:
-        w = self._spawn_worker()
+    async def _spawn_and_wait(self, venv: dict | None = None,
+                              vkey: str | None = None
+                              ) -> WorkerHandle | None:
+        python_exe = None
+        if venv is not None:
+            from ray_tpu._private import runtime_env as renv
+
+            # Venv builds run pip + file copies: off the event loop.
+            python_exe = await asyncio.get_running_loop().run_in_executor(
+                None, renv._ensure_venv, venv)
+        w = self._spawn_worker(python_exe=python_exe, venv_key=vkey)
         fut = self._starting.get(w.worker_id)
         if fut is not None:
             try:
@@ -711,7 +766,7 @@ class NodeAgent:
             if h.get("resources", {}).get("TPU", 0) > 0 or h.get("device_worker"):
                 w = await self._get_device_worker()
             else:
-                w = await self._get_idle_worker()
+                w = await self._get_idle_worker(venv=h.get("venv"))
         except Exception:
             self._release(h)
             raise
@@ -824,12 +879,15 @@ class NodeAgent:
                 # resources to admit them, ignore_cap would allow
                 # unbounded process forks.
                 has_demand = any(v > 0 for v in demand.values())
-                warm = (self._zygote is not None
+                venv = (h.get("creation_header", {})
+                        .get("runtime_env") or {}).get("venv")
+                warm = (venv is None and self._zygote is not None
                         and self._zygote._ready.is_set())
                 w = await self._get_idle_worker(
                     ignore_cap=has_demand,
                     spawn_sem=(self._actor_spawn_sem_warm if warm
-                               else self._actor_spawn_sem))
+                               else self._actor_spawn_sem),
+                    venv=venv)
         finally:
             if w is None or w.addr is None:
                 self._release(lease_h)

@@ -17,10 +17,13 @@ Each kind is a `RuntimeEnvPlugin`:
 
 Built-ins: env_vars, working_dir, py_modules (content-addressed zips in
 the controller KV), pip (OFFLINE: `pip install --no-index --find-links
-<wheel_dir> --target <hash-dir>`, built once per node under flock).
-conda and containers stay absent — this environment has neither a conda
-installation nor a container runtime; the plugin seam is where they
-would land.
+<wheel_dir> --target <hash-dir>`, built once per node under flock), and
+venv — the conda analog: a per-hash ISOLATED INTERPRETER
+(`python -m venv --system-site-packages` + offline wheels) that the
+node agent spawns dedicated workers with (see _ensure_venv).  conda
+itself and containers stay absent — this environment has neither a
+conda installation nor a container runtime; venv covers the isolated-
+interpreter semantics and the plugin seam is where the rest would land.
 
 Custom kinds ship BY VALUE: `runtime_env={"plugins": [MyPlugin(...)]}`
 cloudpickles the instances into the descriptor, so a plugin defined in
@@ -189,49 +192,63 @@ def _pip_env_hash(pip_desc: dict) -> str:
         digest_size=16).hexdigest()
 
 
-def _ensure_pip_env(pip_desc: dict) -> str:
-    """Node-local build-once per env hash (ray: pip.py _install_pip
-    building the per-hash virtualenv, keyed and locked the same way).
-    Offline: --no-index --find-links only."""
+def _build_once(kind: str, desc: dict, build_fn) -> str:
+    """Node-local build-once per env hash (ray: pip.py _install_pip,
+    keyed and locked the same way): fast path on a .ready marker,
+    flock + double-check, build into a scratch dir, atomic rename.
+    A crash-killed build must never leave a half-copied target that a
+    later build would skip over, hence scratch + rename.
+    `build_fn(tmp_dir)` populates the scratch dir (and raises on
+    failure); returns the target dir."""
     import fcntl
-    import subprocess
+    import shutil
 
-    h = _pip_env_hash(pip_desc)
-    target = os.path.join(_EXTRACT_ROOT, "pip", h)
+    h = _pip_env_hash(desc)
+    target = os.path.join(_EXTRACT_ROOT, kind, h)
     marker = os.path.join(target, ".ready")
     if os.path.exists(marker):
         return target
     os.makedirs(os.path.dirname(target), exist_ok=True)
-    lock_path = target + ".lock"
-    with open(lock_path, "w") as lock:
+    with open(target + ".lock", "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
             if os.path.exists(marker):      # built while we waited
                 return target
-            # Build into a scratch dir + atomic rename: a crash-killed
-            # build must never leave a half-copied target that a later
-            # `pip install --target` would skip over (pip refuses to
-            # replace an existing dir without --upgrade).
-            import shutil
-
             tmp = target + ".build"
             shutil.rmtree(tmp, ignore_errors=True)
             shutil.rmtree(target, ignore_errors=True)
-            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
-                   "--no-index", "--find-links", pip_desc["wheel_dir"],
-                   "--target", tmp, *pip_desc["packages"]]
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=600)
-            if proc.returncode != 0:
+            try:
+                build_fn(tmp)
+            except BaseException:
                 shutil.rmtree(tmp, ignore_errors=True)
-                raise RuntimeError(
-                    f"pip runtime_env build failed: {proc.stderr[-2000:]}")
+                raise
             with open(os.path.join(tmp, ".ready"), "w") as f:
                 f.write("ok")
             os.rename(tmp, target)
             return target
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _pip_install_offline(wheel_dir: str, packages: list, site: str) -> None:
+    """`pip install --no-index --find-links <wheel_dir> --target <site>`
+    — the only package source in a zero-egress environment."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+           "--no-index", "--find-links", wheel_dir,
+           "--target", site, *packages]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"runtime_env pip build failed: {proc.stderr[-2000:]}")
+
+
+def _ensure_pip_env(pip_desc: dict) -> str:
+    return _build_once(
+        "pip", pip_desc,
+        lambda tmp: _pip_install_offline(
+            pip_desc["wheel_dir"], pip_desc["packages"], tmp))
 
 
 class PipPlugin(RuntimeEnvPlugin):
@@ -283,9 +300,98 @@ class PipPlugin(RuntimeEnvPlugin):
         importlib.invalidate_caches()
 
 
+def _ensure_venv(desc: dict) -> str:
+    """Node-local ISOLATED INTERPRETER per env hash — the conda analog
+    (ray: runtime_env/conda.py building a dedicated env and running the
+    worker with its python).  `python -m venv --system-site-packages`
+    (jax/torch stay importable), offline wheels installed into its
+    site-packages, built once per node via _build_once.  Returns the
+    venv's python executable; the node agent spawns a DEDICATED worker
+    with it (workers are keyed by env, like the reference's
+    runtime-env-keyed WorkerPool, worker_pool.h:159) — in-process
+    activation cannot swap interpreters, so this kind is the one that
+    routes through spawn."""
+
+    def build(tmp: str) -> None:
+        import venv as venv_mod
+
+        venv_mod.create(tmp, system_site_packages=True,
+                        with_pip=False, symlinks=True)
+        site = os.path.join(
+            tmp, "lib",
+            f"python{sys.version_info.major}.{sys.version_info.minor}",
+            "site-packages")
+        if desc.get("packages"):
+            _pip_install_offline(desc["wheel_dir"], desc["packages"], site)
+        # Make ray_tpu resolvable from the venv interpreter via a .pth
+        # (appended AFTER the venv's own site-packages, so env packages
+        # SHADOW the agent's — a PYTHONPATH entry would invert that and
+        # defeat version isolation).  Covers the repo-checkout case;
+        # a pip-installed ray_tpu is already visible via system site.
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        pkg_parent = os.path.dirname(pkg_parent)   # ray_tpu/ -> its parent
+        with open(os.path.join(site, "ray_tpu_agent_path.pth"), "w") as f:
+            f.write(pkg_parent + "\n")
+
+    target = _build_once("venv", desc, build)
+    return os.path.join(target, "bin", "python")
+
+
+class VenvPlugin(RuntimeEnvPlugin):
+    """Isolated-interpreter env kind (`runtime_env={"venv": {...}}`).
+
+    Unlike every other kind, the env IS the process: tasks/actors with a
+    venv env schedule onto dedicated workers the agent spawns with the
+    venv's python (lease headers carry the desc; node_agent keys workers
+    by its hash).  activate() is therefore a sanity check, not a setup.
+    """
+
+    name = "venv"
+    priority = 1
+
+    def prepare(self, value, core):
+        if value is True or value is None:
+            value = {}
+        reqs = sorted(value.get("packages", ()))
+        wheel_dir = value.get("wheel_dir") \
+            or os.environ.get("RAY_TPU_WHEEL_DIR")
+        if reqs and not wheel_dir:
+            raise ValueError(
+                "venv runtime_env with packages needs a local wheel "
+                'source (no egress): {"venv": {"packages": [...], '
+                '"wheel_dir": ...}} or RAY_TPU_WHEEL_DIR')
+        out = {"packages": reqs}
+        if wheel_dir:
+            out["wheel_dir"] = os.path.abspath(wheel_dir)
+        return out
+
+    def fetch(self, wire, core) -> None:
+        _ensure_venv(wire)
+
+    def activate(self, wire, core, ctx: dict) -> None:
+        # The agent routed this task to a worker ALREADY RUNNING the
+        # venv's interpreter; nothing to do but verify we are in it.
+        expect = os.path.join(_EXTRACT_ROOT, "venv", _pip_env_hash(wire))
+        if not sys.prefix.startswith(expect):
+            raise RuntimeError(
+                f"venv runtime_env task ran outside its env "
+                f"(prefix {sys.prefix}, want {expect}) — agent routing "
+                "bug")
+
+
+def venv_key(desc: dict | None) -> str | None:
+    """Worker-pool key for a runtime env descriptor's venv kind (None =
+    plain pooled worker).  Used by the submit path (scheduling keys),
+    lease headers, and the agent's keyed worker match."""
+    if not desc or "venv" not in desc:
+        return None
+    return _pip_env_hash(desc["venv"])
+
+
 _BUILTINS: dict[str, RuntimeEnvPlugin] = {
     p.name: p for p in (EnvVarsPlugin(), WorkingDirPlugin(),
-                        PyModulesPlugin(), PipPlugin())
+                        PyModulesPlugin(), PipPlugin(), VenvPlugin())
 }
 
 # Driver-side registry for additional kinds usable by dict key
@@ -304,14 +410,16 @@ class RuntimeEnv(dict):
 
     Built-in keys: env_vars (dict), working_dir (path), py_modules (list
     of paths), pip (list of requirements, or {"packages": [...],
-    "wheel_dir": path} for offline resolution).  `plugins` takes a list
-    of RuntimeEnvPlugin INSTANCES; registered plugin names are accepted
-    as extra keys."""
+    "wheel_dir": path} for offline resolution), venv ({"packages": [...],
+    "wheel_dir": path} or True — isolated interpreter, the conda analog).
+    `plugins` takes a list of RuntimeEnvPlugin INSTANCES; registered
+    plugin names are accepted as extra keys."""
 
     def __init__(self, env_vars: dict | None = None,
                  working_dir: str | None = None,
                  py_modules: list | None = None,
                  pip: list | dict | None = None,
+                 venv: dict | bool | None = None,
                  plugins: list | None = None, **kwargs):
         unknown = set(kwargs) - set(_registered)
         if unknown:
@@ -328,6 +436,8 @@ class RuntimeEnv(dict):
             self["py_modules"] = list(py_modules)
         if pip:
             self["pip"] = pip
+        if venv:
+            self["venv"] = venv
         if plugins:
             self["plugins"] = list(plugins)
         self.update(kwargs)

@@ -587,11 +587,16 @@ class CoreWorker:
             bundle_key, options)
         retries = options.get("max_retries",
                               self.config.default_task_max_retries)
+        # venv tasks must not share leases with plain tasks — the worker
+        # pool is keyed by env (runtime_env.venv_key on the agent side).
+        venv_desc = (header.get("runtime_env") or {}).get("venv")
         scheduling_key = (fid, _freeze(resources), bundle_key,
                           options.get("affinity_node_id"),
                           options.get("affinity_soft", False),
                           _freeze(options.get("label_hard") or {}),
-                          _freeze(options.get("label_soft") or {}))
+                          _freeze(options.get("label_soft") or {}),
+                          _freeze(venv_desc)
+                          if venv_desc is not None else None)
         task = PendingTask(
             task_id=task_id.binary(), header=header, blobs=blobs,
             return_ids=return_ids, retries_left=max(0, retries),
@@ -875,6 +880,15 @@ class CoreWorker:
 
             header["runtime_env"] = renv.prepare(
                 options["runtime_env"], self)
+            if header["runtime_env"].get("venv") is not None \
+                    and resources.get("TPU", 0) > 0:
+                # The device worker is a per-host singleton on the
+                # agent's interpreter; it cannot be respawned per env.
+                raise ValueError(
+                    "venv runtime_env is unsupported for TPU "
+                    "tasks/actors: the device worker owns the chip and "
+                    "cannot run an isolated interpreter (use pip/"
+                    "py_modules kinds instead)")
         if options.get("affinity_node_id"):
             header["affinity_node_id"] = options["affinity_node_id"]
             header["affinity_soft"] = options.get("affinity_soft", False)

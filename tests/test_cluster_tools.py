@@ -234,6 +234,101 @@ def test_runtime_env_pip_offline(rt, tmp_path):
     assert ray_tpu.get(with_pkg.options(runtime_env=env2).remote()) == 43
 
 
+def test_runtime_env_venv_isolated_interpreter(rt, tmp_path):
+    """venv runtime env = a DEDICATED worker on an isolated interpreter
+    (the conda analog; ray: runtime_env/conda.py + the env-keyed
+    WorkerPool).  The env's tasks run under the venv prefix with its
+    offline-installed package; plain workers never see either."""
+    import sys
+
+    wheel_dir = tmp_path / "wheels"
+    wheel_dir.mkdir()
+    _make_wheel(wheel_dir, "venvonlypkg", "1.0", "VALUE = 7\n")
+
+    @ray_tpu.remote
+    def probe():
+        import venvonlypkg
+
+        return sys.prefix, venvonlypkg.VALUE
+
+    @ray_tpu.remote
+    def plain():
+        try:
+            import venvonlypkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return sys.prefix
+
+    env = {"venv": {"packages": ["venvonlypkg"],
+                    "wheel_dir": str(wheel_dir)}}
+    prefix, val = ray_tpu.get(
+        probe.options(runtime_env=env).remote(), timeout=180)
+    assert val == 7
+    assert "/venv/" in prefix and prefix != sys.prefix
+    assert ray_tpu.get(plain.remote(), timeout=60) != prefix
+
+    # Same env hash reuses the same dedicated worker (keyed pool);
+    # actors route through the venv path too.
+    @ray_tpu.remote
+    class EnvActor:
+        def where(self):
+            return sys.prefix
+
+    a = EnvActor.options(runtime_env=env).remote()
+    assert ray_tpu.get(a.where.remote(), timeout=180) == prefix
+    ray_tpu.kill(a)
+
+
+def test_venv_lease_evicts_idle_worker_at_cap(tmp_path):
+    """Keyed pools must not deadlock at the worker cap: with the pool
+    full of idle PLAIN workers, a venv lease evicts one and completes
+    (before the fix it pended forever — nothing returns a lease when
+    everyone is idle)."""
+    import sys
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    wheel_dir = tmp_path / "wheels"
+    wheel_dir.mkdir()
+    _make_wheel(wheel_dir, "capevictpkg", "1.0", "VALUE = 1\n")
+    ray_tpu.init(resources={"CPU": 4},
+                 _system_config={"max_workers_per_node": 1})
+    try:
+        @ray_tpu.remote
+        def plain():
+            return sys.prefix
+
+        @ray_tpu.remote
+        def in_venv():
+            import capevictpkg
+
+            return sys.prefix, capevictpkg.VALUE
+
+        plain_prefix = ray_tpu.get(plain.remote(), timeout=60)
+        env = {"venv": {"packages": ["capevictpkg"],
+                        "wheel_dir": str(wheel_dir)}}
+        prefix, val = ray_tpu.get(
+            in_venv.options(runtime_env=env).remote(), timeout=180)
+        assert val == 1 and prefix != plain_prefix
+        # ...and back: a plain task evicts the idle venv worker.
+        assert ray_tpu.get(plain.remote(), timeout=60) == plain_prefix
+    finally:
+        ray_tpu.shutdown()
+        # Restore the module-shared runtime (the module-scoped `rt`
+        # fixture only inits on first use; later tests expect it live).
+        ray_tpu.init(resources={"CPU": 4})
+
+
+def test_venv_rejected_for_tpu_tasks(rt):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported for TPU"):
+        f.options(num_tpus=1, runtime_env={"venv": True}).remote()
+
+
 def test_cli_status_and_list(rt):
     """Smoke the CLI code paths in-process (full subprocess CLI covered by
     job submission)."""
