@@ -4,7 +4,9 @@ logical-axes pytrees consumed by ray_tpu.parallel)."""
 from ray_tpu.models.llama import (LlamaConfig, llama_configs, init_params,
                                   forward, loss_fn, param_logical_axes)
 from ray_tpu.models.resnet import ResNetConfig, resnet_configs
+from ray_tpu.models.vit import ViTConfig, vit_configs
 
 __all__ = ["LlamaConfig", "llama_configs", "init_params", "forward",
            "loss_fn", "param_logical_axes",
-           "ResNetConfig", "resnet_configs"]
+           "ResNetConfig", "resnet_configs",
+           "ViTConfig", "vit_configs"]

@@ -163,8 +163,7 @@ def forward(params: dict, images: jnp.ndarray,
 
 def loss_fn(params: dict, batch: dict, cfg: ResNetConfig) -> jnp.ndarray:
     """Cross-entropy on {'images': [N,H,W,3], 'labels': [N]}."""
+    from ray_tpu.models.llama import cross_entropy
+
     logits = forward(params, batch["images"], cfg)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(logp, batch["labels"][:, None],
-                               axis=-1)[:, 0]
-    return jnp.mean(nll)
+    return cross_entropy(logits, batch["labels"])

@@ -1,0 +1,190 @@
+"""Vision Transformer — the attention-based vision family.
+
+Reference-side analog: vision models arrive via torchvision inside Ray
+Train loops (e.g. the ResNet release benchmark,
+/root/reference/release/air_tests/air_benchmarks/workloads/ — Ray itself
+ships no model code).  Here the model is TPU-native like models/llama:
+
+- Patchify is a RESHAPE + MATMUL, not a conv: [b,H,W,C] -> [b,nP,P*P*C]
+  @ [P*P*C,dim] rides the MXU directly with no im2col materialization
+  (a P-stride conv and this matmul are the same FLOPs; the matmul form
+  is what XLA tiles best).
+- Encoder blocks are ONE scanned body (lax.scan over stacked params)
+  with jax.checkpoint, exactly like llama.run_trunk — layer count never
+  unrolls the HLO.
+- Shardings are logical axes through parallel/sharding.py: patch/embed
+  dims over "fsdp", heads/mlp over "tensor", batch over data x fsdp —
+  the same rules table the LLM uses, so DP/FSDP/TP compose untouched.
+- Attention is non-causal through ops.attention (XLA path on short
+  token counts; flash kernel gates itself on seq length/platform).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import layernorm
+from ray_tpu.parallel.sharding import with_sharding_constraint
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    n_classes: int = 1000
+    norm_eps: float = 1e-6
+    remat: bool = True
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def vit_configs() -> dict:
+    """Named sizes (ViT-B/16 et al.; debug size for tests)."""
+    return {
+        "vit-debug": ViTConfig(image_size=32, patch_size=8, dim=64,
+                               n_layers=2, n_heads=4, mlp_dim=128,
+                               n_classes=10),
+        "vit-b16": ViTConfig(),
+        "vit-l16": ViTConfig(dim=1024, n_layers=24, n_heads=16,
+                             mlp_dim=4096),
+    }
+
+
+def param_logical_axes(cfg: ViTConfig) -> dict:
+    return {
+        "patch_embed": (None, "embed"),
+        "pos_embed": (None, "embed"),
+        "cls_token": (None,),
+        "layers": {
+            "ln1_scale": ("layers", None),
+            "ln1_bias": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ln2_scale": ("layers", None),
+            "ln2_bias": ("layers", None),
+            "w_up": ("layers", "embed", "mlp"),
+            "b_up": ("layers", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "b_down": ("layers", None),
+        },
+        "final_ln_scale": (None,),
+        "final_ln_bias": (None,),
+        "head": ("embed", None),
+        "head_bias": (None,),
+    }
+
+
+def init_params(key: jax.Array, cfg: ViTConfig) -> dict:
+    d, L = cfg.dim, cfg.n_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * cfg.channels
+    keys = jax.random.split(key, 9)
+    dt = cfg.jax_dtype
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    return {
+        "patch_embed": norm_init(keys[0], (patch_dim, d), patch_dim),
+        "pos_embed": norm_init(keys[1], (cfg.n_patches + 1, d), d),
+        "cls_token": jnp.zeros((d,), dt),
+        "layers": {
+            "ln1_scale": jnp.ones((L, d), dt),
+            "ln1_bias": jnp.zeros((L, d), dt),
+            "wq": norm_init(keys[2], (L, d, d), d),
+            "wk": norm_init(keys[3], (L, d, d), d),
+            "wv": norm_init(keys[4], (L, d, d), d),
+            "wo": norm_init(keys[5], (L, d, d), d),
+            "ln2_scale": jnp.ones((L, d), dt),
+            "ln2_bias": jnp.zeros((L, d), dt),
+            "w_up": norm_init(keys[6], (L, d, cfg.mlp_dim), d),
+            "b_up": jnp.zeros((L, cfg.mlp_dim), dt),
+            "w_down": norm_init(keys[7], (L, cfg.mlp_dim, d), cfg.mlp_dim),
+            "b_down": jnp.zeros((L, d), dt),
+        },
+        "final_ln_scale": jnp.ones((d,), dt),
+        "final_ln_bias": jnp.zeros((d,), dt),
+        "head": norm_init(keys[8], (d, cfg.n_classes), d),
+        "head_bias": jnp.zeros((cfg.n_classes,), jnp.float32),
+    }
+
+
+def patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[b, H, W, C] -> [b, n_patches, P*P*C] with pure reshapes/transposes
+    (data stays put per device; the embed matmul that follows is where
+    the FLOPs go)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)            # [b, gh, gw, p, p, c]
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def _encoder_block(x, lp, cfg: ViTConfig):
+    b, s, d = x.shape
+    h = layernorm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    o = attention(q, k, v, causal=False)
+    x = x + (o.reshape(b, s, d) @ lp["wo"])
+    h = layernorm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
+    h = jax.nn.gelu((h @ lp["w_up"] + lp["b_up"]).astype(jnp.float32)
+                    ).astype(x.dtype)
+    h = with_sharding_constraint(h, ("batch", "seq", "mlp"))
+    return x + ((h @ lp["w_down"]) + lp["b_down"])
+
+
+def forward(params: dict, images: jnp.ndarray, cfg: ViTConfig,
+            ) -> jnp.ndarray:
+    """images [b, H, W, C] float -> logits [b, n_classes] float32."""
+    b = images.shape[0]
+    x = patchify(images.astype(cfg.jax_dtype), cfg) @ params["patch_embed"]
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+    x = with_sharding_constraint(x, ("batch", "seq", None))
+
+    def layer(carry, lp):
+        out = _encoder_block(carry, lp, cfg)
+        return with_sharding_constraint(out, ("batch", "seq", None)), None
+
+    body = layer
+    if cfg.remat:
+        body = jax.checkpoint(layer)
+    x, _ = lax.scan(body, x, params["layers"])
+    x = layernorm(x, params["final_ln_scale"], params["final_ln_bias"],
+                  cfg.norm_eps)
+    cls_out = x[:, 0, :]
+    return (cls_out @ params["head"]).astype(jnp.float32) \
+        + params["head_bias"]
+
+
+def loss_fn(params: dict, batch: dict, cfg: ViTConfig) -> jnp.ndarray:
+    """Softmax cross entropy; batch = {"images": [b,H,W,C],
+    "labels": [b] int32}."""
+    from ray_tpu.models.llama import cross_entropy
+
+    logits = forward(params, batch["images"], cfg)
+    return cross_entropy(logits, batch["labels"])

@@ -18,3 +18,16 @@ def rmsnorm(x: jnp.ndarray, weight: jnp.ndarray,
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
     return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+              eps: float = 1e-6) -> jnp.ndarray:
+    """Pre-LN transformer norm (ViT-style models); fp32 accumulation like
+    rmsnorm, same fuse-into-neighbors rationale."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    normed = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(dtype)
