@@ -276,14 +276,8 @@ def split_batch(batch: dict) -> tuple[jnp.ndarray, jnp.ndarray]:
     return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
 
 
-def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
-                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Next-token CE, optionally masked (pad tokens excluded)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return nll.mean()
+# Shared across model families; re-exported here for API stability.
+from ray_tpu.ops.losses import cross_entropy  # noqa: E402,F401
 
 
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> jnp.ndarray:

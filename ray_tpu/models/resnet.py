@@ -163,7 +163,7 @@ def forward(params: dict, images: jnp.ndarray,
 
 def loss_fn(params: dict, batch: dict, cfg: ResNetConfig) -> jnp.ndarray:
     """Cross-entropy on {'images': [N,H,W,3], 'labels': [N]}."""
-    from ray_tpu.models.llama import cross_entropy
+    from ray_tpu.ops.losses import cross_entropy
 
     logits = forward(params, batch["images"], cfg)
     return cross_entropy(logits, batch["labels"])

@@ -184,7 +184,7 @@ def forward(params: dict, images: jnp.ndarray, cfg: ViTConfig,
 def loss_fn(params: dict, batch: dict, cfg: ViTConfig) -> jnp.ndarray:
     """Softmax cross entropy; batch = {"images": [b,H,W,C],
     "labels": [b] int32}."""
-    from ray_tpu.models.llama import cross_entropy
+    from ray_tpu.ops.losses import cross_entropy
 
     logits = forward(params, batch["images"], cfg)
     return cross_entropy(logits, batch["labels"])

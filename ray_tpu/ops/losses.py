@@ -1,0 +1,22 @@
+"""Shared loss functions (model-agnostic, like ops/norms).
+
+The reference keeps losses inside each torch model; here every model
+family (llama/moe decoder trunks, resnet, vit) shares the one fp32
+softmax cross entropy so numerics policy lives in exactly one place.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Next-token / classification CE, optionally masked (pad tokens
+    excluded).  Works on [..., n_classes] logits with [...] int targets.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()

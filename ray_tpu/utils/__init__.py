@@ -1,6 +1,7 @@
 """Utility layer: placement groups, scheduling strategies, actor pool,
 distributed queue, collectives (analog of ray: python/ray/util/)."""
 from ray_tpu.utils.actor_pool import ActorPool
+from ray_tpu.utils.check_serialize import inspect_serializability
 from ray_tpu.utils.placement_group import (placement_group,
                                            placement_group_table,
                                            remove_placement_group)
@@ -11,5 +12,5 @@ from ray_tpu.utils.scheduling_strategies import (
 __all__ = [
     "placement_group", "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "ActorPool", "Queue",
+    "ActorPool", "Queue", "inspect_serializability",
 ]

@@ -1,0 +1,139 @@
+"""Serialization debugging: find WHICH captured object cannot pickle.
+
+Analog of ray: python/ray/util/check_serialize.py
+(inspect_serializability: recursively probes a function's closure /
+globals / an object's attributes with cloudpickle and reports the
+deepest failing members).  Re-designed around a plain recursive probe
+that returns structured findings (the reference prints a colorama tree;
+here the report is data first, text second — callers and tests consume
+the tuples, __str__ renders the tree)."""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any
+
+import cloudpickle
+
+
+@dataclass(eq=False)   # identity hash: instances go in the result set
+class FailureTuple:
+    """One non-serializable member: the object, the name it was reached
+    by, and the object that references it."""
+
+    obj: Any
+    name: str
+    parent: Any
+
+    def __repr__(self):
+        return f"FailTuple({self.name} [obj={self.obj!r}, " \
+               f"parent={self.parent!r}])"
+
+
+@dataclass
+class SerializationReport:
+    serializable: bool
+    failures: list = field(default_factory=list)
+    trace: list = field(default_factory=list)
+
+    def __str__(self):
+        lines = list(self.trace)
+        if self.failures:
+            lines.append("non-serializable members:")
+            lines += [f"  {f!r}" for f in self.failures]
+        return "\n".join(lines)
+
+
+def _try_pickle(obj: Any) -> Exception | None:
+    try:
+        cloudpickle.dumps(obj)
+        return None
+    except Exception as e:  # noqa: BLE001 - the probe exists to catch all
+        return e
+
+
+def _probe_members(obj: Any, name: str, report: SerializationReport,
+                   depth: int, seen: set) -> None:
+    """Recurse into the members cloudpickle would serialize, recording
+    the DEEPEST failing ones (a failing leaf explains its parents)."""
+    if depth <= 0 or id(obj) in seen:
+        report.failures.append(FailureTuple(obj, name, None))
+        return
+    seen.add(id(obj))
+
+    members: list[tuple[str, Any, Any]] = []   # (name, member, parent)
+    if inspect.isfunction(obj):
+        try:
+            closure = inspect.getclosurevars(obj)
+        except (TypeError, ValueError):
+            closure = None
+        if closure is not None:
+            members += [(f"{name}.<global {k}>", v, obj)
+                        for k, v in closure.globals.items()]
+            members += [(f"{name}.<closure {k}>", v, obj)
+                        for k, v in closure.nonlocals.items()]
+        # Default argument values ride the pickle too (cloudpickle
+        # serializes __defaults__/__kwdefaults__ by value).
+        try:
+            params = inspect.signature(obj).parameters
+            members += [(f"{name}.<default {k}>", p.default, obj)
+                        for k, p in params.items()
+                        if p.default is not inspect.Parameter.empty]
+        except (TypeError, ValueError):
+            pass
+    elif inspect.isclass(obj):
+        # The class's OWN dict (a mappingproxy): methods and class
+        # attributes — the primary actor-class diagnosis case.
+        members += [(f"{name}.{k}", v, obj)
+                    for k, v in vars(obj).items()
+                    if not k.startswith("__")]
+    else:
+        state = getattr(obj, "__dict__", None)
+        if hasattr(state, "items"):
+            members += [(f"{name}.{k}", v, obj) for k, v in state.items()]
+
+    found_deeper = False
+    for mname, member, parent in members:
+        err = _try_pickle(member)
+        if err is None:
+            continue
+        report.trace.append(f"{mname}: {type(err).__name__}: {err}")
+        sub = SerializationReport(False)
+        _probe_members(member, mname, sub, depth - 1, seen)
+        if sub.failures:
+            report.failures += sub.failures
+            report.trace += sub.trace
+        else:
+            report.failures.append(FailureTuple(member, mname, parent))
+        found_deeper = True
+    if not found_deeper:
+        # The object itself is the leaf failure.
+        report.failures.append(FailureTuple(obj, name, None))
+
+
+def inspect_serializability(obj: Any, name: str | None = None,
+                            depth: int = 3, print_file=None,
+                            ) -> tuple[bool, set]:
+    """Probe `obj` for cloudpickle serializability.
+
+    Returns (serializable, set_of_FailureTuple) like the reference
+    (`ray.util.inspect_serializability`); prints the findings to
+    `print_file` (default stdout) when not serializable.
+    """
+    name = name or getattr(obj, "__qualname__", None) or repr(obj)
+    err = _try_pickle(obj)
+    if err is None:
+        return True, set()
+    report = SerializationReport(False)
+    report.trace.append(f"{name}: {type(err).__name__}: {err}")
+    _probe_members(obj, name, report, depth, set())
+    # De-dup by (name, id(obj)): the same leaf can be reached through
+    # several parents.  The printed tree renders the SAME deduped set
+    # the caller gets.
+    uniq: dict[tuple, FailureTuple] = {}
+    for f in report.failures:
+        uniq[(f.name, id(f.obj))] = f
+    report.failures = list(uniq.values())
+    report.trace = list(dict.fromkeys(report.trace))
+    print(str(report), file=print_file)
+    return False, set(report.failures)
