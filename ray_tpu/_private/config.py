@@ -48,6 +48,14 @@ class Config:
     transfer_chunks_in_flight: int = 8
     # Idle seconds before a leased worker is returned to the pool.
     lease_idle_timeout_s: float = 1.0
+    # Max seconds a lease request parks agent-side waiting for capacity
+    # before the agent answers {"retry": True} and drops the entry.  The
+    # park must stay well under the client's RPC timeout: a grant fired
+    # into a future whose client already gave up would lease a worker to
+    # nobody — the submitter is alive, so the probe never reaps it, and
+    # the leak is permanent (each cycle wedges one more worker until the
+    # node can grant nothing at all).
+    lease_park_s: float = 20.0
     # Workers prestarted per node agent at boot.
     prestart_workers: int = 2
     # Hard cap on worker processes per node agent.

@@ -148,13 +148,21 @@ class LeaseManager:
     async def _acquire_lease(self, key: tuple) -> dict | None:
         header = self.headers[key]
         addr = self.core.agent_addr
-        for _hop in range(8):
+        hops = 8
+        while hops > 0:
             try:
                 reply, _ = await self.core.clients.get(addr).call(
                     "request_lease", header, timeout=300.0)
             except Exception as e:  # noqa: BLE001
-                logger.warning("lease request to %s failed: %s", addr, e)
+                logger.warning("lease request to %s failed: %r", addr, e)
                 return None
+            if reply.get("retry"):
+                # The agent's bounded park expired with the node still
+                # busy: re-request (the park IS the backoff, so this
+                # stays quiet).  Not a hop — a saturated cluster must
+                # wait indefinitely, exactly like a queued task.
+                continue
+            hops -= 1
             if reply.get("granted"):
                 # The agent vouches a live worker holds this address.
                 self.core._revive_addr(reply["worker_addr"])

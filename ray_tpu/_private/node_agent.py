@@ -599,6 +599,12 @@ class NodeAgent:
 
     async def _on_worker_dead(self, w: WorkerHandle) -> None:
         prev_state = w.state
+        # Capture BEFORE _release_lease_resources nulls them — the
+        # worker_died notify below must name the lease and reach the
+        # submitter, or the submitter only learns of the death from the
+        # (slower, controller-relayed) dead-address broadcast.
+        dead_lease_id = w.lease_id
+        dead_submitter = w.submitter
         w.state = "dead"
         fut = self._starting.pop(w.worker_id, None)
         if fut and not fut.done():
@@ -622,11 +628,11 @@ class NodeAgent:
                     timeout=10.0)
             except Exception:  # noqa: BLE001
                 pass
-        if prev_state == "leased" and w.submitter:
+        if prev_state == "leased" and dead_submitter:
             try:
-                await self.clients.get(w.submitter).notify(
+                await self.clients.get(dead_submitter).notify(
                     "worker_died", {"worker_addr": w.addr,
-                                    "lease_id": w.lease_id,
+                                    "lease_id": dead_lease_id,
                                     "oom": w.oom_killed})
             except Exception:  # noqa: BLE001
                 pass
@@ -750,17 +756,56 @@ class NodeAgent:
                                      label_soft=label_soft)
             if target is not None and h.get("allow_spill", True):
                 return {"spill_to": self.cluster_view[target]["agent_addr"]}
+        return await self._park(h)
+
+    async def _park(self, h: dict) -> dict:
+        """Queue a lease request until capacity frees — but only for a
+        bounded window.  The waiting client times out and re-requests;
+        if the agent kept the entry past that, a later grant would fire
+        into a future nobody reads: the worker goes "leased", its
+        resources stay acquired, and (the submitter being alive) the
+        dead-submitter probe never reaps it.  Answer {"retry": True}
+        before the client gives up so both sides stay in sync."""
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append(PendingLease(h, fut))
-        return await fut
+        p = PendingLease(h, fut)
+        self._pending.append(p)
+        try:
+            return await asyncio.wait_for(fut, self.config.lease_park_s)
+        except asyncio.TimeoutError:
+            with contextlib.suppress(ValueError):
+                self._pending.remove(p)
+            # set_result may have landed in the same tick the timeout
+            # fired (wait_for still raises): the grant sits in a future
+            # nobody else reads — roll it back.
+            if fut.done() and not fut.cancelled():
+                if fut.exception() is None and \
+                        fut.result().get("granted"):
+                    self._ungrant(fut.result())
+            return {"retry": True}
+
+    def _ungrant(self, reply: dict) -> None:
+        """Release a lease by id: free its resources and return the
+        worker to the pool.  Serves both the normal return_lease path
+        and the rollback of a grant whose waiter vanished mid-flight
+        (its park timed out while _grant was running) — the two MUST
+        stay one code path or the rollback silently diverges."""
+        entry = self._leases.pop(reply.get("lease_id"), None)
+        if entry:
+            worker_id, header = entry
+            self._release(header)
+            w = self.workers.get(worker_id)
+            if w is not None:
+                w.lease_id = None
+                w.submitter = None
+                if not w.is_device_worker and w.state == "leased":
+                    w.state = "idle"
+        self._try_grant_pending()
 
     async def _grant(self, h: dict) -> dict:
         # Check + reserve resources BEFORE any await so concurrent lease
         # requests cannot double-book the same capacity while a worker spawns.
         if not self._resources_fit(h):
-            fut = asyncio.get_running_loop().create_future()
-            self._pending.append(PendingLease(h, fut))
-            return await fut
+            return await self._park(h)
         self._acquire(h)
         try:
             if h.get("resources", {}).get("TPU", 0) > 0 or h.get("device_worker"):
@@ -772,9 +817,7 @@ class NodeAgent:
             raise
         if w is None or w.addr is None:
             self._release(h)
-            fut = asyncio.get_running_loop().create_future()
-            self._pending.append(PendingLease(h, fut))
-            return await fut
+            return await self._park(h)
         lease_id = f"{self.node_id}-{next(self._lease_seq)}"
         if not w.is_device_worker:
             w.state = "leased"
@@ -785,17 +828,7 @@ class NodeAgent:
                 "worker_id": w.worker_id, "node_id": self.node_id}
 
     async def rpc_return_lease(self, h: dict, _b: list) -> dict:
-        entry = self._leases.pop(h["lease_id"], None)
-        if entry:
-            worker_id, header = entry
-            self._release(header)
-            w = self.workers.get(worker_id)
-            if w is not None:
-                w.lease_id = None
-                w.submitter = None
-                if not w.is_device_worker and w.state == "leased":
-                    w.state = "idle"
-        self._try_grant_pending()
+        self._ungrant(h)
         return {}
 
     def _try_grant_pending(self) -> None:
@@ -817,8 +850,14 @@ class NodeAgent:
             if not p.fut.done():
                 p.fut.set_exception(e)
             return
-        if not p.fut.done():
-            p.fut.set_result(reply)
+        if p.fut.done():
+            # The waiter's park expired (wait_for cancelled the future)
+            # while _grant ran: nobody will read this reply.  Undo the
+            # grant or the worker stays leased-to-nobody forever.
+            if reply.get("granted"):
+                self._ungrant(reply)
+            return
+        p.fut.set_result(reply)
 
     # --------------------------------------------------------------- actors
     async def rpc_drain(self, h: dict, _b: list) -> dict:

@@ -280,6 +280,62 @@ def test_runtime_env_venv_isolated_interpreter(rt, tmp_path):
     ray_tpu.kill(a)
 
 
+def test_lease_park_is_bounded_and_node_recovers():
+    """A lease request that can't be satisfied parks agent-side for at
+    most `lease_park_s`, then gets an explicit {"retry": True} reply.
+    Before the fix the agent parked forever: the client timed out, and
+    when capacity freed the agent granted a lease into a future nobody
+    read — a worker leased-to-nobody that the dead-submitter probe never
+    reaps (the submitter is alive), wedging the node one worker at a
+    time (suite post-mortem: every later lease request timed out while
+    all worker processes sat idle)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 1},
+                 _system_config={"lease_park_s": 0.3,
+                                 "max_workers_per_node": 1,
+                                 "prestart_workers": 1})
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        @ray_tpu.remote(num_cpus=1)
+        def hold(sec):
+            time.sleep(sec)
+            return 1
+
+        @ray_tpu.remote(num_cpus=1)
+        def quick():
+            return 2
+
+        core = global_worker()
+        r = hold.remote(6.0)
+        # Probe with raw lease requests until one finds the CPU taken:
+        # that one must come back {"retry": True} (bounded park), never
+        # hang to the RPC timeout.
+        deadline = time.monotonic() + 30
+        while True:
+            reply, _ = core.call(
+                core.agent_addr, "request_lease",
+                {"resources": {"CPU": 1.0}, "submitter": core.address},
+                timeout=10.0)
+            if reply.get("retry"):
+                break
+            if reply.get("granted"):
+                # Raced ahead of hold's own lease: give it back.
+                core.call(core.agent_addr, "return_lease",
+                          {"lease_id": reply["lease_id"]}, timeout=5.0)
+            assert time.monotonic() < deadline, f"no retry reply: {reply}"
+            time.sleep(0.2)
+        # The node is NOT wedged: the held task finishes and fresh work
+        # still schedules onto the single worker (a leaked zombie lease
+        # would hold both the CPU and the only worker slot forever).
+        assert ray_tpu.get(r, timeout=60) == 1
+        assert ray_tpu.get(quick.remote(), timeout=60) == 2
+    finally:
+        ray_tpu.shutdown()
+        ray_tpu.init(resources={"CPU": 4})
+
+
 def test_venv_lease_evicts_idle_worker_at_cap(tmp_path):
     """Keyed pools must not deadlock at the worker cap: with the pool
     full of idle PLAIN workers, a venv lease evicts one and completes
