@@ -5,14 +5,18 @@ objects, placement groups, data/train/tune/serve/rl libraries) re-designed
 TPU-first: XLA collectives over ICI inside a slice, a zmq control/object
 plane over DCN between hosts, jax/pjit/Pallas for all device compute.
 """
-from ray_tpu.api import (available_resources, cancel, cluster_resources, get,
-                         get_actor, init, is_initialized, kill, method,
-                         nodes, put, remote, shutdown, timeline, wait)
+from ray_tpu.api import (LOCAL_MODE, SCRIPT_MODE, WORKER_MODE,
+                         ClientBuilder, Language, available_resources,
+                         cancel, cluster_resources, cpp_function, get,
+                         get_actor, get_gpu_ids, get_tpu_ids, init,
+                         is_initialized, kill, method, nodes, put, remote,
+                         show_in_dashboard, shutdown, timeline, wait)
 from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 ObjectLostError, RayTpuError,
                                 TaskCancelledError, TaskError,
                                 WorkerCrashedError)
 from ray_tpu._private import profiling
+from ray_tpu.logging_config import LoggingConfig
 from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.runtime_context import get_runtime_context
 
@@ -22,9 +26,23 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "get",
     "put", "wait", "kill", "cancel", "get_actor", "nodes", "timeline",
     "available_resources", "cluster_resources", "get_runtime_context",
-    "profiling",
+    "profiling", "LoggingConfig", "ClientBuilder", "Language",
+    "cpp_function", "get_gpu_ids", "get_tpu_ids", "show_in_dashboard",
+    "SCRIPT_MODE", "WORKER_MODE", "LOCAL_MODE",
     "ObjectRef", "ObjectRefGenerator",
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
     "WorkerCrashedError", "__version__",
 ]
+
+
+def __getattr__(name):
+    # Submodules reachable as attributes without import-time cost (ray:
+    # ray.autoscaler / ray.client are importable off the top level).
+    if name in ("autoscaler", "client", "data", "train", "tune", "serve",
+                "rl", "workflow", "dag", "experimental", "utils",
+                "cluster_utils"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -965,6 +965,8 @@ def main() -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s controller: %(message)s")
+    from ray_tpu.logging_config import configure_process_logging
+    configure_process_logging()
     config = Config().override(_json.loads(args.config_json))
 
     async def _run():

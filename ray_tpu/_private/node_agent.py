@@ -1064,6 +1064,8 @@ def main() -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s agent: %(message)s")
+    from ray_tpu.logging_config import configure_process_logging
+    configure_process_logging()
     config = Config().override(_json.loads(args.config_json))
     resources = _json.loads(args.resources_json) if args.resources_json else None
     labels = _json.loads(args.labels_json) if args.labels_json else None

@@ -93,6 +93,8 @@ def main() -> None:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s worker[%(process)d]: %(message)s")
+    from ray_tpu.logging_config import configure_process_logging
+    configure_process_logging()
     from ray_tpu._private.config import Config
     from ray_tpu._private.worker import CoreWorker, set_global_worker
 

@@ -57,7 +57,8 @@ def init(address: str | None = None,
          namespace: str = "default",
          object_store_memory: int | None = None,
          _system_config: dict | None = None,
-         log_to_driver: bool = True) -> dict:
+         log_to_driver: bool = True,
+         logging_config: "LoggingConfig | None" = None) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
 
     Without `address`, boots a local head: controller + one node agent as
@@ -70,6 +71,13 @@ def init(address: str | None = None,
         raise RuntimeError("ray_tpu.init() already called; "
                            "call ray_tpu.shutdown() first")
     import os as _os
+
+    if logging_config is not None:
+        # Driver logging now; spawned processes (controller, agents,
+        # zygote-forked workers) pick the config up from the environment
+        # at their own startup (ray: logging_config.py dictConfig).
+        logging_config.apply()
+        _os.environ.update(logging_config.env())
 
     if address is None:
         # Job-submission child drivers attach to the submitting cluster
@@ -374,3 +382,106 @@ def timeline() -> list[dict]:
     reply, _ = core.call(core.controller_addr, "get_task_events",
                          timeout=30.0)
     return reply["events"]
+
+
+# --------------------------------------------------------------- compat
+# Process-mode constants (ray: ray_constants SCRIPT_MODE/WORKER_MODE/
+# LOCAL_MODE; same values for drop-in comparisons).
+SCRIPT_MODE = 0
+WORKER_MODE = 1
+LOCAL_MODE = 2
+
+
+class Language:
+    """Frontend languages (ray: Language proto enum).  JAVA is an
+    intentional gap (no JVM frontend — README); PYTHON and CPP map to
+    the Python API and the native worker API (native/raytpu_api.h)."""
+    PYTHON = "PYTHON"
+    CPP = "CPP"
+
+
+def get_gpu_ids() -> list:
+    """Always empty: this framework schedules TPUs, not GPUs (ray:
+    worker.py:992 get_gpu_ids).  Kept so reference-written code that
+    probes GPU visibility degrades cleanly; see `get_tpu_ids`."""
+    return []
+
+
+def get_tpu_ids() -> list[int]:
+    """IDs of TPU chips visible to this worker (the get_gpu_ids analog).
+
+    Only the per-host singleton device worker holds the chip lease
+    (PARITY: accelerator support); every other process sees none.
+    """
+    import os as _os
+
+    if _os.environ.get("RAY_TPU_IS_DEVICE_WORKER") != "1":
+        return []
+    import jax
+
+    return [d.id for d in jax.devices()]
+
+
+def show_in_dashboard(message: str, key: str = "",
+                      dtype: str = "text") -> None:
+    """Attach a status message to this worker, rendered by the dashboard
+    (ray: worker.py:2521).  Messages land in controller KV under the
+    "dash" namespace keyed by worker+key, so multiple keys coexist and
+    re-posting a key overwrites it."""
+    if dtype not in ("text", "html"):
+        raise ValueError(f"invalid dtype {dtype!r} (text|html)")
+    import time as _time
+
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.runtime_context import get_runtime_context
+
+    core = global_worker()
+    ctx = get_runtime_context()
+    payload = {"message": message, "dtype": dtype,
+               "worker_id": ctx.get_worker_id(),
+               "actor_id": ctx.get_actor_id(),
+               "task_id": ctx.get_task_id(), "ts": _time.time()}
+    core.call(core.controller_addr, "kv_put",
+              {"ns": "dash", "key": f"{ctx.get_worker_id()}:{key}"},
+              [json.dumps(payload).encode()], timeout=30.0)
+
+
+def cpp_function(fn_name: str, lib_path: str):
+    """Handle on a native function for cross-language invocation (ray:
+    ray.cpp_function / cross_language.py).  `fn_name` must be registered
+    with RAYTPU_REMOTE in the shared library at `lib_path`; `.remote()`
+    ships bytes in and bytes out (the C ABI marshalling contract of
+    native/raytpu_api.h — no cross-language object graph)."""
+    from ray_tpu._private.cpp_runtime import cpp_task
+
+    class _CppFunction:
+        def __init__(self, task):
+            self._task = task
+
+        def options(self, **opts) -> "_CppFunction":
+            return _CppFunction(self._task.options(**opts))
+
+        def remote(self, payload: bytes = b"") -> ObjectRef:
+            return self._task.remote(lib_path, fn_name, payload)
+
+    return _CppFunction(cpp_task)
+
+
+class ClientBuilder:
+    """Builder-style client connection (ray: client_builder.py —
+    `ray.client("ray://host:port").namespace("n").connect()`).  Thin
+    veneer over `init`; `init("ray://...")` remains the primary path."""
+
+    def __init__(self, address: str):
+        self._address = address
+        self._namespace = "default"
+
+    def namespace(self, namespace: str) -> "ClientBuilder":
+        self._namespace = namespace
+        return self
+
+    def connect(self) -> dict:
+        return init(self._address, namespace=self._namespace)
+
+    def disconnect(self) -> None:
+        shutdown()

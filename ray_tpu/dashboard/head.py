@@ -127,6 +127,7 @@ class DashboardHead:
         r.add_get("/api/v0/placement_groups", self._pgs)
         r.add_get("/api/v0/objects", self._objects)
         r.add_get("/api/v0/timeline", self._timeline)
+        r.add_get("/api/v0/worker_messages", self._worker_messages)
         r.add_get("/metrics", self._metrics)
         r.add_get("/api/jobs/", self._jobs_list)
         r.add_post("/api/jobs/", self._jobs_submit)
@@ -287,6 +288,28 @@ class DashboardHead:
 
         events = await self._call(ray_tpu.timeline)
         return _json(events)
+
+    async def _worker_messages(self, _req):
+        """Messages posted via ray_tpu.show_in_dashboard (ray:
+        worker.py:2521 → dashboard actor/worker detail panes)."""
+        import json as _jsonlib
+
+        from ray_tpu._private.worker import global_worker
+
+        def _collect():
+            core = global_worker()
+            keys = core.call(core.controller_addr, "kv_keys",
+                             {"ns": "dash"}, timeout=10.0)[0]["keys"]
+            out = []
+            for k in keys:
+                reply, blobs = core.call(core.controller_addr, "kv_get",
+                                         {"ns": "dash", "key": k},
+                                         timeout=10.0)
+                if reply.get("found") and blobs:
+                    out.append({"key": k,
+                                **_jsonlib.loads(bytes(blobs[0]))})
+            return out
+        return _json({"result": await self._call(_collect)})
 
     async def _metrics(self, _req):
         """Prometheus text exposition (ray: per-node metrics agent +

@@ -1,0 +1,74 @@
+"""Reference top-level API compatibility surface (ray: ray/__init__.py
+__all__): mode constants, Language, LoggingConfig, get_gpu_ids/
+get_tpu_ids, show_in_dashboard, ClientBuilder, submodule attributes."""
+import json
+import logging
+
+import pytest
+
+import ray_tpu
+
+
+def test_mode_constants_and_language():
+    assert (ray_tpu.SCRIPT_MODE, ray_tpu.WORKER_MODE,
+            ray_tpu.LOCAL_MODE) == (0, 1, 2)
+    assert ray_tpu.Language.PYTHON == "PYTHON"
+    assert ray_tpu.Language.CPP == "CPP"
+    # JAVA is the documented intentional gap — not present.
+    assert not hasattr(ray_tpu.Language, "JAVA")
+
+
+def test_submodules_reachable_as_attributes():
+    assert hasattr(ray_tpu.autoscaler, "__path__")
+    assert hasattr(ray_tpu.client, "probe")
+    assert hasattr(ray_tpu.cluster_utils, "Cluster")
+
+
+def test_gpu_and_tpu_ids_on_driver():
+    assert ray_tpu.get_gpu_ids() == []
+    # The driver is never the device worker.
+    assert ray_tpu.get_tpu_ids() == []
+
+
+def test_logging_config_validation_and_json_encoding():
+    with pytest.raises(ValueError, match="encoding"):
+        ray_tpu.LoggingConfig(encoding="YAML")
+    with pytest.raises(ValueError, match="log level"):
+        ray_tpu.LoggingConfig(log_level="CHATTY")
+    from ray_tpu.logging_config import JsonFormatter
+
+    rec = logging.LogRecord("t", logging.WARNING, __file__, 1,
+                            "hello %s", ("world",), None)
+    out = json.loads(JsonFormatter().format(rec))
+    assert out["message"] == "hello world"
+    assert out["levelname"] == "WARNING"
+    assert out["name"] == "t"
+
+
+def test_show_in_dashboard_from_task(ray_shared):
+    @ray_tpu.remote
+    def announce():
+        ray_tpu.show_in_dashboard("phase 1 done", key="phase")
+        ray_tpu.show_in_dashboard("<b>hi</b>", key="rich", dtype="html")
+        return ray_tpu.get_runtime_context().get_worker_id()
+
+    wid = ray_tpu.get(announce.remote(), timeout=120)
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    reply, blobs = core.call(core.controller_addr, "kv_get",
+                             {"ns": "dash", "key": f"{wid}:phase"},
+                             timeout=10.0)
+    assert reply["found"]
+    msg = json.loads(bytes(blobs[0]))
+    assert msg["message"] == "phase 1 done"
+    assert msg["dtype"] == "text"
+    assert msg["task_id"]
+    with pytest.raises(ValueError, match="dtype"):
+        ray_tpu.show_in_dashboard("x", dtype="markdown")
+
+
+def test_client_builder_surface():
+    b = ray_tpu.ClientBuilder("ray://127.0.0.1:1")
+    assert b.namespace("ns") is b
+    assert b._namespace == "ns"

@@ -107,6 +107,36 @@ int main(int argc, char** argv) {
 """
 
 
+def test_cpp_function_from_python(ray_shared, tmp_path):
+    """Cross-language call from a PYTHON driver into a native function
+    (ray: ray.cpp_function — cross_language.py): bytes in, bytes out
+    through the RAYTPU_REMOTE registry, no C++ driver involved."""
+    import struct
+
+    import ray_tpu
+    from ray_tpu._private.cpp_runtime import CAPI_HEADER, capi_lib_path
+
+    capi_so = capi_lib_path()
+    build_dir = os.path.dirname(capi_so)
+    native_dir = os.path.dirname(CAPI_HEADER)
+    user_cc = tmp_path / "user_tasks.cc"
+    user_cc.write_text(USER_TASKS_CC)
+    user_so = tmp_path / "libuser_tasks.so"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", str(user_so),
+         str(user_cc), f"-I{native_dir}", f"-L{build_dir}", "-lraytpu_capi",
+         f"-Wl,-rpath,{build_dir}"],
+        check=True, capture_output=True)
+
+    add = ray_tpu.cpp_function("Add", str(user_so))
+    out = ray_tpu.get(add.remote(struct.pack("<qq", 30, 12)), timeout=120)
+    assert struct.unpack("<q", out)[0] == 42
+    # .options passthrough keeps the task-option surface.
+    out = ray_tpu.get(add.options(num_cpus=1).remote(
+        struct.pack("<qq", -5, 5)), timeout=120)
+    assert struct.unpack("<q", out)[0] == 0
+
+
 def test_cpp_driver_end_to_end(ray_shared, tmp_path):
     import ray_tpu
     from ray_tpu._private import worker as worker_mod
