@@ -58,11 +58,15 @@ class ActorInstance:
                  max_concurrency: int | None,
                  is_async: bool, runtime_env: dict | None = None,
                  concurrency_groups: dict | None = None,
-                 method_groups: dict | None = None):
+                 method_groups: dict | None = None,
+                 bundle_key: str | None = None):
         self.actor_id = actor_id
         self.instance = instance
         self.is_async = is_async
         self.runtime_env = runtime_env
+        # PG bundle this actor was placed into (for
+        # util.get_current_placement_group from actor methods).
+        self.bundle_key = bundle_key
         # max_concurrency None = not set by the user.  The async DEFAULT
         # group then gets ray's permissive 1000 bound — binding it to 1
         # would deadlock previously-safe async self-calls the moment any

@@ -891,6 +891,20 @@ class Controller:
              "class_name": a.creation_header.get("class_name", "")}
             for a in self.actors.values()]}
 
+    async def rpc_list_named_actors(self, h: dict, _b: list) -> dict:
+        """Live named actors (ray: list_named_actors).  The name table
+        keeps dead entries (the taken-check tolerates them), so filter
+        by actor state here."""
+        ns = h.get("namespace")
+        out = []
+        for k, aid in self.named_actors.items():
+            if ns is not None and k[0] != ns:
+                continue
+            a = self.actors.get(aid)
+            if a is not None and a.state != DEAD:
+                out.append({"namespace": k[0], "name": k[1]})
+        return {"named": out}
+
     async def rpc_list_pgs(self, h: dict, _b: list) -> dict:
         return {"pgs": [
             {"pg_id": p.pg_id, "name": p.name, "state": p.state,

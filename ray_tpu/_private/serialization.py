@@ -62,7 +62,21 @@ class _Pickler(cloudpickle.CloudPickler):
         if isinstance(obj, ObjectRef):
             _note_ref(obj)
             return (ObjectRef._from_serialized, (obj.binary(), obj.owner_addr))
+        custom = _custom_serializers.get(obj.__class__)
+        if custom is not None:
+            ser, deser = custom
+            # The DESERIALIZER function rides the pickle stream by value
+            # (cloudpickle), so receiving workers need no registration
+            # (ray: util/serialization.py register_serializer — same
+            # one-sided contract).
+            return (deser, (ser(obj),))
         return super().reducer_override(obj)
+
+
+# Exact-type custom reducers (ray: SerializationContext
+# _register_cloudpickle_serializer).  Keyed by class; subclasses do NOT
+# inherit the serializer (matching the reference).
+_custom_serializers: dict = {}
 
 
 _SAFE_SCALARS = frozenset({type(None), bool, int, float, complex, str,

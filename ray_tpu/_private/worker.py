@@ -188,6 +188,9 @@ class CoreWorker:
         self.actor_states: dict[str, ActorSubmitState] = {}
         self.current_actor_id: str | None = None
         self.current_task_id: str | None = None
+        # PG bundle of the currently-executing task (tasks only; actor
+        # methods resolve through their ActorInstance.bundle_key).
+        self.current_bundle_key: str | None = None
         # Trace context of the currently-executing task (ray: OpenTelemetry
         # propagation, util/tracing/tracing_helper.py): child submissions
         # inherit trace_id, and task events / profiling spans carry it.
@@ -1711,9 +1714,11 @@ class CoreWorker:
         prev = self.current_task_id
         prev_trace = self.current_trace
         prev_driver = self.current_driver_addr
+        prev_bundle = self.current_bundle_key
         self.current_task_id = th["task_id"]
         self.current_trace = th.get("trace")
         self.current_driver_addr = th.get("driver_addr") or prev_driver
+        self.current_bundle_key = th.get("bundle_key")
         self._record_event(th["task_id"], "RUNNING", th.get("name", ""))
         try:
             value, contained = deserialize_with_refs(frames)
@@ -1747,6 +1752,7 @@ class CoreWorker:
             self.current_task_id = prev
             self.current_trace = prev_trace
             self.current_driver_addr = prev_driver
+            self.current_bundle_key = prev_bundle
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -1892,7 +1898,8 @@ class CoreWorker:
         try:
             result = await self._run_user_code(
                 _thunk, task_id=task_id, trace=h.get("trace"),
-                driver_addr=h.get("driver_addr"))
+                driver_addr=h.get("driver_addr"),
+                bundle_key=h.get("bundle_key"))
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(e)
         finally:
@@ -1949,9 +1956,11 @@ class CoreWorker:
             prev = self.current_task_id
             prev_trace = self.current_trace
             prev_driver = self.current_driver_addr
+            prev_bundle = self.current_bundle_key
             self.current_task_id = h["task_id"]
             self.current_trace = h.get("trace")
             self.current_driver_addr = h.get("driver_addr") or prev_driver
+            self.current_bundle_key = h.get("bundle_key")
             try:
                 for item in thunk():
                     asyncio.run_coroutine_threadsafe(
@@ -1961,6 +1970,7 @@ class CoreWorker:
                 self.current_task_id = prev
                 self.current_trace = prev_trace
                 self.current_driver_addr = prev_driver
+                self.current_bundle_key = prev_bundle
 
         try:
             await loop.run_in_executor(executor, _producer)
@@ -2035,19 +2045,23 @@ class CoreWorker:
     async def _run_user_code(self, thunk, task_id: bytes | None = None,
                              executor=None, instance_actor: str | None = None,
                              trace: dict | None = None,
-                             driver_addr: str | None = None):
+                             driver_addr: str | None = None,
+                             bundle_key: str | None = None):
         prev_task = self.current_task_id
         prev_trace = self.current_trace
         prev_driver = self.current_driver_addr
+        prev_bundle = self.current_bundle_key
         self.current_task_id = task_id.hex() if task_id else None
         self.current_trace = trace
         self.current_driver_addr = driver_addr or prev_driver
+        self.current_bundle_key = bundle_key
         try:
             return await self.loop.run_in_executor(
                 executor or self._default_executor, thunk)
         finally:
             self.current_task_id = prev_task
             self.current_trace = prev_trace
+            self.current_bundle_key = prev_bundle
             self.current_driver_addr = prev_driver
 
     def _error_reply(self, e: BaseException) -> tuple[dict, list]:
@@ -2230,7 +2244,8 @@ class CoreWorker:
                 max_concurrency=h.get("max_concurrency"),
                 is_async=is_async, runtime_env=renv_desc,
                 concurrency_groups=h.get("concurrency_groups"),
-                method_groups=h.get("method_groups"))
+                method_groups=h.get("method_groups"),
+                bundle_key=h.get("bundle_key"))
             self.current_actor_id = h["actor_id"]
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001

@@ -2,15 +2,78 @@
 distributed queue, collectives (analog of ray: python/ray/util/)."""
 from ray_tpu.utils.actor_pool import ActorPool
 from ray_tpu.utils.check_serialize import inspect_serializability
-from ray_tpu.utils.placement_group import (placement_group,
+from ray_tpu.utils.placement_group import (get_current_placement_group,
+                                           get_placement_group,
+                                           placement_group,
                                            placement_group_table,
                                            remove_placement_group)
 from ray_tpu.utils.queue import Queue
 from ray_tpu.utils.scheduling_strategies import (
     NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+from ray_tpu.utils.serialization import (deregister_serializer,
+                                         register_serializer)
+
+_logged_once: set = set()
+
+
+def log_once(key: str) -> bool:
+    """True the first time `key` is seen in this process (ray:
+    util/debug.py log_once)."""
+    if key in _logged_once:
+        return False
+    _logged_once.add(key)
+    return True
+
+
+def get_node_ip_address() -> str:
+    """This node's IP as the runtime uses it (ray: util
+    get_node_ip_address).  Attached drivers/workers answer from their
+    RPC address; otherwise fall back to a UDP-probe local address."""
+    from ray_tpu._private.worker import _global_worker
+
+    core = _global_worker
+    if core is not None and core.address:
+        return core.address.rsplit(":", 1)[0]
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def list_named_actors(all_namespaces: bool = False):
+    """Names of live named actors (ray: util list_named_actors): the
+    current namespace's names as strings, or [{namespace, name}] dicts
+    with all_namespaces=True."""
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    ns = None if all_namespaces else core.namespace
+    reply, _ = core.call(core.controller_addr, "list_named_actors",
+                         {"namespace": ns}, timeout=30.0)
+    if all_namespaces:
+        return reply["named"]
+    return [row["name"] for row in reply["named"]]
+
+
+def __getattr__(name):
+    if name == "collective":
+        import importlib
+
+        return importlib.import_module("ray_tpu.collective")
+    raise AttributeError(f"module 'ray_tpu.utils' has no attribute {name!r}")
+
 
 __all__ = [
     "placement_group", "remove_placement_group", "placement_group_table",
+    "get_current_placement_group", "get_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "ActorPool", "Queue", "inspect_serializability",
+    "register_serializer", "deregister_serializer", "log_once",
+    "get_node_ip_address", "list_named_actors", "collective",
 ]

@@ -126,6 +126,55 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     core.call_nowait(core.controller_addr, "remove_pg", {"pg_id": pg.id})
 
 
+def get_current_placement_group() -> "PlacementGroup | None":
+    """The placement group the calling task/actor runs in, or None (ray:
+    util/placement_group.py get_current_placement_group).  Tasks resolve
+    through the executing worker's current bundle; actor methods through
+    their hosting ActorInstance (each sync actor owns a dedicated
+    executor, so the thread identifies the actor)."""
+    import threading
+
+    from ray_tpu._private.worker import _global_worker
+
+    core = _global_worker
+    if core is None:
+        return None
+    key = core.current_bundle_key
+    if key is None:
+        tname = threading.current_thread().name
+        if tname.startswith("actor-"):
+            prefix = tname[len("actor-"):].split("_")[0]
+            for inst in core.actors_hosted.values():
+                if inst.actor_id.startswith(prefix):
+                    key = inst.bundle_key
+                    break
+        elif len(core.actors_hosted) == 1:
+            # Async-actor methods run on the worker loop, not a named
+            # executor thread; unambiguous only with one hosted actor.
+            key = next(iter(core.actors_hosted.values())).bundle_key
+    if not key:
+        return None
+    pg_id = key.rsplit(":", 1)[0]
+    return _pg_from_table(pg_id)
+
+
+def get_placement_group(name: str) -> "PlacementGroup":
+    """Look up a placement group by name (ray:
+    util/placement_group.py:175 get_placement_group)."""
+    for row in placement_group_table():
+        if row.get("name") == name:
+            return PlacementGroup(row["pg_id"], row["bundles"],
+                                  row["strategy"])
+    raise ValueError(f"placement group {name!r} not found")
+
+
+def _pg_from_table(pg_id: str) -> "PlacementGroup | None":
+    for row in placement_group_table():
+        if row["pg_id"] == pg_id:
+            return PlacementGroup(pg_id, row["bundles"], row["strategy"])
+    return None
+
+
 def placement_group_table() -> list[dict]:
     from ray_tpu import client as client_mod
     from ray_tpu._private.worker import global_worker

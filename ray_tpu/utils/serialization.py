@@ -1,0 +1,22 @@
+"""Custom serializer registration (ray: python/ray/util/serialization.py).
+
+`register_serializer(cls, serializer=..., deserializer=...)` makes every
+object-plane pickle of EXACTLY `cls` (subclasses excluded, as in the
+reference) go through the given functions.  One-sided contract: the
+deserializer is shipped by value inside the pickle stream, so receiving
+workers never need to register anything.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ray_tpu._private.serialization import _custom_serializers
+
+
+def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
+                        deserializer: Callable[[Any], Any]) -> None:
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type) -> None:
+    _custom_serializers.pop(cls, None)

@@ -72,3 +72,91 @@ def test_client_builder_surface():
     b = ray_tpu.ClientBuilder("ray://127.0.0.1:1")
     assert b.namespace("ns") is b
     assert b._namespace == "ns"
+
+
+def test_log_once_and_node_ip(ray_shared):
+    from ray_tpu import utils
+
+    key = "compat-test-key"
+    assert utils.log_once(key) is True
+    assert utils.log_once(key) is False
+    ip = utils.get_node_ip_address()
+    assert ip and all(p.isdigit() for p in ip.split("."))
+
+
+def test_list_named_actors(ray_shared):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="compat-named", get_if_exists=True).remote()
+    ray_tpu.get(a.ping.remote(), timeout=120)
+    from ray_tpu import utils
+
+    assert "compat-named" in utils.list_named_actors()
+    rows = utils.list_named_actors(all_namespaces=True)
+    assert {"namespace": "default", "name": "compat-named"} in rows
+    ray_tpu.kill(a)
+
+
+def test_register_serializer_roundtrip(ray_shared):
+    from ray_tpu import utils
+
+    class Opaque:
+        """Unpicklable by construction."""
+
+        def __init__(self, v):
+            self.v = v
+            self._lock = __import__("threading").Lock()
+
+        def __reduce__(self):
+            raise TypeError("not picklable")
+
+    utils.register_serializer(Opaque, serializer=lambda o: o.v,
+                              deserializer=Opaque)
+    try:
+        @ray_tpu.remote
+        def probe(o):
+            return o.v * 2
+
+        assert ray_tpu.get(probe.remote(Opaque(21)), timeout=120) == 42
+    finally:
+        utils.deregister_serializer(Opaque)
+    with pytest.raises(Exception):
+        ray_tpu.put(Opaque(1))
+
+
+def test_get_current_placement_group(ray_shared):
+    from ray_tpu import utils
+
+    pg = utils.placement_group([{"CPU": 1}], strategy="PACK",
+                               name="compat-pg")
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        cur = utils.get_current_placement_group()
+        return cur.id if cur else None
+
+    @ray_tpu.remote(num_cpus=1)
+    def outside():
+        cur = utils.get_current_placement_group()
+        return cur.id if cur else None
+
+    assert ray_tpu.get(
+        where.options(placement_group=pg).remote(), timeout=120) == pg.id
+    assert ray_tpu.get(outside.remote(), timeout=120) is None
+
+    @ray_tpu.remote(num_cpus=1)
+    class Member:
+        def pg_id(self):
+            cur = utils.get_current_placement_group()
+            return cur.id if cur else None
+
+    m = Member.options(placement_group=pg).remote()
+    assert ray_tpu.get(m.pg_id.remote(), timeout=120) == pg.id
+    # Named lookup resolves the same group.
+    assert utils.get_placement_group("compat-pg").id == pg.id
+    ray_tpu.kill(m)
+    utils.remove_placement_group(pg)
