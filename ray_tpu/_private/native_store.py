@@ -17,6 +17,7 @@ import fcntl
 import logging
 import os
 import struct
+import threading
 import subprocess
 import weakref
 
@@ -146,6 +147,14 @@ class Arena:
             raise OSError(f"cannot map shm arena {name!r}")
         self.base = self.lib.rt_store_base(self.handle)
         self._created = create
+        # Serializes pin-release finalizers against close(): a zero-copy
+        # view's weakref.finalize fires on WHATEVER thread drops the last
+        # reference (observed: the rpc IO thread), and an unsynchronized
+        # handle check can pass just before close() munmaps the arena —
+        # rt_store_release then touches unmapped memory (SIGSEGV caught
+        # in-suite).  RLock, not Lock: a GC point inside close() itself
+        # can run a finalizer reentrantly on the closing thread.
+        self._pin_lock = threading.RLock()
         # Writable view over the whole mapping: frame payloads are copied in
         # with one memoryview slice assignment (no intermediate bytes()).
         size = self.lib.rt_store_mapped_size(self.handle)
@@ -199,8 +208,9 @@ class Arena:
         return [mv[fo:fo + ln] for fo, ln in zip(offsets, lens)]
 
     def _release_pin(self, oid: bytes) -> None:
-        if self.handle:
-            self.lib.rt_store_release(self.handle, oid)
+        with self._pin_lock:
+            if self.handle:
+                self.lib.rt_store_release(self.handle, oid)
 
     # ---- chunked-transfer raw access (node-to-node object plane) ----
     def get_raw(self, oid: bytes) -> memoryview | None:
@@ -293,11 +303,16 @@ class Arena:
         return None
 
     def close(self) -> None:
-        if self.handle:
-            self.lib.rt_store_close(self.handle)
+        with self._pin_lock:
+            if not self.handle:
+                return
+            # Null the handle BEFORE unmapping: a reentrant finalizer
+            # (GC at a bytecode boundary inside this block, RLock lets
+            # it through) must see a closed arena and no-op.
+            handle, self.handle = self.handle, None
+            self.lib.rt_store_close(handle)
             if self._created:
                 self.lib.rt_store_unlink(self.name.encode())
-            self.handle = None
 
 
 def _cleanup_stale_arenas() -> None:
