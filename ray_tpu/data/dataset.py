@@ -103,6 +103,31 @@ class Dataset:
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
         return self._with(L.RandomShuffle(seed))
 
+    def randomize_block_order(self, *, seed: int | None = None) -> "Dataset":
+        """Shuffle BLOCKS, not rows — the cheap decorrelator (ray:
+        Dataset.randomize_block_order)."""
+        import random as _random
+
+        self.materialize()
+        blocks = list(self._materialized)
+        _random.Random(seed).shuffle(blocks)
+        return _from_blocks(blocks)
+
+    def random_sample(self, fraction: float,
+                      *, seed: int | None = None) -> "Dataset":
+        """Row-level Bernoulli sample (ray: Dataset.random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def sample(batch):
+            import numpy as _np
+
+            n = len(next(iter(batch.values()), []))
+            keep = _np.random.default_rng(seed).random(n) < fraction
+            return {k: _np.asarray(v)[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample)
+
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         return self._with(L.Sort(key, descending))
 
@@ -195,6 +220,23 @@ class Dataset:
     def iter_jax_batches(self, **kw) -> Iterator:
         return self.iterator().iter_jax_batches(**kw)
 
+    def iter_tf_batches(self, **kw) -> Iterator:
+        """Gated on tensorflow being installed (not in this image); the
+        numpy batches convert 1:1 (ray: Dataset.iter_tf_batches)."""
+        try:
+            import tensorflow as tf  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "iter_tf_batches requires tensorflow; use "
+                "iter_jax_batches / iter_torch_batches") from e
+
+        def gen():
+            for batch in self.iter_batches(**kw):
+                yield {k: tf.convert_to_tensor(v)
+                       for k, v in batch.items()}
+
+        return gen()
+
     def take(self, n: int = 20) -> list[dict]:
         out = []
         for row in self.limit(n).iter_rows():
@@ -243,6 +285,108 @@ class Dataset:
     def to_numpy(self) -> dict[str, np.ndarray]:
         return self.iterator().materialize_numpy()
 
+    def to_numpy_refs(self) -> list:
+        """One ref per block, each a dict of column arrays (ray:
+        Dataset.to_numpy_refs)."""
+        @ray_tpu.remote
+        def conv(block):
+            return BlockAccessor.for_block(block).to_numpy()
+
+        return [conv.remote(r) for r in self._ref_iter()]
+
+    def to_arrow_refs(self) -> list:
+        """Block refs as Arrow tables — blocks ARE Arrow tables here, so
+        this is the materialized ref list (ray: Dataset.to_arrow_refs)."""
+        return list(self._ref_iter())
+
+    def names(self) -> list[str]:
+        return self.columns()
+
+    def types(self) -> list:
+        sch = self.schema()
+        return list(sch.types) if sch is not None else []
+
+    def copy(self) -> "Dataset":
+        """New handle over the same lazy plan / materialized blocks
+        (execution state like stats is NOT shared)."""
+        out = Dataset.__new__(Dataset)
+        out._plan = self._plan
+        out._materialized = (list(self._materialized)
+                             if self._materialized is not None else None)
+        out._union_sources = getattr(self, "_union_sources", None)
+        return out
+
+    def context(self):
+        from ray_tpu.data.context import DataContext
+
+        return DataContext.get_current()
+
+    def input_files(self) -> list[str]:
+        """Source paths recorded by file-based read ops, when any (ray:
+        Dataset.input_files)."""
+        files: list[str] = []
+        for plan in ([self._plan] if self._plan is not None
+                     else (getattr(self, "_union_sources", None) or [])):
+            for op in plan.ops:
+                files.extend(getattr(op, "input_files", None) or ())
+        return files
+
+    # ------------------------------------------------------- aggregations
+    def _column(self, on: str) -> np.ndarray:
+        parts = [BlockAccessor.for_block(ray_tpu.get(r)).to_numpy()[on]
+                 for r in self._ref_iter()]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return np.array([])
+        return np.concatenate(parts)
+
+    def sum(self, on: str):
+        v = self._column(on)
+        return v.sum().item() if len(v) else None
+
+    def min(self, on: str):
+        v = self._column(on)
+        return v.min().item() if len(v) else None
+
+    def max(self, on: str):
+        v = self._column(on)
+        return v.max().item() if len(v) else None
+
+    def mean(self, on: str):
+        v = self._column(on)
+        return v.mean().item() if len(v) else None
+
+    def std(self, on: str, ddof: int = 1):
+        v = self._column(on)
+        return v.std(ddof=ddof).item() if len(v) > ddof else None
+
+    def aggregate(self, **aggs: tuple[str, str]) -> dict:
+        """Whole-dataset aggregation: aggregate(total=("v", "sum"),
+        lo=("v", "min")) — the global counterpart of
+        GroupedData.aggregate (ray: Dataset.aggregate with AggregateFn)."""
+        out = {}
+        for name, (col, kind) in aggs.items():
+            if kind not in ("sum", "min", "max", "mean", "std", "count"):
+                raise ValueError(f"unknown aggregation {kind!r}")
+            if kind == "count":
+                out[name] = self.count()
+            else:
+                out[name] = getattr(self, kind)(col)
+        return out
+
+    def unique(self, column: str) -> list:
+        """Distinct values of one column (ray: Dataset.unique)."""
+        v = self._column(column)
+        return sorted(np.unique(v).tolist()) if len(v) else []
+
+    def take_batch(self, batch_size: int = 20) -> dict[str, np.ndarray]:
+        """First batch as a dict of column arrays (ray:
+        Dataset.take_batch)."""
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size):
+            return batch
+        return {}
+
     # ---------------------------------------------------------------- split
     def split(self, n: int) -> list["Dataset"]:
         """Materialize and split into n datasets by block round-robin."""
@@ -256,6 +400,45 @@ class Dataset:
             d._union_sources = None
             outs.append(d)
         return outs
+
+    def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
+        """Split by ROW indices (ray: Dataset.split_at_indices).  Blocks
+        are re-cut so each piece holds exactly its row range."""
+        rows = self.take_all()
+        from ray_tpu.data.block import _rows_to_table
+
+        pieces = []
+        prev = 0
+        for ix in [*indices, len(rows)]:
+            chunk = rows[prev:ix]
+            prev = ix
+            pieces.append(_from_blocks(
+                [ray_tpu.put(_rows_to_table(chunk))]))
+        return pieces
+
+    def split_proportionately(self,
+                              proportions: list[float]) -> list["Dataset"]:
+        """ray: Dataset.split_proportionately — the last piece takes the
+        remainder."""
+        if not proportions or any(p <= 0 for p in proportions) \
+                or builtins.sum(proportions) >= 1.0:
+            raise ValueError("proportions must be positive and sum to <1")
+        total = self.count()
+        cuts, acc = [], 0
+        for p in proportions:
+            acc += int(total * p)
+            cuts.append(acc)
+        return self.split_at_indices(cuts)
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: int | None = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        """ray: Dataset.train_test_split."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        base = self.random_shuffle(seed=seed) if shuffle else self
+        train, test = base.split_proportionately([1.0 - test_size])
+        return train, test
 
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> list[DataIterator]:
@@ -304,6 +487,81 @@ class Dataset:
 
     def write_tfrecords(self, path: str) -> None:
         self._write(path, "tfrecord")
+
+    def write_numpy(self, path: str, *, column: str | None = None) -> None:
+        """One .npy per block (ray: Dataset.write_numpy)."""
+        refs = list(self._ref_iter())
+
+        @ray_tpu.remote
+        def write_one(block, idx):
+            import os as _os
+
+            import numpy as _np
+
+            _os.makedirs(path, exist_ok=True)
+            cols = BlockAccessor.for_block(block).to_numpy()
+            arr = cols[column] if column else \
+                _np.stack([cols[k] for k in sorted(cols)], axis=-1)
+            out = _os.path.join(path, f"part-{idx:05d}.npy")
+            _np.save(out, arr)
+            return out
+
+        ray_tpu.get([write_one.remote(r, i) for i, r in enumerate(refs)])
+
+    def write_sql(self, sql: str, connection_factory) -> None:
+        """executemany per block through a DB-API factory (ray:
+        Dataset.write_sql — e.g. "INSERT INTO t VALUES(?, ?)")."""
+        refs = list(self._ref_iter())
+
+        @ray_tpu.remote
+        def write_one(block):
+            rows = list(BlockAccessor.for_block(block).iter_rows())
+            conn = connection_factory()
+
+            def _py(v):
+                # DB-API drivers bind numpy scalars as raw blobs.
+                return v.item() if hasattr(v, "item") else v
+            try:
+                conn.cursor().executemany(
+                    sql, [tuple(_py(v) for v in r.values()) for r in rows])
+                conn.commit()
+            finally:
+                conn.close()
+            return len(rows)
+
+        # Serialized: DB-API modules (sqlite3) need one writer at a time
+        # unless the user's factory handles locking.
+        for r in refs:
+            ray_tpu.get(write_one.remote(r))
+
+    def write_webdataset(self, path: str) -> None:
+        """One .tar shard per block; each row becomes files
+        "<key>.<column>" (the read_webdataset inverse)."""
+        refs = list(self._ref_iter())
+
+        @ray_tpu.remote
+        def write_one(block, idx):
+            import io as _io
+            import os as _os
+            import tarfile as _tarfile
+
+            _os.makedirs(path, exist_ok=True)
+            out = _os.path.join(path, f"shard-{idx:05d}.tar")
+            rows = list(BlockAccessor.for_block(block).iter_rows())
+            with _tarfile.open(out, "w") as tf:
+                for i, row in enumerate(rows):
+                    key = str(row.get("__key__", f"{idx:05d}{i:07d}"))
+                    for col, val in row.items():
+                        if col == "__key__":
+                            continue
+                        data = val if isinstance(val, bytes) \
+                            else str(val).encode()
+                        info = _tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(data)
+                        tf.addfile(info, _io.BytesIO(data))
+            return out
+
+        ray_tpu.get([write_one.remote(r, i) for i, r in enumerate(refs)])
 
     def __repr__(self):
         if self._materialized is not None:
@@ -360,9 +618,17 @@ class _SplitCoordinator:
 _SplitCoordinator = ray_tpu.remote(_SplitCoordinator)
 
 
+def _from_blocks(blocks: list) -> Dataset:
+    d = Dataset.__new__(Dataset)
+    d._plan = None
+    d._materialized = list(blocks)
+    d._union_sources = None
+    return d
+
+
 # ----------------------------------------------------------- constructors
-def _read(tasks: list) -> Dataset:
-    return Dataset(L.ExecutionPlan([L.Read(tasks)]))
+def _read(tasks: list, input_files: list | None = None) -> Dataset:
+    return Dataset(L.ExecutionPlan([L.Read(tasks, input_files)]))
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
@@ -406,11 +672,13 @@ def from_arrow(tables) -> Dataset:
 
 
 def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
-    return _read(ds.parquet_tasks(paths, parallelism))
+    return _read(ds.parquet_tasks(paths, parallelism),
+                 ds._expand_paths(paths, ".parquet"))
 
 
 def read_csv(paths, *, parallelism: int = 8) -> Dataset:
-    return _read(ds.csv_tasks(paths, parallelism))
+    return _read(ds.csv_tasks(paths, parallelism),
+                 ds._expand_paths(paths, ".csv"))
 
 
 def read_json(paths, *, parallelism: int = 8) -> Dataset:

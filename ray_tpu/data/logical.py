@@ -20,6 +20,8 @@ class LogicalOp:
 @dataclasses.dataclass
 class Read(LogicalOp):
     tasks: list        # list[ReadTask]
+    # Source paths for Dataset.input_files (file-based readers only).
+    input_files: list | None = None
 
     def __post_init__(self):
         self.name = "Read"

@@ -6,6 +6,7 @@ against a single-node cluster.
 import numpy as np
 import pytest
 
+import ray_tpu
 from ray_tpu import data as rd
 
 
@@ -294,3 +295,103 @@ class TestIO:
         df = pd.DataFrame({"x": [1, 2, 3]})
         out = rd.from_pandas(df).to_pandas()
         assert out["x"].tolist() == [1, 2, 3]
+
+
+class TestDatasetCompatSurface:
+    """Round-4 method-parity batch (ray: dataset.py public methods)."""
+
+    def test_global_aggregations(self, ray_shared):
+        ds = rd.from_items([{"v": x} for x in [4, 1, 3, 2]])
+        assert ds.sum("v") == 10
+        assert ds.min("v") == 1
+        assert ds.max("v") == 4
+        assert ds.mean("v") == 2.5
+        assert abs(ds.std("v") - 1.29099) < 1e-4
+        out = ds.aggregate(total=("v", "sum"), lo=("v", "min"),
+                           n=("v", "count"))
+        assert out == {"total": 10, "lo": 1, "n": 4}
+        assert rd.from_items([{"v": 2}, {"v": 1}, {"v": 2}]).unique("v") \
+            == [1, 2]
+
+    def test_take_batch_and_random_sample(self, ray_shared):
+        ds = rd.range(100)
+        batch = ds.take_batch(10)
+        assert len(next(iter(batch.values()))) == 10
+        n = sum(1 for _ in rd.range(2000).random_sample(
+            0.5, seed=7).iter_rows())
+        assert 800 < n < 1200
+
+    def test_randomize_block_order_preserves_rows(self, ray_shared):
+        ds = rd.range(40, parallelism=8)
+        rows = sorted(r["id"] for r in
+                      ds.randomize_block_order(seed=3).iter_rows())
+        assert rows == list(range(40))
+
+    def test_split_at_indices_and_proportions(self, ray_shared):
+        parts = rd.range(10).split_at_indices([3, 7])
+        sizes = [p.count() for p in parts]
+        assert sizes == [3, 4, 3]
+        parts = rd.range(20).split_proportionately([0.25, 0.25])
+        assert [p.count() for p in parts] == [5, 5, 10]
+        train, test = rd.range(20).train_test_split(0.25)
+        assert (train.count(), test.count()) == (15, 5)
+
+    def test_schema_accessors_and_copy(self, ray_shared):
+        ds = rd.from_items([{"a": 1, "b": "x"}])
+        assert ds.names() == ["a", "b"]
+        assert len(ds.types()) == 2
+        cp = ds.copy()
+        assert cp.take_all() == ds.take_all()
+        from ray_tpu.data.context import DataContext
+
+        assert isinstance(ds.context(), DataContext)
+
+    def test_input_files(self, ray_shared, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        f = tmp_path / "part.parquet"
+        pq.write_table(pa.table({"v": [1, 2]}), f)
+        ds = rd.read_parquet(str(tmp_path))
+        assert ds.input_files() == [str(f)]
+
+    def test_to_refs(self, ray_shared):
+        import numpy as np
+
+        ds = rd.range(8, parallelism=2)
+        nrefs = ds.to_numpy_refs()
+        cols = ray_tpu.get(nrefs[0])
+        assert isinstance(cols["id"], np.ndarray)
+        arefs = ds.to_arrow_refs()
+        assert sum(ray_tpu.get(r).num_rows for r in arefs) == 8
+
+    def test_write_numpy_sql_webdataset(self, ray_shared, tmp_path):
+        import sqlite3
+
+        import numpy as np
+
+        rd.range(6).write_numpy(str(tmp_path / "np"), column="id")
+        arrs = [np.load(str(p)) for p in
+                sorted((tmp_path / "np").iterdir())]
+        assert sorted(np.concatenate(arrs).tolist()) == list(range(6))
+
+        db = tmp_path / "t.db"
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (v INTEGER)")
+        conn.commit()
+        conn.close()
+        rd.from_items([{"v": i} for i in range(5)]).write_sql(
+            "INSERT INTO t VALUES (?)",
+            lambda: sqlite3.connect(db))
+        conn = sqlite3.connect(db)
+        assert sorted(r[0] for r in
+                      conn.execute("SELECT v FROM t")) == list(range(5))
+        conn.close()
+
+        wds_dir = tmp_path / "wds"
+        rd.from_items(
+            [{"__key__": f"s{i}", "txt": f"hello{i}".encode()}
+             for i in range(4)]).write_webdataset(str(wds_dir))
+        back = rd.read_webdataset(str(wds_dir)).take_all()
+        assert sorted(bytes(r["txt"]).decode() for r in back) \
+            == [f"hello{i}" for i in range(4)]
