@@ -2215,11 +2215,15 @@ class CoreWorker:
 
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        prev_actor_id = self.current_actor_id
         try:
             cls = await self._fetch_function(h["function_id"])
             args, kwargs = await self._resolve_args(h, blobs)
             is_async = bool(h.get("is_async"))
             renv_desc = h.get("runtime_env")
+            # Visible DURING __init__: an actor constructor may ask
+            # get_runtime_context().get_actor_id() (ray allows it).
+            self.current_actor_id = h["actor_id"]
 
             def _construct():
                 from ray_tpu._private import runtime_env as renv
@@ -2246,9 +2250,9 @@ class CoreWorker:
                 concurrency_groups=h.get("concurrency_groups"),
                 method_groups=h.get("method_groups"),
                 bundle_key=h.get("bundle_key"))
-            self.current_actor_id = h["actor_id"]
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
+            self.current_actor_id = prev_actor_id
             return {"error": f"{type(e).__name__}: {e}\n"
                              f"{traceback.format_exc()}"}
         finally:

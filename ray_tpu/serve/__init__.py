@@ -3,7 +3,8 @@
 Controller reconcile loop + replica actors + power-of-two routing +
 stdlib HTTP proxy (SURVEY §2.3 / §3.5).
 """
-from ray_tpu.serve.api import (delete, get_app_handle, get_deployment_handle,
+from ray_tpu.serve.api import (HTTPOptions, delete, get_app_handle,
+                               get_deployment_handle, get_replica_context,
                                grpc_port, http_port, ingress, list_proxies,
                                proxy_ports, run, shutdown, start, status)
 from ray_tpu.serve.schema import apply_config

@@ -24,6 +24,36 @@ _controller = None      # ActorHandle
 _proxy = None           # ActorHandle
 
 
+class HTTPOptions(dict):
+    """serve.start(http_options=...) options (ray: serve.HTTPOptions).
+    A dict subclass so the existing dict-based plumbing accepts it
+    unchanged; attribute access mirrors the reference dataclass."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 **extra: Any):
+        super().__init__(host=host, port=port, **extra)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+def get_replica_context():
+    """Identity of the replica this code runs in (ray:
+    serve.get_replica_context): .app_name, .deployment, .replica_tag,
+    .servable_object.  Raises outside a replica."""
+    from ray_tpu.serve import replica as _replica
+
+    ctx = _replica.get_current_context()
+    if ctx is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called inside a "
+            "deployment replica")
+    return ctx
+
+
 def start(http_options: dict | None = None, detached: bool = True):
     """Ensure the Serve instance (controller + one proxy PER NODE) is
     running (ray: serve.start; proxies are reconciled by the controller

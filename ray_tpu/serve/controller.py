@@ -480,7 +480,8 @@ class ServeController:
         try:
             handle = ray_tpu.remote(Replica).options(**actor_opts).remote(
                 st.cls, st.init_args, st.init_kwargs,
-                st.config.max_ongoing_requests, st.config.user_config)
+                st.config.max_ongoing_requests, st.config.user_config,
+                app_name=st.app, deployment=st.name)
         except Exception:  # noqa: BLE001
             logger.error("replica start failed:\n%s", traceback.format_exc())
             return

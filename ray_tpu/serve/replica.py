@@ -9,16 +9,36 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import dataclasses
 import inspect
 import time
 from typing import Any
+
+
+@dataclasses.dataclass
+class ReplicaContext:
+    """Identity of the replica the calling code runs inside (ray:
+    serve.get_replica_context / ReplicaContext)."""
+    app_name: str
+    deployment: str
+    replica_tag: str
+    servable_object: Any
+
+
+# Set by Replica.__init__ in replica processes; None elsewhere.
+_current_context: ReplicaContext | None = None
+
+
+def get_current_context() -> ReplicaContext | None:
+    return _current_context
 
 
 class Replica:
     """Created via ActorClass(Replica).options(max_concurrency=...)."""
 
     def __init__(self, cls, init_args: tuple, init_kwargs: dict,
-                 max_ongoing_requests: int, user_config: Any = None):
+                 max_ongoing_requests: int, user_config: Any = None,
+                 app_name: str = "default", deployment: str = ""):
         self._cls = cls
         self._max_ongoing = max_ongoing_requests
         self._num_ongoing = 0
@@ -30,7 +50,15 @@ class Replica:
         self._slots = asyncio.Semaphore(max_ongoing_requests)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(2, max_ongoing_requests))
+        import ray_tpu
+
+        global _current_context
+        ctx = ray_tpu.get_runtime_context()
+        _current_context = ReplicaContext(
+            app_name=app_name, deployment=deployment,
+            replica_tag=ctx.get_actor_id() or "", servable_object=None)
         self._instance = cls(*init_args, **init_kwargs)
+        _current_context.servable_object = self._instance
         if user_config is not None:
             self._reconfigure_sync(user_config)
 

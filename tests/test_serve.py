@@ -253,3 +253,29 @@ def test_llm_deployment_through_serve(serve_instance):
         assert len(r["tokens"]) == 5
         assert r["ttft_s"] > 0
     serve.delete("llm_app")
+
+
+def test_replica_context_and_http_options(ray_shared):
+    """serve.get_replica_context identifies app/deployment/replica from
+    inside the replica (ray: serve.get_replica_context); HTTPOptions is
+    dict-compatible with attribute access."""
+    opts = serve.HTTPOptions(host="127.0.0.1", port=0)
+    assert opts.host == "127.0.0.1" and opts["port"] == 0
+
+    @serve.deployment
+    class WhereAmI:
+        def __call__(self, _req=None):
+            ctx = serve.get_replica_context()
+            return {"app": ctx.app_name, "dep": ctx.deployment,
+                    "tag": ctx.replica_tag,
+                    "self": ctx.servable_object is self}
+
+    h = serve.run(WhereAmI.bind(), name="ctxapp", route_prefix="/ctx")
+    out = h.remote().result(timeout_s=120)
+    assert out["app"] == "ctxapp"
+    assert out["dep"] == "WhereAmI"
+    assert out["tag"]
+    assert out["self"] is True
+    with pytest.raises(RuntimeError, match="inside a"):
+        serve.get_replica_context()
+    serve.delete("ctxapp")
