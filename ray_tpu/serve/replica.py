@@ -25,12 +25,21 @@ class ReplicaContext:
     servable_object: Any
 
 
-# Set by Replica.__init__ in replica processes; None elsewhere.
+# Per-call context (ContextVar: carries into the task handling one
+# request and, via copy_context().run, into pool threads) with a
+# process-global fallback for __init__-time calls.  The fallback alone
+# is wrong when one process hosts several replicas — e.g. every TPU
+# deployment's replicas share the host's single device worker — because
+# the last-constructed replica would clobber the rest.
+import contextvars
+
+_ctx_var: contextvars.ContextVar = contextvars.ContextVar(
+    "raytpu_serve_replica_ctx", default=None)
 _current_context: ReplicaContext | None = None
 
 
 def get_current_context() -> ReplicaContext | None:
-    return _current_context
+    return _ctx_var.get() or _current_context
 
 
 class Replica:
@@ -54,11 +63,16 @@ class Replica:
 
         global _current_context
         ctx = ray_tpu.get_runtime_context()
-        _current_context = ReplicaContext(
+        self._context = ReplicaContext(
             app_name=app_name, deployment=deployment,
             replica_tag=ctx.get_actor_id() or "", servable_object=None)
-        self._instance = cls(*init_args, **init_kwargs)
-        _current_context.servable_object = self._instance
+        _current_context = self._context
+        token = _ctx_var.set(self._context)
+        try:
+            self._instance = cls(*init_args, **init_kwargs)
+        finally:
+            _ctx_var.reset(token)
+        self._context.servable_object = self._instance
         if user_config is not None:
             self._reconfigure_sync(user_config)
 
@@ -88,10 +102,18 @@ class Replica:
         try:
             async with self._slots:
                 target = getattr(self._instance, method)
-                if inspect.iscoroutinefunction(target):
-                    return await target(*args, **kwargs)
-                return await asyncio.get_running_loop().run_in_executor(
-                    self._pool, lambda: target(*args, **kwargs))
+                token = _ctx_var.set(self._context)
+                try:
+                    if inspect.iscoroutinefunction(target):
+                        return await target(*args, **kwargs)
+                    # copy_context carries the replica identity into the
+                    # pool thread (run_in_executor alone does not).
+                    call_ctx = contextvars.copy_context()
+                    return await asyncio.get_running_loop().run_in_executor(
+                        self._pool,
+                        lambda: call_ctx.run(target, *args, **kwargs))
+                finally:
+                    _ctx_var.reset(token)
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
@@ -103,6 +125,7 @@ class Replica:
         generator produces them (ray: replica ASGI streaming path).  A
         non-generator result streams as a single item."""
         self._num_ongoing += 1
+        token = _ctx_var.set(self._context)
         try:
             target = getattr(self._instance, method)
             result = target(*args, **kwargs)
@@ -111,6 +134,7 @@ class Replica:
             else:
                 yield result
         finally:
+            _ctx_var.reset(token)
             self._num_ongoing -= 1
             self._num_processed += 1
 

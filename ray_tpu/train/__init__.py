@@ -7,8 +7,10 @@ Layers:
   - trainer: JaxTrainer(...).fit() -> Result
   - session: report()/get_checkpoint()/get_context() inside the loop
 """
+from ray_tpu.train.backend import Backend, BackendConfig  # noqa: F401
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig,  # noqa: F401
+from ray_tpu.train.config import (CheckpointConfig, DataConfig,  # noqa: F401
+                                  FailureConfig, SyncConfig,
                                   RunConfig, ScalingConfig)
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401

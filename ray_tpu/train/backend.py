@@ -19,6 +19,16 @@ if TYPE_CHECKING:
     from ray_tpu.train.worker_group import WorkerGroup
 
 
+class BackendConfig:
+    """Declarative backend selector (ray: train/backend.py
+    BackendConfig): subclasses name the Backend that implements their
+    setup via backend_cls."""
+
+    @property
+    def backend_cls(self) -> type:
+        return Backend
+
+
 class Backend:
     def on_start(self, worker_group: "WorkerGroup") -> None:  # noqa: B027
         pass

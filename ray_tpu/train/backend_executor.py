@@ -99,11 +99,21 @@ class BackendExecutor:
         # dataset (ray: DataParallelTrainer wiring train.get_dataset_shard
         # through the data StreamSplitDataIterator).
         shards_per_worker: list[dict] = [{} for _ in range(n)]
+        to_split = config.get("_datasets_to_split", "all")
+        if isinstance(to_split, str) and to_split != "all":
+            to_split = [to_split]    # membership, never substring match
         for name, ds in (config.get("_datasets") or {}).items():
-            its = ds.streaming_split(n)
-            for i in range(n):
-                shards_per_worker[i][name] = its[i]
-        config = {k: v for k, v in config.items() if k != "_datasets"}
+            if to_split == "all" or name in to_split:
+                its = ds.streaming_split(n)
+                for i in range(n):
+                    shards_per_worker[i][name] = its[i]
+            else:
+                # Unsplit datasets replicate: every worker iterates the
+                # whole thing (ray: DataConfig.datasets_to_split).
+                for i in range(n):
+                    shards_per_worker[i][name] = ds.iterator()
+        config = {k: v for k, v in config.items()
+                  if k not in ("_datasets", "_datasets_to_split")}
         ray_tpu.get([
             w.start_train_fn.remote(
                 train_fn, config, world_rank=i, world_size=n,

@@ -78,3 +78,28 @@ class RunConfig:
     checkpoint_config: CheckpointConfig | None = None
     stop: dict[str, Any] | None = None
     verbose: int = 1
+    # Tune experiment-loop callbacks (ray: RunConfig.callbacks); a
+    # ProgressReporter is a callback here (progress_reporter.py).
+    callbacks: list | None = None
+    # Accepted for API parity; storage is the local/shared filesystem at
+    # storage_path, so there is nothing to sync (ray: SyncConfig drives
+    # driver<->cloud uploads).
+    sync_config: "SyncConfig | None" = None
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Which datasets split across train workers (ray:
+    train/_internal/data_config.py).  Datasets named here shard via
+    streaming_split; others are passed whole to every worker."""
+    datasets_to_split: "list[str] | str" = "all"
+
+
+@dataclasses.dataclass
+class SyncConfig:
+    """ray: train/_internal/syncer.py SyncConfig — retained fields only;
+    syncing is a no-op because checkpoints/results already land on the
+    shared storage_path filesystem."""
+    sync_period: float = 300.0
+    sync_timeout: float = 1800.0
+    sync_artifacts: bool = False

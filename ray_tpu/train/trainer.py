@@ -51,13 +51,15 @@ class BaseTrainer:
                  scaling_config: ScalingConfig | None = None,
                  run_config: RunConfig | None = None,
                  resume_from_checkpoint: Checkpoint | None = None,
-                 datasets: dict | None = None):
+                 datasets: dict | None = None,
+                 dataset_config=None):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
         self.datasets = datasets or {}
+        self.dataset_config = dataset_config
 
     # ------------------------------------------------------------ plumbing
     def _storage_path(self) -> str:
@@ -133,6 +135,9 @@ class BaseTrainer:
         # Each worker's session.config gains an iterator over its shard
         # via ray_tpu.data streaming_split at run time (data lib).
         self.train_loop_config.setdefault("_datasets", self.datasets)
+        if self.dataset_config is not None:
+            self.train_loop_config.setdefault(
+                "_datasets_to_split", self.dataset_config.datasets_to_split)
 
     # --------------------------------------------------------------- tune
     def as_trainable(self) -> Callable:

@@ -16,11 +16,48 @@ from ray_tpu.tune.search.searcher import (BasicVariantGenerator,
                                           ConcurrencyLimiter, Searcher)
 from ray_tpu.tune.search.bohb import BOHBSearch
 from ray_tpu.tune.search.tpe import TPESearch
+from ray_tpu.tune.search.sample import qlograndint, qrandn
+from ray_tpu.tune.analysis import (Experiment, ExperimentAnalysis,
+                                   TuneError, run_experiments)
+from ray_tpu.tune.callback import Callback
+from ray_tpu.tune.placement_groups import PlacementGroupFactory
+from ray_tpu.tune.progress_reporter import (CLIReporter,
+                                            JupyterNotebookReporter,
+                                            ProgressReporter)
+from ray_tpu.tune.registry import register_env, register_trainable
+from ray_tpu.tune.stopper import (CombinedStopper,
+                                  MaximumIterationStopper, Stopper,
+                                  TrialPlateauStopper)
 from ray_tpu.tune.trainable import (Trainable, get_checkpoint, report,
+                                    with_parameters, with_resources,
                                     wrap_function)
 from ray_tpu.tune.tuner import (Result, ResultGrid, TuneConfig, Tuner, run)
 
 ASHAScheduler = AsyncHyperBandScheduler
+
+
+def create_scheduler(name: str, **kwargs):
+    """Scheduler by name (ray: tune/schedulers/__init__.py
+    create_scheduler)."""
+    table = {"fifo": FIFOScheduler, "asha": AsyncHyperBandScheduler,
+             "async_hyperband": AsyncHyperBandScheduler,
+             "hyperband": HyperBandScheduler,
+             "median_stopping_rule": MedianStoppingRule,
+             "pbt": PopulationBasedTraining}
+    if name not in table:
+        raise ValueError(f"unknown scheduler {name!r}: {sorted(table)}")
+    return table[name](**kwargs)
+
+
+def create_searcher(name: str, **kwargs):
+    """Searcher by name (ray: tune/search/__init__.py
+    create_searcher)."""
+    table = {"random": BasicVariantGenerator,
+             "variant_generator": BasicVariantGenerator,
+             "hyperopt": TPESearch, "tpe": TPESearch, "bohb": BOHBSearch}
+    if name not in table:
+        raise ValueError(f"unknown searcher {name!r}: {sorted(table)}")
+    return table[name](**kwargs)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "Result", "run",
@@ -30,5 +67,12 @@ __all__ = [
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
     "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
-    "qrandint", "lograndint", "choice", "sample_from", "grid_search",
+    "qrandint", "lograndint", "qlograndint", "qrandn", "choice",
+    "sample_from", "grid_search",
+    "Stopper", "CombinedStopper", "MaximumIterationStopper",
+    "TrialPlateauStopper", "Callback", "ProgressReporter", "CLIReporter",
+    "JupyterNotebookReporter", "PlacementGroupFactory", "TuneError",
+    "Experiment", "ExperimentAnalysis", "run_experiments",
+    "register_trainable", "register_env", "with_parameters",
+    "with_resources", "create_scheduler", "create_searcher",
 ]

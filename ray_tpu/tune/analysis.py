@@ -1,0 +1,113 @@
+"""Experiment definition, post-hoc analysis, and legacy run_experiments
+(ray: tune/experiment/experiment.py, tune/analysis/experiment_analysis.py,
+tune/tune.py run_experiments).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+from ray_tpu.tune.experiment import ExperimentState, Trial
+
+
+class TuneError(Exception):
+    """ray: tune/error.py TuneError."""
+
+
+class Experiment:
+    """Declarative experiment spec consumed by run_experiments (ray:
+    Experiment).  A thin record: Tuner is the primary API."""
+
+    def __init__(self, name: str, run: Any, *, config: dict | None = None,
+                 stop: Any = None, num_samples: int = 1,
+                 storage_path: str | None = None,
+                 resources_per_trial: dict | None = None):
+        self.name = name
+        self.run_identifier = run
+        self.config = config or {}
+        self.stop = stop
+        self.num_samples = num_samples
+        self.storage_path = storage_path
+        self.resources_per_trial = resources_per_trial
+
+
+def run_experiments(
+        experiments: "Experiment | list[Experiment]") -> list[Trial]:
+    """Sequentially run Experiment specs (ray: run_experiments); each
+    rides the modern Tuner path."""
+    from ray_tpu.train.config import RunConfig
+    from ray_tpu.tune.trainable import with_resources
+    from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+    if isinstance(experiments, Experiment):
+        experiments = [experiments]
+    trials: list[Trial] = []
+    for exp in experiments:
+        trainable = exp.run_identifier
+        if exp.resources_per_trial:
+            trainable = with_resources(trainable, exp.resources_per_trial)
+        tuner = Tuner(
+            trainable, param_space=exp.config,
+            tune_config=TuneConfig(num_samples=exp.num_samples),
+            run_config=RunConfig(name=exp.name, stop=exp.stop,
+                                 storage_path=exp.storage_path))
+        grid = tuner.fit()
+        trials.extend(grid._trials)
+    return trials
+
+
+class ExperimentAnalysis:
+    """Post-hoc view over a finished (or running) experiment's snapshot
+    (ray: ExperimentAnalysis).  Loads experiment_state.json written by
+    the controller."""
+
+    def __init__(self, experiment_checkpoint_path: str,
+                 default_metric: str | None = None,
+                 default_mode: str | None = None):
+        path = experiment_checkpoint_path
+        if os.path.isfile(path):
+            path = os.path.dirname(path)
+        storage, name = os.path.split(path.rstrip("/"))
+        self._state = ExperimentState(storage, name)
+        self.trials, self._meta = self._state.load(name)
+        self.default_metric = default_metric or self._meta.get("metric")
+        self.default_mode = default_mode or self._meta.get("mode", "max")
+
+    def _scored(self, metric: str) -> list[Trial]:
+        return [t for t in self.trials
+                if t.last_result and t.last_result.get(metric) is not None]
+
+    def get_best_trial(self, metric: str | None = None,
+                       mode: str | None = None) -> Trial | None:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        scored = self._scored(metric)
+        if not scored:
+            return None
+        key: Callable = lambda t: t.last_result[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored,
+                                                              key=key)
+
+    @property
+    def best_trial(self) -> Trial | None:
+        return self.get_best_trial()
+
+    @property
+    def best_config(self) -> dict | None:
+        t = self.get_best_trial()
+        return t.config if t else None
+
+    @property
+    def best_checkpoint(self):
+        t = self.get_best_trial()
+        return t.checkpoint if t else None
+
+    def dataframe(self) -> list[dict]:
+        """Final-result rows (list of dicts, pandas-free)."""
+        out = []
+        for t in self.trials:
+            row = dict(t.last_result or {})
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            out.append(row)
+        return out

@@ -1,0 +1,41 @@
+"""Experiment-loop callbacks (ray: python/ray/tune/callback.py).
+
+The TuneController invokes each hook; exceptions in user callbacks are
+logged, never fatal to the experiment (matching the reference's
+error-isolated callback dispatch).
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    def on_trial_start(self, iteration: int, trials: list, trial,
+                       **info) -> None:
+        pass
+
+    def on_trial_result(self, iteration: int, trials: list, trial,
+                        result: dict, **info) -> None:
+        pass
+
+    def on_trial_complete(self, iteration: int, trials: list, trial,
+                          **info) -> None:
+        pass
+
+    def on_trial_error(self, iteration: int, trials: list, trial,
+                       **info) -> None:
+        pass
+
+    def on_experiment_end(self, trials: list, **info) -> None:
+        pass
+
+
+def fire(callbacks, hook: str, *args, **kwargs) -> None:
+    for cb in callbacks or ():
+        try:
+            getattr(cb, hook)(*args, **kwargs)
+        except Exception:  # noqa: BLE001
+            logger.exception("tune callback %s.%s failed",
+                             type(cb).__name__, hook)

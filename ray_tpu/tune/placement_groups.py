@@ -1,0 +1,28 @@
+"""Per-trial placement-group resource requests (ray:
+tune/execution/placement_groups.py PlacementGroupFactory).
+
+A trial requesting a PlacementGroupFactory gets a placement group with
+those bundles created before its actor starts; the trial actor lands in
+bundle 0 and the PG is removed when the trial's actor stops.
+"""
+from __future__ import annotations
+
+
+class PlacementGroupFactory:
+    def __init__(self, bundles: list[dict], strategy: str = "PACK"):
+        if not bundles:
+            raise ValueError("PlacementGroupFactory needs >= 1 bundle")
+        self.bundles = [dict(b) for b in bundles]
+        self.strategy = strategy
+
+    @property
+    def required_resources(self) -> dict:
+        out: dict = {}
+        for b in self.bundles:
+            for k, v in b.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def __repr__(self):
+        return (f"PlacementGroupFactory({self.bundles}, "
+                f"strategy={self.strategy!r})")

@@ -1,0 +1,59 @@
+"""Progress reporting (ray: python/ray/tune/progress_reporter.py).
+
+Redesigned as a Callback (the reference drives reporters from its own
+loop; riding the callback hooks gives the same output without a second
+dispatch path).  CLIReporter prints a throttled status table.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+from ray_tpu.tune.callback import Callback
+
+
+class ProgressReporter(Callback):
+    pass
+
+
+class CLIReporter(ProgressReporter):
+    def __init__(self, *, metric_columns: list[str] | None = None,
+                 max_report_frequency: float = 5.0, out=None):
+        self._metrics = metric_columns
+        self._period = max_report_frequency
+        self._last = 0.0
+        self._out = out or sys.stdout
+
+    def _row(self, t) -> str:
+        r = t.last_result or {}
+        metrics = self._metrics or [k for k in r
+                                    if isinstance(r[k], (int, float))][:4]
+        cells = " ".join(f"{m}={r.get(m)}" for m in metrics)
+        return f"  {t.trial_id} {t.status:<10} it={len(t.results)} {cells}"
+
+    def _print(self, trials, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last < self._period:
+            return
+        self._last = now
+        by = {}
+        for t in trials:
+            by[t.status] = by.get(t.status, 0) + 1
+        head = ", ".join(f"{v} {k}" for k, v in sorted(by.items()))
+        print(f"== Tune status: {head} ==", file=self._out)
+        for t in trials:
+            print(self._row(t), file=self._out)
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        self._print(trials)
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        self._print(trials)
+
+    def on_experiment_end(self, trials, **info):
+        self._print(trials, force=True)
+
+
+# Notebook environments get the same text output (the reference's rich
+# HTML table is a frontend nicety, not behavior).
+JupyterNotebookReporter = CLIReporter

@@ -1,0 +1,34 @@
+"""Trainable / env registry (ray: python/ray/tune/registry.py).
+
+Names registered in the driver resolve in Tuner(trainable="name") and
+rl Algorithm(env="name").  The registry is process-local: trainables
+ship to trial actors by value (cloudpickle), exactly like unregistered
+ones, so no cluster-side table is needed (the reference's GCS-backed
+registry exists to serve its separate-process trainable resolution).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_trainables: dict[str, Any] = {}
+
+
+def register_trainable(name: str, trainable: Any) -> None:
+    if not callable(trainable):
+        raise TypeError(f"trainable must be callable, got {trainable!r}")
+    _trainables[name] = trainable
+
+
+def get_trainable_cls(name: str) -> Any:
+    if name not in _trainables:
+        raise ValueError(f"unknown trainable {name!r}; "
+                         f"registered: {sorted(_trainables)}")
+    return _trainables[name]
+
+
+def register_env(name: str, env_creator: Callable) -> None:
+    """Delegates to the rl env registry — tune.register_env and the
+    rllib registry are one table in the reference too."""
+    from ray_tpu.rl.env import register_env as _register
+
+    _register(name, env_creator)

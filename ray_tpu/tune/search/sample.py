@@ -64,10 +64,20 @@ class Integer(Domain):
         else:
             v = rng.randint(self.lower, self.upper - 1) \
                 if self.upper > self.lower else self.lower
+        if self.upper > self.lower:
+            v = min(max(v, self.lower), self.upper - 1)
+        else:
+            v = self.lower
         if self.q > 1:
+            # Round to q LAST, then snap back inside the (q-aligned)
+            # range — clamping after rounding could return non-multiples
+            # of q (e.g. upper-1) to the searcher.
             v = int(round(v / self.q) * self.q)
-        return min(max(v, self.lower), self.upper - 1) \
-            if self.upper > self.lower else self.lower
+            if v > self.upper - 1:
+                v -= self.q
+            if v < self.lower:
+                v += self.q
+        return v
 
     def __repr__(self):
         return f"randint({self.lower}, {self.upper})"
@@ -85,11 +95,15 @@ class Categorical(Domain):
 
 
 class Normal(Domain):
-    def __init__(self, mean: float = 0.0, sd: float = 1.0):
-        self.mean, self.sd = mean, sd
+    def __init__(self, mean: float = 0.0, sd: float = 1.0,
+                 q: float | None = None):
+        self.mean, self.sd, self.q = mean, sd, q
 
     def sample(self, rng: random.Random) -> float:
-        return rng.gauss(self.mean, self.sd)
+        v = rng.gauss(self.mean, self.sd)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
 
 
 class Function(Domain):
@@ -136,6 +150,10 @@ def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
     return Normal(mean, sd)
 
 
+def qrandn(mean: float, sd: float, q: float) -> Normal:
+    return Normal(mean, sd, q=q)
+
+
 def randint(lower: int, upper: int) -> Integer:
     return Integer(lower, upper)
 
@@ -146,6 +164,10 @@ def qrandint(lower: int, upper: int, q: int = 1) -> Integer:
 
 def lograndint(lower: int, upper: int) -> Integer:
     return Integer(lower, upper, log=True)
+
+
+def qlograndint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, log=True, q=q)
 
 
 def choice(categories: Sequence[Any]) -> Categorical:

@@ -1,0 +1,72 @@
+"""Programmatic trial stoppers (ray: python/ray/tune/stopper/).
+
+A Stopper is callable per (trial_id, result) and can end the whole
+experiment via stop_all(); `RunConfig(stop=...)` accepts one anywhere a
+dict or callable is accepted (the controller's _should_stop treats the
+instance as the callable it is).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: dict) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    """ray: stopper/maximum_iteration.py."""
+
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+
+    def __call__(self, trial_id: str, result: dict) -> bool:
+        return result.get("training_iteration", 0) >= self._max_iter
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial whose metric stopped moving (ray:
+    stopper/trial_plateau.py): std of the last `num_results` values
+    under `std`, after at least `grace_period` results."""
+
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 mode: str | None = None):
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._window: dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=num_results))
+        self._count: dict[str, int] = defaultdict(int)
+
+    def __call__(self, trial_id: str, result: dict) -> bool:
+        v = result.get(self._metric)
+        if v is None:
+            return False
+        self._count[trial_id] += 1
+        win = self._window[trial_id]
+        win.append(float(v))
+        if self._count[trial_id] < self._grace \
+                or len(win) < self._num_results:
+            return False
+        mean = sum(win) / len(win)
+        var = sum((x - mean) ** 2 for x in win) / len(win)
+        return var ** 0.5 <= self._std
+
+
+class CombinedStopper(Stopper):
+    """ray: stopper/combined.py — OR over sub-stoppers."""
+
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id: str, result: dict) -> bool:
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self) -> bool:
+        return any(s.stop_all() for s in self._stoppers)

@@ -231,3 +231,53 @@ def wrap_function(fn: Callable) -> type:
 
 def is_trainable_class(obj: Any) -> bool:
     return isinstance(obj, type) and issubclass(obj, Trainable)
+
+
+def with_parameters(trainable: Any, **kwargs: Any) -> Any:
+    """Bind large objects to a trainable via the object store (ray:
+    tune.with_parameters): each value is put() ONCE and every trial
+    fetches the shared copy instead of re-pickling it into each trial's
+    config/closure."""
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    if isinstance(trainable, type):
+        if not issubclass(trainable, Trainable):
+            raise TypeError("with_parameters expects a function or a "
+                            "Trainable subclass")
+
+        class _WithParams(trainable):
+            def setup(self, config: dict) -> None:
+                super().setup(
+                    {**config,
+                     **{k: ray_tpu.get(r) for k, r in refs.items()}})
+
+        _WithParams.__name__ = trainable.__name__
+        _WithParams._tune_with_parameters = True
+        return _WithParams
+
+    fn = trainable
+
+    def _bound(config: dict):
+        return fn(config,
+                  **{k: ray_tpu.get(r) for k, r in refs.items()})
+
+    _bound.__name__ = getattr(fn, "__name__", "trainable")
+    return _bound
+
+
+def with_resources(trainable: Any, resources: Any) -> Any:
+    """Attach a per-trial resource request (ray: tune.with_resources).
+    `resources` is a dict ({"CPU": 2}) or a PlacementGroupFactory."""
+    if isinstance(trainable, type):
+        out = type(trainable.__name__, (trainable,), {})
+    elif callable(trainable):
+        def out(config):  # noqa: ANN001
+            return trainable(config)
+
+        out.__name__ = getattr(trainable, "__name__", "trainable")
+    else:
+        raise TypeError(f"not a trainable: {trainable!r}")
+    out._tune_resources = resources
+    return out

@@ -64,7 +64,8 @@ class TuneController:
                  resources_per_trial: dict | None = None,
                  checkpoint_freq: int = 0,
                  num_samples: int = 0,
-                 restored_trials: list[Trial] | None = None):
+                 restored_trials: list[Trial] | None = None,
+                 callbacks: list | None = None):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
         # Trial budget for model-based searchers, which suggest forever
@@ -79,6 +80,11 @@ class TuneController:
         self.max_failures = max_failures
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.checkpoint_freq = checkpoint_freq
+        self.callbacks = list(callbacks or [])
+        self._iteration = 0
+        self._stop_all = False
+        # trial_id -> PlacementGroup for PlacementGroupFactory trials
+        self._trial_pgs: dict = {}
         self.experiment_name = experiment_name
         self.state = ExperimentState(storage_path, experiment_name)
 
@@ -130,12 +136,44 @@ class TuneController:
                     checkpoint = ckpt
                     config = new_config
                     trial.config = new_config
-        opts = _actor_options(trial.resources)
+        from ray_tpu.tune.placement_groups import PlacementGroupFactory
+
+        if isinstance(trial.resources, PlacementGroupFactory):
+            # The trial gets its own PG; the runner actor rides bundle 0
+            # (ray: trials schedule inside their PlacementGroupFactory
+            # reservation; worker groups started by trainers consume the
+            # other bundles).
+            from ray_tpu.utils.placement_group import placement_group
+
+            pg = self._trial_pgs.get(trial.trial_id)
+            if pg is None:
+                pg = placement_group(trial.resources.bundles,
+                                     strategy=trial.resources.strategy)
+                if not pg.ready(timeout=60.0):
+                    # Unreservable now: don't launch against unplaced
+                    # bundles — fail the trial visibly (step()'s except
+                    # path records the error and releases the PG).
+                    from ray_tpu.utils.placement_group import \
+                        remove_placement_group
+
+                    remove_placement_group(pg)
+                    raise RuntimeError(
+                        f"placement group for trial {trial.trial_id} "
+                        f"not ready in 60s: {trial.resources}")
+                self._trial_pgs[trial.trial_id] = pg
+            opts = {"placement_group": pg,
+                    "placement_group_bundle_index": 0}
+        else:
+            opts = _actor_options(trial.resources)
         runner = ray_tpu.remote(_TrialRunner).options(**opts).remote(
             self.trainable_cls, config, trial.trial_id, checkpoint)
         self._actors[trial.trial_id] = runner
         trial.status = RUNNING
         trial.start_time = trial.start_time or time.time()
+        from ray_tpu.tune.callback import fire
+
+        fire(self.callbacks, "on_trial_start", self._iteration,
+             self.trials, trial)
         self._submit_train(trial)
 
     def _donor_checkpoint(self, donor: Trial) -> Checkpoint | None:
@@ -154,23 +192,36 @@ class TuneController:
 
     def _stop_actor(self, trial: Trial, save: bool = False) -> None:
         handle = self._actors.pop(trial.trial_id, None)
-        if handle is None:
-            return
-        try:
-            if save:
-                trial.checkpoint = ray_tpu.get(handle.save.remote(),
-                                               timeout=60.0)
-            ray_tpu.get(handle.stop.remote(), timeout=10.0)
-        except Exception:  # noqa: BLE001
-            pass
-        ray_tpu.kill(handle)
+        if handle is not None:
+            try:
+                if save:
+                    trial.checkpoint = ray_tpu.get(handle.save.remote(),
+                                                   timeout=60.0)
+                ray_tpu.get(handle.stop.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+            ray_tpu.kill(handle)
+        pg = self._trial_pgs.pop(trial.trial_id, None)
+        if pg is not None:
+            from ray_tpu.utils.placement_group import \
+                remove_placement_group
+
+            try:
+                remove_placement_group(pg)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _should_stop(self, trial: Trial, result: dict) -> bool:
         crit = self.stop_criteria
         if crit is None:
             return False
         if callable(crit):
-            return bool(crit(trial.trial_id, result))
+            hit = bool(crit(trial.trial_id, result))
+            # A Stopper can end the whole experiment (ray: stop_all()
+            # polled after each result).
+            if getattr(crit, "stop_all", None) and crit.stop_all():
+                self._stop_all = True
+            return hit
         for key, bound in crit.items():
             v = result.get(key)
             if v is not None and v >= bound:
@@ -180,6 +231,11 @@ class TuneController:
     # ------------------------------------------------------------ main loop
     def step(self) -> bool:
         """One scheduling step; returns False when the experiment is done."""
+        self._iteration += 1
+        if self._stop_all:
+            for t in self._running():
+                self._complete(t, TERMINATED)
+            return False
         # 1. launch work up to the concurrency cap
         cap = self.max_concurrent or 10 ** 9
         while len(self._running()) < cap:
@@ -194,7 +250,12 @@ class TuneController:
             except Exception as e:  # noqa: BLE001
                 trial.status = ERROR
                 trial.error = repr(e)
+                self.scheduler.on_trial_complete(trial, trial.last_result)
                 self.searcher.on_trial_complete(trial.trial_id, error=True)
+                from ray_tpu.tune.callback import fire
+
+                fire(self.callbacks, "on_trial_error", self._iteration,
+                     self.trials, trial)
         if not self._futures:
             if self._live():
                 time.sleep(0.05)   # searcher momentarily out of suggestions
@@ -220,6 +281,11 @@ class TuneController:
     _AUTO_KEYS = frozenset({TRAINING_ITERATION, "time_total_s", "trial_id"})
 
     def _on_trial_result(self, trial: Trial, result: dict) -> None:
+        from ray_tpu.tune.callback import fire
+
+        if not result.get(RESULT_DONE):
+            fire(self.callbacks, "on_trial_result", self._iteration,
+                 self.trials, trial, result)
         if result.pop(RESULT_DONE, False):
             # the done marker only carries data when the fn returned a dict
             if set(result) - self._AUTO_KEYS:
@@ -262,12 +328,21 @@ class TuneController:
         self.scheduler.on_trial_complete(trial, trial.last_result)
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result,
                                         error=True)
+        from ray_tpu.tune.callback import fire
+
+        fire(self.callbacks, "on_trial_error", self._iteration,
+             self.trials, trial)
 
     def _complete(self, trial: Trial, status: str) -> None:
         self._stop_actor(trial, save=trial.checkpoint is None)
         trial.status = status
         self.scheduler.on_trial_complete(trial, trial.last_result)
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+        from ray_tpu.tune.callback import fire
+
+        fire(self.callbacks,
+             "on_trial_error" if status == ERROR else "on_trial_complete",
+             self._iteration, self.trials, trial)
 
     def run(self) -> list[Trial]:
         try:
@@ -280,6 +355,9 @@ class TuneController:
                     t.status = TERMINATED
             self.state.save(self.trials, {"metric": self.metric,
                                           "mode": self.mode})
+            from ray_tpu.tune.callback import fire
+
+            fire(self.callbacks, "on_experiment_end", self.trials)
         return self.trials
 
 

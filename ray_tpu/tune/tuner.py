@@ -135,8 +135,18 @@ class Tuner:
         return self.run_config.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")
 
-    def _trainable_cls(self) -> type:
+    def _resolved(self):
+        """The registered object when `trainable` is a registry name —
+        resource declarations live on the OBJECT, not the name."""
         t = self.trainable
+        if isinstance(t, str):
+            from ray_tpu.tune.registry import get_trainable_cls
+
+            t = get_trainable_cls(t)
+        return t
+
+    def _trainable_cls(self) -> type:
+        t = self._resolved()
         if is_trainable_class(t):
             return t
         if callable(t) and not hasattr(t, "as_trainable"):
@@ -172,8 +182,11 @@ class Tuner:
             return 0
         return self.tune_config.num_samples
 
-    def _resources(self) -> dict:
-        t = self.trainable
+    def _resources(self):
+        t = self._resolved()
+        declared = getattr(t, "_tune_resources", None)
+        if declared is not None:      # tune.with_resources / PGF
+            return declared
         if hasattr(t, "scaling_config"):
             # Trainer: the trial actor only coordinates; its workers hold
             # the real resources (ray: _maybe_warn_resource_contention)
@@ -196,7 +209,8 @@ class Tuner:
             resources_per_trial=self._resources(),
             checkpoint_freq=tc.checkpoint_freq,
             num_samples=self._external_trial_cap(),
-            restored_trials=self._restored_trials)
+            restored_trials=self._restored_trials,
+            callbacks=self.run_config.callbacks)
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
 

@@ -312,3 +312,130 @@ class TestBOHB:
         results = tuner.fit()
         best = results.get_best_result()
         assert best.metrics["score"] > -4.0
+
+
+class TestCompatSurface:
+    """Round-4 tune API parity batch (ray: tune/__init__ __all__)."""
+
+    def test_stoppers(self):
+        s = tune.MaximumIterationStopper(3)
+        assert not s("t", {"training_iteration": 2})
+        assert s("t", {"training_iteration": 3})
+        p = tune.TrialPlateauStopper("loss", std=0.001, num_results=3,
+                                     grace_period=3)
+        assert not p("t", {"loss": 1.0})
+        assert not p("t", {"loss": 0.5})
+        assert p("t", {"loss": 0.5}) is False  # third result, still moving
+        assert p("t", {"loss": 0.5})           # window now flat
+        c = tune.CombinedStopper(tune.MaximumIterationStopper(1), p)
+        assert c("t", {"training_iteration": 5})
+
+    def test_q_samplers(self):
+        import random as _r
+
+        rng = _r.Random(0)
+        v = tune.qrandn(10.0, 2.0, 0.5).sample(rng)
+        assert abs(v / 0.5 - round(v / 0.5)) < 1e-9
+        v = tune.qlograndint(4, 256, 4).sample(rng)
+        assert v % 4 == 0 and 4 <= v <= 256
+
+    def test_callbacks_and_reporter(self, ray_shared, tmp_path):
+        import io
+
+        from ray_tpu.train.config import RunConfig
+
+        events = []
+
+        class Rec(tune.Callback):
+            def on_trial_start(self, it, trials, trial, **kw):
+                events.append("start")
+
+            def on_trial_result(self, it, trials, trial, result, **kw):
+                events.append("result")
+
+            def on_trial_complete(self, it, trials, trial, **kw):
+                events.append("complete")
+
+            def on_experiment_end(self, trials, **kw):
+                events.append("end")
+
+        buf = io.StringIO()
+        reporter = tune.CLIReporter(metric_columns=["score"],
+                                    max_report_frequency=0.0, out=buf)
+
+        def train_fn(config):
+            tune.report({"score": config["x"]})
+
+        tune.Tuner(
+            train_fn, param_space={"x": tune.grid_search([1, 2])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(storage_path=str(tmp_path),
+                                 callbacks=[Rec(), reporter]),
+        ).fit()
+        assert events.count("start") == 2
+        assert events.count("complete") == 2
+        assert events[-1] == "end"
+        assert "Tune status" in buf.getvalue()
+
+    def test_with_parameters_and_resources(self, ray_shared, tmp_path):
+        import numpy as np
+
+        from ray_tpu.train.config import RunConfig
+
+        big = np.arange(1000)
+
+        def train_fn(config, data=None):
+            tune.report({"got": int(data.sum())})
+
+        bound = tune.with_parameters(train_fn, data=big)
+        sized = tune.with_resources(bound, {"CPU": 1})
+        grid = tune.Tuner(
+            sized, param_space={},
+            tune_config=tune.TuneConfig(metric="got", mode="max"),
+            run_config=RunConfig(storage_path=str(tmp_path))).fit()
+        assert grid.get_best_result().metrics["got"] == int(big.sum())
+
+    def test_registry_and_experiment_analysis(self, ray_shared, tmp_path):
+        from ray_tpu.train.config import RunConfig
+
+        def train_fn(config):
+            tune.report({"score": config["x"] * 2})
+
+        tune.register_trainable("doubler", train_fn)
+        tune.Tuner(
+            "doubler", param_space={"x": tune.grid_search([3, 5])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(name="regexp",
+                                 storage_path=str(tmp_path))).fit()
+        ana = tune.ExperimentAnalysis(str(tmp_path / "regexp"))
+        assert ana.best_trial.last_result["score"] == 10
+        assert ana.best_config == {"x": 5}
+        assert len(ana.dataframe()) == 2
+
+    def test_run_experiments_legacy(self, ray_shared, tmp_path):
+        def train_fn(config):
+            tune.report({"v": 1})
+
+        trials = tune.run_experiments(tune.Experiment(
+            "legacy", train_fn, config={}, num_samples=2,
+            storage_path=str(tmp_path)))
+        assert len(trials) == 2
+        assert all(t.status == "TERMINATED" for t in trials)
+
+    def test_placement_group_factory_trial(self, ray_shared, tmp_path):
+        from ray_tpu.train.config import RunConfig
+
+        def train_fn(config):
+            from ray_tpu import utils
+
+            pg = utils.get_current_placement_group()
+            tune.report({"in_pg": 1 if pg is not None else 0})
+
+        pgf = tune.PlacementGroupFactory([{"CPU": 1}, {"CPU": 1}])
+        assert pgf.required_resources == {"CPU": 2.0}
+        sized = tune.with_resources(train_fn, pgf)
+        grid = tune.Tuner(
+            sized, param_space={},
+            tune_config=tune.TuneConfig(metric="in_pg", mode="max"),
+            run_config=RunConfig(storage_path=str(tmp_path))).fit()
+        assert grid.get_best_result().metrics["in_pg"] == 1
