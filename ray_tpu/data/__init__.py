@@ -7,14 +7,28 @@ iter_jax_batches / streaming_split.
 """
 from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
-from ray_tpu.data.dataset import (Dataset, from_arrow, from_generators,  # noqa: F401,E501
-                                  from_huggingface, from_items,
-                                  from_numpy, from_pandas, range,
+from ray_tpu.data.dataset import (Dataset, from_arrow, from_arrow_refs,  # noqa: F401,E501
+                                  from_generators, from_huggingface,
+                                  from_items, from_numpy,
+                                  from_numpy_refs, from_pandas,
+                                  from_pandas_refs, range, range_tensor,
                                   read_avro, read_binary_files, read_csv,
-                                  read_images, read_json, read_parquet,
-                                  read_sql, read_text, read_tfrecords,
-                                  read_webdataset)
+                                  read_datasource, read_images,
+                                  read_json, read_numpy, read_parquet,
+                                  read_parquet_bulk, read_sql, read_text,
+                                  read_tfrecords, read_webdataset,
+                                  set_progress_bars)
+from ray_tpu.data.datasource import ReadTask  # noqa: F401
+from ray_tpu.data.interfaces import (ActorPoolStrategy, Datasink,  # noqa: F401,E501
+                                     Datasource, ExecutionOptions,
+                                     ExecutionResources)
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
+
+# Block schemas ARE pyarrow schemas here (ray wraps them in its own
+# Schema type; the accessor surface .names/.types matches).
+import pyarrow as _pa  # noqa: E402
+
+Schema = _pa.Schema
 from ray_tpu.data.preprocessor import (Preprocessor,  # noqa: F401
                                        PreprocessorNotFittedException)
 from ray_tpu.data import preprocessors  # noqa: F401

@@ -19,16 +19,28 @@ def _to_table(data: Any) -> pa.Table:
         return data
     if isinstance(data, dict):
         cols = {}
+        meta = {}
         for k, v in data.items():
             arr = np.asarray(v)
             if arr.ndim > 1:
-                # tensor column: store as fixed-size-list of flattened rows
+                # Tensor column: fixed-size-list of flattened rows, with
+                # the per-row shape in schema metadata so to_numpy
+                # restores [n, *shape] instead of [n, prod(shape)].
                 flat = arr.reshape(arr.shape[0], -1)
                 cols[k] = pa.FixedSizeListArray.from_arrays(
                     pa.array(flat.ravel()), flat.shape[1])
+                if arr.ndim > 2:
+                    import json as _json
+
+                    meta[f"tensor:{k}"] = _json.dumps(arr.shape[1:])
                 continue
             cols[k] = pa.array(arr)
-        return pa.table(cols)
+        t = pa.table(cols)
+        if meta:
+            t = t.replace_schema_metadata(
+                {**(t.schema.metadata or {}),
+                 **{k.encode(): v.encode() for k, v in meta.items()}})
+        return t
     try:
         import pandas as pd
 
@@ -76,7 +88,14 @@ class BlockAccessor:
                 width = col.type.list_size
                 flat = col.combine_chunks().flatten().to_numpy(
                     zero_copy_only=False)
-                out[name] = flat.reshape(-1, width)
+                arr = flat.reshape(-1, width)
+                meta = self.block.schema.metadata or {}
+                shape_b = meta.get(f"tensor:{name}".encode())
+                if shape_b is not None:
+                    import json as _json
+
+                    arr = arr.reshape(-1, *_json.loads(shape_b))
+                out[name] = arr
             else:
                 out[name] = col.to_numpy(zero_copy_only=False)
         return out

@@ -61,7 +61,13 @@ class Dataset:
                     num_cpus: float | None = None,
                     num_tpus: float = 0.0) -> "Dataset":
         """fn: batch->batch (callable) or a class (stateful actor UDF,
-        compute="actors")."""
+        compute="actors" or an ActorPoolStrategy)."""
+        from ray_tpu.data.interfaces import ActorPoolStrategy
+
+        if isinstance(compute, ActorPoolStrategy):
+            if concurrency is None:
+                concurrency = (compute.min_size, compute.max_size)
+            compute = "actors"
         if compute is None:
             compute = "actors" if isinstance(fn, type) else "tasks"
         return self._with(L.MapBatches(
@@ -563,6 +569,20 @@ class Dataset:
 
         ray_tpu.get([write_one.remote(r, i) for i, r in enumerate(refs)])
 
+    def write_datasink(self, datasink) -> None:
+        """Custom sink: datasink.write(block) runs once per block in a
+        task; on_write_complete gets every result on the driver (ray:
+        Dataset.write_datasink)."""
+        datasink.on_write_start()
+        refs = list(self._ref_iter())
+
+        @ray_tpu.remote
+        def write_one(block):
+            return datasink.write(block)
+
+        results = ray_tpu.get([write_one.remote(r) for r in refs])
+        datasink.on_write_complete(results)
+
     def __repr__(self):
         if self._materialized is not None:
             return f"MaterializedDataset({len(self._materialized)} blocks)"
@@ -700,6 +720,101 @@ def read_images(paths, *, parallelism: int = 8,
 def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
     """Whole files → {"bytes", "path"} rows (ray: read_binary_files)."""
     return _read(ds.binary_tasks(paths, parallelism))
+
+
+def read_numpy(paths, *, parallelism: int = 8) -> Dataset:
+    """.npy files → {"data": array} rows, one block per file (ray:
+    read_numpy; the write_numpy inverse)."""
+    from ray_tpu.data.block import _to_table as _to_block_table
+
+    files = ds._expand_paths(paths, ".npy")
+
+    def mk(path):
+        def read():
+            import numpy as _np
+
+            yield _to_block_table({"data": _np.load(path)})
+
+        return read
+
+    return _read([mk(p) for p in files], files)
+
+
+def read_parquet_bulk(paths, *, parallelism: int = 8) -> Dataset:
+    """One read task per file with no upfront metadata pass — our
+    read_parquet is already per-file and metadata-free, so this is the
+    same plan (ray: read_parquet_bulk exists to skip its sibling's
+    costly metadata fetch)."""
+    return read_parquet(paths, parallelism=parallelism)
+
+
+def read_datasource(datasource, *, parallelism: int = 8) -> Dataset:
+    """Custom Datasource → Dataset (ray: read_datasource)."""
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        raise ValueError("datasource produced no read tasks")
+    return _read(tasks)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = 8) -> Dataset:
+    """{"data": i * ones(shape)} rows (ray: range_tensor)."""
+    def mapper(batch):
+        ids = batch["id"]
+        reps = np.ones((len(ids), *shape), dtype=np.int64)
+        return {"data": reps * np.asarray(ids).reshape(
+            (-1,) + (1,) * len(shape))}
+
+    return range(n, parallelism=parallelism).map_batches(mapper)
+
+
+def from_numpy_refs(refs, column: str = "data") -> Dataset:
+    """Refs to numpy arrays → Dataset (ray: from_numpy_refs)."""
+    refs = refs if isinstance(refs, list) else [refs]
+
+    def mk(r):
+        def read():
+            from ray_tpu.data.block import _to_table as _tt
+
+            yield _tt({column: ray_tpu.get(r)})
+
+        return read
+
+    return _read([mk(r) for r in refs])
+
+
+def from_pandas_refs(refs) -> Dataset:
+    """Refs to pandas DataFrames → Dataset (ray: from_pandas_refs)."""
+    refs = refs if isinstance(refs, list) else [refs]
+
+    def mk(r):
+        def read():
+            import pyarrow as pa
+
+            yield pa.Table.from_pandas(ray_tpu.get(r),
+                                       preserve_index=False)
+
+        return read
+
+    return _read([mk(r) for r in refs])
+
+
+def from_arrow_refs(refs) -> Dataset:
+    """Refs to Arrow tables → Dataset; tables ARE blocks here, so the
+    refs are consumed as-is (ray: from_arrow_refs)."""
+    refs = refs if isinstance(refs, list) else [refs]
+    return _from_blocks(list(refs))
+
+
+def set_progress_bars(enabled: bool) -> bool:
+    """ray: set_progress_bars — recorded on DataContext (executor stats
+    remain the observability surface; there is no rich progress UI)."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    prev = getattr(ctx, "enable_progress_bars", True)
+    ctx.enable_progress_bars = enabled
+    return prev
 
 
 def read_tfrecords(paths, *, parallelism: int = 8,

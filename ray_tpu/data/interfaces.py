@@ -1,0 +1,71 @@
+"""Custom source/sink protocol + execution knobs (ray:
+python/ray/data/datasource/datasource.py Datasource/Datasink,
+data/_internal/execution/interfaces/execution_options.py).
+
+Redesigned small: a Datasource yields ReadTasks (the same plain
+zero-arg callables every built-in reader produces), a Datasink gets one
+`write(block)` call per block inside a task; ExecutionOptions /
+ExecutionResources parameterize the streaming executor's budget through
+DataContext rather than a per-run options object.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+
+class Datasource:
+    """Subclass + implement get_read_tasks (ray: Datasource.get_read_tasks);
+    each task is a zero-arg callable yielding blocks."""
+
+    def get_read_tasks(self, parallelism: int) -> list:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> int | None:
+        return None
+
+
+class Datasink:
+    """Subclass + implement write (ray: Datasink): called once per block
+    inside a write task; on_write_complete runs on the driver after all
+    blocks land."""
+
+    def write(self, block) -> Any:
+        raise NotImplementedError
+
+    def on_write_start(self) -> None:  # noqa: B027
+        pass
+
+    def on_write_complete(self, write_results: list) -> None:  # noqa: B027
+        pass
+
+
+@dataclasses.dataclass
+class ExecutionResources:
+    cpu: float | None = None
+    gpu: float | None = None
+    object_store_memory: float | None = None
+
+
+@dataclasses.dataclass
+class ExecutionOptions:
+    resource_limits: ExecutionResources = dataclasses.field(
+        default_factory=ExecutionResources)
+    locality_with_output: bool = False
+    preserve_order: bool = False
+    verbose_progress: bool = False
+
+
+class ActorPoolStrategy:
+    """map_batches compute strategy (ray: ActorPoolStrategy): stateful
+    UDFs run in a pool of actors sized [min_size, max_size]."""
+
+    def __init__(self, *, size: int | None = None,
+                 min_size: int | None = None,
+                 max_size: int | None = None):
+        if size is not None:
+            min_size = max_size = size
+        self.min_size = min_size or 1
+        self.max_size = max_size or (min_size or 1)
+        if self.max_size < self.min_size:
+            raise ValueError("max_size < min_size")
