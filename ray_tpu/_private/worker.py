@@ -191,6 +191,10 @@ class CoreWorker:
         # PG bundle of the currently-executing task (tasks only; actor
         # methods resolve through their ActorInstance.bundle_key).
         self.current_bundle_key: str | None = None
+        # Lease resources + runtime env of the executing task, for
+        # runtime_context.get_assigned_resources/get_runtime_env_string.
+        self.current_resources: dict | None = None
+        self.current_runtime_env: dict | None = None
         # Trace context of the currently-executing task (ray: OpenTelemetry
         # propagation, util/tracing/tracing_helper.py): child submissions
         # inherit trace_id, and task events / profiling spans carry it.
@@ -1715,10 +1719,14 @@ class CoreWorker:
         prev_trace = self.current_trace
         prev_driver = self.current_driver_addr
         prev_bundle = self.current_bundle_key
+        prev_res = self.current_resources
+        prev_renv = self.current_runtime_env
         self.current_task_id = th["task_id"]
         self.current_trace = th.get("trace")
         self.current_driver_addr = th.get("driver_addr") or prev_driver
         self.current_bundle_key = th.get("bundle_key")
+        self.current_resources = th.get("resources")
+        self.current_runtime_env = th.get("runtime_env")
         self._record_event(th["task_id"], "RUNNING", th.get("name", ""))
         try:
             value, contained = deserialize_with_refs(frames)
@@ -1753,6 +1761,8 @@ class CoreWorker:
             self.current_trace = prev_trace
             self.current_driver_addr = prev_driver
             self.current_bundle_key = prev_bundle
+            self.current_resources = prev_res
+            self.current_runtime_env = prev_renv
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -1899,7 +1909,9 @@ class CoreWorker:
             result = await self._run_user_code(
                 _thunk, task_id=task_id, trace=h.get("trace"),
                 driver_addr=h.get("driver_addr"),
-                bundle_key=h.get("bundle_key"))
+                bundle_key=h.get("bundle_key"),
+                resources=h.get("resources"),
+                runtime_env=h.get("runtime_env"))
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(e)
         finally:
@@ -2046,15 +2058,21 @@ class CoreWorker:
                              executor=None, instance_actor: str | None = None,
                              trace: dict | None = None,
                              driver_addr: str | None = None,
-                             bundle_key: str | None = None):
+                             bundle_key: str | None = None,
+                             resources: dict | None = None,
+                             runtime_env: dict | None = None):
         prev_task = self.current_task_id
         prev_trace = self.current_trace
         prev_driver = self.current_driver_addr
         prev_bundle = self.current_bundle_key
+        prev_res = self.current_resources
+        prev_renv = self.current_runtime_env
         self.current_task_id = task_id.hex() if task_id else None
         self.current_trace = trace
         self.current_driver_addr = driver_addr or prev_driver
         self.current_bundle_key = bundle_key
+        self.current_resources = resources
+        self.current_runtime_env = runtime_env
         try:
             return await self.loop.run_in_executor(
                 executor or self._default_executor, thunk)
@@ -2063,6 +2081,8 @@ class CoreWorker:
             self.current_trace = prev_trace
             self.current_bundle_key = prev_bundle
             self.current_driver_addr = prev_driver
+            self.current_resources = prev_res
+            self.current_runtime_env = prev_renv
 
     def _error_reply(self, e: BaseException) -> tuple[dict, list]:
         import pickle

@@ -16,6 +16,9 @@ class RuntimeContext:
     task_id: str | None
     namespace: str
     trace_context: dict | None = None
+    controller_address: str = ""
+    assigned_resources: dict | None = None
+    runtime_env: dict | None = None
 
     def get_node_id(self) -> str:
         return self.node_id
@@ -39,6 +42,64 @@ class RuntimeContext:
         util/tracing/tracing_helper.py); None on the driver."""
         return self.trace_context
 
+    # ------------------------------------------- reference-surface extras
+    def get(self) -> dict:
+        """Legacy dict form (ray: RuntimeContext.get)."""
+        out = {"job_id": self.job_id, "node_id": self.node_id,
+               "namespace": self.namespace}
+        if self.actor_id:
+            out["actor_id"] = self.actor_id
+        if self.task_id:
+            out["task_id"] = self.task_id
+        return out
+
+    @property
+    def gcs_address(self) -> str:
+        """The controller address (the GCS analog)."""
+        return self.controller_address
+
+    def get_placement_group_id(self) -> str | None:
+        """PG id of the current task/actor, or None (ray:
+        get_placement_group_id)."""
+        from ray_tpu.utils.placement_group import \
+            get_current_placement_group
+
+        pg = get_current_placement_group()
+        return pg.id if pg else None
+
+    def get_actor_name(self) -> str | None:
+        """Name of the current actor when it has one (ray:
+        get_actor_name)."""
+        if not self.actor_id:
+            return None
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker()
+        reply, _ = core.call(core.controller_addr, "list_actors",
+                             timeout=30.0)
+        for a in reply["actors"]:
+            if a["actor_id"] == self.actor_id:
+                return a.get("name")
+        return None
+
+    def get_assigned_resources(self) -> dict:
+        """Resources of the current task/actor lease (ray:
+        get_assigned_resources)."""
+        return dict(self.assigned_resources or {})
+
+    def get_accelerator_ids(self) -> dict:
+        """{"TPU": [...]} chip ids visible to this worker (ray:
+        get_accelerator_ids — GPU/TPU/... keyed; only TPU exists
+        here)."""
+        from ray_tpu.api import get_tpu_ids
+
+        return {"TPU": [str(i) for i in get_tpu_ids()]}
+
+    def get_runtime_env_string(self) -> str:
+        import json as _json
+
+        return _json.dumps(self.runtime_env or {})
+
 
 def get_runtime_context() -> RuntimeContext:
     from ray_tpu._private.worker import global_worker
@@ -52,4 +113,7 @@ def get_runtime_context() -> RuntimeContext:
         task_id=core.current_task_id,
         namespace=core.namespace,
         trace_context=core.current_trace,
+        controller_address=core.controller_addr,
+        assigned_resources=getattr(core, "current_resources", None),
+        runtime_env=getattr(core, "current_runtime_env", None),
     )

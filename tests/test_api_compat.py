@@ -160,3 +160,43 @@ def test_get_current_placement_group(ray_shared):
     assert utils.get_placement_group("compat-pg").id == pg.id
     ray_tpu.kill(m)
     utils.remove_placement_group(pg)
+
+
+def test_runtime_context_extras(ray_shared):
+    from ray_tpu import utils
+
+    pg = utils.placement_group([{"CPU": 1}], name="rc-pg")
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def probe():
+        ctx = ray_tpu.get_runtime_context()
+        return {"d": ctx.get(), "pg": ctx.get_placement_group_id(),
+                "res": ctx.get_assigned_resources(),
+                "accel": ctx.get_accelerator_ids(),
+                "renv": ctx.get_runtime_env_string(),
+                "gcs": ctx.gcs_address}
+
+    out = ray_tpu.get(probe.options(placement_group=pg).remote(),
+                      timeout=120)
+    assert out["pg"] == pg.id
+    assert out["res"].get("CPU") == 1
+    assert out["accel"] == {"TPU": []}
+    assert "job_id" in out["d"]
+    assert out["gcs"]
+    # Driver-side context: no task/actor fields, no PG.
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_placement_group_id() is None
+    assert ctx.get_actor_name() is None
+    utils.remove_placement_group(pg)
+
+
+def test_runtime_context_actor_name(ray_shared):
+    @ray_tpu.remote
+    class Named:
+        def my_name(self):
+            return ray_tpu.get_runtime_context().get_actor_name()
+
+    a = Named.options(name="rc-named", get_if_exists=True).remote()
+    assert ray_tpu.get(a.my_name.remote(), timeout=120) == "rc-named"
+    ray_tpu.kill(a)
