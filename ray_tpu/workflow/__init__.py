@@ -6,9 +6,20 @@ A workflow is a ray_tpu.dag graph; each node's result is checkpointed to
 storage as it completes, so `resume` re-runs only the steps that never
 finished (ray: step checkpoint + deterministic replay).
 """
-from ray_tpu.workflow.execution import (cancel, delete, get_output,
-                                        get_status, list_all, list_events,
-                                        resume, run, run_async)
+from ray_tpu.workflow.execution import (Continuation, EventListener,
+                                        WorkflowCancellationError,
+                                        WorkflowError,
+                                        WorkflowExecutionError, cancel,
+                                        continuation, delete, get_metadata,
+                                        get_output, get_output_async,
+                                        get_status, init, list_all,
+                                        list_events, resume, resume_all,
+                                        resume_async, run, run_async,
+                                        sleep, wait_for_event)
 
-__all__ = ["run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "list_events", "cancel", "delete"]
+__all__ = ["run", "run_async", "resume", "resume_all", "resume_async",
+           "get_output", "get_output_async", "get_status", "get_metadata",
+           "list_all", "list_events", "cancel", "delete", "init",
+           "continuation", "Continuation", "sleep", "wait_for_event",
+           "EventListener", "WorkflowError", "WorkflowExecutionError",
+           "WorkflowCancellationError"]

@@ -28,6 +28,7 @@ from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
                                   MultiOutputNode)
 
 _DEFAULT_ROOT = os.path.expanduser("~/.ray_tpu_workflows")
+_configured_storage: str | None = None
 
 RUNNING = "RUNNING"
 SUCCEEDED = "SUCCEEDED"
@@ -35,11 +36,43 @@ FAILED = "FAILED"
 CANCELED = "CANCELED"
 
 
+class WorkflowError(Exception):
+    """ray: workflow exceptions base."""
+
+
+class WorkflowExecutionError(WorkflowError):
+    pass
+
+
+class WorkflowCancellationError(WorkflowError):
+    pass
+
+
+def init(storage: str | None = None) -> None:
+    """Set the default storage root (ray: workflow.init)."""
+    global _configured_storage
+    _configured_storage = storage
+
+
 def _root(storage: str | None) -> str:
-    root = storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
-                                     _DEFAULT_ROOT)
+    root = storage or _configured_storage or os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE", _DEFAULT_ROOT)
     os.makedirs(root, exist_ok=True)
     return root
+
+
+class Continuation:
+    """A step's return value saying "my result is THIS sub-dag's
+    result" (ray: workflow.continuation — dynamic workflows).  The
+    executor runs the sub-dag durably under the returning step's key
+    prefix and substitutes its result."""
+
+    def __init__(self, dag: "DAGNode"):
+        self.dag = dag
+
+
+def continuation(dag: "DAGNode") -> Continuation:
+    return Continuation(dag)
 
 
 def _wf_dir(workflow_id: str, storage: str | None) -> str:
@@ -109,10 +142,26 @@ class _Execution:
 
     on_event = None
 
+    def _settle_continuations(self, value, path: str, retries, timeout,
+                              max_conc):
+        """Resolve workflow.continuation chains durably: each nested
+        dag executes under a derived path so its steps checkpoint and
+        replay like any other."""
+        depth = 0
+        while isinstance(value, Continuation):
+            value = self.execute(value.dag, (), {},
+                                 step_max_retries=retries,
+                                 step_timeout_s=timeout,
+                                 max_concurrent_steps=max_conc,
+                                 root_path=f"{path}@cont{depth}")
+            depth += 1
+        return value
+
     def execute(self, dag: DAGNode, args: tuple, kwargs: dict, *,
                 step_max_retries: int = 0,
                 step_timeout_s: float | None = None,
-                max_concurrent_steps: int | None = None) -> Any:
+                max_concurrent_steps: int | None = None,
+                root_path: str = "root") -> Any:
         """Drive the DAG with bounded parallelism; checkpoint every step
         result as it completes.  Steps found checkpointed are NOT re-run
         (ray: workflow replay); failed steps retry with backoff up to
@@ -131,7 +180,7 @@ class _Execution:
             for i, c in enumerate(node._children()):
                 assign(c, f"{path}/{i}")
 
-        assign(dag, "root")
+        assign(dag, root_path)
         # Dependency bookkeeping for the ready-queue scheduler.
         dependents: dict[int, list[int]] = {i: [] for i in nodes}
         missing: dict[int, int] = {}
@@ -229,6 +278,9 @@ class _Execution:
                             nid, attempt + 1))
                         continue
                     raise
+                value = self._settle_continuations(
+                    value, paths[nid], step_max_retries, step_timeout_s,
+                    max_concurrent_steps)
                 self.save_step(key, value)
                 self.emit("completed", key, attempt=attempt)
                 finish(nid, value)
@@ -319,8 +371,12 @@ def get_output(workflow_id: str, storage: str | None = None) -> Any:
     ex = _Execution(workflow_id, storage)
     done, value = ex.load_step("__output__")
     if not done:
+        status = get_status(workflow_id, storage)
+        if status == CANCELED:
+            raise WorkflowCancellationError(
+                f"workflow {workflow_id!r} was cancelled")
         raise ValueError(f"workflow {workflow_id!r} has no output "
-                         f"(status={get_status(workflow_id, storage)})")
+                         f"(status={status})")
     return value
 
 
@@ -363,3 +419,86 @@ def list_events(workflow_id: str,
             return [json.loads(line) for line in f if line.strip()]
     except FileNotFoundError:
         return []
+
+
+# ---------------------------------------------------------- api extras
+import ray_tpu as _ray_tpu
+
+
+@_ray_tpu.remote
+def _sleep_task(seconds: float) -> float:
+    time.sleep(seconds)
+    return seconds
+
+
+def sleep(seconds: float) -> "DAGNode":
+    """A durable timer step (ray: workflow.sleep): sleeps once; on
+    resume a completed sleep replays instantly from its checkpoint."""
+    return _sleep_task.bind(seconds)
+
+
+class EventListener:
+    """Subclass + implement poll_for_event (ray: workflow.EventListener):
+    block until the external event arrives, return its payload."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+@_ray_tpu.remote
+def _wait_for_event_task(listener_cls, args: tuple, kwargs: dict):
+    return listener_cls().poll_for_event(*args, **kwargs)
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> "DAGNode":
+    """A step that completes when the listener's event arrives; once
+    checkpointed it never re-polls (ray: workflow.wait_for_event)."""
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener subclass")
+    return _wait_for_event_task.bind(listener_cls, args, kwargs)
+
+
+def get_metadata(workflow_id: str, storage: str | None = None) -> dict:
+    """Workflow-level metadata + step event counts (ray:
+    workflow.get_metadata)."""
+    meta = _read_meta(os.path.join(_root(storage), workflow_id))
+    if not meta:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    events = list_events(workflow_id, storage)
+    steps: dict[str, str] = {}
+    for ev in events:
+        steps[ev.get("step", "?")] = ev.get("event", "?")
+    out = {k: v for k, v in meta.items() if k != "dag"}
+    out["steps"] = steps
+    return out
+
+
+def resume_all(storage: str | None = None) -> list[tuple[str, Any]]:
+    """Resume every interrupted (RUNNING/FAILED) workflow (ray:
+    workflow.resume_all)."""
+    out = []
+    for wid, status in list_all(storage):
+        if status in (RUNNING, FAILED):
+            try:
+                out.append((wid, resume(wid, storage=storage)))
+            except Exception as e:  # noqa: BLE001
+                out.append((wid, e))
+    return out
+
+
+def get_output_async(workflow_id: str, storage: str | None = None):
+    """Future form of get_output (ray: get_output_async returns an
+    ObjectRef; a concurrent Future is this runtime's async handle for
+    driver-side work)."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    return pool.submit(get_output, workflow_id, storage)
+
+
+def resume_async(workflow_id: str, storage: str | None = None):
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    return pool.submit(resume, workflow_id, storage=storage)

@@ -543,3 +543,83 @@ def test_runtime_env_custom_plugin(rt):
     assert out == "alpha"
     # Deactivation: the next task in the pooled worker sees a clean env.
     assert ray_tpu.get(read_stamp.remote(), timeout=120) is None
+
+
+def test_workflow_api_extras(rt, tmp_path):
+    """Round-4 workflow parity: continuation, sleep, wait_for_event,
+    metadata, resume_all, cancellation error (ray: workflow/__init__)."""
+    import time as _time
+
+    from ray_tpu import workflow
+
+    storage = str(tmp_path / "wfx")
+
+    # Dynamic continuation: a step returns continuation(sub-dag).
+    @ray_tpu.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return workflow.continuation(fib_sum.bind(n))
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def fib_sum(n):
+        return workflow.continuation(add.bind(fib.bind(n - 1),
+                                              fib.bind(n - 2)))
+
+    out = workflow.run(fib.bind(6), workflow_id="wfib",
+                       storage=storage)
+    assert out == 8
+    # Replay: the entire continuation tree comes from checkpoints.
+    assert workflow.resume("wfib", storage=storage) == 8
+
+    # sleep is a durable step: replay is instant.
+    t0 = _time.monotonic()
+    workflow.run(workflow.sleep(1.0), workflow_id="wsleep",
+                 storage=storage)
+    took_first = _time.monotonic() - t0
+    assert took_first >= 1.0
+    t0 = _time.monotonic()
+    assert workflow.resume("wsleep", storage=storage) == 1.0
+    assert _time.monotonic() - t0 < max(1.0, took_first / 2)
+
+    # wait_for_event completes when the listener's poll returns.
+    marker = tmp_path / "event-armed"
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            import os as _os
+            import time as _t
+
+            while not _os.path.exists(path):
+                _t.sleep(0.05)
+            return "armed"
+
+    import threading
+
+    threading.Timer(0.5, lambda: marker.write_text("x")).start()
+    out = workflow.run(
+        workflow.wait_for_event(FileEvent, str(marker)),
+        workflow_id="wevent", storage=storage)
+    assert out == "armed"
+
+    # metadata + resume_all + cancellation error.
+    meta = workflow.get_metadata("wsleep", storage=storage)
+    assert meta["status"] == "SUCCEEDED"
+    assert meta["steps"]
+    assert workflow.resume_all(storage=storage) == []
+    workflow.cancel("wevent", storage=storage)
+    assert workflow.get_status("wevent", storage=storage) == "CANCELED"
+    # A cancelled workflow's completed output is still readable; a
+    # cancelled one WITHOUT output raises the typed error.
+    workflow.run(workflow.sleep(0.0), workflow_id="wc2", storage=storage)
+    workflow.cancel("wc2", storage=storage)
+    import os as _os
+    import shutil as _shutil
+
+    _shutil.rmtree(_os.path.join(storage, "wc2", "steps"))
+    with pytest.raises(workflow.WorkflowCancellationError):
+        workflow.get_output("wc2", storage=storage)
