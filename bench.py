@@ -341,7 +341,7 @@ def bench_compiled_dag() -> dict:
     out = {}
     cluster = Cluster()
     cluster.start_head()
-    cluster.add_node(resources={"CPU": 4})
+    cluster.add_node(resources={"CPU": 4, "near": 1})
     cluster.add_node(resources={"CPU": 2, "away": 1})
     ray_tpu.init(address=cluster.address)
     try:
@@ -367,14 +367,25 @@ def bench_compiled_dag() -> dict:
                 compiled.teardown()
             return per_iter, compiled._net_edges
 
-        local = [Stage.remote() for _ in range(3)]
+        # Same-host row: PIN all stages to one node — unpinned actors
+        # scatter across both nodes and the row silently measures a mix
+        # of shm and DCN edges (observed: "local" 4.7ms vs cross-node
+        # 0.85ms, placement luck inverted the comparison).
+        near = {"resources": {"near": 0.1}}
+        local = [Stage.options(**near).remote() for _ in range(3)]
         ray_tpu.get([a.add.remote(0) for a in local])
         per, edges = run_chain(local, 300)
         out["dag_iter_us"] = round(per * 1e6, 1)
+        out["dag_local_net_edges"] = edges
+        # Release the first chain's CPUs before placing the second (each
+        # Stage holds CPU:1; node "near" has 4 - without this the last
+        # pinned actor parks PENDING on an exhausted node).
+        for a in local:
+            ray_tpu.kill(a)
         # Middle stage on the second node: two DCN hops per iteration.
-        away = [Stage.remote(),
+        away = [Stage.options(**near).remote(),
                 Stage.options(resources={"away": 0.1}).remote(),
-                Stage.remote()]
+                Stage.options(**near).remote()]
         ray_tpu.get([a.add.remote(0) for a in away])
         per, edges = run_chain(away, 200)
         out["dag_xnode_iter_us"] = round(per * 1e6, 1)

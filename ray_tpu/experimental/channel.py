@@ -23,14 +23,16 @@ Protocol (single writer, up to 64 registered readers, same host):
     ack >= current seq (so nobody is still copying), then rewrite the
     payload in place, publish length, bump write_seq.
 
-The waits are adaptive polls (brief check-spin → sched_yield → 50µs
-sleeps; the reference uses named semaphores for the same role — the
-yield phase gives the peer process the core on small hosts while
-keeping reaction time in the tens of microseconds).
+Waits are a brief check-spin, then a BLOCKING sem_timedwait on a named
+POSIX semaphore hint (the reference uses named semaphores for the same
+role).  Blocking matters: N poll-spinning processes on a small host
+starve the peer that should produce the data (measured 6.9ms/iter on a
+3-stage chain vs 0.75ms after the change, same contended box).
 """
 from __future__ import annotations
 
 import fcntl
+import ctypes
 import mmap
 import os
 import pickle
@@ -42,23 +44,102 @@ _SHM_DIR = "/dev/shm"
 MAX_READERS = 64
 
 
-class _Waiter:
-    """Adaptive wait: a few raw re-checks, then sched_yield (lets the
-    peer run on shared cores with ~µs turnaround), then 50µs sleeps."""
+class _Sem:
+    """Named POSIX semaphore as a WAKEUP HINT (the reference's channels
+    block on named semaphores for exactly this role).  Pure hint: every
+    wait has a short timeout and the caller re-checks shared state, so a
+    missed post only costs one timeout tick and a stale post one spin.
+    Posts are bounded by `cap` (sem_getvalue) so stale hints can never
+    accumulate past one spin-burst per wait.
 
-    __slots__ = ("n",)
+    Polling (the old design) collapses on contended hosts: N processes
+    sched_yield/sleep-spinning on one core starve the very process that
+    should produce the data (measured 6.9ms/iter on a 3-stage DAG chain
+    vs 0.36ms for the BLOCKING zmq path on the same box).  Blocking in
+    sem_timedwait lets the kernel wake the one right waiter.
+    """
 
-    def __init__(self) -> None:
-        self.n = 0
+    __slots__ = ("_sem", "_name")
+    _libc = None
+    _broken = False
 
-    def pause(self) -> None:
-        self.n += 1
-        if self.n <= 8:
+    @classmethod
+    def _lib(cls):
+        if cls._libc is None and not cls._broken:
+            try:
+                lib = ctypes.CDLL(None, use_errno=True)
+                lib.sem_open.restype = ctypes.c_void_p
+                lib.sem_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_uint32, ctypes.c_uint32]
+                lib.sem_post.argtypes = [ctypes.c_void_p]
+                lib.sem_timedwait.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_void_p]
+                lib.sem_getvalue.argtypes = [ctypes.c_void_p,
+                                             ctypes.POINTER(ctypes.c_int)]
+                lib.sem_close.argtypes = [ctypes.c_void_p]
+                lib.sem_unlink.argtypes = [ctypes.c_char_p]
+                cls._libc = lib
+            except (OSError, AttributeError):
+                cls._broken = True
+        return cls._libc
+
+    def __init__(self, name: str, create: bool):
+        self._sem = None
+        self._name = f"/rtsem_{name}".encode()
+        lib = self._lib()
+        if lib is None:
             return
-        if self.n <= 512:
-            os.sched_yield()
+        O_CREAT = 0o100
+        if create:
+            lib.sem_unlink(self._name)      # supersede stale (crash)
+            sem = lib.sem_open(self._name, O_CREAT, 0o600, 0)
+        else:
+            # sem_open is variadic; the fixed 4-arg signature needs the
+            # (ignored without O_CREAT) mode/value placeholders.
+            sem = lib.sem_open(self._name, 0, 0, 0)
+        self._sem = sem or None             # SEM_FAILED == NULL on glibc
+
+    def post(self, cap: int) -> None:
+        """Raise the value toward `cap` (never beyond: bounded hints)."""
+        if self._sem is None:
             return
-        time.sleep(0.00005)
+        lib = self._libc
+        val = ctypes.c_int(0)
+        while True:
+            lib.sem_getvalue(self._sem, ctypes.byref(val))
+            if val.value >= cap:
+                return
+            lib.sem_post(self._sem)
+            if val.value + 1 >= cap:
+                return
+
+    def wait(self, timeout_s: float) -> None:
+        """Block until a post or the timeout; caller re-checks state."""
+        if self._sem is None:
+            time.sleep(min(timeout_s, 0.00005))
+            return
+        deadline = time.clock_gettime(time.CLOCK_REALTIME) + timeout_s
+        ts = struct.pack("qq", int(deadline),
+                         int((deadline % 1.0) * 1e9))
+        buf = ctypes.create_string_buffer(ts)
+        self._libc.sem_timedwait(self._sem, buf)
+
+    def close(self, unlink: bool = False) -> None:
+        """The OWNING Channel decides unlink (its _created flag is the
+        single source of truth — a duplicated flag here could diverge,
+        e.g. tests that clear Channel._created to simulate crashes)."""
+        lib = self._libc
+        if self._sem is not None and lib is not None:
+            lib.sem_close(self._sem)
+            self._sem = None
+        if unlink and lib is not None:
+            lib.sem_unlink(self._name)
+
+    @classmethod
+    def unlink(cls, name: str) -> None:
+        lib = cls._lib()
+        if lib is not None:
+            lib.sem_unlink(f"/rtsem_{name}".encode())
 
 
 class ChannelError(RuntimeError):
@@ -94,6 +175,10 @@ class Channel:
         self._last_read_seq = 0
         self._slot: int | None = None
         self._closed = False
+        # Wakeup hints (see _Sem): data = writer -> readers, ack =
+        # readers -> writer.  The seq/ack words in shm stay the truth.
+        self._sem_data = _Sem(f"{name}_d", created)
+        self._sem_ack = _Sem(f"{name}_a", created)
 
     # ------------------------------------------------------------ lifecycle
     @staticmethod
@@ -128,11 +213,15 @@ class Channel:
 
     @classmethod
     def destroy(cls, name: str) -> None:
-        """Unlink the segment (live handles keep their mapping)."""
+        """Unlink the segment AND its wakeup semaphores (live handles
+        keep their mappings).  Channel names are random per DAG compile,
+        so anything destroy misses leaks in /dev/shm forever."""
         try:
             os.unlink(os.path.join(_SHM_DIR, cls._fname(name)))
         except OSError:
             pass
+        _Sem.unlink(f"{name}_d")
+        _Sem.unlink(f"{name}_a")
 
     def close(self) -> None:
         if self._closed:
@@ -143,6 +232,8 @@ class Channel:
             os.close(self._fd)
         except (OSError, ValueError):
             pass
+        self._sem_data.close(unlink=self._created)
+        self._sem_ack.close(unlink=self._created)
         if self._created:
             self.destroy(self.name)
 
@@ -210,7 +301,7 @@ class Channel:
                 f"{self.max_size}B")
         deadline = None if timeout is None else time.monotonic() + timeout
         full_mask = None
-        waiter = _Waiter()
+        spins = 0
         while True:
             seq, _len, n_readers, claimed = self._hdr()
             if full_mask is None:
@@ -227,11 +318,16 @@ class Channel:
                 raise TimeoutError(
                     f"channel {self.name}: waiting on readers "
                     f"(claimed={claimed:b}/{full_mask:b}, seq={seq})")
-            waiter.pause()
+            spins += 1
+            if spins <= 8:
+                continue
+            self._sem_ack.wait(0.005 if deadline is None else
+                               min(0.005, deadline - time.monotonic()))
         off = self._payload_off(n_readers)
         self._mm[off:off + len(payload)] = payload
         struct.pack_into("<Q", self._mm, 8, len(payload))   # length first
         struct.pack_into("<Q", self._mm, 0, seq + 1)        # then publish
+        self._sem_data.post(n_readers)
 
     # ----------------------------------------------------------------- read
     def read(self, timeout: float | None = 10.0):
@@ -241,7 +337,7 @@ class Channel:
         if self._slot is None:
             self._slot = self._claim_slot()
         deadline = None if timeout is None else time.monotonic() + timeout
-        waiter = _Waiter()
+        spins = 0
         while True:
             seq, length, n_readers, _claimed = self._hdr()
             if seq > self._last_read_seq:
@@ -250,7 +346,11 @@ class Channel:
                 raise TimeoutError(
                     f"channel {self.name}: no write past seq "
                     f"{self._last_read_seq}")
-            waiter.pause()
+            spins += 1
+            if spins <= 8:
+                continue
+            self._sem_data.wait(0.005 if deadline is None else
+                                min(0.005, deadline - time.monotonic()))
         off = self._payload_off(n_readers)
         value = pickle.loads(bytes(self._mm[off:off + length]))
         self._last_read_seq = seq
@@ -258,6 +358,7 @@ class Channel:
         # cross-reader read-modify-write): the writer may then rewrite.
         struct.pack_into("<Q", self._mm, _FIXED.size + 8 * self._slot,
                          seq)
+        self._sem_ack.post(n_readers)
         return value
 
     def __reduce__(self):
