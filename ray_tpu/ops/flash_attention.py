@@ -37,63 +37,65 @@ NEG_INF = -1e30
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
-                *, sm_scale: float, causal: bool, block_k: int):
-    """One (batch, head, q-block) program; loops over kv blocks.
+                *, sm_scale: float, causal: bool):
+    """One (batch, head, q-block, KV-block) program.  KV is the MINOR
+    grid dimension, so each program sees one [block_k, d] slice — VMEM
+    stays bounded at ANY sequence length (whole-KV residency OOMed
+    scoped vmem at 32k).  The running (max, sum, acc) live in scratch,
+    which persists across the sequential kv iterations; o/lse write out
+    on the last one.
 
-    q_ref: [block_q, d]; k_ref/v_ref: [skv, d] (whole kv for this head in
-    VMEM); o_ref: [block_q, d]; lse_ref: [block_q, 128] (value broadcast
-    across lanes — TPU tiles need a 128 minor dim).
+    q_ref: [block_q, d]; k_ref/v_ref: [block_k, d]; o_ref: [block_q, d];
+    lse_ref: [block_q, 128] (value broadcast across lanes — TPU tiles
+    need a 128 minor dim).
     """
     block_q, d = q_ref.shape
-    skv = k_ref.shape[0]
+    block_k = k_ref.shape[0]
     qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_kv = pl.num_programs(3)
     q_start = qi * block_q
+    k_start = ki * block_k
 
-    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-    l_ref[...] = jnp.zeros_like(l_ref)
-    acc_ref[...] = jnp.zeros_like(acc_ref)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[...]
+    @pl.when(jnp.logical_or(not causal,
+                            k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_ref[:, 0]                      # [bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)           # [bq]
+        p = jnp.exp(s - m_cur[:, None])           # [bq, bk] f32
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p.astype(v.dtype), v,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[:, 0] = m_cur
 
-    num_kv = pl.cdiv(skv, block_k)
-
-    def body(kv_i, _):
-        k_start = kv_i * block_k
-
-        @pl.when(jnp.logical_or(not causal,
-                                k_start <= q_start + block_q - 1))
-        def _():
-            k = k_ref[pl.ds(k_start, block_k), :]
-            v = v_ref[pl.ds(k_start, block_k), :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if causal:
-                qpos = q_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                kpos = k_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            m_prev = m_ref[:, 0]                      # [bq]
-            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-            alpha = jnp.exp(m_prev - m_cur)           # [bq]
-            p = jnp.exp(s - m_cur[:, None])           # [bq, bk] f32
-            l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-            acc_ref[...] = (acc_ref[...] * alpha[:, None]
-                            + jax.lax.dot_general(
-                                p.astype(v.dtype), v,
-                                (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32))
-            m_ref[:, 0] = m_cur
-
-        return ()
-
-    jax.lax.fori_loop(0, num_kv, body, ())
-
-    l = l_ref[:, 0]
-    l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows → zeros, not NaN
-    o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
-    lse_ref[:, 0] = m_ref[:, 0] + jnp.log(l)
+    @pl.when(ki == num_kv - 1)
+    def _write():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows: zeros, no NaN
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[:, 0] = m_ref[:, 0] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
@@ -104,22 +106,23 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
     grid = (b, hq, sq // block_q)
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k),
-        grid=grid,
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(*grid, skv // block_k),
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, skv, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
-            pl.BlockSpec((None, None, skv, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki,
+                         n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki,
+                         n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((None, None, block_q, 128),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
@@ -137,50 +140,53 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
 
 # ----------------------------------------------------------------- backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, sm_scale: float, causal: bool, block_k: int):
-    """dQ for one (b, h, q-block): loop over kv blocks.
-    dS = P * (dO V^T - delta); dQ = dS K * scale."""
+               acc_ref, *, sm_scale: float, causal: bool):
+    """dQ for one (b, h, q-block, KV-block); KV is the minor grid dim
+    (streamed like the forward — whole-KV residency OOMs at 32k).
+    dS = P * (dO V^T - delta); dQ = dS K * scale, accumulated in scratch
+    across the sequential kv iterations."""
     block_q, d = q_ref.shape
-    skv = k_ref.shape[0]
+    block_k = k_ref.shape[0]
     qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_kv = pl.num_programs(3)
     q_start = qi * block_q
+    k_start = ki * block_k
 
-    acc_ref[...] = jnp.zeros_like(acc_ref)
-    q = q_ref[...]
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[:, 0]
-    delta = delta_ref[:, 0]
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(kv_i, _):
-        k_start = kv_i * block_k
+    @pl.when(jnp.logical_or(not causal,
+                            k_start <= q_start + block_q - 1))
+    def _compute():
+        q = q_ref[...]
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[:, 0]
+        delta = delta_ref[:, 0]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                     # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-        @pl.when(jnp.logical_or(not causal,
-                                k_start <= q_start + block_q - 1))
-        def _():
-            k = k_ref[pl.ds(k_start, block_k), :]
-            v = v_ref[pl.ds(k_start, block_k), :]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if causal:
-                qpos = q_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                kpos = k_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(qpos >= kpos, s, NEG_INF)
-            p = jnp.exp(s - lse[:, None])                     # [bq, bk]
-            dp = jax.lax.dot_general(
-                do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[:, None]) * sm_scale
-            acc_ref[...] += jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-
-        return ()
-
-    jax.lax.fori_loop(0, pl.cdiv(skv, block_k), body, ())
-    dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+    @pl.when(ki == num_kv - 1)
+    def _write():
+        dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -254,25 +260,26 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, g):
     delta_b = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k),
-        grid=(b, hq, sq // block_q),
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(b, hq, sq // block_q, skv // block_k),
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, skv, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
-            pl.BlockSpec((None, None, skv, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki,
+                         n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki,
+                         n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
             pl.BlockSpec((None, None, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((None, None, block_q, 128),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((None, None, block_q, 128),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, None, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
