@@ -59,10 +59,17 @@ class LoggingConfig:
 
 def configure_process_logging(encoding: str | None = None,
                               level: str | None = None) -> None:
-    """Apply encoding/level (args override env, env overrides defaults)
-    to the root logger — shared by worker_main/controller/agent startup."""
+    """Apply encoding/level (args override env) to the root logger —
+    shared by worker_main/controller/agent startup.  NO-OP when neither
+    an argument nor an env var is present: each runtime process sets its
+    own role-tagged format ("... controller: ...", "worker[pid]: ...")
+    that must survive an unconfigured run."""
     import os
 
+    if encoding is None and level is None \
+            and "RAY_TPU_LOG_ENCODING" not in os.environ \
+            and "RAY_TPU_LOG_LEVEL" not in os.environ:
+        return
     encoding = encoding or os.environ.get("RAY_TPU_LOG_ENCODING", "TEXT")
     level = level or os.environ.get("RAY_TPU_LOG_LEVEL", "INFO")
     root = logging.getLogger()
