@@ -522,6 +522,10 @@ def bench_model() -> dict:
         # half the batch): flash fwd+bwd streams KV blocks, so memory
         # stays flat while the quadratic attention share grows — the
         # honest long-context stressor.
+        # Free the MAIN train state first: three full (params + adam)
+        # states plus activations do not fit one chip's HBM together
+        # (observed RESOURCE_EXHAUSTED on the 32k point).
+        del state, step_fn, batch_d, tokens, m
         for lb, ls, key in ((2, 16384, ""), (1, 32768, "_32k")):
             # 16k: the round-over-round comparable point.  32k: the
             # capability point the grid-streamed flash kernels opened
@@ -545,6 +549,7 @@ def bench_model() -> dict:
             out[f"long_context_seq{key}"] = ls
             out[f"long_context_tokens_per_s{key}"] = round(
                 lb * ls * 5 / ldt, 1)
+            del lstate, lstep, ltok, lbatch, lm
     return out
 
 
