@@ -250,7 +250,11 @@ class LeaseManager:
                            task.task_id.hex()[:12], task.retries_left)
             self.submit(task)
         else:
-            err = WorkerCrashedError(
+            from ray_tpu.exceptions import OutOfMemoryError
+
+            cls = (OutOfMemoryError if "OOM-killed" in str(exc)
+                   else WorkerCrashedError)
+            err = cls(
                 f"worker died executing task {task.task_id.hex()[:12]}: {exc}")
             for rid in task.return_ids:
                 self.core._resolve_error(rid, err)

@@ -1397,7 +1397,9 @@ class CoreWorker:
             # Known-dead owner: resolving would hang on a reconnecting
             # DEALER; the object is lost with its owner (put objects
             # have no lineage; task returns resubmit via their OWN owner).
-            return ObjectLostError(
+            from ray_tpu.exceptions import OwnerDiedError
+
+            return OwnerDiedError(
                 f"{ref.hex()[:12]} (owner {ref.owner_addr} died)")
         remaining = None if deadline is None \
             else max(0.0, deadline - time.monotonic())

@@ -59,6 +59,17 @@ class WorkerCrashedError(RayTpuError):
     """Worker process died while executing the task (ray: WorkerCrashedError)."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The worker was OOM-killed by the node memory monitor (ray:
+    OutOfMemoryError): the task may retry, but the cause is memory
+    pressure, not a crash in user code."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The object's OWNER process died, taking the authoritative copy
+    and location directory with it (ray: OwnerDiedError)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """ray_tpu.get(timeout=...) expired (ray: GetTimeoutError)."""
 
@@ -73,3 +84,25 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class RuntimeEnvSetupError(RayTpuError):
     pass
+
+
+# ----------------------------------------------------- reference aliases
+# Reference-spelled names for drop-in `except ray.exceptions.X` code.
+# Same classes, not look-alikes: an except on either name catches both.
+RayError = RayTpuError
+RayTaskError = TaskError
+UserCodeException = TaskError
+RayActorError = ActorError
+ActorUnavailableError = ActorError
+RaySystemError = RayTpuError
+
+
+def __getattr__(name):
+    # Channel errors live with the channels (importing them eagerly
+    # would cycle); resolve lazily under the reference names.
+    if name in ("RayChannelError", "RayChannelTimeoutError"):
+        from ray_tpu.experimental.channel import ChannelError
+
+        return ChannelError if name == "RayChannelError" else TimeoutError
+    raise AttributeError(f"module 'ray_tpu.exceptions' has no "
+                         f"attribute {name!r}")

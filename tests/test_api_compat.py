@@ -200,3 +200,25 @@ def test_runtime_context_actor_name(ray_shared):
     a = Named.options(name="rc-named", get_if_exists=True).remote()
     assert ray_tpu.get(a.my_name.remote(), timeout=120) == "rc-named"
     ray_tpu.kill(a)
+
+
+def test_exception_taxonomy(ray_shared):
+    """Reference-spelled exception names are the SAME classes (ray:
+    exceptions.py), and the typed subclasses come from real raise
+    sites: an except on either spelling catches both."""
+    import ray_tpu.exceptions as ex
+
+    assert ex.RayTaskError is ex.TaskError
+    assert ex.RayActorError is ex.ActorError
+    assert ex.RayError is ex.RayTpuError
+    assert issubclass(ex.OutOfMemoryError, ex.WorkerCrashedError)
+    assert issubclass(ex.OwnerDiedError, ex.ObjectLostError)
+    assert ex.RayChannelError.__name__ == "ChannelError"
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("user error")
+
+    with pytest.raises(ex.RayTaskError) as ei:
+        ray_tpu.get(boom.remote(), timeout=120)
+    assert isinstance(ei.value.cause, ValueError)
