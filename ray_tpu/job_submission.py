@@ -27,6 +27,27 @@ FAILED = "FAILED"
 STOPPED = "STOPPED"
 
 
+class JobStatus:
+    """Status namespace (ray: job_submission.JobStatus — a str enum;
+    plain strings here, same values)."""
+    PENDING = PENDING
+    RUNNING = RUNNING
+    SUCCEEDED = SUCCEEDED
+    FAILED = FAILED
+    STOPPED = STOPPED
+
+    @staticmethod
+    def is_terminal(status: str) -> bool:
+        return status in (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobType:
+    """ray: job_submission.JobType — only SUBMISSION exists here (the
+    reference's DRIVER type tracks ad-hoc drivers in its job table)."""
+    SUBMISSION = "SUBMISSION"
+    DRIVER = "DRIVER"
+
+
 @dataclass
 class JobInfo:
     job_id: str
@@ -36,6 +57,12 @@ class JobInfo:
     end_time: float = 0.0
     return_code: int | None = None
     metadata: dict = field(default_factory=dict)
+
+
+# ray: JobDetails is the REST-facing superset of JobInfo; the dict rows
+# list_jobs returns carry the same fields, so the record type is shared.
+JobDetails = JobInfo
+DriverInfo = JobInfo
 
 
 class _JobSupervisor:
