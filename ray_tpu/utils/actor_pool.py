@@ -25,6 +25,25 @@ class ActorPool:
     def has_next(self) -> bool:
         return bool(self._future_to_actor) or bool(self._pending)
 
+    def has_free(self) -> bool:
+        """True when an idle actor is available (ray:
+        ActorPool.has_free)."""
+        return bool(self._idle) and not self._pending
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None (ray: pop_idle)."""
+        if self.has_free():
+            return self._idle.pop(0)
+        return None
+
+    def push(self, actor) -> None:
+        """Return an actor to the pool (ray: push); drains any queued
+        submission onto it immediately."""
+        self._idle.append(actor)
+        if self._pending and self._idle:
+            fn, value = self._pending.pop(0)
+            self.submit(fn, value)
+
     def get_next(self, timeout: float | None = None) -> Any:
         """Next result in submission order."""
         import ray_tpu

@@ -28,11 +28,42 @@ class _QueueActor:
         except asyncio.TimeoutError:
             return False, None
 
+    async def put_nowait(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except Exception:  # noqa: BLE001 - asyncio.QueueFull
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except Exception:  # noqa: BLE001 - asyncio.QueueEmpty
+            return False, None
+
+    async def put_nowait_batch(self, items: list) -> bool:
+        # All-or-nothing (ray: put_nowait_batch raises Full if the whole
+        # batch does not fit).
+        if self._q.maxsize and \
+                self._q.qsize() + len(items) > self._q.maxsize:
+            return False
+        for it in items:
+            self._q.put_nowait(it)
+        return True
+
+    async def get_nowait_batch(self, n: int):
+        if self._q.qsize() < n:
+            return False, []
+        return True, [self._q.get_nowait() for _ in range(n)]
+
     async def qsize(self) -> int:
         return self._q.qsize()
 
     async def empty(self) -> bool:
         return self._q.empty()
+
+    async def full(self) -> bool:
+        return self._q.full()
 
 
 class Empty(Exception):
@@ -76,6 +107,50 @@ class Queue:
         import ray_tpu
 
         return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.full.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def put_nowait(self, item: Any) -> None:
+        import ray_tpu
+
+        if not ray_tpu.get(self._actor.put_nowait.remote(item)):
+            raise Full("queue is full")
+
+    def get_nowait(self) -> Any:
+        import ray_tpu
+
+        ok, value = ray_tpu.get(self._actor.get_nowait.remote())
+        if not ok:
+            raise Empty("queue is empty")
+        return value
+
+    def put_nowait_batch(self, items: list) -> None:
+        import ray_tpu
+
+        if not ray_tpu.get(self._actor.put_nowait_batch.remote(
+                list(items))):
+            raise Full("batch does not fit")
+
+    def get_nowait_batch(self, n: int) -> list:
+        import ray_tpu
+
+        ok, items = ray_tpu.get(self._actor.get_nowait_batch.remote(n))
+        if not ok:
+            raise Empty(f"queue holds fewer than {n} items")
+        return items
+
+    def shutdown(self, force: bool = False) -> None:
+        """Kill the backing actor (ray: Queue.shutdown); the queue is
+        unusable afterwards."""
+        import ray_tpu
+
+        ray_tpu.kill(self._actor)
 
     def __reduce__(self):
         return (Queue._from_actor, (self._actor,))

@@ -146,3 +146,50 @@ def test_train_dataset_shards(rt, tmp_path):
               for p in glob.glob(f"{out_dir}/rank*.txt")]
     assert len(totals) == 2
     assert sum(totals) == sum(range(32))
+
+
+def test_queue_nowait_and_batches(ray_shared):
+    from ray_tpu.utils.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=3)
+    q.put_nowait(1)
+    q.put_nowait_batch([2, 3])
+    assert q.full()
+    assert q.size() == 3
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    with pytest.raises(Full):
+        q.put_nowait_batch([4])          # all-or-nothing
+    assert q.get_nowait() == 1
+    assert q.get_nowait_batch(2) == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get_nowait_batch(1)
+    q.shutdown()
+
+
+def test_actor_pool_free_pop_push(ray_shared):
+    import ray_tpu
+    from ray_tpu.utils import ActorPool
+
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            return x + 1
+
+    actors = [W.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    assert pool.has_free()
+    a = pool.pop_idle()
+    assert a is not None
+    pool.push(a)
+    pool.submit(lambda ac, v: ac.work.remote(v), 1)
+    pool.submit(lambda ac, v: ac.work.remote(v), 2)
+    pool.submit(lambda ac, v: ac.work.remote(v), 3)   # queues (2 actors)
+    assert not pool.has_free()
+    out = [pool.get_next(timeout=60) for _ in range(3)]
+    assert out == [2, 3, 4]
+    assert pool.has_free()
+    for ac in actors:
+        ray_tpu.kill(ac)
