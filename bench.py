@@ -15,32 +15,114 @@ whatever the default jax device is (the real chip under the driver).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 BASELINE_TASKS_ASYNC = 8011.0   # reference single_client_tasks_async
 PEAK_BF16 = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12,
              "TPU v6 lite": 918e12}
+PARTIAL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_partial.json")
+# Belt for every blocking call inside a section; the section alarm is the
+# suspenders.  A lost object then surfaces as GetTimeoutError naming the
+# ref instead of wedging the process (BENCH_r04 recorded a 600s wedge
+# with zero attribution — never again).  Below every section budget so
+# the per-ref error fires BEFORE the section alarm; sections with
+# legitimately-slow single gets (actor boot storms) pass their own.
+GET_T = 60.0
 
 
-def bench_control_plane() -> dict:
+def _dump_stacks(tag: str) -> str:
+    """All-thread stacks to stderr (the driver records the tail) and back
+    to the caller for the JSON record."""
+    import faulthandler
+    import tempfile
+
+    try:
+        with tempfile.TemporaryFile(mode="w+") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.seek(0)
+            text = f.read()
+    except Exception as e:  # noqa: BLE001
+        text = f"<stack dump failed: {e!r}>"
+    sys.stderr.write(f"\n=== WEDGE STACKS [{tag}] ===\n{text}\n")
+    sys.stderr.flush()
+    return text
+
+
+def _flush_partial(extra: dict) -> None:
+    """Crash-safe progress file: rewritten at every section boundary so a
+    wedged run still leaves every completed row + per-section timing on
+    disk next to bench.py."""
+    try:
+        with open(PARTIAL_PATH + ".tmp", "w") as f:
+            json.dump(extra, f, default=str)
+        os.replace(PARTIAL_PATH + ".tmp", PARTIAL_PATH)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class _SectionTimeout(Exception):
+    pass
+
+
+def bench_control_plane(out: dict) -> None:
+    """Control-plane microbenchmarks.  Writes rows into `out` AS THEY
+    COMPLETE (the round-4 bench discarded every partial row when its
+    single 600s alarm fired — BENCH_r04 recorded nothing).  Every section
+    runs under its own alarm inside a shared overall deadline; a timeout
+    dumps all-thread stacks, records the section name, and moves on."""
+    import signal
+
     import ray_tpu
 
-    ray_tpu.init(resources={"CPU": 8})
-    out = {}
-    sections = {}
-    _last = [time.perf_counter()]
+    sections: dict = {}
+    errors: dict = {}
+    out["_section_s"] = sections
+    overall_deadline = time.monotonic() + 540.0
 
-    def mark(name: str) -> None:
-        now = time.perf_counter()
-        sections[name] = round(now - _last[0], 1)
-        _last[0] = now
+    def rnd(v):
+        return v if isinstance(v, dict) else round(v, 2)
+
+    def section(name: str, budget: int, fn, always: bool = False) -> bool:
+        if not always:
+            budget = int(min(budget, max(1.0, overall_deadline
+                                         - time.monotonic())))
+            if time.monotonic() >= overall_deadline:
+                errors[name] = "skipped: overall deadline exhausted"
+                out["_section_errors"] = errors
+                return False
+        def handler(signum, frame):
+            raise _SectionTimeout(f"{name} exceeded {budget}s")
+        old = signal.signal(signal.SIGALRM, handler)
+        signal.alarm(budget)
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            fn()
+        except _SectionTimeout as e:
+            ok = False
+            errors[name] = repr(e)
+            out["_wedge_stacks_" + name] = _dump_stacks(name)[-2000:]
+        except Exception as e:  # noqa: BLE001
+            ok = False
+            errors[name] = repr(e)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+            sections[name] = round(time.perf_counter() - t0, 1)
+            if errors:
+                out["_section_errors"] = errors
+            _flush_partial(out)
+        return ok
 
     def best_of(fn, n: int, trials: int = 2) -> float:
         """Max rate over `trials` runs: the box's hypervisor-steal noise
-        swings a single window 2-3x (BENCH_r03 recorded a 0.49x 'regression'
-        that an A/B against the round-2 tree could not reproduce — pure
-        measurement noise).  Max-of-trials records capability, not the
-        scheduler's mood."""
+        swings a single window 2-3x (BENCH_r03 recorded a 0.49x
+        'regression' that an A/B against the round-2 tree could not
+        reproduce — pure measurement noise).  Max-of-trials records
+        capability, not the scheduler's mood."""
         rates = []
         for _ in range(trials):
             t0 = time.perf_counter()
@@ -48,24 +130,16 @@ def bench_control_plane() -> dict:
             rates.append(n / (time.perf_counter() - t0))
         return max(rates)
 
+    if not section("init", 120, lambda: ray_tpu.init(resources={"CPU": 8})):
+        # A wedged init may have booted head subprocesses already — tear
+        # down before returning or they compete for the box through every
+        # remaining bench.
+        section("shutdown", 60, ray_tpu.shutdown, always=True)
+        return
     try:
         @ray_tpu.remote
         def noop(*a):
             return b"ok"
-
-        # warm the worker pool
-        ray_tpu.get([noop.remote() for _ in range(20)])
-        mark("init_warm")
-
-        out["tasks_async_per_s"] = best_of(
-            lambda n: ray_tpu.get([noop.remote() for _ in range(n)]), 2000)
-        mark("tasks_async")
-
-        def _sync_tasks(n):
-            for _ in range(n):
-                ray_tpu.get(noop.remote())
-        out["tasks_sync_per_s"] = best_of(_sync_tasks, 300)
-        mark("tasks_sync")
 
         @ray_tpu.remote
         class Counter:
@@ -76,17 +150,41 @@ def bench_control_plane() -> dict:
                 self.v += 1
                 return self.v
 
-        c = Counter.remote()
-        ray_tpu.get(c.inc.remote())
-        out["actor_calls_async_per_s"] = best_of(
-            lambda n: ray_tpu.get([c.inc.remote() for _ in range(n)]), 2000)
-        mark("actor_async")
+        # warm the worker pool
+        section("init_warm", 90, lambda: ray_tpu.get(
+            [noop.remote() for _ in range(20)], timeout=GET_T))
 
-        def _sync_actor(n):
-            for _ in range(n):
-                ray_tpu.get(c.inc.remote())
-        out["actor_calls_sync_per_s"] = best_of(_sync_actor, 300)
-        mark("actor_sync")
+        def _tasks_async():
+            out["tasks_async_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get([noop.remote() for _ in range(n)],
+                                      timeout=GET_T), 2000))
+        section("tasks_async", 90, _tasks_async)
+
+        def _tasks_sync():
+            def run(n):
+                for _ in range(n):
+                    ray_tpu.get(noop.remote(), timeout=GET_T)
+            out["tasks_sync_per_s"] = rnd(best_of(run, 300))
+        section("tasks_sync", 90, _tasks_sync)
+
+        c = None
+
+        def _actor_async():
+            nonlocal c
+            c = Counter.remote()
+            ray_tpu.get(c.inc.remote(), timeout=GET_T)
+            out["actor_calls_async_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get([c.inc.remote() for _ in range(n)],
+                                      timeout=GET_T), 2000))
+        section("actor_async", 90, _actor_async)
+
+        def _actor_sync():
+            def run(n):
+                for _ in range(n):
+                    ray_tpu.get(c.inc.remote(), timeout=GET_T)
+            out["actor_calls_sync_per_s"] = rnd(best_of(run, 300))
+        if c is not None:
+            section("actor_sync", 90, _actor_sync)
 
         # Async actor (coroutine methods ride the worker's event loop;
         # reference "1_1_async_actor_calls_async" 4,457/s bar) and a
@@ -101,42 +199,48 @@ def bench_control_plane() -> dict:
                 self.v += 1
                 return self.v
 
-        ac = AsyncCounter.remote()
-        ray_tpu.get(ac.inc.remote())
-        out["async_actor_calls_async_per_s"] = best_of(
-            lambda n: ray_tpu.get([ac.inc.remote() for _ in range(n)]),
-            2000)
-        ray_tpu.kill(ac)
-        cc = Counter.options(max_concurrency=4).remote()
-        ray_tpu.get(cc.inc.remote())
-        out["actor_calls_concurrent_per_s"] = best_of(
-            lambda n: ray_tpu.get([cc.inc.remote() for _ in range(n)]),
-            2000)
-        ray_tpu.kill(cc)
-        mark("actor_async_modes")
+        def _actor_async_modes():
+            ac = AsyncCounter.remote()
+            ray_tpu.get(ac.inc.remote(), timeout=GET_T)
+            out["async_actor_calls_async_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get([ac.inc.remote() for _ in range(n)],
+                                      timeout=GET_T), 2000))
+            ray_tpu.kill(ac)
+            cc = Counter.options(max_concurrency=4).remote()
+            ray_tpu.get(cc.inc.remote(), timeout=GET_T)
+            out["actor_calls_concurrent_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get([cc.inc.remote() for _ in range(n)],
+                                      timeout=GET_T), 2000))
+            ray_tpu.kill(cc)
+        section("actor_async_modes", 120, _actor_async_modes)
 
         # n:n — several actors, calls fanned across all of them
         # (reference "n_n_actor_calls_async").
-        actors = [Counter.remote() for _ in range(4)]
-        ray_tpu.get([a.inc.remote() for a in actors])
-        out["actor_calls_nn_async_per_s"] = best_of(
-            lambda n: ray_tpu.get(
-                [actors[i % 4].inc.remote() for i in range(n)]), 2000)
-        for a in actors:
-            ray_tpu.kill(a)
-        mark("actor_nn")
+        def _actor_nn():
+            actors = [Counter.remote() for _ in range(4)]
+            ray_tpu.get([a.inc.remote() for a in actors], timeout=GET_T)
+            out["actor_calls_nn_async_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get(
+                    [actors[i % 4].inc.remote() for i in range(n)],
+                    timeout=GET_T), 2000))
+            for a in actors:
+                ray_tpu.kill(a)
+        section("actor_nn", 120, _actor_nn)
 
         import numpy as np
 
         small = np.zeros(1024, np.uint8)
-        put_refs: list = []
 
-        def _puts(n):
-            put_refs.append([ray_tpu.put(small) for _ in range(n)])
-        out["put_small_per_s"] = best_of(_puts, 1000)
-        out["get_small_per_s"] = best_of(
-            lambda n: ray_tpu.get(put_refs.pop()[:n]), 1000, trials=2)
-        mark("small_putget")
+        def _small_putget():
+            put_refs: list = []
+
+            def _puts(n):
+                put_refs.append([ray_tpu.put(small) for _ in range(n)])
+            out["put_small_per_s"] = rnd(best_of(_puts, 1000))
+            out["get_small_per_s"] = rnd(best_of(
+                lambda n: ray_tpu.get(put_refs.pop()[:n], timeout=GET_T),
+                1000, trials=2))
+        section("small_putget", 90, _small_putget)
 
         # Cross-process rows: the local rows above resolve from the
         # in-process memory store (a genuine design win, but it stopped
@@ -155,61 +259,68 @@ def bench_control_plane() -> dict:
             ray_tpu.get(list(refs))
             return len(refs) / (time.perf_counter() - t0)
 
-        # Driver resolves worker-owned refs (owner lives in the worker).
-        n = 500
-        worker_refs = ray_tpu.get(mint.remote(n))
-        t0 = time.perf_counter()
-        ray_tpu.get(worker_refs)
-        out["get_small_xproc_per_s"] = n / (time.perf_counter() - t0)
-        del worker_refs
-        # Worker resolves driver-owned refs (rate measured inside the
-        # task: the arg-passing overhead is the task row's job, not this
-        # one's).
-        driver_refs = [ray_tpu.put(small) for _ in range(n)]
-        out["put_small_xproc_per_s"] = round(
-            ray_tpu.get(fetch.remote(driver_refs)), 1)
-        del driver_refs
-        mark("small_xproc")
+        def _small_xproc():
+            # Driver resolves worker-owned refs (owner in the worker).
+            n = 500
+            worker_refs = ray_tpu.get(mint.remote(n), timeout=GET_T)
+            t0 = time.perf_counter()
+            ray_tpu.get(worker_refs, timeout=GET_T)
+            out["get_small_xproc_per_s"] = rnd(
+                n / (time.perf_counter() - t0))
+            # Worker resolves driver-owned refs (rate measured inside
+            # the task: the arg-passing overhead is the task row's job,
+            # not this one's).
+            driver_refs = [ray_tpu.put(small) for _ in range(n)]
+            out["put_small_xproc_per_s"] = round(
+                ray_tpu.get(fetch.remote(driver_refs), timeout=GET_T), 1)
+        section("small_xproc", 90, _small_xproc)
 
-        big = np.random.randint(0, 255, 256 * 1024 * 1024,
-                                np.uint8)   # 256 MiB host array
-        t0 = time.perf_counter()
-        ref = ray_tpu.put(big)
-        dt = time.perf_counter() - t0
-        out["put_gib_per_s"] = big.nbytes / dt / (1 << 30)
-        del big
-        t0 = time.perf_counter()
-        got = ray_tpu.get(ref)
-        dt = time.perf_counter() - t0
-        out["get_gib_per_s"] = got.nbytes / dt / (1 << 30)
-        del got, ref
-        mark("big_putget")
+        def _big_putget():
+            big = np.random.randint(0, 255, 256 * 1024 * 1024,
+                                    np.uint8)   # 256 MiB host array
+            t0 = time.perf_counter()
+            ref = ray_tpu.put(big)
+            dt = time.perf_counter() - t0
+            out["put_gib_per_s"] = rnd(big.nbytes / dt / (1 << 30))
+            nbytes = big.nbytes
+            del big
+            t0 = time.perf_counter()
+            got = ray_tpu.get(ref, timeout=GET_T)
+            dt = time.perf_counter() - t0
+            out["get_gib_per_s"] = rnd(nbytes / dt / (1 << 30))
+        section("big_putget", 90, _big_putget)
 
-        # Placement-group churn (reference: placement_group create+remove,
-        # ray_perf.py — 824 PG/s bar; stress-test latencies 0.94/0.91 ms).
-        from ray_tpu.utils.placement_group import (placement_group,
-                                                   remove_placement_group)
-        n = 30
-        t0 = time.perf_counter()
-        for _ in range(n):
-            pg = placement_group([{"CPU": 1}])
-            pg.ready(timeout=30.0)
-            remove_placement_group(pg)
-        out["pg_create_remove_per_s"] = n / (time.perf_counter() - t0)
-        mark("pg_churn")
+        # Placement-group churn (reference: placement_group
+        # create+remove, ray_perf.py — 824 PG/s bar).
+        def _pg_churn():
+            from ray_tpu.utils.placement_group import (
+                placement_group, remove_placement_group)
+            n = 30
+            t0 = time.perf_counter()
+            for _ in range(n):
+                pg = placement_group([{"CPU": 1}])
+                pg.ready(timeout=30.0)
+                remove_placement_group(pg)
+            out["pg_create_remove_per_s"] = rnd(
+                n / (time.perf_counter() - t0))
+        section("pg_churn", 90, _pg_churn)
 
         # Many-actors scale point (reference: many_actors release bench —
         # creation + readiness churn, not steady-state calls).  Sized for
         # the 1-core box: each actor forks a ~2s worker process.
-        n = 24
-        t0 = time.perf_counter()
-        actors = [Counter.options(num_cpus=0.125).remote()
-                  for _ in range(n)]
-        ray_tpu.get([a.inc.remote() for a in actors])
-        out["many_actors_ready_per_s"] = n / (time.perf_counter() - t0)
-        mark("many_actors_create")
-        for a in actors:
-            ray_tpu.kill(a)
+        def _many_actors():
+            n = 24
+            t0 = time.perf_counter()
+            actors = [Counter.options(num_cpus=0.125).remote()
+                      for _ in range(n)]
+            # Boot storm: 24 actors through the 4-wide fork gate can
+            # legitimately take ~60s on a 1-core box — own belt here.
+            ray_tpu.get([a.inc.remote() for a in actors], timeout=140.0)
+            out["many_actors_ready_per_s"] = rnd(
+                n / (time.perf_counter() - t0))
+            for a in actors:
+                ray_tpu.kill(a)
+        section("many_actors_create", 150, _many_actors)
 
         # Scalability-envelope points at the REFERENCE's published scale
         # (release/benchmarks: 10,000 args to one task 18.4 s; 3,000
@@ -222,45 +333,55 @@ def bench_control_plane() -> dict:
         def many_returns(k):
             return tuple(range(k))
 
-        arg_refs = [ray_tpu.put(i) for i in range(10000)]
-        t0 = time.perf_counter()
-        assert ray_tpu.get(count_args.remote(*arg_refs)) == 10000
-        out["args_10k_s"] = round(time.perf_counter() - t0, 2)
-        del arg_refs
-        t0 = time.perf_counter()
-        rets = ray_tpu.get(
-            many_returns.options(num_returns=3000).remote(3000))
-        assert len(rets) == 3000
-        out["returns_3k_s"] = round(time.perf_counter() - t0, 2)
-        del rets
-        mark("envelope")
+        def _envelope():
+            arg_refs = [ray_tpu.put(i) for i in range(10000)]
+            t0 = time.perf_counter()
+            assert ray_tpu.get(count_args.remote(*arg_refs),
+                               timeout=GET_T) == 10000
+            out["args_10k_s"] = round(time.perf_counter() - t0, 2)
+            del arg_refs
+            t0 = time.perf_counter()
+            rets = ray_tpu.get(
+                many_returns.options(num_returns=3000).remote(3000),
+                timeout=GET_T)
+            assert len(rets) == 3000
+            out["returns_3k_s"] = round(time.perf_counter() - t0, 2)
+        section("envelope", 150, _envelope)
 
         # wait()-heavy pattern (reference: ray.wait loops in ray_perf.py).
-        n = 1000
-        refs = [noop.remote() for _ in range(n)]
-        t0 = time.perf_counter()
-        remaining = refs
-        while remaining:
-            _done, remaining = ray_tpu.wait(remaining,
-                                            num_returns=min(
-                                                100, len(remaining)))
-        out["wait_batches_per_s"] = n / (time.perf_counter() - t0)
-        mark("wait_heavy")
-        out["_section_s"] = sections
+        def _wait_heavy():
+            n = 1000
+            refs = [noop.remote() for _ in range(n)]
+            t0 = time.perf_counter()
+            remaining = refs
+            while remaining:
+                _done, remaining = ray_tpu.wait(
+                    remaining, num_returns=min(100, len(remaining)),
+                    timeout=GET_T)
+            out["wait_batches_per_s"] = rnd(
+                n / (time.perf_counter() - t0))
+        section("wait_heavy", 90, _wait_heavy)
     finally:
-        ray_tpu.shutdown()
-    # Wall-time rows (args_10k_s, ...) keep 2 decimals — sub-second values
-    # would alias at 1-decimal resolution; throughput rows round to 1.
-    return {k: (v if isinstance(v, dict)
-                else round(v, 2) if k.endswith("_s") else round(v, 1))
-            for k, v in out.items()}
+        # Shutdown gets its own alarm (a wedged teardown must not eat
+        # the rest of the bench) and is EXEMPT from the overall deadline:
+        # skipping it would leave _initialized=True and zero out every
+        # subsequent bench function's init.
+        section("shutdown", 60, ray_tpu.shutdown, always=True)
 
 
 def bench_multi_client() -> dict:
     """K driver processes hammering one cluster (reference:
-    multi_client_tasks_async 23,312/s and multi-client put 38.5 GiB/s on a
-    64-core node; this box has ONE core, so these bound at the single-core
-    aggregate)."""
+    multi_client_tasks_async 23,312/s and multi-client put 38.5 GiB/s on
+    a 64-core node; this box has ONE core, so these bound at the
+    single-core aggregate).
+
+    Wall clock starts at a READY/GO BARRIER, matching the reference's
+    methodology (its multi-client rows time task windows of
+    already-connected drivers, ray_perf.py): the pre-round-5 version
+    started the clock at Popen, so the row measured 3x interpreter+jax
+    boot (~12s on this box) around a 0.3s task window — recorded 149
+    tasks/s while the cluster was actually doing ~6,900 (BENCH_r04).
+    Startup is reported separately as multi_client_startup_s."""
     import subprocess
     import sys
 
@@ -274,10 +395,11 @@ def bench_multi_client() -> dict:
 
         addr = global_worker().controller_addr
         repo_dir = os.path.abspath(os.path.dirname(__file__) or ".")
-        n_clients, n_tasks = 3, 600
+        n_clients, n_tasks = 3, 2000
         script = f"""
 import sys, time, json
 sys.path.insert(0, {repo_dir!r})
+t_boot = time.perf_counter()
 import ray_tpu
 ray_tpu.init(address={addr!r})
 
@@ -286,6 +408,9 @@ def noop():
     return b"ok"
 
 ray_tpu.get([noop.remote() for _ in range(20)])
+startup_s = time.perf_counter() - t_boot
+print("READY", flush=True)
+assert sys.stdin.readline().strip() == "GO"
 t0 = time.perf_counter()
 ray_tpu.get([noop.remote() for _ in range({n_tasks})])
 dt = time.perf_counter() - t0
@@ -295,25 +420,34 @@ t1 = time.perf_counter()
 ref = ray_tpu.put(big)
 put_dt = time.perf_counter() - t1
 print(json.dumps({{"tasks_per_s": {n_tasks}/dt,
-                   "put_gib_per_s": big.nbytes/put_dt/(1<<30)}}))
+                   "startup_s": startup_s,
+                   "put_gib_per_s": big.nbytes/put_dt/(1<<30)}}),
+      flush=True)
 ray_tpu.shutdown()
 import os; os._exit(0)
 """
-        t0 = time.perf_counter()
         procs = [subprocess.Popen([sys.executable, "-c", script],
                                   stdout=subprocess.PIPE,
+                                  stdin=subprocess.PIPE,
                                   stderr=subprocess.DEVNULL, text=True)
                  for _ in range(n_clients)]
-        results = []
+        for p in procs:              # barrier: all clients connected
+            line = p.stdout.readline()
+            assert line.strip() == "READY", f"client said {line!r}"
+        t0 = time.perf_counter()
         for p in procs:
-            stdout, _ = p.communicate(timeout=300)
-            for line in stdout.splitlines():
-                try:
-                    results.append(json.loads(line))
-                    break
-                except json.JSONDecodeError:
-                    continue
+            p.stdin.write("GO\n")
+            p.stdin.flush()
+        results = []
+        for p in procs:              # first line after GO = result JSON
+            line = p.stdout.readline()
+            try:
+                results.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
         wall = time.perf_counter() - t0
+        for p in procs:
+            p.wait(timeout=60)
         if results:
             # Aggregate of the clients' own measured rates (their timers
             # exclude process startup/warmup; all clients run
@@ -322,6 +456,8 @@ import os; os._exit(0)
                 sum(r["tasks_per_s"] for r in results), 1)
             out["multi_client_wall_tasks_per_s"] = round(
                 n_clients * n_tasks / wall, 1)
+            out["multi_client_startup_s"] = round(
+                max(r["startup_s"] for r in results), 2)
             out["multi_client_put_gib_per_s"] = round(
                 sum(r["put_gib_per_s"] for r in results), 2)
             out["multi_client_n"] = n_clients
@@ -615,10 +751,14 @@ def bench_serve_llm() -> dict:
 
 def _with_timeout(fn, seconds: int):
     """Alarm-guarded call: the chip is single-holder on this box and a
-    stuck lease must not zero out the rest of the bench."""
+    stuck lease must not zero out the rest of the bench.  On alarm the
+    handler dumps all-thread stacks BEFORE unwinding, so the wedge site
+    is in the recorded tail (round-4 lesson: a timeout with no stacks is
+    unactionable)."""
     import signal
 
     def handler(signum, frame):
+        _dump_stacks(fn.__name__)
         raise TimeoutError(f"{fn.__name__} exceeded {seconds}s")
 
     old = signal.signal(signal.SIGALRM, handler)
@@ -678,33 +818,40 @@ def _vs_previous_round(extra: dict) -> dict:
 
 def main() -> None:
     extra = {}
+    # Control plane writes into `extra` incrementally: every completed
+    # row + section timing survives a wedge (the per-section alarms and
+    # the overall 540s deadline live INSIDE bench_control_plane).
     try:
-        cp = _with_timeout(bench_control_plane, 600)
-        extra.update(cp)
-        value = cp["tasks_async_per_s"]
+        bench_control_plane(extra)
     except Exception as e:  # noqa: BLE001
         extra["control_plane_error"] = repr(e)
-        value = 0.0
+    value = extra.get("tasks_async_per_s", 0.0)
+    _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_multi_client, 300))
     except Exception as e:  # noqa: BLE001
         extra["multi_client_error"] = repr(e)
+    _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_ray_client, 300))
     except Exception as e:  # noqa: BLE001
         extra["ray_client_error"] = repr(e)
+    _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_compiled_dag, 300))
     except Exception as e:  # noqa: BLE001
         extra["compiled_dag_error"] = repr(e)
+    _flush_partial(extra)
     try:
         extra["model_bench"] = _with_timeout(bench_model, 900)
     except Exception as e:  # noqa: BLE001
         extra["model_bench"] = {"error": repr(e)}
+    _flush_partial(extra)
     try:
         extra["serve_bench"] = _with_timeout(bench_serve_llm, 600)
     except Exception as e:  # noqa: BLE001
         extra["serve_bench"] = {"error": repr(e)}
+    _flush_partial(extra)
     regressions = _vs_previous_round(extra)
     if regressions:
         extra["regressions_vs_prev_round"] = regressions

@@ -146,6 +146,13 @@ def make_snapshot_storage(uri: str) -> SnapshotStorage:
     scheme, sep, _rest = uri.partition("://")
     if not sep or scheme == "file":
         return FileSnapshotStorage(uri[len("file://"):] if sep else uri)
+    if scheme == "kv":
+        # Builtin external-store backend (ray: redis_store_client.cc:1):
+        # snapshots live in a TCP KV server OUTSIDE the head host, so a
+        # replacement controller on a fresh host can restore.
+        from ray_tpu._private.kv_snapshot import KvSnapshotStorage
+
+        return KvSnapshotStorage(uri)
     if scheme not in _snapshot_schemes:
         hook = os.environ.get("RAY_TPU_SNAPSHOT_STORAGE_FACTORY")
         if hook:

@@ -1,0 +1,226 @@
+"""External TCP KV store for controller snapshots.
+
+The head-node-loss durability backend (ray analog: the GCS Redis store
+client, src/ray/gcs/store_client/redis_store_client.cc:1, selected in
+gcs_server.cc:41-78 as StorageType::REDIS_PERSIST): controller snapshots
+are written to a store that lives OUTSIDE the head host, so a
+replacement controller on a fresh host restores cluster state the local
+file backend cannot provide.  Redis is absent from this environment, so
+the store itself is part of the framework: a dependency-free TCP server
+(`python -m ray_tpu._private.kv_snapshot --port N [--dir d]`) speaking a
+length-prefixed binary protocol, and a `kv://host:port/name` client
+registered as a builtin snapshot scheme (controller.py
+make_snapshot_storage).
+
+Wire format (all u32 big-endian):
+  request : cmd(1) keylen(4) key vallen(4) val
+  response: status(1) vallen(4) val
+  cmds    : S=set  G=get  D=del  P=ping
+  status  : '+'=ok  '-'=miss  '!'=error (val carries the message)
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("kv peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes, bytes]:
+    cmd = _recv_exact(sock, 1)
+    (klen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    key = _recv_exact(sock, klen) if klen else b""
+    (vlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    val = _recv_exact(sock, vlen) if vlen else b""
+    return cmd, key, val
+
+
+def _send_resp(sock: socket.socket, status: bytes, val: bytes = b"") -> None:
+    sock.sendall(status + struct.pack(">I", len(val)) + val)
+
+
+class KvStoreServer:
+    """Tiny durable KV: in-memory dict, optionally mirrored to one file
+    per key under `data_dir` (loaded at boot), so the STORE process can
+    itself restart without losing snapshots.  One thread per connection —
+    snapshot traffic is one controller writing every snapshot period."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: str | None = None):
+        self.data: dict[bytes, bytes] = {}
+        self.data_dir = data_dir
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            for fn in os.listdir(data_dir):
+                if fn.endswith(".kv"):
+                    with open(os.path.join(data_dir, fn), "rb") as f:
+                        self.data[bytes.fromhex(fn[:-3])] = f.read()
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.addr = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="kv-store", daemon=True)
+
+    def start(self) -> "KvStoreServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _persist(self, key: bytes, val: bytes | None) -> None:
+        if not self.data_dir:
+            return
+        path = os.path.join(self.data_dir, key.hex() + ".kv")
+        if val is None:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(val)
+        os.replace(tmp, path)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                cmd, key, val = _recv_frame(conn)
+                with self._lock:
+                    if cmd == b"S":
+                        self.data[key] = val
+                        self._persist(key, val)
+                        _send_resp(conn, b"+")
+                    elif cmd == b"G":
+                        got = self.data.get(key)
+                        if got is None:
+                            _send_resp(conn, b"-")
+                        else:
+                            _send_resp(conn, b"+", got)
+                    elif cmd == b"D":
+                        self.data.pop(key, None)
+                        self._persist(key, None)
+                        _send_resp(conn, b"+")
+                    elif cmd == b"P":
+                        _send_resp(conn, b"+", b"pong")
+                    else:
+                        _send_resp(conn, b"!", b"unknown cmd")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class KvClient:
+    """Blocking client; one short-lived connection per op so it survives
+    store restarts without reconnect logic (snapshot cadence is seconds,
+    not microseconds — simplicity beats pooling here)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def _call(self, cmd: bytes, key: bytes,
+              val: bytes = b"") -> tuple[bytes, bytes]:
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout) as s:
+            s.sendall(cmd + struct.pack(">I", len(key)) + key
+                      + struct.pack(">I", len(val)) + val)
+            status = _recv_exact(s, 1)
+            (vlen,) = struct.unpack(">I", _recv_exact(s, 4))
+            out = _recv_exact(s, vlen) if vlen else b""
+        if status == b"!":
+            raise RuntimeError(f"kv store error: {out!r}")
+        return status, out
+
+    def set(self, key: bytes, val: bytes) -> None:
+        self._call(b"S", key, val)
+
+    def get(self, key: bytes) -> bytes | None:
+        status, val = self._call(b"G", key)
+        return val if status == b"+" else None
+
+    def delete(self, key: bytes) -> None:
+        self._call(b"D", key)
+
+    def ping(self) -> bool:
+        try:
+            return self._call(b"P", b"")[1] == b"pong"
+        except (OSError, ConnectionError):
+            return False
+
+
+class KvSnapshotStorage:
+    """SnapshotStorage over `kv://host:port/name` (registered as a
+    builtin scheme in controller.make_snapshot_storage).  Write failures
+    propagate to the controller's snapshot loop, which logs and retries
+    next period — same contract as the file backend on a full disk."""
+
+    def __init__(self, uri: str):
+        rest = uri[len("kv://"):]
+        hostport, _, name = rest.partition("/")
+        host, _, port = hostport.rpartition(":")
+        self.client = KvClient(host or "127.0.0.1", int(port))
+        self.key = (name or "controller").encode()
+
+    def read(self) -> bytes | None:
+        return self.client.get(self.key)
+
+    def write(self, blob: bytes) -> None:
+        self.client.set(self.key, blob)
+
+
+def main() -> None:
+    import argparse
+    import json
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(description="ray_tpu snapshot KV store")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--dir", default=None,
+                    help="persist keys to this directory")
+    args = ap.parse_args()
+    srv = KvStoreServer(args.host, args.port, args.dir).start()
+    print(json.dumps({"kv_addr": srv.addr}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

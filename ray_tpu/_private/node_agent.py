@@ -128,6 +128,9 @@ class NodeAgent:
         self._worker_env = dict(env or {})
         self._starting: dict[str, asyncio.Future] = {}
         self._pending: list[PendingLease] = []
+        # Strong refs for in-flight pending-grant tasks (see
+        # _try_grant_pending).
+        self._grant_tasks: set = set()
         self._lease_seq = itertools.count()
         self.cluster_view: sched.View = {}
         # lease_id -> (worker_id, lease header) for task leases
@@ -837,8 +840,14 @@ class NodeAgent:
         still: list[PendingLease] = []
         for p in self._pending:
             if not p.fut.done() and self._resources_fit(p.header):
-                asyncio.get_running_loop().create_task(
+                # Hold a strong ref: asyncio keeps only weak refs to
+                # tasks, and a grant awaiting a minutes-long spawn (venv
+                # build) could be GC'd mid-flight, silently losing the
+                # parked grant (round-4 advisor finding).
+                t = asyncio.get_running_loop().create_task(
                     self._grant_pending(p))
+                self._grant_tasks.add(t)
+                t.add_done_callback(self._grant_tasks.discard)
             elif not p.fut.done():
                 still.append(p)
         self._pending = still

@@ -624,7 +624,10 @@ class CoreWorker:
             self.lease_manager.submit(task)
 
         self._post_to_loop(_go)
-        self._record_event(task_id.hex(), "SUBMITTED", fid)
+        # The submitted TASK's trace context (not this process's current
+        # one): its span_id/parent_span are what the OTLP bridge pairs.
+        self._record_event(task_id.hex(), "SUBMITTED", fid,
+                           trace=header["trace"])
         return refs
 
     def memory_entries_for(self, return_ids: list[bytes]) -> None:
@@ -1170,6 +1173,33 @@ class CoreWorker:
             self._release_borrow(c_oid, c_owner)
         return offset
 
+    def _service_entry_from_owned(self, oid: bytes, e) -> bool:
+        """Lost-wake recovery: if this process's owner record for `oid`
+        has resolved but the memory entry never woke (fill/wake race),
+        republish the fill through the store (which wakes both waiter
+        kinds).  Returns True when the entry is now resolvable."""
+        rec = self.owned.get(oid)
+        if rec is None or rec.state == "pending":
+            return False
+        with self._ref_lock:
+            rec = self.owned.get(oid)
+            if rec is None or rec.state == "pending":
+                return False
+            if e.resolved():
+                # Fields landed but a set() was missed — just re-wake.
+                e.wake()
+            elif rec.state == "error" and rec.error is not None:
+                self.memory.put_error(oid, rec.error)
+            elif rec.state == "inline" and rec.frames is not None:
+                self.memory.put_frames(oid, rec.frames)
+            elif rec.state == "stored" and rec.locations:
+                self.memory.put_locations(oid, rec.locations)
+            else:
+                return False
+        logger.warning("recovered lost fill for %s (owner state=%s)",
+                       oid.hex()[:12], rec.state)
+        return True
+
     def _resolve_error(self, rid: bytes, err: BaseException) -> None:
         rec = self.owned.get(rid)
         if rec is None:
@@ -1371,13 +1401,37 @@ class CoreWorker:
         if e is None and owned_here:
             e = self.memory.entry(ref.binary())
         if e is not None:
-            remaining = None if deadline is None \
-                else max(0.0, deadline - time.monotonic())
-            try:
-                await asyncio.wait_for(e.event.wait(), remaining)
-            except asyncio.TimeoutError:
-                raise GetTimeoutError(
-                    f"get() timed out waiting for {ref.hex()[:12]}")
+            # Bounded-slice wait + watchdog instead of one unbounded
+            # event wait: the owner record (self.owned) is the truth, and
+            # a fill whose wake was lost in a race (observed once on the
+            # bench box as a 600s wedge, BENCH_r04) would otherwise hang
+            # this coroutine forever.  Every slice re-checks the record
+            # and self-services a resolved-but-unwoken entry; a record
+            # stuck "pending" is logged with its state so a real wedge
+            # names itself in the process tail.
+            waited = 0.0
+            while not e.event.is_set():
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                slice_t = 10.0 if remaining is None \
+                    else min(10.0, remaining)
+                try:
+                    await asyncio.wait_for(e.event.wait(), slice_t)
+                    break
+                except asyncio.TimeoutError:
+                    if remaining is not None and remaining <= slice_t:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for "
+                            f"{ref.hex()[:12]}")
+                    waited += slice_t
+                    if self._service_entry_from_owned(ref.binary(), e):
+                        break
+                    if waited >= 30.0 and int(waited) % 30 < 10:
+                        rec = self.owned.get(ref.binary())
+                        logger.warning(
+                            "get() still waiting for %s after %.0fs "
+                            "(owner record: %s)", ref.hex()[:12], waited,
+                            "absent" if rec is None else rec.state)
             if e.error is not None:
                 return e.error
             if e.has_value:
@@ -3082,7 +3136,10 @@ class CoreWorker:
         self._task_events.append(
             {"task_id": task_id, "state": state, "name": name,
              "t": time.time(), "worker": tag[0], "node": tag[1],
-             "trace_id": tc["trace_id"][:16] if tc else ""})
+             "trace_id": tc["trace_id"][:16] if tc else "",
+             # Parent span for the OTLP export bridge (utils/tracing.py):
+             # present only on events of tasks submitted inside tasks.
+             "parent": (tc.get("parent_span") or "")[:16] if tc else ""})
         if len(self._task_events) > self.config.task_event_buffer_size:
             self._task_events = self._task_events[-self.config.
                                                   task_event_buffer_size:]

@@ -261,6 +261,24 @@ class Reconciler:
                                  key=lambda i: i.created_at, reverse=True)
                 for inst in running[:excess]:
                     self.im.set_state(inst.instance_id, DRAINING)
+                excess -= min(excess, len(running))
+            # ... and finally ALLOCATED nodes that never joined the
+            # cluster (a pool scaled up for demand that evaporated, or a
+            # provider whose nodes join out-of-band): nothing to drain —
+            # terminate directly, newest first.
+            if excess > 0:
+                allocated = sorted(self.im.in_state(ALLOCATED),
+                                   key=lambda i: i.created_at,
+                                   reverse=True)
+                for inst in allocated[:excess]:
+                    self.im.set_state(inst.instance_id, TERMINATING)
+                    try:
+                        self.provider.terminate_node(
+                            inst.provider_node_id)
+                        self.im.set_state(inst.instance_id, TERMINATED)
+                    except Exception as e:  # noqa: BLE001
+                        self.im.set_state(inst.instance_id, FAILED,
+                                          error=str(e))
 
         # 3. Launch QUEUED.
         for inst in self.im.in_state(QUEUED):

@@ -129,7 +129,33 @@ class Dataset:
             import numpy as _np
 
             n = len(next(iter(batch.values()), []))
-            keep = _np.random.default_rng(seed).random(n) < fraction
+            if seed is None:
+                rng = _np.random.default_rng()
+            else:
+                # Distinct deterministic stream PER BATCH: seeding every
+                # batch with the bare user seed drew the identical
+                # keep-mask in every block — correlated, not i.i.d.
+                # (round-4 advisor finding).  No batch index reaches the
+                # UDF, so fold a content digest into the seed sequence:
+                # schedule-independent, and distinct blocks get distinct
+                # streams.
+                import pickle
+                import zlib
+
+                h = 0
+                for k in sorted(batch):
+                    a = _np.asarray(batch[k])
+                    if a.dtype.kind in "OUS":
+                        # Object/str columns: tobytes() would hash
+                        # PyObject POINTERS — different every process.
+                        # Pickle of the prefix is stable content.
+                        buf = pickle.dumps(list(a[:64]), protocol=4)
+                    else:
+                        buf = _np.ascontiguousarray(a).tobytes()[:4096]
+                    h = zlib.crc32(buf, h)
+                rng = _np.random.default_rng(
+                    _np.random.SeedSequence([seed & 0xFFFFFFFF, h, n]))
+            keep = rng.random(n) < fraction
             return {k: _np.asarray(v)[keep] for k, v in batch.items()}
 
         return self.map_batches(sample)
@@ -408,18 +434,44 @@ class Dataset:
         return outs
 
     def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
-        """Split by ROW indices (ray: Dataset.split_at_indices).  Blocks
-        are re-cut so each piece holds exactly its row range."""
-        rows = self.take_all()
+        """Split by ROW indices (ray: Dataset.split_at_indices).  Splits
+        at BLOCK boundaries: interior blocks move whole (by ref); only
+        the blocks straddling a cut are re-sliced in tasks.  The driver
+        touches per-block row counts, never rows — no O(dataset)
+        materialization (round-4 advisor finding)."""
         from ray_tpu.data.block import _rows_to_table
 
+        self.materialize()
+        refs = list(self._materialized)
+
+        @ray_tpu.remote
+        def _nrows(block):
+            return BlockAccessor.for_block(block).num_rows()
+
+        @ray_tpu.remote
+        def _cut(block, start, stop):
+            return BlockAccessor.for_block(block).slice(start, stop)
+
+        counts = ray_tpu.get([_nrows.remote(r) for r in refs])
+        total = builtins.sum(counts)
         pieces = []
         prev = 0
-        for ix in [*indices, len(rows)]:
-            chunk = rows[prev:ix]
+        for ix in [*indices, total]:
+            ix = min(max(ix, prev), total)
+            piece_refs = []
+            off = 0
+            for r, c in zip(refs, counts):
+                lo, hi = off, off + c
+                off = hi
+                if c == 0 or hi <= prev or lo >= ix:
+                    continue
+                s, e = max(prev, lo) - lo, min(ix, hi) - lo
+                piece_refs.append(r if (s == 0 and e == c)
+                                  else _cut.remote(r, s, e))
             prev = ix
-            pieces.append(_from_blocks(
-                [ray_tpu.put(_rows_to_table(chunk))]))
+            if not piece_refs:
+                piece_refs = [ray_tpu.put(_rows_to_table([]))]
+            pieces.append(_from_blocks(piece_refs))
         return pieces
 
     def split_proportionately(self,

@@ -158,14 +158,28 @@ def remat_policy(cfg: "LlamaConfig | None" = None):
     this degrades to exactly nothing_saveable.
 
     "nothing": full recompute — the minimal-HBM profile for models at
-    the memory ceiling."""
+    the memory ceiling.
+
+    "dots": save all non-batch matmul outputs (qkv/o/mlp projections) —
+    the maximal-HBM profile; backward recomputes only elementwise ops.
+
+    "flash_dots": dots PLUS the flash residuals — without the flash
+    names the backward re-runs the attention kernel just to rebuild
+    (o, lse) even though every projection around it was saved."""
     mode = cfg.remat_mode if cfg is not None else "flash_resid"
     if mode == "nothing":
         return jax.checkpoint_policies.nothing_saveable
+    if mode == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if mode == "flash_dots":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse"))
     if mode != "flash_resid":
         raise ValueError(
             f"unknown remat_mode {mode!r}; valid: 'flash_resid', "
-            "'nothing'")
+            "'nothing', 'dots', 'flash_dots'")
     return jax.checkpoint_policies.save_only_these_names(
         "flash_o", "flash_lse")
 
@@ -501,13 +515,46 @@ def scatter_prefill_pages(cache: dict, ks, vs, page_ids: jnp.ndarray,
     (page id + in-page row per token position; positions past a slot's
     allocation point at the trash page).  Returns the updated cache.
     Duplicate wave-padding rows write identical data, so scatter order
-    is irrelevant (same rule as the dense _prefill_wave).  The [W, P]
-    advanced indices straddle the pool's kvh axis, so numpy semantics
-    put them first — the value shape is exactly ks[li]'s [W,P,kvh,hd]."""
-    k = [cache["k"][li].at[page_ids, :, rows].set(ks[li])
-         for li in range(len(cache["k"]))]
-    v = [cache["v"][li].at[page_ids, :, rows].set(vs[li])
-         for li in range(len(cache["v"]))]
+    is irrelevant (same rule as the dense _prefill_wave).
+
+    Fast paths write PAGE-ALIGNED BLOCKS with a single [n] advanced
+    index on the pool's page axis: the original [W, P] per-token
+    coordinate scatter cost ~50ms of a 64x128 wave's prefill on a v5e
+    (measured round 5: 193ms vs 143ms for the bare forward) — per-token
+    scatters are the one indexed-write shape XLA:TPU cannot tile.
+    Bucketed prompt lengths and power-of-two pages make every wave
+    page-aligned in practice; the coordinate path remains as the
+    general fallback."""
+    nk = len(cache["k"])
+    W, P = page_ids.shape
+    page = cache["k"][0].shape[2]
+    if P <= page:
+        # One (partial) page per wave member: block-write rows [0, P).
+        pids0 = page_ids[:, 0]
+        k = [cache["k"][li].at[pids0, :, :P, :].set(
+                 ks[li].transpose(0, 2, 1, 3)) for li in range(nk)]
+        v = [cache["v"][li].at[pids0, :, :P, :].set(
+                 vs[li].transpose(0, 2, 1, 3)) for li in range(nk)]
+    elif P % page == 0:
+        # m whole pages per wave member: flatten to W*m full-page writes.
+        m = P // page
+        flat = page_ids[:, ::page].reshape(W * m)
+
+        def blockify(a):
+            kvh, hd = a.shape[2], a.shape[3]
+            return a.reshape(W, m, page, kvh, hd) \
+                    .transpose(0, 1, 3, 2, 4) \
+                    .reshape(W * m, kvh, page, hd)
+
+        k = [cache["k"][li].at[flat].set(blockify(ks[li]))
+             for li in range(nk)]
+        v = [cache["v"][li].at[flat].set(blockify(vs[li]))
+             for li in range(nk)]
+    else:
+        k = [cache["k"][li].at[page_ids, :, rows].set(ks[li])
+             for li in range(nk)]
+        v = [cache["v"][li].at[page_ids, :, rows].set(vs[li])
+             for li in range(nk)]
     pos = cache["pos"].at[slots].set(true_lens)
     return {"k": k, "v": v, "pos": pos}
 

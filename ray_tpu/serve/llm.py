@@ -110,7 +110,13 @@ class LLMEngine:
             self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
                                                     self.max_len)
         self._buckets = _buckets_for(self.max_len)
-        self._width_buckets = sorted({w for w in (1, 8, max_batch)
+        # Prefill sub-wave cap: a full-width wave serializes the whole
+        # burst's forward in front of EVERY first-token fetch (64x128
+        # prefill ≈ 40ms compute on a v5e); <=32-wide chunks let the
+        # first chunk's tokens reach the host while later chunks are
+        # still computing (the fetches overlap via copy_to_host_async).
+        self._chunk = min(16, max_batch)
+        self._width_buckets = sorted({w for w in (1, 8, self._chunk)
                                       if w <= max_batch})
         self._rng = jax.random.PRNGKey(seed + 1)
 
@@ -216,15 +222,17 @@ class LLMEngine:
 
         self._prefill = jax.jit(_prefill_wave, donate_argnums=(1,))
 
-        # Paged prefill: same wave semantics, but the prompt K/V scatters
-        # into page-pool leaves via (page_id, row) coordinates computed
-        # host-side from the slot page tables.
-        def _prefill_wave_paged(params, cache, tokens, true_lens, slots,
-                                temps, rng, page_ids, rows):
+        # Paged prefill is SPLIT into two programs: (A) forward +
+        # first-token sample, (B) the KV page scatter.  The first-token
+        # fetch depends only on A, so its host round trip (the dominant
+        # TTFT term on a tunneled chip) overlaps B's 24-layer page
+        # writes AND later chunks' forwards instead of queueing behind
+        # them (round-5 serve-TTFT rework; the fused program measured
+        # ~50ms slower per wave).
+        def _prefill_fwd_only(params, tokens, true_lens, slots, temps,
+                              rng):
             W = tokens.shape[0]
             hidden, ks, vs = llama.prefill(params, tokens, cfg)
-            cache = llama.scatter_prefill_pages(cache, ks, vs, page_ids,
-                                                rows, slots, true_lens)
             last_h = hidden[jnp.arange(W), true_lens - 1]
             last = (last_h @ params["lm_head"]).astype(jnp.float32)
             greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -234,10 +242,14 @@ class LLMEngine:
                     k_, l_ / jnp.maximum(t_, 1e-6)))(
                         keys, last, temps).astype(jnp.int32)
             nxt = jnp.where(temps > 0, sampled, greedy)
-            return nxt, cache
+            return nxt, ks, vs
 
-        self._prefill_paged = jax.jit(_prefill_wave_paged,
-                                      donate_argnums=(1,))
+        self._prefill_fwd = jax.jit(_prefill_fwd_only)
+        self._scatter_pages = jax.jit(
+            lambda cache, ks, vs, page_ids, rows, slots, true_lens:
+            llama.scatter_prefill_pages(cache, ks, vs, page_ids, rows,
+                                        slots, true_lens),
+            donate_argnums=(0,))
 
         # Slot state.  Current tokens live ON DEVICE between blocks: the
         # decode output feeds the next decode input directly, so the only
@@ -340,6 +352,7 @@ class LLMEngine:
         import jax.numpy as jnp
 
         wave: list[tuple[int, _Request]] = []    # (slot, request)
+        grace_deadline = None
         while True:
             free = next((i for i, s in enumerate(self._slots)
                          if s is None), None)
@@ -351,7 +364,24 @@ class LLMEngine:
                 try:
                     req = self._waiting.get_nowait()
                 except queue.Empty:
-                    break
+                    # Burst coalescing: submissions race admission, and a
+                    # wave that launches a beat early strands the rest of
+                    # the burst behind a full prefill+sync round (~120ms
+                    # of loaded TTFT on a tunneled chip).  Once at least
+                    # one request is in hand, linger a few ms so the
+                    # whole burst rides ONE wave; idle requests never
+                    # wait (no linger on an empty wave).
+                    if not wave:
+                        break
+                    if grace_deadline is None:
+                        grace_deadline = time.perf_counter() + 0.005
+                    rem = grace_deadline - time.perf_counter()
+                    if rem <= 0:
+                        break
+                    try:
+                        req = self._waiting.get(timeout=rem)
+                    except queue.Empty:
+                        break
             if self.paged:
                 # Allocate the request's full page span up front (prompt
                 # + max_new_tokens) — no mid-decode growth, and the pool
@@ -373,52 +403,72 @@ class LLMEngine:
             wave.append((free, req))
         if not wave:
             return
-        W = len(wave)
-        bucket = next(b for b in self._buckets
-                      if b >= max(len(r.prompt) for _, r in wave))
-        # Pad the wave by duplicating the last row: the duplicate writes
-        # the same slot with the same data, so correctness is unaffected.
-        # Width is BUCKETED (1 / 8 / max_batch), not always max_batch: an
-        # idle single request padded to a 64-wide wave paid 64x the
-        # prefill FLOPs it needed — the round-3 idle-TTFT regression.
-        # Few widths × few length buckets keeps the compile count small.
-        padded_w = next(w for w in self._width_buckets if w >= W)
-        tokens = np.zeros((padded_w, bucket), np.int32)
-        true_lens = np.ones((padded_w,), np.int32)
-        slots = np.zeros((padded_w,), np.int32)
-        temps = np.zeros((padded_w,), np.float32)
-        for j in range(padded_w):
-            slot, req = wave[min(j, W - 1)]
-            tokens[j, :len(req.prompt)] = req.prompt
-            true_lens[j] = len(req.prompt)
-            slots[j] = slot
-            temps[j] = req.temperature
-        self._rng, sub = jax.random.split(self._rng)
-        slots_dev = jnp.asarray(slots)
-        if self.paged:
-            cols = np.arange(bucket) // self.page
-            page_ids = self._table[slots][:, cols]     # [padded_w, bkt]
-            rows = np.tile(np.arange(bucket, dtype=np.int32) % self.page,
-                           (padded_w, 1))
-            nxt, self.cache = self._prefill_paged(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(true_lens), slots_dev, jnp.asarray(temps),
-                sub, jnp.asarray(page_ids), jnp.asarray(rows))
-        else:
-            nxt, self.cache = self._prefill(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(true_lens), slots_dev,
-                jnp.asarray(temps), sub)
-        # Duplicate padding rows target the same slot with the same token.
-        self._cur_dev = self._set_slots(self._cur_dev, slots_dev, nxt)
-        firsts = np.asarray(nxt)[:W]
-        now = time.perf_counter()
-        for (slot, req), first in zip(wave, firsts):
-            req.first_token_at = now
-            req.tokens.append(int(first))
-            req.emit(int(first))
-            if self._done(req):
-                self._finish(slot)
+        # Sub-waves of <=_chunk requests: dispatch every chunk's forward
+        # (and, paged, its separate scatter program) back-to-back, THEN
+        # fetch first tokens — chunk 1's round trip overlaps chunk 2's
+        # compute, so a big burst's p50 TTFT tracks one RTT plus HALF
+        # the total prefill instead of all of it.
+        pending_waves = []        # (chunk, nxt_device)
+        for c0 in range(0, len(wave), self._chunk):
+            chunk = wave[c0:c0 + self._chunk]
+            W = len(chunk)
+            bucket = next(b for b in self._buckets
+                          if b >= max(len(r.prompt) for _, r in chunk))
+            # Pad by duplicating the last row: the duplicate writes the
+            # same slot with the same data, so correctness is
+            # unaffected.  Width is BUCKETED (1 / 8 / _chunk), not
+            # always max_batch: an idle single request padded to a
+            # 64-wide wave paid 64x the prefill FLOPs it needed — the
+            # round-3 idle-TTFT regression.  Few widths × few length
+            # buckets keeps the compile count small.
+            padded_w = next(w for w in self._width_buckets if w >= W)
+            tokens = np.zeros((padded_w, bucket), np.int32)
+            true_lens = np.ones((padded_w,), np.int32)
+            slots = np.zeros((padded_w,), np.int32)
+            temps = np.zeros((padded_w,), np.float32)
+            for j in range(padded_w):
+                slot, req = chunk[min(j, W - 1)]
+                tokens[j, :len(req.prompt)] = req.prompt
+                true_lens[j] = len(req.prompt)
+                slots[j] = slot
+                temps[j] = req.temperature
+            self._rng, sub = jax.random.split(self._rng)
+            slots_dev = jnp.asarray(slots)
+            lens_dev = jnp.asarray(true_lens)
+            if self.paged:
+                cols = np.arange(bucket) // self.page
+                page_ids = self._table[slots][:, cols]  # [padded_w, bkt]
+                rows = np.tile(
+                    np.arange(bucket, dtype=np.int32) % self.page,
+                    (padded_w, 1))
+                nxt, ks, vs = self._prefill_fwd(
+                    self.params, jnp.asarray(tokens), lens_dev,
+                    slots_dev, jnp.asarray(temps), sub)
+                self.cache = self._scatter_pages(
+                    self.cache, ks, vs, jnp.asarray(page_ids),
+                    jnp.asarray(rows), slots_dev, lens_dev)
+            else:
+                nxt, self.cache = self._prefill(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    lens_dev, slots_dev, jnp.asarray(temps), sub)
+            # Duplicate padding rows target the same slot + same token.
+            self._cur_dev = self._set_slots(self._cur_dev, slots_dev,
+                                            nxt)
+            pending_waves.append((chunk, nxt))
+        for _, nxt in pending_waves:
+            try:
+                nxt.copy_to_host_async()
+            except AttributeError:
+                pass
+        for chunk, nxt in pending_waves:
+            firsts = np.asarray(nxt)[:len(chunk)]
+            now = time.perf_counter()
+            for (slot, req), first in zip(chunk, firsts):
+                req.first_token_at = now
+                req.tokens.append(int(first))
+                req.emit(int(first))
+                if self._done(req):
+                    self._finish(slot)
 
     def _done(self, req: _Request) -> bool:
         return (len(req.tokens) >= req.max_new_tokens

@@ -15,6 +15,7 @@ import time
 from typing import Any, Callable
 
 import ray_tpu
+from ray_tpu.exceptions import ActorError, WorkerCrashedError
 from ray_tpu.train.backend import Backend, JaxBackend
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import FailureConfig, ScalingConfig
@@ -64,21 +65,39 @@ class BackendExecutor:
     # ------------------------------------------------------------ training
     def run(self, train_fn: Callable, config: dict | None = None,
             on_report: Callable[[list[dict]], Any] | None = None,
-            resume_checkpoint: Checkpoint | None = None) -> list:
+            resume_checkpoint: Checkpoint | None = None,
+            latest_checkpoint: Callable[[], Checkpoint | None]
+            | None = None) -> list:
         """Run train_fn on all workers to completion.  `on_report` sees the
         per-round list of rank reports (aligned, one per worker) and may
         return "stop" to early-stop.  Returns per-worker return values.
+
+        `latest_checkpoint` (ray: backend_executor.py:740-756 pairs
+        _restart with the session's newest checkpoint): after a group
+        restart the retry resumes from the NEWEST checkpoint reported so
+        far, not the run's original resume point — without it a failure
+        at step 900/1000 replays from step 0.
         """
         config = config or {}
         max_failures = self.failure.max_failures
         while True:
+            resume = resume_checkpoint
+            if latest_checkpoint is not None:
+                resume = latest_checkpoint() or resume_checkpoint
             try:
                 return self._run_once(train_fn, config, on_report,
-                                      resume_checkpoint)
-            except TrainingFailedError:
+                                      resume)
+            except (TrainingFailedError, ActorError,
+                    WorkerCrashedError) as e:
+                # Any actor/worker failure inside a run round counts as a
+                # training failure: raw ActorError can surface from
+                # group-wide calls (get_status/get_result/execute) when a
+                # worker dies between result polls — same recovery.
+                if not isinstance(e, TrainingFailedError):
+                    e = TrainingFailedError(f"worker group failure: {e!r}")
                 self._num_failures += 1
                 if max_failures >= 0 and self._num_failures > max_failures:
-                    raise
+                    raise e from None
                 self._restart()
 
     def _run_once(self, train_fn, config, on_report,

@@ -176,7 +176,14 @@ class CheckpointManager:
 
     @property
     def latest_checkpoint(self) -> Checkpoint | None:
-        return self._checkpoints[-1].checkpoint if self._checkpoints else None
+        """Most RECENT registration (ray: Result.checkpoint).  Explicit
+        max over index: _enforce_limit re-sorts the list by SCORE when a
+        checkpoint_score_attribute is set, so list order stops meaning
+        recency — crash-restart resume (backend_executor.run) depends on
+        this being the newest, not the best."""
+        if not self._checkpoints:
+            return None
+        return max(self._checkpoints, key=lambda t: t.index).checkpoint
 
     @property
     def best_checkpoint(self) -> Checkpoint | None:

@@ -113,7 +113,9 @@ class BaseTrainer:
             self._pre_run(executor)
             executor.run(self._train_fn(), self.train_loop_config,
                          on_report=on_report,
-                         resume_checkpoint=self.resume_from_checkpoint)
+                         resume_checkpoint=self.resume_from_checkpoint,
+                         latest_checkpoint=lambda:
+                         manager.latest_checkpoint)
             error = None
         except TrainingFailedError as e:
             error = e

@@ -77,6 +77,11 @@ class Integer(Domain):
                 v -= self.q
             if v < self.lower:
                 v += self.q
+            # When q exceeds the range width no q-multiple may fit; a
+            # single +/-q correction can still land outside [lower,
+            # upper-1] (round-4 advisor finding).  Hard-clamp as the
+            # final word: in-range beats q-aligned.
+            v = min(max(v, self.lower), self.upper - 1)
         return v
 
     def __repr__(self):

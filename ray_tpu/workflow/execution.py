@@ -487,18 +487,28 @@ def resume_all(storage: str | None = None) -> list[tuple[str, Any]]:
     return out
 
 
+_async_pool = None
+
+
+def _shared_pool():
+    """One module-level executor for the *_async veneers: a fresh pool
+    per call leaked one idle thread per invocation in long-lived drivers
+    (round-4 advisor finding)."""
+    global _async_pool
+    if _async_pool is None:
+        import concurrent.futures
+
+        _async_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="workflow-async")
+    return _async_pool
+
+
 def get_output_async(workflow_id: str, storage: str | None = None):
     """Future form of get_output (ray: get_output_async returns an
     ObjectRef; a concurrent Future is this runtime's async handle for
     driver-side work)."""
-    import concurrent.futures
-
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    return pool.submit(get_output, workflow_id, storage)
+    return _shared_pool().submit(get_output, workflow_id, storage)
 
 
 def resume_async(workflow_id: str, storage: str | None = None):
-    import concurrent.futures
-
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    return pool.submit(resume, workflow_id, storage=storage)
+    return _shared_pool().submit(resume, workflow_id, storage=storage)

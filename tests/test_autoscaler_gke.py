@@ -1,0 +1,176 @@
+"""GKE TPU node-pool provider against a fake GKE REST API + autoscaler
+v2 reconcile driving it (ray analog:
+python/ray/autoscaler/_private/kuberay/node_provider.py — replica-scaled
+managed groups instead of raw VM creates)."""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+
+class _FakeGKEAPI(http.server.BaseHTTPRequestHandler):
+    """Minimal node-pool surface: list/get/create pools, setSize,
+    deleteInstances.  Pool instances materialize deterministically as
+    {pool}-{n} with fake IPs."""
+
+    pools: dict = {}
+    counters: dict = {}
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.endswith("/token"):
+            self._send(200, {"access_token": "fake-token",
+                             "expires_in": 3600})
+            return
+        assert self.headers.get("Authorization") == "Bearer fake-token"
+        if self.path.endswith("/nodePools"):
+            self._send(200, {"nodePools": list(self.pools.values())})
+            return
+        name = self.path.rsplit("/", 1)[-1]
+        if name in self.pools:
+            self._send(200, self.pools[name])
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        assert self.headers.get("Authorization") == "Bearer fake-token"
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n).decode()) if n else {}
+        if self.path.endswith("/nodePools"):
+            pool = body["nodePool"]
+            pool.setdefault("status", "RUNNING")
+            pool.setdefault("instances", [])
+            self.pools[pool["name"]] = pool
+            self.counters.setdefault(pool["name"], 0)
+            self._send(200, {"name": "op-create"})
+            return
+        if self.path.endswith(":setSize"):
+            name = self.path.rsplit("/", 1)[-1].split(":")[0]
+            pool = self.pools[name]
+            want = body["nodeCount"]
+            insts = pool["instances"]
+            while len(insts) < want:
+                i = self.counters[name] = self.counters.get(name, 0) + 1
+                insts.append({"name": f"{name}-{i}",
+                              "ip": f"10.0.0.{i}",
+                              "status": "RUNNING"})
+            while len(insts) > want:
+                insts.pop()
+            self._send(200, {"name": "op-resize"})
+            return
+        if self.path.endswith(":deleteInstances"):
+            name = self.path.rsplit("/", 1)[-1].split(":")[0]
+            pool = self.pools[name]
+            gone = set(body["instances"])
+            pool["instances"] = [i for i in pool["instances"]
+                                 if i["name"] not in gone]
+            self._send(200, {"name": "op-delete"})
+            return
+        self._send(404, {"error": self.path})
+
+
+@pytest.fixture
+def fake_gke_api():
+    _FakeGKEAPI.pools = {}
+    _FakeGKEAPI.counters = {}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeGKEAPI)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _provider(fake):
+    from ray_tpu.autoscaler.gke import GKETPUNodeProvider
+
+    return GKETPUNodeProvider(
+        "proj", "us-central2-b", "tpu-cluster", api_endpoint=fake,
+        metadata_endpoint=fake, cluster_name="rt")
+
+
+class TestGKEProvider:
+    def test_pool_create_scale_terminate(self, fake_gke_api):
+        p = _provider(fake_gke_api)
+        ids = p.create_node({"pool": "tpu-v5e", "machine_type":
+                             "ct5lp-hightpu-8t", "tpu_topology": "2x4"},
+                            count=2)
+        assert len(ids) == 2
+        pool = _FakeGKEAPI.pools["tpu-v5e"]
+        assert pool["config"]["machineType"] == "ct5lp-hightpu-8t"
+        assert pool["placementPolicy"]["tpuTopology"] == "2x4"
+        assert pool["config"]["labels"]["ray-cluster"] == "rt"
+        assert sorted(p.non_terminated_nodes()) == sorted(ids)
+        assert p.is_running(ids[0])
+        assert p.node_ip(ids[0]).startswith("10.0.0.")
+
+        p.terminate_node(ids[0])
+        assert p.non_terminated_nodes() == [ids[1]]
+        # growing again resizes the SAME pool (no second pool)
+        more = p.create_node({"pool": "tpu-v5e"}, count=1)
+        assert len(more) == 1
+        assert len(_FakeGKEAPI.pools) == 1
+
+    def test_foreign_pools_ignored(self, fake_gke_api):
+        _FakeGKEAPI.pools["other"] = {
+            "name": "other", "status": "RUNNING",
+            "config": {"labels": {"ray-cluster": "not-ours"}},
+            "instances": [{"name": "other-1", "status": "RUNNING"}]}
+        p = _provider(fake_gke_api)
+        assert p.non_terminated_nodes() == []
+
+    def test_head_node_from_labelled_pool(self, fake_gke_api):
+        p = _provider(fake_gke_api)
+        assert p.head_node() is None
+        _FakeGKEAPI.pools["head-pool"] = {
+            "name": "head-pool", "status": "RUNNING",
+            "config": {"labels": {"ray-cluster": "rt",
+                                  "ray-node-type": "head"}},
+            "instances": [{"name": "head-pool-1", "ip": "10.0.1.1",
+                           "status": "RUNNING"}]}
+        assert p.head_node() == "head-pool-1"
+
+
+class TestGKEReconcile:
+    def test_v2_scales_fake_pool_up_and_down(self, fake_gke_api,
+                                             ray_shared):
+        """VERDICT round-4 item 6: the v2 reconciler scales a fake GKE
+        TPU pool up to the target and back down."""
+        from ray_tpu.autoscaler.v2 import (ALLOCATED, Reconciler,
+                                           TERMINATED)
+
+        p = _provider(fake_gke_api)
+        rec = Reconciler(p, node_config={"pool": "tpu-v5e",
+                                         "tpu_topology": "2x4"})
+        rec.im = type(rec.im)()     # fresh table (ignore persisted)
+        rec.set_target(3)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rec.reconcile_once()
+            if len(rec.im.in_state(ALLOCATED)) == 3:
+                break
+            time.sleep(0.1)
+        assert len(rec.im.in_state(ALLOCATED)) == 3, rec.summary()
+        assert len(_FakeGKEAPI.pools["tpu-v5e"]["instances"]) == 3
+
+        rec.set_target(1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rec.reconcile_once()
+            if len(rec.im.in_state(ALLOCATED)) == 1:
+                break
+            time.sleep(0.1)
+        assert len(rec.im.in_state(ALLOCATED)) == 1, rec.summary()
+        assert len(rec.im.in_state(TERMINATED)) == 2, rec.summary()
+        assert len(_FakeGKEAPI.pools["tpu-v5e"]["instances"]) == 1

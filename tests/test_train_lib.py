@@ -264,3 +264,164 @@ class TestMultiHostJax:
         assert result.error is None, result.error
         losses = result.metrics["losses"]
         assert losses[-1] < losses[0], losses
+
+
+def _resumable_loop(config):
+    """Checkpoint-per-step loop whose rank 1 hard-kills itself ONCE at the
+    configured step (marker file arms the kill exactly one incarnation)."""
+    import os
+    import signal
+    import time
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    ckpt = train.get_checkpoint()
+    start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+    for i in range(start, config["total_steps"]):
+        marker = config.get("kill_marker")
+        if (marker and i == config.get("kill_at", -1)
+                and ctx.get_world_rank() == 1
+                and not os.path.exists(marker)):
+            open(marker, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
+        if config.get("progress_dir"):
+            with open(os.path.join(config["progress_dir"],
+                                   f"rank{ctx.get_world_rank()}"),
+                      "w") as f:
+                f.write(f"{ctx.get_node_id()} {i}")
+        train.report({"step": i, "start": start,
+                      "rank": ctx.get_world_rank()},
+                     checkpoint=Checkpoint.from_dict({"step": i}))
+        if config.get("step_sleep_s"):
+            time.sleep(config["step_sleep_s"])
+
+
+class TestTrainElasticity:
+    """Chaos tests for the group-restart path (ray:
+    backend_executor.py:740-756 _restart + max_failures): the round-4
+    verdict's most under-tested claim — recovery is implemented but no
+    test killed anything mid-fit()."""
+
+    def test_worker_sigkill_restarts_and_resumes(self, ray_shared,
+                                                 tmp_path):
+        """SIGKILL rank 1 mid-run: the group restarts within
+        max_failures and the retry resumes from the NEWEST checkpoint
+        (not the run's original resume point)."""
+        marker = tmp_path / "killed_once"
+        # step_sleep paces the loop to the executor's poll cadence so the
+        # checkpointed rounds 0-2 EMIT before the kill; an instant loop
+        # dies with its reports still queued worker-side and the retry
+        # legitimately restarts from scratch.
+        trainer = JaxTrainer(
+            _resumable_loop,
+            train_loop_config={"total_steps": 6, "kill_at": 3,
+                               "step_sleep_s": 0.4,
+                               "kill_marker": str(marker)},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         num_cpus_per_worker=0.5),
+            run_config=RunConfig(
+                name="chaos_worker_kill", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)))
+        result = trainer.fit()
+        assert marker.exists(), "kill never armed - test is vacuous"
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 5
+        # The retry resumed from the newest full-round checkpoint: some
+        # report in the history carries start > 0.  A replay-from-zero
+        # (the pre-round-5 behavior: _restart reused the ORIGINAL
+        # resume_checkpoint) would report start == 0 everywhere.
+        starts = {m.get("start") for m in result.metrics_history}
+        assert any(s > 0 for s in starts if s is not None), starts
+
+    def test_max_failures_exhausted_surfaces_error(self, ray_shared,
+                                                   tmp_path):
+        """Unconditional rank-1 suicide: restarts stop after
+        max_failures and the failure surfaces in Result.error."""
+
+        def always_dies(config):
+            import os
+            import signal
+
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            if ctx.get_world_rank() == 1:
+                os.kill(os.getpid(), signal.SIGKILL)
+            train.report({"step": 0})
+
+        trainer = JaxTrainer(
+            always_dies,
+            scaling_config=ScalingConfig(num_workers=2,
+                                         num_cpus_per_worker=0.5),
+            run_config=RunConfig(
+                name="chaos_exhaust", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)))
+        result = trainer.fit()
+        assert result.error is not None
+        msg = str(result.error)
+        assert "died" in msg or "worker" in msg, msg
+
+
+def test_node_agent_kill_mid_fit(tmp_path):
+    """Kill the NODE AGENT hosting the train workers mid-fit(): worker
+    death propagates, the group restarts on surviving nodes, and the run
+    completes from the latest checkpoint (the reference's recovery unit
+    — lose a host, keep the run)."""
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    n1 = cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(2)
+        progress = tmp_path / "progress"
+        progress.mkdir()
+        trainer = JaxTrainer(
+            _resumable_loop,
+            train_loop_config={"total_steps": 8, "step_sleep_s": 0.3,
+                               "progress_dir": str(progress)},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         num_cpus_per_worker=0.5),
+            run_config=RunConfig(
+                name="chaos_node_kill", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2)))
+        box = {}
+
+        def run_fit():
+            box["result"] = trainer.fit()
+
+        t = threading.Thread(target=run_fit, daemon=True)
+        t.start()
+        # Wait for both ranks to make progress, then kill the agent of
+        # whichever NON-HEAD node hosts rank 0.
+        deadline = time.monotonic() + 120
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            f = progress / "rank0"
+            if f.exists():
+                node_id, step = f.read_text().split()
+                if int(step) >= 1:
+                    victim = next((n for n in (n1, n2)
+                                   if n["node_id"] == node_id), None)
+            time.sleep(0.2)
+        assert victim is not None, "rank0 never reported progress"
+        cluster.kill_node(victim)
+        t.join(timeout=240)
+        assert not t.is_alive(), "fit() wedged after node kill"
+        result = box["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 7
+        starts = {m.get("start") for m in result.metrics_history}
+        assert any(s > 0 for s in starts if s is not None), starts
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
