@@ -991,6 +991,8 @@ def main() -> None:
     config = Config().override(_json.loads(args.config_json))
 
     async def _run():
+        from ray_tpu._private.stack_dump import register_loop
+        register_loop(asyncio.get_running_loop())
         c = Controller(config, port=args.port or None,
                        snapshot_path=args.snapshot_path or None)
         await c.start()

@@ -1082,6 +1082,8 @@ def main() -> None:
     _watch_parent()
 
     async def _run():
+        from ray_tpu._private.stack_dump import register_loop
+        register_loop(asyncio.get_running_loop())
         agent = NodeAgent(config, args.controller, resources=resources,
                           node_id=args.node_id or None, labels=labels)
         await agent.start()

@@ -350,6 +350,14 @@ class RpcServer:
         self._sock = self._io.ctx.socket(zmq.ROUTER)
         self._sock.setsockopt(zmq.LINGER, 0)
         self._sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
+        # UNLIMITED queues on the RPC fabric: a ROUTER at SNDHWM (default
+        # 1000) silently DROPS replies to the saturated peer — a 10k-RPC
+        # burst (one task resolving 10k arg refs) lost ~30 replies and
+        # wedged the caller forever (the round-4/round-5 bench wedge,
+        # caught by the coroutine stack dumps).  Control-plane frames are
+        # small; death detection reaps queues of dead peers.
+        self._sock.setsockopt(zmq.SNDHWM, 0)
+        self._sock.setsockopt(zmq.RCVHWM, 0)
         if port:
             # Fixed port: lets a restarted controller come back at the
             # SAME address so agents/clients reconnect transparently
@@ -466,6 +474,12 @@ class RpcClient:
         self._io = io_thread()
         self._sock = self._io.ctx.socket(zmq.DEALER)
         self._sock.setsockopt(zmq.LINGER, 0)
+        # Unlimited queues, matching the server ROUTER: a DEALER at HWM
+        # EAGAINs into the IO thread's overflow queue (fine), but the
+        # REPLY path back through a ROUTER at HWM drops silently — both
+        # ends of the RPC fabric must be lossless (see RpcServer).
+        self._sock.setsockopt(zmq.SNDHWM, 0)
+        self._sock.setsockopt(zmq.RCVHWM, 0)
         self._sock.connect(f"tcp://{address}")
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 1

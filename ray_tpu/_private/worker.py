@@ -288,6 +288,8 @@ class CoreWorker:
 
     async def _io_async_main(self, started: threading.Event) -> None:
         self.loop = asyncio.get_running_loop()
+        from ray_tpu._private.stack_dump import register_loop
+        register_loop(self.loop)
         # Transport sockets live on the process-wide rpc IO thread; this
         # component only closes ITS server/clients/subscriber on the way
         # out (the shared context is never terminated — in-process

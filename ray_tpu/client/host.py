@@ -525,6 +525,8 @@ async def _serve() -> None:
 
     server = RpcServer()
     _HOST.loop = asyncio.get_running_loop()
+    from ray_tpu._private.stack_dump import register_loop
+    register_loop(_HOST.loop)
     server.register_all(_HOST)
     server.start()
     print(json.dumps({"host_addr": server.address}), flush=True)

@@ -83,6 +83,9 @@ class ProxyServer:
 async def _main(argv: list[str]) -> None:
 
     from ray_tpu._private.rpc import ClientPool, RpcServer
+    from ray_tpu._private.stack_dump import register_loop
+
+    register_loop(asyncio.get_running_loop())
 
     p = argparse.ArgumentParser()
     p.add_argument("--cluster", required=True)
