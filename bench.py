@@ -117,18 +117,20 @@ def bench_control_plane(out: dict) -> None:
             _flush_partial(out)
         return ok
 
-    def best_of(fn, n: int, trials: int = 2) -> float:
+    def best_of(fn, n: int, trials: int = 2) -> dict:
         """Max rate over `trials` runs: the box's hypervisor-steal noise
         swings a single window 2-3x (BENCH_r03 recorded a 0.49x
         'regression' that an A/B against the round-2 tree could not
         reproduce — pure measurement noise).  Max-of-trials records
-        capability, not the scheduler's mood."""
+        capability, not the scheduler's mood — and since round 6 every
+        row also records the raw trials, so cross-round drift and
+        variance stop being absorbed by best-vs-best comparison."""
         rates = []
         for _ in range(trials):
             t0 = time.perf_counter()
             fn(n)
-            rates.append(n / (time.perf_counter() - t0))
-        return max(rates)
+            rates.append(round(n / (time.perf_counter() - t0), 2))
+        return {"best": max(rates), "trials": rates}
 
     if not section("init", 120, lambda: ray_tpu.init(resources={"CPU": 8})):
         # A wedged init may have booted head subprocesses already — tear
@@ -183,8 +185,31 @@ def bench_control_plane(out: dict) -> None:
                 for _ in range(n):
                     ray_tpu.get(c.inc.remote(), timeout=GET_T)
             out["actor_calls_sync_per_s"] = rnd(best_of(run, 300))
+            from ray_tpu._private.worker import global_worker
+            out["actor_sync_fused_calls"] = \
+                global_worker()._direct_sync_calls
         if c is not None:
             section("actor_sync", 90, _actor_sync)
+
+        # Per-hop latency of ONE traced sync actor call (the ISSUE-1
+        # tracer): where the ~1ms/call actually goes, hop by hop —
+        # caller thread -> IO thread -> wire -> executee loop ->
+        # executor and back.  Best (lowest-total) of 3 traces: a single
+        # traced call is one sample of a 3x-swinging box.
+        def _hop_breakdown():
+            from ray_tpu._private import profiling
+            best = None
+            for _ in range(3):
+                with profiling.hop_trace() as rec:
+                    ray_tpu.get(c.inc.remote(), timeout=GET_T)
+                table = profiling.hop_breakdown_us(rec)
+                if table and (best is None
+                              or table["total_us"] < best["total_us"]):
+                    best = table
+            if best:
+                out["sync_hop_breakdown_us"] = best
+        if c is not None:
+            section("sync_hop_breakdown", 30, _hop_breakdown)
 
         # Async actor (coroutine methods ride the worker's event loop;
         # reference "1_1_async_actor_calls_async" 4,457/s bar) and a
@@ -295,14 +320,13 @@ def bench_control_plane(out: dict) -> None:
         def _pg_churn():
             from ray_tpu.utils.placement_group import (
                 placement_group, remove_placement_group)
-            n = 30
-            t0 = time.perf_counter()
-            for _ in range(n):
-                pg = placement_group([{"CPU": 1}])
-                pg.ready(timeout=30.0)
-                remove_placement_group(pg)
-            out["pg_create_remove_per_s"] = rnd(
-                n / (time.perf_counter() - t0))
+
+            def run(n):
+                for _ in range(n):
+                    pg = placement_group([{"CPU": 1}])
+                    pg.ready(timeout=30.0)
+                    remove_placement_group(pg)
+            out["pg_create_remove_per_s"] = rnd(best_of(run, 30))
         section("pg_churn", 90, _pg_churn)
 
         # Many-actors scale point (reference: many_actors release bench —
@@ -608,6 +632,10 @@ def bench_model() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+
     from ray_tpu.models import llama
     from ray_tpu.parallel.mesh import MeshConfig, create_mesh
     from ray_tpu.train import step as train_step
@@ -646,6 +674,7 @@ def bench_model() -> dict:
                          / (time.perf_counter() - t0))
 
     tokens_per_s = max(rates)
+    trial_rates = [round(r, 1) for r in rates]
     flops_per_token = 6.0 * cfg.num_params() + \
         12.0 * cfg.n_layers * cfg.dim * seq
     peak = next((v for k, v in PEAK_BF16.items() if str(dev).startswith(k)),
@@ -654,6 +683,7 @@ def bench_model() -> dict:
     out = {"model": "bench-350m" if on_tpu else "debug",
            "device": str(dev),
            "train_tokens_per_s_chip": round(tokens_per_s, 1),
+           "train_tokens_per_s_trials": trial_rates,
            "train_step_ms": round(batch * seq / tokens_per_s * 1000, 2),
            "mfu": round(mfu, 4),
            "loss": round(loss_val, 4)}
@@ -703,6 +733,9 @@ def bench_serve_llm() -> dict:
     import jax
     import numpy as np
 
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
     from ray_tpu.models import llama
     from ray_tpu.serve.llm import LLMEngine
 
@@ -742,6 +775,7 @@ def bench_serve_llm() -> dict:
         # shared chip's steal windows swing p50 TTFT ~10ms run-to-run;
         # record capability, keep the winning run's rows together.
         best = None
+        runs = []
         for _ in range(2):
             prompts = [rng.integers(1, cfg.vocab_size,
                                     prompt_len).tolist()
@@ -758,12 +792,15 @@ def bench_serve_llm() -> dict:
                 "decode_tokens_per_s": round(
                     n_requests * new_tokens / wall, 1),
             }
+            runs.append(run)
             if best is None or run["p50_ttft_ms"] < best["p50_ttft_ms"]:
                 best = run
         return {
             "model": "bench-350m" if on_tpu else "debug",
             "idle_ttft_ms": round(sorted(idle)[1] * 1000, 1),
+            "idle_ttft_ms_trials": [round(t * 1000, 1) for t in idle],
             **best,
+            "trials": runs,
         }
     finally:
         eng.stop()
@@ -818,11 +855,20 @@ def _vs_previous_round(extra: dict) -> dict:
     # the best-of-trials version re-resolves, and the honest store rows
     # are now get/put_small_xproc.
     changed = {"get_small_per_s"}
+
+    def _num(v):
+        # best-of rows carry {"best": x, "trials": [...]} since round 6;
+        # compare on the best either way.
+        if isinstance(v, dict):
+            v = v.get("best")
+        return v if isinstance(v, (int, float)) else None
+
     out = {}
     for key, val in extra.items():
-        pv = prev_extra.get(key)
-        if (key in changed or not isinstance(val, (int, float))
-                or not isinstance(pv, (int, float)) or pv <= 0 or val <= 0):
+        pv = _num(prev_extra.get(key))
+        val = _num(val)
+        if (key in changed or val is None or pv is None
+                or pv <= 0 or val <= 0):
             continue
         if key.endswith(("_per_s", "_gib_per_s")):
             worse = val < 0.7 * pv          # throughput: higher is better
@@ -845,7 +891,8 @@ def main() -> None:
         bench_control_plane(extra)
     except Exception as e:  # noqa: BLE001
         extra["control_plane_error"] = repr(e)
-    value = extra.get("tasks_async_per_s", 0.0)
+    row = extra.get("tasks_async_per_s", 0.0)
+    value = row.get("best", 0.0) if isinstance(row, dict) else row
     _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_multi_client, 300))

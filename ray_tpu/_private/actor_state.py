@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import threading
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -49,6 +50,16 @@ class ActorSubmitState:
     # Seqnos currently inside _send_actor_batch (unacked): min() is the
     # seq_floor stamped on outgoing batches — the receiver's baseline.
     inflight_seqs: set = field(default_factory=set)
+    # Guards seqno assignment and the unacked count across USER threads
+    # and the loop: the fused sync fast path submits off-loop, so the
+    # per-submission seqno must be taken where the submission happens
+    # (submission order == seqno order regardless of which path sends).
+    submit_lock: threading.Lock = field(default_factory=threading.Lock)
+    # Calls submitted but not yet terminally replied/failed.  The fused
+    # path is only taken at unacked == 0 (no ordering hazard with queued
+    # or in-flight loop-path sends; seq_floor is then trivially our own
+    # seqno).
+    unacked: int = 0
 
 
 class ActorInstance:

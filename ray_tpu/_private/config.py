@@ -14,6 +14,30 @@ import os
 from typing import Any
 
 
+def ensure_cpu_devices(n: int) -> None:
+    """Point jax at >= n virtual CPU devices, POST-import (this image's
+    sitecustomize pre-imports jax at interpreter startup, so env vars at
+    launch are already consumed).  Prefers jax.config; jax builds
+    without the `jax_num_cpu_devices` option fall back to XLA_FLAGS,
+    which the CPU backend reads at its (not yet triggered)
+    initialization.  No-op once a backend is up — callers assert/skip on
+    len(jax.devices()) as before."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        return              # backend already initialized
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+    except RuntimeError:
+        pass
+
+
 @dataclasses.dataclass
 class Config:
     # --- object store ---

@@ -752,22 +752,33 @@ class Controller:
                 self._pg_attempt_done(pg)
                 await self._pg_retry_wait()
                 continue
-            async def _reserve(idx: int, node_id: str) -> bool:
+
+            # ONE reserve round trip per agent for the whole wave (was:
+            # one per bundle — parallel, but each paying its own message
+            # + loop overhead; ray's 2PC also prepares per NODE,
+            # gcs_placement_group_scheduler.cc ReserveResourceFromNodes).
+            by_node: dict[str, list[int]] = {}
+            for idx, node_id in zip(pending, placement):
+                by_node.setdefault(node_id, []).append(idx)
+
+            async def _reserve_node(node_id: str, idxs: list[int]) -> set:
                 try:
                     reply, _ = await self.clients.get(
                         self.nodes[node_id].agent_addr).call(
-                        "reserve_bundle",
-                        {"pg_id": pg.pg_id, "bundle_index": idx,
-                         "resources": pg.bundles[idx]}, timeout=10.0)
-                    return bool(reply.get("ok"))
+                        "reserve_bundles",
+                        {"pg_id": pg.pg_id,
+                         "bundles": [{"bundle_index": i,
+                                      "resources": pg.bundles[i]}
+                                     for i in idxs]}, timeout=10.0)
+                    return set(reply.get("granted", ()))
                 except Exception:  # noqa: BLE001
-                    return False
+                    return set()
 
-            # One parallel reserve wave: bundle count must not multiply
-            # the agent RTT (ray's 2PC also prepares bundles in parallel,
-            # gcs_placement_group_scheduler.cc ReserveResourceFromNodes).
-            grants = await asyncio.gather(
-                *[_reserve(i, n) for i, n in zip(pending, placement)])
+            node_grants = await asyncio.gather(
+                *[_reserve_node(n, i) for n, i in by_node.items()])
+            granted_by_node = dict(zip(by_node, node_grants))
+            grants = [idx in granted_by_node.get(node_id, ())
+                      for idx, node_id in zip(pending, placement)]
             reserved = [(i, n) for (i, n), g
                         in zip(zip(pending, placement), grants) if g]
             if pg.state != "PENDING":
@@ -793,17 +804,9 @@ class Controller:
                         "pg", {"event": "created", "pg_id": pg.pg_id})
                     return
             else:
-                # Roll back partial reservations and retry (STRICT semantics).
-                for idx, node_id in reserved:
-                    node = self.nodes.get(node_id)
-                    if node:
-                        try:
-                            await self.clients.get(node.agent_addr).call(
-                                "release_bundle",
-                                {"pg_id": pg.pg_id, "bundle_index": idx},
-                                timeout=10.0)
-                        except Exception:  # noqa: BLE001
-                            pass
+                # Roll back partial reservations and retry (STRICT
+                # semantics) — batched per agent like the remove wave.
+                await self._release_pg_bundles(pg.pg_id, reserved)
                 self._pg_attempt_done(pg)
                 await self._pg_retry_wait()
         self._pg_attempt_done(pg)
@@ -871,15 +874,32 @@ class Controller:
 
     async def _release_pg_bundles(self, pg_id: str,
                                   bundles: list[tuple[int, str]]) -> None:
+        """Release a bundle wave in ONE round trip per agent, all agents
+        in parallel (was: one awaited RPC per bundle, sequential — N
+        bundles cost N chained RTTs on every remove and every STRICT
+        rollback).  Ordering note for churn (create right after remove):
+        the release sends post to each agent connection when this
+        coroutine first runs, which the loop schedules BEFORE any
+        create_pg that arrives later on the wire — per-connection order
+        then guarantees the agent frees capacity before it sees the next
+        reserve."""
+        by_node: dict[str, list[int]] = {}
         for idx, node_id in bundles:
+            by_node.setdefault(node_id, []).append(idx)
+
+        async def _one(node_id: str, idxs: list[int]) -> None:
             node = self.nodes.get(node_id)
-            if node and node.state == "ALIVE":
-                try:
-                    await self.clients.get(node.agent_addr).call(
-                        "release_bundle",
-                        {"pg_id": pg_id, "bundle_index": idx}, timeout=10.0)
-                except Exception:  # noqa: BLE001
-                    pass
+            if node is None or node.state != "ALIVE":
+                return
+            try:
+                await self.clients.get(node.agent_addr).call(
+                    "release_bundles",
+                    {"pg_id": pg_id, "bundle_indexes": idxs},
+                    timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+        await asyncio.gather(*[_one(n, i) for n, i in by_node.items()])
         self._pg_retry.set()
 
     # ------------------------------------------------------------ state API

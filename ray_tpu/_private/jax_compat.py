@@ -1,0 +1,139 @@
+"""Version gates for this image's jax graft.
+
+The codebase is written against current jax APIs; the container may ship
+an older graft (e.g. 0.4.x without `jax.set_mesh`).  Gates live here so
+model code, bench, and tests run unchanged on either build.  Importing
+this module requires jax — control-plane modules must NOT import it
+(the control plane never touches the chip, and `import ray_tpu` must
+stay jax-free).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+# Names of gates that actually installed (empty on current jax).  Tests
+# use is_legacy() to skip the few cases the old build CANNOT run (e.g.
+# partial-auto shard_map lowers a PartitionId the CPU SPMD partitioner
+# does not implement) — a gate, not an emulation.
+legacy_gates: list[str] = []
+
+
+def is_legacy() -> bool:
+    return bool(legacy_gates)
+
+
+class _Ambient:
+    """Mesh recorded by the set_mesh fallback, so the
+    get_abstract_mesh fallback can report it (new jax keeps this state
+    inside its trace machinery)."""
+
+    mesh = None
+
+
+def ensure_set_mesh() -> None:
+    """Make `jax.set_mesh(mesh)` available on older jax builds.
+
+    The fallback enters the plain Mesh context (the ambient-mesh
+    equivalent for jit/shard_map on old jax — every sharding in this
+    framework is an explicit NamedSharding, so the explicit-sharding
+    extras of the real set_mesh are never exercised) and records the
+    mesh for the get_abstract_mesh fallback."""
+    if hasattr(jax, "set_mesh"):
+        return
+    legacy_gates.append("set_mesh")
+
+    @contextlib.contextmanager
+    def _set_mesh(mesh):
+        prev = _Ambient.mesh
+        _Ambient.mesh = mesh
+        try:
+            with mesh:
+                yield mesh
+        finally:
+            _Ambient.mesh = prev
+
+    jax.set_mesh = _set_mesh
+
+
+def ensure_get_abstract_mesh() -> None:
+    """`jax.sharding.get_abstract_mesh()` fallback: the mesh recorded by
+    the set_mesh fallback, else the legacy ambient physical mesh, else
+    None (matching how framework callers treat 'no mesh')."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return
+    legacy_gates.append("get_abstract_mesh")
+
+    def _get():
+        if _Ambient.mesh is not None:
+            return _Ambient.mesh
+        try:
+            from jax._src.mesh import thread_resources
+
+            m = thread_resources.env.physical_mesh
+            if m is not None and m.axis_names:
+                return m
+        except Exception:  # noqa: BLE001 - internal layout drift
+            pass
+        return None
+
+    jax.sharding.get_abstract_mesh = _get
+
+
+def ensure_shard_map() -> None:
+    """Top-level `jax.shard_map` fallback over the experimental one.
+
+    Signature drift handled: new code passes `axis_names={...}` (manual
+    ONLY over those axes) and `check_vma=`; the old API spells those
+    `auto=<complement>` and `check_rep=`."""
+    if hasattr(jax, "shard_map"):
+        return
+    legacy_gates.append("shard_map")
+    from jax.experimental.shard_map import shard_map as _old
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+                  axis_names=None, check_vma=None, check_rep=None, **kw):
+        if axis_names is not None and mesh is not None:
+            auto = frozenset(n for n in mesh.axis_names
+                             if n not in axis_names)
+            if auto:
+                kw["auto"] = auto
+        if check_vma is not None and check_rep is None:
+            check_rep = check_vma
+        if check_rep is not None:
+            kw["check_rep"] = bool(check_rep)
+        return _old(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def ensure_pallas_tpu_params() -> None:
+    """`pltpu.CompilerParams` was `TPUCompilerParams` on older builds."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # noqa: BLE001 - no pallas on this build
+        return
+    if not hasattr(pltpu, "CompilerParams") \
+            and hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+def ensure_axis_size() -> None:
+    """`jax.lax.axis_size(name)` fallback: lax.psum(1, name) constant-
+    folds to a static python int inside shard_map on old builds."""
+    if hasattr(jax.lax, "axis_size"):
+        return
+    legacy_gates.append("axis_size")
+    jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+
+
+def install() -> None:
+    """Install every gate (idempotent; no-ops on current jax)."""
+    ensure_set_mesh()
+    ensure_get_abstract_mesh()
+    ensure_shard_map()
+    ensure_pallas_tpu_params()
+    ensure_axis_size()

@@ -190,6 +190,14 @@ class KvSnapshotStorage:
         rest = uri[len("kv://"):]
         hostport, _, name = rest.partition("/")
         host, _, port = hostport.rpartition(":")
+        if not port or not port.isdigit():
+            # A portless URI used to surface as a bare
+            # ValueError('myhost') from int() — name the expected form.
+            raise ValueError(
+                f"invalid kv snapshot URI {uri!r}: expected "
+                "kv://HOST:PORT/NAME (e.g. kv://127.0.0.1:7379/"
+                f"controller), got host:port part {hostport!r} "
+                "with a missing or non-numeric port")
         self.client = KvClient(host or "127.0.0.1", int(port))
         self.key = (name or "controller").encode()
 

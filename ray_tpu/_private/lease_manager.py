@@ -32,6 +32,10 @@ class PendingTask:
     # in the args; released when the reply arrives unless the executing
     # worker reports the ref still held (ray: reference_count.cc borrows).
     borrowed: list = field(default_factory=list)
+    # ActorSubmitState of the target actor (actor tasks only): the
+    # terminal reply/failure decrements its unacked count exactly once
+    # (cleared to None at the decrement site).
+    actor_state: object = None
 
 
 class LeaseManager:

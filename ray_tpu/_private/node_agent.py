@@ -999,25 +999,52 @@ class NodeAgent:
         return {"found": False}
 
     # ---------------------------------------------------- placement bundles
-    async def rpc_reserve_bundle(self, h: dict, _b: list) -> dict:
-        key = f"{h['pg_id']}:{h['bundle_index']}"
+    def _reserve_one_bundle(self, pg_id: str, index: int,
+                            demand: dict) -> bool:
+        key = f"{pg_id}:{index}"
         if key in self.bundles:
-            return {"ok": True}
-        demand = h["resources"]
+            return True
         if not sched.available(self.available, demand):
-            return {"ok": False}
+            return False
         for k, v in demand.items():
             self.available[k] = self.available.get(k, 0.0) - v
         self.bundles[key] = {"resources": dict(demand),
                              "available": dict(demand)}
-        return {"ok": True}
+        return True
 
-    async def rpc_release_bundle(self, h: dict, _b: list) -> dict:
-        key = f"{h['pg_id']}:{h['bundle_index']}"
-        b = self.bundles.pop(key, None)
+    def _release_one_bundle(self, pg_id: str, index: int) -> None:
+        b = self.bundles.pop(f"{pg_id}:{index}", None)
         if b:
             for k, v in b["resources"].items():
                 self.available[k] = self.available.get(k, 0.0) + v
+
+    async def rpc_reserve_bundle(self, h: dict, _b: list) -> dict:
+        return {"ok": self._reserve_one_bundle(
+            h["pg_id"], h["bundle_index"], h["resources"])}
+
+    async def rpc_reserve_bundles(self, h: dict, _b: list) -> dict:
+        """Batched reservation: ONE round trip reserves every bundle the
+        controller placed on this node (ISSUE-1 PG round-trip collapse;
+        ray's 2PC also prepares per node, not per bundle).  Grants are
+        per-bundle — the controller rolls back partial waves exactly as
+        with the single verb."""
+        granted = []
+        for b in h["bundles"]:
+            if self._reserve_one_bundle(h["pg_id"], b["bundle_index"],
+                                        b["resources"]):
+                granted.append(b["bundle_index"])
+        return {"granted": granted}
+
+    async def rpc_release_bundle(self, h: dict, _b: list) -> dict:
+        self._release_one_bundle(h["pg_id"], h["bundle_index"])
+        self._try_grant_pending()
+        return {}
+
+    async def rpc_release_bundles(self, h: dict, _b: list) -> dict:
+        """Batched release: one round trip frees every listed bundle of
+        one placement group on this node."""
+        for idx in h["bundle_indexes"]:
+            self._release_one_bundle(h["pg_id"], idx)
         self._try_grant_pending()
         return {}
 

@@ -11,6 +11,86 @@ from __future__ import annotations
 import time
 from contextlib import contextmanager
 
+# Stamp order of one traced sync call, caller-side entry to caller-side
+# return.  Not every stamp appears on every path: the fused fast path
+# stamps caller_post/caller_wake (no event loop on the caller's critical
+# path), the loop path stamps loop_call/caller_loop_wake instead.
+HOP_ORDER = (
+    "caller_entry",       # API entry on the caller thread (arm time)
+    "caller_post",        # fused path: posted straight to the IO thread
+    "loop_call",          # loop path: RpcClient.call ran on the loop
+    "io_send",            # IO thread handed the frames to zmq
+    "peer_recv",          # executee IO thread pulled the frames off zmq
+    "peer_dispatch",      # executee loop picked the request up
+    "exec_start",         # executor thread entered user code
+    "exec_end",           # executor thread left user code
+    "handler_done",       # executee loop finished the handler
+    "reply_io_send",      # executee IO thread sent the reply
+    "reply_recv",         # caller IO thread received the reply
+    "caller_loop_wake",   # loop path: reply future resolved on the loop
+    "caller_wake",        # fused path: blocked caller thread released
+    "caller_done",        # API returned on the caller thread
+)
+
+
+def arm_hop_trace(methods: tuple = ("actor_call",)) -> None:
+    """Trace the next outgoing RPC whose method matches (one-shot).
+    See `hop_trace` for the usual usage."""
+    from ray_tpu._private import rpc
+
+    rpc.arm_hop_trace(methods)
+
+
+def last_hop_trace() -> dict | None:
+    """Raw stamps (name -> monotonic seconds) of the most recent traced
+    call, cleared on read."""
+    from ray_tpu._private import rpc
+
+    return rpc.take_hop_trace()
+
+
+@contextmanager
+def hop_trace(methods: tuple = ("actor_call",)):
+    """Trace ONE sync call's per-hop latency:
+
+        with profiling.hop_trace() as rec:
+            ray_tpu.get(counter.inc.remote())
+        table = profiling.hop_breakdown_us(rec)
+
+    The yielded dict gains "hops" (raw stamps) and "caller_done" when the
+    block exits; feed it to `hop_breakdown_us` for per-hop microseconds."""
+    from ray_tpu._private import rpc
+
+    rec: dict = {}
+    rpc.arm_hop_trace(methods)
+    try:
+        yield rec
+    finally:
+        rec["caller_done"] = time.monotonic()
+        rec["hops"] = rpc.take_hop_trace()
+        rpc.disarm_hop_trace()
+
+
+def hop_breakdown_us(rec: dict) -> dict:
+    """Per-hop latency table (microseconds between consecutive observed
+    stamps, in HOP_ORDER) for a completed `hop_trace` record.  Empty when
+    the traced call never fired (e.g. the value resolved locally)."""
+    hops = dict(rec.get("hops") or {})
+    if not hops:
+        return {}
+    if "caller_done" in rec:
+        hops["caller_done"] = rec["caller_done"]
+    present = [(k, hops[k]) for k in HOP_ORDER if k in hops]
+    if len(present) < 2:
+        return {}
+    out: dict = {}
+    prev_name, prev_t = present[0]
+    for name, t in present[1:]:
+        out[f"{prev_name}->{name}_us"] = round((t - prev_t) * 1e6, 1)
+        prev_name, prev_t = name, t
+    out["total_us"] = round((present[-1][1] - present[0][1]) * 1e6, 1)
+    return out
+
 
 @contextmanager
 def profile(event_name: str, extra_data: dict | None = None):

@@ -37,10 +37,12 @@ shared context.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import os
 import pickle
 import threading
+import time
 import traceback
 from collections import deque
 from typing import Any, Awaitable, Callable
@@ -52,6 +54,69 @@ logger = logging.getLogger(__name__)
 
 Blobs = list[bytes]
 Handler = Callable[[dict, Blobs], Awaitable[tuple[dict, Blobs] | dict | None]]
+
+
+# ------------------------------------------------------------- hop tracer
+# Opt-in per-hop latency stamps for ONE call at a time: monotonic-clock
+# stamps ride the RPC header under "_hops" (CLOCK_MONOTONIC is system-wide
+# on Linux, so stamps from different processes on one host compare
+# directly).  Arm from the caller thread right before the API call; the
+# next outgoing RPC whose method matches consumes the arm, every layer it
+# crosses appends its stamp in place, the server echoes the dict back in
+# the reply header, and the completed trace lands in _hop_last for
+# profiling.last/take.  Zero cost when disarmed: one `is not None` check
+# per call.
+_hop_armed: dict | None = None
+_hop_last: dict | None = None
+# Generation guard: a late reply from an ABANDONED traced call (the
+# hop_trace block timed out and exited) must not publish stale stamps
+# over the next trace.  arm and disarm both bump the generation; a
+# publish whose trace carries an older generation is dropped.
+_hop_gen = 0
+
+
+def arm_hop_trace(methods: tuple = ("actor_call",)) -> None:
+    """One-shot: trace the next outgoing RPC whose method is in
+    `methods`.  Stamps `caller_entry` now (the caller-thread API entry)."""
+    global _hop_armed, _hop_gen
+    _hop_gen += 1
+    _hop_armed = {"methods": tuple(methods), "gen": _hop_gen,
+                  "caller_entry": time.monotonic()}
+
+
+def _consume_hop_arm(method: str) -> dict | None:
+    """Claim the armed trace for this call (any thread; GIL-atomic swap)."""
+    global _hop_armed
+    armed = _hop_armed
+    if armed is None or method not in armed["methods"]:
+        return None
+    _hop_armed = None
+    return {"_gen": armed["gen"], "caller_entry": armed["caller_entry"]}
+
+
+def take_hop_trace() -> dict | None:
+    """The most recent completed trace (stamp name -> monotonic seconds),
+    cleared on read."""
+    global _hop_last
+    trace, _hop_last = _hop_last, None
+    return trace
+
+
+def disarm_hop_trace() -> None:
+    """Invalidate any still-pending arm AND any still-in-flight traced
+    call (the traced block is over): a stale arm would be consumed by a
+    later unrelated call, and a stale reply would publish over the next
+    trace."""
+    global _hop_armed, _hop_gen
+    _hop_gen += 1
+    _hop_armed = None
+
+
+def _publish_hop_trace(hops: dict) -> None:
+    global _hop_last
+    if hops.get("_gen") != _hop_gen:
+        return          # superseded trace: drop, don't impersonate
+    _hop_last = dict(hops)
 
 
 def pack_header(h: dict) -> bytes:
@@ -133,6 +198,10 @@ class IoThread:
         self._poller.register(self._wake_r, zmq.POLLIN)
         self._on_read: dict = {}        # socket -> cb(frames), IO thread
         self._outq: dict = {}           # socket -> deque[(frames, copy)]
+        # socket -> endpoint label, written ONLY on the IO thread (when
+        # a queue first forms) so the gauge below never touches a zmq
+        # socket from a foreign thread.
+        self._outq_labels: dict = {}
         self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="raytpu-io")
@@ -163,6 +232,7 @@ class IoThread:
         def _do():
             self._on_read.pop(sock, None)
             self._outq.pop(sock, None)
+            self._outq_labels.pop(sock, None)
             try:
                 self._poller.unregister(sock)
             except KeyError:
@@ -174,12 +244,44 @@ class IoThread:
         """Post a send; per-socket order is post order."""
         self.post(lambda: self._send_now(sock, frames, copy))
 
+    @staticmethod
+    def _sock_label(sock) -> str:
+        """IO-THREAD ONLY: zmq sockets are not thread-safe even for
+        getsockopt — every other thread reads the cached label."""
+        try:
+            ep = sock.get(zmq.LAST_ENDPOINT)
+            return ep.decode() if isinstance(ep, bytes) else str(ep)
+        except Exception:  # noqa: BLE001 - label is best-effort
+            return repr(sock)
+
+    def queue_depths(self) -> dict[str, int]:
+        """Per-socket overflow-queue depths (endpoint label -> queued
+        messages).  HWM=0 sockets never EAGAIN, so on the RPC fabric the
+        kernel/zmq buffers absorb everything and this stays empty — but a
+        PUB peer at HWM or a wedged-but-alive TCP peer grows a queue here;
+        this gauge (plus the threshold logs in _send_now) makes that
+        growth observable before it becomes an OOM.  Racy snapshot over
+        plain dicts/deques (labels were cached by the IO thread) — fine
+        for a gauge, and no zmq socket is touched off-thread."""
+        labels = self._outq_labels
+        return {labels.get(s, hex(id(s))): len(q)
+                for s, q in list(self._outq.items()) if q}
+
     # --------------------------------------------------------- IO-thread
     def _send_now(self, sock, frames, copy: bool) -> None:
         q = self._outq.get(sock)
         if q:
             # Order behind already-queued messages.
             q.append((frames, copy))
+            depth = len(q)
+            if depth >= 256 and (depth & (depth - 1)) == 0:
+                # Threshold-crossing log at powers of two: unbounded
+                # growth toward a wedged-but-alive peer names itself in
+                # the process tail long before memory runs out.
+                logger.warning(
+                    "rpc send queue to %s at depth %d (peer not "
+                    "draining)", self._outq_labels.get(sock, sock),
+                    depth)
             return
         try:
             sock.send_multipart(frames, zmq.NOBLOCK, copy=copy)
@@ -188,6 +290,7 @@ class IoThread:
             # part is accepted, so the whole message is still ours to
             # queue.  Drain on POLLOUT.
             self._outq.setdefault(sock, deque()).append((frames, copy))
+            self._outq_labels.setdefault(sock, self._sock_label(sock))
             if sock in self._on_read:
                 self._poller.modify(sock, zmq.POLLIN | zmq.POLLOUT)
             else:
@@ -285,6 +388,14 @@ def io_thread() -> IoThread:
             _io = IoThread()
             _io_pid = os.getpid()
     return _io
+
+
+def queue_depths() -> dict[str, int]:
+    """Process-wide per-socket send-queue gauge (empty when no IO thread
+    has started)."""
+    if _io is None or _io_pid != os.getpid():
+        return {}
+    return _io.queue_depths()
 
 
 def _reset_io() -> None:
@@ -386,10 +497,14 @@ class RpcServer:
         self._io.register(self._sock, self._on_frames)
 
     def _on_frames(self, frames) -> None:               # IO thread
+        # Recv stamp taken unconditionally (one clock read per message):
+        # a traced request needs the IO-thread arrival time, and by the
+        # time the header is unpacked loop-side that moment is gone.
+        t_recv = time.monotonic()
         self._poster.post(lambda: self._loop.create_task(
-            self._dispatch(frames)))
+            self._dispatch(frames, t_recv)))
 
-    async def _dispatch(self, frames) -> None:
+    async def _dispatch(self, frames, t_recv: float = 0.0) -> None:
         identity = frames[0]
         msgid, method = 0, "?"
         try:
@@ -398,6 +513,10 @@ class RpcServer:
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
+            hops = header.get("_hops") if isinstance(header, dict) else None
+            if isinstance(hops, dict):
+                hops["peer_recv"] = t_recv
+                hops["peer_dispatch"] = time.monotonic()
             result = await handler(header or {}, blobs)
             if msgid == 0:
                 return
@@ -407,6 +526,22 @@ class RpcServer:
                 rh, rb = result
             else:
                 rh, rb = result, []
+            if isinstance(hops, dict):
+                # Echo the (executor-stamped) trace back in the reply
+                # header, and stamp the actual reply send on the IO
+                # thread — so pack there, where the time is taken.
+                hops["handler_done"] = time.monotonic()
+                rh = dict(rh or {})
+                rh["_hops"] = hops
+                rb = list(rb)
+
+                def _send_traced(sock=self._sock):
+                    hops["reply_io_send"] = time.monotonic()
+                    out = [identity, msgpack.packb([msgid, True, rh]), *rb]
+                    self._io._send_now(sock, out, _send_flags(out))
+
+                self._io.post(_send_traced)
+                return
             out = [identity, msgpack.packb([msgid, True, rh]), *rb]
             self._io.send(self._sock, out, copy=_send_flags(out))
         except Exception as e:  # noqa: BLE001 - errors cross the wire
@@ -481,14 +616,24 @@ class RpcClient:
         self._sock.setsockopt(zmq.SNDHWM, 0)
         self._sock.setsockopt(zmq.RCVHWM, 0)
         self._sock.connect(f"tcp://{address}")
-        self._pending: dict[int, asyncio.Future] = {}
+        self._pending: dict[int, Any] = {}
         self._next_id = 1
+        # msgid allocation is shared with call_direct_start, which runs
+        # on arbitrary caller threads (the sync fast path).
+        self._id_lock = threading.Lock()
         self._loop = asyncio.get_running_loop()
         self._poster = LoopPoster(self._loop)
         self._closed = False
         self._io.register(self._sock, self._on_frames)
 
+    def _alloc_msgid(self) -> int:
+        with self._id_lock:
+            msgid = self._next_id
+            self._next_id += 1
+        return msgid
+
     def _on_frames(self, frames) -> None:               # IO thread
+        t_recv = time.monotonic()
         # A malformed or unpicklable reply must fail ITS caller, not
         # kill the transport (which would hang every pending call).
         try:
@@ -500,10 +645,38 @@ class RpcClient:
         fut = self._pending.pop(msgid, None)            # GIL-atomic
         if fut is None:
             return
+        hops = getattr(fut, "_hops", None)
+        if hops is not None:
+            hops["reply_recv"] = t_recv
+            srv = (header or {}).get("_hops")
+            if isinstance(srv, dict):
+                hops.update(srv)
+        if isinstance(fut, concurrent.futures.Future):
+            # Sync-direct caller (call_direct_start): resolve ON the IO
+            # thread — a set_result wake is cheap, and skipping the loop
+            # handoff is the point of the fast path.  Error payloads
+            # unpickle on the CALLER's thread, never here.
+            if hops is not None:
+                hops["caller_wake"] = time.monotonic()
+                _publish_hop_trace(hops)
+            if ok:
+                fut.set_result(("ok", header or {}, frames[1:]))
+            else:
+                fut.set_result(
+                    ("err", frames[1] if len(frames) > 1 else b"", []))
+            return
         if ok:
             result = (header or {}, frames[1:])
-            self._poster.post(
-                lambda: fut.done() or fut.set_result(result))
+
+            def _resolve():
+                if fut.done():
+                    return
+                if hops is not None:
+                    hops["caller_loop_wake"] = time.monotonic()
+                    _publish_hop_trace(hops)
+                fut.set_result(result)
+
+            self._poster.post(_resolve)
         else:
             # Unpickle LOOP-side: reconstructing arbitrary exception
             # classes (imports, __setstate__) on the process-wide IO
@@ -530,19 +703,79 @@ class RpcClient:
     ) -> tuple[dict, Blobs]:
         if self._closed:
             raise ConnectionLost(self.address)
-        msgid = self._next_id
-        self._next_id += 1
+        msgid = self._alloc_msgid()
         fut: asyncio.Future = self._loop.create_future()
         fut._method = method
         self._pending[msgid] = fut
-        out = [msgpack.packb([msgid, method, header]), *(blobs or [])]
-        self._io.send(self._sock, out, copy=_send_flags(out))
+        hops = _consume_hop_arm(method) if _hop_armed is not None else None
+        if hops is not None:
+            hops["loop_call"] = time.monotonic()
+            fut._hops = hops
+            self._send_traced(msgid, method, dict(header or {}), hops,
+                              list(blobs or []))
+        else:
+            out = [msgpack.packb([msgid, method, header]),
+                   *(blobs or [])]
+            self._io.send(self._sock, out, copy=_send_flags(out))
         if timeout is None:
             return await fut
         try:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(msgid, None)
+
+    def _send_traced(self, msgid: int, method: str, header: dict,
+                     hops: dict, blobs: list) -> None:
+        """Traced request: the header packs ON the IO thread so the
+        io_send stamp is the moment the bytes actually go to zmq."""
+        header["_hops"] = hops
+
+        def _go(sock=self._sock):
+            hops["io_send"] = time.monotonic()
+            out = [msgpack.packb([msgid, method, header]), *blobs]
+            self._io._send_now(sock, out, _send_flags(out))
+
+        self._io.post(_go)
+
+    def call_direct_start(self, method: str, header: dict | None = None,
+                          blobs: Blobs | None = None
+                          ) -> concurrent.futures.Future:
+        """Loop-bypassing request from a NON-loop thread (the sync
+        fast path): the send posts straight to the IO thread and the
+        reply resolves the returned concurrent future ON the IO thread,
+        so a blocked caller wakes without any event-loop handoff.
+
+        The future resolves to ("ok", header, blobs) or ("err",
+        pickled (exc, tb), []); transport loss surfaces as a
+        ConnectionLost exception set by close().  A caller that stops
+        waiting (timeout) must LEAVE the msgid registered: the reply
+        still resolves this future, and downstream bookkeeping (the
+        worker's loop-side finalize) depends on consuming it."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        fut._method = method
+        # Closed-check + registration ATOMIC with close()'s drain (both
+        # under _id_lock): this entry point runs on arbitrary user
+        # threads, and an insert landing after close() snapshotted
+        # _pending would orphan the future forever (the loop-path call()
+        # never raced close because both run on the loop).
+        with self._id_lock:
+            if self._closed:
+                raise ConnectionLost(self.address)
+            msgid = self._next_id
+            self._next_id += 1
+            fut._rpc_msgid = msgid
+            self._pending[msgid] = fut
+        hops = _consume_hop_arm(method) if _hop_armed is not None else None
+        if hops is not None:
+            hops["caller_post"] = time.monotonic()
+            fut._hops = hops
+            self._send_traced(msgid, method, dict(header or {}), hops,
+                              list(blobs or []))
+        else:
+            out = [msgpack.packb([msgid, method, header]),
+                   *(blobs or [])]
+            self._io.send(self._sock, out, copy=_send_flags(out))
+        return fut
 
     async def notify(self, method: str, header: dict | None = None,
                      blobs: Blobs | None = None) -> None:
@@ -554,15 +787,37 @@ class RpcClient:
     def close(self) -> None:
         if self._closed:
             return
-        self._closed = True
-        pending = list(self._pending.values())
-        self._pending.clear()
+        with self._id_lock:
+            # Atomic with call_direct_start's closed-check+insert: no
+            # future can slip in after this drain.
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+
+        # Sync-direct futures (concurrent.futures) resolve from any
+        # thread — fail them NOW so a blocked caller thread wakes even
+        # if the loop is already gone; asyncio futures must resolve on
+        # their loop.
+        sync_pending: list = []
+        loop_pending: list = []
+        for f in pending:
+            (sync_pending if isinstance(f, concurrent.futures.Future)
+             else loop_pending).append(f)
+        for f in sync_pending:
+            try:
+                if not f.done():
+                    f.set_exception(ConnectionLost(self.address))
+            except Exception:  # noqa: BLE001 - resolution race
+                pass
 
         def _fail_all():
-            for fut in pending:
-                if not fut.done():
-                    fut.set_exception(ConnectionLost(self.address))
-        if pending:
+            for fut in loop_pending:
+                try:
+                    if not fut.done():
+                        fut.set_exception(ConnectionLost(self.address))
+                except Exception:  # noqa: BLE001 - resolution race
+                    pass
+        if loop_pending:
             self._poster.post(_fail_all)
         self._io.unregister(self._sock)
 

@@ -96,9 +96,18 @@ def install(role: str) -> None:
         tmp = path + ".reg"
         f = open(tmp, "w", buffering=1)   # noqa: SIM115 - held for life
         faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
-        f.write(f"# {role} pid={os.getpid()} usr2=1 "
-                f"argv={sys.argv[:3]}\n")
+    except (OSError, ValueError, AttributeError):
+        # No SIGUSR1 handler at all: stay invisible to collect() (the
+        # signal's default disposition is Term).
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return
 
+    usr2 = True
+    try:
         def _on_usr2(signum, frame):
             try:
                 _dump_asyncio_tasks(f)
@@ -106,14 +115,21 @@ def install(role: str) -> None:
                 pass
 
         signal.signal(signal.SIGUSR2, _on_usr2)
+    except (ValueError, OSError):
+        # signal.signal off the MAIN thread raises ValueError.  SIGUSR1
+        # (faulthandler.register works from any thread) is live, so
+        # still publish — just without the usr2 marker, and collect()
+        # will not send the unhandled (default-Term) SIGUSR2.
+        usr2 = False
+    try:
+        f.write(f"# {role} pid={os.getpid()} {'usr2=1 ' if usr2 else ''}"
+                f"argv={sys.argv[:3]}\n")
         os.replace(tmp, path)
-    except (OSError, ValueError, AttributeError):
-        # Non-main-thread registration / exotic platform: best effort.
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def collect(timeout_s: float = 3.0) -> str:

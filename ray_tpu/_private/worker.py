@@ -121,6 +121,20 @@ class _UntrackedRef(ObjectRef):
         pass
 
 
+class _SyncCall:
+    """In-flight fused sync actor call (ISSUE-1 fast path): the return-0
+    object id maps to this record so a get() right after the submit can
+    block on the reply future directly — resolved on the rpc IO thread,
+    no event-loop handoff on the caller's critical path."""
+
+    __slots__ = ("task", "cfut", "client")
+
+    def __init__(self, task, cfut, client):
+        self.task = task
+        self.cfut = cfut
+        self.client = client
+
+
 _EMPTY_ARGS_FRAMES: list | None = None
 
 
@@ -251,6 +265,16 @@ class CoreWorker:
         # remove_placement_group) still reaches the wire.
         self._nowait_tasks: set = set()
         self._post_mutex = threading.Lock()
+        # return-0 object id -> _SyncCall for fused sync actor calls
+        # (see _submit_actor_direct): entries are claimed by the first
+        # get() on the ref and always cleaned up by the loop-side
+        # finalize when the reply (or transport failure) lands.
+        self._sync_calls: dict[bytes, _SyncCall] = {}
+        # Fused-path counter (tests/bench assert the path engages) and
+        # kill switch (A/B debugging: RAY_TPU_SYNC_FASTPATH=0).
+        self._direct_sync_calls = 0
+        self._sync_fastpath = os.environ.get(
+            "RAY_TPU_SYNC_FASTPATH", "1") != "0"
 
     # ---------------------------------------------------------------- setup
     def start(self) -> None:
@@ -1019,6 +1043,16 @@ class CoreWorker:
     def _on_task_reply(self, task: PendingTask, reply: dict,
                        blobs: list[bytes]) -> None:
         status = reply.get("status")
+        if task.actor_state is not None and not (
+                status == "error" and task.retry_exceptions
+                and task.retries_left > 0):
+            # Terminal reply of an actor call: release its slot in the
+            # submitter's unacked count (gates the fused sync fast path).
+            # Exactly once — the direct path's IO-thread callback clears
+            # actor_state before this runs.
+            with task.actor_state.submit_lock:
+                task.actor_state.unacked -= 1
+            task.actor_state = None
         if status != "error" or not (task.retry_exceptions
                                      and task.retries_left > 0):
             # Terminal reply: drop submission borrow pins (retried tasks
@@ -1298,6 +1332,15 @@ class CoreWorker:
 
     def get_objects(self, refs: list[ObjectRef],
                     timeout: float | None = None) -> list[Any]:
+        if len(refs) == 1 and self._sync_calls:
+            # get-after-submit of a fused sync actor call: bind to the
+            # in-flight reply future and wake straight from the IO
+            # thread (the submit side already skipped the loop).
+            sc = self._sync_calls.pop(refs[0].binary(), None)
+            if sc is not None:
+                out = self._finish_sync_call(refs[0], sc, timeout)
+                if out is not CoreWorker._GET_MISS:
+                    return [out]
         out = self._get_objects_fast(refs, timeout)
         if out is not CoreWorker._GET_MISS:
             return out
@@ -1607,12 +1650,18 @@ class CoreWorker:
         async def _wait():
             try:
                 v = await self._get_one(ref, None)
+                if fut.done():
+                    return   # consumer cancelled/abandoned the future
                 if isinstance(v, BaseException):
                     fut.set_exception(_copy_error(v))
                 else:
                     fut.set_result(v)
             except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
+                try:
+                    if not fut.done():
+                        fut.set_exception(e)
+                except concurrent.futures.InvalidStateError:
+                    pass
 
         self.loop.call_soon_threadsafe(lambda: self.loop.create_task(_wait()))
         return fut
@@ -1773,6 +1822,9 @@ class CoreWorker:
         import pickle as _pickle
 
         rec = {"arg_contained": (), "svs": None, "err": None, "stored": ()}
+        hops = th.get("_hops")
+        if isinstance(hops, dict):
+            hops["exec_start"] = time.monotonic()
         prev = self.current_task_id
         prev_trace = self.current_trace
         prev_driver = self.current_driver_addr
@@ -1821,6 +1873,8 @@ class CoreWorker:
             self.current_bundle_key = prev_bundle
             self.current_resources = prev_res
             self.current_runtime_env = prev_renv
+            if isinstance(hops, dict):
+                hops["exec_end"] = time.monotonic()
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -2741,17 +2795,168 @@ class CoreWorker:
                 rec.local_refs += 1
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         max_task_retries = options.get("max_task_retries", 0)
+        st = self._actor_state(actor_id)
+        direct_cli = None
+        with st.submit_lock:
+            # Seqno at SUBMIT time (not loop time): submission order ==
+            # seqno order no matter which path carries the call, and the
+            # receiver's parking protocol handles any transport
+            # interleaving between the two paths.
+            header["seqno"] = st.seqno
+            st.seqno += 1
+            prior_unacked = st.unacked
+            st.unacked += 1
+            addr = st.address
+            if (self._sync_fastpath and prior_unacked == 0 and addr
+                    and not st.dead
+                    and not st.outbox and num_returns == 1
+                    and not options.get("streaming")
+                    and max_task_retries == 0
+                    and not borrowed and not header.get("arg_refs")
+                    and addr not in self._dead_worker_addrs):
+                # Sole in-flight call to a resolved live actor: eligible
+                # for the fused sync fast path.  Requires an EXISTING
+                # client (RpcClient construction is loop-bound).
+                cli = self.clients._clients.get(addr)
+                if cli is not None and not cli._closed:
+                    direct_cli = cli
+                    # In inflight_seqs BEFORE the lock releases: a
+                    # racing loop-path submit must compute a seq_floor
+                    # that includes this still-in-flight call, or the
+                    # receiver would fast-forward past it and execute
+                    # the two out of order.
+                    st.inflight_seqs.add(header["seqno"])
+        if direct_cli is not None:
+            # With unacked==0 every earlier seqno is terminally settled,
+            # so our own seqno is the correct floor.
+            header["seq_floor"] = header["seqno"]
+            if self._submit_actor_direct(st, direct_cli, header, blobs,
+                                         return_ids):
+                return refs
+            # Fallback: leave the seqno IN inflight_seqs — the loop
+            # path's _send_actor_batch re-adds it (idempotent) and its
+            # finally removes it; discarding here would reopen the
+            # floor window until the outbox drains.
 
         def _go():
             self.memory_entries_for(return_ids)
-            st = self._actor_state(actor_id)
-            header["seqno"] = st.seqno
-            st.seqno += 1
             self._push_actor_task(
                 st, header, blobs, return_ids, max_task_retries, borrowed)
 
         self._post_to_loop(_go)
         return refs
+
+    def _submit_actor_direct(self, st: ActorSubmitState, cli, header: dict,
+                             blobs: list, return_ids: list[bytes]) -> bool:
+        """Fused sync-path submit (the ISSUE-1 round-trip collapse): the
+        request posts straight to the rpc IO thread and the reply wakes a
+        blocked getter FROM the IO thread — the caller's critical path
+        crosses no event loop in either direction.  Owner bookkeeping
+        (_on_task_reply) still runs on the loop, posted off that path.
+        Returns False to fall back to the loop path (nothing sent)."""
+        task = PendingTask(
+            task_id=bytes.fromhex(header["task_id"]), header=header,
+            blobs=blobs, return_ids=return_ids, retries_left=0,
+            retry_exceptions=False, scheduling_key=(), borrowed=[],
+            actor_state=st)
+        addr = cli.address
+        try:
+            cfut = cli.call_direct_start("actor_call", header, blobs)
+        except Exception:  # noqa: BLE001 - client raced closed: loop path
+            return False
+        self.memory_entries_for(return_ids)     # thread-safe store
+        rid0 = return_ids[0]
+        self._sync_calls[rid0] = _SyncCall(task, cfut, cli)
+        self._direct_sync_calls += 1
+
+        def _on_reply(f):
+            # Resolving thread (IO thread, or close()): keep it tiny —
+            # release the unacked slot NOW so the next sync call can
+            # take the fast path before the loop finalize runs, then
+            # post the real bookkeeping to the loop.
+            if task.actor_state is not None:
+                with st.submit_lock:
+                    st.unacked -= 1
+                    st.inflight_seqs.discard(header.get("seqno", 0))
+                task.actor_state = None
+            try:
+                self._post_to_loop(
+                    lambda: self._finalize_direct(task, st, f, rid0, addr))
+            except RuntimeError:
+                pass        # shutdown: nothing left to bookkeep
+
+        cfut.add_done_callback(_on_reply)
+        return True
+
+    def _finalize_direct(self, task: PendingTask, st: ActorSubmitState,
+                         cfut, rid0: bytes, addr: str) -> None:
+        """Loop-side completion of a direct-path actor call: fills the
+        owner record exactly like the loop path would, so every other
+        resolution surface (entry events, wait(), borrowers) observes
+        the same outcome."""
+        self._sync_calls.pop(rid0, None)
+        try:
+            kind, a, b = cfut.result()
+        except Exception as e:  # noqa: BLE001 - transport loss
+            if st.address == addr:
+                st.address = None
+            self._fail_actor_call(task, ActorError(
+                st.actor_id, f"actor worker connection lost: {e}"))
+            return
+        if kind == "ok":
+            self._on_task_reply(task, a, b)
+            return
+        # Remote handler raised (the transport-level error reply): the
+        # at-most-once discipline of the loop path applies.
+        import pickle
+
+        try:
+            exc, _tb = pickle.loads(a)
+        except Exception:  # noqa: BLE001 - unpicklable remote error
+            exc = RemoteError("actor_call", "remote failure")
+        self._fail_actor_call(
+            task, ActorError(st.actor_id, f"actor call failed: {exc!r}"))
+
+    def _finish_sync_call(self, ref: ObjectRef, sc: _SyncCall,
+                          timeout: float | None):
+        """User-thread wait of a fused sync actor call: block on the
+        reply future directly.  Anything non-trivial (errors, multi/
+        stored/ref-bearing returns, transport loss, slow replies) hands
+        off to the normal resolution paths via _GET_MISS — the loop-side
+        finalize fills the owner record regardless of this wait."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            wait_s = 5.0 if deadline is None else \
+                min(5.0, max(0.0, deadline - time.monotonic()))
+            try:
+                kind, a, b = sc.cfut.result(wait_s)
+                break
+            except concurrent.futures.TimeoutError:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {ref.hex()[:12]}")
+                if deadline is None:
+                    # Same discipline as _get_objects_fast: never wait
+                    # unbounded on one event source — the async path has
+                    # the death-event and watchdog machinery.
+                    return CoreWorker._GET_MISS
+            except Exception:  # noqa: BLE001 - transport loss
+                return CoreWorker._GET_MISS
+        if kind != "ok" or a.get("status") != "ok":
+            return CoreWorker._GET_MISS      # errors flow via the record
+        returns = a.get("returns") or []
+        if len(returns) != 1:
+            return CoreWorker._GET_MISS
+        meta = returns[0]
+        if (not meta.get("inline") or meta.get("dynamic") is not None
+                or meta.get("contained")):
+            return CoreWorker._GET_MISS
+        value, contained = deserialize_with_refs(
+            b[:meta.get("nframes", len(b))])
+        if contained:
+            return CoreWorker._GET_MISS      # borrow registration: loop
+        return value
 
     def _push_actor_task(self, st: ActorSubmitState, header: dict,
                          blobs: list, return_ids: list[bytes],
@@ -2761,7 +2966,7 @@ class CoreWorker:
             task_id=bytes.fromhex(header["task_id"]), header=header,
             blobs=blobs, return_ids=return_ids, retries_left=0,
             retry_exceptions=False, scheduling_key=(),
-            borrowed=borrowed or [])
+            borrowed=borrowed or [], actor_state=st)
         # Coalescing outbox: one drainer per actor sends queued calls in
         # seqno order, many per RPC when the queue is deep (per-message
         # overhead is the 1:1 actor-call throughput cost); a lone call
@@ -2806,6 +3011,10 @@ class CoreWorker:
 
     def _fail_actor_call(self, task: PendingTask,
                          err: BaseException) -> None:
+        if task.actor_state is not None:
+            with task.actor_state.submit_lock:
+                task.actor_state.unacked -= 1
+            task.actor_state = None
         for rid in task.return_ids:
             self._resolve_error(rid, err)
         self._release_task_borrows(task)
@@ -2815,11 +3024,17 @@ class CoreWorker:
         """Deliver one batch (retrying per-call budgets on connection
         loss); returns once every call has a reply or a terminal error."""
         seqs = [t.header.get("seqno", 0) for t, _ in batch]
-        st.inflight_seqs.update(seqs)
+        with st.submit_lock:
+            # inflight_seqs is shared with the fused direct path (adds
+            # from user threads, removes from the IO thread) — every
+            # multi-element mutation and the floor's min() iterate it
+            # under the submit lock.
+            st.inflight_seqs.update(seqs)
         try:
             await self._send_actor_batch_inner(st, batch)
         finally:
-            st.inflight_seqs.difference_update(seqs)
+            with st.submit_lock:
+                st.inflight_seqs.difference_update(seqs)
 
     async def _send_actor_batch_inner(self, st: ActorSubmitState,
                                       batch: list) -> None:
@@ -2865,7 +3080,8 @@ class CoreWorker:
             # calls).  Without it, a reordered FIRST batch (socket
             # recreate mid-burst) set the baseline at its own seqnos and
             # earlier calls were executed as if they were stale retries.
-            floor = min(st.inflight_seqs) if st.inflight_seqs else 0
+            with st.submit_lock:
+                floor = min(st.inflight_seqs) if st.inflight_seqs else 0
             for t, _ in batch:
                 t.header["seq_floor"] = floor
             try:

@@ -47,6 +47,15 @@ class ClientContext:
         self.proxy_addr = proxy_addr
         self.namespace = namespace
         self.op_timeout = op_timeout
+        # Deferred lone actor-call submission — the sync-fusion window
+        # (ISSUE-1 client collapse): a .remote() parks here instead of
+        # going to the wire; a get() on exactly its refs turns the pair
+        # into ONE call_and_wait op (submit-RT + get-RT -> one RT).  Any
+        # other API op flushes it first (order preserved: every send
+        # happens under _def_lock), and a timer flushes a lone
+        # fire-and-forget call after ~2ms.
+        self._def_lock = threading.Lock()
+        self._deferred: tuple | None = None   # (header, blobs, ref_ids)
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, daemon=True,
@@ -77,20 +86,50 @@ class ClientContext:
         return self._run(self._cli.call(method, header, blobs or [],
                                         timeout=timeout))
 
-    def _req(self, op: str, header: dict, blobs: list | None = None,
-             timeout: float | None = None):
-        """One API op, relayed through the proxy to this client's host.
-        Remote exceptions unwrap to their original cause."""
-        from ray_tpu._private.rpc import RemoteError
+    # ------------------------------------------- deferred-submission window
+    def _flush_deferred(self) -> None:
+        with self._def_lock:
+            d, self._deferred = self._deferred, None
+            if d is not None:
+                header, blobs, ids = d
+                self._send_pipelined_locked("actor_call", header, blobs,
+                                            ids)
 
+    def _schedule_flush(self) -> None:
+        """Safety-net timer: a lone fire-and-forget .remote() that is
+        never followed by another API op still reaches the wire."""
+        def _arm():
+            self._loop.call_later(0.002, self._flush_deferred)
+        try:
+            self._loop.call_soon_threadsafe(_arm)
+        except RuntimeError:
+            self._flush_deferred()
+
+    def _start_req(self, op: str, header: dict,
+                   blobs: list | None = None,
+                   timeout: float | None = None):
+        """Schedule one API op WITHOUT waiting (returns the concurrent
+        future).  Safe under _def_lock — scheduling is nonblocking, and
+        doing it there is how the fused get keeps its send ordered
+        against the deferral window."""
         if timeout is None:
             timeout = self.op_timeout
-        try:
-            return self._call_proxy(
+        return asyncio.run_coroutine_threadsafe(
+            self._cli.call(
                 "client_req",
                 {"client_id": self.client_id, "op": op, "header": header,
                  "timeout": timeout},
-                blobs, timeout=timeout + 30.0)
+                blobs or [], timeout=timeout + 30.0),
+            self._loop)
+
+    @staticmethod
+    def _wait_req(cfut):
+        """Block on a _start_req future; remote exceptions unwrap to
+        their original cause."""
+        from ray_tpu._private.rpc import RemoteError
+
+        try:
+            return cfut.result()
         except RemoteError as e:
             cause = e.cause
             while isinstance(cause, RemoteError):
@@ -98,6 +137,13 @@ class ClientContext:
             if isinstance(cause, BaseException):
                 raise cause from None
             raise
+
+    def _req(self, op: str, header: dict, blobs: list | None = None,
+             timeout: float | None = None):
+        """One API op, relayed through the proxy to this client's host.
+        Remote exceptions unwrap to their original cause."""
+        self._flush_deferred()
+        return self._wait_req(self._start_req(op, header, blobs, timeout))
 
     def _req_pipelined(self, op: str, header: dict,
                        blobs: list | None = None,
@@ -109,6 +155,14 @@ class ClientContext:
         them.  Host-side submission errors are delivered through the
         refs; a TRANSPORT failure is recorded under the assigned `ids`
         and raised from the next API call that touches them."""
+        self._flush_deferred()
+        self._send_pipelined_locked(op, header, blobs, ids)
+
+    def _send_pipelined_locked(self, op: str, header: dict,
+                               blobs: list | None = None,
+                               ids: Sequence[str] = ()) -> None:
+        """The raw pipelined send (safe to call while holding _def_lock:
+        it only schedules a coroutine, never blocks)."""
         async def _go():
             try:
                 await self._cli.call(
@@ -142,9 +196,31 @@ class ClientContext:
         ref_list = [refs] if single else list(refs)
         import pickle
 
-        self._check_pipeline_errors([r.hex for r in ref_list])
+        hexes = [r.hex for r in ref_list]
+        cfut = None
+        with self._def_lock:
+            if self._deferred is not None and self._deferred[2] == hexes:
+                # get-after-submit of the deferred call: fuse the pair
+                # into ONE call_and_wait op (the whole point of the
+                # deferral window).  Scheduled UNDER the lock so no
+                # other thread's submission can slip onto the wire
+                # between the pop and this send (send order must stay
+                # submission order for the host's per-actor sequencer).
+                header, payload, _ids = self._deferred
+                self._deferred = None
+                op_t = self.op_timeout if timeout is None \
+                    else timeout + 30.0
+                cfut = self._start_req(
+                    "call_and_wait", {**header, "timeout": timeout},
+                    payload, timeout=op_t)
+        if cfut is not None:
+            reply, blobs = self._wait_req(cfut)
+            values = [self._decode_value(v)
+                      for v in pickle.loads(blobs[0])]
+            return values[0] if single else values
+        self._check_pipeline_errors(hexes)
         reply, blobs = self._req(
-            "get", {"refs": [r.hex for r in ref_list], "timeout": timeout})
+            "get", {"refs": hexes, "timeout": timeout})
         values = [self._decode_value(v) for v in pickle.loads(blobs[0])]
         return values[0] if single else values
 
@@ -202,11 +278,19 @@ class ClientContext:
                    kwargs: dict, opts: dict):
         self._check_pipeline_errors([actor_id])
         ref_ids = self._new_ref_ids(opts)
-        self._req_pipelined(
-            "actor_call",
-            {"actor_id": actor_id, "method": method,
-             "opts": _plain_opts(opts), "ref_ids": ref_ids},
-            [_cloudpickle_dumps((args, kwargs))], ids=ref_ids)
+        header = {"actor_id": actor_id, "method": method,
+                  "opts": _plain_opts(opts), "ref_ids": ref_ids}
+        payload = [_cloudpickle_dumps((args, kwargs))]
+        with self._def_lock:
+            # Park this submission in the fusion window; flush whatever
+            # was parked before (send order == submission order — both
+            # happen under this lock).
+            prev, self._deferred = self._deferred, (header, payload,
+                                                    ref_ids)
+            if prev is not None:
+                ph, pb, pids = prev
+                self._send_pipelined_locked("actor_call", ph, pb, pids)
+        self._schedule_flush()
         refs = [ClientObjectRef(x, self) for x in ref_ids]
         return refs[0] if len(refs) == 1 else refs
 
@@ -301,12 +385,25 @@ class ClientContext:
     def _release(self, ref_hexes: list[str]) -> None:
         for h in ref_hexes:
             self._pipeline_errors.pop(h, None)
-        self._fire_and_forget("release", {"refs": ref_hexes})
+        # Flush-then-release as ONE loop callback: a release overtaking
+        # a still-parked submission that owns the ref would make the
+        # host pin it forever (the flushed call re-registers the id the
+        # release already popped).  __del__ can run on any thread, so
+        # the blocking-flush + send pair moves to the loop, where the
+        # ordering is guaranteed regardless of who holds _def_lock.
+        def _go():
+            self._flush_deferred()
+            self._fire_and_forget("release", {"refs": ref_hexes})
+        try:
+            self._loop.call_soon_threadsafe(_go)
+        except RuntimeError:
+            pass    # loop stopped at teardown: nothing to release
 
     def disconnect(self) -> None:
         global _ctx
         if self._closed:
             return
+        self._flush_deferred()
         self._closed = True
         try:
             self._call_proxy("client_disconnect",

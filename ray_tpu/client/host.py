@@ -294,13 +294,19 @@ class ClientHost:
             return {}
         return {"actor_id": handle.actor_id}
 
-    async def rpc_actor_call(self, h: dict, blobs: list):
-        # Sync prefix: ticket + placeholders BEFORE any await.
+    async def _submit_actor_call(self, h: dict, blobs: list):
+        """THE submit discipline shared by rpc_actor_call and
+        rpc_call_and_wait: ticket + placeholders in the synchronous
+        prefix (before any await), args/actor/opts resolution, then
+        submit AT OUR TURN on the loop (.remote() is nonblocking:
+        thread-pool completion order must not reorder actor calls).
+        Returns (refs, pends, err); pends are already filled (with the
+        refs, or the error) — the caller picks its error policy."""
         seq = self._actor_seq.setdefault(h["actor_id"],
                                          _SubmitSequencer())
         ticket = seq.take()
         pends = self._register_pending(h.get("ref_ids") or [])
-        err = method = args = kwargs = None
+        err = method = args = kwargs = refs = None
         try:
             args, kwargs = await asyncio.to_thread(
                 self._loads, blobs[0])
@@ -310,8 +316,6 @@ class ClientHost:
                 method = method.options(**self._decode_opts(h["opts"]))
         except BaseException as e:  # noqa: BLE001
             err = e
-        # Submit AT OUR TURN, on the loop (.remote() is nonblocking):
-        # thread-pool completion order must not reorder actor calls.
         await seq.turn(ticket)
         try:
             if err is None:
@@ -321,15 +325,57 @@ class ClientHost:
             err = e
         finally:
             seq.done(ticket)
+        if pends:
+            self._fill_pending(
+                pends, [err] * len(pends) if err is not None else refs)
+        return refs, pends, err
+
+    async def rpc_actor_call(self, h: dict, blobs: list):
+        refs, pends, err = await self._submit_actor_call(h, blobs)
         if err is not None:
             if pends:
-                self._fill_pending(pends, [err] * len(pends))
-                return {}
+                return {}   # pipelined: the error travels via the refs
             raise err
         if pends:
-            self._fill_pending(pends, refs)
             return {}
         return {"refs": [self._pin(r) for r in refs]}
+
+    async def rpc_call_and_wait(self, h: dict, blobs: list):
+        """Fused sync actor call (the client-mode round-trip collapse):
+        submit AND await the result in ONE proxy round trip, instead of
+        a pipelined actor_call op followed by a separate get op.  The
+        real refs are still pinned under the client-assigned ref_ids —
+        the client holds ClientObjectRefs it may get again, ship as task
+        args, or release — so everything downstream behaves exactly as
+        if the two-op path had run."""
+        from ray_tpu.client.common import ClientDynRefs
+        from ray_tpu.exceptions import GetTimeoutError
+        from ray_tpu.object_ref import ObjectRefGenerator
+
+        refs, _pends, err = await self._submit_actor_call(h, blobs)
+        if err is not None:
+            # Fused caller is blocked on THIS op: raise now (the filled
+            # pends still serve any later get on the same refs).
+            raise err
+        futs = [asyncio.wrap_future(r.future()) for r in refs]
+        timeout = h.get("timeout")
+        # Shield: a timeout must NOT cancel the underlying ref futures
+        # (the value still arrives and serves the client's retry get);
+        # the abandoned gather keeps running, its eventual exception
+        # consumed so the loop stays quiet.
+        gathered = asyncio.ensure_future(asyncio.gather(*futs))
+        gathered.add_done_callback(
+            lambda t: t.cancelled() or t.exception())
+        try:
+            values = await asyncio.wait_for(asyncio.shield(gathered),
+                                            timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(
+                f"call_and_wait timed out after {timeout}s") from None
+        values = [ClientDynRefs([self._pin(r) for r in v])
+                  if isinstance(v, ObjectRefGenerator) else v
+                  for v in values]
+        return {}, [self._dumps(values)]
 
     async def rpc_get_actor(self, h: dict, blobs: list):
         pending = self._pending_names.get(h["name"])

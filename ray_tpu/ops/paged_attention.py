@@ -39,6 +39,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu._private.jax_compat import install as _jax_compat
+
+_jax_compat()
+
 NEG_INF = -1e30
 
 

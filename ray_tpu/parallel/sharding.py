@@ -12,6 +12,10 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private.jax_compat import install as _jax_compat
+
+_jax_compat()
+
 # logical axis -> mesh axes (None = replicated).
 # fsdp shards the *largest* param axis; tensor shards the Megatron axis.
 LOGICAL_RULES: dict[str, tuple | str | None] = {

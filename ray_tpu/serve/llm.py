@@ -271,6 +271,16 @@ class LLMEngine:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # Submissions currently between submit() entry and the queue
+        # put, plus the entry stamp of the newest one: _admit skips its
+        # burst-coalescing grace when the queue is empty, nobody is
+        # mid-submit, and nothing was submitted after the requests it
+        # already holds — a lone request must never linger the grace
+        # window ("idle requests never wait"), while a burst still
+        # coalesces.
+        self._inflight_lock = threading.Lock()
+        self._inflight_submits = 0
+        self._last_submit_t = 0.0
         self.completed = 0
 
     # ------------------------------------------------------------- public
@@ -301,11 +311,18 @@ class LLMEngine:
             raise RuntimeError(
                 "LLM engine is dead after an earlier failure") \
                 from self._error
-        req = _Request(list(prompt), max_new_tokens, temperature, eos_id,
-                       concurrent.futures.Future(),
-                       token_queue=token_queue)
-        self._waiting.put(req)
-        self._wake.set()
+        with self._inflight_lock:
+            self._inflight_submits += 1
+            self._last_submit_t = time.perf_counter()
+        try:
+            req = _Request(list(prompt), max_new_tokens, temperature,
+                           eos_id, concurrent.futures.Future(),
+                           token_queue=token_queue)
+            self._waiting.put(req)
+            self._wake.set()
+        finally:
+            with self._inflight_lock:
+                self._inflight_submits -= 1
         return req.future
 
     def generate(self, prompt: list[int], max_new_tokens: int = 32,
@@ -374,6 +391,18 @@ class LLMEngine:
                     if not wave:
                         break
                     if grace_deadline is None:
+                        with self._inflight_lock:
+                            busy = self._inflight_submits > 0
+                            last_t = self._last_submit_t
+                        if not busy and last_t <= max(
+                                r.submitted_at for _, r in wave):
+                            # Lone request(s): nobody is mid-submit and
+                            # nothing arrived after the requests already
+                            # in hand — launch NOW instead of lingering
+                            # the full grace ("idle requests never
+                            # wait"); bursts still coalesce because a
+                            # racing submit moves _last_submit_t.
+                            break
                         grace_deadline = time.perf_counter() + 0.005
                     rem = grace_deadline - time.perf_counter()
                     if rem <= 0:

@@ -9,19 +9,21 @@ CPU platform so multi-chip sharding logic runs on one machine
 import os
 
 # 8 virtual CPU devices stand in for an 8-chip slice in all sharding tests.
-# The env-var route (JAX_PLATFORMS/XLA_FLAGS) does NOT work here: the
-# machine's sitecustomize imports jax at interpreter startup, so only
-# jax.config.update takes effect.
+# The env-var-at-launch route (JAX_PLATFORMS/XLA_FLAGS) does NOT work
+# here: the machine's sitecustomize imports jax at interpreter startup,
+# so the switch must happen post-import.  jax.config is the first
+# choice; jax builds without the `jax_num_cpu_devices` option (this
+# image's 0.4.x graft) take the XLA_FLAGS fallback — the CPU backend
+# reads XLA_FLAGS at INITIALIZATION, which has not happened yet at
+# conftest import.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-import jax  # noqa: E402
+import jax  # noqa: E402,F401 - imported before any backend init
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-except RuntimeError:
-    # A backend already initialized (e.g. plugin imported jax first);
-    # tests then run on whatever devices exist.
-    pass
+from ray_tpu._private.config import ensure_cpu_devices  # noqa: E402
+from ray_tpu._private.jax_compat import install as _jax_compat  # noqa: E402
+
+ensure_cpu_devices(8)
+_jax_compat()
 
 import pytest  # noqa: E402
 

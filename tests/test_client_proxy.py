@@ -189,6 +189,59 @@ def test_client_proxy_pg_and_generators(ray_shared):
         proc.wait(timeout=10)
 
 
+def test_client_sync_call_fusion(ray_shared):
+    """A get() right after an actor .remote() collapses into ONE
+    call_and_wait op through the proxy (ISSUE-1 client collapse) — same
+    values, same errors; calls that are never gotten still reach the
+    wire (flushed by the next op or the safety timer) in order."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.client import ClientContext
+
+    controller = worker_mod._global_worker.controller_addr
+    proc, addr = _spawn_proxy(controller)
+    c = None
+    try:
+        c = ClientContext(addr, namespace="nsfuse")
+
+        class Counter:
+            def __init__(self):
+                self.v = 0
+
+            def incr(self, by=1):
+                self.v += by
+                return self.v
+
+            def boom(self):
+                raise ValueError("kapow")
+
+        h = c.create_actor(Counter, (), {}, {})
+        for i in range(1, 11):
+            assert c.get(h.incr.remote()) == i          # fused op
+        # Fire-and-forget (flushed by the next op) keeps its order.
+        h.incr.remote(10)
+        assert c.get(h.incr.remote()) == 21
+        # Error parity through the fused verb.
+        with pytest.raises(Exception, match="kapow"):
+            c.get(h.boom.remote())
+        assert c.get(h.incr.remote()) == 22
+        # A lone fire-and-forget reaches the wire via the flush timer.
+        h.incr.remote(100)
+        time.sleep(0.3)
+        assert c.get(h.incr.remote()) == 123
+        # A fused-window ref shipped as a task arg still resolves.
+        r = h.incr.remote()
+
+        def plus(x):
+            return x + 1
+
+        assert c.get(c.submit_function(plus, (r,), {}, {})) == 125
+    finally:
+        if c is not None:
+            c.disconnect()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_client_pipelined_submissions(ray_shared):
     """.remote() through the client does NOT wait on the proxy round
     trip (ray: the client worker streams submissions over its data

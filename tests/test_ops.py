@@ -100,6 +100,11 @@ class TestFlashAttention:
         assert 0 < n_flash < n_nothing, (n_flash, n_nothing)
 
 
+@pytest.mark.skipif(
+    __import__("ray_tpu._private.jax_compat",
+               fromlist=["is_legacy"]).is_legacy(),
+    reason="legacy jax: shard_map+ppermute over a partial-auto mesh "
+    "hard-aborts the CPU backend's SPMD compile (AllReduce promotion)")
 class TestRingAttention:
     @pytest.fixture
     def mesh(self):

@@ -76,11 +76,9 @@ def test_resnet_dp_sharded_step(small):
     DDP-image-training layout, GSPMD edition)."""
     import jax
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 4)
-    except RuntimeError:
-        pass
+    from ray_tpu._private.config import ensure_cpu_devices
+
+    ensure_cpu_devices(4)
     if len(jax.devices()) < 4:
         pytest.skip("needs 4 virtual devices")
     import jax.numpy as jnp
