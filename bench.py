@@ -301,12 +301,22 @@ def bench_control_plane(out: dict) -> None:
         section("small_xproc", 90, _small_xproc)
 
         def _big_putget():
+            from ray_tpu._private import profiling
+
             big = np.random.randint(0, 255, 256 * 1024 * 1024,
                                     np.uint8)   # 256 MiB host array
             t0 = time.perf_counter()
-            ref = ray_tpu.put(big)
+            with profiling.put_trace() as put_rec:
+                ref = ray_tpu.put(big)
             dt = time.perf_counter() - t0
             out["put_gib_per_s"] = rnd(big.nbytes / dt / (1 << 30))
+            # Where the put's time went (serialize/alloc/copy/seal/owner
+            # bookkeeping) — the stage table the streaming-write work is
+            # judged by (ISSUE 2; same discipline as
+            # sync_hop_breakdown_us).
+            breakdown = profiling.put_breakdown_us(put_rec)
+            if breakdown:
+                out["put_stage_breakdown_us"] = breakdown
             nbytes = big.nbytes
             del big
             t0 = time.perf_counter()
@@ -443,9 +453,14 @@ big = np.zeros(64 * 1024 * 1024, np.uint8)
 t1 = time.perf_counter()
 ref = ray_tpu.put(big)
 put_dt = time.perf_counter() - t1
+from ray_tpu._private import profiling
+st = profiling.put_stats()
 print(json.dumps({{"tasks_per_s": {n_tasks}/dt,
                    "startup_s": startup_s,
-                   "put_gib_per_s": big.nbytes/put_dt/(1<<30)}}),
+                   "put_gib_per_s": big.nbytes/put_dt/(1<<30),
+                   "arena_direct": bool(st["arena_puts"]
+                                        and not st["rpc_fallback_puts"]),
+                   "fallback_cause": st["first_fallback_cause"]}}),
       flush=True)
 ray_tpu.shutdown()
 import os; os._exit(0)
@@ -484,7 +499,88 @@ import os; os._exit(0)
                 max(r["startup_s"] for r in results), 2)
             out["multi_client_put_gib_per_s"] = round(
                 sum(r["put_gib_per_s"] for r in results), 2)
+            # Per-client attribution: a low summed figure must be
+            # distinguishable as "clients fell back to the store_put RPC"
+            # (arena_direct False + cause) vs "copies are genuinely
+            # bandwidth-bound" (ISSUE 2 multi-writer diagnosis).
+            out["multi_client_put_clients"] = [
+                {"gib_per_s": round(r["put_gib_per_s"], 2),
+                 "arena_direct": r.get("arena_direct"),
+                 **({"fallback_cause": r["fallback_cause"]}
+                    if r.get("fallback_cause") else {})}
+                for r in results]
             out["multi_client_n"] = n_clients
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_put_path() -> dict:
+    """Same-run A/B of the arena write path (ISSUE 2): one fresh driver
+    puts 256 MiB with the streaming kernel / parallel writer / free-space
+    prefault KILLED, a second with the defaults.  Fresh processes per
+    leg because the prefault is per-process one-shot state — an
+    in-process toggle could not un-prefault.  Sequential legs against
+    one cluster, each into a fresh arena region; relative same-box
+    comparison per CLAUDE.md (absolute numbers swing 3x hour-to-hour)."""
+    import os
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    # Arena large enough for both legs' 256 MiB bundles plus slack.
+    ray_tpu.init(resources={"CPU": 8},
+                 object_store_memory=1536 * 1024 * 1024)
+    out = {}
+    try:
+        addr = global_worker().controller_addr
+        repo_dir = os.path.abspath(os.path.dirname(__file__) or ".")
+        script = f"""
+import sys, time, json
+sys.path.insert(0, {repo_dir!r})
+import ray_tpu
+from ray_tpu._private import profiling
+ray_tpu.init(address={addr!r})
+import numpy as np
+big = np.random.randint(0, 255, 256 * 1024 * 1024, np.uint8)
+time.sleep(1.0)          # let the arena-warm thread finish its prefault
+with profiling.put_trace() as rec:
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(big)
+    dt = time.perf_counter() - t0
+st = profiling.put_stats()
+print(json.dumps({{"gib_per_s": big.nbytes/dt/(1<<30),
+                   "breakdown": profiling.put_breakdown_us(rec),
+                   "arena_direct": bool(st["arena_puts"]
+                                        and not st["rpc_fallback_puts"])}}),
+      flush=True)
+ray_tpu.shutdown()
+import os; os._exit(0)
+"""
+        legs = {
+            "off": {"RAY_TPU_PUT_STREAM": "0", "RAY_TPU_PUT_PARALLEL": "0",
+                    "RAY_TPU_ARENA_PREFAULT": "0"},
+            "on": {},
+        }
+        ab = {}
+        for name, env_extra in legs.items():
+            env = {**os.environ, **env_extra}
+            proc = subprocess.run([sys.executable, "-c", script],
+                                  capture_output=True, text=True,
+                                  timeout=120, env=env)
+            line = proc.stdout.strip().splitlines()[-1] if \
+                proc.stdout.strip() else "{}"
+            try:
+                ab[name] = json.loads(line)
+            except json.JSONDecodeError:
+                ab[name] = {"error": proc.stderr[-500:]}
+        out["put_path_ab"] = ab
+        off_v = (ab.get("off") or {}).get("gib_per_s")
+        on_v = (ab.get("on") or {}).get("gib_per_s")
+        if off_v and on_v:
+            out["put_path_ab_ratio"] = round(on_v / off_v, 2)
     finally:
         ray_tpu.shutdown()
     return out
@@ -903,6 +999,11 @@ def main() -> None:
         extra.update(_with_timeout(bench_ray_client, 300))
     except Exception as e:  # noqa: BLE001
         extra["ray_client_error"] = repr(e)
+    _flush_partial(extra)
+    try:
+        extra.update(_with_timeout(bench_put_path, 300))
+    except Exception as e:  # noqa: BLE001
+        extra["put_path_error"] = repr(e)
     _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_compiled_dag, 300))

@@ -32,6 +32,10 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
+
 namespace {
 
 constexpr uint64_t kMagic = 0x7261795f74707532ULL;  // "ray_tpu2"
@@ -134,6 +138,31 @@ IndexEntry* find_slot(ArenaHeader* hdr, const uint8_t* id, bool for_insert) {
     if (std::memcmp(e->id, id, 16) == 0) return e;
   }
   return for_insert ? first_tomb : nullptr;
+}
+
+// Single-pass variant for the alloc path: one probe run yields BOTH the
+// existing entry (if any) and the slot an insert would take.  rt_store_alloc
+// used to probe twice (existence check, then insert) with the arena mutex
+// held throughout — under concurrent writers the second pass is pure
+// critical-section padding.
+IndexEntry* find_slot_for_alloc(ArenaHeader* hdr, const uint8_t* id,
+                                IndexEntry** existing) {
+  uint32_t start = hash_id(id) & (kIndexSlots - 1);
+  IndexEntry* first_tomb = nullptr;
+  *existing = nullptr;
+  for (uint32_t probe = 0; probe < kIndexSlots; probe++) {
+    IndexEntry* e = &hdr->index[(start + probe) & (kIndexSlots - 1)];
+    if (e->state == 0) return first_tomb ? first_tomb : e;
+    if (e->state == 3) {
+      if (!first_tomb) first_tomb = e;
+      continue;
+    }
+    if (std::memcmp(e->id, id, 16) == 0) {
+      *existing = e;
+      return first_tomb ? first_tomb : e;
+    }
+  }
+  return first_tomb;
 }
 
 BlockHeader* block_at(Handle* h, uint64_t off) {
@@ -375,6 +404,55 @@ MutexGuard::MutexGuard(Handle* h) : m_(&h->hdr->mutex) {
   }
 }
 
+// ---------------------------------------------------------- streaming copy
+// Non-temporal copy: a bulk write with regular stores first READS every
+// destination cache line it is about to overwrite (write-allocate), so a
+// 256 MiB put moves 2x the bytes through the cache hierarchy and evicts
+// everything else.  movnt bypasses the cache entirely.  Only worth it when
+// the destination cannot plausibly be re-read from cache (frames larger
+// than the LLC share): below kStreamMin memcpy wins, and glibc's own
+// large-copy NT path takes over at sizes it knows about — this kernel
+// guarantees the behavior regardless of libc tuning.
+constexpr uint64_t kStreamMin = 256 * 1024;
+
+#if defined(__SSE2__)
+bool stream_available() {
+#if defined(__x86_64__)
+  return true;  // SSE2 is baseline on x86-64
+#else
+  return __builtin_cpu_supports("sse2");
+#endif
+}
+
+void stream_copy(uint8_t* dst, const uint8_t* src, uint64_t n) {
+  // Head: memcpy until dst is 16-byte aligned (movntdq requires it).
+  uint64_t head = (16 - (reinterpret_cast<uintptr_t>(dst) & 15)) & 15;
+  if (head > n) head = n;
+  if (head) { std::memcpy(dst, src, head); dst += head; src += head; n -= head; }
+  uint64_t vecs = n / 16;
+  __m128i* d = reinterpret_cast<__m128i*>(dst);
+  if ((reinterpret_cast<uintptr_t>(src) & 15) == 0) {
+    const __m128i* s = reinterpret_cast<const __m128i*>(src);
+    for (uint64_t i = 0; i < vecs; i++) _mm_stream_si128(d + i, _mm_load_si128(s + i));
+  } else {
+    const __m128i* s = reinterpret_cast<const __m128i*>(src);
+    for (uint64_t i = 0; i < vecs; i++) _mm_stream_si128(d + i, _mm_loadu_si128(s + i));
+  }
+  // NT stores are weakly ordered: fence BEFORE returning so the caller's
+  // subsequent seal (mutex-guarded state flip other processes read) can
+  // never publish an object whose bytes are still in write-combining
+  // buffers.
+  _mm_sfence();
+  uint64_t tail = n & 15;
+  if (tail) std::memcpy(dst + vecs * 16, src + vecs * 16, tail);
+}
+#else
+bool stream_available() { return false; }
+void stream_copy(uint8_t* dst, const uint8_t* src, uint64_t n) {
+  std::memcpy(dst, src, n);
+}
+#endif
+
 }  // namespace
 
 extern "C" {
@@ -462,8 +540,10 @@ void* rt_store_open(const char* name) {
 uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
   Handle* h = static_cast<Handle*>(hv);
   MutexGuard g(h);
-  IndexEntry* existing = find_slot(h->hdr, id, false);
-  if (existing && existing->state != 3) return 0;  // already present
+  IndexEntry* existing = nullptr;
+  IndexEntry* e = find_slot_for_alloc(h->hdr, id, &existing);
+  if (existing) return 0;  // already present
+  if (!e) return 0;        // index full
   // No implicit eviction: every sealed object is referenced (owners
   // delete via store_delete when refs drop), so dropping one here would
   // lose data.  On full, the caller falls back to the agent, which
@@ -471,8 +551,6 @@ uint64_t rt_store_alloc(void* hv, const uint8_t* id, uint64_t size) {
   // reference's plasma → LocalObjectManager spill path.
   uint64_t off = alloc_block(h, size);
   if (off == 0) return 0;
-  IndexEntry* e = find_slot(h->hdr, id, true);
-  if (!e) { free_block(h, off); return 0; }
   std::memcpy(e->id, id, 16);
   e->offset = off;
   e->size = size;
@@ -505,6 +583,74 @@ int rt_store_seal(void* hv, const uint8_t* id) {
   e->state = 2;
   if (e->pins > 0) e->pins--;
   return 0;
+}
+
+// Copy `n` bytes from `src` into the arena at data offset `dst_off` —
+// the put/chunked-transfer write kernel.  Frames >= kStreamMin go through
+// non-temporal stores (runtime-selected; plain memcpy fallback on
+// non-SSE2 builds), smaller ones memcpy.  NO locking and NO bounds
+// metadata: callers write only into creating-state regions they own
+// (rt_store_alloc → write → rt_store_seal), exactly like writing through
+// rt_store_base directly.  GIL-free from ctypes, so a thread pool of
+// these calls writes disjoint chunks of one frame in parallel.
+void rt_store_write_stream(void* hv, uint64_t dst_off, const void* src,
+                           uint64_t n) {
+  Handle* h = static_cast<Handle*>(hv);
+  uint8_t* dst = h->base + dst_off;
+  if (n >= kStreamMin && stream_available()) {
+    stream_copy(dst, static_cast<const uint8_t*>(src), n);
+  } else {
+    std::memcpy(dst, src, n);
+  }
+}
+
+// 1 when the non-temporal path is compiled in and selected at runtime —
+// bench/tests attribute copy numbers to the right kernel.
+int rt_store_stream_mode(void) { return stream_available() ? 1 : 0; }
+
+// Write-prefault THIS process's page tables over the arena's free space.
+// On kernels without MADV_POPULATE_WRITE (< 5.14) map_prefaulted only
+// read-faults, so the first bulk write per process pays a write-protect
+// fault on every page — measured 2-2.6x off peak copy bandwidth on the
+// dev box.  A write prefault must not corrupt live data, so free space
+// is claimed first: allocate free blocks as creating-state objects
+// (exclusive ownership, crash-swept via creator_pid if we die), touch
+// one byte per page, then abort them all.  Claims are held until the end
+// so the allocator cannot hand the same block back; concurrent real
+// allocations during the pass (~100ms per 512 MiB) may fail and take the
+// caller's normal full-arena fallback — callers run this off the hot
+// path at process start, when that race is narrowest.  Returns bytes
+// touched (0 = nothing free or another process holds the space).
+uint64_t rt_store_prefault_free(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  struct Claim { uint8_t id[16]; };
+  std::vector<Claim> claims;
+  uint64_t total = 0;
+  uint32_t counter = 0;
+  int32_t pid = static_cast<int32_t>(getpid());
+  // Descending size tiers: big claims first (fewest mutex acquisitions),
+  // smaller tiers mop up the remaining fragments.
+  static const uint64_t tiers[] = {128ull << 20, 32ull << 20,
+                                   8ull << 20, 1ull << 20};
+  for (uint64_t tier : tiers) {
+    for (;;) {
+      Claim c;
+      std::memset(c.id, 0, 16);
+      c.id[0] = 0xFE;                       // prefault-claim namespace
+      std::memcpy(c.id + 1, "prefault", 8);
+      std::memcpy(c.id + 9, &pid, 4);
+      uint32_t n = ++counter;
+      std::memcpy(c.id + 13, &n, 3);
+      uint64_t off = rt_store_alloc(hv, c.id, tier);
+      if (off == 0) break;
+      claims.push_back(c);
+      uint8_t* p = h->base + off;
+      for (uint64_t i = 0; i < tier; i += 4096) p[i] = 0;
+      total += tier;
+    }
+  }
+  for (const Claim& c : claims) rt_store_abort(hv, c.id);
+  return total;
 }
 
 // Look up a sealed object; pins it and returns offset/size. 1=found.

@@ -19,6 +19,7 @@
 //        store_hammer writer <shm> <widx> <seconds>
 //        store_hammer reader <shm> <nwriters> <seconds>
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -47,6 +48,9 @@ int rt_store_sweep_dead(void* h);
 int rt_store_oldest(void* h, uint8_t* out_id);
 void rt_store_stats(void* h, uint64_t* used, uint64_t* cap, uint64_t* n);
 uint8_t* rt_store_base(void* h);
+void rt_store_write_stream(void* h, uint64_t dst_off, const void* src,
+                           uint64_t n);
+uint64_t rt_store_prefault_free(void* h);
 void rt_store_close(void* h);
 int rt_store_unlink(const char* name);
 }
@@ -93,10 +97,44 @@ void writer_thread(void* h, int widx, int tidx, double deadline,
       usleep(1000);
     }
     uint64_t size = 256 + (rand_r(&seed) % 4096);
+    int mode = rand_r(&seed) % 4;
+    if (mode != 0) {
+      // Exercise the streaming write kernel too: sizes straddling its
+      // internal NT threshold so both branches run under TSAN/ASAN.
+      size = 256 + (rand_r(&seed) % (512 * 1024));
+    }
     uint64_t off = rt_store_alloc(h, id, size);
     if (off == 0) { gen++; continue; }   // full or still present
     uint8_t* base = rt_store_base(h);
-    for (uint64_t i = 0; i < size; i++) base[off + i] = fill_byte(id, i);
+    if (mode == 0) {
+      // Direct byte stores (the original path).
+      for (uint64_t i = 0; i < size; i++) base[off + i] = fill_byte(id, i);
+    } else {
+      // Chunked assembly via rt_store_write_stream, the path local puts
+      // and DCN pulls use: stage the pattern, then stream it in chunks.
+      std::vector<uint8_t> staging(size);
+      for (uint64_t i = 0; i < size; i++) staging[i] = fill_byte(id, i);
+      if (mode == 1) {
+        rt_store_write_stream(h, off, staging.data(), size);
+      } else if (mode == 2) {
+        // Sequential chunks (chunked node-to-node transfer shape).
+        uint64_t chunk = 1 + size / 3;
+        for (uint64_t s = 0; s < size; s += chunk) {
+          uint64_t n = std::min(chunk, size - s);
+          rt_store_write_stream(h, off + s, staging.data() + s, n);
+        }
+      } else {
+        // Two threads writing disjoint halves of ONE creating-state
+        // region (the parallel chunked writer shape) — page-unaligned
+        // split on purpose; the region is exclusively ours so the only
+        // sharing is the allocator metadata around it.
+        uint64_t half = size / 2;
+        std::thread t2(rt_store_write_stream, h, off + half,
+                       staging.data() + half, size - half);
+        rt_store_write_stream(h, off, staging.data(), half);
+        t2.join();
+      }
+    }
     if (rand_r(&seed) % 16 == 0) {
       rt_store_abort(h, id);
     } else if (rt_store_seal(h, id) != 0) {
@@ -184,12 +222,19 @@ int run_orchestrate(const char* self, const char* shm, int writers,
   // pin table would corrupt for every later reader on the host.
   unsigned seed = 42;
   double deadline = now_s() + seconds;
+  int iter = 0;
   while (now_s() < deadline) {
     usleep(200 * 1000);
     int victim = rand_r(&seed) % rpids.size();
     kill(rpids[victim], SIGKILL);
     waitpid(rpids[victim], nullptr, 0);
     rt_store_sweep_dead(h);
+    if (++iter % 3 == 0) {
+      // Race the write-prefault pass (claim free blocks / touch / abort)
+      // against live writers and the sweep — the claims must never be
+      // observable as objects nor strand bytes.
+      rt_store_prefault_free(h);
+    }
     rpids[victim] = spawn(self, "reader", shm, writers,
                           deadline - now_s() + 0.1);
   }

@@ -49,6 +49,14 @@ class Config:
     # Chunk size for node-to-node object transfer over DCN (ray uses 64MB
     # gRPC chunks; zmq multipart makes smaller chunks cheap).
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Arena put write path: frames >= stream_min copy through the
+    # non-temporal streaming kernel (native/store.cc
+    # rt_store_write_stream); frames >= parallel_min additionally split
+    # across min(cpu_count, chunks) copy threads.  Kill switches
+    # RAY_TPU_PUT_STREAM=0 / RAY_TPU_PUT_PARALLEL=0 override both
+    # (native_store.py reads them directly).
+    put_stream_min_bytes: int = 1 * 1024 * 1024
+    put_parallel_min_bytes: int = 64 * 1024 * 1024
     # --- scheduling ---
     # Hybrid policy: pack onto lower-index nodes until utilization crosses
     # this threshold, then spread (ray: scheduler_spread_threshold=0.5).

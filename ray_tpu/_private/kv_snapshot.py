@@ -15,8 +15,19 @@ make_snapshot_storage).
 Wire format (all u32 big-endian):
   request : cmd(1) keylen(4) key vallen(4) val
   response: status(1) vallen(4) val
-  cmds    : S=set  G=get  D=del  P=ping
+  cmds    : S=set  G=get  D=del  P=ping  A=auth (val carries the token)
   status  : '+'=ok  '-'=miss  '!'=error (val carries the message)
+
+Auth: when the server is started with a shared secret (RAY_TPU_KV_TOKEN
+env var or the --token flag), every connection must present it in an
+`A` frame before any other command; a missing or wrong token gets a
+clear '!' error and the connection is closed.  The client sends the
+frame automatically when its own RAY_TPU_KV_TOKEN is set.  WITHOUT a
+token the server trusts its network completely — anyone who can reach
+the port can read and overwrite controller snapshots — so an unset
+token is only appropriate on a loopback interface or an isolated
+cluster-management network (the same trust assumption as an
+unauthenticated Redis for the reference's GCS).
 """
 from __future__ import annotations
 
@@ -56,9 +67,12 @@ class KvStoreServer:
     snapshot traffic is one controller writing every snapshot period."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None, token: str | None = None):
         self.data: dict[bytes, bytes] = {}
         self.data_dir = data_dir
+        # Shared-secret auth (see module docstring).  None/"" = open.
+        self.token = (token if token is not None
+                      else os.environ.get("RAY_TPU_KV_TOKEN", "")) or ""
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             for fn in os.listdir(data_dir):
@@ -111,9 +125,36 @@ class KvStoreServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        authed = not self.token
         try:
             while True:
                 cmd, key, val = _recv_frame(conn)
+                if cmd == b"A":
+                    # Tokenless servers accept (and ignore) the frame so a
+                    # token-configured client still talks to them.
+                    if self.token and val.decode(
+                            "utf-8", "replace") != self.token:
+                        _send_resp(conn, b"!",
+                                   b"auth failed: RAY_TPU_KV_TOKEN "
+                                   b"mismatch with kv store")
+                        # The client pipelines its command behind the
+                        # auth frame (one sendall); consume it before
+                        # close() so unread bytes don't turn the close
+                        # into an RST that can discard the error
+                        # response in flight.
+                        try:
+                            _recv_frame(conn)
+                        except (ConnectionError, OSError):
+                            pass
+                        return
+                    authed = True
+                    _send_resp(conn, b"+")
+                    continue
+                if not authed:
+                    _send_resp(conn, b"!",
+                               b"auth required: kv store has a token; "
+                               b"set RAY_TPU_KV_TOKEN on the client")
+                    return
                 with self._lock:
                     if cmd == b"S":
                         self.data[key] = val
@@ -147,15 +188,31 @@ class KvClient:
     store restarts without reconnect logic (snapshot cadence is seconds,
     not microseconds — simplicity beats pooling here)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 token: str | None = None):
         self.host, self.port, self.timeout = host, port, timeout
+        self.token = (token if token is not None
+                      else os.environ.get("RAY_TPU_KV_TOKEN", "")) or ""
 
     def _call(self, cmd: bytes, key: bytes,
               val: bytes = b"") -> tuple[bytes, bytes]:
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout) as s:
-            s.sendall(cmd + struct.pack(">I", len(key)) + key
-                      + struct.pack(">I", len(val)) + val)
+            payload = cmd + struct.pack(">I", len(key)) + key \
+                + struct.pack(">I", len(val)) + val
+            if self.token:
+                # One-connection-per-op protocol: prepend the auth frame
+                # and read its response before the real one.
+                tok = self.token.encode()
+                payload = (b"A" + struct.pack(">I", 0)
+                           + struct.pack(">I", len(tok)) + tok + payload)
+            s.sendall(payload)
+            if self.token:
+                auth_status = _recv_exact(s, 1)
+                (alen,) = struct.unpack(">I", _recv_exact(s, 4))
+                auth_out = _recv_exact(s, alen) if alen else b""
+                if auth_status == b"!":
+                    raise RuntimeError(f"kv store error: {auth_out!r}")
             status = _recv_exact(s, 1)
             (vlen,) = struct.unpack(">I", _recv_exact(s, 4))
             out = _recv_exact(s, vlen) if vlen else b""
@@ -219,8 +276,12 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--dir", default=None,
                     help="persist keys to this directory")
+    ap.add_argument("--token", default=None,
+                    help="shared-secret auth token (default: "
+                         "RAY_TPU_KV_TOKEN env var; empty = open)")
     args = ap.parse_args()
-    srv = KvStoreServer(args.host, args.port, args.dir).start()
+    srv = KvStoreServer(args.host, args.port, args.dir,
+                        token=args.token).start()
     print(json.dumps({"kv_addr": srv.addr}), flush=True)
     try:
         while True:

@@ -12,6 +12,7 @@ array deserialized from the arena aliases arena memory directly.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import ctypes
 import fcntl
 import logging
@@ -19,9 +20,56 @@ import os
 import struct
 import threading
 import subprocess
+import time
 import weakref
+from concurrent.futures import ThreadPoolExecutor
 
 logger = logging.getLogger(__name__)
+
+# ---- put-path tuning: Config.put_stream_min_bytes /
+# put_parallel_min_bytes are the single source of the defaults (worker/
+# agent pass resolved values into Arena(...); bare Arena construction
+# falls back to env-or-Config-default).  Kill switches for A/B
+# debugging, read once per process like RAY_TPU_SYNC_FASTPATH:
+#   RAY_TPU_PUT_STREAM=0    -> never call the non-temporal write kernel
+#   RAY_TPU_PUT_PARALLEL=0  -> never split a frame across copy threads
+#   RAY_TPU_ARENA_PREFAULT=0-> skip the free-space write-prefault pass
+from ray_tpu._private.config import DEFAULT as _DEFAULT_CONFIG
+
+DEFAULT_STREAM_MIN = _DEFAULT_CONFIG.put_stream_min_bytes
+DEFAULT_PARALLEL_MIN = _DEFAULT_CONFIG.put_parallel_min_bytes
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "1") != "0"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# Shared copy pool for the parallel chunked writer (pid-checked: a forked
+# child must not reuse the parent's threads).  Sized to the machine, not
+# the frame: min(cpu_count, chunks) threads are used per put.
+_copy_pool: ThreadPoolExecutor | None = None
+_copy_pool_pid: int | None = None
+_copy_pool_lock = threading.Lock()
+
+
+def _put_pool() -> ThreadPoolExecutor:
+    global _copy_pool, _copy_pool_pid
+    if _copy_pool is not None and _copy_pool_pid == os.getpid():
+        return _copy_pool
+    with _copy_pool_lock:
+        if _copy_pool is None or _copy_pool_pid != os.getpid():
+            _copy_pool = ThreadPoolExecutor(
+                max_workers=max(1, (os.cpu_count() or 1) - 1),
+                thread_name_prefix="raytpu-putcopy")
+            _copy_pool_pid = os.getpid()
+    return _copy_pool
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SAN = os.environ.get("RAYTPU_STORE_SANITIZE", "")
@@ -110,6 +158,13 @@ def load_lib():
     lib.rt_store_peek.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.c_uint64),
                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_store_write_stream.restype = None
+    lib.rt_store_write_stream.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                          ctypes.c_void_p, ctypes.c_uint64]
+    lib.rt_store_stream_mode.restype = ctypes.c_int
+    lib.rt_store_stream_mode.argtypes = []
+    lib.rt_store_prefault_free.restype = ctypes.c_uint64
+    lib.rt_store_prefault_free.argtypes = [ctypes.c_void_p]
     lib.rt_store_close.argtypes = [ctypes.c_void_p]
     lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
@@ -135,9 +190,22 @@ class Arena:
     """One mapped shared-memory arena (create on agents, open on workers)."""
 
     def __init__(self, name: str, capacity: int | None = None,
-                 create: bool = False):
+                 create: bool = False, *, stream_min: int | None = None,
+                 parallel_min: int | None = None):
         self.lib = load_lib()
         self.name = name
+        # Put-path tuning: explicit args (worker/agent pass Config values)
+        # beat env beats defaults; the kill switches zero out a path.
+        self.stream_min = (stream_min if stream_min is not None else
+                           _env_int("RAY_TPU_PUT_STREAM_MIN_BYTES",
+                                    DEFAULT_STREAM_MIN))
+        self.parallel_min = (parallel_min if parallel_min is not None else
+                             _env_int("RAY_TPU_PUT_PARALLEL_MIN_BYTES",
+                                      DEFAULT_PARALLEL_MIN))
+        if not _env_flag("RAY_TPU_PUT_STREAM"):
+            self.stream_min = 0x7FFFFFFFFFFFFFFF
+        if not _env_flag("RAY_TPU_PUT_PARALLEL"):
+            self.parallel_min = 0x7FFFFFFFFFFFFFFF
         if create:
             self.handle = self.lib.rt_store_create(
                 name.encode(), ctypes.c_uint64(capacity or 0))
@@ -155,6 +223,11 @@ class Arena:
         # in-suite).  RLock, not Lock: a GC point inside close() itself
         # can run a finalizer reentrantly on the closing thread.
         self._pin_lock = threading.RLock()
+        # Serializes prefault_free against close() WITHOUT touching
+        # _pin_lock: the prefault pass runs ~100ms+ and pin-release
+        # finalizers fire on the rpc IO thread — holding _pin_lock that
+        # long would stall every RPC in the process.
+        self._close_lock = threading.Lock()
         # Writable view over the whole mapping: frame payloads are copied in
         # with one memoryview slice assignment (no intermediate bytes()).
         size = self.lib.rt_store_mapped_size(self.handle)
@@ -162,11 +235,82 @@ class Arena:
             (ctypes.c_ubyte * size).from_address(self.base)).cast("B")
 
     # ---- write path ----
-    def put_frames(self, oid: bytes, frames: list) -> bool:
+    def _frame_addr(self, f) -> tuple[int, object] | None:
+        """(address, keepalive) of a frame's buffer, or None when the
+        buffer exposes no raw pointer we can take (exotic read-only
+        views fall back to slice assignment)."""
+        if isinstance(f, bytes):
+            # c_char_p points at the bytes object's internal buffer (the
+            # returned keepalive holds the reference).
+            p = ctypes.c_char_p(f)
+            return ctypes.cast(p, ctypes.c_void_p).value, (f, p)
+        mv = memoryview(f)
+        try:
+            c = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+        except (TypeError, BufferError):
+            return None
+        return ctypes.addressof(c), (mv, c)
+
+    def _write_frame(self, dst_off: int, f, n: int,
+                     trace: dict | None) -> None:
+        """Copy one frame into the arena at data offset dst_off.
+
+        Large frames go through the C streaming kernel (non-temporal
+        stores — a 256 MiB put stops read-allocating the cache lines it
+        is about to overwrite); frames >= parallel_min additionally split
+        across min(cpu_count, chunks) GIL-releasing calls so multi-core
+        boxes use more than one memory pipe.  A 1-core box always takes
+        the single-call path."""
+        src = self._frame_addr(f)
+        if src is None:
+            # Read-only exotic buffer: slice assignment (copies via the
+            # buffer protocol).
+            self._map[dst_off:dst_off + n] = memoryview(f).cast("B")
+            return
+        addr, _keep = src
+        if n < self.stream_min:
+            ctypes.memmove(self.base + dst_off, addr, n)
+            return
+        nthreads = min(os.cpu_count() or 1, 8)
+        if n >= self.parallel_min and nthreads >= 2:
+            # Page-aligned split: two threads must never write-fault the
+            # same page.
+            chunk = -(-n // nthreads) + 4095 & ~4095
+            spans = [(s, min(chunk, n - s)) for s in range(0, n, chunk)]
+            if trace is not None:
+                trace["parallel_chunks"] = len(spans)
+            pool = _put_pool()
+            futs = [pool.submit(self.lib.rt_store_write_stream, self.handle,
+                                dst_off + s, addr + s, ln)
+                    for s, ln in spans[1:]]
+            try:
+                s0, ln0 = spans[0]
+                self.lib.rt_store_write_stream(self.handle, dst_off + s0,
+                                               addr + s0, ln0)
+                for fut in futs:
+                    fut.result()
+            except BaseException:
+                # Every pool thread must be OUT of the block before the
+                # exception reaches put_frames' abort handler: abort
+                # frees the block, and a still-running chunk write would
+                # scribble over whatever gets allocated there next.
+                for fut in futs:
+                    fut.cancel()
+                concurrent.futures.wait(futs)
+                raise
+        else:
+            self.lib.rt_store_write_stream(self.handle, dst_off, addr, n)
+        if trace is not None:
+            trace["stream"] = bool(self.lib.rt_store_stream_mode())
+
+    def put_frames(self, oid: bytes, frames: list,
+                   trace: dict | None = None) -> bool:
         lens = [len(f) for f in frames]
         total, offsets = _bundle_layout(lens)
         off = self.lib.rt_store_alloc(self.handle, oid,
                                       ctypes.c_uint64(total))
+        if trace is not None:
+            trace["alloc_done"] = time.monotonic()
         if off == 0:
             return False
         try:
@@ -176,25 +320,33 @@ class Arena:
             for f, fo in zip(frames, offsets):
                 n = len(f)
                 if n:
-                    dst = self.base + off + fo
-                    if isinstance(f, bytes):
-                        ctypes.memmove(dst, f, n)
-                    else:
-                        mv = memoryview(f)
-                        try:
-                            # Writable buffers: raw memmove (fastest path).
-                            ctypes.memmove(
-                                dst, (ctypes.c_char * n).from_buffer(mv), n)
-                        except (TypeError, BufferError):
-                            # Read-only views copy via slice assignment.
-                            self._map[off + fo:off + fo + n] = mv.cast("B")
+                    self._write_frame(off + fo, f, n, trace)
         except BaseException:
             # Never leak a creating-state block: abort the allocation so
             # the entry doesn't sit unreclaimable until a crash sweep.
             self.lib.rt_store_abort(self.handle, oid)
             raise
+        if trace is not None:
+            trace["copy_done"] = time.monotonic()
         self.lib.rt_store_seal(self.handle, oid)
+        if trace is not None:
+            trace["seal_done"] = time.monotonic()
         return True
+
+    def prefault_free(self) -> int:
+        """Write-prefault this process's PTEs over the arena's free space
+        (claim free blocks exclusively, touch one byte per page, abort) —
+        see rt_store_prefault_free.  Without it, on kernels lacking
+        MADV_POPULATE_WRITE every page of a process's first bulk put
+        costs a write-protect fault: ~2-2.6x off peak copy bandwidth on
+        the dev box.  Returns bytes touched; honors
+        RAY_TPU_ARENA_PREFAULT=0."""
+        if not _env_flag("RAY_TPU_ARENA_PREFAULT"):
+            return 0
+        with self._close_lock:
+            if not self.handle:
+                return 0
+            return int(self.lib.rt_store_prefault_free(self.handle))
 
     # ---- read path ----
     def get_frames(self, oid: bytes) -> list | None:
@@ -255,15 +407,22 @@ class Arena:
             self.handle, oid, ctypes.c_uint64(total)) != 0
 
     def write_raw(self, oid: bytes, offset: int, chunk: bytes) -> bool:
-        """Write one chunk into a creating-state region."""
+        """Write one chunk into a creating-state region (DCN pulls land
+        here); big chunks ride the same streaming kernel as local puts."""
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         if not self.lib.rt_store_peek(self.handle, oid, ctypes.byref(off),
                                       ctypes.byref(size)):
             return False
-        if offset + len(chunk) > size.value:
+        n = len(chunk)
+        if offset + n > size.value:
             return False
-        ctypes.memmove(self.base + off.value + offset, chunk, len(chunk))
+        src = self._frame_addr(chunk)
+        if src is not None and n >= self.stream_min:
+            self.lib.rt_store_write_stream(self.handle, off.value + offset,
+                                           src[0], n)
+        else:
+            ctypes.memmove(self.base + off.value + offset, chunk, n)
         return True
 
     def seal_raw(self, oid: bytes) -> bool:
@@ -303,7 +462,11 @@ class Arena:
         return None
 
     def close(self) -> None:
-        with self._pin_lock:
+        # _close_lock first (waits out an in-flight prefault pass, which
+        # never takes _pin_lock), then _pin_lock for the finalizer
+        # protocol.  Lock order close_lock -> pin_lock, nobody nests the
+        # other way.
+        with self._close_lock, self._pin_lock:
             if not self.handle:
                 return
             # Null the handle BEFORE unmapping: a reentrant finalizer
@@ -339,10 +502,27 @@ class NativeStoreBackend:
     """Agent-side node-store backend over the native arena (drop-in for
     object_store._DictBackend)."""
 
-    def __init__(self, node_id: str, capacity: int):
+    def __init__(self, node_id: str, capacity: int, config=None):
         _cleanup_stale_arenas()
         self._name = f"/raytpu_{node_id[:16]}_{os.getpid()}"
-        self.arena = Arena(self._name, capacity, create=True)
+        self.arena = Arena(
+            self._name, capacity, create=True,
+            stream_min=getattr(config, "put_stream_min_bytes", None),
+            parallel_min=getattr(config, "put_parallel_min_bytes", None))
+        # Write-prefault the fresh arena's pages off the boot path: at
+        # create time every block is free and no client is connected, so
+        # the claim/touch/abort pass races nothing.
+        threading.Thread(target=self._prefault, daemon=True,
+                         name="raytpu-arena-prefault").start()
+
+    def _prefault(self) -> None:
+        try:
+            touched = self.arena.prefault_free()
+            if touched:
+                logger.debug("arena %s prefaulted %d MiB of free space",
+                             self._name, touched >> 20)
+        except Exception:  # noqa: BLE001 - prefault is best-effort
+            logger.debug("arena prefault failed", exc_info=True)
 
     @property
     def shm_name(self) -> str:

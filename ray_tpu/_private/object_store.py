@@ -188,11 +188,11 @@ class _DictBackend:
         self._data.clear()
 
 
-def _make_backend(node_id: str, capacity: int):
+def _make_backend(node_id: str, capacity: int, config=None):
     try:
         from ray_tpu._private.native_store import NativeStoreBackend
 
-        return NativeStoreBackend(node_id, capacity)
+        return NativeStoreBackend(node_id, capacity, config=config)
     except Exception:  # noqa: BLE001 - native build absent is fine
         return _DictBackend(capacity)
 
@@ -208,7 +208,8 @@ class StoreRunner:
 
         self.node_id = node_id
         self.config = config
-        self.backend = _make_backend(node_id, config.object_store_memory)
+        self.backend = _make_backend(node_id, config.object_store_memory,
+                                     config=config)
         self._clients = None
         self.spill_dir = os.path.join(
             tempfile.gettempdir(),

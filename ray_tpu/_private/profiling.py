@@ -92,6 +92,114 @@ def hop_breakdown_us(rec: dict) -> dict:
     return out
 
 
+# ------------------------------------------------------------ put tracer
+# Stamp order of one traced `ray_tpu.put`, API entry to API return.  The
+# arena path stamps every stage; the inline path stops at owner_reg_done
+# (no arena involved); the RPC fallback stamps store_rpc_done instead of
+# alloc/copy/seal.  Like the hop tracer: opt-in, one call at a time, zero
+# cost when disarmed (one `is not None` check per put).
+PUT_ORDER = (
+    "put_entry",        # put_object entry on the caller thread
+    "serialize_done",   # value pickled (out-of-band buffers captured)
+    "owner_reg_done",   # owner record + contained-ref pins registered
+    "alloc_done",       # arena block allocated (mutex wait included)
+    "copy_done",        # frame bytes copied into the arena
+    "seal_done",        # object sealed (visible to readers)
+    "store_rpc_done",   # RPC fallback: agent store_put round trip done
+    "put_done",         # put_object returned (memory-store publication)
+)
+
+_put_armed: bool = False
+_put_last: dict | None = None
+
+
+def arm_put_trace() -> None:
+    """One-shot: trace the next `ray_tpu.put` in this process."""
+    global _put_armed
+    _put_armed = True
+
+
+def consume_put_arm() -> dict | None:
+    """Claim the armed put trace (called by worker.put_object)."""
+    global _put_armed
+    if not _put_armed:
+        return None
+    _put_armed = False
+    return {"put_entry": time.monotonic()}
+
+
+def publish_put_trace(rec: dict) -> None:
+    global _put_last
+    _put_last = dict(rec)
+
+
+def take_put_trace() -> dict | None:
+    """The most recent completed put trace, cleared on read."""
+    global _put_last
+    trace, _put_last = _put_last, None
+    return trace
+
+
+@contextmanager
+def put_trace():
+    """Trace ONE put's per-stage latency:
+
+        with profiling.put_trace() as rec:
+            ref = ray_tpu.put(big_array)
+        table = profiling.put_breakdown_us(rec)
+
+    The yielded dict gains "stages" (raw monotonic stamps plus path
+    metadata) when the block exits; feed it to `put_breakdown_us`."""
+    global _put_armed
+    rec: dict = {}
+    arm_put_trace()
+    try:
+        yield rec
+    finally:
+        rec["stages"] = take_put_trace()
+        _put_armed = False
+
+
+def put_breakdown_us(rec: dict) -> dict:
+    """Per-stage latency table (microseconds between consecutive observed
+    stamps, in PUT_ORDER) for a completed `put_trace` record, plus path
+    metadata ("path", "bytes", "stream", "parallel_chunks") and the copy
+    stage's effective bandwidth.  Empty when no put fired."""
+    stages = dict(rec.get("stages") or {})
+    if not stages:
+        return {}
+    present = [(k, stages[k]) for k in PUT_ORDER if k in stages]
+    if len(present) < 2:
+        return {}
+    out: dict = {}
+    prev_name, prev_t = present[0]
+    for name, t in present[1:]:
+        out[f"{prev_name}->{name}_us"] = round((t - prev_t) * 1e6, 1)
+        prev_name, prev_t = name, t
+    out["total_us"] = round((present[-1][1] - present[0][1]) * 1e6, 1)
+    for key in ("path", "bytes", "stream", "parallel_chunks"):
+        if key in stages:
+            out[key] = stages[key]
+    copy_us = out.get("alloc_done->copy_done_us")
+    if copy_us and stages.get("bytes"):
+        out["copy_gib_per_s"] = round(
+            stages["bytes"] / (copy_us / 1e6) / (1 << 30), 2)
+    return out
+
+
+def put_stats() -> dict:
+    """Per-process put-path counters: how many large puts wrote straight
+    into the mmap'd arena vs silently degraded to the agent store_put
+    RPC, and the first recorded fallback cause.  "put is slow" becomes
+    diagnosable as "put is not using the arena"."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    return {"arena_puts": w._arena_puts,
+            "rpc_fallback_puts": w._arena_fallbacks,
+            "first_fallback_cause": w._arena_fallback_cause}
+
+
 @contextmanager
 def profile(event_name: str, extra_data: dict | None = None):
     """Record a named span attributed to the current task (or the driver).

@@ -183,6 +183,19 @@ class IoThread:
     _RECV_BURST = 256
 
     def __init__(self) -> None:
+        # Degraded-network test hook: RAY_TPU_NET_DELAY_MS holds every
+        # outbound message for that long before it reaches zmq — a
+        # LATENCY model (messages in flight overlap, per-socket order
+        # kept), NOT an occupancy one: sleeping on the IO thread would
+        # serialize concurrent sends and make pipelining unobservable by
+        # construction.  Default off; test-only — it delays every send in
+        # the process, heartbeats included.
+        try:
+            self._net_delay_s = float(
+                os.environ.get("RAY_TPU_NET_DELAY_MS", "0")) / 1e3
+        except ValueError:
+            self._net_delay_s = 0.0
+        self._delayq: deque = deque()   # (due, sock, frames, copy) FIFO
         self.ctx = zmq.Context.instance()
         self._cmds: deque = deque()
         self._lock = threading.Lock()
@@ -233,6 +246,10 @@ class IoThread:
             self._on_read.pop(sock, None)
             self._outq.pop(sock, None)
             self._outq_labels.pop(sock, None)
+            if self._delayq:
+                # Drop net-delay-parked messages to the closing socket.
+                self._delayq = deque(
+                    e for e in self._delayq if e[1] is not sock)
             try:
                 self._poller.unregister(sock)
             except KeyError:
@@ -269,6 +286,22 @@ class IoThread:
 
     # --------------------------------------------------------- IO-thread
     def _send_now(self, sock, frames, copy: bool) -> None:
+        if self._net_delay_s:
+            # Park in the delay queue; the poll loop releases due entries
+            # (same single-thread ownership, so per-socket order holds —
+            # one shared queue, monotonic due times).
+            self._delayq.append((time.monotonic() + self._net_delay_s,
+                                 sock, frames, copy))
+            return
+        self._send_wire(sock, frames, copy)
+
+    def _flush_delayed(self) -> None:
+        now = time.monotonic()
+        while self._delayq and self._delayq[0][0] <= now:
+            _, sock, frames, copy = self._delayq.popleft()
+            self._send_wire(sock, frames, copy)
+
+    def _send_wire(self, sock, frames, copy: bool) -> None:
         q = self._outq.get(sock)
         if q:
             # Order behind already-queued messages.
@@ -322,10 +355,16 @@ class IoThread:
 
     def _run(self) -> None:
         while not self._closed:
+            timeout = 1000
+            if self._delayq:
+                timeout = max(0, min(1000, int(
+                    (self._delayq[0][0] - time.monotonic()) * 1000) + 1))
             try:
-                events = dict(self._poller.poll(1000))
+                events = dict(self._poller.poll(timeout))
             except zmq.ZMQError:
                 return
+            if self._delayq:
+                self._flush_delayed()
             if self._wake_r in events:
                 while True:
                     try:

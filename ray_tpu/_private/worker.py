@@ -252,6 +252,13 @@ class CoreWorker:
         self._arena = None
         self._arena_tried = False
         self._arena_lock = threading.Lock()
+        # Put-path attribution (profiling.put_stats): arena-direct puts
+        # vs silent degradations to the agent store_put RPC, with the
+        # first fallback cause kept (and logged once) so "put is slow"
+        # is diagnosable as "put is not using the arena".
+        self._arena_puts = 0
+        self._arena_fallbacks = 0
+        self._arena_fallback_cause: str | None = None
         self.loop: asyncio.AbstractEventLoop = None  # set in start()
         self._default_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
@@ -295,7 +302,7 @@ class CoreWorker:
             # first-use open costs ~250ms for a 512MB arena
             # (MADV_POPULATE_WRITE), which would land inside the first
             # big put otherwise.
-            threading.Thread(target=self.local_arena, daemon=True,
+            threading.Thread(target=self.warm_arena, daemon=True,
                              name="raytpu-arena-warm").start()
 
     @property
@@ -1260,28 +1267,81 @@ class CoreWorker:
                         try:
                             from ray_tpu._private.native_store import Arena
 
-                            self._arena = Arena(self.store_name)
-                        except Exception:  # noqa: BLE001 - RPC fallback
+                            self._arena = Arena(
+                                self.store_name,
+                                stream_min=self.config.put_stream_min_bytes,
+                                parallel_min=(
+                                    self.config.put_parallel_min_bytes))
+                        except Exception as e:  # noqa: BLE001 - RPC fallback
                             self._arena = None
+                            self._note_arena_fallback(
+                                f"arena map failed: {e!r}", count=False)
                     self._arena_tried = True
         return self._arena
 
-    def _store_frames_local(self, oid: bytes, frames: list) -> bool:
-        """Write frames into the local node store, zero-RPC when the arena
-        is mapped; falls back to the agent store_put RPC."""
+    def warm_arena(self) -> None:
+        """Map the arena, then write-prefault this process's PTEs over
+        its free space (claim/touch/abort — native_store.prefault_free).
+        A concurrent warmer in another process holds the claims while it
+        touches, so retry briefly before giving up: an unwarmed process
+        pays a write-protect fault per page on its first bulk put."""
         arena = self.local_arena()
-        if arena is not None:
+        if arena is None:
+            return
+        for attempt in range(3):
             try:
-                if arena.put_frames(oid, frames):
-                    return True
-            except Exception:  # noqa: BLE001
-                pass
+                if arena.prefault_free() or attempt == 2:
+                    return
+            except Exception:  # noqa: BLE001 - prefault is best-effort
+                return
+            time.sleep(0.1 * (attempt + 1))
+
+    def _note_arena_fallback(self, cause: str, count: bool = True) -> None:
+        """Record (and log ONCE per process) why large puts are not
+        writing straight into the mmap'd arena."""
+        if count:
+            self._arena_fallbacks += 1
+        if self._arena_fallback_cause is None:
+            self._arena_fallback_cause = cause
+            logger.warning(
+                "large put falling back to the agent store_put RPC "
+                "(first cause: %s) — arena-direct puts disabled or "
+                "degraded in this process", cause)
+
+    def _store_frames_local(self, oid: bytes, frames: list,
+                            trace: dict | None = None) -> bool:
+        """Write frames into the local node store, zero-RPC when the arena
+        is mapped; falls back to the agent store_put RPC.  Every fallback
+        is counted and its first cause logged (profiling.put_stats)."""
+        arena = self.local_arena()
+        if arena is None:
+            self._note_arena_fallback(
+                "arena unmapped"
+                + ("" if self.store_name else " (agent reported no shm "
+                   "store — native build unavailable?)"))
+            return False
+        try:
+            if arena.put_frames(oid, frames, trace=trace):
+                self._arena_puts += 1
+                return True
+        except Exception as e:  # noqa: BLE001
+            self._note_arena_fallback(f"arena put raised: {e!r}")
+            return False
+        self._note_arena_fallback(
+            "arena refused put (full or duplicate id); stats=%s"
+            % (arena.stats(),))
         return False
 
     def put_object(self, value: Any) -> ObjectRef:
+        from ray_tpu._private import profiling
+
+        trace = profiling.consume_put_arm()
         oid = ObjectID.for_put(WorkerID.from_hex(self.worker_id),
                                next(self._put_seq)).binary()
         sv = serialize(value)
+        if trace is not None:
+            trace["serialize_done"] = time.monotonic()
+            trace["bytes"] = sv.total_bytes
         with self._ref_lock:
             rec = self.owned.setdefault(oid, OwnedObject())
             rec.local_refs += 1
@@ -1294,7 +1354,11 @@ class CoreWorker:
             for c_oid, owner in self._dedup_contained(sv.contained_refs):
                 rec.contained.append((c_oid, owner))
                 self._add_borrow(c_oid, owner)
+        if trace is not None:
+            trace["owner_reg_done"] = time.monotonic()
         if sv.total_bytes <= self.config.max_inline_object_size:
+            if trace is not None:
+                trace["path"] = "inline"
             rec.state = "inline"
             rec.frames = sv.frames
             # Fields publish synchronously (the get fast path reads them
@@ -1308,15 +1372,20 @@ class CoreWorker:
             # pipe read + GIL trade per object — the dominant cost of
             # put-heavy loops).
             self._post_to_loop(e.wake)
-        elif self._store_frames_local(oid, sv.frames):
+        elif self._store_frames_local(oid, sv.frames, trace=trace):
             # Zero-RPC path: wrote straight into the mmap'd arena from the
             # caller's thread.
+            if trace is not None:
+                trace["path"] = "arena"
             rec.state = "stored"
             rec.locations = [self.agent_addr]
             e = self.memory.entry(oid)
             e.has_value, e.value = True, value
             self._post_to_loop(e.wake)
         else:
+            if trace is not None:
+                trace["path"] = "rpc"
+
             async def _store():
                 reply, _ = await self.clients.get(self.agent_addr).call(
                     "store_put", {"object_id": oid.hex()}, sv.frames)
@@ -1326,6 +1395,11 @@ class CoreWorker:
                 e.has_value, e.value = True, value
                 e.wake()
             self.run(_store())
+            if trace is not None:
+                trace["store_rpc_done"] = time.monotonic()
+        if trace is not None:
+            trace["put_done"] = time.monotonic()
+            profiling.publish_put_trace(trace)
         return ObjectRef(oid, self.address)
 
     _GET_MISS = object()

@@ -161,7 +161,7 @@ def init(address: str | None = None,
             # the driver only learns the store name here).
             import threading
 
-            threading.Thread(target=core.local_arena, daemon=True,
+            threading.Thread(target=core.warm_arena, daemon=True,
                              name="raytpu-arena-warm").start()
     # Fetch pub address + register the job.
     reply, _ = core.call(controller_addr, "ping", {}, timeout=30.0)

@@ -150,6 +150,112 @@ time.sleep(60)
     assert not arena.contains(oid)
 
 
+def test_stream_memcpy_parity(arena):
+    """The streaming (non-temporal) write kernel and the memcpy path must
+    produce byte-identical sealed bundles for the same frames — including
+    odd sizes and the sub-16B head/tail the kernel handles specially."""
+    from ray_tpu._private.native_store import Arena
+
+    rng = np.random.default_rng(7)
+    frames = [b"pickle-stream-stub",
+              rng.integers(0, 255, 3 * 1024 * 1024 + 13,
+                           dtype=np.uint8).tobytes(),
+              b"x" * 63, b"", b"tail"]
+    # Second handle onto the same arena with streaming forced OFF.
+    plain = Arena(arena.name, stream_min=1 << 62)
+    try:
+        assert arena.stream_min < 3 * 1024 * 1024  # streaming engages
+        assert arena.put_frames(b"s" * 16, frames)
+        assert plain.put_frames(b"m" * 16, frames)
+        raw_s = arena.get_raw(b"s" * 16)
+        raw_m = arena.get_raw(b"m" * 16)
+        assert bytes(raw_s) == bytes(raw_m)
+        del raw_s, raw_m
+    finally:
+        plain.close()
+
+
+def test_write_stream_kernel_alignments(arena):
+    """rt_store_write_stream at every head misalignment (dst and src)
+    copies exactly the requested bytes — neighbors stay untouched."""
+    import ctypes
+
+    oid = b"W" * 16
+    size = 1024 * 1024
+    assert arena.create_raw(oid, size)
+    off = ctypes.c_uint64()
+    osize = ctypes.c_uint64()
+    assert arena.lib.rt_store_peek(arena.handle, oid, ctypes.byref(off),
+                                   ctypes.byref(osize))
+    base = arena.base + off.value
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, 255, size, dtype=np.uint8)
+    src_c = (ctypes.c_char * size).from_buffer(src.data)
+    src_addr = ctypes.addressof(src_c)
+    for shift in (0, 1, 7, 15, 16):
+        n = 700_000 - shift
+        ctypes.memset(base, 0xAB, size)
+        arena.lib.rt_store_write_stream(
+            arena.handle, off.value + shift, src_addr + shift, n)
+        got = bytes((ctypes.c_ubyte * size).from_address(base))
+        assert got[:shift] == b"\xab" * shift
+        assert got[shift:shift + n] == src.tobytes()[shift:shift + n]
+        assert got[shift + n:shift + n + 16] == b"\xab" * 16
+    arena.abort_raw(oid)
+
+
+def test_prefault_free_leaves_no_objects(arena):
+    """The write-prefault pass (claim free blocks / touch / abort) must
+    be invisible: same object count, same used bytes, sealed data
+    intact, and the touched space still allocatable."""
+    arena.put_frames(b"L" * 16, [b"live-data" * 100])
+    before = arena.stats()
+    touched = arena.prefault_free()
+    assert touched > 0
+    after = arena.stats()
+    assert after["num_objects"] == before["num_objects"]
+    assert after["used"] == before["used"]
+    assert bytes(arena.get_frames(b"L" * 16)[0]) == b"live-data" * 100
+    # Space is free again: a big put still fits.
+    assert arena.put_frames(b"B" * 16, [b"z" * (4 * 1024 * 1024)])
+
+
+def test_prefault_respects_kill_switch(arena, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ARENA_PREFAULT", "0")
+    assert arena.prefault_free() == 0
+
+
+def test_put_frames_trace_stamps(arena):
+    trace = {}
+    assert arena.put_frames(b"T" * 16, [b"q" * 2048], trace=trace)
+    assert {"alloc_done", "copy_done", "seal_done"} <= set(trace)
+    assert trace["alloc_done"] <= trace["copy_done"] <= trace["seal_done"]
+
+
+def test_parallel_writer_parity():
+    """A frame above the parallel threshold split across copy threads
+    must land byte-identical to the single-call path (and engage only
+    when the box has >1 core)."""
+    from ray_tpu._private.native_store import Arena
+
+    name = f"/raytpu_testpar_{os.getpid()}"
+    a = Arena(name, capacity=80 * 1024 * 1024, create=True,
+              stream_min=1 << 20, parallel_min=8 * 1024 * 1024)
+    try:
+        rng = np.random.default_rng(3)
+        payload = rng.integers(0, 255, 16 * 1024 * 1024 + 5,
+                               dtype=np.uint8)
+        trace: dict = {}
+        assert a.put_frames(b"p" * 16, [b"hdr", payload.data], trace=trace)
+        got = a.get_frames(b"p" * 16)
+        assert bytes(got[1]) == payload.tobytes()
+        del got
+        if (os.cpu_count() or 1) >= 2:
+            assert trace.get("parallel_chunks", 0) >= 2
+    finally:
+        a.close()
+
+
 def test_stale_pin_release_after_close_is_noop():
     """A zero-copy view's pin finalizer can fire on any thread at any
     time — including AFTER the arena is closed (observed in-suite: the

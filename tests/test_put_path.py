@@ -1,0 +1,238 @@
+"""High-bandwidth object plane (ISSUE 2): put-stage tracer, arena
+fallback attribution, kv snapshot auth, and the degraded-network
+chunk-pipelining hook.
+
+The put tracer mirrors the ISSUE-1 hop tracer discipline: opt-in
+one-shot stamps, zero cost when disarmed, and a bench row
+(`put_stage_breakdown_us`) that proves which stage a perf change moved.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+# ------------------------------------------------------------ put tracer
+def test_put_trace_arena_path(ray_shared):
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu._private.worker import global_worker
+
+    # Ensure the arena is mapped (the warm thread races the first put).
+    if global_worker().local_arena() is None:
+        pytest.skip("native arena unavailable (dict backend)")
+    big = np.random.randint(0, 255, 4 * 1024 * 1024, np.uint8)
+    with profiling.put_trace() as rec:
+        ref = ray_tpu.put(big)
+    table = profiling.put_breakdown_us(rec)
+    assert table, f"no put trace captured: {rec}"
+    assert table["path"] == "arena"
+    assert table["bytes"] >= big.nbytes
+    for hop in ("put_entry->serialize_done_us",
+                "owner_reg_done->alloc_done_us",
+                "alloc_done->copy_done_us",
+                "copy_done->seal_done_us",
+                "seal_done->put_done_us"):
+        assert hop in table, f"{hop} missing from {table}"
+    assert table["copy_gib_per_s"] > 0
+    # The traced put is a real put.
+    assert (ray_tpu.get(ref, timeout=60) == big).all()
+
+
+def test_put_trace_inline_path(ray_shared):
+    import ray_tpu
+    from ray_tpu._private import profiling
+
+    with profiling.put_trace() as rec:
+        ray_tpu.put(b"small")
+    table = profiling.put_breakdown_us(rec)
+    assert table["path"] == "inline"
+    assert "alloc_done" not in (rec.get("stages") or {})
+
+
+def test_put_trace_one_shot(ray_shared):
+    import ray_tpu
+    from ray_tpu._private import profiling
+
+    with profiling.put_trace() as rec:
+        ray_tpu.put(b"first")
+        ray_tpu.put(b"second")          # not traced: arm is one-shot
+    stages = rec.get("stages") or {}
+    assert stages.get("path") == "inline"
+    # An untraced put leaves nothing behind.
+    ray_tpu.put(b"third")
+    assert profiling.take_put_trace() is None
+
+
+def test_put_stats_count_arena_puts(ray_shared):
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu._private.worker import global_worker
+
+    if global_worker().local_arena() is None:
+        pytest.skip("native arena unavailable (dict backend)")
+    before = profiling.put_stats()
+    ray_tpu.put(np.zeros(2 * 1024 * 1024, np.uint8))
+    after = profiling.put_stats()
+    assert after["arena_puts"] == before["arena_puts"] + 1
+    assert after["rpc_fallback_puts"] == before["rpc_fallback_puts"]
+
+
+def test_put_fallback_counted_with_cause(ray_shared):
+    """An unusable arena degrades to the agent RPC — but no longer
+    silently: the fallback is counted and its first cause recorded."""
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    saved = (w._arena, w._arena_tried, w._arena_fallback_cause)
+    w._arena, w._arena_tried = None, True
+    w._arena_fallback_cause = None
+    try:
+        before = profiling.put_stats()["rpc_fallback_puts"]
+        big = np.arange(1024 * 1024, dtype=np.float64)
+        ref = ray_tpu.put(big)
+        st = profiling.put_stats()
+        assert st["rpc_fallback_puts"] == before + 1
+        assert "arena unmapped" in st["first_fallback_cause"]
+        # The RPC path still stores the object correctly.
+        assert (ray_tpu.get(ref, timeout=60) == big).all()
+    finally:
+        w._arena, w._arena_tried, w._arena_fallback_cause = saved
+
+
+# -------------------------------------------------------- kv store auth
+def test_kv_token_roundtrip():
+    from ray_tpu._private.kv_snapshot import KvClient, KvStoreServer
+
+    srv = KvStoreServer(token="sekrit").start()
+    host, port = srv.addr.split(":")
+    try:
+        good = KvClient(host, int(port), token="sekrit")
+        good.set(b"k", b"v")
+        assert good.get(b"k") == b"v"
+        assert good.ping()
+    finally:
+        srv.stop()
+
+
+def test_kv_token_mismatch_is_clear_error():
+    from ray_tpu._private.kv_snapshot import KvClient, KvStoreServer
+
+    srv = KvStoreServer(token="sekrit").start()
+    host, port = srv.addr.split(":")
+    try:
+        bad = KvClient(host, int(port), token="wrong")
+        with pytest.raises(RuntimeError, match="auth failed"):
+            bad.set(b"k", b"v")
+        anon = KvClient(host, int(port), token="")
+        with pytest.raises(RuntimeError, match="auth required"):
+            anon.get(b"k")
+    finally:
+        srv.stop()
+
+
+def test_kv_tokened_client_on_open_server():
+    """A client with RAY_TPU_KV_TOKEN set still talks to a tokenless
+    server (the auth frame is accepted and ignored)."""
+    from ray_tpu._private.kv_snapshot import KvClient, KvStoreServer
+
+    srv = KvStoreServer(token="").start()
+    host, port = srv.addr.split(":")
+    try:
+        cli = KvClient(host, int(port), token="whatever")
+        cli.set(b"a", b"b")
+        assert cli.get(b"a") == b"b"
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- degraded-network hook
+def test_net_delay_env_delays_sends(monkeypatch):
+    """The delay hook is a LATENCY model: every message is held ~delay,
+    but concurrent messages overlap in flight (a sleep-per-send would
+    serialize the IO thread and make pipelining unobservable)."""
+    import zmq
+
+    from ray_tpu._private.rpc import IoThread
+
+    monkeypatch.setenv("RAY_TPU_NET_DELAY_MS", "150")
+    it = IoThread()          # private instance: reads the env at init
+    ctx = zmq.Context.instance()
+    a = ctx.socket(zmq.PAIR)
+    port = a.bind_to_random_port("tcp://127.0.0.1")
+    b = ctx.socket(zmq.PAIR)
+    b.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        t0 = time.perf_counter()
+        for _ in range(4):
+            it.send(a, [b"ping"], copy=True)
+        for _ in range(4):
+            assert b.recv_multipart(copy=True) == [b"ping"]
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.150, f"delay not applied: {elapsed:.3f}s"
+        assert elapsed < 3 * 0.150, (
+            f"sends serialized instead of overlapping: {elapsed:.3f}s")
+    finally:
+        it.unregister(a)     # closes on the IO thread (its owner)
+        time.sleep(0.2)
+        it.close()
+        b.close(0)
+
+
+def test_chunked_pull_pipelining_under_net_delay():
+    """VERDICT 'what's missing' #3, first step: under an injected ~15ms
+    per-send delay, a multi-chunk node-to-node pull must beat the
+    sequential-chunk floor — chunks overlap in flight
+    (transfer_chunks_in_flight) instead of paying one round trip each."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    delay_ms = 15.0
+    chunk = 128 * 1024
+    nbytes = 6 * 1024 * 1024            # 48 chunks, 8 in flight
+    os.environ["RAY_TPU_NET_DELAY_MS"] = str(delay_ms)
+    cluster = None
+    try:
+        cluster = Cluster(config_json=json.dumps(
+            {"transfer_chunk_bytes": chunk,
+             "transfer_chunks_in_flight": 8}))
+        cluster.start_head()
+        cluster.add_node(resources={"CPU": 2, "src": 1})
+        cluster.add_node(resources={"CPU": 2, "dst": 1})
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"dst": 0.1})
+        def fetch(wrapped):
+            got = ray_tpu.get(wrapped[0], timeout=120)
+            return int(got.nbytes)
+
+        # Warm a worker on the destination node so the timed window has
+        # no ~2s fork in it.
+        ray_tpu.get(fetch.remote([ray_tpu.put(np.zeros(1, np.uint8))]),
+                    timeout=120)
+        big = np.random.randint(0, 255, nbytes, np.uint8)
+        ref = ray_tpu.put(big)          # lands in the driver node's arena
+        t0 = time.perf_counter()
+        assert ray_tpu.get(fetch.remote([ref]), timeout=120) == nbytes
+        wall = time.perf_counter() - t0
+        # Sequential floor: every chunk pays request+reply sends through
+        # the delayed IO threads (2 x 15ms), back to back.
+        nchunks = nbytes // chunk
+        sequential_floor_s = nchunks * 2 * (delay_ms / 1e3)
+        assert wall < 0.7 * sequential_floor_s, (
+            f"pull took {wall:.2f}s vs sequential floor "
+            f"{sequential_floor_s:.2f}s — chunks are not pipelining")
+    finally:
+        os.environ.pop("RAY_TPU_NET_DELAY_MS", None)
+        try:
+            ray_tpu.shutdown()
+        finally:
+            if cluster is not None:
+                cluster.shutdown()
