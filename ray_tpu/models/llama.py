@@ -508,7 +508,8 @@ def init_paged_kv_cache(cfg: LlamaConfig, batch: int, n_pages: int,
 
 def scatter_prefill_pages(cache: dict, ks, vs, page_ids: jnp.ndarray,
                           rows: jnp.ndarray, slots: jnp.ndarray,
-                          true_lens: jnp.ndarray) -> dict:
+                          true_lens: jnp.ndarray,
+                          aligned: bool = True) -> dict:
     """Write a prefill wave's K/V into the page pool.
 
     ks/vs: [L, W, P, kvh, hd] from prefill(); page_ids/rows: [W, P]
@@ -524,11 +525,18 @@ def scatter_prefill_pages(cache: dict, ks, vs, page_ids: jnp.ndarray,
     scatters are the one indexed-write shape XLA:TPU cannot tile.
     Bucketed prompt lengths and power-of-two pages make every wave
     page-aligned in practice; the coordinate path remains as the
-    general fallback."""
+    general fallback — and is FORCED with aligned=False (prefix-cache
+    suffix waves start mid-span at per-request offsets, so rows don't
+    begin at 0)."""
     nk = len(cache["k"])
     W, P = page_ids.shape
     page = cache["k"][0].shape[2]
-    if P <= page:
+    if not aligned:
+        k = [cache["k"][li].at[page_ids, :, rows].set(ks[li])
+             for li in range(nk)]
+        v = [cache["v"][li].at[page_ids, :, rows].set(vs[li])
+             for li in range(nk)]
+    elif P <= page:
         # One (partial) page per wave member: block-write rows [0, P).
         pids0 = page_ids[:, 0]
         k = [cache["k"][li].at[pids0, :, :P, :].set(
@@ -557,6 +565,81 @@ def scatter_prefill_pages(cache: dict, ks, vs, page_ids: jnp.ndarray,
              for li in range(nk)]
     pos = cache["pos"].at[slots].set(true_lens)
     return {"k": k, "v": v, "pos": pos}
+
+
+def prefill_with_prefix(params: dict, tokens: jnp.ndarray,
+                        pos0: jnp.ndarray, cfg: LlamaConfig,
+                        k_pages: list, v_pages: list,
+                        prefix_table: jnp.ndarray,
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Suffix prompt pass over a CACHED paged prefix (the radix
+    prefix-cache fast path: prefill runs only on the tokens the cache
+    didn't cover).
+
+    tokens [b, S]: suffix tokens, right-padded; suffix token j sits at
+    absolute position pos0[b] + j.  pos0 [b]: per-request prefix length
+    (a multiple of the page size — the block manager matches full
+    blocks only, so suffix writes never land in a shared page).
+    k_pages/v_pages: per-layer page-pool leaves (READ-only here);
+    prefix_table [b, maxp]: the requests' page-table rows.
+
+    Each layer gathers its prefix rows dense ([b, maxp*page, kvh, hd] —
+    prefill-scale traffic, paid once per admitted wave, never during
+    decode) and runs GQA attention where suffix query i admits prefix
+    keys < pos0[b] plus suffix keys j <= i.  Layers are UNROLLED like
+    decode_step_paged: scanning would force the page pools into stacked
+    scan inputs, copying every pool per wave.
+
+    Returns (hidden [b, S, dim] post final norm, ks, vs [L, b, S, kvh,
+    hd]) — the same contract as prefill(), so the engine's page scatter
+    and first-token sampling reuse one code path for both."""
+    from ray_tpu.ops.paged_attention import gather_pages
+
+    b, S = tokens.shape
+    page = k_pages[0].shape[2]
+    Pp = prefix_table.shape[1] * page
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+    cos, sin = rope_frequencies(cfg.head_dim, Pp + S, cfg.rope_theta)
+    positions = pos0[:, None] + jnp.arange(S)[None, :]       # [b, S]
+    # Masks (shared by every layer): prefix keys admitted while they
+    # fall below the request's cached-prefix length; suffix keys are
+    # plain causal within the suffix.
+    prefix_admit = (jnp.arange(Pp)[None, None, :]
+                    < pos0[:, None, None])                   # [b, 1, Pp]
+    causal = (jnp.arange(S)[None, :, None]
+              >= jnp.arange(S)[None, None, :])               # [1, S, S]
+    admit = jnp.concatenate(
+        [jnp.broadcast_to(prefix_admit, (b, S, Pp)),
+         jnp.broadcast_to(causal, (b, S, S))], axis=2)       # [b, S, Pp+S]
+
+    ks_out, vs_out = [], []
+    for lid in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[lid], params["layers"])
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, S, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        ks_out.append(k.astype(cfg.dtype))
+        vs_out.append(v.astype(cfg.dtype))
+        pk = gather_pages(k_pages[lid], prefix_table)   # [b, Pp, kvh, hd]
+        pv = gather_pages(v_pages[lid], prefix_table)
+        ck = jnp.concatenate([pk, k.astype(cfg.dtype)], axis=1)
+        cv = jnp.concatenate([pv, v.astype(cfg.dtype)], axis=1)
+        qg = q.reshape(b, S, cfg.n_kv_heads, n_rep, cfg.head_dim)
+        a = jnp.einsum("bsgrd,bkgd->bgrsk", qg, ck,
+                       preferred_element_type=jnp.float32)
+        a *= cfg.head_dim ** -0.5
+        a = jnp.where(admit[:, None, None, :, :], a, -1e30)
+        probs = jax.nn.softmax(a, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bgrsk,bkgd->bsgrd", probs, cv)
+        o = o.reshape(b, S, cfg.n_heads * cfg.head_dim)
+        x = x + (o @ lp["wo"])
+        x = _mlp_block(x, lp, cfg)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return x, jnp.stack(ks_out), jnp.stack(vs_out)
 
 
 def decode_step_paged(params: dict, pages: dict, tails: dict,

@@ -201,6 +201,21 @@ def merge_tail_pages(pages, tail, page_table, tail_start, n_rows):
     return pages.at[pids, :, rows].set(value)
 
 
+def gather_pages(pages, page_table):
+    """Materialize per-slot dense KV windows from the page pool.
+
+    pages [n_pages, kvh, page, hd] + page_table [B, maxp] →
+    [B, maxp*page, kvh, hd].  The prefix-cache suffix prefill reads a
+    request's CACHED prefix rows through this gather (a one-shot,
+    prefill-scale HBM read — the decode path never materializes it);
+    rows past a slot's allocation resolve to the trash page and are
+    masked by the caller's prefix-length mask."""
+    B, maxp = page_table.shape
+    _, kvh, page, hd = pages.shape
+    g = pages[page_table]                      # [B, maxp, kvh, page, hd]
+    return g.transpose(0, 1, 3, 2, 4).reshape(B, maxp * page, kvh, hd)
+
+
 def paged_decode_reference(q, k_pages, v_pages, k_tail, v_tail,
                            page_table, pos, tail_start, *,
                            sm_scale: float | None = None):

@@ -6,7 +6,8 @@ stdlib HTTP proxy (SURVEY §2.3 / §3.5).
 from ray_tpu.serve.api import (HTTPOptions, delete, get_app_handle,
                                get_deployment_handle, get_replica_context,
                                grpc_port, http_port, ingress, list_proxies,
-                               proxy_ports, run, shutdown, start, status)
+                               proxy_ports, replica_metrics, run, shutdown,
+                               start, status)
 from ray_tpu.serve.schema import apply_config
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
@@ -20,6 +21,7 @@ __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "status", "delete", "get_app_handle", "get_deployment_handle",
     "http_port", "grpc_port", "proxy_ports", "list_proxies",
+    "replica_metrics",
     "apply_config", "ingress", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "Request",

@@ -161,6 +161,40 @@ class ServeController:
                 "max_ongoing": st.config.max_ongoing_requests,
             }
 
+    def replica_metrics(self, app_name: str | None = None) -> dict:
+        """Per-replica metrics incl. the user callable's own stats()
+        (e.g. the LLM engine's KV-cache hit/preempt counters) — the
+        serve state API's detail surface (ray: serve application
+        details' replica_details).  Fanned out OUTSIDE the lock: a slow
+        replica must not wedge the control loop."""
+        import ray_tpu
+
+        with self._lock:
+            targets = []
+            for an, app in self._apps.items():
+                if app_name is not None and an != app_name:
+                    continue
+                for dname, st in app["deployments"].items():
+                    for rid, rec in st.replicas.items():
+                        if rec["state"] == "RUNNING":
+                            targets.append((an, dname, rid,
+                                            rec["handle"]))
+        out: dict = {}
+        refs = []
+        for an, dname, rid, handle in targets:
+            try:
+                refs.append((an, dname, rid,
+                             handle.get_metrics.remote()))
+            except Exception:  # noqa: BLE001 - replica mid-restart
+                pass
+        for an, dname, rid, ref in refs:
+            try:
+                m = ray_tpu.get(ref, timeout=5.0)
+            except Exception:  # noqa: BLE001
+                m = {"error": "unreachable"}
+            out.setdefault(an, {}).setdefault(dname, {})[rid[:12]] = m
+        return out
+
     def get_app_routes(self) -> dict:
         """route_prefix -> (app, ingress deployment); polled by proxies
         (ray: long-poll route table push)."""

@@ -17,13 +17,30 @@ TPU-native shape (SURVEY §7 "Serve continuous batching on TPU"):
   - Sampling (greedy / temperature) happens on device; only the [B]
     next-token vector crosses to the host per step.
 
+Paged KV memory is managed by serve/kv_blocks.py (refcounted blocks,
+radix prefix cache, COW) — this file owns the SCHEDULER on top of it:
+  - admission matches each prompt's longest cached prefix and prefills
+    only the suffix (`prefill_from`);
+  - blocks are allocated lazily, one decode window ahead; when the pool
+    runs dry the NEWEST request is preempted (blocks committed to the
+    prefix cache + released, request re-queued for recompute);
+  - sampling keys are per-request (fold_in(engine key, request seed,
+    token index)), so a preempted-and-recomputed request draws the same
+    tokens it would have drawn uninterrupted — preemption is
+    deterministic under seeded sampling, hence testable.
+Kill switches: RAY_TPU_PREFIX_CACHE=0 disables prefix matching,
+RAY_TPU_KV_PREEMPT=0 restores full-span up-front allocation with FIFO
+head-of-line blocking (the pre-block-manager admission semantics).
+
 The engine loop runs on one thread inside the replica actor; requests
 arrive via a thread-safe queue and resolve concurrent.futures.Futures,
 so the Serve router's async path and the engine's step loop compose.
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures
+import os
 import queue
 import threading
 import time
@@ -31,6 +48,8 @@ from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
+
+from ray_tpu.serve.kv_blocks import BlockManager
 
 
 def _buckets_for(max_len: int, smallest: int = 32) -> list[int]:
@@ -40,6 +59,59 @@ def _buckets_for(max_len: int, smallest: int = 32) -> list[int]:
         b *= 2
     out.append(max_len)
     return out
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _engine_metrics():
+    """Process-wide serve-LLM metrics (utils.metrics registry → flushed
+    to the controller KV → dashboard /metrics Prometheus endpoint).
+    Tagged per engine so replicas don't clobber each other."""
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            from ray_tpu.utils import metrics as um
+
+            tk = ("engine",)
+            _METRICS = {
+                "prefill_tokens": um.get_or_create(
+                    um.Counter, "serve_llm_prefill_tokens",
+                    "Prompt tokens actually prefilled on device", tk),
+                "prefix_hit_tokens": um.get_or_create(
+                    um.Counter, "serve_llm_prefix_hit_tokens",
+                    "Prompt tokens served from the KV prefix cache", tk),
+                "decode_tokens": um.get_or_create(
+                    um.Counter, "serve_llm_decode_tokens",
+                    "Tokens decoded", tk),
+                "preemptions": um.get_or_create(
+                    um.Counter, "serve_llm_preemptions",
+                    "Requests preempted for KV blocks", tk),
+                "evictions": um.get_or_create(
+                    um.Counter, "serve_llm_kv_evictions",
+                    "Cached KV blocks LRU-evicted", tk),
+                "completed": um.get_or_create(
+                    um.Counter, "serve_llm_requests_completed",
+                    "Requests completed", tk),
+                "occupancy": um.get_or_create(
+                    um.Gauge, "serve_llm_batch_occupancy",
+                    "Active slots / max_batch", tk),
+                "free_blocks": um.get_or_create(
+                    um.Gauge, "serve_llm_kv_free_blocks",
+                    "Free KV blocks in the pool", tk),
+                "hit_rate": um.get_or_create(
+                    um.Gauge, "serve_llm_prefix_hit_rate",
+                    "Prefix-cache hit tokens / prompt tokens", tk),
+            }
+    return _METRICS
 
 
 @dataclass
@@ -56,8 +128,20 @@ class _Request:
     # Optional thread-safe sink for token streaming: every decoded token
     # is pushed as produced; None marks end-of-stream.
     token_queue: Any = None
-    # KV pages owned by this request (paged engine); freed at finish.
+    # KV blocks owned by this request, in table order (block i covers
+    # positions [i*page, (i+1)*page)); released at finish/preempt.
     pages: list[int] = field(default_factory=list)
+    # Per-request sampling identity: token at generation index g is
+    # drawn from fold_in(fold_in(engine_key, sample_seed), g) — timing,
+    # batching and preemption cannot change a request's sample stream.
+    sample_seed: int = 0
+    # First prompt position this admission actually prefills (everything
+    # below it came from the prefix cache; 0 = full prefill).
+    prefill_from: int = 0
+    # False for warmup traffic: never match or populate the prefix
+    # cache (warmup must compile the full-prefill bucket programs).
+    cache_ok: bool = True
+    preempted: int = 0
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -70,13 +154,17 @@ class LLMEngine:
     def __init__(self, cfg, params=None, *, max_batch: int = 8,
                  max_len: int | None = None, seed: int = 0,
                  steps_per_sync: int = 8, paged: bool = True,
-                 page_size: int = 512, kv_pages: int | None = None):
+                 page_size: int = 512, kv_pages: int | None = None,
+                 prefix_cache: bool | None = None,
+                 kv_preempt: bool | None = None,
+                 name: str = "llm"):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.models import llama
 
         self.cfg = cfg
+        self.name = name
         self.max_batch = max_batch
         self.max_len = max_len or cfg.max_seq
         # Decode steps per host round-trip.  Device→host sync latency is
@@ -88,6 +176,12 @@ class LLMEngine:
         self.params = params if params is not None else llama.init_params(
             jax.random.PRNGKey(seed), cfg)
         self.paged = paged
+        self._prefix_cache = paged and (
+            prefix_cache if prefix_cache is not None
+            else _env_on("RAY_TPU_PREFIX_CACHE"))
+        self._preempt_on = paged and (
+            kv_preempt if kv_preempt is not None
+            else _env_on("RAY_TPU_KV_PREEMPT"))
         if paged:
             # Shared page pool (ops/paged_attention.py): HBM holds the
             # page budget, NOT max_len x slots — max_len can be 32k+
@@ -101,7 +195,10 @@ class LLMEngine:
             self.n_pages = kv_pages
             self.cache = llama.init_paged_kv_cache(cfg, max_batch,
                                                    kv_pages, page_size)
-            self._free_pages = list(range(1, kv_pages))
+            # Host-side accounting: refcounted blocks + radix prefix
+            # index over pool ids 1..n_pages-1 (serve/kv_blocks.py).
+            self._mgr = BlockManager(kv_pages - 1, page_size,
+                                     prefix_cache=self._prefix_cache)
             self._table = np.zeros((max_batch, self._maxp), np.int32)
         else:
             # Dense per-layer cache leaves: the stacked [L, ...] cache
@@ -109,6 +206,7 @@ class LLMEngine:
             # decode step copied the whole cache.
             self.cache = llama.init_kv_cache_leaves(cfg, max_batch,
                                                     self.max_len)
+            self._mgr = None
         self._buckets = _buckets_for(self.max_len)
         # Prefill sub-wave cap: a full-width wave serializes the whole
         # burst's forward in front of EVERY first-token fetch (64x128
@@ -118,29 +216,48 @@ class LLMEngine:
         self._chunk = min(16, max_batch)
         self._width_buckets = sorted({w for w in (1, 8, self._chunk)
                                       if w <= max_batch})
-        self._rng = jax.random.PRNGKey(seed + 1)
+        # Per-request sampling base key (see _Request.sample_seed).
+        self._base_key = jax.random.PRNGKey(seed + 1)
 
-        # One compiled K-step decode program; cache donated (in-place).
-        def _sample(logits, temps, key):
+        def _sample_rows(logits, temps, keys):
+            """Per-row sampling: each row draws from ITS OWN key — the
+            sample stream belongs to the request, not to the batch."""
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            sampled = jax.random.categorical(
-                key, logits / jnp.maximum(temps, 1e-6)[:, None]
-            ).astype(jnp.int32)
+            sampled = jax.vmap(
+                lambda k_, l_, t_: jax.random.categorical(
+                    k_, l_ / jnp.maximum(t_, 1e-6)))(
+                        keys, logits, temps).astype(jnp.int32)
             return jnp.where(temps > 0, sampled, greedy)
 
-        def _decode_k_dense(params, cache, tokens, temps, rng, table):
-            def step(carry, key):
+        def _first_token(params, last_h, temps, seeds, starts):
+            last = (last_h @ params["lm_head"]).astype(jnp.float32)
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(
+                    jax.random.fold_in(self._base_key, s), t))(seeds,
+                                                               starts)
+            return _sample_rows(last, temps, keys)
+
+        # One compiled K-step decode program; cache donated (in-place).
+        def _decode_k_dense(params, cache, tokens, temps, table, seeds,
+                            starts):
+            lane_keys = jax.vmap(
+                lambda s: jax.random.fold_in(self._base_key, s))(seeds)
+
+            def step(carry, j):
                 cache, toks = carry
                 logits, cache = llama.decode_step_unrolled(
                     params, cache, toks, cfg)
-                nxt = _sample(logits, temps, key)
+                keys = jax.vmap(jax.random.fold_in)(lane_keys,
+                                                    starts + j)
+                nxt = _sample_rows(logits, temps, keys)
                 return (cache, nxt), nxt
 
-            keys = jax.random.split(rng, self.steps_per_sync)
-            (cache, last), seq = jax.lax.scan(step, (cache, tokens), keys)
+            (cache, last), seq = jax.lax.scan(
+                step, (cache, tokens), jnp.arange(self.steps_per_sync))
             return seq, last, cache   # seq [K, B]
 
-        def _decode_k_paged(params, cache, tokens, temps, rng, table):
+        def _decode_k_paged(params, cache, tokens, temps, table, seeds,
+                            starts):
             """Pages stay OUT of the scan carry (read-only during the
             block; a carried write would copy the whole pool every
             step); new rows ride a small dense tail, merged into the
@@ -155,18 +272,20 @@ class LLMEngine:
                            for _ in range(cfg.n_layers)],
                      "v": [jnp.zeros(tshape, cfg.dtype)
                            for _ in range(cfg.n_layers)]}
+            lane_keys = jax.vmap(
+                lambda s: jax.random.fold_in(self._base_key, s))(seeds)
 
-            def step(carry, xs):
+            def step(carry, j):
                 tails, pos, toks = carry
-                key, j = xs
                 logits, tails = llama.decode_step_paged(
                     params, pages, tails, toks, pos, ts, j, table, cfg)
-                nxt = _sample(logits, temps, key)
+                keys = jax.vmap(jax.random.fold_in)(lane_keys,
+                                                    starts + j)
+                nxt = _sample_rows(logits, temps, keys)
                 return (tails, pos + 1, nxt), nxt
 
-            keys = jax.random.split(rng, K)
             (tails, pos, last), seq = jax.lax.scan(
-                step, (tails, ts, tokens), (keys, jnp.arange(K)))
+                step, (tails, ts, tokens), jnp.arange(K))
             new_k = [merge_tail_pages(pages["k"][li], tails["k"][li],
                                       table, ts, K)
                      for li in range(cfg.n_layers)]
@@ -188,7 +307,7 @@ class LLMEngine:
         # written twice with identical data — harmless), so there is one
         # compile per prompt-length bucket, not per wave size.
         def _prefill_wave(params, cache, tokens, true_lens, slots, temps,
-                          rng):
+                          seeds, starts):
             W = tokens.shape[0]
             hidden, ks, vs = llama.prefill(params, tokens, cfg)
 
@@ -204,20 +323,11 @@ class LLMEngine:
             pos = cache["pos"].at[slots].set(true_lens)
             # Project only the W last-position rows through lm_head (the
             # full [W, P, vocab] logits tensor would be GBs at serving
-            # shapes).
+            # shapes).  Duplicate padding rows carry the same
+            # (seed, start), so they draw the SAME sample — cur-token
+            # and recorded token can't diverge under temperature.
             last_h = hidden[jnp.arange(W), true_lens - 1]    # [W, dim]
-            last = (last_h @ params["lm_head"]).astype(jnp.float32)
-            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            # Per-row keys folded from the SLOT index: duplicate padding
-            # rows (same slot, same logits, same temp) then draw the SAME
-            # sample, so cur-token and recorded token can't diverge under
-            # temperature sampling.
-            keys = jax.vmap(lambda s: jax.random.fold_in(rng, s))(slots)
-            sampled = jax.vmap(
-                lambda k_, l_, t_: jax.random.categorical(
-                    k_, l_ / jnp.maximum(t_, 1e-6)))(
-                        keys, last, temps).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
+            nxt = _first_token(params, last_h, temps, seeds, starts)
             return nxt, {"k": k, "v": v, "pos": pos}
 
         self._prefill = jax.jit(_prefill_wave, donate_argnums=(1,))
@@ -230,25 +340,51 @@ class LLMEngine:
         # them (round-5 serve-TTFT rework; the fused program measured
         # ~50ms slower per wave).
         def _prefill_fwd_only(params, tokens, true_lens, slots, temps,
-                              rng):
+                              seeds, starts):
             W = tokens.shape[0]
             hidden, ks, vs = llama.prefill(params, tokens, cfg)
             last_h = hidden[jnp.arange(W), true_lens - 1]
-            last = (last_h @ params["lm_head"]).astype(jnp.float32)
-            greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            keys = jax.vmap(lambda s: jax.random.fold_in(rng, s))(slots)
-            sampled = jax.vmap(
-                lambda k_, l_, t_: jax.random.categorical(
-                    k_, l_ / jnp.maximum(t_, 1e-6)))(
-                        keys, last, temps).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
+            nxt = _first_token(params, last_h, temps, seeds, starts)
             return nxt, ks, vs
 
         self._prefill_fwd = jax.jit(_prefill_fwd_only)
+
+        # Prefix-cache suffix prefill (program A'): forward ONLY the
+        # tokens the radix cache didn't cover, attending the cached
+        # prefix through the page pool (llama.prefill_with_prefix).
+        # Same split as above: the scatter rides program B.
+        def _prefill_suffix_fwd(params, kp, vp, tokens, pos0, prefix_t,
+                                last_idx, temps, seeds, starts):
+            W = tokens.shape[0]
+            hidden, ks, vs = llama.prefill_with_prefix(
+                params, tokens, pos0, cfg, kp, vp, prefix_t)
+            last_h = hidden[jnp.arange(W), last_idx]
+            nxt = _first_token(params, last_h, temps, seeds, starts)
+            return nxt, ks, vs
+
+        self._prefill_suffix = jax.jit(_prefill_suffix_fwd)
+
         self._scatter_pages = jax.jit(
             lambda cache, ks, vs, page_ids, rows, slots, true_lens:
             llama.scatter_prefill_pages(cache, ks, vs, page_ids, rows,
                                         slots, true_lens),
+            donate_argnums=(0,))
+        # Suffix scatters start mid-span (prefill_from), so the
+        # page-aligned fast paths don't apply — force the coordinate
+        # form (see scatter_prefill_pages).
+        self._scatter_pages_coord = jax.jit(
+            lambda cache, ks, vs, page_ids, rows, slots, true_lens:
+            llama.scatter_prefill_pages(cache, ks, vs, page_ids, rows,
+                                        slots, true_lens, aligned=False),
+            donate_argnums=(0,))
+        # COW page copy: duplicate shared blocks before a writer touches
+        # them.  Pairs are padded with (0, 0) — trash-to-trash is a
+        # no-op — so the compile count stays at a few pad widths.
+        self._copy_pages = jax.jit(
+            lambda cache, src, dst: {
+                "k": [l.at[dst].set(l[src]) for l in cache["k"]],
+                "v": [l.at[dst].set(l[src]) for l in cache["v"]],
+                "pos": cache["pos"]},
             donate_argnums=(0,))
 
         # Slot state.  Current tokens live ON DEVICE between blocks: the
@@ -257,15 +393,17 @@ class LLMEngine:
         self._slots: list[_Request | None] = [None] * max_batch
         self._cur_dev = jnp.zeros((max_batch,), jnp.int32)
         self._temps = np.zeros((max_batch,), np.float32)
+        self._seeds = np.zeros((max_batch,), np.int32)
         # Device copy of the page table, refreshed only when admission or
         # completion changed it (dense mode passes a constant dummy).
         self._table_dev = jnp.zeros((1, 1), jnp.int32)
         self._table_dirty = paged
-        # FIFO backpressure slot: a request whose pages don't fit yet
-        # (re-admitted first, never skipped past).
-        self._head_of_line: _Request | None = None
-        self._set_slots = jax.jit(
-            lambda cur, slots, toks: cur.at[slots].set(toks))
+        # Admission order: new submissions drain from the thread-safe
+        # queue into this deque; preempted requests re-enter at the
+        # FRONT (they keep their place — recompute, not starvation).
+        # The front request is the head-of-line FIFO barrier when the
+        # pool can't cover it yet.
+        self._pending: collections.deque[_Request] = collections.deque()
         self._waiting: queue.Queue[_Request] = queue.Queue()
         self._error: BaseException | None = None
         self._stop = threading.Event()
@@ -281,13 +419,23 @@ class LLMEngine:
         self._inflight_lock = threading.Lock()
         self._inflight_submits = 0
         self._last_submit_t = 0.0
+        self._next_seed = 0
         self.completed = 0
+        self.preemptions = 0
+        self.prefill_tokens = 0        # tokens actually prefilled
+        self.decode_tokens = 0
+        self._metrics_last: dict[str, float] = {}
+        self._metrics_t = 0.0
+        # stats() flushes from replica threads while the loop flushes on
+        # its own cadence; the delta bookkeeping must not double-count.
+        self._metrics_lock = threading.Lock()
 
     # ------------------------------------------------------------- public
     def submit(self, prompt: list[int], max_new_tokens: int = 32,
                temperature: float = 0.0,
                eos_id: int | None = None,
                token_queue: "queue.Queue | None" = None,
+               _cache_ok: bool = True,
                ) -> concurrent.futures.Future:
         """Thread-safe; resolves to {tokens, ttft_s, total_s}.  With
         `token_queue`, every decoded token is ALSO pushed to the queue as
@@ -314,10 +462,13 @@ class LLMEngine:
         with self._inflight_lock:
             self._inflight_submits += 1
             self._last_submit_t = time.perf_counter()
+            seed = self._next_seed
+            self._next_seed += 1
         try:
             req = _Request(list(prompt), max_new_tokens, temperature,
                            eos_id, concurrent.futures.Future(),
-                           token_queue=token_queue)
+                           token_queue=token_queue, sample_seed=seed,
+                           cache_ok=_cache_ok)
             self._waiting.put(req)
             self._wake.set()
         finally:
@@ -327,11 +478,12 @@ class LLMEngine:
 
     def generate(self, prompt: list[int], max_new_tokens: int = 32,
                  temperature: float = 0.0,
-                 eos_id: int | None = None) -> dict:
+                 eos_id: int | None = None,
+                 _cache_ok: bool = True) -> dict:
         """Blocking convenience wrapper."""
         self.start()
         return self.submit(prompt, max_new_tokens, temperature,
-                           eos_id).result()
+                           eos_id, _cache_ok=_cache_ok).result()
 
     def warmup(self, buckets: list[int] | None = None) -> None:
         """Pre-compile the decode program and prefill buckets so the first
@@ -339,14 +491,19 @@ class LLMEngine:
         standard TPU-serving warmup discipline).  Warmup prompts are
         capped by the paged pool's capacity — a pool sized below one
         full max_len span (the very configurations paging enables) must
-        not make warmup trip its own admission check."""
+        not make warmup trip its own admission check.  Warmup traffic
+        bypasses the prefix cache (_cache_ok=False): each bucket's
+        ramp prompt is a prefix of the next one's, and matching it
+        would compile the suffix programs instead of the full-prefill
+        bucket programs warmup exists to build."""
         cap = self.max_len - 1
         if getattr(self, "page", None):
             cap = min(cap, (self.n_pages - 1) * self.page - 1)
         for b in buckets or self._buckets:
             n = min(b, cap)
             if n >= 1:
-                self.generate(list(range(1, n + 1)), max_new_tokens=1)
+                self.generate(list(range(1, n + 1)), max_new_tokens=1,
+                              _cache_ok=False)
 
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -361,77 +518,158 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
 
+    def abort_pending(self, exc: BaseException) -> None:
+        """Fail every queued and in-flight request (call AFTER stop():
+        the loop thread must not be racing the slot table).  A stopped
+        engine would otherwise hang their futures forever — the replica
+        reconfigure path swaps engines mid-traffic."""
+        self._drain_requests(exc)
+
+    def _drain_requests(self, exc: BaseException) -> None:
+        for req in list(self._pending):
+            req.emit(None)
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self._pending.clear()
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.emit(None)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            self._slots[i] = None
+        while True:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.emit(None)
+            if not req.future.done():
+                req.future.set_exception(exc)
+
     # -------------------------------------------------------------- engine
+    def _reserve_blocks(self, req: _Request,
+                        copies: list[tuple[int, int]]) -> bool:
+        """Admission-time block reservation: match the longest cached
+        prefix, then allocate enough fresh blocks to cover the prompt
+        plus one decode window (the full remaining span when preemption
+        is off — the legacy admission contract).  Returns False with no
+        net state change when the pool can't cover it."""
+        mgr = self._mgr
+        seq = req.prompt + req.tokens       # resume includes generated
+        total = len(seq)
+        remaining = req.max_new_tokens - len(req.tokens)
+        matched = mgr.match(seq) if req.cache_ok else []
+        matched_tokens = len(matched) * self.page
+        cover = total + (min(remaining, self.steps_per_sync)
+                         if self._preempt_on else remaining)
+        need = max(0, -(-cover // self.page) - len(matched))
+        fresh = mgr.allocate(need)
+        if fresh is None:
+            mgr.release(matched)
+            return False
+        pages = matched + fresh
+        if matched_tokens >= total:
+            # Whole prompt cached: recompute only the LAST token (its
+            # logits seed the first sample).  That one write lands in
+            # the block holding position total-1 — the final MATCHED
+            # block, shared and sealed — so fork it first (COW).
+            li = (total - 1) // self.page
+            nb, copied = mgr.cow(pages[li])
+            if nb < 0:
+                mgr.release(pages)
+                return False
+            if copied:
+                copies.append((pages[li], nb))
+                pages[li] = nb
+            req.prefill_from = total - 1
+        else:
+            req.prefill_from = matched_tokens
+        req.pages = pages
+        return True
+
     def _admit(self) -> None:
         """Prefill a whole wave of waiting requests in ONE device call;
         one batched fetch materializes their first tokens."""
-        import jax
         import jax.numpy as jnp
 
+        while True:        # drain arrivals behind any preempted requests
+            try:
+                self._pending.append(self._waiting.get_nowait())
+            except queue.Empty:
+                break
         wave: list[tuple[int, _Request]] = []    # (slot, request)
+        copies: list[tuple[int, int]] = []       # COW (src, dst) pages
         grace_deadline = None
         while True:
             free = next((i for i, s in enumerate(self._slots)
                          if s is None), None)
             if free is None:
                 break
-            if self._head_of_line is not None:
-                req, self._head_of_line = self._head_of_line, None
-            else:
+            if not self._pending:
+                # Burst coalescing: submissions race admission, and a
+                # wave that launches a beat early strands the rest of
+                # the burst behind a full prefill+sync round (~120ms
+                # of loaded TTFT on a tunneled chip).  Once at least
+                # one request is in hand, linger a few ms so the
+                # whole burst rides ONE wave; idle requests never
+                # wait (no linger on an empty wave).
                 try:
-                    req = self._waiting.get_nowait()
+                    self._pending.append(self._waiting.get_nowait())
+                    continue
                 except queue.Empty:
-                    # Burst coalescing: submissions race admission, and a
-                    # wave that launches a beat early strands the rest of
-                    # the burst behind a full prefill+sync round (~120ms
-                    # of loaded TTFT on a tunneled chip).  Once at least
-                    # one request is in hand, linger a few ms so the
-                    # whole burst rides ONE wave; idle requests never
-                    # wait (no linger on an empty wave).
-                    if not wave:
-                        break
-                    if grace_deadline is None:
-                        with self._inflight_lock:
-                            busy = self._inflight_submits > 0
-                            last_t = self._last_submit_t
-                        if not busy and last_t <= max(
-                                r.submitted_at for _, r in wave):
-                            # Lone request(s): nobody is mid-submit and
-                            # nothing arrived after the requests already
-                            # in hand — launch NOW instead of lingering
-                            # the full grace ("idle requests never
-                            # wait"); bursts still coalesce because a
-                            # racing submit moves _last_submit_t.
-                            break
-                        grace_deadline = time.perf_counter() + 0.005
-                    rem = grace_deadline - time.perf_counter()
-                    if rem <= 0:
-                        break
-                    try:
-                        req = self._waiting.get(timeout=rem)
-                    except queue.Empty:
-                        break
-            if self.paged:
-                # Allocate the request's full page span up front (prompt
-                # + max_new_tokens) — no mid-decode growth, and the pool
-                # is the admission control: FIFO blocks when it's dry
-                # (vLLM-style KV backpressure).
-                need = -(-(len(req.prompt) + req.max_new_tokens)
-                         // self.page)
-                if len(self._free_pages) < need:
-                    self._head_of_line = req
+                    pass
+                if not wave:
                     break
-                req.pages = [self._free_pages.pop()
-                             for _ in range(need)]
+                if grace_deadline is None:
+                    with self._inflight_lock:
+                        busy = self._inflight_submits > 0
+                        last_t = self._last_submit_t
+                    if not busy and last_t <= max(
+                            r.submitted_at for _, r in wave):
+                        # Lone request(s): nobody is mid-submit and
+                        # nothing arrived after the requests already
+                        # in hand — launch NOW instead of lingering
+                        # the full grace ("idle requests never
+                        # wait"); bursts still coalesce because a
+                        # racing submit moves _last_submit_t.
+                        break
+                    grace_deadline = time.perf_counter() + 0.005
+                rem = grace_deadline - time.perf_counter()
+                if rem <= 0:
+                    break
+                try:
+                    self._pending.append(self._waiting.get(timeout=rem))
+                except queue.Empty:
+                    break
+                continue
+            req = self._pending[0]
+            if self.paged:
+                # The block pool is the admission control: the FRONT
+                # request blocks FIFO when free + evictable can't cover
+                # it (vLLM-style KV backpressure; nothing skips past).
+                if not self._reserve_blocks(req, copies):
+                    break
                 self._table[free, :] = 0
-                self._table[free, :need] = req.pages
+                self._table[free, :len(req.pages)] = req.pages
                 self._table_dirty = True
+            self._pending.popleft()
             req.slot = free
             self._slots[free] = req
             self._temps[free] = req.temperature
+            self._seeds[free] = req.sample_seed
             wave.append((free, req))
         if not wave:
             return
+        if copies:
+            # Materialize COW copies before any prefill reads/writes the
+            # forked pages (ordering rides the donated-cache dependency).
+            m = 1
+            while m < len(copies):
+                m *= 2
+            pairs = copies + [(0, 0)] * (m - len(copies))
+            self.cache = self._copy_pages(
+                self.cache, jnp.asarray([s for s, _ in pairs], jnp.int32),
+                jnp.asarray([d for _, d in pairs], jnp.int32))
         # Sub-waves of <=_chunk requests: dispatch every chunk's forward
         # (and, paged, its separate scatter program) back-to-back, THEN
         # fetch first tokens — chunk 1's round trip overlaps chunk 2's
@@ -440,49 +678,10 @@ class LLMEngine:
         pending_waves = []        # (chunk, nxt_device)
         for c0 in range(0, len(wave), self._chunk):
             chunk = wave[c0:c0 + self._chunk]
-            W = len(chunk)
-            bucket = next(b for b in self._buckets
-                          if b >= max(len(r.prompt) for _, r in chunk))
-            # Pad by duplicating the last row: the duplicate writes the
-            # same slot with the same data, so correctness is
-            # unaffected.  Width is BUCKETED (1 / 8 / _chunk), not
-            # always max_batch: an idle single request padded to a
-            # 64-wide wave paid 64x the prefill FLOPs it needed — the
-            # round-3 idle-TTFT regression.  Few widths × few length
-            # buckets keeps the compile count small.
-            padded_w = next(w for w in self._width_buckets if w >= W)
-            tokens = np.zeros((padded_w, bucket), np.int32)
-            true_lens = np.ones((padded_w,), np.int32)
-            slots = np.zeros((padded_w,), np.int32)
-            temps = np.zeros((padded_w,), np.float32)
-            for j in range(padded_w):
-                slot, req = chunk[min(j, W - 1)]
-                tokens[j, :len(req.prompt)] = req.prompt
-                true_lens[j] = len(req.prompt)
-                slots[j] = slot
-                temps[j] = req.temperature
-            self._rng, sub = jax.random.split(self._rng)
-            slots_dev = jnp.asarray(slots)
-            lens_dev = jnp.asarray(true_lens)
-            if self.paged:
-                cols = np.arange(bucket) // self.page
-                page_ids = self._table[slots][:, cols]  # [padded_w, bkt]
-                rows = np.tile(
-                    np.arange(bucket, dtype=np.int32) % self.page,
-                    (padded_w, 1))
-                nxt, ks, vs = self._prefill_fwd(
-                    self.params, jnp.asarray(tokens), lens_dev,
-                    slots_dev, jnp.asarray(temps), sub)
-                self.cache = self._scatter_pages(
-                    self.cache, ks, vs, jnp.asarray(page_ids),
-                    jnp.asarray(rows), slots_dev, lens_dev)
+            if self.paged and any(r.prefill_from > 0 for _, r in chunk):
+                nxt = self._prefill_chunk_suffix(chunk)
             else:
-                nxt, self.cache = self._prefill(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    lens_dev, slots_dev, jnp.asarray(temps), sub)
-            # Duplicate padding rows target the same slot + same token.
-            self._cur_dev = self._set_slots(self._cur_dev, slots_dev,
-                                            nxt)
+                nxt = self._prefill_chunk_full(chunk)
             pending_waves.append((chunk, nxt))
         for _, nxt in pending_waves:
             try:
@@ -493,30 +692,158 @@ class LLMEngine:
             firsts = np.asarray(nxt)[:len(chunk)]
             now = time.perf_counter()
             for (slot, req), first in zip(chunk, firsts):
-                req.first_token_at = now
+                if req.first_token_at is None:
+                    req.first_token_at = now
                 req.tokens.append(int(first))
                 req.emit(int(first))
                 if self._done(req):
                     self._finish(slot)
+
+    def _prefill_chunk_full(self, chunk):
+        """Full-prompt prefill (no cached prefix anywhere in the chunk):
+        the original bucketed wave path, byte-for-byte."""
+        import jax.numpy as jnp
+
+        W = len(chunk)
+        bucket = next(b for b in self._buckets
+                      if b >= max(len(r.prompt) + len(r.tokens)
+                                  for _, r in chunk))
+        # Pad by duplicating the last row: the duplicate writes the
+        # same slot with the same data, so correctness is
+        # unaffected.  Width is BUCKETED (1 / 8 / _chunk), not
+        # always max_batch: an idle single request padded to a
+        # 64-wide wave paid 64x the prefill FLOPs it needed — the
+        # round-3 idle-TTFT regression.  Few widths × few length
+        # buckets keeps the compile count small.
+        padded_w = next(w for w in self._width_buckets if w >= W)
+        tokens = np.zeros((padded_w, bucket), np.int32)
+        true_lens = np.ones((padded_w,), np.int32)
+        slots = np.zeros((padded_w,), np.int32)
+        temps = np.zeros((padded_w,), np.float32)
+        seeds = np.zeros((padded_w,), np.int32)
+        starts = np.zeros((padded_w,), np.int32)
+        for j in range(padded_w):
+            slot, req = chunk[min(j, W - 1)]
+            seq = req.prompt + req.tokens   # resume: recompute full seq
+            tokens[j, :len(seq)] = seq
+            true_lens[j] = len(seq)
+            slots[j] = slot
+            temps[j] = req.temperature
+            seeds[j] = req.sample_seed
+            starts[j] = len(req.tokens)
+        for _, req in chunk:
+            self.prefill_tokens += len(req.prompt) + len(req.tokens)
+        slots_dev = jnp.asarray(slots)
+        lens_dev = jnp.asarray(true_lens)
+        if self.paged:
+            cols = np.arange(bucket) // self.page
+            page_ids = self._table[slots][:, cols]  # [padded_w, bkt]
+            rows = np.tile(
+                np.arange(bucket, dtype=np.int32) % self.page,
+                (padded_w, 1))
+            nxt, ks, vs = self._prefill_fwd(
+                self.params, jnp.asarray(tokens), lens_dev,
+                slots_dev, jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(starts))
+            self.cache = self._scatter_pages(
+                self.cache, ks, vs, jnp.asarray(page_ids),
+                jnp.asarray(rows), slots_dev, lens_dev)
+        else:
+            nxt, self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(tokens),
+                lens_dev, slots_dev, jnp.asarray(temps),
+                jnp.asarray(seeds), jnp.asarray(starts))
+        # Duplicate padding rows target the same slot + same token.
+        self._cur_dev = self._cur_dev.at[slots_dev].set(nxt)
+        return nxt
+
+    def _prefill_chunk_suffix(self, chunk):
+        """Prefix-cache prefill: forward only each request's uncached
+        SUFFIX, attending the cached prefix through the page pool; the
+        suffix KV scatters at its absolute positions (prefill_from is a
+        page multiple — or the COW'd private page for a full match — so
+        shared pages are never written)."""
+        import jax.numpy as jnp
+
+        W = len(chunk)
+        suf = [len(r.prompt) + len(r.tokens) - r.prefill_from
+               for _, r in chunk]
+        bucket = next(b for b in self._buckets if b >= max(suf))
+        padded_w = next(w for w in self._width_buckets if w >= W)
+        tokens = np.zeros((padded_w, bucket), np.int32)
+        pos0 = np.zeros((padded_w,), np.int32)
+        last_idx = np.zeros((padded_w,), np.int32)
+        true_lens = np.ones((padded_w,), np.int32)
+        slots = np.zeros((padded_w,), np.int32)
+        temps = np.zeros((padded_w,), np.float32)
+        seeds = np.zeros((padded_w,), np.int32)
+        starts = np.zeros((padded_w,), np.int32)
+        for j in range(padded_w):
+            slot, req = chunk[min(j, W - 1)]
+            seq = req.prompt + req.tokens
+            suffix = seq[req.prefill_from:]
+            tokens[j, :len(suffix)] = suffix
+            pos0[j] = req.prefill_from
+            last_idx[j] = len(suffix) - 1
+            true_lens[j] = len(seq)
+            slots[j] = slot
+            temps[j] = req.temperature
+            seeds[j] = req.sample_seed
+            starts[j] = len(req.tokens)
+        for _, req in chunk:
+            self.prefill_tokens += (len(req.prompt) + len(req.tokens)
+                                    - req.prefill_from)
+        # Scatter coordinates at ABSOLUTE positions: suffix token p of
+        # slot b lands at pos0[b] + p; positions past the allocated
+        # span resolve to the trash page via the zeroed table columns.
+        apos = np.minimum(pos0[:, None] + np.arange(bucket)[None, :],
+                          self._maxp * self.page - 1)
+        cols = (apos // self.page).astype(np.int64)
+        page_ids = np.take_along_axis(self._table[slots], cols, axis=1)
+        rows = (apos % self.page).astype(np.int32)
+        slots_dev = jnp.asarray(slots)
+        nxt, ks, vs = self._prefill_suffix(
+            self.params, self.cache["k"], self.cache["v"],
+            jnp.asarray(tokens), jnp.asarray(pos0),
+            jnp.asarray(self._table[slots]), jnp.asarray(last_idx),
+            jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(starts))
+        self.cache = self._scatter_pages_coord(
+            self.cache, ks, vs, jnp.asarray(page_ids),
+            jnp.asarray(rows), slots_dev, jnp.asarray(true_lens))
+        self._cur_dev = self._cur_dev.at[slots_dev].set(nxt)
+        return nxt
 
     def _done(self, req: _Request) -> bool:
         return (len(req.tokens) >= req.max_new_tokens
                 or (req.eos_id is not None
                     and req.tokens[-1] == req.eos_id))
 
+    def _release_slot(self, slot: int, req: _Request) -> None:
+        """Commit the request's computed full blocks into the prefix
+        cache, then drop its references (cached blocks stay resident
+        but evictable; private ones free).  KV is valid only below
+        prompt+tokens-1: the newest token's K/V hasn't been written,
+        and rows past a lane's early finish hold trimmed overshoot."""
+        if not (self.paged and req.pages):
+            return
+        kv_valid = len(req.prompt) + len(req.tokens) - 1
+        if req.cache_ok:
+            self._mgr.commit(req.prompt + req.tokens,
+                             req.pages[:kv_valid // self.page])
+        self._mgr.release(req.pages)
+        req.pages = []
+        # The freed slot's future (garbage) decode writes go to the
+        # trash page once the zeroed table row reaches the device
+        # (next _admit or dirty refresh — both before the pages can
+        # be re-issued to a new request).
+        self._table[slot, :] = 0
+        self._table_dirty = True
+
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
         self._slots[slot] = None
         self.completed += 1
-        if self.paged and req.pages:
-            # The freed slot's future (garbage) decode writes go to the
-            # trash page once the zeroed table row reaches the device
-            # (next _admit or dirty refresh — both before the pages can
-            # be re-issued to a new request).
-            self._free_pages.extend(req.pages)
-            req.pages = []
-            self._table[slot, :] = 0
-            self._table_dirty = True
+        self._release_slot(slot, req)
         now = time.perf_counter()
         req.emit(None)
         if not req.future.done():
@@ -526,6 +853,61 @@ class LLMEngine:
                 "total_s": now - req.submitted_at,
             })
 
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict a running request from its slot: its blocks go to the
+        prefix cache (so recompute usually prefix-hits them if nobody
+        claims the memory first) and it re-enters the pending queue at
+        the FRONT.  Tokens already streamed stay valid — per-request
+        sampling keys make the recomputed continuation identical."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._seeds[slot] = 0
+        self._release_slot(slot, req)
+        req.slot = -1
+        req.preempted += 1
+        self.preemptions += 1
+        self._pending.appendleft(req)
+
+    def _ensure_decode_blocks(self) -> list[int]:
+        """Block-budget scheduling before each decode block: every
+        active slot needs real pages under the next K merge positions.
+        Oldest requests are funded first; when the pool (free +
+        evictable) runs dry, the NEWEST active request is preempted and
+        recomputed later — deterministic, and the oldest request can
+        always make progress (its full span fits the pool by the
+        submit-time check).  Returns the surviving active slots."""
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not self.paged or not active:
+            return active
+        for slot in sorted(active,
+                           key=lambda i: self._slots[i].sample_seed):
+            req = self._slots[slot]
+            if req is None:                  # preempted this round
+                continue
+            total = len(req.prompt) + len(req.tokens)
+            cover = min(total - 1 + self.steps_per_sync,
+                        len(req.prompt) + req.max_new_tokens)
+            need = -(-cover // self.page) - len(req.pages)
+            if need <= 0:
+                continue
+            got = self._mgr.allocate(need)
+            while got is None and self._preempt_on:
+                victims = [i for i, s in enumerate(self._slots)
+                           if s is not None]
+                victim = max(victims,
+                             key=lambda i: self._slots[i].sample_seed)
+                self._preempt_slot(victim)
+                if victim == slot:
+                    break
+                got = self._mgr.allocate(need)
+            if got is None or self._slots[slot] is None:
+                continue
+            req.pages.extend(got)
+            self._table[slot, :len(req.pages)] = req.pages
+            self._table_dirty = True
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
     def _loop(self) -> None:
         try:
             self._loop_inner()
@@ -534,66 +916,112 @@ class LLMEngine:
             # death would hang their futures forever, and the donated
             # cache is invalid after a failed call anyway.
             self._error = e
-            if self._head_of_line is not None:
-                req, self._head_of_line = self._head_of_line, None
-                req.emit(None)
-                if not req.future.done():
-                    req.future.set_exception(e)
-            for i, req in enumerate(self._slots):
-                if req is not None:
-                    req.emit(None)
-                    if not req.future.done():
-                        req.future.set_exception(e)
-                self._slots[i] = None
-            while True:
-                try:
-                    req = self._waiting.get_nowait()
-                except queue.Empty:
-                    break
-                req.emit(None)
-                if not req.future.done():
-                    req.future.set_exception(e)
+            self._drain_requests(e)
             self._stop.set()
             raise
 
     def _loop_inner(self) -> None:
-        import jax
         import jax.numpy as jnp
 
         while not self._stop.is_set():
             self._admit()
-            active = [i for i, s in enumerate(self._slots)
-                      if s is not None]
+            active = self._ensure_decode_blocks()
+            self._flush_metrics()
             if not active:
-                self._wake.wait(timeout=0.05)
+                if self._pending:
+                    # Head-of-line request waiting on blocks with no
+                    # active decode to free them: only finished-and-
+                    # cached blocks can help — _admit retries (allocate
+                    # evicts refcount-0 leaves), so just avoid a busy
+                    # spin.
+                    self._wake.wait(timeout=0.002)
+                else:
+                    self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
-            self._rng, sub = jax.random.split(self._rng)
             if self._table_dirty:
                 self._table_dev = jnp.asarray(self._table) if self.paged \
                     else jnp.zeros((1, 1), jnp.int32)
                 self._table_dirty = False
+            starts = np.zeros((self.max_batch,), np.int32)
+            for i in active:
+                starts[i] = len(self._slots[i].tokens)
             seq, last, self.cache = self._decode(
                 self.params, self.cache, self._cur_dev,
-                jnp.asarray(self._temps), sub, self._table_dev)
+                jnp.asarray(self._temps), self._table_dev,
+                jnp.asarray(self._seeds), jnp.asarray(starts))
             self._cur_dev = last                # stays on device
             seq = np.asarray(seq)               # the ONE sync per block
             for i in active:
                 req = self._slots[i]
+                if req is None:
+                    continue
                 for tok in seq[:, i]:
                     req.tokens.append(int(tok))
+                    self.decode_tokens += 1
                     req.emit(int(tok))
                     if self._done(req):
                         # Trim K-step overshoot past EOS/max_new_tokens.
                         self._finish(i)
                         break
 
+    def _flush_metrics(self, force: bool = False) -> None:
+        """Export engine/cache counters as process metrics (→ controller
+        KV → dashboard /metrics).  Counters flush as deltas against the
+        last snapshot; throttled to ~1 Hz so the loop never stalls on
+        the registry lock."""
+        now = time.monotonic()
+        if not force and now - self._metrics_t < 1.0:
+            return
+        try:
+            m = _engine_metrics()
+        except Exception:  # noqa: BLE001 - metrics must never stop decode
+            return
+        tags = {"engine": self.name}
+        cur = {"prefill_tokens": self.prefill_tokens,
+               "decode_tokens": self.decode_tokens,
+               "preemptions": self.preemptions,
+               "completed": self.completed}
+        if self._mgr is not None:
+            cur["prefix_hit_tokens"] = self._mgr.hit_tokens
+            cur["evictions"] = self._mgr.evictions
+        with self._metrics_lock:
+            self._metrics_t = now
+            for key, val in cur.items():
+                delta = val - self._metrics_last.get(key, 0)
+                if delta > 0:
+                    m[key].inc(delta, tags)
+                self._metrics_last[key] = val
+        m["occupancy"].set(
+            sum(s is not None for s in self._slots) / self.max_batch,
+            tags)
+        if self._mgr is not None:
+            m["free_blocks"].set(self._mgr.free_count(), tags)
+            seen = self._mgr.hit_tokens + self.prefill_tokens
+            m["hit_rate"].set(
+                self._mgr.hit_tokens / seen if seen else 0.0, tags)
+
     def stats(self) -> dict:
-        return {"completed": self.completed,
-                "active": sum(s is not None for s in self._slots),
-                "waiting": self._waiting.qsize(),
-                "max_batch": self.max_batch,
-                "max_len": self.max_len}
+        out = {"completed": self.completed,
+               "active": sum(s is not None for s in self._slots),
+               "waiting": self._waiting.qsize() + len(self._pending),
+               "max_batch": self.max_batch,
+               "max_len": self.max_len,
+               "preemptions": self.preemptions,
+               "prefill_tokens": self.prefill_tokens,
+               "decode_tokens": self.decode_tokens,
+               "prefix_cache": self._prefix_cache,
+               "kv_preempt": self._preempt_on}
+        if self._mgr is not None:
+            kv = self._mgr.stats()
+            out["kv"] = kv
+            out["prefix_hits"] = kv["hits"]
+            out["prefix_misses"] = kv["misses"]
+            out["prefix_hit_tokens"] = kv["hit_tokens"]
+            out["evictions"] = kv["evictions"]
+            out["cow_copies"] = kv["cow_copies"]
+        self._flush_metrics(force=True)
+        return out
 
 
 class LLMServer:
@@ -601,19 +1029,41 @@ class LLMServer:
 
     serve.deployment(LLMServer).options(...) — requests carry token-id
     prompts; a tokenizer front can be composed as another deployment.
+    Engine memory knobs (page_size / kv_pages / prefix_cache /
+    kv_preempt) are operator-tunable through `engine_config` in the
+    declarative deploy config (serve/schema.py) and through
+    `reconfigure` (user_config), which rebuilds the engine in place.
     """
 
     def __init__(self, model: str = "debug", *, max_batch: int = 8,
                  max_len: int | None = None, params=None, seed: int = 0,
                  warmup: bool = False, paged: bool = True,
-                 page_size: int = 512, kv_pages: int | None = None):
+                 page_size: int = 512, kv_pages: int | None = None,
+                 prefix_cache: bool | None = None,
+                 kv_preempt: bool | None = None,
+                 steps_per_sync: int = 8):
         from ray_tpu.models import llama
 
         cfg = llama.llama_configs()[model] if isinstance(model, str) \
             else model
-        self.engine = LLMEngine(cfg, params, max_batch=max_batch,
-                                max_len=max_len, seed=seed, paged=paged,
-                                page_size=page_size, kv_pages=kv_pages)
+        name = "llm"
+        try:
+            from ray_tpu.serve import replica as _replica
+
+            ctx = _replica.get_current_context()
+            if ctx is not None and ctx.deployment:
+                name = ctx.deployment
+        except Exception:  # noqa: BLE001 - outside a replica
+            pass
+        self._engine_kwargs = dict(
+            max_batch=max_batch, max_len=max_len, seed=seed, paged=paged,
+            page_size=page_size, kv_pages=kv_pages,
+            prefix_cache=prefix_cache, kv_preempt=kv_preempt,
+            steps_per_sync=steps_per_sync, name=name)
+        self._cfg = cfg
+        self._params = params
+        self._warmup = warmup
+        self.engine = LLMEngine(cfg, params, **self._engine_kwargs)
         self.engine.start()
         if warmup:
             self.engine.warmup()
@@ -659,7 +1109,53 @@ class LLMServer:
     def stats(self) -> dict:
         return self.engine.stats()
 
+    def reconfigure(self, user_config: dict) -> None:
+        """Apply engine knobs from a declarative config without a code
+        change (serve/schema.py engine_config or user_config; the same
+        key set, including the operator-facing `kv_blocks` name).
+        Knobs that reshape device memory rebuild the engine; the old
+        engine's thread is stopped FIRST (deterministic teardown, not
+        GC) and any requests it still held fail with a clear error —
+        the controller applies config-only changes without draining, so
+        a silent stop would hang those futures forever."""
+        if not user_config:
+            return
+        from ray_tpu.serve.schema import ENGINE_CONFIG_KEYS
+
+        allowed = ENGINE_CONFIG_KEYS | {"kv_pages", "paged"}
+        unknown = set(user_config) - allowed
+        if unknown:
+            raise ValueError(
+                f"unknown engine_config keys {sorted(unknown)}; "
+                f"valid: {sorted(allowed)}")
+        cfg = dict(user_config)
+        if "kv_blocks" in cfg:
+            cfg["kv_pages"] = cfg.pop("kv_blocks")
+        kwargs = {**self._engine_kwargs, **cfg}
+        if kwargs == self._engine_kwargs:
+            return
+        old = self.engine
+        old.stop()
+        old.abort_pending(RuntimeError(
+            "LLM engine rebuilt by reconfigure; resubmit the request"))
+        self._engine_kwargs = kwargs
+        self.engine = LLMEngine(self._cfg, self._params, **kwargs)
+        self.engine.start()
+        if self._warmup:
+            self.engine.warmup()
+
+    def shutdown(self) -> None:
+        """Explicit close hook: Replica.prepare_for_shutdown calls this
+        on teardown/drain (serve reconfigure, rolling update, app
+        delete), so the engine thread stops deterministically instead
+        of at GC time.  Replica drain waits out in-flight requests
+        first; anything still queued fails instead of hanging."""
+        self.engine.stop()
+        self.engine.abort_pending(
+            RuntimeError("LLM engine shut down with the replica"))
+
     def __del__(self):
+        # GC backstop only — the deterministic path is shutdown().
         try:
             self.engine.stop()
         except Exception:  # noqa: BLE001

@@ -144,10 +144,23 @@ class Replica:
         return self._num_ongoing
 
     async def get_metrics(self) -> dict:
-        return {"num_ongoing": self._num_ongoing,
-                "num_processed": self._num_processed,
-                "max_ongoing": self._max_ongoing,
-                "ts": time.time()}
+        out = {"num_ongoing": self._num_ongoing,
+               "num_processed": self._num_processed,
+               "max_ongoing": self._max_ongoing,
+               "ts": time.time()}
+        # Surface the user callable's own stats() (e.g. the LLM engine's
+        # cache hit/preempt counters) through the serve state API, not
+        # only via direct handle calls.
+        fn = getattr(self._instance, "stats", None)
+        if fn is not None:
+            try:
+                r = fn()
+                if inspect.isawaitable(r):
+                    r = await r
+                out["user_stats"] = r
+            except Exception:  # noqa: BLE001 - stats must not fail probes
+                pass
+        return out
 
     async def check_health(self) -> bool:
         """User class may define check_health; raising marks unhealthy

@@ -15,6 +15,15 @@ from typing import Any
 from ray_tpu.serve.deployment import Application
 
 
+# LLM engine memory knobs an operator may set per deployment in the
+# declarative config, without code changes (they land in the servable's
+# init kwargs — see LLMServer).  kv_blocks is the operator-facing name
+# for the page-pool size (engine kwarg kv_pages).
+ENGINE_CONFIG_KEYS = {"page_size", "kv_blocks", "prefix_cache",
+                      "kv_preempt", "max_batch", "max_len",
+                      "steps_per_sync"}
+
+
 @dataclasses.dataclass
 class DeploymentSchema:
     """Per-deployment override block (ray: DeploymentSchema)."""
@@ -25,6 +34,9 @@ class DeploymentSchema:
     user_config: Any = None
     autoscaling_config: dict | None = None
     ray_actor_options: dict | None = None
+    # KV-cache / batching knobs for LLM deployments (serve/llm.py):
+    # merged into the deployment's init kwargs at apply time.
+    engine_config: dict | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "DeploymentSchema":
@@ -32,6 +44,13 @@ class DeploymentSchema:
         unknown = set(d) - known
         if unknown:
             raise ValueError(f"unknown deployment config keys {unknown}")
+        ec = d.get("engine_config")
+        if ec is not None:
+            bad = set(ec) - ENGINE_CONFIG_KEYS
+            if bad:
+                raise ValueError(
+                    f"unknown engine_config keys {sorted(bad)}; valid: "
+                    f"{sorted(ENGINE_CONFIG_KEYS)}")
         return cls(**d)
 
 
@@ -83,8 +102,17 @@ class ApplicationSchema:
             if ov is None:
                 continue
             opts = {k: v for k, v in dataclasses.asdict(ov).items()
-                    if k != "name" and v is not None}
-            node.deployment = node.deployment.options(**opts)
+                    if k not in ("name", "engine_config")
+                    and v is not None}
+            if opts:
+                node.deployment = node.deployment.options(**opts)
+            if ov.engine_config:
+                # Operator-tunable engine memory: kv_blocks is the
+                # config-facing name for the engine's kv_pages kwarg.
+                ec = dict(ov.engine_config)
+                if "kv_blocks" in ec:
+                    ec["kv_pages"] = ec.pop("kv_blocks")
+                node.init_kwargs = {**node.init_kwargs, **ec}
         if overrides:
             raise ValueError(
                 f"config overrides for unknown deployments: "

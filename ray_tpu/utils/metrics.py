@@ -112,6 +112,31 @@ class Histogram(Metric):
         return base
 
 
+def get_or_create(cls, name: str, description: str = "",
+                  tag_keys: Sequence[str] | None = None, **kwargs):
+    """Idempotent metric handle: return the registered metric when one
+    of the same name and type exists, else create it.  Library code
+    that may instantiate many times per process (e.g. one serve LLM
+    engine per replica, many per test run) must use this instead of the
+    constructor — re-constructing replaces the registry entry and
+    silently drops the accumulated series."""
+    with _registry_lock:
+        m = _registry.get(name)
+    if m is None:
+        # The constructor registers itself (under the lock); two racing
+        # creators both construct, the registry keeps the last writer —
+        # re-read and return THAT one so every caller holds the same
+        # handle and no series is silently dropped.
+        cls(name, description, tag_keys=tag_keys, **kwargs)
+        with _registry_lock:
+            m = _registry[name]
+    if type(m) is not cls:
+        raise TypeError(
+            f"metric {name!r} already registered as "
+            f"{type(m).__name__}, requested {cls.__name__}")
+    return m
+
+
 def _ensure_flusher() -> None:
     """Push local metric snapshots to the controller KV (the metrics-agent
     export path, collapsed)."""

@@ -22,3 +22,11 @@ def build_echo():
             return {"echo": x}
 
     return Echo.bind()
+
+
+def build_llm():
+    """Debug-scale LLM app for declarative engine_config tests."""
+    from ray_tpu import serve
+
+    return serve.deployment(serve.LLMServer).options(name="LLM").bind(
+        "debug", max_batch=2, max_len=64, page_size=16)

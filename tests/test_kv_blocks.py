@@ -1,0 +1,211 @@
+"""KV block manager unit tests: refcounts, radix prefix matching, LRU
+eviction (never of in-use blocks), COW, and a property-style allocator
+hammer (random alloc/free/fork/commit sequences must leak nothing and
+double-free nothing — kv_blocks.check() asserts the full partition
+after every op)."""
+import random
+
+import pytest
+
+from ray_tpu.serve.kv_blocks import BlockManager
+
+
+def test_allocate_free_roundtrip():
+    m = BlockManager(4, 8)
+    a = m.allocate(3)
+    assert a == [1, 2, 3]
+    assert m.free_count() == 1
+    assert m.allocate(2) is None          # only 1 left, no partial take
+    assert m.free_count() == 1
+    m.release(a)
+    assert m.free_count() == 4
+    m.check()
+
+
+def test_allocate_rejects_overcommit_without_touching_state():
+    m = BlockManager(2, 4)
+    a = m.allocate(1)
+    before = m.stats()
+    assert m.allocate(5) is None
+    assert m.stats() == before
+    m.release(a)
+    m.check()
+
+
+def test_release_double_free_raises():
+    m = BlockManager(2, 4)
+    a = m.allocate(1)
+    m.release(a)
+    with pytest.raises(ValueError, match="double free"):
+        m.release(a)
+
+
+def test_match_commit_refcounts():
+    m = BlockManager(8, 4)
+    toks = list(range(10))                # 2 full chunks + remainder
+    blocks = m.allocate(3)
+    m.commit(toks, blocks[:2])            # only full chunks cached
+    m.release(blocks)
+    m.check()
+    assert m.cached_count() == 2
+    assert m.free_count() == 6            # the uncommitted block freed
+    got = m.match(toks)
+    assert got == blocks[:2]
+    assert m.hit_tokens == 8 and m.hits == 1
+    # Matched blocks are referenced: not evictable, pool can't reclaim.
+    assert m.evictable_count() == 0
+    assert m.allocate(7) is None
+    m.release(got)
+    assert m.evictable_count() == 2
+    m.check()
+
+
+def test_match_is_longest_prefix():
+    m = BlockManager(8, 4)
+    a = m.allocate(2)
+    m.commit(list(range(8)), a)
+    m.release(a)
+    # Same first chunk, different second chunk: one-block match.
+    got = m.match(list(range(4)) + [99, 98, 97, 96])
+    assert got == [a[0]]
+    m.release(got)
+    # No chunk in common: miss.
+    assert m.match([50] * 8) == []
+    assert m.misses == 1
+    m.check()
+
+
+def test_lru_eviction_leaf_first_and_never_in_use():
+    m = BlockManager(4, 2)
+    a = m.allocate(2)
+    m.commit([1, 2, 3, 4], a)             # chain 1 -> 2
+    m.release(a)
+    b = m.allocate(1)
+    m.commit([9, 9], b)                   # separate, younger leaf
+    m.release(b)
+    assert m.free_count() == 1 and m.evictable_count() == 3
+    # Hold a ref on the chain's LEAF: its parent must not be evicted
+    # either (the path above a referenced block stays matchable).
+    held = m.match([1, 2, 3, 4])
+    assert held == a
+    assert m.evictable_count() == 1       # only b's block
+    got = m.allocate(2)
+    assert got is not None                # 1 free + evict b
+    assert m.evictions == 1
+    assert m.match([9, 9]) == []          # b's entry is gone
+    m.release(held)
+    m.release(got)
+    m.check()
+
+
+def test_lru_prefers_oldest():
+    m = BlockManager(3, 2)
+    a = m.allocate(1)
+    m.commit([1, 1], a)
+    m.release(a)
+    b = m.allocate(1)
+    m.commit([2, 2], b)
+    m.release(b)
+    m.match([1, 1])                       # touch a -> b is now LRU
+    m.release([a[0]])
+    m.allocate(2)                         # evicts exactly one: b
+    assert m.match([2, 2]) == []
+    assert m.match([1, 1]) == a
+    m.check()
+
+
+def test_cow_exclusive_vs_shared():
+    m = BlockManager(4, 4)
+    a = m.allocate(1)
+    # Exclusive private block: writable as-is.
+    nb, copied = m.cow(a[0])
+    assert nb == a[0] and not copied
+    # Cached block (tree-resident): a writer must get a copy even at
+    # refcount 1 — sealed content other requests may still match.
+    m.commit([1, 2, 3, 4], a)
+    nb, copied = m.cow(a[0])
+    assert copied and nb != a[0]
+    assert m.cow_copies == 1
+    m.release([nb])
+    m.check()
+    # Shared between two holders: second holder's write copies too.
+    got = m.match([1, 2, 3, 4])
+    m.retain(got)
+    nb2, copied2 = m.cow(got[0])
+    assert copied2 and nb2 != got[0]
+    m.release([nb2])
+    m.release(got)
+    m.check()
+
+
+def test_cow_fails_clean_when_pool_dry():
+    m = BlockManager(1, 4)
+    a = m.allocate(1)
+    m.commit([1, 2, 3, 4], a)
+    nb, copied = m.cow(a[0])              # no block left for the copy
+    assert nb == -1 and not copied
+    m.release(a)
+    m.check()
+
+
+def test_commit_duplicate_chunk_keeps_existing():
+    m = BlockManager(4, 4)
+    a = m.allocate(1)
+    m.commit([1, 2, 3, 4], a)
+    m.release(a)
+    b = m.allocate(1)
+    m.commit([1, 2, 3, 4], b)             # same content, later writer
+    m.release(b)                          # b frees (existing node wins)
+    assert m.cached_count() == 1
+    assert m.free_count() == 3
+    assert m.match([1, 2, 3, 4]) == a
+    m.release(a)
+    m.check()
+
+
+def test_hammer_random_ops_no_leaks():
+    """Property-style allocator hammer: random alloc/free/fork(COW)/
+    match/commit sequences; the free/managed partition must hold after
+    EVERY op and all blocks must be accounted for at the end."""
+    rng = random.Random(1234)
+    m = BlockManager(24, 4)
+    held: list[list[int]] = []            # block lists we hold refs on
+    seqs: list[list[int]] = []            # token seqs we committed
+    for step in range(2000):
+        op = rng.random()
+        if op < 0.35:
+            n = rng.randint(1, 4)
+            got = m.allocate(n)
+            if got is not None:
+                held.append(got)
+        elif op < 0.55 and held:
+            blocks = held.pop(rng.randrange(len(held)))
+            if rng.random() < 0.5 and blocks:
+                toks = [rng.randint(0, 6)
+                        for _ in range(len(blocks) * m.page)]
+                m.commit(toks, blocks)
+                seqs.append(toks)
+            m.release(blocks)
+        elif op < 0.7 and seqs:
+            got = m.match(seqs[rng.randrange(len(seqs))])
+            if got:
+                held.append(got)
+        elif op < 0.85 and held and held[-1]:
+            blocks = held[-1]
+            i = rng.randrange(len(blocks))
+            nb, _copied = m.cow(blocks[i])
+            if nb > 0:
+                blocks[i] = nb
+        elif held:
+            blocks = held.pop(rng.randrange(len(held)))
+            m.retain(blocks)
+            m.release(blocks)
+            held.append(blocks)
+        m.check()
+    for blocks in held:
+        m.release(blocks)
+    m.check()
+    assert m.free_count() + m.cached_count() == m.n_blocks
+    # Everything cached is reclaimable once nothing holds refs.
+    assert m.evictable_count() == m.cached_count()
+    assert m.allocate(m.n_blocks) is not None

@@ -252,6 +252,14 @@ def test_llm_deployment_through_serve(serve_instance):
     for r in results:
         assert len(r["tokens"]) == 5
         assert r["ttft_s"] > 0
+    # Engine counters surface through the serve state API (round 8):
+    # replica get_metrics carries the user callable's stats() dict.
+    rm = serve.replica_metrics("llm_app")
+    replicas = rm["llm_app"]["llm"]
+    assert replicas
+    stats = next(iter(replicas.values()))["user_stats"]
+    assert stats["completed"] >= 4
+    assert "prefix_hit_tokens" in stats
     serve.delete("llm_app")
 
 
