@@ -515,6 +515,108 @@ import os; os._exit(0)
     return out
 
 
+def bench_chaos_recovery() -> dict:
+    """MTTR rows (ISSUE 4): kill-to-first-successful-call recovery time,
+    tracked like any perf metric so a regression in death detection →
+    restart → first call shows up in the round compare (lower is
+    better; the *_ms suffix is wired into _vs_previous_round).
+
+      worker-kill: SIGKILL a restartable actor's worker process; clock
+        stops when a call on the SAME handle succeeds on the restarted
+        incarnation (reaper poll → actor restart → address re-resolve).
+      node-kill:   hard-kill the node agent hosting an actor that CAN
+        be re-placed (its custom resource exists on a surviving node);
+        clock stops when a call succeeds on the replacement (heartbeat
+        timeout → node death → actor reschedule on the other node).
+    """
+    import os
+    import signal
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out = {}
+    # ---- worker kill ----------------------------------------------------
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4})
+    try:
+        @ray_tpu.remote(max_restarts=10, max_task_retries=10)
+        class Ping:
+            def pid(self):
+                import os as _os
+
+                return _os.getpid()
+
+            def ping(self):
+                return "ok"
+
+        a = Ping.remote()
+        pid = ray_tpu.get(a.pid.remote(), timeout=GET_T)
+        t0 = time.perf_counter()
+        os.kill(pid, signal.SIGKILL)
+        assert ray_tpu.get(a.ping.remote(), timeout=120) == "ok"
+        out["chaos_recovery_worker_kill_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+    except Exception as e:  # noqa: BLE001 - phases are independent
+        out["chaos_recovery_worker_kill_error"] = repr(e)
+    finally:
+        ray_tpu.shutdown()
+    # ---- node kill ------------------------------------------------------
+    cluster = None
+    try:
+        # Setup inside the try: a cluster-boot failure must record an
+        # error row, not discard the worker-kill row measured above.
+        cluster = Cluster()
+        cluster.start_head()
+        n1 = cluster.add_node(resources={"CPU": 2, "slot": 1})
+        n2 = cluster.add_node(resources={"CPU": 2, "slot": 1})
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(max_restarts=10, max_task_retries=10,
+                        num_cpus=0.5, resources={"slot": 0.5})
+        class Pinned:
+            def node(self):
+                import ray_tpu as _rt
+
+                return _rt.get_runtime_context().get_node_id()
+
+            def ping(self):
+                return "ok"
+
+        a = Pinned.remote()
+        host_node = ray_tpu.get(a.node.remote(), timeout=120)
+        victim = n1 if n1["node_id"] == host_node else n2
+        t0 = time.perf_counter()
+        cluster.kill_node(victim)
+        # Clock stops only when a call answers from the SURVIVING node:
+        # a bare post-kill ping can win the race against the dying
+        # worker's pdeathsig and "recover" in ms without any failover.
+        deadline = time.monotonic() + 180
+        while True:
+            try:
+                where = ray_tpu.get(a.node.remote(), timeout=30)
+                if where != host_node:
+                    break
+            except Exception:  # noqa: BLE001 - mid-failover churn
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError("node-kill recovery timed out")
+            time.sleep(0.05)
+        out["chaos_recovery_node_kill_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+    except Exception as e:  # noqa: BLE001 - keep the worker-kill row:
+        # one flaky phase must not wipe BOTH MTTR rows from the round.
+        out["chaos_recovery_node_kill_error"] = repr(e)
+    finally:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+    return out
+
+
 def bench_put_path() -> dict:
     """Same-run A/B of the arena write path (ISSUE 2): one fresh driver
     puts 256 MiB with the streaming kernel / parallel writer / free-space
@@ -1055,8 +1157,10 @@ def _vs_previous_round(extra: dict) -> dict:
             continue
         if key.endswith(("_per_s", "_gib_per_s")):
             worse = val < 0.7 * pv          # throughput: higher is better
-        elif key.endswith("_s"):
-            worse = val > pv / 0.7          # wall-time rows: lower is better
+        elif key.endswith(("_s", "_ms")):
+            # Wall-time rows (incl. the chaos_recovery_*_ms MTTR rows):
+            # lower is better.
+            worse = val > pv / 0.7
         else:
             continue
         if worse:
@@ -1091,6 +1195,16 @@ def main() -> None:
         extra.update(_with_timeout(bench_put_path, 300))
     except Exception as e:  # noqa: BLE001
         extra["put_path_error"] = repr(e)
+    _flush_partial(extra)
+    try:
+        # Umbrella must exceed the SUM of the phases' internal deadlines
+        # (worker-kill ~200s worst case; node-kill boot + 120s placement
+        # + 180s recovery deadline + one trailing 30s get ≈ 400s): a
+        # tighter alarm would discard the worker-kill row a slow-but-in-
+        # budget node-kill phase already measured.
+        extra.update(_with_timeout(bench_chaos_recovery, 640))
+    except Exception as e:  # noqa: BLE001
+        extra["chaos_recovery_error"] = repr(e)
     _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_compiled_dag, 300))

@@ -15,9 +15,17 @@
 // Exit 0 = clean; 65 = data corruption; TSAN/ASAN report exits with the
 // sanitizer's own exitcode (the test sets exitcode=66).
 //
+//   - Crash-in-window mode: `crashwriter` processes alloc a block, write
+//     half of it, and SIGKILL THEMSELVES between alloc and seal — the
+//     exact window the runtime's arena.alloc/arena.copy failpoints hit.
+//     The orchestrator spawns one per chaos iteration under concurrent
+//     writers and asserts, after the sweep, that the half-created object
+//     is not observable (index-publish-last) and that its bytes drain.
+//
 // usage: store_hammer orchestrate <shm> <writers> <readers> <seconds>
 //        store_hammer writer <shm> <widx> <seconds>
 //        store_hammer reader <shm> <nwriters> <seconds>
+//        store_hammer crashwriter <shm> <widx> <seconds-ignored>
 
 #include <algorithm>
 #include <atomic>
@@ -193,6 +201,34 @@ int run_reader(const char* shm, int nwriters, double seconds) {
   return failures.load() ? 65 : 0;
 }
 
+// Deterministic id namespace for crash-in-window allocs: disjoint from
+// the writer/reader namespace (readers must never try to verify a block
+// that by construction is never sealed).
+void make_crash_id(uint8_t id[16], int widx) {
+  std::memset(id, 0, 16);
+  id[0] = static_cast<uint8_t>(200 + (widx % 40));
+  id[1] = static_cast<uint8_t>(widx / 40 + 1);
+  id[15] = 0xc5;
+}
+
+int run_crashwriter(const char* shm, int widx) {
+  void* h = rt_store_open(shm);
+  if (!h) return 64;
+  uint8_t id[16];
+  make_crash_id(id, widx);
+  unsigned seed = getpid();
+  uint64_t size = 256 + (rand_r(&seed) % 8192);
+  uint64_t off = rt_store_alloc(h, id, size);
+  if (off) {
+    // Half-written creating-state block: the crash window between
+    // alloc and seal that the runtime's put pipeline can die in.
+    uint8_t* base = rt_store_base(h);
+    for (uint64_t i = 0; i < size / 2; i++) base[off + i] = 0xee;
+  }
+  kill(getpid(), SIGKILL);  // die IN the window — no abort, no seal
+  return 63;                // unreachable
+}
+
 pid_t spawn(const char* self, const char* mode, const char* shm,
             int arg, double seconds) {
   pid_t pid = fork();
@@ -223,12 +259,27 @@ int run_orchestrate(const char* self, const char* shm, int writers,
   unsigned seed = 42;
   double deadline = now_s() + seconds;
   int iter = 0;
+  int crash_rc = 0;
   while (now_s() < deadline) {
     usleep(200 * 1000);
     int victim = rand_r(&seed) % rpids.size();
     kill(rpids[victim], SIGKILL);
     waitpid(rpids[victim], nullptr, 0);
+    // Crash-in-window: a process allocs + half-writes a block and
+    // SIGKILLs itself between alloc and seal, under the live writer
+    // churn.  After the sweep its entry must be GONE — never visible
+    // as an object (index-publish-last) and its bytes reclaimed.
+    pid_t cw = spawn(self, "crashwriter", shm, iter, 0.0);
+    waitpid(cw, nullptr, 0);
     rt_store_sweep_dead(h);
+    uint8_t cid[16];
+    make_crash_id(cid, iter);
+    uint64_t coff = 0, csz = 0;
+    if (rt_store_contains(h, cid) || rt_store_get(h, cid, &coff, &csz)) {
+      fprintf(stderr, "crash-window alloc %d observable after sweep\n",
+              iter);
+      crash_rc = 65;
+    }
     if (++iter % 3 == 0) {
       // Race the write-prefault pass (claim free blocks / touch / abort)
       // against live writers and the sweep — the claims must never be
@@ -239,7 +290,7 @@ int run_orchestrate(const char* self, const char* shm, int writers,
                           deadline - now_s() + 0.1);
   }
 
-  int rc = 0;
+  int rc = crash_rc;
   for (pid_t p : wpids) {
     int st = 0;
     waitpid(p, &st, 0);
@@ -267,7 +318,10 @@ int run_orchestrate(const char* self, const char* shm, int writers,
       }
   uint64_t used = 0, cap = 0, n = 0;
   rt_store_stats(h, &used, &cap, &n);
-  if (n != 0) {
+  // Bytes too, not just the object count: a sweep that dropped a
+  // half-created index entry but stranded its allocated blocks would
+  // leak exactly the bytes the crash-window mode exists to catch.
+  if (n != 0 || used != 0) {
     fprintf(stderr, "arena not drained: %llu objects, %llu bytes\n",
             (unsigned long long)n, (unsigned long long)used);
     if (rc == 0) rc = 65;
@@ -290,5 +344,7 @@ int main(int argc, char** argv) {
     return run_writer(shm, atoi(argv[3]), atof(argv[4]));
   if (mode == "reader" && argc >= 5)
     return run_reader(shm, atoi(argv[3]), atof(argv[4]));
+  if (mode == "crashwriter" && argc >= 4)
+    return run_crashwriter(shm, atoi(argv[3]));
   return 62;
 }

@@ -16,6 +16,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any
 
+# Tombstone left in reply_cache when a large reply's payload is trimmed:
+# the execution COMPLETED — only its (big) result was dropped to bound
+# memory.  A resend that hits it must NOT re-execute the method
+# (at-most-once for stateful actors); it gets an explicit "reply
+# evicted" error instead of a silently double-applied side effect.
+REPLY_EVICTED = "reply-evicted"
+
 
 @dataclass
 class StreamState:
@@ -119,8 +126,10 @@ class ActorInstance:
     def cache_reply(self, key: tuple, task) -> None:
         # Window ≥ the max inflight depth (batch_size × inflight batches
         # = 1024): a retransmit always targets calls that were in
-        # flight.  Large replies evict on completion — memory stays
-        # bounded and big results fall back to at-least-once.
+        # flight.  Large replies shed their payload on completion —
+        # memory stays bounded — but leave a REPLY_EVICTED tombstone so
+        # a resend still dedupes (it gets an error, not a re-execution;
+        # the reply-resend watchdog depends on this for at-most-once).
         self.reply_cache[key] = task
         while len(self.reply_cache) > 1024:
             self.reply_cache.popitem(last=False)
@@ -133,8 +142,8 @@ class ActorInstance:
             if isinstance(r, tuple) and len(r) == 2 and sum(
                     len(b) for b in r[1]
                     if isinstance(b, (bytes, bytearray, memoryview))
-                    ) > 65536:
-                self.reply_cache.pop(key, None)
+                    ) > 65536 and key in self.reply_cache:
+                self.reply_cache[key] = REPLY_EVICTED
 
         task.add_done_callback(_trim)
 

@@ -74,6 +74,17 @@ class Config:
     # async/threaded actors).
     actor_call_batch_size: int = 64
     actor_max_inflight_batches: int = 16
+    # Reply watchdog for in-flight actor calls: a reply lost in transit
+    # (dropped message, wedged-but-alive peer) would otherwise park the
+    # caller forever — zmq never surfaces it.  After this many seconds
+    # without a reply the call is RESENT with its original seqno; the
+    # receiver's reply cache / in-flight dedupe returns the original
+    # execution's result without re-running, so the resend is safe for
+    # stateful methods.  (Replies >64KiB shed their payload from the
+    # cache on completion; a resend that hits the tombstone gets an
+    # explicit "reply evicted" error — still never a re-execution.)
+    # 0 disables (pre-round-9 behavior).
+    actor_reply_resend_s: float = 60.0
     # Node-to-node object transfer: chunk size + parallel chunk window
     # (ray: 64MB chunks, 8 in flight — object_manager.cc:508).
     transfer_chunk_bytes: int = 64 * 1024 * 1024

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+from ray_tpu._private import failpoints
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private.config import Config
 from ray_tpu._private.rpc import ClientPool, Publisher, RpcServer
@@ -763,6 +764,13 @@ class Controller:
 
             async def _reserve_node(node_id: str, idxs: list[int]) -> set:
                 try:
+                    # Failpoint window: mid-reserve-wave on the
+                    # controller side (error = this node's grants are
+                    # abandoned and STRICT rollback must release the
+                    # others; crash = controller restart must restore PG
+                    # state from the snapshot).
+                    if failpoints.ACTIVE:
+                        await failpoints.fire_async("controller.reserve_wave")
                     reply, _ = await self.clients.get(
                         self.nodes[node_id].agent_addr).call(
                         "reserve_bundles",
@@ -954,6 +962,31 @@ class Controller:
         self.jobs[h["job_id"]] = {"state": "RUNNING", "start": time.time(),
                                   "driver_addr": h.get("driver_addr")}
         return {}
+
+    async def rpc_failpoints(self, h: dict, _b: list) -> dict:
+        """Cluster-wide fault-injection control verb: apply to the
+        controller itself and, with broadcast=True, fan out to every
+        ALIVE agent (each of which fans out to its workers)."""
+        local = failpoints.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        if h.get("broadcast"):
+            # Concurrent fan-out: per-agent calls are independent, and a
+            # wedged-but-ALIVE agent (exactly what this subsystem tests)
+            # must cost ONE 15s timeout, not 15s × unreachable agents.
+            alive = [n for n in list(self.nodes.values())
+                     if n.state == "ALIVE"]
+
+            async def _one(node):
+                try:
+                    reply, _ = await self.clients.get(node.agent_addr).call(
+                        "failpoints", h, timeout=15.0)
+                    return node.node_id, reply
+                except Exception as e:  # noqa: BLE001 - node churning
+                    return node.node_id, {"error": repr(e)}
+
+            local["nodes"] = dict(await asyncio.gather(
+                *(_one(n) for n in alive)))
+        return local
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:
         return {"pong": True, "t": time.time(),

@@ -34,6 +34,7 @@ logger = logging.getLogger(__name__)
 #   RAY_TPU_PUT_STREAM=0    -> never call the non-temporal write kernel
 #   RAY_TPU_PUT_PARALLEL=0  -> never split a frame across copy threads
 #   RAY_TPU_ARENA_PREFAULT=0-> skip the free-space write-prefault pass
+from ray_tpu._private import failpoints
 from ray_tpu._private.config import DEFAULT as _DEFAULT_CONFIG
 
 DEFAULT_STREAM_MIN = _DEFAULT_CONFIG.put_stream_min_bytes
@@ -314,6 +315,13 @@ class Arena:
         if off == 0:
             return False
         try:
+            # Failpoint window: the block is allocated (creating state)
+            # but nothing is written yet — a crash here leaves a
+            # half-created entry only the dead-pid sweep can reclaim; an
+            # error must take the abort path below (the process is
+            # alive, so nothing else would ever reclaim the block).
+            if failpoints.ACTIVE:
+                failpoints.fire("arena.alloc")
             hdr = struct.pack("<I", len(frames)) + struct.pack(
                 f"<{len(lens)}Q", *lens)
             self._map[off:off + len(hdr)] = hdr
@@ -321,6 +329,11 @@ class Arena:
                 n = len(f)
                 if n:
                     self._write_frame(off + fo, f, n, trace)
+            # Failpoint window: bytes copied, seal not yet reached — an
+            # error here exercises the abort path below; a crash here
+            # exercises the EOWNERDEAD/creating-state crash sweep.
+            if failpoints.ACTIVE:
+                failpoints.fire("arena.copy")
         except BaseException:
             # Never leak a creating-state block: abort the allocation so
             # the entry doesn't sit unreclaimable until a crash sweep.
@@ -329,6 +342,10 @@ class Arena:
         if trace is not None:
             trace["copy_done"] = time.monotonic()
         self.lib.rt_store_seal(self.handle, oid)
+        # Failpoint window: sealed but the owner record has not published
+        # yet (worker.put_object's "put.publish" is the layer above).
+        if failpoints.ACTIVE:
+            failpoints.fire("arena.seal")
         if trace is not None:
             trace["seal_done"] = time.monotonic()
         return True

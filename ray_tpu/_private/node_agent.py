@@ -25,12 +25,14 @@ import contextlib
 import itertools
 import logging
 import os
+import signal
 import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
 
 
+from ray_tpu._private import failpoints
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID
@@ -225,7 +227,38 @@ class NodeAgent:
         # cluster cannot leave orphan agents running on every executor.
         exit_after = float(os.environ.get("RAY_TPU_EXIT_ON_HEAD_LOSS", 0))
         last_ok = time.monotonic()
+
+        def _exit_if_head_lost() -> None:
+            # Shared by the real unreachable-controller path and the
+            # agent.heartbeat=drop injection — a dropped beat must still
+            # honor RAY_TPU_EXIT_ON_HEAD_LOSS, or the injected fault
+            # diverges from the real one it models.
+            if (exit_after > 0
+                    and time.monotonic() - last_ok > exit_after):
+                logger.error(
+                    "controller unreachable for %.0fs and "
+                    "RAY_TPU_EXIT_ON_HEAD_LOSS is set; exiting",
+                    time.monotonic() - last_ok)
+                os._exit(1)
+
         while not self._closed:
+            # Failpoint window: the liveness signal itself (drop = this
+            # beat never reaches the controller; enough dropped beats and
+            # the node is declared dead while its work still runs).  An
+            # injected `error` loses this one beat too — it must never
+            # escape and kill the loop, or the node could NEVER rejoin
+            # after the site is cleared.
+            if failpoints.ACTIVE:
+                try:
+                    dropped = await failpoints.fire_async("agent.heartbeat")
+                except Exception:  # noqa: BLE001 - injected
+                    logger.warning("agent.heartbeat failpoint: injected "
+                                   "error -> beat skipped")
+                    dropped = True
+                if dropped:
+                    _exit_if_head_lost()
+                    await asyncio.sleep(self.config.heartbeat_period_s)
+                    continue
             try:
                 reply, _ = await self.clients.get(self.controller_addr).call(
                     "heartbeat",
@@ -240,21 +273,27 @@ class NodeAgent:
                          "resources": self.resources}, timeout=30.0)
                 last_ok = time.monotonic()
             except Exception:  # noqa: BLE001
-                if (exit_after > 0
-                        and time.monotonic() - last_ok > exit_after):
-                    logger.error(
-                        "controller unreachable for %.0fs and "
-                        "RAY_TPU_EXIT_ON_HEAD_LOSS is set; exiting",
-                        time.monotonic() - last_ok)
-                    os._exit(1)
+                _exit_if_head_lost()
             await asyncio.sleep(self.config.heartbeat_period_s)
 
     async def _on_resource_view(self, _topic: str, payload: dict) -> None:
         self.cluster_view = payload["view"]
 
     async def _on_node_event(self, _topic: str, payload: dict) -> None:
+        addr = payload.get("agent_addr")
         if payload.get("event") == "dead":
             self.cluster_view.pop(payload["node_id"], None)
+            if addr and addr != self.server.address:
+                # Fail in-flight transfers to the dead agent NOW (a
+                # chunked pull would otherwise wait out its 120s RPC
+                # timeout before the getter can try lineage) and refuse
+                # new ones until the node provably rejoins.
+                if self.store is not None:
+                    self.store.dead_addrs.add(addr)
+                self.clients.drop(addr)
+        elif payload.get("event") == "alive":
+            if addr and self.store is not None:
+                self.store.dead_addrs.discard(addr)
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, device_worker: bool = False,
@@ -601,6 +640,12 @@ class NodeAgent:
                 pass
 
     async def _on_worker_dead(self, w: WorkerHandle) -> None:
+        if w.proc is not None and w.proc.returncode == -signal.SIGKILL:
+            # A SIGKILLed worker while a one-shot crash failpoint is
+            # armed in THIS agent: presume the worker fired it, and
+            # scrub it from our env before the replacement (spawned
+            # with {**os.environ}) inherits it and crashes too.
+            failpoints.on_child_sigkill()
         prev_state = w.state
         # Capture BEFORE _release_lease_resources nulls them — the
         # worker_died notify below must name the lease and reach the
@@ -811,6 +856,11 @@ class NodeAgent:
             return await self._park(h)
         self._acquire(h)
         try:
+            # Failpoint window: resources acquired, grant not yet replied
+            # (error = the release path must run; crash = the agent dies
+            # holding the acquisition — node death frees everything).
+            if failpoints.ACTIVE:
+                await failpoints.fire_async("agent.lease_grant")
             if h.get("resources", {}).get("TPU", 0) > 0 or h.get("device_worker"):
                 w = await self._get_device_worker()
             else:
@@ -1030,6 +1080,13 @@ class NodeAgent:
         with the single verb."""
         granted = []
         for b in h["bundles"]:
+            # Failpoint window: mid-reservation-wave — some bundles of
+            # this PG are already reserved on this node, the reply is
+            # not sent (crash = the controller sees the whole node call
+            # fail and must roll back the OTHER nodes' grants; the dead
+            # node's reservations die with it).
+            if failpoints.ACTIVE:
+                await failpoints.fire_async("agent.reserve_bundles")
             if self._reserve_one_bundle(h["pg_id"], b["bundle_index"],
                                         b["resources"]):
                 granted.append(b["bundle_index"])
@@ -1047,6 +1104,32 @@ class NodeAgent:
             self._release_one_bundle(h["pg_id"], idx)
         self._try_grant_pending()
         return {}
+
+    async def rpc_failpoints(self, h: dict, _b: list) -> dict:
+        """Fault-injection control verb: apply to THIS agent and, with
+        broadcast=True, fan out to every live worker it supervises (the
+        "reach already-running processes" leg of failpoint propagation —
+        env inheritance only covers processes spawned after arming)."""
+        local = failpoints.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        if h.get("broadcast"):
+            sub = {k: v for k, v in h.items() if k != "broadcast"}
+            live = [w for w in list(self.workers.values())
+                    if w.addr and w.state not in ("dead", "stopping")]
+
+            # Concurrent fan-out (see controller.rpc_failpoints): a
+            # wedged worker costs one 10s timeout, not 10s × stragglers.
+            async def _one(w):
+                try:
+                    reply, _ = await self.clients.get(w.addr).call(
+                        "failpoints", sub, timeout=10.0)
+                    return w.worker_id, reply
+                except Exception as e:  # noqa: BLE001 - worker churning
+                    return w.worker_id, {"error": repr(e)}
+
+            local["workers"] = dict(await asyncio.gather(
+                *(_one(w) for w in live)))
+        return local
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:
         states: dict[str, int] = {}

@@ -23,6 +23,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu._private import failpoints
+
 logger = logging.getLogger(__name__)
 
 
@@ -228,6 +230,10 @@ class StoreRunner:
         # a single transfer (and never mistake a sibling's creating-state
         # allocation for a full arena).
         self._pulling: dict[bytes, asyncio.Future] = {}
+        # Agent addresses of DEAD nodes (maintained by the node agent
+        # from controller "node" events): transfers skip them instead of
+        # waiting out the RPC timeout against a silent zmq reconnect.
+        self.dead_addrs: set[str] = set()
 
     @property
     def shm_name(self) -> str:
@@ -459,6 +465,11 @@ class StoreRunner:
         """One raw slice of the frame bundle (pinned zero-copy view)."""
         oid = bytes.fromhex(h["object_id"])
         off, length = h["offset"], h["length"]
+        # Failpoint window: the SOURCE node serving one chunk of a
+        # multi-chunk transfer (crash = source dies mid-pull; the puller
+        # must fall back to other locations or lineage).
+        if failpoints.ACTIVE:
+            await failpoints.fire_async("store.serve_chunk")
         raw_fn = getattr(self.backend, "get_raw", None)
         raw = raw_fn(oid) if raw_fn is not None else None
         if raw is None:
@@ -501,6 +512,11 @@ class StoreRunner:
             async with sem:
                 if failed.is_set():
                     return
+                if addr in self.dead_addrs:
+                    # Source died mid-pull: abandon NOW (a fresh client
+                    # to the dead address would hang out the timeout).
+                    failed.set()
+                    return
                 try:
                     reply, blobs = await self._clients.get(addr).call(
                         "store_get_chunk",
@@ -512,9 +528,22 @@ class StoreRunner:
                 if not reply.get("found") or not self.backend.write_raw(
                         oid, off, blobs[0]):
                     failed.set()
+                    return
+                # Failpoint window: a chunk boundary of the PULLING node
+                # — the destination block is creating-state; a crash here
+                # leaves it for the dead-pid sweep.
+                if failpoints.ACTIVE:
+                    await failpoints.fire_async("store.pull_chunk")
 
-        await asyncio.gather(*[fetch(off)
-                               for off in range(0, size, chunk)])
+        # return_exceptions: an exception escaping a fetch (e.g. an
+        # injected store.pull_chunk error) must reach the abort below,
+        # not propagate past it — a live process's creating-state block
+        # is invisible to the dead-pid sweep and would leak forever.
+        results = await asyncio.gather(
+            *[fetch(off) for off in range(0, size, chunk)],
+            return_exceptions=True)
+        if any(isinstance(r, BaseException) for r in results):
+            failed.set()
         if failed.is_set():
             self.backend.abort_raw(oid)
             return False
@@ -553,6 +582,8 @@ class StoreRunner:
                 return True
         chunked_ok = hasattr(self.backend, "create_raw")
         for addr in h.get("from", []):
+            if addr in self.dead_addrs:
+                continue
             if chunked_ok:
                 try:
                     meta, _ = await self._clients.get(addr).call(
@@ -570,6 +601,11 @@ class StoreRunner:
                 # Fall through to the whole-object path: it handles
                 # objects larger than the arena (spill-to-disk landing)
                 # and transient chunk failures.
+            if addr in self.dead_addrs:
+                # The source died DURING the chunked attempt above: a
+                # whole-object retry against it would burn the full RPC
+                # timeout for nothing.
+                continue
             try:
                 reply, blobs = await self._clients.get(addr).call(
                     "store_get", {"object_id": h["object_id"]}, timeout=60.0)

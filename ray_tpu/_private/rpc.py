@@ -50,6 +50,8 @@ from typing import Any, Awaitable, Callable
 import msgpack
 import zmq
 
+from ray_tpu._private import failpoints
+
 logger = logging.getLogger(__name__)
 
 Blobs = list[bytes]
@@ -147,8 +149,10 @@ class RemoteError(RpcError):
         return (RemoteError, (self.method, self.cause))
 
 
-class ConnectionLost(RpcError):
-    pass
+# ConnectionLost lives in the public exceptions module (serve's
+# dead-replica requeue classifies on it without touching transport
+# internals); re-exported here for its many transport-layer users.
+from ray_tpu.exceptions import ConnectionLost  # noqa: E402
 
 
 # pyzmq copy=False routes every frame through the zero-copy tracker
@@ -286,6 +290,15 @@ class IoThread:
 
     # --------------------------------------------------------- IO-thread
     def _send_now(self, sock, frames, copy: bool) -> None:
+        # Failpoint window: every outbound message of this process
+        # crosses here on the IO thread (drop = the message vanishes in
+        # flight; crash = the process dies with sends queued).  A
+        # `delay` action sleeps HERE, stalling every socket of the
+        # process — deliberate: the injected fault is "the IO thread
+        # stalls", the one failure the per-message _net_delay_s queue
+        # below (a latency model) cannot express.
+        if failpoints.ACTIVE and failpoints.fire("rpc.io_send"):
+            return
         if self._net_delay_s:
             # Park in the delay queue; the poll loop releases due entries
             # (same single-thread ownership, so per-socket order holds —
@@ -398,6 +411,21 @@ class IoThread:
                         break
                     except zmq.ZMQError:
                         break
+                    # Failpoint window: every inbound message lands here
+                    # (drop = the message was lost on the wire).  An
+                    # injected `error` degrades to drop-with-log: there
+                    # is no caller on the IO thread to deliver it to,
+                    # and letting it escape would kill the thread and
+                    # wedge every socket of the process.
+                    if failpoints.ACTIVE:
+                        try:
+                            if failpoints.fire("rpc.io_recv"):
+                                continue
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "rpc.io_recv failpoint: injected error "
+                                "-> message dropped")
+                            continue
                     try:
                         cb(frames)
                     except Exception:  # noqa: BLE001
@@ -558,6 +586,13 @@ class RpcServer:
                 hops["peer_dispatch"] = time.monotonic()
             result = await handler(header or {}, blobs)
             if msgid == 0:
+                return
+            # Failpoint window: the handler RAN (state mutated) but the
+            # reply is lost before it reaches the wire — the hardest
+            # at-most-once window (drop = caller waits; crash = process
+            # dies with the side effect applied).
+            if failpoints.ACTIVE and await failpoints.fire_async(
+                    "rpc.reply_dispatch"):
                 return
             if result is None:
                 rh, rb = {}, []
@@ -733,13 +768,11 @@ class RpcClient:
                     RemoteError(getattr(fut, "_method", "?"), exc))
             self._poster.post(_fail)
 
-    async def call(
-        self,
-        method: str,
-        header: dict | None = None,
-        blobs: Blobs | None = None,
-        timeout: float | None = None,
-    ) -> tuple[dict, Blobs]:
+    def _register_and_send(self, method: str, header: dict | None,
+                           blobs: Blobs | None
+                           ) -> tuple[int, asyncio.Future]:
+        """Shared preamble of call()/call_with_resend(): closed check,
+        msgid alloc, pending registration, hop-trace arm, first send."""
         if self._closed:
             raise ConnectionLost(self.address)
         msgid = self._alloc_msgid()
@@ -756,6 +789,16 @@ class RpcClient:
             out = [msgpack.packb([msgid, method, header]),
                    *(blobs or [])]
             self._io.send(self._sock, out, copy=_send_flags(out))
+        return msgid, fut
+
+    async def call(
+        self,
+        method: str,
+        header: dict | None = None,
+        blobs: Blobs | None = None,
+        timeout: float | None = None,
+    ) -> tuple[dict, Blobs]:
+        msgid, fut = self._register_and_send(method, header, blobs)
         if timeout is None:
             return await fut
         try:
@@ -775,6 +818,44 @@ class RpcClient:
             self._io._send_now(sock, out, _send_flags(out))
 
         self._io.post(_go)
+
+    async def call_with_resend(
+        self,
+        method: str,
+        header: dict | None = None,
+        blobs: Blobs | None = None,
+        resend_s: float = 60.0,
+    ) -> tuple[dict, Blobs]:
+        """call() with a lost-reply watchdog — the loop-thread analog of
+        resend_direct: if no reply lands within resend_s, re-send the
+        SAME msgid and keep waiting.  The pending entry stays registered
+        across deadlines, so a reply already in flight when the watchdog
+        fires still resolves the call (call(timeout=...) would pop the
+        entry and drop that reply, and for a >64KiB reply the resend
+        would then hit the receiver's REPLY_EVICTED tombstone — failing
+        a call that succeeded).  Whichever reply copy arrives first
+        wins; a late duplicate pops no pending entry and is dropped."""
+        msgid, fut = self._register_and_send(method, header, blobs)
+        attempt = 0
+        try:
+            while True:
+                try:
+                    return await asyncio.wait_for(asyncio.shield(fut),
+                                                  resend_s)
+                except asyncio.TimeoutError:
+                    if self._closed:
+                        raise ConnectionLost(self.address)
+                    attempt += 1
+                    logger.warning(
+                        "no reply from %s for %s after %.1fs; resending "
+                        "msgid=%d (attempt %d — the receiver dedupes by "
+                        "seqno)", self.address, method, resend_s, msgid,
+                        attempt)
+                    out = [msgpack.packb([msgid, method, header]),
+                           *(blobs or [])]
+                    self._io.send(self._sock, out, copy=_send_flags(out))
+        finally:
+            self._pending.pop(msgid, None)
 
     def call_direct_start(self, method: str, header: dict | None = None,
                           blobs: Blobs | None = None
@@ -815,6 +896,20 @@ class RpcClient:
                    *(blobs or [])]
             self._io.send(self._sock, out, copy=_send_flags(out))
         return fut
+
+    def resend_direct(self, fut: concurrent.futures.Future, method: str,
+                      header: dict | None = None,
+                      blobs: Blobs | None = None) -> None:
+        """Re-send a call_direct_start request with its ORIGINAL msgid
+        (lost-reply watchdog): the peer's seqno dedupe serves the cached
+        reply, and whichever copy of the reply arrives first resolves
+        the still-registered future — a late duplicate pops no pending
+        entry and is dropped.  Safe from any thread."""
+        if self._closed:
+            raise ConnectionLost(self.address)
+        msgid = fut._rpc_msgid
+        out = [msgpack.packb([msgid, method, header]), *(blobs or [])]
+        self._io.send(self._sock, out, copy=_send_flags(out))
 
     async def notify(self, method: str, header: dict | None = None,
                      blobs: Blobs | None = None) -> None:

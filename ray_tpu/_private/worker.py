@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+from ray_tpu._private import failpoints
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import MemoryStore
@@ -55,7 +56,8 @@ from ray_tpu.exceptions import (ActorDiedError, ActorError, GetTimeoutError,
                                 WorkerCrashedError)
 from ray_tpu.object_ref import ObjectRef, set_release_hook
 
-from ray_tpu._private.actor_state import (ActorInstance,
+from ray_tpu._private.actor_state import (REPLY_EVICTED,
+                                          ActorInstance,
                                           ActorSubmitState,
                                           StreamState)
 from ray_tpu._private.lease_manager import LeaseManager, PendingTask
@@ -364,6 +366,7 @@ class CoreWorker:
         self.subscriber = Subscriber(address=pub_addr)
         self.subscriber.subscribe("actor", self._on_actor_event)
         self.subscriber.subscribe("worker", self._on_worker_event)
+        self.subscriber.subscribe("node", self._on_node_event)
         if self.mode == "driver" and getattr(self, "log_to_driver", False):
             self.subscriber.subscribe("logs", self._on_log_lines)
 
@@ -379,6 +382,22 @@ class CoreWorker:
             return
         self._mark_addr_dead(addr)
         self.clients.drop(addr)
+
+    async def _on_node_event(self, _topic: str, payload: dict) -> None:
+        """Node death fan-out (round-9 MTTR fix): an object pull from a
+        dead node's agent used to wait out the full transfer RPC timeout
+        (120s per location) before recovery could start — the dominant
+        term in crash-mid-chunked-pull MTTR.  Mark the dead agent's
+        address and fail its in-flight calls NOW; a rejoining node
+        (same address) is revived on its "alive" event."""
+        addr = payload.get("agent_addr")
+        if not addr or addr == self.agent_addr:
+            return          # our own agent's fate is ours anyway
+        if payload.get("event") == "dead":
+            self._mark_addr_dead(addr)
+            self.clients.drop(addr)
+        elif payload.get("event") == "alive":
+            self._revive_addr(addr)
 
     def _mark_addr_dead(self, addr: str) -> None:
         """The ONE bookkeeping site for the dead-address registry (the
@@ -1375,6 +1394,11 @@ class CoreWorker:
         elif self._store_frames_local(oid, sv.frames, trace=trace):
             # Zero-RPC path: wrote straight into the mmap'd arena from the
             # caller's thread.
+            # Failpoint window: the object is SEALED in the arena but the
+            # owner record has not published it yet — a crash here orphans
+            # a sealed object whose owner never existed.
+            if failpoints.ACTIVE:
+                failpoints.fire("put.publish")
             if trace is not None:
                 trace["path"] = "arena"
             rec.state = "stored"
@@ -1573,7 +1597,10 @@ class CoreWorker:
             from ray_tpu.exceptions import OwnerDiedError
 
             return OwnerDiedError(
-                f"{ref.hex()[:12]} (owner {ref.owner_addr} died)")
+                ref.hex(),
+                f"object {ref.hex()[:12]}: owner {ref.owner_addr} died "
+                f"with the authoritative copy; put/borrowed objects have "
+                f"no lineage, so reconstruction was not attempted")
         remaining = None if deadline is None \
             else max(0.0, deadline - time.monotonic())
         try:
@@ -1584,8 +1611,10 @@ class CoreWorker:
             raise GetTimeoutError(ref.hex()[:12])
         except (ConnectionLost, RemoteError) as err:
             return ObjectLostError(
-                f"owner {ref.owner_addr} unreachable for "
-                f"{ref.hex()[:12]}: {err}")
+                ref.hex(),
+                f"object {ref.hex()[:12]}: owner {ref.owner_addr} "
+                f"unreachable ({err}); lineage lives with the owner, so "
+                f"reconstruction was not attempted")
         state = reply.get("state")
         if state == "inline":
             value = await self._deserialize_registering(blobs)
@@ -1599,7 +1628,11 @@ class CoreWorker:
         if state == "stored":
             e = self.memory.entry(ref.binary())
             return await self._pull_and_load(ref, reply["locations"], e)
-        return ObjectLostError(ref.hex()[:12])
+        return ObjectLostError(
+            ref.hex(),
+            f"object {ref.hex()[:12]}: owner {ref.owner_addr} no longer "
+            f"holds it (state={state!r}); borrowed objects have no "
+            f"lineage, so reconstruction was not attempted")
 
     async def _pull_and_load(self, ref: ObjectRef, locations: list[str],
                              entry) -> Any:
@@ -1632,20 +1665,34 @@ class CoreWorker:
                     entry.has_value, entry.value = True, value
                     entry.wake()
                     return value
+        tried: list[str] = []
         for addr in locations:
+            if addr in self._dead_worker_addrs:
+                # Known-dead node/worker: a fresh DEALER would silently
+                # reconnect-forever; skip straight to the next copy (or
+                # lineage) instead of burning the RPC timeout.
+                tried.append(f"{addr} (known dead)")
+                continue
             try:
                 reply, blobs = await self.clients.get(addr).call(
                     "store_get", {"object_id": ref.hex()}, timeout=120.0)
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                tried.append(f"{addr} ({type(e).__name__})")
                 continue
             if reply.get("found"):
                 value = await self._deserialize_registering(blobs)
                 entry.has_value, entry.value = True, value
                 entry.wake()
                 return value
+            tried.append(f"{addr} (not found)")
         # Every location failed: try lineage reconstruction.
         rec = self.owned.get(ref.binary())
         if rec and rec.submit_spec and rec.retries_left > 0:
+            # Failpoint window: every copy is gone and the owner is about
+            # to resubmit the producing task (crash = the getter dies
+            # mid-reconstruction; error = reconstruction refused).
+            if failpoints.ACTIVE:
+                await failpoints.fire_async("worker.lineage_resubmit")
             rec.retries_left -= 1
             fid, header, blobs_, key = rec.submit_spec
             logger.warning("reconstructing %s via lineage", ref.hex()[:12])
@@ -1662,7 +1709,21 @@ class CoreWorker:
             self.lease_manager.submit(task)
             return await self._get_one(
                 _UntrackedRef(ref.binary(), self.address), None)
-        return ObjectLostError(ref.hex()[:12])
+        # Name the ref, the nodes tried, and the lineage verdict: a bare
+        # object id gives an operator nothing to act on (the detail used
+        # to stop at a log line here and the surfaced error lost it).
+        if rec is not None and rec.submit_spec:
+            lineage = "lineage reconstruction exhausted its retry budget"
+        elif rec is not None:
+            lineage = ("no lineage to reconstruct from (the object was "
+                       "put(), not returned by a task)")
+        else:
+            lineage = ("not owned by this process, so no lineage is "
+                       "available here")
+        return ObjectLostError(
+            ref.hex(),
+            f"object {ref.hex()[:12]} lost: locations tried "
+            f"{tried if tried else '(none known)'}; {lineage}")
 
     def wait(self, refs: list[ObjectRef], num_returns: int,
              timeout: float | None) -> tuple[list[ObjectRef], list[ObjectRef]]:
@@ -2270,6 +2331,19 @@ class CoreWorker:
             self.current_resources = prev_res
             self.current_runtime_env = prev_renv
 
+    def _evicted_reply(self, seq: int) -> tuple[dict, list]:
+        """Reply for a resend whose original execution completed but
+        whose (large) result was trimmed from the dedupe cache: an
+        explicit error, NOT a re-execution — the method's side effects
+        are already applied and must not double-apply (at-most-once)."""
+        from ray_tpu.exceptions import ReplyEvictedError
+
+        return self._error_reply(ReplyEvictedError(
+            f"seq {seq}: the call already executed, but its reply "
+            f"(>64KiB) was evicted from the reply cache before the "
+            f"resend arrived; refusing to re-execute (side effects are "
+            f"applied exactly once — re-fetch state with another call)"))
+
     def _error_reply(self, e: BaseException) -> tuple[dict, list]:
         import pickle
         tb = traceback.format_exc()
@@ -2642,6 +2716,8 @@ class CoreWorker:
             # double-apply stateful methods (a counter once advanced by a
             # retransmitted batch whose originals were mid-execution).
             hit = inst.reply_cache.get((caller, seq))
+            if hit is REPLY_EVICTED:
+                return self._immediate_reply(self._evicted_reply(seq))
             if hit is not None:
                 return self._share_reply(hit)
             # Beyond the dedupe window: execute out of order — the
@@ -2655,9 +2731,12 @@ class CoreWorker:
             return started
         if seq != nxt:
             # Out-of-order arrival: park until predecessors START
-            # (ray: ActorSchedulingQueue buffering by seq_no).
-            fut = self.loop.create_future()
-            inst.buffered.setdefault(caller, {})[seq] = fut
+            # (ray: ActorSchedulingQueue buffering by seq_no).  A resend
+            # of an already-parked seqno must JOIN the original's park
+            # future, not replace it — the clobbered original would wait
+            # forever on a future nobody resolves.
+            fut = inst.buffered.setdefault(caller, {}).setdefault(
+                seq, self.loop.create_future())
             await fut
             # A seq_floor fast-forward may have woken us STALE (our
             # predecessors terminally failed and the floor moved past
@@ -2667,6 +2746,8 @@ class CoreWorker:
             # past the floor and re-demote every later call.
             if seq < inst.next_seq.get(caller, 0):
                 hit = inst.reply_cache.get((caller, seq))
+                if hit is REPLY_EVICTED:
+                    return self._immediate_reply(self._evicted_reply(seq))
                 if hit is not None:
                     return self._share_reply(hit)
                 try:
@@ -2681,26 +2762,44 @@ class CoreWorker:
         # The sequence MUST advance even when dispatch fails (bad args, arg
         # resolution error): a burned seqno would otherwise park every later
         # call from this caller forever.
+        hit = inst.reply_cache.get((caller, seq))
+        if hit is not None and hit is not REPLY_EVICTED:
+            # A resend racing the ORIGINAL's still-running dispatch: arg
+            # resolution (a slow pull, lineage) can outlast the reply
+            # watchdog, and next_seq only advances after dispatch — so
+            # dedupe on the reply-cache placeholder the original
+            # registered below, never re-execute.
+            return self._share_reply(hit)
+        # The placeholder goes in BEFORE the first await (loop-atomic
+        # with the check above); next_seq still advances only after
+        # dispatch, so executor submission order keeps matching seqno
+        # order (advancing early would let the successor submit first).
+        shared: asyncio.Future = self.loop.create_future()
+        inst.cache_reply((caller, seq), shared)
         try:
             started = await self._start_actor_method(inst, h, blobs)
         except BaseException as e:  # noqa: BLE001
-            err = self.loop.create_future()
-            err.set_result(self._error_reply(e))
-            inst.cache_reply((caller, seq), err)
-            return self._share_reply(err)
+            if not shared.done():
+                shared.set_result(self._error_reply(e))
+            return self._share_reply(shared)
         finally:
             inst.next_seq[caller] = seq + 1
             buf = inst.buffered.get(caller, {})
             nxt_fut = buf.pop(seq + 1, None)
             if nxt_fut and not nxt_fut.done():
                 nxt_fut.set_result(None)
-        shared = self.loop.create_task(self._await_reply(started))
-        inst.cache_reply((caller, seq), shared)
+        self.loop.create_task(self._pipe_reply(started, shared))
         return self._share_reply(shared)
 
-    @staticmethod
-    async def _await_reply(started):
-        return await started
+    async def _pipe_reply(self, started, shared: "asyncio.Future") -> None:
+        """Resolve a pre-registered dedupe future from an execution's
+        awaitable (never leave it pending — resends await it)."""
+        try:
+            res = await started
+        except BaseException as e:  # noqa: BLE001
+            res = self._error_reply(e)
+        if not shared.done():
+            shared.set_result(res)
 
     @staticmethod
     def _share_reply(fut):
@@ -2960,6 +3059,49 @@ class CoreWorker:
                 pass        # shutdown: nothing left to bookkeep
 
         cfut.add_done_callback(_on_reply)
+        resend_s = self.config.actor_reply_resend_s
+        if resend_s and resend_s > 0:
+            # Lost-reply watchdog for the fused path (the loop path has
+            # its own in _actor_call_with_resend): periodically resend
+            # the SAME msgid until the reply future resolves.  The
+            # receiver dedupes by seqno, so the retry is safe; genuine
+            # actor death resolves cfut via ConnectionLost (death
+            # broadcast → clients.drop) and stops the timer chain.
+            timer = []      # TimerHandle box, owned by the loop thread
+
+            def _watchdog():
+                timer.clear()
+                if cfut.done():
+                    return
+                logger.warning(
+                    "no reply for direct actor call seq=%s to %s after "
+                    "%.1fs; resending (receiver dedupes by seqno)",
+                    header.get("seqno"), addr, resend_s)
+                try:
+                    cli.resend_direct(cfut, "actor_call", header, blobs)
+                except Exception:  # noqa: BLE001 - client closed: cfut
+                    return         # already failed with ConnectionLost
+                timer.append(self.loop.call_later(resend_s, _watchdog))
+
+            def _cancel_timer(_f):
+                # Cancel NOW, not at expiry: the pending timer pins the
+                # call's header and arg blobs — at a sustained call rate
+                # that is resend_s seconds of already-answered argument
+                # buffers held live.  Handle.cancel() drops the closure
+                # immediately.
+                try:
+                    self._post_to_loop(
+                        lambda: timer and timer.pop().cancel())
+                except RuntimeError:
+                    pass    # shutdown: loop (and timer) already gone
+
+            try:
+                self._post_to_loop(lambda: timer.append(
+                    self.loop.call_later(resend_s, _watchdog)))
+            except RuntimeError:
+                pass        # shutdown race: call resolves via close()
+            else:
+                cfut.add_done_callback(_cancel_timer)
         return True
 
     def _finalize_direct(self, task: PendingTask, st: ActorSubmitState,
@@ -3161,8 +3303,8 @@ class CoreWorker:
             try:
                 if len(batch) == 1:
                     task, _ = batch[0]
-                    reply, rblobs = await self.clients.get(addr).call(
-                        "actor_call", task.header, task.blobs)
+                    reply, rblobs = await self._actor_call_with_resend(
+                        addr, "actor_call", task.header, task.blobs)
                     self._on_task_reply(task, reply, rblobs)
                     return
                 headers = [{**t.header, "nframes": len(t.blobs)}
@@ -3170,8 +3312,8 @@ class CoreWorker:
                 blobs: list = []
                 for t, _ in batch:
                     blobs.extend(t.blobs)
-                reply, rblobs = await self.clients.get(addr).call(
-                    "actor_call_batch", {"calls": headers}, blobs)
+                reply, rblobs = await self._actor_call_with_resend(
+                    addr, "actor_call_batch", {"calls": headers}, blobs)
             except (ConnectionLost, RemoteError):
                 if st.address == addr:
                     st.address = None
@@ -3201,6 +3343,30 @@ class CoreWorker:
                 self._on_task_reply(task, tr, rblobs[offset:offset + n])
                 offset += n
             return
+
+    async def _actor_call_with_resend(self, addr: str, method: str,
+                                      header: dict, blobs: list):
+        """Actor-call transport with a lost-reply watchdog (the round-9
+        "dropped actor reply" window): after actor_reply_resend_s with
+        no reply, RESEND the same msgid+seqnos (rpc call_with_resend —
+        the pending future stays registered across deadlines, so a
+        large reply still in flight when the watchdog fires lands
+        instead of being dropped and tombstoning as REPLY_EVICTED on
+        the resend, mirroring the fused path's resend_direct).  The
+        receiver's at-most-once machinery makes the resend safe — a
+        seqno whose execution completed serves the cached reply, one
+        still in flight attaches the resend to the shared execution
+        future (rpc_actor_call stale-seqno path), so stateful methods
+        never double-apply.  Genuine worker death still surfaces as
+        ConnectionLost via the death broadcast (clients.drop fails the
+        pending future), which breaks the wait into the caller's
+        retry/fail handling."""
+        resend_s = self.config.actor_reply_resend_s
+        cli = self.clients.get(addr)
+        if not resend_s or resend_s <= 0:
+            return await cli.call(method, header, blobs)
+        return await cli.call_with_resend(method, header, blobs,
+                                          resend_s=resend_s)
 
     async def _resolve_actor_addr(self, st: ActorSubmitState) -> str | None:
         if st.address:
@@ -3414,6 +3580,12 @@ class CoreWorker:
     async def rpc_ping(self, h: dict, _b: list) -> dict:
         return {"worker_id": self.worker_id,
                 "actors": list(self.actors_hosted)}
+
+    async def rpc_failpoints(self, h: dict, _b: list) -> dict:
+        """Runtime fault-injection control verb (see _private/failpoints):
+        arm/clear/read the deterministic failpoint table of THIS process
+        without restarting it."""
+        return failpoints.control(h)
 
     # ------------------------------------------------------------ telemetry
     def _record_event(self, task_id: str, state: str, name: str = "",

@@ -71,6 +71,16 @@ def _child_enter(req: dict, inherited: list) -> None:
             os.dup2(fd, fileno)
             os.close(fd)
     os.environ.update(req["env"])
+    # The zygote pre-imported the runtime, so import-time env hooks
+    # never saw THIS worker's env: re-sync what depends on it.  update()
+    # cannot REMOVE keys, so a spec the agent has since cleared would
+    # survive in the zygote's stale env and re-arm disarmed sites —
+    # drop it explicitly when the spawn env carries none.
+    from ray_tpu._private import failpoints
+
+    if failpoints.ENV_VAR not in req["env"]:
+        os.environ.pop(failpoints.ENV_VAR, None)
+    failpoints.reload_from_env()
     signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     from ray_tpu._private import worker_main
 

@@ -45,18 +45,44 @@ class ActorDiedError(ActorError):
 
 class ObjectLostError(RayTpuError):
     """Object is gone from every node store and could not be reconstructed
-    (ray: ObjectLostError / ObjectReconstructionFailedError)."""
+    (ray: ObjectLostError / ObjectReconstructionFailedError).
 
-    def __init__(self, object_id: str = ""):
+    `detail`, when present, is the full diagnosis (ref, locations tried,
+    lineage verdict) and becomes the message verbatim — the old
+    single-arg form truncated everything to 12 chars, which is fine for
+    a bare hex id and destroys anything richer."""
+
+    def __init__(self, object_id: str = "", detail: str = ""):
         self.object_id = object_id
-        super().__init__(f"object {object_id[:12]} lost")
+        self.detail = detail
+        super().__init__(detail or f"object {object_id[:12]} lost")
 
     def __reduce__(self):
-        return (ObjectLostError, (self.object_id,))
+        # type(self), not ObjectLostError: pickling an OwnerDiedError
+        # across a process hop must not demote it to the base class
+        # (callers catch the subclass).
+        return (type(self), (self.object_id, self.detail))
+
+
+class ReplyEvictedError(RayTpuError):
+    """The actor call EXECUTED — its side effects are applied exactly
+    once — but the reply (>64KiB) was evicted from the receiver's dedupe
+    cache before a lost-reply resend arrived, so the result is gone.
+    Deliberately NOT an ActorError subclass: retry layers that re-route
+    on replica/worker death (serve's dead-replica requeue, task retries)
+    must not classify this as a death and re-run the call."""
 
 
 class WorkerCrashedError(RayTpuError):
     """Worker process died while executing the task (ray: WorkerCrashedError)."""
+
+
+class ConnectionLost(RayTpuError):
+    """The rpc transport lost the peer process mid-call — it died or its
+    socket went away (analog of ray: GrpcUnavailable/RpcError).  Defined
+    here rather than in `_private/rpc.py` (which raises it) so library
+    layers — serve's dead-replica requeue classifies on it — depend only
+    on the public exception surface, never on transport internals."""
 
 
 class OutOfMemoryError(WorkerCrashedError):

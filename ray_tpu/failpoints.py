@@ -1,0 +1,28 @@
+"""Public facade over the deterministic fault-injection subsystem.
+
+Library layers (serve/train/data/tune/rl) must build only on core
+primitives and public surfaces, never on runtime internals — this module
+is the public surface for compiling failpoint sites into library code
+and for arming them from tests/operators.  See
+`ray_tpu/_private/failpoints.py` for the site/action semantics and the
+`RAY_TPU_FAILPOINTS` env syntax.
+"""
+from __future__ import annotations
+
+from ray_tpu._private import failpoints as _impl
+
+FailpointError = _impl.FailpointError
+fire = _impl.fire
+fire_async = _impl.fire_async
+configure = _impl.configure
+arm = _impl.arm
+disarm = _impl.disarm
+reset = _impl.reset
+counters = _impl.counters
+spec = _impl.spec
+
+
+def __getattr__(name):
+    # ACTIVE is a mutable module flag — read it live off the
+    # implementation module; an import-time snapshot would never flip.
+    return getattr(_impl, name)

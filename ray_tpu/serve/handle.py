@@ -29,6 +29,24 @@ from ray_tpu.actor import ActorHandle
 from ray_tpu.object_ref import ObjectRef
 
 _MEMBERSHIP_TTL_S = 0.5
+# Dead-replica requeue budget per request: a submit that lands on a
+# replica which dies before producing any response is re-routed to
+# another running replica at most this many times (ray: serve retries
+# ActorDiedError/ActorUnavailableError requests that never started).
+_REQUEUE_BUDGET = 3
+
+
+def _is_replica_death(e: BaseException) -> bool:
+    """True for errors that mean the REPLICA PROCESS failed before (or
+    while) handling the request — never for user-code exceptions, which
+    arrive as TaskError and must surface to the caller, and never for
+    ObjectLostError: a lost RESULT object means the request already
+    executed to completion (the side effects are applied) and only the
+    stored reply was lost with its node — requeueing would re-execute."""
+    from ray_tpu.exceptions import (ActorError, ConnectionLost,
+                                    WorkerCrashedError)
+
+    return isinstance(e, (ActorError, WorkerCrashedError, ConnectionLost))
 
 
 class _NoCapacity(RuntimeError):
@@ -44,9 +62,14 @@ class DeploymentResponse:
     """
 
     def __init__(self, ref: ObjectRef | None,
-                 ref_future: "concurrent.futures.Future | None" = None):
+                 ref_future: "concurrent.futures.Future | None" = None,
+                 requeue=None):
         self._ref = ref
         self._ref_future = ref_future
+        # Callable(exc) -> ObjectRef | None: re-route this request to
+        # another running replica after the assigned one died before
+        # producing a response (None = budget exhausted / no replica).
+        self._requeue = requeue
 
     def _to_object_ref(self, timeout_s: float | None = 30.0) -> ObjectRef:
         if self._ref is None:
@@ -55,18 +78,85 @@ class DeploymentResponse:
 
     def result(self, timeout_s: float | None = None) -> Any:
         import ray_tpu
+        from ray_tpu.exceptions import GetTimeoutError
 
-        return ray_tpu.get(self._to_object_ref(), timeout=timeout_s)
+        # One deadline for the WHOLE call, spanning requeue retries —
+        # each retry gets the remaining budget, not a fresh timeout_s.
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"deployment response not ready within {timeout_s}s")
+            try:
+                ref = self._to_object_ref(
+                    remaining if remaining is not None else 30.0)
+                # Ref resolution may have blocked (router-queued
+                # submit): re-derive the budget or the get below would
+                # run on the stale pre-wait value, overshooting the
+                # caller's deadline by the whole resolution wait.
+                if deadline is not None:
+                    remaining = max(0.0, deadline - time.monotonic())
+                value = ray_tpu.get(ref, timeout=remaining)
+                # The requeue closure pins the request's args/kwargs;
+                # once a response has been produced it can never be used
+                # again — release the payload with the closure.
+                self._requeue = None
+                return value
+            except concurrent.futures.TimeoutError:
+                bound = timeout_s if timeout_s is not None else 30.0
+                raise GetTimeoutError(
+                    "deployment response not ready: replica submit did "
+                    f"not resolve within {bound}s") from None
+            except Exception as e:  # noqa: BLE001 - filtered below
+                if self._requeue is None or not _is_replica_death(e):
+                    raise
+                if deadline is None:
+                    ref = self._requeue(e)
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    # Cap the re-route wait too: it blocks on membership
+                    # refresh + the router thread.
+                    ref = self._requeue(e, wait_s=remaining)
+                if ref is None:
+                    self._requeue = None   # budget exhausted — for good
+                    raise
+                self._ref = ref
 
     def __await__(self):
         import asyncio
 
         async def _resolve():
-            ref = self._ref
-            if ref is None:
-                ref = await asyncio.wrap_future(self._ref_future)
-                self._ref = ref
-            return await ref
+            while True:
+                try:
+                    # Ref resolution INSIDE the try: a router-submitted
+                    # request whose replica died at submit time fails
+                    # the ref_future itself, and must requeue exactly
+                    # like a post-submit death (the sync result() path
+                    # already does — the two must not diverge).
+                    ref = self._ref
+                    if ref is None:
+                        ref = await asyncio.wrap_future(self._ref_future)
+                        self._ref = ref
+                    value = await ref
+                    self._requeue = None   # see result(): drop the payload
+                    return value
+                except Exception as e:  # noqa: BLE001 - filtered below
+                    if self._requeue is None or not _is_replica_death(e):
+                        raise
+                    # The requeue refreshes membership over blocking RPC
+                    # — never on this (possibly worker-IO) loop.
+                    loop = asyncio.get_running_loop()
+                    new_ref = await loop.run_in_executor(
+                        None, self._requeue, e)
+                    if new_ref is None:
+                        self._requeue = None
+                        raise
+                    self._ref = new_ref
 
         return _resolve().__await__()
 
@@ -79,14 +169,31 @@ class DeploymentResponseGenerator:
     generator produces, as it is produced (ray: serve/handle.py
     DeploymentResponseGenerator via handle.options(stream=True))."""
 
-    def __init__(self, gen_future: "concurrent.futures.Future"):
+    def __init__(self, gen_future: "concurrent.futures.Future",
+                 requeue=None):
         self._gen_future = gen_future
         self._gen = None
+        self._yielded = 0
+        # Callable(exc) -> stream generator | None; only consulted while
+        # ZERO items have been produced — a partially-consumed stream
+        # must fail (replaying it would duplicate delivered items).
+        self._requeue = requeue
 
     def _resolve(self):
         if self._gen is None:
             self._gen = self._gen_future.result(timeout=30.0)
         return self._gen
+
+    def _try_requeue(self, e: BaseException) -> bool:
+        if (self._yielded or self._requeue is None
+                or not _is_replica_death(e)):
+            return False
+        gen = self._requeue(e)
+        if gen is None:
+            self._requeue = None   # budget exhausted — drop the payload
+            return False
+        self._gen = gen
+        return True
 
     def __iter__(self):
         return self
@@ -94,7 +201,20 @@ class DeploymentResponseGenerator:
     def __next__(self) -> Any:
         import ray_tpu
 
-        return ray_tpu.get(next(self._resolve()))
+        while True:
+            try:
+                item = ray_tpu.get(next(self._resolve()))
+            except StopIteration:
+                raise
+            except Exception as e:  # noqa: BLE001 - filtered in helper
+                if not self._try_requeue(e):
+                    raise
+                continue
+            self._yielded += 1
+            # A partially-consumed stream never requeues; the closure
+            # pins the request payload — release both together.
+            self._requeue = None
+            return item
 
     def __aiter__(self):
         return self
@@ -105,9 +225,23 @@ class DeploymentResponseGenerator:
         import ray_tpu
 
         loop = asyncio.get_running_loop()
-        gen = await loop.run_in_executor(None, self._resolve)
-        ref = await gen.__anext__()
-        return await loop.run_in_executor(None, ray_tpu.get, ref)
+        while True:
+            try:
+                gen = await loop.run_in_executor(None, self._resolve)
+                ref = await gen.__anext__()
+                item = await loop.run_in_executor(None, ray_tpu.get, ref)
+            except StopAsyncIteration:
+                raise
+            except Exception as e:  # noqa: BLE001 - filtered in helper
+                # Requeue refreshes membership over blocking RPC: keep
+                # it off this (possibly worker-IO) loop.
+                if not await loop.run_in_executor(
+                        None, self._try_requeue, e):
+                    raise
+                continue
+            self._yielded += 1
+            self._requeue = None   # see __next__
+            return item
 
 
 class DeploymentHandle:
@@ -178,6 +312,12 @@ class DeploymentHandle:
             if item is None:
                 continue
             fut, submit_fn, args, kwargs, deadline = item
+            # PENDING→RUNNING is atomic with a consumer's cancel(): an
+            # abandoned submit (requeue caller timed out) is skipped
+            # instead of executed-with-no-consumer.  A _NoCapacity
+            # retry re-enters here already RUNNING — don't re-claim.
+            if not fut.running() and not fut.set_running_or_notify_cancel():
+                continue
             try:
                 fut.set_result(submit_fn(args, kwargs))
             except _NoCapacity as e:
@@ -190,17 +330,22 @@ class DeploymentHandle:
                 fut.set_exception(e)
 
     # -- routing ------------------------------------------------------------
-    def _pick(self) -> tuple[str, ActorHandle]:
+    def _pick(self, exclude=()) -> tuple[str, ActorHandle]:
         """Power-of-two choices over in-flight counts, skipping replicas at
         their max_ongoing_requests cap — the routing-side backpressure of
         ray: pow_2_scheduler.py:51 (replicas over capacity are not sent
-        more work; the request queues in the router instead)."""
+        more work; the request queues in the router instead).  `exclude`
+        holds replica ids that already FAILED this request (dead-replica
+        requeue must land somewhere else)."""
         with self._lock:
-            reps = self._replicas
+            reps = [r for r in self._replicas if r not in exclude] \
+                if exclude else self._replicas
             if not reps:
                 raise _NoCapacity(
                     f"deployment {self.deployment_name!r} has no running "
-                    f"replicas")
+                    f"replicas"
+                    + (f" ({len(exclude)} excluded after failure)"
+                       if exclude else ""))
             cap = self._max_ongoing
             if cap > 0:
                 eligible = [r for r in reps
@@ -221,8 +366,12 @@ class DeploymentHandle:
             handle = self._handles[choice]
         return choice, handle
 
-    def _submit(self, args: tuple, kwargs: dict) -> ObjectRef:
-        rid, handle = self._pick()
+    def _submit(self, args: tuple, kwargs: dict,
+                state: dict | None = None) -> ObjectRef:
+        rid, handle = self._pick(
+            state["failed"] if state is not None else ())
+        if state is not None:
+            state["rid"] = rid
         try:
             args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
                          else a for a in args)
@@ -241,10 +390,14 @@ class DeploymentHandle:
             if self._inflight.get(rid, 0) > 0:
                 self._inflight[rid] -= 1
 
-    def _submit_streaming(self, args: tuple, kwargs: dict):
+    def _submit_streaming(self, args: tuple, kwargs: dict,
+                          state: dict | None = None):
         """Route one streaming request: returns a
         StreamingObjectRefGenerator over the replica generator's items."""
-        rid, handle = self._pick()
+        rid, handle = self._pick(
+            state["failed"] if state is not None else ())
+        if state is not None:
+            state["rid"] = rid
         try:
             args = tuple(a._to_object_ref()
                          if isinstance(a, DeploymentResponse) else a
@@ -261,27 +414,73 @@ class DeploymentHandle:
             lambda _f: self._done(rid))
         return gen
 
+    def _make_requeue(self, submit_fn, args: tuple, kwargs: dict,
+                      state: dict):
+        """Bounded dead-replica requeue for one request: refresh
+        membership (dropping the dead replica), then re-route through
+        the router thread — which keeps retrying while the controller
+        starts a replacement — to a replica that has not already failed
+        this request.  Returns the new ref/generator or None (budget
+        spent / nothing to route to: the original error surfaces)."""
+        def _requeue(exc: BaseException, wait_s: float = 35.0):
+            if state["budget"] <= 0:
+                return None
+            state["budget"] -= 1
+            if state.get("rid"):
+                state["failed"].add(state["rid"])
+            try:
+                self._refresh_blocking()
+            except Exception:  # noqa: BLE001 - controller restarting
+                pass
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            self._ensure_router().put(
+                (fut, submit_fn, args, kwargs,
+                 time.monotonic() + min(30.0, wait_s)))
+            try:
+                return fut.result(timeout=wait_s)
+            except Exception:  # noqa: BLE001 - surface the ORIGINAL error
+                # Still queued (router wedged in a refresh): cancel so
+                # the router skips it — executing an abandoned submit
+                # would dispatch a request nobody consumes.
+                fut.cancel()
+                return None
+        return _requeue
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         chained_pending = any(
             isinstance(a, DeploymentResponse) and a._ref is None
             for a in list(args) + list(kwargs.values()))
+        # Per-request routing state: requeue budget + replicas that
+        # already failed it (see _make_requeue).
+        state = {"budget": _REQUEUE_BUDGET, "failed": set(), "rid": None}
         if self._stream:
+            def submit_stream(a, k):
+                return self._submit_streaming(a, k, state=state)
+
+            requeue = self._make_requeue(submit_stream, args, kwargs,
+                                         state)
             fut: concurrent.futures.Future = concurrent.futures.Future()
             with self._lock:
                 have = bool(self._replicas)
             if have and not chained_pending:
                 try:
-                    fut.set_result(self._submit_streaming(args, kwargs))
-                    return DeploymentResponseGenerator(fut)
+                    fut.set_result(submit_stream(args, kwargs))
+                    return DeploymentResponseGenerator(fut,
+                                                       requeue=requeue)
                 except _NoCapacity:
                     fut = concurrent.futures.Future()
             # No membership / unresolved chained response / no capacity:
             # the router thread resolves the generator off the caller's
             # thread (which may be a worker IO loop — never block it).
             self._ensure_router().put(
-                (fut, self._submit_streaming, args, kwargs,
+                (fut, submit_stream, args, kwargs,
                  time.monotonic() + 30.0))
-            return DeploymentResponseGenerator(fut)
+            return DeploymentResponseGenerator(fut, requeue=requeue)
+
+        def submit(a, k):
+            return self._submit(a, k, state=state)
+
+        requeue = self._make_requeue(submit, args, kwargs, state)
         # An unresolved chained response would require a blocking wait to
         # convert to an ObjectRef — never do that on the caller's thread
         # (it may be a worker IO loop); hand it to the router thread.
@@ -292,13 +491,14 @@ class DeploymentHandle:
             if not fresh:    # serve stale, refresh in background
                 self._ensure_router()
             try:
-                return DeploymentResponse(self._submit(args, kwargs))
+                return DeploymentResponse(submit(args, kwargs),
+                                          requeue=requeue)
             except _NoCapacity:
                 pass         # queue to the router thread below
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._ensure_router().put(
-            (fut, self._submit, args, kwargs, time.monotonic() + 30.0))
-        return DeploymentResponse(None, ref_future=fut)
+            (fut, submit, args, kwargs, time.monotonic() + 30.0))
+        return DeploymentResponse(None, ref_future=fut, requeue=requeue)
 
     def options(self, method_name: str | None = None,
                 stream: bool | None = None) -> "DeploymentHandle":

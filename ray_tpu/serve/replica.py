@@ -101,6 +101,13 @@ class Replica:
         self._num_ongoing += 1
         try:
             async with self._slots:
+                # Failpoint window: the request is admitted but the user
+                # callable has not run (crash = replica dies mid-request;
+                # the handle must requeue to another replica).
+                from ray_tpu import failpoints
+
+                if failpoints.ACTIVE:
+                    await failpoints.fire_async("serve.replica_call")
                 target = getattr(self._instance, method)
                 token = _ctx_var.set(self._context)
                 try:

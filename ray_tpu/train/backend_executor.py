@@ -57,6 +57,12 @@ class BackendExecutor:
             self.worker_group = None
 
     def _restart(self) -> None:
+        # Failpoint window: the group-restart path itself (delay = slow
+        # recovery observable in MTTR; error = restart refused).
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            failpoints.fire("train.group_restart")
         logger.warning("restarting worker group (failure %d)",
                        self._num_failures)
         self.shutdown()

@@ -36,6 +36,14 @@ class _Session:
     def report(self, metrics: dict, checkpoint: Checkpoint | None) -> None:
         if self.stop_event.is_set():
             raise StopIteration("training stopped by the coordinator")
+        # Failpoint window: a train worker at a step boundary, checkpoint
+        # in hand but not yet handed to the coordinator (crash = worker
+        # dies mid-step; the group restart must resume from the NEWEST
+        # checkpoint that made it out).
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            failpoints.fire("train.step")
         self.out.put({"type": "report", "metrics": dict(metrics),
                       "checkpoint": checkpoint, "rank": self.world_rank})
 

@@ -28,6 +28,14 @@ _jax_compat()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: process-killing fault-injection suites (test_chaos*, "
+        "test_failpoints) — each test runs its own cluster and kills "
+        "pieces of it; deselect with -m 'not chaos' for a quiet pass")
+
+
 @pytest.hookimpl(wrapper=True)
 def pytest_runtest_call(item):
     """Per-test watchdog (pytest-timeout isn't in this image): SIGALRM

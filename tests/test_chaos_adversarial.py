@@ -16,6 +16,8 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+pytestmark = pytest.mark.chaos
+
 
 @pytest.fixture
 def fresh_cluster():

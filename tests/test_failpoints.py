@@ -1,0 +1,831 @@
+"""Deterministic chaos: every hard window of the runtime, by name.
+
+The random-kill suites (test_chaos*.py) prove the availability story
+statistically; THIS suite steps through each named failpoint site
+(_private/failpoints.py), arms it with a deterministic action, observes
+the injected fault fire (site hit counters), and asserts full recovery —
+the windows that random kills only hit by luck:
+
+  arena.alloc/copy/seal + put.publish   crash inside the put pipeline
+  rpc.reply_dispatch                    reply dropped after state mutated
+  rpc.io_send / rpc.io_recv             messages lost/delayed in transit
+  agent.heartbeat                       liveness signal suppressed
+  agent.lease_grant                     grant window errors
+  agent.reserve_bundles                 agent dies mid-PG-reserve-wave
+  controller.reserve_wave               controller-side wave aborts
+  store.serve_chunk / store.pull_chunk  chunked transfer boundaries
+  worker.lineage_resubmit               reconstruction entry point
+  serve.replica_call                    replica dies mid-request
+  train.step / train.group_restart      train worker dies mid-step
+
+Every cluster-level test ends with zero dead-process arena pins
+(_arena_pins_settle).  Each test runs its own cluster (it kills pieces
+of it).
+"""
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+from ray_tpu.cluster_utils import Cluster
+
+from test_chaos_adversarial import _arena_pins_settle
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """No armed site may leak between tests (or into other suites)."""
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture
+def fp_ray():
+    """Single-node runtime with a short actor-reply watchdog (the
+    dropped-reply tests wait on it) and everything else stock."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4},
+                 _system_config={"actor_reply_resend_s": 2.0})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _core():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker()
+
+
+# --------------------------------------------------------------- module
+class TestFailpointModule:
+    """Pure-unit semantics of the failpoint table itself."""
+
+    def test_parse_and_env_sync(self):
+        failpoints.configure("a.b=nth:3+drop,c.d=delay:5")
+        assert failpoints.ACTIVE
+        assert os.environ[failpoints.ENV_VAR] == failpoints.spec()
+        failpoints.reset()
+        assert not failpoints.ACTIVE
+        assert failpoints.ENV_VAR not in os.environ
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            failpoints.configure("no_equals_sign")
+        with pytest.raises(ValueError):
+            failpoints.configure("a.b=frobnicate")
+
+    def test_nth_fires_once_then_disarms(self):
+        failpoints.configure("s=nth:2+drop")
+        assert failpoints.fire("s") is False          # hit 1
+        assert failpoints.fire("s") is True           # hit 2: fires
+        assert "s" not in failpoints.spec()           # one-shot disarm
+        assert failpoints.counters()["s"]["fired"] == 1
+
+    def test_error_action_resolves_class(self):
+        failpoints.configure("s=error:ValueError")
+        with pytest.raises(ValueError, match="injected by failpoint"):
+            failpoints.fire("s")
+        failpoints.configure("s=error")
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire("s")
+
+    def test_prob_is_seed_deterministic(self):
+        failpoints.configure("s=prob:0.5+drop", seed=123)
+        run1 = [failpoints.fire("s") for _ in range(32)]
+        failpoints.configure("s=prob:0.5+drop", seed=123)
+        run2 = [failpoints.fire("s") for _ in range(32)]
+        assert run1 == run2
+        assert any(run1) and not all(run1)
+        failpoints.configure("s=prob:0.5+drop", seed=124)
+        assert [failpoints.fire("s") for _ in range(32)] != run1
+
+    def test_delay_action(self):
+        failpoints.configure("s=delay:30")
+        t0 = time.monotonic()
+        assert failpoints.fire("s") is False
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_child_sigkill_scrubs_one_shot_crash_sites(self):
+        """A SIGKILLed child while a one-shot crash site is armed in the
+        SPAWNER must disarm it there too (the dying process can only
+        scrub its own env) — otherwise every replacement worker inherits
+        the armed spec and "fire exactly once" becomes a crash loop.
+        Recurring crash sites stay armed: crashing every process is
+        their contract."""
+        failpoints.configure("a.b=nth:1+crash,c.d=crash,e.f=nth:2+drop")
+        failpoints.on_child_sigkill()
+        spec = failpoints.spec()
+        assert "a.b" not in spec                       # one-shot crash: gone
+        assert "c.d=crash" in spec                     # recurring: stays
+        assert "e.f=nth:2+drop" in spec                # non-crash: stays
+        assert "a.b" not in os.environ[failpoints.ENV_VAR]
+        assert "a.b" in failpoints.counters()          # visible post-scrub
+
+    def test_control_ops(self):
+        out = failpoints.control({"op": "set", "spec": "x.y=off"})
+        assert out["armed"] == "x.y=off" and out["pid"] == os.getpid()
+        failpoints.fire("x.y")
+        out = failpoints.control({"op": "counters"})
+        assert out["counters"]["x.y"]["hits"] == 1
+        assert out["counters"]["x.y"]["fired"] == 0   # "off" never fires
+        out = failpoints.control({"op": "clear"})
+        assert out["armed"] == ""
+
+
+# ----------------------------------------------------- rpc transport
+def test_io_send_windows(fp_ray):
+    """rpc.io_send: delay leaves calls correct (just slower); drop makes
+    the process mute until disarmed — and it recovers the moment the
+    site clears."""
+    core = _core()
+    failpoints.configure("rpc.io_send=delay:10")
+    reply, _ = core.call(core.agent_addr, "ping", {}, timeout=30.0)
+    assert reply["node_id"]
+    assert failpoints.counters()["rpc.io_send"]["hits"] > 0
+    failpoints.configure("rpc.io_send=drop")
+    with pytest.raises(Exception):
+        core.call(core.agent_addr, "ping", {}, timeout=1.5)
+    failpoints.reset()
+    reply, _ = core.call(core.agent_addr, "ping", {}, timeout=30.0)
+    assert reply["node_id"]
+
+
+def test_io_recv_drop_window(fp_ray):
+    """rpc.io_recv=drop: every inbound message (including our call's
+    reply) is lost; the call times out instead of wedging, and clearing
+    the site restores the transport."""
+    core = _core()
+    failpoints.configure("rpc.io_recv=drop")
+    with pytest.raises(Exception):
+        core.call(core.agent_addr, "ping", {}, timeout=1.5)
+    counters = failpoints.counters()
+    failpoints.reset()
+    assert counters["rpc.io_recv"]["fired"] >= 1
+    reply, _ = core.call(core.agent_addr, "ping", {}, timeout=30.0)
+    assert reply["node_id"]
+    # An injected ERROR on the IO thread has no caller to surface to: it
+    # must degrade to drop-with-log, never kill the IO thread (which
+    # would wedge every socket of the process — including the clear).
+    failpoints.configure("rpc.io_recv=error")
+    with pytest.raises(Exception):
+        core.call(core.agent_addr, "ping", {}, timeout=1.5)
+    failpoints.reset()
+    reply, _ = core.call(core.agent_addr, "ping", {}, timeout=30.0)
+    assert reply["node_id"]
+
+
+# ------------------------------------------------- dropped actor reply
+def _counter_actor():
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def arm(self, spec):
+            from ray_tpu._private import failpoints as fp
+
+            fp.configure(spec)
+            return True
+
+        def counters(self):
+            from ray_tpu._private import failpoints as fp
+
+            return fp.counters()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    return Counter
+
+
+def test_reply_dropped_loop_path(fp_ray):
+    """rpc.reply_dispatch=drop on the actor's worker: the actor MUTATED
+    state, the reply vanished.  The caller's watchdog resends the same
+    seqno after actor_reply_resend_s; the receiver serves the CACHED
+    reply — the call completes and the state advanced exactly once."""
+    Counter = _counter_actor()
+    # max_task_retries forces the loop path (the fused direct path is
+    # covered by the next test).
+    c = ray_tpu.remote(Counter).options(max_task_retries=1).remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    # Reply hits on this worker: 1 = the arm call's own reply, 2 = the
+    # next incr — which is the one that gets dropped.
+    assert ray_tpu.get(c.arm.remote("rpc.reply_dispatch=nth:2+drop"),
+                       timeout=30)
+    t0 = time.monotonic()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+    dt = time.monotonic() - t0
+    assert dt >= 1.5, f"reply can't have been dropped (completed in {dt:.2f}s)"
+    # Safe retry: no double-apply.
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 3
+    ctr = ray_tpu.get(c.counters.remote(), timeout=30)
+    assert ctr["rpc.reply_dispatch"]["fired"] == 1
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+
+
+def test_reply_dropped_direct_path(fp_ray):
+    """Same window on the fused sync fast path (sole-in-flight,
+    max_task_retries=0): the loop-side watchdog resends the SAME msgid
+    and the original future resolves."""
+    Counter = _counter_actor()
+    c = ray_tpu.remote(Counter).remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.arm.remote("rpc.reply_dispatch=nth:2+drop"),
+                       timeout=30)
+    t0 = time.monotonic()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 2
+    assert time.monotonic() - t0 >= 1.5
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 3
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+
+
+def test_reply_dropped_big_reply_never_reexecutes(fp_ray):
+    """Replies >64KiB shed their payload from the receiver's dedupe
+    cache at completion; the watchdog's resend must hit the tombstone
+    and get an explicit "reply evicted" error — NOT a silent second
+    execution (the method mutated state; at-most-once is the contract
+    the resend watchdog advertises)."""
+    class BigCounter:
+        def __init__(self):
+            self.n = 0
+
+        def arm(self, spec):
+            from ray_tpu._private import failpoints as fp
+
+            fp.configure(spec)
+            return True
+
+        def incr_big(self):
+            self.n += 1
+            return bytes(100_000)       # > the 64KiB reply-cache trim
+
+        def get_n(self):
+            return self.n
+
+    c = ray_tpu.remote(BigCounter).remote()
+    assert ray_tpu.get(c.get_n.remote(), timeout=60) == 0
+    # Hit 1 = arm's own reply; hit 2 = incr_big's (the dropped one).
+    assert ray_tpu.get(c.arm.remote("rpc.reply_dispatch=nth:2+drop"),
+                       timeout=30)
+    with pytest.raises(Exception, match="evicted"):
+        ray_tpu.get(c.incr_big.remote(), timeout=60)
+    # The execution happened EXACTLY once — the resend did not re-run it.
+    assert ray_tpu.get(c.get_n.remote(), timeout=60) == 1
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+
+
+# ------------------------------------------------- arena put pipeline
+@pytest.mark.parametrize("site", ["arena.alloc", "arena.copy",
+                                  "arena.seal", "put.publish"])
+def test_arena_put_crash_windows(fp_ray, site):
+    """Crash at each stage of the put pipeline inside an actor: the
+    worker dies IN the window, the retried call completes on the
+    restarted incarnation, and the crash sweep reclaims the dead
+    process's half-created blocks and pins (EOWNERDEAD recovery — the
+    index-publish-last invariant makes everything else rebuildable)."""
+    class Putter:
+        def arm(self, spec):
+            from ray_tpu._private import failpoints as fp
+
+            fp.configure(spec)
+            return True
+
+        def put_big(self):
+            import numpy as np
+
+            ref = ray_tpu.put(np.full(300_000, 7, np.uint8))
+            return [ref]
+
+        def ping(self):
+            return "ok"
+
+    p = ray_tpu.remote(Putter).options(max_restarts=2,
+                                       max_task_retries=2).remote()
+    assert ray_tpu.get(p.ping.remote(), timeout=60) == "ok"
+    assert ray_tpu.get(p.arm.remote(f"{site}=crash"), timeout=30)
+    # The crash fires mid-put; max_task_retries re-runs put_big on the
+    # restarted (unarmed) incarnation, so the call COMPLETES.
+    wrapped = ray_tpu.get(p.put_big.remote(), timeout=120)
+    value = ray_tpu.get(wrapped[0], timeout=60)
+    assert value[0] == 7 and value.nbytes == 300_000
+    assert ray_tpu.get(p.ping.remote(), timeout=60) == "ok"
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), f"leaked pins: {stats}"
+
+
+def test_arena_copy_error_takes_abort_path(fp_ray):
+    """arena.copy=error in the DRIVER: the abort handler must free the
+    creating-state block (no crash-sweep needed) and the put must still
+    succeed through the RPC fallback path."""
+    import numpy as np
+
+    failpoints.configure("arena.copy=error:RuntimeError")
+    ref = ray_tpu.put(np.full(300_000, 9, np.uint8))
+    failpoints.reset()
+    assert ray_tpu.get(ref, timeout=60)[0] == 9
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+
+
+def test_arena_alloc_error_aborts_allocation():
+    """arena.alloc=error in a LIVE process: the abort handler must free
+    the just-allocated creating-state block — a live process's creating
+    block is invisible to the dead-pid sweep, so anything short of an
+    immediate abort leaks it until the arena fills."""
+    from ray_tpu._private.native_store import Arena
+
+    a = Arena(f"/raytpu_fpalloc_{os.getpid()}",
+              capacity=8 * 1024 * 1024, create=True)
+    try:
+        baseline = a.stats()
+        failpoints.configure("arena.alloc=error:RuntimeError")
+        for i in range(3):
+            with pytest.raises(RuntimeError):
+                a.put_frames(f"{i:016d}".encode(), [b"x" * 300_000])
+        assert failpoints.counters()["arena.alloc"]["fired"] == 3
+        failpoints.reset()
+        after = a.stats()
+        # Nothing may survive the aborts: neither bytes nor entries.
+        assert after["used"] == baseline["used"], after
+        assert after["num_objects"] == baseline["num_objects"], after
+        # The arena still works once disarmed.
+        oid = b"Z" * 16
+        assert a.put_frames(oid, [b"y" * 1000])
+        assert bytes(a.get_frames(oid)[0]) == b"y" * 1000
+    finally:
+        failpoints.reset()
+        a.close()
+
+
+# ------------------------------------------------------- control verb
+def test_control_verb_reaches_running_processes(fp_ray):
+    """Cluster-wide broadcast through the controller arms agents AND
+    already-running workers; spawn-time env inheritance covers workers
+    created afterwards; clear undoes both."""
+    core = _core()
+
+    @ray_tpu.remote
+    def read_spec():
+        from ray_tpu._private import failpoints as fp
+
+        return fp.spec()
+
+    # Make sure at least one worker exists and is registered.
+    assert ray_tpu.get(read_spec.remote(), timeout=60) == ""
+    reply, _ = core.call(core.controller_addr, "failpoints",
+                         {"op": "set", "spec": "test.probe=off",
+                          "broadcast": True}, timeout=30.0)
+    assert reply["armed"] == "test.probe=off"
+    assert reply["nodes"], "broadcast reached no agents"
+    agent_reply = next(iter(reply["nodes"].values()))
+    assert agent_reply["armed"] == "test.probe=off"
+    assert agent_reply.get("workers"), "agent broadcast reached no workers"
+    # Any worker — already running (verb) or spawned later (env
+    # inheritance from the armed agent) — sees the site.
+    assert ray_tpu.get(read_spec.remote(), timeout=60) == "test.probe=off"
+    reply, _ = core.call(core.controller_addr, "failpoints",
+                         {"op": "clear", "broadcast": True}, timeout=30.0)
+    assert reply["armed"] == ""
+    assert ray_tpu.get(read_spec.remote(), timeout=60) == ""
+
+
+# --------------------------------------------------------- node agent
+def test_heartbeat_drop_node_dies_and_rejoins():
+    """The two-level liveness contract, window by window: (1) dropped
+    heartbeats alone must NOT kill a reachable node — the controller's
+    direct probe saves it; (2) dropping the agent's replies too (probe
+    unanswerable) must declare it dead; (3) clearing the sites lets the
+    still-running agent re-register and come back ALIVE."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    n1 = cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(1)
+        core = _core()
+
+        def node_state():
+            for n in ray_tpu.nodes():
+                if n["node_id"] == n1["node_id"]:
+                    return n["state"]
+            return "GONE"
+
+        # (1) heartbeats suppressed, agent reachable: probe keeps it ALIVE.
+        reply, _ = core.call(n1["agent_addr"], "failpoints",
+                             {"op": "set", "spec": "agent.heartbeat=drop"},
+                             timeout=10.0)
+        assert reply["armed"] == "agent.heartbeat=drop"
+        time.sleep(12.0)      # >2x node_death_timeout_s
+        assert node_state() == "ALIVE", \
+            "probe layer failed to save a reachable node"
+        # (2) replies suppressed too: the probe goes unanswered → DEAD.
+        # The set APPLIES server-side but its own reply is eaten by the
+        # site it just armed — exactly the window under test.
+        try:
+            core.call(
+                n1["agent_addr"], "failpoints",
+                {"op": "set",
+                 "spec": "agent.heartbeat=drop,rpc.reply_dispatch=drop"},
+                timeout=5.0)
+        except Exception:  # noqa: BLE001 - reply dropped by design
+            pass
+        deadline = time.monotonic() + 45
+        while node_state() == "ALIVE" and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert node_state() != "ALIVE", "node never declared dead"
+        # (3) clear over the SAME address: reset() lowers the flag before
+        # the reply dispatches, so THIS reply gets through — and the
+        # agent's next heartbeat re-registers the node.
+        reply, _ = core.call(n1["agent_addr"], "failpoints",
+                             {"op": "clear"}, timeout=10.0)
+        assert reply["armed"] == ""
+        deadline = time.monotonic() + 30
+        while node_state() != "ALIVE" and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert node_state() == "ALIVE", "node never rejoined after clear"
+
+        @ray_tpu.remote
+        def ping():
+            return "ok"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_lease_grant_error_window(fp_ray):
+    """agent.lease_grant=nth:1+error: the first grant dies AFTER the
+    resource acquisition — the release path must run (no double-booked
+    resources) and the submitter's pusher re-requests, so the task
+    completes and the node's full capacity stays usable."""
+    core = _core()
+    reply, _ = core.call(core.agent_addr, "failpoints",
+                         {"op": "set",
+                          "spec": "agent.lease_grant=nth:1+error"},
+                         timeout=10.0)
+    assert reply["armed"]
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1), timeout=120) == 2
+    reply, _ = core.call(core.agent_addr, "failpoints", {"op": "counters"},
+                         timeout=10.0)
+    assert reply["counters"]["agent.lease_grant"]["fired"] == 1
+    # Full capacity proves the failed grant released its acquisition.
+    @ray_tpu.remote(num_cpus=4)
+    def wide():
+        return "fits"
+
+    assert ray_tpu.get(wide.remote(), timeout=120) == "fits"
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+
+
+# ------------------------------------------------ PG reserve wave
+def test_agent_crash_mid_reserve_wave_no_leaked_bundles():
+    """agent.reserve_bundles=nth:1+crash on node 2: the agent dies
+    mid-wave with bundle 1 locally reserved but never granted.  The
+    controller's STRICT rollback must release node 1's reservation (the
+    dead node's dies with it), and node 1's FULL capacity must remain
+    placeable afterwards."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    n1 = cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+
+        cluster.wait_for_nodes(2)
+        core = _core()
+        reply, _ = core.call(
+            n2["agent_addr"], "failpoints",
+            {"op": "set", "spec": "agent.reserve_bundles=nth:1+crash"},
+            timeout=10.0)
+        assert reply["armed"]
+        # Two 2-CPU bundles can only place across BOTH nodes: the wave
+        # reserves on n1, crashes n2 mid-reserve, and must roll back.
+        pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+        assert pg.ready(timeout=10) is False, \
+            "PG became ready despite the agent dying mid-wave"
+        # n2 is dead; n1's 2 CPUs must NOT be leaked by the rollback: a
+        # single-bundle 2-CPU group must become ready on n1.
+        pg2 = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg2.ready(timeout=60), "rollback leaked node 1's bundle"
+
+        @ray_tpu.remote(num_cpus=2, placement_group=pg2)
+        def inside():
+            return "placed"
+
+        assert ray_tpu.get(inside.remote(), timeout=120) == "placed"
+        remove_placement_group(pg2)
+        remove_placement_group(pg)
+        # The dead node is eventually observed dead.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+            if states.get(n2["node_id"]) != "ALIVE":
+                break
+            time.sleep(0.5)
+        assert states.get(n2["node_id"]) != "ALIVE"
+        stats = _arena_pins_settle()
+        assert not stats.get("swept_dead_pins", 0), stats
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_controller_reserve_wave_error_retries():
+    """controller.reserve_wave=nth:1+error: the first wave aborts before
+    any reserve RPC; the PG scheduler's retry loop places the group on
+    the next pass (one-shot site), and the controller's counters prove
+    the window fired."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+
+        cluster.wait_for_nodes(1)
+        core = _core()
+        reply, _ = core.call(
+            core.controller_addr, "failpoints",
+            {"op": "set", "spec": "controller.reserve_wave=nth:1+error"},
+            timeout=10.0)
+        assert reply["armed"]
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=60), "PG never recovered from the aborted wave"
+        reply, _ = core.call(core.controller_addr, "failpoints",
+                             {"op": "counters"}, timeout=10.0)
+        assert reply["counters"]["controller.reserve_wave"]["fired"] == 1
+        remove_placement_group(pg)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------------- chunked pull + lineage
+def test_source_crash_mid_chunked_pull_lineage_recovers():
+    """store.serve_chunk=nth:3+crash on the node holding a large object:
+    the source agent dies after serving two chunks of the pull.  The
+    getter must fall through its locations, hit the lineage-resubmit
+    window (observed via the driver's own counters), re-run the
+    producing task on the surviving node, and return the right bytes —
+    with zero dead-process pins afterwards."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster('{"transfer_chunk_bytes": 1048576}')
+    cluster.start_head()
+    n1 = cluster.add_node(resources={"CPU": 2, "remote": 1, "pin1": 1})
+    n2 = cluster.add_node(resources={"CPU": 2, "remote": 1})
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"transfer_chunk_bytes": 1048576})
+    try:
+        cluster.wait_for_nodes(2)
+        core = _core()
+
+        # Blocker holds n1's "remote" so the producer MUST run on n2;
+        # killed afterwards so the lineage re-run fits on n1.
+        @ray_tpu.remote(resources={"remote": 1, "pin1": 1}, num_cpus=0)
+        class Blocker:
+            def ping(self):
+                return "held"
+
+        blocker = Blocker.remote()
+        assert ray_tpu.get(blocker.ping.remote(), timeout=60) == "held"
+
+        @ray_tpu.remote(resources={"remote": 0.5}, max_retries=4)
+        def big(fill):
+            import numpy as np
+
+            return np.full(6_000_000, fill, dtype=np.uint8)
+
+        ref_warm = big.remote(2)
+        ref = big.remote(3)
+        done, _ = ray_tpu.wait([ref_warm, ref], num_returns=2,
+                               timeout=120)
+        assert len(done) == 2, "producers never finished"
+        ray_tpu.kill(blocker)
+        time.sleep(1.0)   # agent frees the blocker's resources
+
+        # Phase A — healthy chunked pull with the chunk-boundary site
+        # armed on the PULLING agent (n1): proves the window is crossed.
+        core.call(n1["agent_addr"], "failpoints",
+                  {"op": "set", "spec": "store.pull_chunk=delay:1"},
+                  timeout=10.0)
+        warm = ray_tpu.get(ref_warm, timeout=120)
+        assert warm[0] == 2
+        reply, _ = core.call(n1["agent_addr"], "failpoints",
+                             {"op": "counters"}, timeout=10.0)
+        assert reply["counters"]["store.pull_chunk"]["hits"] >= 1, \
+            "pull never crossed a chunk boundary on the pulling agent"
+
+        # Phase B — n2's agent dies serving a chunk of the second
+        # object; the driver's get must fall through to lineage.
+        reply, _ = core.call(
+            n2["agent_addr"], "failpoints",
+            {"op": "set", "spec": "store.serve_chunk=nth:3+crash"},
+            timeout=10.0)
+        assert reply["armed"]
+        failpoints.configure("worker.lineage_resubmit=delay:1")
+
+        value = ray_tpu.get(ref, timeout=180)
+        assert value[0] == 3 and value.nbytes == 6_000_000
+        assert failpoints.counters()[
+            "worker.lineage_resubmit"]["fired"] >= 1, \
+            "recovery did not go through the lineage window"
+        # The crash (not a timeout) is what killed n2.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+            if states.get(n2["node_id"]) != "ALIVE":
+                break
+            time.sleep(0.5)
+        assert states.get(n2["node_id"]) != "ALIVE"
+        failpoints.reset()
+        stats = _arena_pins_settle()
+        assert not stats.get("swept_dead_pins", 0), stats
+    finally:
+        failpoints.reset()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# ----------------------------------------------- error-message audit
+def test_object_lost_error_names_locations_and_lineage():
+    """The surfaced ObjectLostError carries the diagnosis (ref, every
+    location tried, lineage verdict) instead of a bare 12-char id —
+    round 9 also fixed the exception class truncating its message."""
+    from ray_tpu.exceptions import ObjectLostError
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 2, "remote": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"remote": 0.5}, max_retries=0)
+        def big():
+            import numpy as np
+
+            return np.ones(3_000_000, np.uint8)
+
+        ref = big.remote()
+        done, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
+        assert done
+        cluster.kill_node(n2)
+        # Wait for death detection so the skip-dead-location path logs
+        # its reason rather than burning RPC timeouts.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+            if states.get(n2["node_id"]) != "ALIVE":
+                break
+            time.sleep(0.5)
+        with pytest.raises(ObjectLostError) as ei:
+            ray_tpu.get(ref, timeout=120)
+        msg = str(ei.value)
+        assert ref.hex()[:12] in msg, msg
+        assert "locations tried" in msg, msg
+        assert "lineage" in msg, msg
+        assert ei.value.object_id == ref.hex()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# -------------------------------------------------------------- serve
+def test_replica_crash_mid_request_requeues(fp_ray):
+    """serve.replica_call=nth:1+crash on ONE replica of a 2-replica
+    deployment: the next request routed to it dies mid-request (before
+    the user callable ran) and must complete on the other replica via
+    the handle's dead-replica requeue — no caller ever sees the death."""
+    from ray_tpu import serve
+
+    serve.start()
+    try:
+        @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+        class Svc:
+            def arm(self):
+                import os as _os
+
+                from ray_tpu._private import failpoints as fp
+
+                fp.arm("serve.replica_call", "nth:1+crash")
+                return _os.getpid()
+
+            def ping(self, i):
+                import os as _os
+
+                return (i, _os.getpid())
+
+        h = serve.run(Svc.bind(), name="fp_app", route_prefix="/fp")
+        armed_pid = h.arm.remote().result(timeout_s=60)
+        # Sequential requests: pow-2 routing sends one to the armed
+        # replica almost immediately; THAT request crashes it and must
+        # still succeed on the survivor.
+        results = []
+        for i in range(12):
+            results.append(h.ping.remote(i).result(timeout_s=120))
+        assert [r[0] for r in results] == list(range(12))
+        # The window genuinely fired: the armed replica process is gone.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                os.kill(armed_pid, 0)
+                time.sleep(0.5)
+            except ProcessLookupError:
+                break
+        else:
+            raise AssertionError(
+                f"armed replica {armed_pid} still alive — the "
+                f"serve.replica_call window never fired")
+        stats = _arena_pins_settle()
+        assert not stats.get("swept_dead_pins", 0), stats
+    finally:
+        serve.shutdown()
+
+
+# -------------------------------------------------------------- train
+def _fp_train_loop(config):
+    """Checkpoint-per-step loop; rank 0 arms train.step=crash ONCE at
+    the configured step (marker file bounds it to one incarnation) — the
+    crash then fires INSIDE session.report, i.e. mid-step."""
+    import os as _os
+    import time as _time
+
+    from ray_tpu import train
+    from ray_tpu._private import failpoints as fp
+    from ray_tpu.train import Checkpoint
+
+    ctx = train.get_context()
+    ckpt = train.get_checkpoint()
+    start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+    for i in range(start, config["total_steps"]):
+        marker = config["kill_marker"]
+        if (i == config["kill_at"] and ctx.get_world_rank() == 0
+                and not _os.path.exists(marker)):
+            open(marker, "w").close()
+            fp.arm("train.step", "crash")
+        train.report({"step": i, "start": start,
+                      "rank": ctx.get_world_rank()},
+                     checkpoint=Checkpoint.from_dict({"step": i}))
+        _time.sleep(config.get("step_sleep_s", 0.4))
+
+
+def test_train_step_crash_group_restart(fp_ray, tmp_path):
+    """train.step=crash mid-run: the group restart (train.group_restart
+    window instrumented with a delay in the driver) resumes from the
+    NEWEST checkpoint, not the run's origin."""
+    from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    failpoints.configure("train.group_restart=delay:10")
+    marker = tmp_path / "killed_once"
+    trainer = JaxTrainer(
+        _fp_train_loop,
+        train_loop_config={"total_steps": 6, "kill_at": 3,
+                           "step_sleep_s": 0.4,
+                           "kill_marker": str(marker)},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     num_cpus_per_worker=0.5),
+        run_config=RunConfig(name="fp_train", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert marker.exists(), "the train.step window never armed"
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 5
+    # Resumed from the newest checkpoint: some incarnation started > 0.
+    starts = {m.get("start") for m in result.metrics_history}
+    assert any(s > 0 for s in starts if s is not None), starts
+    # The group-restart window fired in THIS process.
+    assert failpoints.counters()["train.group_restart"]["fired"] >= 1
+    failpoints.reset()
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
