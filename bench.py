@@ -617,6 +617,141 @@ def bench_chaos_recovery() -> dict:
     return out
 
 
+def bench_collective() -> dict:
+    """Same-run A/B of the DCN collective plane (ISSUE 5): 3 ranks
+    pinned to 3 in-process cluster nodes (real per-node arenas; the
+    inter-node path is the chunked object plane with the round-10
+    same-host direct-shm fast copy underneath) stream allreduces with
+    the RING schedule vs the LEGACY gather backend, at 2 sizes.
+
+    Streamed (allreduce_async, 2 ops in flight) because overlap is part
+    of the shipped design; trials interleave ring/legacy legs and keep
+    the best per leg (PR 1 best-of convention — hypervisor steal swings
+    single legs 2-3x).  The tracer rows prove the SCHEDULE shape: ring
+    moves 2*N*(world-1)/world bytes per rank regardless of world size,
+    the legacy gather pulls O(world*N).
+    """
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out: dict = {}
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(config_json=json.dumps(
+        {"object_store_memory": 1024 * 1024 * 1024}))
+    cluster.start_head()
+    for i in range(3):
+        cluster.add_node(resources={"CPU": 2, f"colr{i}": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        class Rank:
+            def init_collective_group(self, world, rank, backend, name):
+                import os as _os
+
+                _os.environ["RAY_TPU_COLLECTIVE_INFLIGHT_OPS"] = "2"
+                from ray_tpu import collective as col
+
+                col.init_collective_group(world, rank, backend, name,
+                                          timeout_s=120.0)
+                self.rank = rank
+                return rank
+
+            def stream(self, group, mib, iters, ring):
+                import os as _os
+                import time as _t
+
+                import numpy as np
+
+                _os.environ["RAY_TPU_RING_COLLECTIVES"] = \
+                    "1" if ring else "0"
+                from ray_tpu import collective as col
+
+                x = np.full(mib * 1024 * 1024 // 4,
+                            float(self.rank + 1), np.float32)
+                col.barrier(group)
+                t0 = _t.perf_counter()
+                works = [col.allreduce_async(x, group_name=group)
+                         for _ in range(iters)]
+                outs = [w.wait(300) for w in works]
+                dt = _t.perf_counter() - t0
+                for o in outs:
+                    assert o[0] == 6.0 and o[-1] == 6.0
+                return x.nbytes * iters / dt / (1 << 30)
+
+            def traced(self, group, mib, ring):
+                import os as _os
+
+                import numpy as np
+
+                _os.environ["RAY_TPU_RING_COLLECTIVES"] = \
+                    "1" if ring else "0"
+                from ray_tpu import collective as col
+                from ray_tpu import profiling
+
+                x = np.full(mib * 1024 * 1024 // 4,
+                            float(self.rank + 1), np.float32)
+                col.barrier(group)
+                with profiling.collective_trace() as rec:
+                    col.allreduce(x, group_name=group)
+                return profiling.collective_breakdown_us(rec)
+
+        mk = ray_tpu.remote(Rank)
+        ws = [mk.options(num_cpus=0.5,
+                         resources={f"colr{i}": 0.5}).remote()
+              for i in range(3)]
+        ray_tpu.get([w.init_collective_group.remote(
+            3, i, "object_store", "bench") for i, w in enumerate(ws)],
+            timeout=120)
+
+        sizes = {"8mib": 8, "64mib": 64}
+        best: dict = {}
+        for trial in range(3):
+            for label, mib in sizes.items():
+                for ring in (True, False):
+                    iters = 3 if mib <= 8 else 2
+                    rates = ray_tpu.get(
+                        [w.stream.remote("bench", mib, iters, ring)
+                         for w in ws], timeout=300)
+                    key = (label, ring)
+                    best[key] = max(best.get(key, 0.0), min(rates))
+        for label in sizes:
+            out[f"collective_allreduce_{label}_ring_gib_per_s"] = round(
+                best[(label, True)], 3)
+            out[f"collective_allreduce_{label}_legacy_gib_per_s"] = \
+                round(best[(label, False)], 3)
+        r64, l64 = best[("64mib", True)], best[("64mib", False)]
+        out["collective_allreduce_ring_gib_per_s"] = round(r64, 3)
+        out["collective_allreduce_legacy_gib_per_s"] = round(l64, 3)
+        out["collective_ring_speedup_x"] = round(r64 / l64, 2) if l64 \
+            else None
+
+        # Schedule-shape proof: per-rank bytes counted by the tracer.
+        ring_br = ray_tpu.get(
+            [w.traced.remote("bench", 64, True) for w in ws],
+            timeout=300)[0]
+        legacy_br = ray_tpu.get(
+            [w.traced.remote("bench", 64, False) for w in ws],
+            timeout=300)[0]
+        n = 64 * 1024 * 1024
+        out["collective_ring_bytes_per_rank"] = ring_br.get("recv_bytes")
+        out["collective_ring_bytes_expected"] = 2 * n * 2 // 3
+        out["collective_legacy_bytes_per_rank"] = \
+            legacy_br.get("recv_bytes")
+        out["collective_ring_phase_us"] = {
+            k: ring_br.get(k) for k in
+            ("send_us", "pull_us", "reduce_us", "wait_us", "total_us")}
+        from ray_tpu import collective as col
+
+        col.destroy_collective_group("bench")
+    finally:
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+    return out
+
+
 def bench_put_path() -> dict:
     """Same-run A/B of the arena write path (ISSUE 2): one fresh driver
     puts 256 MiB with the streaming kernel / parallel writer / free-space
@@ -1195,6 +1330,13 @@ def main() -> None:
         extra.update(_with_timeout(bench_put_path, 300))
     except Exception as e:  # noqa: BLE001
         extra["put_path_error"] = repr(e)
+    _flush_partial(extra)
+    try:
+        # 3 trials x 2 sizes x 2 paths of streamed allreduces + cluster
+        # boot: ~200s typical; alarm above the worst observed leg.
+        extra.update(_with_timeout(bench_collective, 420))
+    except Exception as e:  # noqa: BLE001
+        extra["collective_error"] = repr(e)
     _flush_partial(extra)
     try:
         # Umbrella must exceed the SUM of the phases' internal deadlines

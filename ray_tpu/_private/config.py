@@ -89,6 +89,27 @@ class Config:
     # (ray: 64MB chunks, 8 in flight — object_manager.cc:508).
     transfer_chunk_bytes: int = 64 * 1024 * 1024
     transfer_chunks_in_flight: int = 8
+    # --- DCN collectives (ray_tpu/collective) ---
+    # Schedule threshold: tensors >= ring_min_bytes take the bandwidth-
+    # optimal ring (reduce-scatter + allgather, 2*N*(world-1)/world bytes
+    # per rank); smaller tensors take the binomial-tree path (2*ceil(log2
+    # world) hops — round trips dominate, per CLAUDE.md).  NOTE: unlike
+    # every other field here, these document the knob NAMES and defaults
+    # only — the collective module is library-layer code (no runtime
+    # internals), so it reads the RAY_TPU_COLLECTIVE_* ENV VARS directly
+    # at call time and `_system_config`/config_json does NOT reach it.
+    # Kill switch RAY_TPU_RING_COLLECTIVES=0 restores the legacy
+    # gather-all path for same-run A/B.
+    collective_ring_min_bytes: int = 256 * 1024
+    # Sub-chunks per ring hop: the local reduce of sub-chunk k overlaps
+    # the transport of sub-chunk k+1 (prefetch thread).  Each sub-chunk
+    # is kept >= pipeline_min_bytes so tiny puts don't dominate.
+    collective_pipeline_chunks: int = 4
+    collective_pipeline_min_bytes: int = 1 * 1024 * 1024
+    # Per-exchange deadline: a rank that crashes mid-collective must
+    # surface as a diagnostic error naming the missing rank(s) on the
+    # survivors, never a hang.
+    collective_timeout_s: float = 120.0
     # Idle seconds before a leased worker is returned to the pool.
     lease_idle_timeout_s: float = 1.0
     # Max seconds a lease request parks agent-side waiting for capacity

@@ -681,6 +681,14 @@ class Controller:
             (h.get("namespace", "default"), h["name"]))
         if actor_id is None:
             return {"found": False}
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == DEAD:
+            # The name table keeps dead entries (the creation taken-check
+            # tolerates them); a lookup must not hand out a handle to a
+            # terminally dead actor — callers treat "found" as "usable"
+            # (e.g. destroy_collective_group killing a leftover
+            # rendezvous would otherwise always "find" the old corpse).
+            return {"found": False}
         return {"found": True, "actor_id": actor_id}
 
     async def rpc_remove_actor(self, h: dict, _b: list) -> dict:

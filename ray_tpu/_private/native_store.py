@@ -403,6 +403,46 @@ class Arena:
         weakref.finalize(buf, self._release_pin, oid)
         return memoryview(buf).toreadonly()
 
+    def get_raw_addr(self, oid: bytes) -> tuple[int, int, object] | None:
+        """(address, size, release) of the WHOLE frame bundle for the
+        same-host cross-arena copy path: the caller streams bytes
+        straight out of this arena's mapping into another arena, then
+        calls release() exactly once.  The pin taken here is the normal
+        pid-attributed read pin — a crashed reader's pin is reclaimed by
+        this arena's sweep, same as any zero-copy view."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_get(self.handle, oid,
+                                     ctypes.byref(off), ctypes.byref(size)):
+            return None
+        released = threading.Event()
+
+        def release() -> None:
+            if not released.is_set():
+                released.set()
+                self._release_pin(oid)
+        return self.base + off.value, size.value, release
+
+    def write_raw_from_addr(self, oid: bytes, offset: int, src_addr: int,
+                            n: int) -> bool:
+        """write_raw from a raw source address (another mapped arena):
+        big spans ride the same non-temporal streaming kernel as local
+        puts — the same-host object transfer is ONE copy at memory
+        bandwidth, no zmq hop."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        if not self.lib.rt_store_peek(self.handle, oid, ctypes.byref(off),
+                                      ctypes.byref(size)):
+            return False
+        if offset + n > size.value:
+            return False
+        if n >= self.stream_min:
+            self.lib.rt_store_write_stream(self.handle, off.value + offset,
+                                           src_addr, n)
+        else:
+            ctypes.memmove(self.base + off.value + offset, src_addr, n)
+        return True
+
     def read_bundle_copy(self, oid: bytes) -> bytes | None:
         """COPY of the whole frame bundle with the pin released before
         returning.  The spill path uses this instead of get_raw: a
@@ -422,6 +462,17 @@ class Arena:
         """Allocate an unsealed region for chunked assembly."""
         return self.lib.rt_store_alloc(
             self.handle, oid, ctypes.c_uint64(total)) != 0
+
+    def peek_raw(self, oid: bytes) -> bool:
+        """True while a CREATING-state block exists for oid (another
+        puller's in-flight assembly).  Distinguishes create_raw's two
+        failure causes: duplicate id (wait for the sibling) vs capacity
+        (spill to make room)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        return bool(self.lib.rt_store_peek(self.handle, oid,
+                                           ctypes.byref(off),
+                                           ctypes.byref(size)))
 
     def write_raw(self, oid: bytes, offset: int, chunk: bytes) -> bool:
         """Write one chunk into a creating-state region (DCN pulls land
@@ -570,11 +621,21 @@ class NativeStoreBackend:
     def get_raw(self, oid: bytes):
         return self.arena.get_raw(oid)
 
+    def get_raw_addr(self, oid: bytes):
+        return self.arena.get_raw_addr(oid)
+
+    def write_raw_from_addr(self, oid: bytes, offset: int, src_addr: int,
+                            n: int) -> bool:
+        return self.arena.write_raw_from_addr(oid, offset, src_addr, n)
+
     def get_bundle_copy(self, oid: bytes) -> bytes | None:
         return self.arena.read_bundle_copy(oid)
 
     def create_raw(self, oid: bytes, total: int) -> bool:
         return self.arena.create_raw(oid, total)
+
+    def peek_raw(self, oid: bytes) -> bool:
+        return self.arena.peek_raw(oid)
 
     def write_raw(self, oid: bytes, offset: int, chunk) -> bool:
         return self.arena.write_raw(oid, offset, chunk)

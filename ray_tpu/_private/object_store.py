@@ -234,6 +234,11 @@ class StoreRunner:
         # from controller "node" events): transfers skip them instead of
         # waiting out the RPC timeout against a silent zmq reconnect.
         self.dead_addrs: set[str] = set()
+        # Same-host peer arenas (shm name -> mapped Arena): multiple
+        # node agents on one host (in-process Cluster, multi-agent
+        # deployments) pull from each other with ONE streaming-kernel
+        # copy instead of the zmq chunk protocol (see _pull_same_host).
+        self._peer_arenas: dict[str, Any] = {}
 
     @property
     def shm_name(self) -> str:
@@ -444,13 +449,16 @@ class StoreRunner:
 
     # --------------------------------------------- node-to-node transfer
     async def rpc_store_get_meta(self, h: dict, _b: list) -> dict:
-        """Bundle size for a chunked pull."""
+        """Bundle size for a chunked pull.  Native arenas also advertise
+        their shm name so a same-host puller can take the direct
+        cross-arena copy path."""
         oid = bytes.fromhex(h["object_id"])
         raw_fn = getattr(self.backend, "get_raw", None)
         if raw_fn is not None:
             raw = raw_fn(oid)
             if raw is not None:
-                return {"found": True, "size": len(raw)}
+                return {"found": True, "size": len(raw),
+                        "shm": getattr(self.backend, "shm_name", None)}
         if oid in self.spilled:
             try:
                 return {"found": True,
@@ -489,22 +497,114 @@ class StoreRunner:
             return {"found": False}, []
         return {"found": True}, [raw[off:off + length]]
 
+    def _peer_arena(self, shm: str):
+        a = self._peer_arenas.get(shm)
+        if a is None:
+            from ray_tpu._private.native_store import Arena
+
+            a = Arena(shm, create=False)
+            self._peer_arenas[shm] = a
+        return a
+
+    async def _reserve_raw(self, oid: bytes, size: int) -> str:
+        """create_raw with the make-room-by-spilling discipline of local
+        puts (shared by the chunked and same-host pull paths).  Returns
+        "created" | "present" | "fail".
+
+        A create_raw failure has TWO causes and only one of them is
+        capacity: a DUPLICATE id means another puller (possibly a
+        worker's direct-shm pull — invisible to this agent's _pulling
+        dedup) is assembling the same object right now.  Spilling in
+        that case would futilely evict the whole arena (create_raw keeps
+        failing on the duplicate), so wait for the sibling instead:
+        "present" once it seals; retry the alloc if its creating block
+        vanishes (aborted, or swept after a crash)."""
+        peek = getattr(self.backend, "peek_raw", None)
+        deadline = time.monotonic() + 120.0
+        for _ in range(8192):
+            if self.backend.contains(oid):
+                return "present"
+            if self.backend.create_raw(oid, size):
+                return "created"
+            if peek is not None and peek(oid):
+                if time.monotonic() > deadline:
+                    return "fail"
+                await asyncio.sleep(0.05)
+                continue
+            async with self._spill_lock:
+                if self.backend.create_raw(oid, size):
+                    return "created"
+                if not await self._spill_one():
+                    return "fail"
+        return "fail"
+
+    async def _pull_same_host(self, oid: bytes, meta: dict) -> bool:
+        """Same-host fast path: the source agent's arena is a /dev/shm
+        file on THIS machine, so map it and stream the sealed bundle
+        straight into the local arena — one non-temporal copy at memory
+        bandwidth, zero zmq hops (the NCCL SHM-transport analog; the
+        in-process test Cluster's "DCN" is exactly this shape).  The
+        source-side read pin is the normal pid-attributed pin, so a
+        crashed puller is swept like any dead reader.  Kill switch
+        RAY_TPU_SHM_PULL=0 restores the chunk protocol."""
+        shm = meta.get("shm")
+        if (not shm or not hasattr(self.backend, "write_raw_from_addr")
+                or os.environ.get("RAY_TPU_SHM_PULL", "1") == "0"):
+            return False
+        if not os.path.exists(os.path.join("/dev/shm", shm.lstrip("/"))):
+            return False    # source arena is not on this host
+        try:
+            peer = self._peer_arena(shm)
+            raw = peer.get_raw_addr(oid)
+        except Exception:  # noqa: BLE001 - racing arena teardown
+            stale = self._peer_arenas.pop(shm, None)
+            if stale is not None:
+                stale.close()
+            return False
+        if raw is None:
+            return False
+        src_addr, size, release = raw
+        try:
+            got = await self._reserve_raw(oid, size)
+            if got == "present":
+                return True       # a sibling pull landed it meanwhile
+            if got != "created":
+                return False
+            def _copy() -> bool:
+                return self.backend.write_raw_from_addr(
+                    oid, 0, src_addr, size)
+            # Off-loop above 8 MiB: even at streaming-kernel speed a
+            # big bundle copy would stall every other RPC this agent
+            # serves.
+            ok = (await asyncio.to_thread(_copy)
+                  if size > (8 << 20) else _copy())
+            if ok:
+                ok = self.backend.seal_raw(oid)
+            if not ok:
+                # Abort on ANY failure (copy or seal): a live agent's
+                # creating-state block is invisible to the dead-pid
+                # sweep, so a leftover would strand the allocation and
+                # park every later _reserve_raw for this oid in its
+                # wait-for-sibling loop.
+                self.backend.abort_raw(oid)
+            return ok
+        except BaseException:
+            self.backend.abort_raw(oid)
+            raise
+        finally:
+            release()
+
     async def _pull_chunked(self, oid: bytes, addr: str,
                             size: int) -> bool:
         """Assemble a remote object from parallel chunk fetches directly
         into the local arena (ray: ObjectManager 64MB chunks, 8 in
         flight, object_manager.cc:508)."""
         chunk = self.config.transfer_chunk_bytes
-        if not self.backend.create_raw(oid, size):
-            # Arena full: make room the same way puts do.
-            async with self._spill_lock:
-                for _ in range(4096):
-                    if not await self._spill_one():
-                        return False
-                    if self.backend.create_raw(oid, size):
-                        break
-                else:
-                    return False
+        got = await self._reserve_raw(oid, size)
+        if got == "present":
+            return True           # a sibling pull landed it meanwhile
+        if got != "created":
+            return False
         sem = asyncio.Semaphore(self.config.transfer_chunks_in_flight)
         failed = asyncio.Event()
 
@@ -547,7 +647,12 @@ class StoreRunner:
         if failed.is_set():
             self.backend.abort_raw(oid)
             return False
-        return self.backend.seal_raw(oid)
+        if not self.backend.seal_raw(oid):
+            # Same discipline as _pull_same_host: never leave a live
+            # process's creating-state block behind.
+            self.backend.abort_raw(oid)
+            return False
+        return True
 
     async def rpc_store_pull(self, h: dict, _b: list) -> dict:
         """Replicate an object from a remote node store into this one
@@ -594,6 +699,9 @@ class StoreRunner:
                 if not meta.get("found"):
                     continue
                 size = meta.get("size")
+                if (size and size <= self.config.object_store_memory
+                        and await self._pull_same_host(oid, meta)):
+                    return True
                 if (size and size > self.config.transfer_chunk_bytes
                         and size <= self.config.object_store_memory
                         and await self._pull_chunked(oid, addr, size)):
@@ -618,7 +726,10 @@ class StoreRunner:
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:
         out = {**self.backend.stats(),
                "spilled_objects": len(self.spilled),
-               "spilled_bytes": self.spilled_bytes}
+               "spilled_bytes": self.spilled_bytes,
+               # Same-host pullers key their direct-shm fast path on
+               # this (None for the dict backend).
+               "shm_name": getattr(self.backend, "shm_name", None)}
         if h.get("sweep"):
             # Chaos-test hook: reclaim + report pins of crash-killed
             # processes right now (the reaper also does this on a 5s
@@ -628,6 +739,12 @@ class StoreRunner:
         return out
 
     def close(self) -> None:
+        for peer in self._peer_arenas.values():
+            try:
+                peer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._peer_arenas.clear()
         self.backend.close()
         import shutil
 

@@ -254,6 +254,11 @@ class CoreWorker:
         self._arena = None
         self._arena_tried = False
         self._arena_lock = threading.Lock()
+        # Same-host peer arenas for the direct-shm pull fast path:
+        # agent addr -> shm name (None = not native / not same host),
+        # shm name -> mapped Arena.  See _pull_direct_shm.
+        self._peer_shm: dict[str, str | None] = {}
+        self._peer_arenas: dict[str, Any] = {}
         # Put-path attribution (profiling.put_stats): arena-direct puts
         # vs silent degradations to the agent store_put RPC, with the
         # first fallback cause kept (and logged once) so "put is slow"
@@ -1057,11 +1062,24 @@ class CoreWorker:
 
         async def _one(oid: bytes, owner: str) -> None:
             try:
-                await self.clients.get(owner).call(
+                reply, _ = await self.clients.get(owner).call(
                     "add_borrow", {"object_id": oid.hex()}, timeout=10.0)
                 acked.append((oid, owner))
             except Exception:  # noqa: BLE001 - owner may already be gone
-                pass
+                return
+            # Location hint riding the ack (see rpc_add_borrow): prefill
+            # the entry so the upcoming get() pulls straight from the
+            # holding node with no resolve_object round trip.  Hints can
+            # go stale (the owner may free/move the object) — _get_one
+            # falls back to the authoritative owner resolve when a
+            # hinted pull misses.
+            if isinstance(reply, dict) and reply.get("state") == "stored":
+                e = self.memory.entry(oid)
+                if not e.resolved():
+                    e.locations = list(reply.get("locations") or [])
+                    if e.locations:
+                        e.hinted = True
+                        e.wake()
         await asyncio.gather(*[_one(o, w) for o, w in pairs])
         return acked
 
@@ -1584,7 +1602,17 @@ class CoreWorker:
                 e.has_value, e.value = True, value
                 return value
             if e.locations:
-                return await self._pull_and_load(ref, e.locations, e)
+                value = await self._pull_and_load(ref, e.locations, e)
+                if not (isinstance(value, ObjectLostError)
+                        and getattr(e, "hinted", False)
+                        and not owned_here):
+                    return value
+                # A piggybacked location hint (borrow-ack fast path)
+                # went stale — the owner may have moved/freed and
+                # re-created state we don't see.  Clear it and ask the
+                # owner authoritatively.
+                e.locations = []
+                e.hinted = False
             # fallthrough: resolved elsewhere
         return await self._get_from_owner(ref, deadline)
 
@@ -1634,25 +1662,116 @@ class CoreWorker:
             f"holds it (state={state!r}); borrowed objects have no "
             f"lineage, so reconstruction was not attempted")
 
+    async def _shm_name_of(self, addr: str) -> str | None:
+        """The shm arena name behind a node agent addr, cached forever
+        (an agent's arena never changes).  None = not native backend or
+        meta unreachable (cached only on a definitive answer)."""
+        if addr in self._peer_shm:
+            return self._peer_shm[addr]
+        try:
+            st, _ = await self.clients.get(addr).call(
+                "store_stats", {}, timeout=10.0)
+        except Exception:  # noqa: BLE001 - don't cache a transient miss
+            return None
+        shm = st.get("shm_name") if isinstance(st, dict) else None
+        self._peer_shm[addr] = shm
+        return shm
+
+    async def _pull_direct_shm(self, ref: ObjectRef, locations: list[str],
+                               arena0) -> bool:
+        """Same-host fast path: map the SOURCE node's /dev/shm arena and
+        stream the sealed bundle straight into the local arena — no
+        agent hop, no zmq, and (after the per-agent shm name is cached)
+        zero control round trips per object.  The source-side read pin
+        is the normal pid-attributed pin; a crashed puller is swept like
+        any dead reader.  Kill switch RAY_TPU_SHM_PULL=0.
+
+        Twin of StoreRunner._pull_same_host with a deliberately simpler
+        failure policy: no spill-to-make-room and no wait-for-sibling —
+        any create_raw refusal falls back to the agent path, which has
+        both (keep the copy/seal/abort discipline in sync with it)."""
+        if os.environ.get("RAY_TPU_SHM_PULL", "1") == "0":
+            return False
+        oid = ref.binary()
+        for addr in locations:
+            if addr in self._dead_worker_addrs:
+                continue
+            shm = await self._shm_name_of(addr)
+            if not shm or not os.path.exists(
+                    os.path.join("/dev/shm", shm.lstrip("/"))):
+                continue
+            peer = self._peer_arenas.get(shm)
+            if peer is None:
+                try:
+                    from ray_tpu._private.native_store import Arena
+
+                    peer = Arena(shm, create=False)
+                except Exception:  # noqa: BLE001 - racing teardown
+                    continue
+                self._peer_arenas[shm] = peer
+            raw = peer.get_raw_addr(oid)
+            if raw is None:
+                continue
+            src_addr, size, release = raw
+            try:
+                if not arena0.create_raw(oid, size):
+                    if arena0.contains(oid):
+                        return True   # a sibling pull landed it already
+                    # Full arena or another puller's in-flight creating
+                    # block: the agent path handles both (spill to make
+                    # room, wait-for-sibling in _reserve_raw).
+                    return False
+                def _copy() -> bool:
+                    return arena0.write_raw_from_addr(oid, 0, src_addr,
+                                                      size)
+                ok = (await self.loop.run_in_executor(None, _copy)
+                      if size > (8 << 20) else _copy())
+                if ok:
+                    ok = arena0.seal_raw(oid)
+                    if ok:
+                        return True
+                arena0.abort_raw(oid)
+                return False
+            except BaseException:
+                arena0.abort_raw(oid)
+                raise
+            finally:
+                release()
+        return False
+
     async def _pull_and_load(self, ref: ObjectRef, locations: list[str],
                              entry) -> Any:
         """Fetch frames from a node store holding the object."""
         arena0 = self.local_arena()
         if (arena0 is not None and locations
                 and self.agent_addr not in locations):
-            # Remote object + local arena: pull THROUGH the local node
-            # store (chunked, parallel, cached for other local readers —
-            # ray: gets always materialize into local plasma via the
-            # PullManager) then read it zero-copy.
+            # Remote object + local arena: same-host sources are copied
+            # straight out of THEIR mmap'd arena into ours (one
+            # streaming-kernel copy, zero control round trips once the
+            # source's shm name is cached — see _pull_direct_shm);
+            # otherwise pull THROUGH the local node store (chunked,
+            # parallel, cached for other local readers — ray: gets
+            # always materialize into local plasma via the PullManager).
+            # Either way the object lands locally and is read zero-copy.
+            pulled = False
             try:
-                reply, _ = await self.clients.get(self.agent_addr).call(
-                    "store_pull",
-                    {"object_id": ref.hex(), "from": list(locations)},
-                    timeout=300.0)
-                if reply.get("ok"):
-                    locations = [self.agent_addr] + list(locations)
-            except Exception:  # noqa: BLE001
-                pass
+                pulled = await self._pull_direct_shm(ref, locations,
+                                                     arena0)
+            except Exception:  # noqa: BLE001 - fast path is best-effort
+                pulled = False
+            if not pulled:
+                try:
+                    reply, _ = await self.clients.get(
+                        self.agent_addr).call(
+                        "store_pull",
+                        {"object_id": ref.hex(), "from": list(locations)},
+                        timeout=300.0)
+                    pulled = bool(reply.get("ok"))
+                except Exception:  # noqa: BLE001
+                    pulled = False
+            if pulled:
+                locations = [self.agent_addr] + list(locations)
+                self._announce_location(ref)
         if self.agent_addr in locations:
             arena = self.local_arena()
             if arena is not None:
@@ -1872,8 +1991,57 @@ class CoreWorker:
         except Exception:  # noqa: BLE001
             pass
 
+    def _announce_location(self, ref: ObjectRef) -> None:
+        """A cross-node pull just cached a REPLICA of `ref` in this
+        node's store.  The owner's location directory must learn about
+        it, or _free_object will only scrub the owner-side copy and the
+        replica leaks forever (pre-round-10: every cross-node get of a
+        since-freed object stranded its replica — the DCN collectives
+        hammer exactly this pattern, one replica per ring hop)."""
+        owner = ref.owner_addr
+        oid = ref.binary()
+        if not owner or owner == self.address:
+            with self._ref_lock:
+                rec = self.owned.get(oid)
+                if rec is not None and self.agent_addr not in rec.locations:
+                    rec.locations.append(self.agent_addr)
+            return
+
+        async def _notify():
+            try:
+                await self.clients.get(owner).notify(
+                    "add_location",
+                    {"object_id": oid.hex(), "addr": self.agent_addr})
+            except Exception:  # noqa: BLE001 - owner death handled by gets
+                pass
+        self.loop.create_task(_notify())
+
+    async def rpc_add_location(self, h: dict, _b: list) -> dict:
+        """Owner side of _announce_location.  If the object was already
+        freed while the replica was being created, scrub the replica now
+        — nobody else will."""
+        oid = bytes.fromhex(h["object_id"])
+        addr = h["addr"]
+        with self._ref_lock:
+            rec = self.owned.get(oid)
+            if rec is not None:
+                if addr not in rec.locations:
+                    rec.locations.append(addr)
+                return {}
+        await self._delete_remote(addr, oid)
+        return {}
+
     async def rpc_add_borrow(self, h: dict, _b: list) -> dict:
-        self._add_borrow(bytes.fromhex(h["object_id"]), self.address)
+        oid = bytes.fromhex(h["object_id"])
+        self._add_borrow(oid, self.address)
+        # Piggyback the location directory on the ack: the borrower is
+        # about to get() this ref, and answering here collapses its
+        # resolve_object round trip into the borrow registration it
+        # already pays (round 10: per-chunk resolve RTs against busy
+        # owners dominated ring-collective pull latency).
+        rec = self.owned.get(oid)
+        if rec is not None and rec.state == "stored" and rec.locations:
+            return {"state": "stored", "locations": list(rec.locations)}
         return {}
 
     async def rpc_remove_borrow(self, h: dict, _b: list) -> dict:

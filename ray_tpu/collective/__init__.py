@@ -11,17 +11,29 @@ TPU-first split (SURVEY §2.4 "Collective backend"):
 - *Across actor processes* (hosts over DCN) this module provides the
   gloo-analog control-plane collectives: host numpy/jax arrays moved
   through the object store with a named rendezvous actor per group.
+  Since round 10 the transport is bandwidth-optimal: ring
+  reduce-scatter/allgather for large tensors (chunked, pipelined,
+  2*N*(world-1)/world bytes per rank), a binomial tree for small ones,
+  async variants (`allreduce_async` → wait()-able CollectiveWork), and
+  an opt-in per-collective phase tracer
+  (`ray_tpu.profiling.collective_trace`).  Kill switch
+  `RAY_TPU_RING_COLLECTIVES=0` restores the legacy gather path.
 """
-from ray_tpu.collective.collective import (allgather, allreduce, barrier,
-                                           broadcast, create_collective_group,
+from ray_tpu.collective.collective import (CollectiveWork, allgather,
+                                           allgather_async, allreduce,
+                                           allreduce_async, barrier,
+                                           broadcast, broadcast_async,
+                                           create_collective_group,
                                            destroy_collective_group,
                                            get_rank, get_collective_group_size,
                                            init_collective_group, recv,
-                                           reducescatter, send)
+                                           reducescatter,
+                                           reducescatter_async, send)
 
 __all__ = [
     "init_collective_group", "create_collective_group",
     "destroy_collective_group", "allreduce", "allgather", "reducescatter",
     "broadcast", "barrier", "send", "recv", "get_rank",
-    "get_collective_group_size",
+    "get_collective_group_size", "allreduce_async", "allgather_async",
+    "reducescatter_async", "broadcast_async", "CollectiveWork",
 ]

@@ -1,0 +1,497 @@
+"""Bandwidth-optimal pipelined DCN collective schedules (ISSUE 5).
+
+Schedules over the object plane replacing the legacy "gather all
+world_size refs, reduce locally" backend (O(world*N) bytes pulled per
+rank):
+
+- **Ring** (large tensors): reduce-scatter + allgather (Thakur et al.
+  2005; Horovod).  Each rank moves 2*N*(world-1)/world bytes regardless
+  of world size; every chunk hops peer-to-peer as object-plane puts
+  (the PR 2 streaming write kernel and chunked pulls carry the bytes),
+  with the hop's payload split into sub-chunks whose pulls run
+  concurrently on a prefetch pool while the local reduce consumes them
+  in order — transport of sub-chunk k+1 overlaps the reduce of k.  The
+  rendezvous mailbox carries ONE message per hop (the sub-chunk ref
+  list), so per-hop control cost is 2 round trips, not O(sub-chunks)
+  (count RTs, not ms, per CLAUDE.md).
+- **Binomial tree** (small tensors): 2*ceil(log2 world) hops with the
+  payload inline in the mailbox message — round trips dominate under
+  the size threshold, so no put/pull indirection at all.
+
+Reduction-order note: the ring accumulates chunk c along the ring
+(rank c+1, c+2, ... c), the tree along the binomial recursion, and the
+legacy path over a stacked axis — all three are exact for min/max, any
+integer dtype, and float values without rounding (integers within the
+mantissa); float sums that round may differ in final ULPs between
+schedules, as with any collective library.
+
+This is library-layer code: only public surfaces (`ray_tpu` core API,
+`ray_tpu.profiling`, `ray_tpu.failpoints`) — never runtime internals
+(enforced by tests/test_layering.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import failpoints
+
+# Binary reduce ops (the legacy gather path reduces a stacked axis; the
+# ring/tree paths fold pairwise).
+BINARY_OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
+}
+
+
+# Env knob readers, shared with collective.py (which imports this
+# module; defining them there instead would make an import cycle).
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _bcast_by_ref(nbytes: int) -> bool:
+    """Broadcast payload transport: inline through the mailbox below the
+    ring threshold, object-plane refs above it — bulk bytes must never
+    ride the rendezvous actor."""
+    return nbytes >= _env_int("RAY_TPU_COLLECTIVE_RING_MIN_BYTES",
+                              256 * 1024)
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+# Tracer records are mutated from the op thread AND the prefetch-pool
+# threads (concurrent sub-chunk pulls); dict `+=` is not atomic across
+# bytecode boundaries, and recv_bytes is the schedule proof the bench
+# and tests assert on — guard every accumulation.
+_REC_LOCK = threading.Lock()
+
+
+def _acc(rec: dict | None, key: str, t0: float) -> None:
+    if rec is not None:
+        with _REC_LOCK:
+            rec[key] += (_now() - t0) * 1e6
+
+
+def _count(rec: dict | None, key: str, nbytes: int) -> None:
+    if rec is not None:
+        with _REC_LOCK:
+            rec[key] += int(nbytes)
+
+
+def _split_subchunks(chunk: np.ndarray, pipeline_chunks: int,
+                     pipeline_min_bytes: int) -> list[np.ndarray]:
+    """Sub-chunks of one ring hop's payload: enough pieces that pulls
+    pipeline, each big enough that per-object overhead stays amortized."""
+    if chunk.nbytes <= 0:
+        return [chunk]
+    p = max(1, min(pipeline_chunks,
+                   chunk.nbytes // max(1, pipeline_min_bytes)))
+    return np.array_split(chunk, p)
+
+
+def _deposit(g, key: tuple, payload_chunks: list[np.ndarray], *,
+             by_ref: bool, rec: dict | None, holds: list,
+             pending: list) -> None:
+    """Hand one hop's payload to the peer via the rendezvous mailbox —
+    by ref (one object-plane put per sub-chunk; the peer pulls the bytes
+    peer-to-peer) or inline (small-tensor path).  One mailbox message
+    per hop either way."""
+    if failpoints.ACTIVE:
+        failpoints.fire("collective.chunk_send")
+    t0 = _now()
+    if by_ref:
+        msg = [ray_tpu.put(c) for c in payload_chunks]
+        # The sender's handles keep the chunks alive until the op's
+        # completion ack proves the peer pulled them.
+        holds.extend(msg)
+    else:
+        msg = list(payload_chunks)
+    # Fire-and-forget: deposits pipeline behind each other; delivery is
+    # confirmed in one batch by _settle() at op end.
+    pending.append(g.rendezvous.put_p2p.remote(key, msg))
+    _acc(rec, "send_us", t0)
+    _count(rec, "sent_bytes",
+           sum(getattr(c, "nbytes", 0) for c in payload_chunks))
+
+
+def _submit_take(g, key: tuple):
+    """Start a mailbox take; the actor side bounds the wait and names
+    the missing peer on timeout (not a hang)."""
+    return g.rendezvous.take_p2p.remote(key, g.timeout_s)
+
+
+def _pull_one(g, ref, rec: dict | None) -> np.ndarray:
+    """Pull one sub-chunk through the object plane (prefetch-pool
+    thread: pulls run concurrently and overlap the in-order reduce)."""
+    t0 = _now()
+    val = ray_tpu.get(ref, timeout=g.timeout_s)
+    _acc(rec, "pull_us", t0)
+    _count(rec, "recv_bytes", getattr(val, "nbytes", 0))
+    return val
+
+
+def _as_parts(g, msg: list, rec: dict | None) -> list:
+    """Turn one hop's mailbox message into in-order payload parts: pull
+    futures for by-ref sub-chunks (the pulls run concurrently on the
+    prefetch pool, overlapping the in-order reduce), values for inline
+    payloads."""
+    if msg and isinstance(msg[0], ray_tpu.ObjectRef):
+        return [g.prefetcher.submit(_pull_one, g, r, rec) for r in msg]
+    _count(rec, "recv_bytes",
+           sum(getattr(v, "nbytes", 0) for v in msg))
+    return msg
+
+
+def _recv_hop(g, key: tuple, rec: dict | None) -> list:
+    """Take one hop's mailbox message (tree path: receive-only ranks)."""
+    t0 = _now()
+    msg = ray_tpu.get(_submit_take(g, key), timeout=g.timeout_s + 30.0)
+    _acc(rec, "wait_us", t0)
+    if rec is not None:
+        rec["hops"] += 1
+    return _as_parts(g, msg, rec)
+
+
+def _swap_msg(g, put_key: tuple, msg: list, take_key: tuple,
+              rec: dict | None) -> list:
+    """ONE `swap` round trip: deposit the outgoing hop message, return
+    the incoming one — the entire per-hop mailbox cost is a single RT."""
+    if failpoints.ACTIVE:
+        failpoints.fire("collective.chunk_send")
+    t0 = _now()
+    incoming = ray_tpu.get(
+        g.rendezvous.swap.remote(put_key, msg, take_key, g.timeout_s),
+        timeout=g.timeout_s + 30.0)
+    _acc(rec, "wait_us", t0)
+    if rec is not None:
+        rec["hops"] += 1
+    return incoming
+
+
+def _put_chunks(g, payload_chunks: list[np.ndarray], rec: dict | None,
+                holds: list) -> list:
+    """Put one hop's sub-chunks into the object plane; the handles stay
+    in `holds` until the op's completion ack proves the peers pulled."""
+    t0 = _now()
+    msg = [ray_tpu.put(c) for c in payload_chunks]
+    holds.extend(msg)
+    _acc(rec, "send_us", t0)
+    _count(rec, "sent_bytes", sum(c.nbytes for c in payload_chunks))
+    return msg
+
+
+def _swap_hop(g, put_key: tuple, payload_chunks: list[np.ndarray],
+              take_key: tuple, rec: dict | None, holds: list) -> list:
+    """One ring hop: put the outgoing sub-chunks, swap their refs for
+    the incoming hop's message, hand back in-order payload parts."""
+    msg = _put_chunks(g, payload_chunks, rec, holds)
+    return _as_parts(g, _swap_msg(g, put_key, msg, take_key, rec), rec)
+
+
+def _consume(part) -> np.ndarray:
+    return part.result() if hasattr(part, "result") else part
+
+
+def _settle(g, pending: list, holds: list, seq: int,
+            rec: dict | None, *, ack: bool) -> None:
+    """Op epilogue: confirm every mailbox deposit landed, then (ring
+    paths) run the neighbor completion ack — the downstream peer
+    deposits an ack only after it consumed everything we sent, so our
+    chunk refs can be dropped without racing its pulls.  The ack is one
+    swap: deposit ours to the upstream peer, await the downstream's."""
+    t0 = _now()
+    if pending:
+        ray_tpu.get(pending, timeout=g.timeout_s + 30.0)
+    if ack and g.world_size > 1:
+        me, w = g.rank, g.world_size
+        up, down = (me - 1) % w, (me + 1) % w
+        ray_tpu.get(g.rendezvous.swap.remote(
+            (seq, "ack", 0, me, up), [True],
+            (seq, "ack", 0, down, me), g.timeout_s),
+            timeout=g.timeout_s + 30.0)
+    _acc(rec, "wait_us", t0)
+    holds.clear()
+
+
+def _reduce_into(binop, incoming: np.ndarray, own: np.ndarray,
+                 rec: dict | None,
+                 out: np.ndarray | None = None) -> np.ndarray:
+    if failpoints.ACTIVE:
+        failpoints.fire("collective.reduce")
+    t0 = _now()
+    # out= writes straight into the caller's (pre-allocated) buffer —
+    # the ring paths hand hop/result slices here so no per-hop
+    # intermediate arrays get allocated, copied, then concatenated.
+    res = binop(incoming, own) if out is None \
+        else binop(incoming, own, out=out)
+    _acc(rec, "reduce_us", t0)
+    return res
+
+
+# --------------------------------------------------------------- ring
+def _ring_reduce_scatter(g, chunk_views: list[np.ndarray], op: str,
+                         seq: int, rec: dict | None, holds: list,
+                         out_final: np.ndarray,
+                         phase: str = "rs") -> np.ndarray:
+    """Ring reduce-scatter over world_size flat chunks.  W-1 hops; at
+    step s rank r forwards the partial for chunk (r-s-1) mod W to r+1
+    and folds its own contribution into chunk (r-s-2) mod W, so rank r
+    ends owning the fully reduced chunk r — written into `out_final`
+    (a caller slice) on the last hop.  Intermediate hops ping through
+    ONE scratch buffer: the hop's deposit has already copied the
+    partial into the arena before the buffer is overwritten.  Bytes per
+    rank: N*(world-1)/world."""
+    w, r = g.world_size, g.rank
+    binop = BINARY_OPS[op]
+    nxt, prv = (r + 1) % w, (r - 1) % w
+    scratch = np.empty(max(len(c) for c in chunk_views),
+                       dtype=out_final.dtype) if w > 2 else None
+    acc: np.ndarray | None = None
+    for s in range(w - 1):
+        send_idx = (r - s - 1) % w
+        recv_idx = (r - s - 2) % w
+        send_data = chunk_views[send_idx] if s == 0 else acc
+        own = chunk_views[recv_idx]
+        target = out_final if s == w - 2 else scratch[:len(own)]
+        incoming = _swap_hop(
+            g, (seq, phase, s, r, nxt),
+            _split_subchunks(send_data, g.pipeline_chunks,
+                             g.pipeline_min_bytes),
+            (seq, phase, s, prv, r), rec, holds)
+        own_subs = np.array_split(own, len(incoming))
+        tgt_subs = np.array_split(target, len(incoming))
+        for part, own_sub, tgt_sub in zip(incoming, own_subs, tgt_subs):
+            _reduce_into(binop, _consume(part), own_sub, rec,
+                         out=tgt_sub)
+        acc = target
+    if acc is None:          # world_size == 1
+        np.copyto(out_final, chunk_views[0])
+        acc = out_final
+    return acc
+
+
+def _ring_allgather_chunks(g, slices: list[np.ndarray], my_idx: int,
+                           seq: int, rec: dict | None, holds: list,
+                           phase: str = "ag") -> None:
+    """Ring allgather into pre-placed output slices: `slices[my_idx]`
+    already holds this rank's chunk; W-1 store-and-forward hops fill
+    the rest in place (at step s rank r forwards chunk (r-s) mod W and
+    receives chunk (r-s-1) mod W).  Hops re-put the forwarded bytes —
+    every pull then hits the NEIGHBOR's node and the borrow chain stays
+    one hop deep (forwarding the origin's refs instead was measured
+    slower: each forwarded borrow adds a cross-owner ack round trip on
+    the critical path).  Bytes per rank: sum of the other chunks."""
+    w, r = g.world_size, g.rank
+    nxt, prv = (r + 1) % w, (r - 1) % w
+    for s in range(w - 1):
+        send_idx = (r - s) % w
+        recv_idx = (r - s - 1) % w
+        parts = _swap_hop(
+            g, (seq, phase, s, r, nxt),
+            _split_subchunks(slices[send_idx], g.pipeline_chunks,
+                             g.pipeline_min_bytes),
+            (seq, phase, s, prv, r), rec, holds)
+        vals = [_consume(p) for p in parts]
+        got = sum(v.size for v in vals)
+        if got != slices[recv_idx].size:
+            raise ValueError(
+                f"ring allgather requires same-shape tensors on every "
+                f"rank (hop {s}: got {got} elements for chunk "
+                f"{recv_idx}, expected {slices[recv_idx].size}); use "
+                f"RAY_TPU_RING_COLLECTIVES=0 for heterogeneous shapes")
+        tgt_subs = np.array_split(slices[recv_idx], len(vals))
+        t0 = _now()
+        for val, tgt in zip(vals, tgt_subs):
+            # Copy out of the zero-copy read view into the output slice
+            # (releases the arena pin as soon as the ref drops).
+            np.copyto(tgt, val)
+        _acc(rec, "reduce_us", t0)
+
+
+def ring_allreduce(g, tensor: np.ndarray, op: str, seq: int,
+                   rec: dict | None) -> np.ndarray:
+    """Ring allreduce = reduce-scatter + allgather over the flattened
+    tensor: 2*N*(world-1)/world bytes per rank.  Both phases write
+    straight into one pre-allocated result buffer — the allgather
+    forwards result slices, so no intermediate copies."""
+    x = np.ascontiguousarray(tensor)
+    w = g.world_size
+    if w == 1:
+        return np.array(x, copy=True)
+    flat = x.reshape(-1)
+    chunk_views = np.array_split(flat, w)
+    result = np.empty_like(flat)
+    out_slices = np.array_split(result, w)
+    holds: list = []
+    # Ring hops confirm delivery inside each swap — no deferred
+    # deposits to settle (the tree paths are the ones that batch them).
+    _ring_reduce_scatter(g, chunk_views, op, seq, rec, holds,
+                         out_final=out_slices[g.rank])
+    _ring_allgather_chunks(g, out_slices, g.rank, seq, rec, holds)
+    _settle(g, [], holds, seq, rec, ack=True)
+    return result.reshape(x.shape)
+
+
+def ring_reducescatter(g, tensor: np.ndarray, op: str, seq: int,
+                       rec: dict | None) -> np.ndarray:
+    """Ring reduce-scatter with the legacy output contract: rank r gets
+    the reduction's r-th `np.array_split(..., axis=0)` slice.  Bytes
+    per rank: N*(world-1)/world."""
+    x = np.ascontiguousarray(tensor)
+    w = g.world_size
+    axis_chunks = np.array_split(x, w, axis=0)
+    if w == 1:
+        return np.array(axis_chunks[0], copy=True)
+    chunk_views = [c.reshape(-1) for c in axis_chunks]
+    out = np.empty(axis_chunks[g.rank].shape, dtype=x.dtype)
+    holds: list = []
+    _ring_reduce_scatter(g, chunk_views, op, seq, rec, holds,
+                         out_final=out.reshape(-1))
+    _settle(g, [], holds, seq, rec, ack=True)
+    return out
+
+
+def ring_allgather(g, tensor: np.ndarray, seq: int,
+                   rec: dict | None) -> list[np.ndarray]:
+    """Ring allgather of same-shape per-rank tensors (the group
+    contract, as in MPI_Allgather): W-1 store-and-forward hops,
+    N*(world-1) bytes per rank."""
+    x = np.ascontiguousarray(tensor)
+    w = g.world_size
+    if w == 1:
+        return [np.array(x, copy=True)]
+    outs = [np.empty_like(x) for _ in range(w)]
+    np.copyto(outs[g.rank], x)
+    holds: list = []
+    _ring_allgather_chunks(g, [o.reshape(-1) for o in outs], g.rank,
+                           seq, rec, holds)
+    _settle(g, [], holds, seq, rec, ack=True)
+    return outs
+
+
+# --------------------------------------------------------- binomial tree
+def tree_allreduce(g, tensor: np.ndarray, op: str, seq: int,
+                   rec: dict | None) -> np.ndarray:
+    """Binomial-tree allreduce for the latency regime: reduce to rank 0
+    (ceil(log2 W) hops), broadcast back down (same).  Payloads ride
+    inline in the mailbox message — no put/pull round trips."""
+    w, r = g.world_size, g.rank
+    acc = np.asarray(tensor)
+    if w == 1:
+        return np.array(acc, copy=True)
+    binop = BINARY_OPS[op]
+    pending: list = []
+    holds: list = []
+    # -- reduce up --
+    mask = 1
+    while mask < w:
+        if r & mask:
+            dst = r - mask
+            _deposit(g, (seq, "tr", mask, r, dst), [acc], by_ref=False,
+                     rec=rec, holds=holds, pending=pending)
+            break
+        src = r + mask
+        if src < w:
+            incoming = _consume(_recv_hop(
+                g, (seq, "tr", mask, src, r), rec)[0])
+            acc = _reduce_into(binop, acc, incoming, rec)
+        mask <<= 1
+    peel = (r & -r) if r else 0
+    # -- broadcast down (mirror) --
+    if r != 0:
+        parent = r - peel
+        acc = np.asarray(_consume(_recv_hop(
+            g, (seq, "tb", peel, parent, r), rec)[0]))
+    m = (peel >> 1) if r else 1
+    if r == 0:
+        while m < w:
+            m <<= 1
+        m >>= 1
+    while m >= 1:
+        child = r + m
+        if child < w:
+            _deposit(g, (seq, "tb", m, r, child), [acc], by_ref=False,
+                     rec=rec, holds=holds, pending=pending)
+        m >>= 1
+    _settle(g, pending, holds, seq, rec, ack=False)
+    return np.array(acc, copy=True)
+
+
+def tree_broadcast(g, tensor: np.ndarray | None, src: int, seq: int,
+                   rec: dict | None) -> np.ndarray:
+    """Binomial-tree broadcast from `src`, ceil(log2 W) hops.  Non-src
+    ranks don't know the size, so the TOPOLOGY can't be size-gated —
+    but the transport per edge is: each sender ships small payloads
+    inline in the mailbox message and large ones as object-plane
+    sub-chunk refs (axis-0 split, so concatenation restores the shape);
+    receivers just follow what arrives.  Bulk bytes never ride the
+    rendezvous actor."""
+    w, r = g.world_size, g.rank
+    if w == 1:
+        return np.array(np.asarray(tensor), copy=True)
+    vr = (r - src) % w
+    pending: list = []
+    holds: list = []
+    data = np.asarray(tensor) if vr == 0 else None
+    peel = (vr & -vr) if vr else 0
+    if vr != 0:
+        parent_vr = vr - peel
+        parts = _recv_hop(
+            g, (seq, "bc", peel, (parent_vr + src) % w, r), rec)
+        vals = [np.asarray(_consume(p)) for p in parts]
+        data = vals[0] if len(vals) == 1 else np.concatenate(vals)
+    m = (peel >> 1) if vr else 1
+    if vr == 0:
+        while m < w:
+            m <<= 1
+        m >>= 1
+    by_ref = bool(data.ndim) and _bcast_by_ref(data.nbytes)
+    payload = _split_subchunks(data, g.pipeline_chunks,
+                               g.pipeline_min_bytes) if by_ref \
+        else [data]
+    children = []
+    while m >= 1:
+        child_vr = vr + m
+        if child_vr < w:
+            child = (child_vr + src) % w
+            _deposit(g, (seq, "bc", m, r, child), payload,
+                     by_ref=by_ref, rec=rec, holds=holds,
+                     pending=pending)
+            children.append(child)
+        m >>= 1
+    if by_ref and vr != 0:
+        # Consumed ack to the parent: its chunk refs may drop.
+        _deposit(g, (seq, "bca", 0, r, (parent_vr + src) % w), [True],
+                 by_ref=False, rec=rec, holds=holds, pending=pending)
+    _settle(g, pending, holds if not (by_ref and children) else [],
+            seq, rec, ack=False)
+    if by_ref and children:
+        # Our holds drop only after every child consumed what we sent
+        # (same insurance as the ring paths' neighbor ack).
+        for child in children:
+            ray_tpu.get(_submit_take(g, (seq, "bca", 0, child, r)),
+                        timeout=g.timeout_s + 30.0)
+        holds.clear()
+    return np.array(data, copy=True)

@@ -14,7 +14,8 @@ from ray_tpu.train.config import (CheckpointConfig, DataConfig,  # noqa: F401
                                   RunConfig, ScalingConfig)
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
-                                   get_dataset_shard, report)
+                                   get_dataset_shard, host_allreduce,
+                                   host_allreduce_async, report)
 from ray_tpu.train.step import (TrainState, create_train_state,  # noqa: F401
                                 make_train_step, sharded_init,
                                 sharded_train_step)

@@ -53,6 +53,19 @@ class BackendExecutor:
                 self.backend.on_shutdown(self.worker_group)
             except Exception:  # noqa: BLE001
                 pass
+            if self.worker_group.num_workers >= 2:
+                # The host collective group's detached rendezvous would
+                # otherwise outlive the run (the round-10
+                # destroy_collective_group works from the driver even
+                # though the group's registries live in the workers).
+                try:
+                    from ray_tpu import collective as col
+
+                    col.destroy_collective_group(
+                        getattr(self, "_host_group",
+                                f"train_host:{self.trial_name}"))
+                except Exception:  # noqa: BLE001
+                    pass
             self.worker_group.shutdown()
             self.worker_group = None
 
@@ -85,6 +98,7 @@ class BackendExecutor:
         at step 900/1000 replays from step 0.
         """
         config = config or {}
+        self._host_group = f"train_host:{self.trial_name}"
         max_failures = self.failure.max_failures
         while True:
             resume = resume_checkpoint
@@ -120,6 +134,17 @@ class BackendExecutor:
             local_ranks.append(seen.get(nid, 0))
             seen[nid] = local_ranks[-1] + 1
         self.backend.on_training_start(wg)
+        # Host-side DCN collective group over the gang (ISSUE 5): the
+        # train loop syncs host state through session.host_allreduce
+        # (ring/tree schedules, async overlap) instead of bespoke RPCs.
+        host_group = None
+        if n >= 2:
+            from ray_tpu import collective as col
+
+            host_group = getattr(self, "_host_group",
+                                 f"train_host:{self.trial_name}")
+            col.create_collective_group(wg.workers, n, list(range(n)),
+                                        group_name=host_group)
         # Dataset shards: one streaming_split iterator per worker per
         # dataset (ray: DataParallelTrainer wiring train.get_dataset_shard
         # through the data StreamSplitDataIterator).
@@ -144,7 +169,8 @@ class BackendExecutor:
                 train_fn, config, world_rank=i, world_size=n,
                 local_rank=local_ranks[i], trial_name=self.trial_name,
                 checkpoint=resume_checkpoint,
-                dataset_shards=shards_per_worker[i])
+                dataset_shards=shards_per_worker[i],
+                host_group=host_group)
             for i, w in enumerate(wg.workers)
         ])
 

@@ -13,8 +13,66 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 import time
 from typing import Any
+
+# ---------------------------------------------------------- async writer
+# One background writer thread per process (ISSUE 5 satellite): the train
+# step loop hands a flattened pytree to `from_pytree_async` and keeps
+# computing while serialization+write run here; the write is forced
+# complete by Checkpoint.wait(), by CheckpointManager.register(), by
+# pickling the handle (it never crosses a process boundary half-written),
+# and by flush_pending_writes() at fit()/train-fn exit.
+_writer_lock = threading.Lock()
+_writer_pool = None
+# STRONG refs to in-flight write futures: a handle dropped without ever
+# reaching a flush point (an abandoned conditional save) must still be
+# waited out — and a FAILED write must still surface — at fit()/train-fn
+# exit.  Successful futures self-remove on completion; failed ones stay
+# until a flush observes (and raises) them.
+_inflight_futs: set = set()
+
+
+def _writer():
+    global _writer_pool
+    with _writer_lock:
+        if _writer_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _writer_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="raytpu-ckpt-writer")
+        return _writer_pool
+
+
+def _track(fut) -> None:
+    _inflight_futs.add(fut)
+
+    def _done(f):
+        if not f.cancelled() and f.exception() is None:
+            _inflight_futs.discard(f)
+    fut.add_done_callback(_done)
+
+
+def flush_pending_writes(timeout: float | None = None) -> int:
+    """Block until every in-flight async checkpoint write in this
+    process has completed; re-raises the first failure; returns how
+    many were pending.  Called at fit() exit and when a train fn
+    finishes, so no background save can outlive (or silently fail
+    after) the run that started it."""
+    pending = list(_inflight_futs)
+    first_err = None
+    for fut in pending:
+        try:
+            fut.result(timeout)
+        except Exception as e:  # noqa: BLE001 - re-raised below
+            first_err = first_err or e
+        # Observed (success or failure): drop it either way so a failed
+        # write doesn't poison every later run in this process.
+        _inflight_futs.discard(fut)
+    if first_err is not None:
+        raise first_err
+    return len(pending)
 
 
 class Checkpoint:
@@ -22,6 +80,24 @@ class Checkpoint:
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
+        # Future of an in-flight background write (from_pytree_async);
+        # None once complete.  Never crosses process boundaries — see
+        # __reduce__.
+        self._pending = None
+
+    def wait(self, timeout: float | None = None) -> "Checkpoint":
+        """Block until this checkpoint's background write (if any) has
+        finished; re-raises a failed write's exception.  No-op for
+        synchronously written checkpoints.  `_pending` clears only on a
+        COMPLETED future — a timed-out wait must leave the handle
+        flagged, or the next register()/pickle would silently treat a
+        half-written directory as done (and a terminally failed write
+        keeps re-raising on every later flush point)."""
+        fut = self._pending
+        if fut is not None:
+            fut.result(timeout)
+            self._pending = None
+        return self
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -35,6 +111,7 @@ class Checkpoint:
         return cls(d)
 
     def to_dict(self) -> dict:
+        self.wait()
         with open(os.path.join(self.path, "data.pkl"), "rb") as f:
             return pickle.load(f)
 
@@ -69,10 +146,41 @@ class Checkpoint:
             pickle.dump(treedef, f)
         return cls(d)
 
+    @classmethod
+    def from_pytree_async(cls, tree: Any, path: str | None = None,
+                          use_orbax: bool = True) -> "Checkpoint":
+        """`from_pytree` with serialization+write offloaded to the
+        process's background writer thread, so checkpointing overlaps
+        the next train steps instead of blocking the loop (ISSUE 5
+        satellite).  Returns the Checkpoint handle immediately; the
+        write is forced complete by wait(), by the next
+        CheckpointManager.register(), by pickling the handle, and by
+        flush_pending_writes() at fit() exit.
+
+        The tree is flattened NOW (cheap, and it fails fast on
+        non-pytrees); the leaves must not be mutated in place before
+        the write lands — jax arrays are immutable, so in a jax train
+        loop the contract is automatic."""
+        import jax
+
+        d = path or tempfile.mkdtemp(prefix="raytpu-ckpt-")
+        os.makedirs(d, exist_ok=True)
+        leaves, treedef = jax.tree.flatten(tree)
+
+        def _write() -> None:
+            cls.from_pytree(jax.tree.unflatten(treedef, leaves), path=d,
+                            use_orbax=use_orbax)
+
+        ckpt = cls(d)
+        ckpt._pending = _writer().submit(_write)
+        _track(ckpt._pending)
+        return ckpt
+
     def to_pytree(self, target: Any = None) -> Any:
         """Restore; `target` (a pytree of like-shaped arrays or
         ShapeDtypeStructs with shardings) directs orbax restoration into
         the right layout."""
+        self.wait()
         state_dir = os.path.join(self.path, "state")
         if os.path.isdir(state_dir):
             import orbax.checkpoint as ocp
@@ -113,6 +221,12 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
     def __reduce__(self):
+        # A handle must never cross a process boundary (train.report →
+        # coordinator, actor replies) with its write still in flight:
+        # the receiver reconstructs a plain path handle and would read a
+        # half-written directory.  Pickling IS the synchronization
+        # point.
+        self.wait()
         return (Checkpoint, (self.path,))
 
 
@@ -137,6 +251,10 @@ class CheckpointManager:
         self._index = 0
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        # Async-written checkpoints flush here: register() is the
+        # explicit wait() point — the copy below must see a complete
+        # directory.
+        checkpoint.wait()
         dest = os.path.join(self.storage_path,
                             f"checkpoint_{self._index:06d}")
         if os.path.abspath(checkpoint.path) != dest:

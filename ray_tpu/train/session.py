@@ -21,7 +21,8 @@ class _Session:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  node_id: str, trial_name: str,
                  checkpoint: Checkpoint | None, config: dict,
-                 dataset_shards: dict | None = None):
+                 dataset_shards: dict | None = None,
+                 host_group: str | None = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -30,6 +31,10 @@ class _Session:
         self.loaded_checkpoint = checkpoint
         self.config = config
         self.dataset_shards = dataset_shards or {}
+        # Name of the gang-wide host-DCN collective group the
+        # BackendExecutor formed over the workers (None for single-rank
+        # runs — host_allreduce then degenerates to identity).
+        self.host_group = host_group
         self.out: queue.Queue = queue.Queue(maxsize=8)
         self.stop_event = threading.Event()
 
@@ -85,6 +90,45 @@ def get_dataset_shard(name: str = "train"):
     """This worker's split of the trainer's dataset (ray:
     train.get_dataset_shard — a DataIterator fed by streaming_split)."""
     return get_session().dataset_shards.get(name)
+
+
+def host_allreduce(value, op: str = "sum"):
+    """Allreduce host-side state (numpy/jax array) across the trainer's
+    worker gang over the DCN collective plane (ISSUE 5: ring for large
+    tensors, tree for small; gradients stay on ICI — this carries
+    host-side state like metric sums and data-loader bookkeeping)."""
+    return host_allreduce_async(value, op).wait()
+
+
+def host_allreduce_async(value, op: str = "sum"):
+    """Async host allreduce: returns a wait()-able CollectiveWork so
+    the sync overlaps the next step's input pipeline:
+
+        work = train.host_allreduce_async(step_metrics)
+        batch = next(loader)          # overlaps the DCN exchange
+        metrics = work.wait()
+    """
+    import numpy as np
+
+    from ray_tpu import collective as col
+
+    s = get_session()
+    if s.host_group is None or s.world_size <= 1:
+        class _Done:
+            def __init__(self, v):
+                # Copy, matching the collective contract: every real
+                # path returns a fresh array, so single-rank callers
+                # must not get an alias of their own (mutable) input.
+                self._v = np.array(v, copy=True)
+
+            def wait(self, timeout=None):
+                return self._v
+            result = wait
+
+            def done(self):
+                return True
+        return _Done(value)
+    return col.allreduce_async(value, group_name=s.host_group, op=op)
 
 
 class TrainContext:

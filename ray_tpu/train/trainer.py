@@ -109,6 +109,10 @@ class BaseTrainer:
             return None
 
         executor.start()
+        # Bound before the try: the finally block below reads it, and a
+        # non-TrainingFailedError escaping executor.run would otherwise
+        # leave it unbound there.
+        error = None
         try:
             self._pre_run(executor)
             executor.run(self._train_fn(), self.train_loop_config,
@@ -116,10 +120,22 @@ class BaseTrainer:
                          resume_checkpoint=self.resume_from_checkpoint,
                          latest_checkpoint=lambda:
                          manager.latest_checkpoint)
-            error = None
         except TrainingFailedError as e:
             error = e
         finally:
+            # Driver-side async checkpoint writes (from_pytree_async in
+            # callbacks, tests) must not outlive the run.  A failed
+            # write surfaces on the Result, never as an exception out of
+            # the finally block — that would mask the training error AND
+            # skip executor.shutdown() (leaking the worker group and the
+            # host collective's rendezvous).
+            try:
+                from ray_tpu.train import checkpoint as ckpt_mod
+
+                ckpt_mod.flush_pending_writes()
+            except Exception as e:  # noqa: BLE001
+                error = error or TrainingFailedError(
+                    f"async checkpoint write failed: {e!r}")
             executor.shutdown()
         return Result(metrics=last_metrics,
                       checkpoint=manager.latest_checkpoint,

@@ -46,6 +46,20 @@ class TrainWorker:
         hooks, debugging probes)."""
         return fn(*args, **kwargs)
 
+    def init_collective_group(self, world_size: int, rank: int,
+                              backend: str = "object_store",
+                              group_name: str = "train_host") -> int:
+        """Join the trainer's host-side DCN collective group (ISSUE 5):
+        the BackendExecutor forms one group across the worker gang so
+        the train loop can sync host-side state (data-loader offsets,
+        eval metrics, optimizer-shard exchanges) over the ring/tree
+        schedules — `session.host_allreduce_async` overlaps that sync
+        with the next step's input pipeline."""
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
     def setup_env(self, env: dict[str, str]) -> bool:
         import os
 
@@ -56,7 +70,8 @@ class TrainWorker:
     def start_train_fn(self, fn: Callable, config: dict, *,
                        world_rank: int, world_size: int, local_rank: int,
                        trial_name: str, checkpoint=None,
-                       dataset_shards: dict | None = None) -> bool:
+                       dataset_shards: dict | None = None,
+                       host_group: str | None = None) -> bool:
         self._finished = False
         self._error = None
         self._result = None
@@ -65,7 +80,7 @@ class TrainWorker:
             local_rank=local_rank,
             node_id=ray_tpu.get_runtime_context().get_node_id(),
             trial_name=trial_name, checkpoint=checkpoint, config=config,
-            dataset_shards=dataset_shards)
+            dataset_shards=dataset_shards, host_group=host_group)
 
         def run():
             try:
@@ -79,6 +94,18 @@ class TrainWorker:
             except BaseException:  # noqa: BLE001
                 self._error = traceback.format_exc()
             finally:
+                # Async checkpoint writes must land before the loop is
+                # declared done: an unflushed background save would race
+                # the coordinator's final checkpoint collection — and a
+                # FAILED write must surface as this rank's error, not
+                # vanish (the flush re-raises the first failure).
+                try:
+                    from ray_tpu.train import checkpoint as ckpt_mod
+
+                    ckpt_mod.flush_pending_writes()
+                except Exception:  # noqa: BLE001
+                    if self._error is None:
+                        self._error = traceback.format_exc()
                 self._finished = True
                 self._session.out.put({"type": "done"})
 

@@ -1,0 +1,151 @@
+"""Deterministic chaos for the DCN collective plane (ISSUE 5 satellite).
+
+New failpoint sites `collective.chunk_send` and `collective.reduce` are
+compiled into the ring/tree schedules (ray_tpu/collective/ring.py via
+the public ray_tpu.failpoints facade).  These tests arm them in ONE
+rank, run a ring allreduce across the group, and assert the failure
+contract: the armed rank dies (crash) or raises (error) deterministically,
+every SURVIVING rank surfaces a clean diagnostic error (the rendezvous
+deadline names the missing deposit — never a hang), and the cluster
+converges to zero dead-process arena pins afterwards.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import failpoints
+
+from test_chaos_adversarial import _arena_pins_settle
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture
+def fp_ray():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class RingRank:
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name,
+                                  timeout_s=10.0)
+        self.rank = rank
+        return rank
+
+    def arm(self, site, action):
+        from ray_tpu import failpoints as fp
+
+        fp.arm(site, action)
+        return fp.spec()
+
+    def counters(self):
+        from ray_tpu import failpoints as fp
+
+        return fp.counters()
+
+    def allreduce(self, group):
+        import os
+
+        os.environ["RAY_TPU_RING_COLLECTIVES"] = "1"
+        os.environ["RAY_TPU_COLLECTIVE_RING_MIN_BYTES"] = "16"
+        from ray_tpu import collective as col
+
+        x = np.full(1 << 19, float(self.rank + 1), np.float32)  # 2 MiB
+        return float(col.allreduce(x, group_name=group)[0])
+
+
+def _make_group(n, name):
+    from ray_tpu import collective as col
+
+    cls = ray_tpu.remote(RingRank)
+    ws = [cls.options(num_cpus=0.5, max_restarts=0).remote()
+          for _ in range(n)]
+    col.create_collective_group(ws, n, list(range(n)), group_name=name)
+    return ws
+
+
+def test_chaos_rank_crash_mid_ring(fp_ray):
+    """collective.chunk_send=nth:2+crash: rank 1 SIGKILLs itself on its
+    second ring hop.  Rank 1's call dies with the actor; ranks 0 and 2
+    surface the rendezvous deadline diagnostic (the missing deposit is
+    named) instead of hanging, and no arena pins leak."""
+    ws = _make_group(3, "cc")
+    assert "collective.chunk_send" in ray_tpu.get(
+        ws[1].arm.remote("collective.chunk_send", "nth:2+crash"))
+    # Submit ALL ranks first so the ring actually runs concurrently —
+    # the contract under test is a peer dying mid-collective while the
+    # others are live inside it, not three lone ranks timing out.
+    refs = [w.allreduce.remote("cc") for w in ws]
+    results = []
+    for ref in refs:
+        try:
+            results.append(("ok", ray_tpu.get(ref, timeout=120)))
+        except Exception as e:  # noqa: BLE001
+            results.append(("err", repr(e)))
+    kinds = [k for k, _ in results]
+    assert kinds.count("err") == 3, results
+    # Rank 1 died mid-call: actor-death error.  Survivors: the deadline
+    # diagnostic (their swap's take never got rank 1's deposit) or, for
+    # a pull already in flight, a clean object/connection error.
+    assert any(s in results[1][1]
+               for s in ("ActorDied", "WorkerCrashed", "ConnectionLost",
+                         "connection lost", "unavailable", "died")), \
+        results[1]
+    for r in (0, 2):
+        msg = results[r][1]
+        assert ("timed out" in msg or "never deposited" in msg
+                or "ObjectLost" in msg or "OwnerDied" in msg
+                or "ConnectionLost" in msg), (r, msg)
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+    from ray_tpu import collective as col
+
+    col.destroy_collective_group("cc")
+    for w in ws:
+        try:
+            ray_tpu.kill(w)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_chaos_reduce_error_surfaces_and_counts(fp_ray):
+    """collective.reduce=nth:1+error: the armed rank's allreduce raises
+    FailpointError out of its local reduce; the fired counter proves the
+    injection; peers get the deadline diagnostic; zero pins leak."""
+    ws = _make_group(3, "ce")
+    ray_tpu.get(ws[2].arm.remote("collective.reduce", "nth:1+error"))
+    refs = [w.allreduce.remote("ce") for w in ws]
+    results = []
+    for ref in refs:
+        try:
+            results.append(("ok", ray_tpu.get(ref, timeout=120)))
+        except Exception as e:  # noqa: BLE001
+            results.append(("err", repr(e)))
+    assert results[2][0] == "err" and "FailpointError" in results[2][1], \
+        results[2]
+    counters = ray_tpu.get(ws[2].counters.remote())
+    assert counters["collective.reduce"]["fired"] == 1, counters
+    for r in (0, 1):
+        assert results[r][0] == "err", results[r]
+        assert ("timed out" in results[r][1]
+                or "never deposited" in results[r][1]), results[r]
+    stats = _arena_pins_settle()
+    assert not stats.get("swept_dead_pins", 0), stats
+    from ray_tpu import collective as col
+
+    col.destroy_collective_group("ce")
+    for w in ws:
+        ray_tpu.kill(w)

@@ -596,6 +596,13 @@ def test_source_crash_mid_chunked_pull_lineage_recovers():
     with zero dead-process pins afterwards."""
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+    # This test exercises the CHUNK protocol's crash window.  Since
+    # round 10 same-host pulls (which is all an in-process Cluster has)
+    # take the direct-shm fast path and never cross a chunk boundary —
+    # kill it for every process this test spawns (and for this driver,
+    # which does the pulling) so the window under test is the one that
+    # runs.
+    os.environ["RAY_TPU_SHM_PULL"] = "0"
     cluster = Cluster('{"transfer_chunk_bytes": 1048576}')
     cluster.start_head()
     n1 = cluster.add_node(resources={"CPU": 2, "remote": 1, "pin1": 1})
@@ -668,6 +675,7 @@ def test_source_crash_mid_chunked_pull_lineage_recovers():
         stats = _arena_pins_settle()
         assert not stats.get("swept_dead_pins", 0), stats
     finally:
+        os.environ.pop("RAY_TPU_SHM_PULL", None)
         failpoints.reset()
         ray_tpu.shutdown()
         cluster.shutdown()

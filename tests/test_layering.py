@@ -1,0 +1,100 @@
+"""Library-layering invariant as a checked test (ISSUE 5 satellite).
+
+CLAUDE.md: "Every library feature (data/train/tune/serve/rl) builds ONLY
+on core primitives (tasks/actors/objects/PGs/KV) — never on runtime
+internals."  This walks the import statements of every module in the
+library layers (plus `collective`, which round 10 rebuilt as pure
+library code) and fails on any `ray_tpu._private` import beyond the
+sanctioned facades.  Static AST scan — no imports executed, so a
+violation can't hide behind lazy/function-local imports either (those
+are scanned too).
+"""
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+LIBRARY_LAYERS = ("data", "train", "tune", "serve", "rl", "collective")
+
+# The only runtime-internal modules library code may import, and why:
+#   jax_compat — environment shim (version-gates missing jax APIs); it
+#     touches jax, not the runtime, and must run before any jax use.
+# Everything else must come through public surfaces: the ray_tpu core
+# API, ray_tpu.profiling, ray_tpu.failpoints, ray_tpu.exceptions, ...
+SANCTIONED = {
+    "ray_tpu._private.jax_compat",
+}
+
+
+def _imports_of(path: str):
+    """Every (module, lineno) imported anywhere in the file, including
+    inside functions (lazy imports are still layering violations)."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield alias.name, node.lineno
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mod = node.module or ""
+            yield mod, node.lineno
+            # `from ray_tpu import _private` smuggles the package in
+            # under a from-import; flag the combined path too.
+            for alias in node.names:
+                yield f"{mod}.{alias.name}", node.lineno
+
+
+def _violations():
+    out = []
+    for layer in LIBRARY_LAYERS:
+        root = os.path.join(PKG, layer)
+        assert os.path.isdir(root), root
+        for dirpath, _dirs, files in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, REPO)
+                for mod, lineno in _imports_of(path):
+                    if not (mod == "ray_tpu._private"
+                            or mod.startswith("ray_tpu._private.")):
+                        continue
+                    if mod in SANCTIONED:
+                        continue
+                    # `from ray_tpu._private.jax_compat import install`
+                    # yields "...jax_compat.install" — still sanctioned.
+                    if any(mod.startswith(s + ".") for s in SANCTIONED):
+                        continue
+                    out.append(f"{rel}:{lineno}: imports {mod}")
+    return out
+
+
+def test_library_layers_never_import_runtime_internals():
+    violations = _violations()
+    assert not violations, (
+        "library-layering invariant violated (CLAUDE.md): library code "
+        "must build on core primitives and public facades only —\n  "
+        + "\n  ".join(violations))
+
+
+def test_sanctioned_facades_exist():
+    """A stale sanction (module renamed away) must fail loudly, not
+    silently allow-list nothing."""
+    for mod in SANCTIONED:
+        rel = mod.replace(".", os.sep) + ".py"
+        assert os.path.exists(os.path.join(REPO, rel)), mod
+
+
+@pytest.mark.parametrize("mod", ["ray_tpu.collective",
+                                 "ray_tpu.collective.ring"])
+def test_collective_is_importable_standalone(mod):
+    """The rebuilt collective layer imports cleanly (its only runtime
+    coupling is the lazily-bound public facade surface)."""
+    import importlib
+
+    assert importlib.import_module(mod) is not None
