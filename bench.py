@@ -1226,6 +1226,210 @@ def bench_serve_prefix_cache() -> dict:
     }
 
 
+def bench_serve_cluster_route() -> dict:
+    """Cluster-level serving (round 11): TWO same-run A/Bs through the
+    full serve stack.
+
+    (1) Cache-aware routing vs cache-blind (RAY_TPU_CACHE_ROUTER, a
+    driver-side switch — the handle router lives in this process): a
+    zipf shared-prefix workload over 2 replicas whose prefix working
+    set EXCEEDS one replica's page pool (8 groups x 14 pages vs 64
+    pages/engine — the millions-of-users regime: no single cache holds
+    every system prompt).  Blind pow-2 scatters every group across
+    both replicas, so each cache thrashes trying to hold all 8 and
+    popular prefixes get recomputed repeatedly; the prefix-locality
+    score pins each group to the replica that already holds it, so the
+    CLUSTER's aggregate cache capacity actually scales with the
+    replica count.  Rows: cluster tok/s + p99 TTFT per arm, per-arm
+    prefix-hit rate.
+
+    (2) Disaggregated prefill/decode vs unified (per-request "disagg"
+    switch — RAY_TPU_PD_DISAGG is replica-side env): 1 prefill + 1
+    decode replica; the kv_migrate rows (bytes, ms, GiB/s) time the KV
+    pages' trip through the object plane (put at the prefill replica +
+    pull at the decode replica — same-host, so the pull rides the
+    arena-view/direct-shm path)."""
+    import numpy as np
+
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+    import ray_tpu
+    from ray_tpu import serve
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 8})
+    prev_router_env = os.environ.get("RAY_TPU_CACHE_ROUTER")
+    out: dict = {}
+    try:
+        serve.start()
+        ekw = dict(max_batch=4, max_len=1024, page_size=64,
+                   steps_per_sync=4, seed=0)
+        vocab = 256                      # debug model vocab
+        # 8 groups x ceil(912/64)=15 pages = 120 pages of working set
+        # per replica under blind routing vs a 64-page pool; aware
+        # routing partitions ~4 groups (60 pages) per replica.  The
+        # 896-token shared prefix makes prefill the honest majority
+        # term at debug scale (the serve_prefix_cache lesson).
+        shared_len, unique_len, new_tokens = 896, 16, 2
+        groups, n_req = 8, 20
+
+        # ---- (1) cache-aware vs cache-blind routing -----------------
+        LLM = serve.deployment(serve.LLMServer).options(
+            name="llm", num_replicas=2, max_ongoing_requests=8)
+        h = serve.run(LLM.bind("debug", **ekw), name="route_bench",
+                      route_prefix="/rb")
+        rng = np.random.default_rng(0)
+        # Compile warm on BOTH replicas: a concurrent burst (spreads
+        # over the pool) at the real bucket, then repeats for the
+        # suffix-prefill program.
+        warm = [rng.integers(1, vocab,
+                             shared_len + unique_len).tolist()
+                for _ in range(8)]
+        for batch in (warm, warm):
+            futs = [h.remote({"prompt": p, "max_new_tokens": 2})
+                    for p in batch]
+            for f in futs:
+                f.result(timeout_s=600)
+
+        zw = np.array([1.0 / (g + 1) ** 1.1 for g in range(groups)])
+        zw /= zw.sum()
+
+        def run_arm(aware: bool, seed: int) -> dict:
+            os.environ["RAY_TPU_CACHE_ROUTER"] = "1" if aware else "0"
+            arng = np.random.default_rng(seed)
+            prefixes = [arng.integers(1, vocab, shared_len).tolist()
+                        for _ in range(groups)]
+            gids = arng.choice(groups, size=n_req, p=zw)
+            prompts = [prefixes[g]
+                       + arng.integers(1, vocab, unique_len).tolist()
+                       for g in gids]
+            # Seeding pass: each prefix lands (and caches) somewhere.
+            for p in prefixes:
+                h.remote({"prompt": p + [5, 6, 7],
+                          "max_new_tokens": 2}).result(timeout_s=600)
+            time.sleep(1.6)      # one summary-poll TTL: router learns
+            base = serve.replica_metrics("route_bench",
+                                         deployment="llm")
+            t0 = time.perf_counter()
+            futs = [h.remote({"prompt": p,
+                              "max_new_tokens": new_tokens})
+                    for p in prompts]
+            results = [f.result(timeout_s=600) for f in futs]
+            wall = time.perf_counter() - t0
+            cur = serve.replica_metrics("route_bench",
+                                        deployment="llm")
+
+            def hit_tokens(rm):
+                return sum(
+                    m.get("user_stats", {}).get("prefix_hit_tokens", 0)
+                    for m in rm["route_bench"]["llm"].values())
+
+            ttfts = sorted(r["ttft_s"] for r in results)
+            toks = sum(len(p) + new_tokens for p in prompts)
+            hits = hit_tokens(cur) - hit_tokens(base)
+            prompt_toks = sum(len(p) for p in prompts)
+            return {
+                "tokens_per_s": round(toks / wall, 1),
+                "wall_s": round(wall, 3),
+                "p50_ttft_ms": round(
+                    ttfts[len(ttfts) // 2] * 1000, 1),
+                "p99_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1,
+                              int(0.99 * len(ttfts)))] * 1000, 1),
+                "hit_rate": round(hits / prompt_toks, 3),
+            }
+
+        blind = run_arm(False, seed=101)
+        aware = run_arm(True, seed=202)
+        out["route"] = {
+            "replicas": 2, "requests": n_req, "groups": groups,
+            "shared_prefix_tokens": shared_len,
+            "blind": blind, "aware": aware,
+            "speedup": round(aware["tokens_per_s"]
+                             / max(blind["tokens_per_s"], 1e-9), 2),
+        }
+        serve.delete("route_bench")
+
+        # ---- (2) prefill/decode disaggregation + KV migration -------
+        Decode = serve.deployment(serve.LLMServer).options(
+            name="decode", num_replicas=1, max_ongoing_requests=8)
+        decode_app = Decode.bind("debug", role="decode", **ekw)
+        Prefill = serve.deployment(serve.LLMServer).options(
+            name="prefill", num_replicas=1, max_ongoing_requests=8)
+        hp = serve.run(
+            Prefill.bind("debug", role="prefill",
+                         decode_deployment=decode_app, **ekw),
+            name="pd_bench", route_prefix="/pdb")
+        pd_prompts = [rng.integers(1, vocab, shared_len).tolist()
+                      for _ in range(6)]
+        # Warm both pools' programs (incl. the export gather and import
+        # scatter) with one untimed migrated request per width.
+        hp.remote({"prompt": pd_prompts[0],
+                   "max_new_tokens": 8}).result(timeout_s=600)
+
+        def pd_stats():
+            rm = serve.replica_metrics("pd_bench")
+            pre = next(iter(rm["pd_bench"]["prefill"].values()))[
+                "user_stats"]
+            dec = next(iter(rm["pd_bench"]["decode"].values()))[
+                "user_stats"]
+            return pre, dec
+
+        pre0, dec0 = pd_stats()
+
+        def run_pd(disagg: bool) -> float:
+            t0 = time.perf_counter()
+            futs = [hp.remote({"prompt": p, "max_new_tokens": 8,
+                               "disagg": disagg})
+                    for p in pd_prompts]
+            for f in futs:
+                f.result(timeout_s=600)
+            return time.perf_counter() - t0
+
+        wall_on = run_pd(True)
+        pre1, dec1 = pd_stats()
+        wall_off = run_pd(False)      # same-run legacy arm (unified)
+        pre2, _ = pd_stats()
+        toks = sum(len(p) + 8 for p in pd_prompts)
+        mig_bytes = (pre1["pd"]["kv_migrate_bytes"]
+                     - pre0["pd"]["kv_migrate_bytes"])
+        mig_ms = (pre1["pd"]["kv_migrate_put_ms"]
+                  - pre0["pd"]["kv_migrate_put_ms"]
+                  + dec1["pd"]["kv_pull_ms"]
+                  - dec0["pd"]["kv_pull_ms"])
+        out["pd"] = {
+            "migrations": (pre1["pd"]["migrations"]
+                           - pre0["pd"]["migrations"]),
+            "kv_migrate_bytes": mig_bytes,
+            "kv_migrate_ms": round(mig_ms, 3),
+            "kv_migrate_gib_per_s": round(
+                mig_bytes / max(mig_ms, 1e-6) * 1000 / 2**30, 3),
+            "disagg_tokens_per_s": round(toks / wall_on, 1),
+            "unified_tokens_per_s": round(toks / wall_off, 1),
+            # The per-request switch left the migration counter flat —
+            # the legacy arm really ran unified (kill-switch proof).
+            "off_arm_migrations": (pre2["pd"]["migrations"]
+                                   - pre1["pd"]["migrations"]),
+        }
+        serve.delete("pd_bench")
+        return out
+    finally:
+        if prev_router_env is None:
+            os.environ.pop("RAY_TPU_CACHE_ROUTER", None)
+        else:
+            os.environ["RAY_TPU_CACHE_ROUTER"] = prev_router_env
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _with_timeout(fn, seconds: int):
     """Alarm-guarded call: the chip is single-holder on this box and a
     stuck lease must not zero out the rest of the bench.  On alarm the
@@ -1374,6 +1578,29 @@ def main() -> None:
             row["cache_off"]["tokens_per_s"]
     except Exception as e:  # noqa: BLE001
         extra["serve_prefix_cache"] = {"error": repr(e)}
+    _flush_partial(extra)
+    try:
+        # Cluster routing A/B + PD migration: serve boot (controller +
+        # proxy + 2-4 LLM replicas, each paying jax import + debug
+        # compiles on this 1-core box) dominates; the timed windows are
+        # seconds.
+        row = _with_timeout(bench_serve_cluster_route, 540)
+        extra["serve_cluster_route"] = row
+        # Flat rows so _vs_previous_round's suffix guards cover the
+        # A/Bs (the nested dict is for humans).
+        extra["serve_route_aware_tokens_per_s"] = \
+            row["route"]["aware"]["tokens_per_s"]
+        extra["serve_route_blind_tokens_per_s"] = \
+            row["route"]["blind"]["tokens_per_s"]
+        extra["serve_route_aware_p99_ttft_ms"] = \
+            row["route"]["aware"]["p99_ttft_ms"]
+        extra["serve_route_blind_p99_ttft_ms"] = \
+            row["route"]["blind"]["p99_ttft_ms"]
+        extra["kv_migrate_ms"] = row["pd"]["kv_migrate_ms"]
+        extra["kv_migrate_gib_per_s"] = \
+            row["pd"]["kv_migrate_gib_per_s"]
+    except Exception as e:  # noqa: BLE001
+        extra["serve_cluster_route"] = {"error": repr(e)}
     _flush_partial(extra)
     regressions = _vs_previous_round(extra)
     if regressions:

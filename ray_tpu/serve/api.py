@@ -186,14 +186,17 @@ def status() -> dict:
     return ray_tpu.get(ctrl.status.remote())
 
 
-def replica_metrics(app_name: str | None = None) -> dict:
+def replica_metrics(app_name: str | None = None,
+                    deployment: str | None = None) -> dict:
     """Per-replica metrics including the user callable's stats() dict
-    (e.g. the LLM engine's prefix-cache hit/evict/preempt counters) —
+    (e.g. the LLM engine's prefix-cache hit/evict/preempt counters and
+    the prefix-summary digest the cache-aware router consumes) —
     {app: {deployment: {replica: metrics}}}.  The state-API detail
     surface next to serve.status() (ray: serve application details)."""
     ctrl = _require_controller()
-    return ray_tpu.get(ctrl.replica_metrics.remote(app_name),
-                       timeout=30.0)
+    return ray_tpu.get(
+        ctrl.replica_metrics.remote(app_name, deployment=deployment),
+        timeout=30.0)
 
 
 def delete(name: str, _blocking: bool = True) -> None:

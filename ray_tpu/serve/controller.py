@@ -161,12 +161,18 @@ class ServeController:
                 "max_ongoing": st.config.max_ongoing_requests,
             }
 
-    def replica_metrics(self, app_name: str | None = None) -> dict:
+    def replica_metrics(self, app_name: str | None = None,
+                        deployment: str | None = None,
+                        full_ids: bool = False) -> dict:
         """Per-replica metrics incl. the user callable's own stats()
-        (e.g. the LLM engine's KV-cache hit/preempt counters) — the
-        serve state API's detail surface (ray: serve application
-        details' replica_details).  Fanned out OUTSIDE the lock: a slow
-        replica must not wedge the control loop."""
+        (e.g. the LLM engine's KV-cache hit/preempt counters and its
+        prefix-cache summary) — the serve state API's detail surface
+        (ray: serve application details' replica_details).  Fanned out
+        OUTSIDE the lock: a slow replica must not wedge the control
+        loop.  `deployment` narrows the fan-out to one deployment (the
+        cache-aware router polls this per handle); `full_ids` keys
+        replicas by their complete actor id so callers can join against
+        membership from get_deployment_info."""
         import ray_tpu
 
         with self._lock:
@@ -175,6 +181,8 @@ class ServeController:
                 if app_name is not None and an != app_name:
                     continue
                 for dname, st in app["deployments"].items():
+                    if deployment is not None and dname != deployment:
+                        continue
                     for rid, rec in st.replicas.items():
                         if rec["state"] == "RUNNING":
                             targets.append((an, dname, rid,
@@ -192,7 +200,8 @@ class ServeController:
                 m = ray_tpu.get(ref, timeout=5.0)
             except Exception:  # noqa: BLE001
                 m = {"error": "unreachable"}
-            out.setdefault(an, {}).setdefault(dname, {})[rid[:12]] = m
+            key = rid if full_ids else rid[:12]
+            out.setdefault(an, {}).setdefault(dname, {})[key] = m
         return out
 
     def get_app_routes(self) -> dict:

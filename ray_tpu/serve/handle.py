@@ -27,8 +27,15 @@ from typing import Any
 
 from ray_tpu.actor import ActorHandle
 from ray_tpu.object_ref import ObjectRef
+from ray_tpu.serve import kv_router
 
 _MEMBERSHIP_TTL_S = 0.5
+# Prefix-summary refresh cadence: the router thread re-pulls every
+# replica's cached-prefix digest (serve/kv_router.py) through the
+# controller at this TTL while requests are flowing.  Staler than
+# membership on purpose — a summary is advisory (a miss only costs a
+# recomputed prefix), membership is correctness.
+_SUMMARY_TTL_S = 1.0
 # Dead-replica requeue budget per request: a submit that lands on a
 # replica which dies before producing any response is re-routed to
 # another running replica at most this many times (ray: serve retries
@@ -260,6 +267,16 @@ class DeploymentHandle:
         self._fetched_at = 0.0
         self._router_q: queue_mod.Queue | None = None
         self._router_thread: threading.Thread | None = None
+        # Cache-aware routing state (serve/kv_router.py): per-replica
+        # prefix summaries refreshed by the router thread on their own
+        # TTL.  Empty until a replica reports one (non-LLM deployments
+        # never do — scoring is skipped and this stays pure pow-2;
+        # their poll interval backs off 10x, and polling stops
+        # entirely once the handle has been idle for a while).
+        self._summaries: dict[str, dict] = {}
+        self._summaries_at = 0.0
+        self._summary_interval = _SUMMARY_TTL_S
+        self._last_request_t = 0.0
 
     # -- membership ---------------------------------------------------------
     def _refresh_blocking(self) -> None:
@@ -281,6 +298,35 @@ class DeploymentHandle:
                 if rid not in self._replicas:
                     self._handles.pop(rid)
                     self._inflight.pop(rid, None)
+                    self._summaries.pop(rid, None)
+
+    def _refresh_summaries(self) -> None:
+        """Pull every replica's prefix-cache summary through the
+        controller's replica_metrics verb (the serve state API detail
+        path — the summary rides each replica's user_stats).  Blocks —
+        router thread only.  Deployments whose replicas report no
+        summary (anything that isn't an LLM engine) just leave the dict
+        empty and cost one controller RT per TTL while traffic flows."""
+        import ray_tpu
+
+        rm = ray_tpu.get(
+            ActorHandle(self._controller_id).replica_metrics.remote(
+                self.app_name, deployment=self.deployment_name,
+                full_ids=True),
+            timeout=10.0)
+        reps = rm.get(self.app_name, {}).get(self.deployment_name, {})
+        summaries = {}
+        for rid, m in reps.items():
+            s = ((m.get("user_stats") or {}).get("kv") or {}) \
+                .get("prefix_summary") if isinstance(m, dict) else None
+            s = kv_router.compile_summary(s)
+            if s is not None:
+                summaries[rid] = s
+        with self._lock:
+            self._summaries = summaries
+            self._summaries_at = time.monotonic()
+            self._summary_interval = _SUMMARY_TTL_S if summaries \
+                else 10 * _SUMMARY_TTL_S
 
     def _ensure_router(self) -> queue_mod.Queue:
         with self._lock:
@@ -301,14 +347,41 @@ class DeploymentHandle:
                 item = self._router_q.get(timeout=_MEMBERSHIP_TTL_S)
             except queue_mod.Empty:
                 item = None
+            now = time.monotonic()
             with self._lock:
-                stale = (time.monotonic() - self._fetched_at) \
-                    > _MEMBERSHIP_TTL_S
+                stale = now - self._fetched_at > _MEMBERSHIP_TTL_S
+                # Summary refresh is ADVISORY and must not delay queued
+                # submits/requeues (the controller fan-out can block
+                # seconds on a dying replica): poll on idle ticks, only
+                # while requests have flowed recently, at an interval
+                # that backs off 10x for deployments that report no
+                # summaries (non-LLM: polling them forever would cost a
+                # controller RT per TTL for nothing).  A queue that
+                # never drains must not STARVE the poll either — past
+                # 5x the interval, refresh anyway (bounded: at most one
+                # blocking refresh per 5 TTLs ahead of a queued item).
+                age = now - self._summaries_at
+                refresh_summaries = (
+                    age > self._summary_interval
+                    and now - self._last_request_t < 30.0
+                    and (item is None
+                         or age > 5 * self._summary_interval))
             if stale:
                 try:
                     self._refresh_blocking()
                 except Exception:  # noqa: BLE001 - controller restarting
                     pass
+            if refresh_summaries and kv_router.cache_router_on():
+                try:
+                    self._refresh_summaries()
+                except Exception:  # noqa: BLE001 - controller restarting
+                    # Back off on failure too: without advancing the
+                    # stamp, a wedged controller would re-block every
+                    # idle tick for up to the RPC timeout — exactly the
+                    # queued-submit delay this gating exists to avoid.
+                    with self._lock:
+                        self._summaries_at = time.monotonic()
+                        self._summary_interval = 10 * _SUMMARY_TTL_S
             if item is None:
                 continue
             fut, submit_fn, args, kwargs, deadline = item
@@ -330,14 +403,23 @@ class DeploymentHandle:
                 fut.set_exception(e)
 
     # -- routing ------------------------------------------------------------
-    def _pick(self, exclude=()) -> tuple[str, ActorHandle]:
+    def _pick(self, exclude=(), prompt=None) -> tuple[str, ActorHandle]:
         """Power-of-two choices over in-flight counts, skipping replicas at
         their max_ongoing_requests cap — the routing-side backpressure of
         ray: pow_2_scheduler.py:51 (replicas over capacity are not sent
         more work; the request queues in the router instead).  `exclude`
         holds replica ids that already FAILED this request (dead-replica
-        requeue must land somewhere else)."""
+        requeue must land somewhere else).
+
+        With `prompt` (a token-id list) and cached prefix summaries,
+        the replica whose radix cache holds the deepest prefix of the
+        prompt wins, discounted by its queue length (kv_router.choose —
+        the SGLang cache-aware routing shape).  Capacity still rules:
+        a replica at its cap is not a candidate no matter how deep its
+        match.  No match anywhere (or RAY_TPU_CACHE_ROUTER=0) → pure
+        power-of-two, exactly as before."""
         with self._lock:
+            self._last_request_t = time.monotonic()
             reps = [r for r in self._replicas if r not in exclude] \
                 if exclude else self._replicas
             if not reps:
@@ -356,12 +438,19 @@ class DeploymentHandle:
                         f"max_ongoing_requests={cap}")
             else:
                 eligible = reps
-            if len(eligible) == 1:
-                choice = eligible[0]
-            else:
-                a, b = random.sample(eligible, 2)
-                choice = a if self._inflight.get(a, 0) <= \
-                    self._inflight.get(b, 0) else b
+            choice = None
+            if (prompt is not None and self._summaries
+                    and kv_router.cache_router_on()):
+                choice = kv_router.choose(prompt, eligible,
+                                          self._inflight,
+                                          self._summaries)
+            if choice is None:
+                if len(eligible) == 1:
+                    choice = eligible[0]
+                else:
+                    a, b = random.sample(eligible, 2)
+                    choice = a if self._inflight.get(a, 0) <= \
+                        self._inflight.get(b, 0) else b
             self._inflight[choice] = self._inflight.get(choice, 0) + 1
             handle = self._handles[choice]
         return choice, handle
@@ -369,7 +458,9 @@ class DeploymentHandle:
     def _submit(self, args: tuple, kwargs: dict,
                 state: dict | None = None) -> ObjectRef:
         rid, handle = self._pick(
-            state["failed"] if state is not None else ())
+            state["failed"] if state is not None else (),
+            prompt=kv_router.extract_prompt(args, kwargs)
+            if self._summaries else None)
         if state is not None:
             state["rid"] = rid
         try:
@@ -395,7 +486,9 @@ class DeploymentHandle:
         """Route one streaming request: returns a
         StreamingObjectRefGenerator over the replica generator's items."""
         rid, handle = self._pick(
-            state["failed"] if state is not None else ())
+            state["failed"] if state is not None else (),
+            prompt=kv_router.extract_prompt(args, kwargs)
+            if self._summaries else None)
         if state is not None:
             state["rid"] = rid
         try:

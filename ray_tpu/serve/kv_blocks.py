@@ -38,6 +38,8 @@ from __future__ import annotations
 import functools
 import threading
 
+from ray_tpu.serve.kv_router import ROOT_HASH, chain_hash, summary_digest
+
 
 def _locked(fn):
     """Serialize a public method on the manager's RLock (reentrant:
@@ -51,9 +53,14 @@ def _locked(fn):
 
 class _Node:
     """One cached block: a radix-tree edge labeled by its page's token
-    ids.  Children keyed by the next page's token tuple."""
+    ids.  Children keyed by the next page's token tuple.  `hash` is the
+    chained prefix hash (kv_router.chain_hash over the parent's hash +
+    this page's token ids): membership of the hash alone proves the
+    whole path root..node is cached — the unit the cluster router's
+    prefix summaries are built from."""
 
-    __slots__ = ("key", "block", "parent", "children", "last_used")
+    __slots__ = ("key", "block", "parent", "children", "last_used",
+                 "hash")
 
     def __init__(self, key: tuple | None, block: int,
                  parent: "_Node | None"):
@@ -62,6 +69,8 @@ class _Node:
         self.parent = parent
         self.children: dict[tuple, _Node] = {}
         self.last_used = 0
+        self.hash = ROOT_HASH if parent is None \
+            else chain_hash(parent.hash, key)
 
 
 class BlockManager:
@@ -93,6 +102,11 @@ class BlockManager:
         self.hit_tokens = 0      # prompt tokens served from cache
         self.evictions = 0
         self.cow_copies = 0
+        # Memoized prefix_summary (stats() embeds it on every metrics
+        # poll): rebuilt only when the cached SET changes (commit /
+        # evict) — LRU-clock touches may reorder an over-cap subset,
+        # which is acceptable staleness for an advisory summary.
+        self._summary_cache: tuple[int, dict] | None = None
 
     # ------------------------------------------------------------ helpers
     def _chunks(self, tokens) -> list[tuple]:
@@ -174,6 +188,7 @@ class BlockManager:
         del self._node_of[victim.block]
         self._free.append(victim.block)
         self.evictions += 1
+        self._summary_cache = None
 
     # --------------------------------------------------------- refcounts
     @_locked
@@ -265,8 +280,57 @@ class BlockManager:
                 child = _Node(key, blocks[i], node)
                 node.children[key] = child
                 self._node_of[blocks[i]] = child
+                self._summary_cache = None
             child.last_used = self._clock
             node = child
+
+    # ----------------------------------------------------------- cluster
+    @_locked
+    def export_blocks(self, pages: list[int], n_valid_tokens: int,
+                      ) -> list[int]:
+        """Pin the blocks covering the first `n_valid_tokens` positions
+        for a KV export: takes one extra reference on each covered
+        block so the exporter may read their device pages while the
+        owning request independently commits/releases, and returns the
+        covered ids in table order.  Caller MUST release() them once
+        the copy is sealed (the serve migration path — see
+        LLMEngine.kv_export)."""
+        n = -(-n_valid_tokens // self.page)
+        if n > len(pages):
+            raise ValueError(
+                f"export of {n_valid_tokens} tokens needs {n} blocks "
+                f"but the request holds {len(pages)}")
+        blocks = list(pages[:n])
+        self.retain(blocks)
+        return blocks
+
+    @_locked
+    def prefix_summary(self, cap: int = 2048) -> dict:
+        """Compact description of the cached radix tree for the cluster
+        router: the chained prefix hashes of (up to `cap`, newest-LRU
+        first) cached nodes plus an order-independent XOR digest.  A
+        router holding this set can compute a prompt's matched-prefix
+        depth without talking to the replica (kv_router.matched_depth).
+        The digest changes whenever the cached set changes — the cheap
+        'did serving alter the cache' probe the state API exposes.
+        Memoized until commit/evict alters the set (every metrics poll
+        embeds this; rebuilding per poll would tax the legacy metrics
+        path even with the router switched off)."""
+        if self._summary_cache is not None \
+                and self._summary_cache[0] == cap:
+            return self._summary_cache[1]
+        nodes = self._node_of.values()
+        if len(nodes) > cap:
+            nodes = sorted(nodes, key=lambda n: (-n.last_used, n.block))
+            nodes = nodes[:cap]
+        hashes = [n.hash for n in nodes]
+        # Only set-derived fields belong here: anything tracking the
+        # free list would go stale under the memoization.
+        out = {"page": self.page, "hashes": hashes,
+               "digest": summary_digest(hashes),
+               "cached": len(self._node_of)}
+        self._summary_cache = (cap, out)
+        return out
 
     # ------------------------------------------------------------ checks
     @_locked
@@ -311,4 +375,7 @@ class BlockManager:
             "hit_tokens": self.hit_tokens,
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
+            # The cluster router's view of this cache (compiled by the
+            # DeploymentHandle via controller replica_metrics).
+            "prefix_summary": self.prefix_summary(),
         }

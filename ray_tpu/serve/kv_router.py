@@ -1,0 +1,180 @@
+"""Prefix-cache-aware replica routing: hashing, summaries, scoring.
+
+The cluster half of the radix prefix cache (serve/kv_blocks.py).  A
+single replica's cache only helps requests that happen to land on it;
+under power-of-two routing a popular shared prefix ends up recomputed
+on every replica it bounces across.  SGLang-style cache-aware routing
+(Zheng et al. 2024: the router keeps an approximation of each worker's
+radix tree) fixes that: route a request to the replica that already
+holds the longest prefix of its prompt, unless that replica's queue
+says otherwise.
+
+Three pieces, all host-side and dependency-free so the DeploymentHandle
+can import this module without touching jax or the runtime:
+
+  - **Chained block hashes** (`chain_hash` / `prompt_hashes`): block i's
+    hash commits to the whole prefix through block i (blake2b over the
+    parent hash + the block's token ids), so set-membership of h_i
+    alone proves the replica caches blocks 0..i.  blake2b, NOT Python's
+    `hash()` — the router and the replicas live in different processes
+    and `PYTHONHASHSEED` randomizes `hash()` per process.
+  - **Compact summaries**: each BlockManager exports its cached tree as
+    the set of node hashes plus an order-independent XOR digest
+    (`prefix_summary`); the handle's router thread refreshes these
+    through the controller's `replica_metrics` verb on a TTL.
+  - **Scoring** (`choose`): matched-prefix depth in blocks, discounted
+    by the replica's locally-tracked in-flight count — a deep match on
+    a drowning replica loses to an idle one.  No replica matches →
+    None, and the caller falls back to pure power-of-two choices.
+
+Kill switch: RAY_TPU_CACHE_ROUTER=0 disables scoring AND the summary
+polling (read per call, so one process can A/B it in the same run).
+RAY_TPU_PD_DISAGG gates the prefill/decode split (serve/llm.py) and
+lives here with its sibling so both cluster-serving switches are in one
+place.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+# Root of every hash chain (the empty prefix).
+ROOT_HASH = 0
+
+# Queue-length discount: one in-flight request costs a candidate this
+# many blocks of matched depth (RAY_TPU_CACHE_ROUTER_ALPHA).
+_DEFAULT_ALPHA = 1.0
+
+
+def env_on(name: str, default: bool = True) -> bool:
+    """Shared kill-switch truthiness rule (one copy — serve modules
+    import it so RAY_TPU_* switches can never drift apart)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+def cache_router_on() -> bool:
+    """RAY_TPU_CACHE_ROUTER kill switch (checked per call: same-run A/B)."""
+    return env_on("RAY_TPU_CACHE_ROUTER")
+
+
+def pd_disagg_on() -> bool:
+    """RAY_TPU_PD_DISAGG kill switch for prefill/decode disaggregation."""
+    return env_on("RAY_TPU_PD_DISAGG")
+
+
+def queue_alpha() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_CACHE_ROUTER_ALPHA", ""))
+    except ValueError:
+        return _DEFAULT_ALPHA
+
+
+def chain_hash(parent: int, chunk) -> int:
+    """Hash of one cached block given its parent's hash: 64-bit blake2b
+    over (parent_hash || token ids).  Deterministic across processes —
+    the whole routing scheme rides on the router and every replica
+    agreeing on these values."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent).to_bytes(8, "little"))
+    h.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                      for t in chunk))
+    return int.from_bytes(h.digest(), "little")
+
+
+def prompt_hashes(tokens, page: int) -> list[int]:
+    """Chained hashes of a prompt's FULL blocks (block granularity —
+    the radix tree never caches partial pages, so a trailing partial
+    chunk can't match anything)."""
+    n = len(tokens) // page
+    out, h = [], ROOT_HASH
+    for i in range(n):
+        h = chain_hash(h, tokens[i * page:(i + 1) * page])
+        out.append(h)
+    return out
+
+
+def summary_digest(hashes) -> int:
+    """Order-independent digest of a hash set: XOR folds in O(n) and
+    any insertion/eviction flips it — 'did this replica's cache change'
+    in one integer."""
+    d = 0
+    for h in hashes:
+        d ^= int(h)
+    return d
+
+
+def compile_summary(summary: dict) -> dict | None:
+    """Normalize a replica-reported prefix summary for scoring: the
+    hash list becomes a set (membership tests dominate).  Returns None
+    for summaries the scorer can't use."""
+    if not isinstance(summary, dict):
+        return None
+    page = summary.get("page")
+    hashes = summary.get("hashes")
+    if not page or hashes is None:
+        return None
+    return {"page": int(page), "set": frozenset(int(h) for h in hashes),
+            "digest": summary.get("digest", 0)}
+
+
+def matched_depth(hashes: list[int], cached: frozenset) -> int:
+    """Longest prefix (in blocks) of the chained `hashes` present in a
+    replica's cached-hash set.  Chaining makes membership of h_i imply
+    the full path, so the walk stops at the first miss."""
+    depth = 0
+    for h in hashes:
+        if h not in cached:
+            break
+        depth += 1
+    return depth
+
+
+def extract_prompt(args: tuple, kwargs: dict):
+    """Pull a token-id prompt out of a request payload, if there is
+    one: LLM requests through serve carry {"prompt": [ids...], ...}.
+    Anything else → None (the deployment isn't prompt-shaped; route by
+    queue length alone)."""
+    for v in list(args) + list(kwargs.values()):
+        if isinstance(v, dict):
+            p = v.get("prompt")
+            if isinstance(p, (list, tuple)) and p:
+                return p
+    return None
+
+
+def choose(prompt, candidates, inflight: dict, summaries: dict,
+           ) -> str | None:
+    """Pick the replica with the best prefix-locality score, or None.
+
+    score(replica) = matched_depth(prompt, replica) - alpha * inflight.
+    Every candidate participates (an unmatched idle replica scores 0
+    and can beat an overloaded deep match — locality must not create a
+    hotspot), but when NO candidate matches at all the answer is None:
+    the caller's power-of-two path owns the tie-breaking then.  Ties go
+    to the lower in-flight count, then to replica-id order so the
+    choice is deterministic under test."""
+    alpha = queue_alpha()
+    hash_cache: dict[int, list[int]] = {}
+    best = None            # (score, -depth?, inflight, rid)
+    any_match = False
+    for rid in candidates:
+        s = summaries.get(rid)
+        depth = 0
+        if s is not None:
+            hs = hash_cache.get(s["page"])
+            if hs is None:
+                hs = prompt_hashes(prompt, s["page"])
+                hash_cache[s["page"]] = hs
+            depth = matched_depth(hs, s["set"])
+        if depth > 0:
+            any_match = True
+        q = inflight.get(rid, 0)
+        key = (-(depth - alpha * q), q, rid)
+        if best is None or key < best[0]:
+            best = (key, rid)
+    if not any_match or best is None:
+        return None
+    return best[1]

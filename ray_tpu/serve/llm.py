@@ -61,11 +61,39 @@ def _buckets_for(max_len: int, smallest: int = 32) -> list[int]:
     return out
 
 
-def _env_on(name: str, default: bool = True) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v not in ("0", "false", "False", "")
+from ray_tpu.serve.kv_router import env_on as _env_on
+
+
+def _check_pool_role(role: str, decode_deployment) -> None:
+    """The pool-role combination rules, shared by LLMServer.__init__
+    and reconfigure (the declarative schema enforces the same rules at
+    config time — ENGINE_ROLES is its source of truth)."""
+    from ray_tpu.serve.schema import ENGINE_ROLES
+
+    if role not in ENGINE_ROLES:
+        raise ValueError(
+            f"engine role must be one of {list(ENGINE_ROLES)}, "
+            f"got {role!r}")
+    if role == "prefill" and decode_deployment is None:
+        raise ValueError(
+            "role='prefill' requires decode_deployment (the decode "
+            "pool this replica ships KV to) — a prefill pool with no "
+            "decode pool cannot serve")
+    if role != "prefill" and decode_deployment is not None:
+        raise ValueError(
+            f"decode_deployment only applies to role='prefill' (got "
+            f"role={role!r}) — a dangling decode target would "
+            "silently serve unified")
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n: the shared width-bucketing rule of
+    the COW / import / export padding paths (one copy — the compile
+    count and pad waste must never diverge between them)."""
+    m = 1
+    while m < n:
+        m *= 2
+    return m
 
 
 _METRICS = None
@@ -142,6 +170,16 @@ class _Request:
     # cache (warmup must compile the full-prefill bucket programs).
     cache_ok: bool = True
     preempted: int = 0
+    # Prefill-pool mode: finish after the first sampled token and
+    # attach the request's KV pages (device → host) to the result so
+    # the server can migrate them to a decode replica (kv_export).
+    prefill_only: bool = False
+    # Migrated-KV admission (kv_import): [2, L, n, kvh, page, hd] host
+    # array scattered into freshly-allocated pool pages at admission
+    # instead of running prefill.  Cleared right after the scatter —
+    # this may be a pinned arena view and must not outlive its use.
+    import_kv: Any = None
+    import_len: int = 0          # valid KV positions in import_kv
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -377,6 +415,33 @@ class LLMEngine:
             llama.scatter_prefill_pages(cache, ks, vs, page_ids, rows,
                                         slots, true_lens, aligned=False),
             donate_argnums=(0,))
+        # KV migration surface (prefill/decode disaggregation).  Export
+        # gathers a request's pages into ONE stacked [2, L, n, kvh,
+        # page, hd] array (a single host fetch, a single object-plane
+        # put); import scatters such an array into freshly-allocated
+        # pages and seeds the slot's pos/current-token — together they
+        # let a decode engine resume exactly where a prefill engine
+        # stopped.  Widths are padded to powers of two (pad ids target
+        # the trash page 0, whose content is garbage by contract) so
+        # the compile count stays logarithmic.
+        def _gather_kv_fn(ks, vs, ids):
+            return jnp.stack([jnp.stack([k[ids] for k in ks]),
+                              jnp.stack([v[ids] for v in vs])])
+
+        self._gather_kv = jax.jit(_gather_kv_fn)
+
+        def _import_kv_fn(cache, cur, kv, ids, slot, kvlen, tok):
+            k = [cache["k"][li].at[ids].set(kv[0, li])
+                 for li in range(cfg.n_layers)]
+            v = [cache["v"][li].at[ids].set(kv[1, li])
+                 for li in range(cfg.n_layers)]
+            pos = cache["pos"].at[slot].set(kvlen)
+            return ({"k": k, "v": v, "pos": pos},
+                    cur.at[slot].set(tok))
+
+        self._import_pages = jax.jit(_import_kv_fn,
+                                     donate_argnums=(0, 1))
+
         # COW page copy: duplicate shared blocks before a writer touches
         # them.  Pairs are padded with (0, 0) — trash-to-trash is a
         # no-op — so the compile count stays at a few pad widths.
@@ -422,6 +487,13 @@ class LLMEngine:
         self._next_seed = 0
         self.completed = 0
         self.preemptions = 0
+        self.kv_exports = 0            # prefill-side page migrations out
+        self.kv_imports = 0            # decode-side page migrations in
+        # Export side-channel (created lazily by the loop thread on the
+        # first prefill_only finish): the device→host fetch of migrated
+        # KV runs here so the decode loop never blocks on it.
+        self._export_q: queue.Queue | None = None
+        self._export_thread: threading.Thread | None = None
         self.prefill_tokens = 0        # tokens actually prefilled
         self.decode_tokens = 0
         self._metrics_last: dict[str, float] = {}
@@ -436,10 +508,19 @@ class LLMEngine:
                eos_id: int | None = None,
                token_queue: "queue.Queue | None" = None,
                _cache_ok: bool = True,
+               prefill_only: bool = False,
                ) -> concurrent.futures.Future:
         """Thread-safe; resolves to {tokens, ttft_s, total_s}.  With
         `token_queue`, every decoded token is ALSO pushed to the queue as
-        produced (None = end) — the token-streaming hook."""
+        produced (None = end) — the token-streaming hook.  With
+        `prefill_only` (paged engines), the result additionally carries
+        `kv_export`: the request's KV pages as one host array plus the
+        metadata kv_import() needs to resume decoding on ANOTHER engine
+        (the prefill half of disaggregated serving)."""
+        if prefill_only and not self.paged:
+            raise ValueError(
+                "prefill_only requires a paged engine (KV export is "
+                "page-granular)")
         if len(prompt) >= self.max_len:
             raise ValueError(
                 f"prompt length {len(prompt)} >= max_len {self.max_len}")
@@ -468,7 +549,7 @@ class LLMEngine:
             req = _Request(list(prompt), max_new_tokens, temperature,
                            eos_id, concurrent.futures.Future(),
                            token_queue=token_queue, sample_seed=seed,
-                           cache_ok=_cache_ok)
+                           cache_ok=_cache_ok, prefill_only=prefill_only)
             self._waiting.put(req)
             self._wake.set()
         finally:
@@ -484,6 +565,77 @@ class LLMEngine:
         self.start()
         return self.submit(prompt, max_new_tokens, temperature,
                            eos_id, _cache_ok=_cache_ok).result()
+
+    def kv_import(self, prompt: list[int], tokens: list[int], kv,
+                  *, kv_len: int, max_new_tokens: int = 32,
+                  temperature: float = 0.0, eos_id: int | None = None,
+                  sample_seed: int = 0,
+                  token_queue: "queue.Queue | None" = None,
+                  ) -> concurrent.futures.Future:
+        """Resume a request whose prefill ran on ANOTHER engine: `kv` is
+        that engine's `kv_export` array ([2, L, n, kvh, page, hd],
+        gather_pages-compatible page layout), covering the first
+        `kv_len` positions of prompt+tokens.  The pages are scattered
+        into freshly-allocated pool blocks at admission; decode then
+        continues from tokens[-1] exactly as if prefill had run here.
+        With matching engine seeds and the exporter's `sample_seed`,
+        the continued sample stream is bit-identical to an uninterrupted
+        single-engine run (the migration-parity contract).  The future
+        resolves like submit()'s — `tokens` in the result INCLUDES the
+        ones passed in."""
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            failpoints.fire("serve.kv_import")
+        if not self.paged:
+            raise ValueError("kv_import requires a paged engine")
+        if not tokens:
+            raise ValueError("kv_import needs at least the first "
+                             "generated token")
+        if len(tokens) > max_new_tokens:
+            # Under-reserving pages for a negative remaining budget
+            # would blow up inside the jitted scatter ON THE ENGINE
+            # LOOP (killing every tenant) — reject at the API edge like
+            # every other misuse.
+            raise ValueError(
+                f"already have {len(tokens)} generated tokens but "
+                f"max_new_tokens is {max_new_tokens}")
+        if kv_len != len(prompt) + len(tokens) - 1:
+            raise ValueError(
+                f"kv_len {kv_len} != prompt+tokens-1 "
+                f"({len(prompt) + len(tokens) - 1}): exported KV must "
+                "cover every position but the newest token's")
+        kv = np.asarray(kv)
+        L = self.cfg.n_layers
+        n_imp = -(-kv_len // self.page)
+        want = (2, L, n_imp, self.cfg.n_kv_heads, self.page,
+                self.cfg.head_dim)
+        if kv.shape != want:
+            raise ValueError(
+                f"kv shape {kv.shape} does not match this engine "
+                f"(expected {want}: page_size/config mismatch between "
+                "prefill and decode pools?)")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        need = -(-(len(prompt) + max_new_tokens) // self.page)
+        if need > self.n_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool holds "
+                f"{self.n_pages - 1}; raise kv_pages")
+        if self._error is not None:
+            raise RuntimeError(
+                "LLM engine is dead after an earlier failure") \
+                from self._error
+        req = _Request(list(prompt), max_new_tokens, temperature,
+                       eos_id, concurrent.futures.Future(),
+                       token_queue=token_queue, sample_seed=sample_seed,
+                       tokens=list(tokens), import_kv=kv,
+                       import_len=kv_len)
+        self._waiting.put(req)
+        self._wake.set()
+        return req.future
 
     def warmup(self, buckets: list[int] | None = None) -> None:
         """Pre-compile the decode program and prefill buckets so the first
@@ -517,6 +669,13 @@ class LLMEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        if self._export_thread is not None:
+            # Sentinel AFTER the loop stopped: pending exports drain in
+            # order, then the thread exits.
+            self._export_q.put(None)
+            self._export_thread.join(timeout=10.0)
+            self._export_thread = None
+            self._export_q = None
 
     def abort_pending(self, exc: BaseException) -> None:
         """Fail every queued and in-flight request (call AFTER stop():
@@ -558,7 +717,10 @@ class LLMEngine:
         seq = req.prompt + req.tokens       # resume includes generated
         total = len(seq)
         remaining = req.max_new_tokens - len(req.tokens)
-        matched = mgr.match(seq) if req.cache_ok else []
+        # Imported-KV requests never match the local cache: their pages
+        # arrive by scatter and must be fresh private blocks.
+        matched = mgr.match(seq) \
+            if (req.cache_ok and req.import_kv is None) else []
         matched_tokens = len(matched) * self.page
         cover = total + (min(remaining, self.steps_per_sync)
                          if self._preempt_on else remaining)
@@ -660,13 +822,25 @@ class LLMEngine:
             wave.append((free, req))
         if not wave:
             return
+        # Migrated-KV admissions scatter their imported pages instead of
+        # prefilling; their first token was already produced (and
+        # delivered) by the exporting engine, so they skip the
+        # first-token fetch below entirely.
+        imports = [(s, r) for s, r in wave if r.import_kv is not None]
+        wave = [(s, r) for s, r in wave if r.import_kv is None]
+        for slot, req in imports:
+            self._apply_import(slot, req)
+            if req.first_token_at is None:
+                req.first_token_at = time.perf_counter()
+            if self._done(req):
+                self._finish(slot)
+        if not wave:
+            return
         if copies:
             # Materialize COW copies before any prefill reads/writes the
             # forked pages (ordering rides the donated-cache dependency).
-            m = 1
-            while m < len(copies):
-                m *= 2
-            pairs = copies + [(0, 0)] * (m - len(copies))
+            pairs = copies + [(0, 0)] * (_pow2(len(copies))
+                                         - len(copies))
             self.cache = self._copy_pages(
                 self.cache, jnp.asarray([s for s, _ in pairs], jnp.int32),
                 jnp.asarray([d for _, d in pairs], jnp.int32))
@@ -813,6 +987,129 @@ class LLMEngine:
         self._cur_dev = self._cur_dev.at[slots_dev].set(nxt)
         return nxt
 
+    def _apply_import(self, slot: int, req: _Request) -> None:
+        """Scatter a migrated request's KV pages into its freshly
+        reserved blocks and seed the slot's position/current token —
+        the admission-time half of kv_import().  The (possibly
+        arena-view) payload is dropped immediately after the device
+        copy so a migrated object's pin never outlives its single
+        read."""
+        import jax.numpy as jnp
+
+        n_imp = -(-req.import_len // self.page)
+        ids = req.pages[:n_imp]
+        kv = req.import_kv
+        m = _pow2(n_imp)
+        if m > n_imp:
+            # Pad ids with the trash page (writes there are garbage by
+            # contract) so import widths compile per power of two.
+            pad = np.zeros(kv.shape[:2] + (m - n_imp,) + kv.shape[3:],
+                           kv.dtype)
+            kv = np.concatenate([kv, pad], axis=2)
+            ids = list(ids) + [0] * (m - n_imp)
+        self.cache, self._cur_dev = self._import_pages(
+            self.cache, self._cur_dev, jnp.asarray(kv),
+            jnp.asarray(ids, jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(req.import_len, jnp.int32),
+            jnp.asarray(req.tokens[-1], jnp.int32))
+        req.import_kv = None
+        self.kv_imports += 1
+
+    def _finish_export(self, slot: int, req: _Request) -> None:
+        """Finish a prefill_only request: dispatch the page gather for
+        migration and hand the HOST FETCH to the export thread — a
+        synchronous device→host read here would stall the engine loop
+        (and every co-resident request's admission) for the full
+        tunnel round trip per migration.  The covered blocks are
+        export-pinned (BlockManager.export_blocks) so the
+        commit/release in _release_slot — which must run on THIS
+        thread, it owns the slot table — cannot free them before the
+        fetch lands; refcounted pins also make them eviction-proof."""
+        import jax.numpy as jnp
+
+        from ray_tpu import failpoints
+
+        ids = None
+        try:
+            kv_len = len(req.prompt) + len(req.tokens) - 1
+            ids = self._mgr.export_blocks(req.pages, kv_len)
+            # The failpoint models a fault INSIDE the pinned window —
+            # the hard case: the export pins must be dropped on the
+            # way out or the pool silently shrinks per failed export.
+            if failpoints.ACTIVE:
+                failpoints.fire("serve.kv_export")
+            n = len(ids)
+            m = _pow2(n)
+            ids_p = list(ids) + [0] * (m - n)
+            # Async dispatch + async copy: the loop moves on while the
+            # device computes and the bytes stream to the host.
+            arr = self._gather_kv(self.cache["k"], self.cache["v"],
+                                  jnp.asarray(ids_p, jnp.int32))
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        except BaseException as e:  # noqa: BLE001 - injected faults
+            # A failed export (serve.kv_export failpoint, OOM on the
+            # gather) must not kill the engine loop NOR leak anything:
+            # drop the export pins AND the request's own refs, fail the
+            # one future, and let the server fall back to serving
+            # locally.
+            if ids is not None:
+                self._mgr.release(ids)
+            self._release_slot(slot, req)
+            req.emit(None)
+            if not req.future.done():
+                req.future.set_exception(e)
+            return
+        self._release_slot(slot, req)
+        if self._export_q is None:
+            self._export_q = queue.Queue()
+            self._export_thread = threading.Thread(
+                target=self._export_loop, name="llm-kv-export",
+                daemon=True)
+            self._export_thread.start()
+        self._export_q.put((req, arr, ids, kv_len, n))
+
+    def _export_loop(self) -> None:
+        """Materializes export payloads off the engine loop: one
+        stacked [2, L, n, kvh, page, hd] host array covering every
+        position whose KV has been written (the newest token's hasn't
+        — the importer recomputes it as its first decode step), then
+        resolves the request's future and drops the export pins."""
+        while True:
+            item = self._export_q.get()
+            if item is None:
+                return
+            req, arr, ids, kv_len, n = item
+            try:
+                # Contiguous copy of the REAL payload: a bare slice
+                # would pin the whole pow-2-padded buffer and force
+                # put() to copy the non-contiguous view again.
+                host = np.ascontiguousarray(np.asarray(arr)[:, :, :n])
+            except BaseException as e:  # noqa: BLE001
+                self._mgr.release(ids)
+                req.emit(None)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            self._mgr.release(ids)
+            self.kv_exports += 1
+            now = time.perf_counter()
+            req.emit(None)
+            if not req.future.done():
+                req.future.set_result({
+                    "tokens": req.tokens,
+                    "ttft_s": (req.first_token_at or now)
+                    - req.submitted_at,
+                    "total_s": now - req.submitted_at,
+                    "kv_export": {
+                        "kv": host, "len": kv_len, "page": self.page,
+                        "sample_seed": req.sample_seed,
+                        "tokens": list(req.tokens)},
+                })
+
     def _done(self, req: _Request) -> bool:
         return (len(req.tokens) >= req.max_new_tokens
                 or (req.eos_id is not None
@@ -843,6 +1140,18 @@ class LLMEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self.completed += 1
+        if req.prefill_only and self.paged and req.pages \
+                and not (req.eos_id is not None and req.tokens
+                         and req.tokens[-1] == req.eos_id):
+            # Export path: block release + table scrub happen here (the
+            # loop owns both); the host fetch and future resolution ride
+            # the export thread.  An eos-terminated request skips it —
+            # generation is over, so gathering/fetching its KV would be
+            # a full tunnel round trip for a payload nobody consumes
+            # (the server returns the tokens directly when kv_export is
+            # absent).
+            self._finish_export(slot, req)
+            return
         self._release_slot(slot, req)
         now = time.perf_counter()
         req.emit(None)
@@ -1011,7 +1320,9 @@ class LLMEngine:
                "prefill_tokens": self.prefill_tokens,
                "decode_tokens": self.decode_tokens,
                "prefix_cache": self._prefix_cache,
-               "kv_preempt": self._preempt_on}
+               "kv_preempt": self._preempt_on,
+               "kv_exports": self.kv_exports,
+               "kv_imports": self.kv_imports}
         if self._mgr is not None:
             kv = self._mgr.stats()
             out["kv"] = kv
@@ -1033,6 +1344,21 @@ class LLMServer:
     kv_preempt) are operator-tunable through `engine_config` in the
     declarative deploy config (serve/schema.py) and through
     `reconfigure` (user_config), which rebuilds the engine in place.
+
+    **Pool roles** (disaggregated prefill/decode, DistServe/Mooncake
+    shape): `role="prefill"` replicas run ONLY the prompt pass — the
+    finished KV pages are sealed into an arena object and shipped to a
+    replica of the `decode_deployment` pool, whose engine imports them
+    (`kv_decode`) and owns the whole decode phase.  Prefill compute
+    thus never steals decode batch slots, and the KV transfer rides the
+    object plane (same-host moves take the direct-shm pull, cross-node
+    the streaming-write path).  `decode_deployment` is the decode
+    pool's deployment name (declarative config) or its bound
+    Application/handle (Python composition).  Both pools should share
+    the engine `seed` so a migrated continuation draws the same sample
+    stream an unsplit engine would.  Kill switch RAY_TPU_PD_DISAGG=0
+    (or per-request {"disagg": false}) serves unified on the prefill
+    replica itself — same-run A/B.
     """
 
     def __init__(self, model: str = "debug", *, max_batch: int = 8,
@@ -1041,18 +1367,27 @@ class LLMServer:
                  page_size: int = 512, kv_pages: int | None = None,
                  prefix_cache: bool | None = None,
                  kv_preempt: bool | None = None,
-                 steps_per_sync: int = 8):
+                 steps_per_sync: int = 8,
+                 role: str = "unified",
+                 decode_deployment=None):
         from ray_tpu.models import llama
 
+        _check_pool_role(role, decode_deployment)
+        if role == "prefill" and not paged:
+            raise ValueError(
+                "role='prefill' requires a paged engine (KV migration "
+                "is page-granular)")
         cfg = llama.llama_configs()[model] if isinstance(model, str) \
             else model
         name = "llm"
+        self._app_name = None
         try:
             from ray_tpu.serve import replica as _replica
 
             ctx = _replica.get_current_context()
             if ctx is not None and ctx.deployment:
                 name = ctx.deployment
+                self._app_name = ctx.app_name
         except Exception:  # noqa: BLE001 - outside a replica
             pass
         self._engine_kwargs = dict(
@@ -1063,14 +1398,187 @@ class LLMServer:
         self._cfg = cfg
         self._params = params
         self._warmup = warmup
+        self._role = role
+        self._decode_dep = decode_deployment
+        self._decode_handle = None
+        self._decode_kv_handle = None
+        # Migration observability (→ stats() → serve.replica_metrics):
+        # bytes/ms through the object plane, split by side.  The pull
+        # side mutates from the replica's thread POOL (kv_decode is a
+        # sync method), so its counters take the lock; the put side
+        # runs on the event loop and is naturally serialized.
+        self._pd_lock = threading.Lock()
+        self._migrations = 0
+        self._pd_fallbacks = 0
+        self._kv_migrate_bytes = 0
+        self._kv_migrate_put_ms = 0.0
+        self._kv_pull_bytes = 0
+        self._kv_pull_ms = 0.0
         self.engine = LLMEngine(cfg, params, **self._engine_kwargs)
         self.engine.start()
         if warmup:
             self.engine.warmup()
 
+    # ------------------------------------------------- prefill/decode
+    def _disagg(self, request: dict) -> bool:
+        from ray_tpu.serve import kv_router
+
+        return (self._role == "prefill"
+                and self._decode_dep is not None
+                and self.engine.paged
+                and kv_router.pd_disagg_on()
+                and request.get("disagg", True)
+                and request.get("max_new_tokens", 32) > 1)
+
+    def _get_decode_handle(self):
+        """The decode pool's handle pair, created once per server: the
+        base handle (full-generate fallback) and its kv_decode-bound
+        sibling (a .options() handle owns its own membership cache and
+        router thread — per-request construction would cost a
+        controller RT every call)."""
+        if self._decode_handle is None:
+            dd = self._decode_dep
+            if isinstance(dd, str):
+                from ray_tpu import serve as serve_api
+
+                base = serve_api.get_deployment_handle(
+                    dd, self._app_name or "default")
+            else:
+                # Bound composition: serve.run already substituted the
+                # child Application with a DeploymentHandle.
+                base = dd
+            self._decode_kv_handle = base.options(
+                method_name="kv_decode")
+            self._decode_handle = base
+        return self._decode_handle
+
+    async def _local_generate(self, request: dict, t_start: float,
+                              why: str) -> dict:
+        import asyncio
+
+        fut = self.engine.submit(
+            request["prompt"],
+            max_new_tokens=request.get("max_new_tokens", 32),
+            temperature=request.get("temperature", 0.0),
+            eos_id=request.get("eos_id"))
+        out = await asyncio.wrap_future(fut)
+        out["total_s"] = time.perf_counter() - t_start
+        out["pd_fallback"] = why
+        return out
+
+    async def _prefill_decode(self, request: dict) -> dict:
+        """The migration path: prefill here, seal the KV pages into an
+        arena object, hand the refs to a decode replica.  Failure at
+        any stage degrades, never fails the request: export error →
+        serve unified locally; decode-pool error (a replica dying
+        mid-migration, an import fault) → full re-prefill on a
+        surviving decode replica, then locally as the last resort."""
+        import asyncio
+
+        import ray_tpu
+
+        t_start = time.perf_counter()
+        try:
+            pre = await asyncio.wrap_future(self.engine.submit(
+                request["prompt"], max_new_tokens=1,
+                temperature=request.get("temperature", 0.0),
+                eos_id=request.get("eos_id"), prefill_only=True))
+        except Exception:  # noqa: BLE001 - export window faults
+            self._pd_fallbacks += 1
+            return await self._local_generate(request, t_start,
+                                              "export_failed")
+        exp = pre.get("kv_export")
+        eos = request.get("eos_id")
+        if exp is None or (eos is not None and pre["tokens"]
+                           and pre["tokens"][-1] == eos):
+            return {"tokens": pre["tokens"], "ttft_s": pre["ttft_s"],
+                    "total_s": time.perf_counter() - t_start}
+        loop = asyncio.get_running_loop()
+
+        def _put():
+            t0 = time.perf_counter()
+            r = ray_tpu.put(exp["kv"])
+            return r, (time.perf_counter() - t0) * 1000.0
+
+        # put() may block on arena allocation — keep it off the event
+        # loop (same rule as every blocking framework call here).
+        ref, put_ms = await loop.run_in_executor(None, _put)
+        self._migrations += 1
+        self._kv_migrate_bytes += exp["kv"].nbytes
+        self._kv_migrate_put_ms += put_ms
+        meta = {"prompt": list(request["prompt"]),
+                "tokens": exp["tokens"], "kv_len": exp["len"],
+                "page": exp["page"], "sample_seed": exp["sample_seed"],
+                "max_new_tokens": request.get("max_new_tokens", 32),
+                "temperature": request.get("temperature", 0.0),
+                "eos_id": eos}
+        # The arena now holds the KV; drop the host copy BEFORE the
+        # decode await (seconds per request) or every in-flight
+        # migration carries its prompt KV twice.
+        pre.pop("kv_export", None)
+        exp = None
+        handle = self._get_decode_handle()
+        try:
+            out = await self._decode_kv_handle.remote(meta, ref)
+            return {"tokens": out["tokens"], "ttft_s": pre["ttft_s"],
+                    "total_s": time.perf_counter() - t_start,
+                    "disagg": True}
+        except Exception:  # noqa: BLE001 - decode pool failed
+            self._pd_fallbacks += 1
+            del ref            # free the orphaned KV object
+            try:
+                out = await handle.remote({**request, "disagg": False})
+                out["pd_fallback"] = "full_reprefill"
+                return out
+            except Exception:  # noqa: BLE001 - decode pool gone
+                return await self._local_generate(request, t_start,
+                                                  "local")
+
+    def kv_decode(self, meta: dict, kv_ref) -> dict:
+        """Decode-pool entry point: pull the migrated KV object (the
+        ref arrives nested in the request args, so the pull happens
+        HERE — same-host via the direct-shm/arena-view path, cross-node
+        via chunked streaming), import it into this engine's pool, and
+        run the decode phase to completion."""
+        import ray_tpu
+        from ray_tpu.object_ref import ObjectRef
+
+        t0 = time.perf_counter()
+        blob = kv_ref
+        if isinstance(blob, ObjectRef):
+            blob = ray_tpu.get(blob)
+        blob = np.asarray(blob)
+        pull_ms = (time.perf_counter() - t0) * 1000.0
+        fut = self.engine.kv_import(
+            meta["prompt"], meta["tokens"], blob,
+            kv_len=meta["kv_len"],
+            max_new_tokens=meta.get("max_new_tokens", 32),
+            temperature=meta.get("temperature", 0.0),
+            eos_id=meta.get("eos_id"),
+            sample_seed=meta.get("sample_seed", 0))
+        with self._pd_lock:
+            self._kv_pull_bytes += blob.nbytes
+            self._kv_pull_ms += pull_ms
+        del blob, kv_ref       # the engine holds the view until scatter
+        out = fut.result()
+        out["migrated"] = True
+        return out
+
+    def kv_check(self) -> dict:
+        """Assert the engine's block-state partition (test/ops probe):
+        raises if any block is leaked or double-booked."""
+        mgr = self.engine._mgr
+        if mgr is None:
+            return {"ok": True, "paged": False}
+        mgr.check()
+        return {"ok": True, "free": mgr.free_count(),
+                "available": mgr.available()}
+
     async def __call__(self, request: dict) -> dict:
         import asyncio
 
+        if self._disagg(request):
+            return await self._prefill_decode(request)
         fut = self.engine.submit(
             request["prompt"],
             max_new_tokens=request.get("max_new_tokens", 32),
@@ -1107,7 +1615,17 @@ class LLMServer:
             raise exc
 
     def stats(self) -> dict:
-        return self.engine.stats()
+        out = self.engine.stats()
+        out["pd"] = {
+            "role": self._role,
+            "migrations": self._migrations,
+            "fallbacks": self._pd_fallbacks,
+            "kv_migrate_bytes": self._kv_migrate_bytes,
+            "kv_migrate_put_ms": round(self._kv_migrate_put_ms, 3),
+            "kv_pull_bytes": self._kv_pull_bytes,
+            "kv_pull_ms": round(self._kv_pull_ms, 3),
+        }
+        return out
 
     def reconfigure(self, user_config: dict) -> None:
         """Apply engine knobs from a declarative config without a code
@@ -1129,17 +1647,48 @@ class LLMServer:
                 f"unknown engine_config keys {sorted(unknown)}; "
                 f"valid: {sorted(allowed)}")
         cfg = dict(user_config)
+        # Pool-role knobs live on the SERVER, not the engine: applying
+        # them never costs an engine rebuild.  Validate the WHOLE new
+        # configuration before mutating anything — a rejected
+        # reconfigure must leave the server exactly as it was.
+        new_role = cfg.pop("role", None) or self._role
+        dd_given = cfg.pop("decode_deployment", None)
+        new_dd = self._decode_dep if dd_given is None else dd_given
+        if new_role != "prefill" and dd_given is None:
+            # Moving away from prefill sheds an inherited decode
+            # target (there is no explicit clear syntax); an EXPLICIT
+            # target with a non-prefill role is still rejected below.
+            new_dd = None
+        _check_pool_role(new_role, new_dd)
         if "kv_blocks" in cfg:
             cfg["kv_pages"] = cfg.pop("kv_blocks")
         kwargs = {**self._engine_kwargs, **cfg}
+        if new_role == "prefill" and not kwargs.get("paged", True):
+            # Mirror the constructor's check: this combination must
+            # fail at (re)configuration, not silently serve unified.
+            raise ValueError(
+                "role='prefill' requires a paged engine (KV migration "
+                "is page-granular)")
+        def commit_roles():
+            self._role = new_role
+            if new_dd is not self._decode_dep:
+                self._decode_dep = new_dd
+                self._decode_handle = None
+                self._decode_kv_handle = None
+
         if kwargs == self._engine_kwargs:
+            commit_roles()
             return
         old = self.engine
         old.stop()
         old.abort_pending(RuntimeError(
             "LLM engine rebuilt by reconfigure; resubmit the request"))
         self._engine_kwargs = kwargs
+        # Role/handle state commits only once the rebuild succeeded: a
+        # constructor failure must not leave a half-applied role on top
+        # of the (unavoidably) stopped engine.
         self.engine = LLMEngine(self._cfg, self._params, **kwargs)
+        commit_roles()
         self.engine.start()
         if self._warmup:
             self.engine.warmup()

@@ -18,10 +18,19 @@ from ray_tpu.serve.deployment import Application
 # LLM engine memory knobs an operator may set per deployment in the
 # declarative config, without code changes (they land in the servable's
 # init kwargs — see LLMServer).  kv_blocks is the operator-facing name
-# for the page-pool size (engine kwarg kv_pages).
+# for the page-pool size (engine kwarg kv_pages).  role /
+# decode_deployment split an app's replicas into disaggregated
+# prefill/decode pools (see LLMServer pool roles).
 ENGINE_CONFIG_KEYS = {"page_size", "kv_blocks", "prefix_cache",
                       "kv_preempt", "max_batch", "max_len",
-                      "steps_per_sync"}
+                      "steps_per_sync", "role", "decode_deployment"}
+
+ENGINE_ROLES = ("unified", "prefill", "decode")
+
+# The LLMEngine's default page size: pool page_size declarations are
+# compared against it when one side of a prefill→decode edge omits the
+# knob (see _validate_pool_roles).
+_DEFAULT_PAGE_SIZE = 512
 
 
 @dataclasses.dataclass
@@ -51,7 +60,80 @@ class DeploymentSchema:
                 raise ValueError(
                     f"unknown engine_config keys {sorted(bad)}; valid: "
                     f"{sorted(ENGINE_CONFIG_KEYS)}")
+            role = ec.get("role")
+            if role is not None and role not in ENGINE_ROLES:
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: engine_config.role "
+                    f"must be one of {list(ENGINE_ROLES)}, got {role!r}")
+            dd = ec.get("decode_deployment")
+            if dd is not None and not isinstance(dd, str):
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: "
+                    f"engine_config.decode_deployment must be a "
+                    f"deployment name, got {type(dd).__name__}")
+            if dd is not None and role != "prefill":
+                # Covers role omitted too: a dangling decode target
+                # would otherwise deploy cleanly and serve unified
+                # forever with no migration and no error.
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: "
+                    f"decode_deployment only applies to role='prefill' "
+                    f"(got role={role!r})")
+            nr = d.get("num_replicas")
+            if role in ("prefill", "decode") and isinstance(nr, int) \
+                    and nr < 1:
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: a {role!r} pool "
+                    f"needs num_replicas >= 1, got {nr} (a zero-sized "
+                    f"pool cannot serve its phase)")
         return cls(**d)
+
+
+def _validate_pool_roles(app_name, deps: "list[DeploymentSchema]"):
+    """Cross-deployment pool-role checks (the per-deployment value
+    checks live in DeploymentSchema.from_dict).  A prefill pool must
+    name a decode pool it ships KV to, and when that pool is declared
+    in the same config its role must actually be 'decode' — the
+    classic misconfigurations fail at validation, not at first
+    request."""
+    roles = {}
+    pages = {}
+    for dep in deps:
+        ec = dep.engine_config or {}
+        roles[dep.name] = (ec.get("role"), ec.get("decode_deployment"))
+        if "page_size" in ec:
+            pages[dep.name] = ec["page_size"]
+    for name, (role, dd) in roles.items():
+        if role != "prefill":
+            continue
+        if dd is None:
+            raise ValueError(
+                f"app {app_name!r}: deployment {name!r} declares "
+                f"role='prefill' but no decode_deployment — a prefill "
+                f"pool with no decode pool cannot serve")
+        if dd == name:
+            raise ValueError(
+                f"app {app_name!r}: deployment {name!r} names itself "
+                f"as its decode_deployment")
+        if dd in roles and roles[dd][0] != "decode":
+            raise ValueError(
+                f"app {app_name!r}: deployment {name!r} routes decode "
+                f"to {dd!r}, whose role is "
+                f"{roles[dd][0] or 'unified'!r} (must be 'decode')")
+        if pages.get(name, _DEFAULT_PAGE_SIZE) != \
+                pages.get(dd, _DEFAULT_PAGE_SIZE):
+            # A page-size mismatch breaks the migrated-KV shape on
+            # EVERY request (import fails → permanent full-re-prefill
+            # fallback) — fail it here, not at first request.  A side
+            # that omits page_size is compared at the engine default,
+            # so declaring it on only one pool is caught too.
+            raise ValueError(
+                f"app {app_name!r}: prefill pool {name!r} "
+                f"(page_size={pages.get(name, _DEFAULT_PAGE_SIZE)}) "
+                f"and decode pool {dd!r} "
+                f"(page_size={pages.get(dd, _DEFAULT_PAGE_SIZE)}) "
+                f"must agree on page_size — migrated KV pages are "
+                f"page-granular (declare it on both or neither)")
 
 
 @dataclasses.dataclass
@@ -74,6 +156,7 @@ class ApplicationSchema:
         unknown = set(d) - known
         if unknown:
             raise ValueError(f"unknown application config keys {unknown}")
+        _validate_pool_roles(d.get("name"), deps)
         return cls(deployments=deps, **d)
 
     def load(self) -> Application:

@@ -1,0 +1,184 @@
+"""Cache-aware routing units: chained prefix hashing (process-stable),
+the BlockManager prefix summary, the locality scorer, and the
+DeploymentHandle._pick integration (fallback to power-of-two, capacity
+discipline, kill switch).  Pure host Python — no jax, no runtime.
+"""
+import subprocess
+import sys
+
+from ray_tpu.serve import kv_router
+from ray_tpu.serve.kv_blocks import BlockManager
+
+PROMPT = [(i * 11 + 5) % 97 + 1 for i in range(32)]
+
+
+def test_chain_hash_stable_across_processes():
+    """The router and the replicas hash in different processes; Python's
+    hash() is seed-randomized per process, so the scheme must NOT rest
+    on it.  A child interpreter must produce the identical chain."""
+    here = kv_router.prompt_hashes(PROMPT, 8)
+    assert len(here) == 4
+    code = (
+        "from ray_tpu.serve import kv_router\n"
+        f"print(kv_router.prompt_hashes({PROMPT!r}, 8))\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, check=True)
+    assert eval(out.stdout.strip()) == here  # noqa: S307 - our output
+
+
+def test_prompt_hashes_block_granular_and_chained():
+    hs = kv_router.prompt_hashes(PROMPT, 8)
+    # Partial trailing chunks never hash (the radix tree can't cache
+    # a partial page).
+    assert kv_router.prompt_hashes(PROMPT[:15], 8) == hs[:1]
+    assert kv_router.prompt_hashes(PROMPT[:7], 8) == []
+    # Chained: block i commits to the whole prefix — change one token
+    # in block 0 and EVERY downstream hash moves.
+    mutated = [PROMPT[0] + 1] + PROMPT[1:]
+    hs2 = kv_router.prompt_hashes(mutated, 8)
+    assert all(a != b for a, b in zip(hs, hs2))
+    # Same prefix, different suffix: shared blocks hash identically.
+    assert kv_router.prompt_hashes(PROMPT[:16] + [3, 1, 4, 1, 5, 9, 2,
+                                                  6], 8)[:2] == hs[:2]
+
+
+def test_block_manager_prefix_summary_tracks_commits():
+    mgr = BlockManager(8, 4)
+    s0 = mgr.prefix_summary()
+    assert s0["hashes"] == [] and s0["digest"] == 0
+    blocks = mgr.allocate(2)
+    mgr.commit(PROMPT[:8], blocks)
+    s1 = mgr.prefix_summary()
+    assert s1["digest"] != s0["digest"]
+    # The summary IS the chained prompt hashing — the router can match
+    # against it without any shared state beyond the page size.
+    assert set(kv_router.prompt_hashes(PROMPT[:8], 4)) <= set(s1["hashes"])
+    assert kv_router.matched_depth(
+        kv_router.prompt_hashes(PROMPT, 4),
+        frozenset(s1["hashes"])) == 2
+    # Eviction flips the digest again (the cached set changed).
+    mgr.release(blocks)
+    got = mgr.allocate(8)            # forces eviction of both leaves
+    assert got is not None
+    s2 = mgr.prefix_summary()
+    assert s2["digest"] != s1["digest"] and s2["hashes"] == []
+    mgr.release(got)
+    mgr.check()
+
+
+def test_export_blocks_retains_and_caps():
+    import pytest
+
+    mgr = BlockManager(8, 4)
+    blocks = mgr.allocate(3)
+    ids = mgr.export_blocks(blocks, 9)   # 9 tokens → 3 pages of 4... no:
+    # ceil(9/4) = 3 blocks — all of them, each now at refcount 2.
+    assert ids == blocks
+    mgr.release(ids)
+    mgr.release(blocks)
+    mgr.check()
+    assert mgr.free_count() == 8
+    b2 = mgr.allocate(1)
+    with pytest.raises(ValueError):
+        mgr.export_blocks(b2, 100)       # more tokens than blocks cover
+    mgr.release(b2)
+    mgr.check()
+
+
+def _summary_for(tokens, page=8):
+    hs = kv_router.prompt_hashes(tokens, page)
+    return {"page": page, "set": frozenset(hs),
+            "digest": kv_router.summary_digest(hs)}
+
+
+def test_choose_prefers_deepest_match_discounted_by_queue():
+    summaries = {"a": _summary_for(PROMPT),          # 4 blocks cached
+                 "b": _summary_for(PROMPT[:16])}     # 2 blocks cached
+    # Idle: deeper match wins.
+    assert kv_router.choose(PROMPT, ["a", "b"], {}, summaries) == "a"
+    # Queue discount: a's 2-block lead erased by 3 extra in-flight.
+    assert kv_router.choose(PROMPT, ["a", "b"],
+                            {"a": 3, "b": 0}, summaries) == "b"
+    # An unmatched idle replica beats a drowning matched one (score 0
+    # vs negative) — locality must not create a hotspot.
+    summaries2 = {"a": _summary_for(PROMPT)}
+    assert kv_router.choose(PROMPT, ["a", "c"],
+                            {"a": 9}, summaries2) == "c"
+    # No candidate matches at all → None (caller falls back to pow-2).
+    other = [7] * 32
+    assert kv_router.choose(other, ["a", "b"], {}, summaries) is None
+    # Candidates filter: the deep match excluded (at capacity / failed)
+    # leaves the shallow one.
+    assert kv_router.choose(PROMPT, ["b"], {}, summaries) == "b"
+
+
+def test_compile_summary_rejects_garbage():
+    assert kv_router.compile_summary(None) is None
+    assert kv_router.compile_summary({"page": 0, "hashes": []}) is None
+    assert kv_router.compile_summary("x") is None
+    s = kv_router.compile_summary({"page": 8, "hashes": [1, 2],
+                                   "digest": 3})
+    assert s["set"] == frozenset((1, 2))
+
+
+def test_extract_prompt_only_from_prompt_shaped_payloads():
+    assert kv_router.extract_prompt(({"prompt": [1, 2]},), {}) == [1, 2]
+    assert kv_router.extract_prompt((), {"request": {"prompt": (3,)}}) \
+        == (3,)
+    assert kv_router.extract_prompt((41,), {}) is None
+    assert kv_router.extract_prompt(({"prompt": "text"},), {}) is None
+
+
+def _fake_handle(summaries, inflight, replicas=("a", "b"),
+                 max_ongoing=0):
+    """A DeploymentHandle with injected membership/summaries — _pick
+    never touches the runtime, so the routing decision is unit-testable
+    without a controller."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h = DeploymentHandle("dep", "app", "ctrl-id")
+    h._replicas = list(replicas)
+    h._handles = {r: object() for r in replicas}
+    h._inflight = dict(inflight)
+    h._max_ongoing = max_ongoing
+    h._summaries = summaries
+    return h
+
+
+def test_handle_pick_routes_to_cached_replica(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_CACHE_ROUTER", raising=False)
+    h = _fake_handle({"b": _summary_for(PROMPT)}, {"a": 0, "b": 0})
+    for _ in range(5):
+        rid, _ = h._pick(prompt=PROMPT)
+        assert rid == "b"
+        h._done(rid)
+
+
+def test_handle_pick_kill_switch_restores_pow2(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CACHE_ROUTER", "0")
+    # b holds the prefix but is loaded; pow-2 must pick idle a.
+    h = _fake_handle({"b": _summary_for(PROMPT)}, {"a": 0, "b": 5})
+    rid, _ = h._pick(prompt=PROMPT)
+    assert rid == "a"
+    h._done(rid)
+    # Switch back on in the same process: locality resumes (same-run
+    # A/B is the kill switch's whole point).
+    monkeypatch.delenv("RAY_TPU_CACHE_ROUTER")
+    h._inflight = {"a": 0, "b": 1}
+    rid2, _ = h._pick(prompt=PROMPT)
+    assert rid2 == "b"
+
+
+def test_handle_pick_capacity_overrides_locality(monkeypatch):
+    """The preferred (cached) replica at max_ongoing_requests is NOT a
+    candidate: the request routes to the other replica rather than
+    queueing behind locality."""
+    monkeypatch.delenv("RAY_TPU_CACHE_ROUTER", raising=False)
+    h = _fake_handle({"b": _summary_for(PROMPT)},
+                     {"a": 0, "b": 2}, max_ongoing=2)
+    rid, _ = h._pick(prompt=PROMPT)
+    assert rid == "a"
+    # Capacity freed → locality wins again.
+    h._inflight["b"] = 1
+    rid2, _ = h._pick(prompt=PROMPT)
+    assert rid2 == "b"

@@ -244,7 +244,7 @@ def test_llm_deployment_through_serve(serve_instance):
     LLMDeployment = serve.deployment(serve.LLMServer).options(
         name="llm", num_replicas=1)
     h = serve.run(LLMDeployment.bind(cfg, max_batch=2, max_len=64,
-                                     seed=11),
+                                     seed=11, page_size=8),
                   name="llm_app", route_prefix="/llm")
     futs = [h.remote({"prompt": [3 + i, 1, 4], "max_new_tokens": 5})
             for i in range(4)]
@@ -254,12 +254,23 @@ def test_llm_deployment_through_serve(serve_instance):
         assert r["ttft_s"] > 0
     # Engine counters surface through the serve state API (round 8):
     # replica get_metrics carries the user callable's stats() dict.
-    rm = serve.replica_metrics("llm_app")
+    rm = serve.replica_metrics("llm_app", deployment="llm")
     replicas = rm["llm_app"]["llm"]
     assert replicas
     stats = next(iter(replicas.values()))["user_stats"]
     assert stats["completed"] >= 4
     assert "prefix_hit_tokens" in stats
+    # The prefix-summary digest (round 11, cache-aware routing) rides
+    # the same path and must UPDATE once serving commits a full block:
+    # the 3-token prompts above commit nothing (page_size=8)...
+    digest0 = stats["kv"]["prefix_summary"]["digest"]
+    assert digest0 == 0
+    # ...and a 12-token prompt commits one block, moving the digest.
+    h.remote({"prompt": list(range(1, 13)),
+              "max_new_tokens": 3}).result(timeout_s=120)
+    rm2 = serve.replica_metrics("llm_app", deployment="llm")
+    stats2 = next(iter(rm2["llm_app"]["llm"].values()))["user_stats"]
+    assert stats2["kv"]["prefix_summary"]["digest"] != digest0
     serve.delete("llm_app")
 
 

@@ -95,3 +95,111 @@ class TestSchemaOverridesApply:
         app2 = ApplicationSchema.from_dict(base).load()
         node2 = next(iter(app2._walk({})))
         assert node2.deployment.config.num_replicas != 3
+
+
+class TestPoolRoleValidation:
+    """Disaggregated prefill/decode pool roles (round 11): value checks
+    per deployment, combination checks across the app's pools."""
+
+    BASE = {"name": "a", "import_path": "m:x"}
+
+    def _app(self, deployments):
+        return ApplicationSchema.from_dict(
+            {**self.BASE, "deployments": deployments})
+
+    def test_valid_pd_pools_round_trip(self):
+        app = self._app([
+            {"name": "pre", "num_replicas": 2,
+             "engine_config": {"role": "prefill",
+                               "decode_deployment": "dec",
+                               "page_size": 64}},
+            {"name": "dec", "num_replicas": 4,
+             "engine_config": {"role": "decode", "page_size": 64}},
+        ])
+        assert app.deployments[0].engine_config["role"] == "prefill"
+        assert app.deployments[1].engine_config["role"] == "decode"
+
+    def test_bad_role_value_rejected(self):
+        with pytest.raises(ValueError, match="engine_config.role"):
+            DeploymentSchema.from_dict(
+                {"name": "d", "engine_config": {"role": "shard"}})
+
+    def test_prefill_without_decode_pool_rejected(self):
+        with pytest.raises(ValueError, match="no decode pool"):
+            self._app([{"name": "pre",
+                        "engine_config": {"role": "prefill"}}])
+
+    def test_decode_target_with_wrong_role_rejected(self):
+        with pytest.raises(ValueError, match="must be 'decode'"):
+            self._app([
+                {"name": "pre",
+                 "engine_config": {"role": "prefill",
+                                   "decode_deployment": "dec"}},
+                {"name": "dec",
+                 "engine_config": {"role": "unified"}},
+            ])
+
+    def test_self_decode_target_rejected(self):
+        with pytest.raises(ValueError, match="itself"):
+            self._app([{"name": "pre",
+                        "engine_config": {"role": "prefill",
+                                          "decode_deployment": "pre"}}])
+
+    def test_zero_sized_pool_rejected(self):
+        with pytest.raises(ValueError, match="num_replicas >= 1"):
+            DeploymentSchema.from_dict(
+                {"name": "dec", "num_replicas": 0,
+                 "engine_config": {"role": "decode"}})
+
+    def test_decode_deployment_on_decode_pool_rejected(self):
+        with pytest.raises(ValueError, match="only applies"):
+            DeploymentSchema.from_dict(
+                {"name": "dec",
+                 "engine_config": {"role": "decode",
+                                   "decode_deployment": "other"}})
+
+    def test_decode_deployment_without_role_rejected(self):
+        """role omitted + decode_deployment set would deploy cleanly
+        and serve unified forever — must fail at validation."""
+        with pytest.raises(ValueError, match="only applies"):
+            DeploymentSchema.from_dict(
+                {"name": "pre",
+                 "engine_config": {"decode_deployment": "dec"}})
+
+    def test_decode_deployment_must_be_a_name(self):
+        with pytest.raises(ValueError, match="deployment name"):
+            DeploymentSchema.from_dict(
+                {"name": "pre",
+                 "engine_config": {"role": "prefill",
+                                   "decode_deployment": 7}})
+
+    def test_pool_page_size_mismatch_rejected(self):
+        """Mismatched page_size between prefill and decode pools breaks
+        the migrated-KV shape on every request — fail at validation,
+        including when only ONE side declares it (the other compares
+        at the engine default)."""
+        with pytest.raises(ValueError, match="page_size"):
+            self._app([
+                {"name": "pre",
+                 "engine_config": {"role": "prefill",
+                                   "decode_deployment": "dec",
+                                   "page_size": 64}},
+                {"name": "dec",
+                 "engine_config": {"role": "decode",
+                                   "page_size": 512}},
+            ])
+        with pytest.raises(ValueError, match="page_size"):
+            self._app([
+                {"name": "pre",
+                 "engine_config": {"role": "prefill",
+                                   "decode_deployment": "dec",
+                                   "page_size": 64}},
+                {"name": "dec", "engine_config": {"role": "decode"}},
+            ])
+        # Both omitted → both run the engine default: valid.
+        self._app([
+            {"name": "pre",
+             "engine_config": {"role": "prefill",
+                               "decode_deployment": "dec"}},
+            {"name": "dec", "engine_config": {"role": "decode"}},
+        ])
