@@ -617,6 +617,129 @@ def bench_chaos_recovery() -> dict:
     return out
 
 
+def bench_train_elastic() -> dict:
+    """Elastic vs restart-loop recovery (ISSUE 8): SIGKILL rank 1 of a
+    2-worker gang mid-step under (a) the elastic membership-epoch path
+    and (b) the legacy restart loop (RAY_TPU_ELASTIC=0) — same process,
+    same cluster, same kill, checkpoint interval = 2 steps.  Rows
+    (all _ms rows lower-is-better in _vs_previous_round):
+
+      train_steps_lost_per_kill   coordinator-emitted rounds replayed
+                                  after the shrink (target: <= the
+                                  checkpoint interval, 2 here)
+      elastic_shrink_mttr_ms      failure detected -> survivors
+                                  relaunched at W-1 (no process respawn)
+      elastic_regrow_mttr_ms      bundle re-reserved -> full-W gang
+                                  relaunched (joiner bootstraps via
+                                  broadcast)
+      train_restart_mttr_ms       legacy A/B: failure detected -> whole
+                                  gang torn down and respawned
+    """
+    import os
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.train.backend_executor import BackendExecutor
+    from ray_tpu.train.checkpoint import CheckpointManager
+    from ray_tpu.train.config import FailureConfig, ScalingConfig
+
+    def loop(config):
+        import os as _os
+        import signal as _sig
+        import time as _time
+
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.train import Checkpoint
+
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        step = ckpt.to_dict()["step"] + 1 if ckpt else 0
+        state = train.host_broadcast({"step": np.int64(step)})
+        step = int(state["step"])
+        start = step
+        while step < config["total_steps"]:
+            marker = config.get("kill_marker")
+            if (marker and step == config.get("kill_at", -1)
+                    and ctx.get_world_rank() == 1
+                    and not _os.path.exists(marker)):
+                open(marker, "w").close()
+                _os.kill(_os.getpid(), _sig.SIGKILL)
+            train.host_allreduce(np.ones(4, np.float32))
+            ck = Checkpoint.from_dict({"step": step}) \
+                if step % 2 == 1 else None      # interval = 2
+            train.report({"step": step, "start": start,
+                          "world": ctx.get_world_size()}, checkpoint=ck)
+            _time.sleep(0.25)
+            step += 1
+
+    def run_leg(trial, tmp, elastic):
+        os.environ["RAY_TPU_ELASTIC"] = "1" if elastic else "0"
+        executor = BackendExecutor(
+            ScalingConfig(num_workers=2, num_cpus_per_worker=0.5),
+            failure=FailureConfig(max_failures=3), trial_name=trial)
+        manager = CheckpointManager(tmp)
+        history = []
+
+        def on_report(msgs):
+            by_rank = {m["rank"]: m for m in msgs}
+            rank0 = by_rank.get(0) or msgs[0]
+            history.append(rank0["metrics"])
+            ck = next((m["checkpoint"] for m in msgs
+                       if m.get("checkpoint")), None)
+            if ck is not None:
+                manager.register(ck, rank0["metrics"])
+
+        executor.start()
+        try:
+            executor.run(
+                loop,
+                {"total_steps": 10, "kill_at": 4,
+                 "kill_marker": os.path.join(tmp, "killed")},
+                on_report=on_report,
+                latest_checkpoint=lambda: manager.latest_checkpoint)
+        finally:
+            executor.shutdown()
+        return executor, history
+
+    out: dict = {}
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4})
+    prev_elastic = os.environ.get("RAY_TPU_ELASTIC")
+    try:
+        with tempfile.TemporaryDirectory() as tmp_e:
+            executor, history = run_leg("bench_elastic", tmp_e, True)
+            st = executor.elastic.stats
+            out["elastic_shrink_mttr_ms"] = st.get(
+                "elastic_shrink_mttr_ms")
+            out["elastic_regrow_mttr_ms"] = st.get(
+                "elastic_regrow_mttr_ms")
+            out["elastic_transitions"] = [t["kind"]
+                                          for t in st["transitions"]]
+            pre = [m["step"] for m in history
+                   if m["world"] == 2 and m["start"] == 0]
+            shrink_start = next((m["start"] for m in history
+                                 if m["world"] == 1), None)
+            if shrink_start is not None and pre:
+                out["train_steps_lost_per_kill"] = max(
+                    0, max(pre) + 1 - shrink_start)
+        with tempfile.TemporaryDirectory() as tmp_l:
+            executor, history = run_leg("bench_legacy", tmp_l, False)
+            out["train_restart_mttr_ms"] = executor.restart_mttr_ms
+    except Exception as e:  # noqa: BLE001 - partial rows beat no rows
+        out["train_elastic_error"] = repr(e)
+    finally:
+        if prev_elastic is None:
+            os.environ.pop("RAY_TPU_ELASTIC", None)
+        else:
+            os.environ["RAY_TPU_ELASTIC"] = prev_elastic
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+    return out
+
+
 def bench_collective() -> dict:
     """Same-run A/B of the DCN collective plane (ISSUE 5): 3 ranks
     pinned to 3 in-process cluster nodes (real per-node arenas; the
@@ -1551,6 +1674,13 @@ def main() -> None:
         extra.update(_with_timeout(bench_chaos_recovery, 640))
     except Exception as e:  # noqa: BLE001
         extra["chaos_recovery_error"] = repr(e)
+    _flush_partial(extra)
+    try:
+        # Two ~10-step train legs (elastic + legacy A/B) on one local
+        # cluster; worker spawn + jax import in fresh gangs dominates.
+        extra.update(_with_timeout(bench_train_elastic, 420))
+    except Exception as e:  # noqa: BLE001
+        extra["train_elastic_error"] = repr(e)
     _flush_partial(extra)
     try:
         extra.update(_with_timeout(bench_compiled_dag, 300))

@@ -437,13 +437,20 @@ class Controller:
         await self.publisher.publish(
             "node", {"event": "dead", "node_id": node.node_id,
                      "agent_addr": node.agent_addr})
-        # Release PG bundles on the dead node.
+        # Release PG bundles on the dead node.  PENDING groups (mid-
+        # initial-schedule, or flipped back by pg_reschedule) must also
+        # drop their dead-node entries: their live scheduler task only
+        # re-places bundles MISSING from bundle_nodes, so a stale entry
+        # pointing at the corpse would never be re-reserved.
         for pg in self.pgs.values():
-            if pg.state == "CREATED" and node.node_id in pg.bundle_nodes.values():
-                pg.state = "PENDING"
+            if pg.state in ("CREATED", "PENDING") \
+                    and node.node_id in pg.bundle_nodes.values():
                 pg.bundle_nodes = {i: n for i, n in pg.bundle_nodes.items()
                                    if n != node.node_id}
-                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+                if pg.state == "CREATED":
+                    pg.state = "PENDING"
+                    asyncio.get_running_loop().create_task(
+                        self._schedule_pg(pg))
         # Restart or fail actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state == ALIVE:
@@ -846,6 +853,39 @@ class Controller:
         if pg is not None:
             self._remove_pg(pg)
         return {}
+
+    async def rpc_pg_release_bundles(self, h: dict, _b: list) -> dict:
+        """Eagerly release SPECIFIC bundles of a live placement group
+        (elastic train shrink): a dead worker's reservation must not sit
+        on its agent until trial end — the autoscaler and the regrow
+        path need to see honest free capacity.  Bundles whose node
+        already died (and was popped by _on_node_dead) are a no-op."""
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None or pg.state == "REMOVED":
+            return {"ok": False, "released": []}
+        released = [(i, pg.bundle_nodes.pop(i))
+                    for i in h["bundle_indexes"] if i in pg.bundle_nodes]
+        if released:
+            await self._release_pg_bundles(pg.pg_id, released)
+        return {"ok": True, "released": [i for i, _ in released]}
+
+    async def rpc_pg_reschedule(self, h: dict, _b: list) -> dict:
+        """Re-reserve a placement group's missing bundles (elastic train
+        regrow): flips a CREATED-with-holes group back to PENDING and
+        re-runs the bundle scheduler; pg_ready reports CREATED again
+        once every hole is filled.  Idempotent — a group already PENDING
+        has a live scheduler task that re-computes the missing set every
+        pass, so no second task is spawned."""
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None:
+            return {"state": "UNKNOWN", "missing": []}
+        missing = [i for i in range(len(pg.bundles))
+                   if i not in pg.bundle_nodes]
+        if missing and pg.state == "CREATED":
+            pg.state = "PENDING"
+            asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        self._pg_retry.set()
+        return {"state": pg.state, "missing": missing}
 
     def _remove_pg(self, pg: PlacementGroupInfo) -> None:
         pg.state = "REMOVED"

@@ -24,6 +24,7 @@ from ray_tpu.collective.collective import (CollectiveWork, allgather,
                                            allreduce_async, barrier,
                                            broadcast, broadcast_async,
                                            create_collective_group,
+                                           deregister_collective_group,
                                            destroy_collective_group,
                                            get_rank, get_collective_group_size,
                                            init_collective_group, recv,
@@ -32,7 +33,8 @@ from ray_tpu.collective.collective import (CollectiveWork, allgather,
 
 __all__ = [
     "init_collective_group", "create_collective_group",
-    "destroy_collective_group", "allreduce", "allgather", "reducescatter",
+    "destroy_collective_group", "deregister_collective_group",
+    "allreduce", "allgather", "reducescatter",
     "broadcast", "barrier", "send", "recv", "get_rank",
     "get_collective_group_size", "allreduce_async", "allgather_async",
     "reducescatter_async", "broadcast_async", "CollectiveWork",

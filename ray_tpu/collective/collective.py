@@ -328,9 +328,26 @@ def create_collective_group(actors: list, world_size: int, ranks: list[int],
     ray_tpu.get(refs)
 
 
-def destroy_collective_group(group_name: str = "default") -> None:
+def deregister_collective_group(group_name: str = "default") -> None:
+    """Local-only teardown: drop THIS process's group state (op threads,
+    prefetch pool) without touching the shared rendezvous.  The elastic
+    train path uses it at a membership-epoch change: the DRIVER destroys
+    the stale epoch's group cluster-wide (draining parked waiters);
+    each surviving worker only needs to forget its local handle before
+    joining the next epoch's group."""
+    with _registry_lock:
+        g = _registry.pop(group_name, None)
+    if g is not None:
+        g.close()
+
+
+def destroy_collective_group(group_name: str = "default",
+                             reason: str | None = None) -> None:
     """Tear down the group cluster-wide (ray: collective.py
-    destroy_collective_group).  Call only after all ranks are done.
+    destroy_collective_group).  Call only after all ranks are done —
+    or, at an elastic epoch change, to UNPARK ranks still waiting on a
+    collective with a dead peer: `reason` becomes the diagnostic every
+    parked waiter raises (default names the destroy itself).
 
     Works from ANY process: the pre-round-10 version only killed the
     rendezvous when the calling process had the group in its local
@@ -352,7 +369,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
     if rdv is not None:
         try:
             ray_tpu.get(rdv.drain.remote(
-                f"collective group {group_name!r} destroyed"),
+                reason or f"collective group {group_name!r} destroyed"),
                 timeout=10.0)
         except Exception:  # noqa: BLE001 - best effort before the kill
             pass

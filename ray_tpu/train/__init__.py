@@ -15,10 +15,11 @@ from ray_tpu.train.config import (CheckpointConfig, DataConfig,  # noqa: F401
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.session import (get_checkpoint, get_context,  # noqa: F401
                                    get_dataset_shard, host_allreduce,
-                                   host_allreduce_async, report)
+                                   host_allreduce_async, host_broadcast,
+                                   report)
 from ray_tpu.train.step import (TrainState, create_train_state,  # noqa: F401
-                                make_train_step, sharded_init,
-                                sharded_train_step)
+                                make_train_step, reshard_state,
+                                sharded_init, sharded_train_step)
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,  # noqa: F401,E501
                                    JaxTrainer, Result)
 from ray_tpu.train import torch  # noqa: F401  (TorchTrainer lives here)

@@ -22,7 +22,8 @@ class _Session:
                  node_id: str, trial_name: str,
                  checkpoint: Checkpoint | None, config: dict,
                  dataset_shards: dict | None = None,
-                 host_group: str | None = None):
+                 host_group: str | None = None,
+                 epoch: int = 0, joined: bool = False):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -35,6 +36,17 @@ class _Session:
         # BackendExecutor formed over the workers (None for single-rank
         # runs — host_allreduce then degenerates to identity).
         self.host_group = host_group
+        # Elastic membership (ISSUE 8): the monotonically increasing
+        # epoch naming this gang roster, and whether THIS rank joined at
+        # this epoch boundary (a regrown rank bootstraps its parameters
+        # from rank 0 via host_broadcast instead of a checkpoint
+        # reload).  epoch_abort marks an incarnation interrupted at an
+        # epoch barrier: its unwind fallout (StopIteration escaping, a
+        # collective erroring on the drained group) is transition
+        # mechanics, not a training failure.
+        self.epoch = epoch
+        self.joined = joined
+        self.epoch_abort = False
         self.out: queue.Queue = queue.Queue(maxsize=8)
         self.stop_event = threading.Event()
 
@@ -113,6 +125,11 @@ def host_allreduce_async(value, op: str = "sum"):
     from ray_tpu import collective as col
 
     s = get_session()
+    if s.stop_event.is_set():
+        # Epoch-aware: a survivor parked at an elastic epoch barrier (or
+        # a coordinator stop) must unwind NOW, not submit into a group
+        # the driver is about to drain and destroy.
+        raise StopIteration("training stopped by the coordinator")
     if s.host_group is None or s.world_size <= 1:
         class _Done:
             def __init__(self, v):
@@ -129,6 +146,41 @@ def host_allreduce_async(value, op: str = "sum"):
                 return True
         return _Done(value)
     return col.allreduce_async(value, group_name=s.host_group, op=op)
+
+
+def host_broadcast(tree, src_rank: int = 0):
+    """Broadcast a pytree of host arrays from `src_rank` across the
+    trainer's gang (tree schedule over the DCN collective plane) and
+    return it with rank `src_rank`'s leaf values everywhere.
+
+    This is the elastic bootstrap (ISSUE 8): every rank calls it with a
+    same-STRUCTURE tree right after building/restoring its initial
+    state — a rank that JOINED the gang at this membership epoch
+    receives the current parameters (and step counter) from rank 0
+    instead of reloading a checkpoint, so regrow works even when the
+    replacement host does not share the checkpoint filesystem.  For
+    single-rank runs it degenerates to a defensive copy."""
+    import jax
+    import numpy as np
+
+    from ray_tpu import collective as col
+    from ray_tpu import failpoints
+
+    s = get_session()
+    if s.stop_event.is_set():
+        raise StopIteration("training stopped by the coordinator")
+    if failpoints.ACTIVE and s.joined:
+        # Failpoint window: a JOINING rank mid-parameter-broadcast
+        # (crash = the epoch must abort cleanly back to the surviving
+        # roster; delay = slow join observable in regrow MTTR).
+        failpoints.fire("train.rank_join")
+    leaves, treedef = jax.tree.flatten(tree)
+    if s.host_group is None or s.world_size <= 1:
+        return jax.tree.unflatten(
+            treedef, [np.array(np.asarray(x), copy=True) for x in leaves])
+    out = [col.broadcast(np.asarray(x), src_rank=src_rank,
+                         group_name=s.host_group) for x in leaves]
+    return jax.tree.unflatten(treedef, out)
 
 
 class TrainContext:
@@ -148,6 +200,18 @@ class TrainContext:
 
     def get_trial_name(self) -> str:
         return get_session().trial_name
+
+    def get_epoch(self) -> int:
+        """Membership epoch of the current gang roster (ISSUE 8): bumps
+        on every elastic shrink/regrow; 0 for the initial gang and for
+        the whole run when elastic is off."""
+        return get_session().epoch
+
+    def get_joined(self) -> bool:
+        """True iff THIS rank joined the gang at the current epoch
+        boundary (a regrown replacement, expected to bootstrap its
+        state via host_broadcast rather than a checkpoint reload)."""
+        return get_session().joined
 
 
 def get_context() -> TrainContext:

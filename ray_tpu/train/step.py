@@ -150,6 +150,22 @@ def sharded_init(key: jax.Array, cfg: llama.LlamaConfig,
         return init(key)
 
 
+def reshard_state(state, cfg: llama.LlamaConfig,
+                  optimizer: optax.GradientTransformation,
+                  mesh: Mesh):
+    """Re-lay a TrainState pytree (host arrays from a checkpoint, or
+    arrays sharded for a DIFFERENT mesh) onto `mesh` via the logical-axis
+    rules — the elastic resume hook (ISSUE 8): after a membership-epoch
+    world-size change the physical mesh changed but the logical table
+    didn't, so a device_put of every leaf to its new NamedSharding is the
+    whole resharding story.  Deterministic: same checkpoint + same mesh
+    => bit-identical device state regardless of the world size it was
+    saved under."""
+    st_sh = state_shardings(cfg, mesh, optimizer)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(jnp.asarray(x), s), state, st_sh)
+
+
 def sharded_train_step(cfg: llama.LlamaConfig,
                        optimizer: optax.GradientTransformation,
                        mesh: Mesh, loss_fn: Callable | None = None,

@@ -71,16 +71,18 @@ class TrainWorker:
                        world_rank: int, world_size: int, local_rank: int,
                        trial_name: str, checkpoint=None,
                        dataset_shards: dict | None = None,
-                       host_group: str | None = None) -> bool:
+                       host_group: str | None = None,
+                       epoch: int = 0, joined: bool = False) -> bool:
         self._finished = False
         self._error = None
         self._result = None
-        self._session = session_mod.init_session(
+        self._session = sess = session_mod.init_session(
             world_rank=world_rank, world_size=world_size,
             local_rank=local_rank,
             node_id=ray_tpu.get_runtime_context().get_node_id(),
             trial_name=trial_name, checkpoint=checkpoint, config=config,
-            dataset_shards=dataset_shards, host_group=host_group)
+            dataset_shards=dataset_shards, host_group=host_group,
+            epoch=epoch, joined=joined)
 
         def run():
             try:
@@ -92,7 +94,12 @@ class TrainWorker:
             except StopIteration:
                 pass
             except BaseException:  # noqa: BLE001
-                self._error = traceback.format_exc()
+                # An incarnation interrupted at an elastic epoch barrier
+                # unwinds however it can (collective error on the
+                # drained group, StopIteration escaping a generator...):
+                # that fallout is transition mechanics, not a failure.
+                if not sess.epoch_abort:
+                    self._error = traceback.format_exc()
             finally:
                 # Async checkpoint writes must land before the loop is
                 # declared done: an unflushed background save would race
@@ -104,10 +111,10 @@ class TrainWorker:
 
                     ckpt_mod.flush_pending_writes()
                 except Exception:  # noqa: BLE001
-                    if self._error is None:
+                    if self._error is None and not sess.epoch_abort:
                         self._error = traceback.format_exc()
                 self._finished = True
-                self._session.out.put({"type": "done"})
+                sess.out.put({"type": "done"})
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -127,6 +134,67 @@ class TrainWorker:
             return None
         return msg
 
+    # ------------------------------------------------------ elastic epochs
+    def park_at_barrier(self, epoch: int) -> bool:
+        """First half of an elastic epoch transition (ISSUE 8): stop the
+        running train fn at its next session touchpoint (report /
+        host_allreduce / host_broadcast all raise StopIteration once the
+        stop flag is up) and mark the incarnation as epoch-aborted so
+        its unwind fallout never reads as a training failure.  The
+        driver destroys the stale collective group right after this
+        call, which unparks any rank blocked inside a collective."""
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            # Failpoint window: a survivor parking at the epoch barrier
+            # (crash = the survivor dies mid-transition and the driver
+            # must shrink further; delay = slow barrier, visible in
+            # elastic_shrink_mttr_ms).
+            failpoints.fire("train.epoch_barrier")
+        s = self._session
+        if s is not None:
+            s.epoch_abort = True
+            s.stop_event.set()
+            # Unjam a report() blocked on the bounded outbound queue.
+            import queue as q
+
+            try:
+                while True:
+                    s.out.get_nowait()
+            except q.Empty:
+                pass
+        return True
+
+    def join_train(self, timeout: float = 20.0) -> dict:
+        """Second half of the barrier: wait (bounded) for the train-fn
+        thread to exit, draining the outbound queue so a blocked report
+        can finish, then forget the stale epoch's collective group
+        locally (the driver already destroyed the shared rendezvous).
+        parked=False means the thread is wedged past the deadline — the
+        driver treats that worker as lost."""
+        import queue as q
+        import time as _t
+
+        t = self._thread
+        s = self._session
+        deadline = _t.monotonic() + timeout
+        while t is not None and t.is_alive() and _t.monotonic() < deadline:
+            if s is not None:
+                try:
+                    while True:
+                        s.out.get_nowait()
+                except q.Empty:
+                    pass
+            t.join(timeout=0.1)
+        parked = t is None or not t.is_alive()
+        if s is not None and s.host_group:
+            from ray_tpu import collective as col
+
+            col.deregister_collective_group(s.host_group)
+        import os
+
+        return {"parked": parked, "pid": os.getpid()}
+
     def get_status(self) -> dict:
         return {"finished": self._finished, "error": self._error}
 
@@ -140,7 +208,13 @@ class TrainWorker:
 
 
 class WorkerGroup:
-    """Owns the PG + actors.  `execute` fans a callable to all workers."""
+    """Owns the PG + actors.  `execute` fans a callable to all workers.
+
+    Elastic epochs (ISSUE 8) patch the group IN PLACE: `remove_worker`
+    kills a slot's actor and eagerly releases its PG bundle (honest
+    free capacity for the autoscaler and the regrow path);
+    `restore_worker` places a fresh actor on a re-reserved bundle.
+    Removed slots hold None — `execute` fans over live workers only."""
 
     def __init__(self, num_workers: int, bundles: list[dict],
                  strategy: str = "PACK",
@@ -163,20 +237,67 @@ class WorkerGroup:
 
     def execute(self, method: str, *args, _timeout: float | None = None,
                 **kwargs) -> list:
-        """Call `method` on every worker, gather results."""
+        """Call `method` on every live worker, gather results."""
         return ray_tpu.get([getattr(w, method).remote(*args, **kwargs)
-                            for w in self.workers], timeout=_timeout)
+                            for w in self.workers if w is not None],
+                           timeout=_timeout)
 
     def execute_async(self, method: str, *args, **kwargs) -> list:
         return [getattr(w, method).remote(*args, **kwargs)
-                for w in self.workers]
+                for w in self.workers if w is not None]
 
     def execute_single(self, idx: int, method: str, *args, **kwargs):
         return ray_tpu.get(
             getattr(self.workers[idx], method).remote(*args, **kwargs))
 
+    # ------------------------------------------------------ elastic patching
+    def remove_worker(self, idx: int, release_bundle: bool = True) -> None:
+        """Drop one slot: kill its actor (no-op if already dead) and
+        eagerly release its PG bundle so the reservation doesn't sit on
+        the agent until trial end (ISSUE-8 satellite — the autoscaler /
+        regrow path must see honest free capacity)."""
+        w = self.workers[idx]
+        self.workers[idx] = None
+        if w is not None:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+        if release_bundle:
+            try:
+                from ray_tpu.utils.placement_group import release_bundles
+
+                release_bundles(self.pg, [idx])
+            except Exception:  # noqa: BLE001 - node already reaped it
+                pass
+
+    def reschedule_lost_bundles(self) -> str:
+        """Kick the controller's bundle scheduler for released slots
+        (regrow step 1); returns the PG state."""
+        from ray_tpu.utils.placement_group import \
+            reschedule_placement_group
+
+        return reschedule_placement_group(self.pg)
+
+    def pg_state(self) -> str:
+        from ray_tpu.utils.placement_group import placement_group_state
+
+        return placement_group_state(self.pg)
+
+    def restore_worker(self, idx: int):
+        """Place a fresh TrainWorker on slot `idx`'s (re-reserved)
+        bundle; the caller must confirm liveness before trusting it."""
+        assert self.workers[idx] is None, f"slot {idx} still occupied"
+        cls = ray_tpu.remote(TrainWorker)
+        w = cls.options(num_cpus=0, placement_group=self.pg,
+                        placement_group_bundle_index=idx).remote()
+        self.workers[idx] = w
+        return w
+
     def shutdown(self) -> None:
         for w in self.workers:
+            if w is None:
+                continue
             try:
                 ray_tpu.kill(w)
             except Exception:  # noqa: BLE001

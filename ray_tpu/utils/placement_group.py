@@ -126,6 +126,60 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     core.call_nowait(core.controller_addr, "remove_pg", {"pg_id": pg.id})
 
 
+def release_bundles(pg: PlacementGroup, bundle_indexes: list[int]) -> list:
+    """Eagerly release specific bundles of a live PG (elastic train
+    shrink: a dead worker's reservation must not block the autoscaler /
+    regrow path until trial end).  Returns the indexes actually
+    released; bundles already gone (dead node) are skipped."""
+    from ray_tpu import client as client_mod
+    from ray_tpu._private.worker import global_worker
+
+    if client_mod._ctx is not None:
+        raise NotImplementedError(
+            "per-bundle PG patching is not proxied in client mode")
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "pg_release_bundles",
+                         {"pg_id": pg.id,
+                          "bundle_indexes": list(bundle_indexes)},
+                         timeout=30.0)
+    return reply.get("released", [])
+
+
+def reschedule_placement_group(pg: PlacementGroup) -> str:
+    """Ask the controller to re-reserve a PG's missing bundles (elastic
+    regrow); returns the group's state after kicking the scheduler
+    (PENDING until the holes fill, then CREATED via pg_ready)."""
+    from ray_tpu import client as client_mod
+    from ray_tpu._private.worker import global_worker
+
+    if client_mod._ctx is not None:
+        raise NotImplementedError(
+            "per-bundle PG patching is not proxied in client mode")
+    core = global_worker()
+    pg._created = False          # ready() must re-ask the controller
+    reply, _ = core.call(core.controller_addr, "pg_reschedule",
+                         {"pg_id": pg.id}, timeout=30.0)
+    return reply.get("state", "UNKNOWN")
+
+
+def placement_group_state(pg: PlacementGroup) -> str:
+    """Non-blocking state probe (the regrow poll): CREATED / PENDING /
+    REMOVED / UNKNOWN, without pg.ready()'s wait-for-created block."""
+    from ray_tpu import client as client_mod
+    from ray_tpu._private.worker import global_worker
+
+    if client_mod._ctx is not None:
+        # The proxy only exposes a ready/not-ready bool, which cannot
+        # distinguish PENDING from REMOVED — refuse rather than lie
+        # (elastic runs, the only caller, are driver-side anyway).
+        raise NotImplementedError(
+            "per-bundle PG state probing is not proxied in client mode")
+    core = global_worker()
+    reply, _ = core.call(core.controller_addr, "pg_ready",
+                         {"pg_id": pg.id}, timeout=30.0)
+    return reply.get("state", "UNKNOWN")
+
+
 def get_current_placement_group() -> "PlacementGroup | None":
     """The placement group the calling task/actor runs in, or None (ray:
     util/placement_group.py get_current_placement_group).  Tasks resolve
