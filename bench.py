@@ -1553,6 +1553,167 @@ def bench_serve_cluster_route() -> dict:
             pass
 
 
+def bench_rlhf() -> dict:
+    """Online RLHF loop (round 13): three windows through the
+    in-process loop on the debug model.
+
+    (1) GRPO rollout throughput, prefix cache ON vs OFF (same-run A/B
+        via engine kwargs — the RAY_TPU_PREFIX_CACHE kill-switch
+        semantics): one shared prompt, a K-wide group.  Cache-off
+        prefills the prompt K times; cache-on prefills once and the
+        K-1 followers hit the leader's committed blocks — the
+        group-sharing claim, with the hit rate recorded.
+    (2) Update throughput: a short seeded training run through
+        rollout → GRPO update → live weight sync.
+    (3) Live weight sync: stage a policy update while a request
+        decodes; the engine swaps BETWEEN sync windows, so the row to
+        watch is stage→visible latency vs the decode window wall —
+        rlhf_weight_lag_windows ~ 1 proves decode never stalled more
+        than one sync window (and the request delivers every token:
+        never drained)."""
+    import queue as _q
+
+    import numpy as np
+
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.rl.rlhf import RLHFConfig, RLHFTrainer
+    from ray_tpu.rl.rollout_llm import LLMRolloutWorker
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model = "bench-350m" if on_tpu else "debug"
+    cfg = llama.llama_configs()[model]
+    if on_tpu:
+        shared_len, new_tokens, group, page = 384, 8, 16, 64
+        max_len, mb_ab, k = 512, 4, 4
+        max_batch = 16
+    else:
+        # Debug-scale honesty rules: a long shared prompt makes prefill
+        # the majority term (the serve_prefix_cache lesson), and
+        # max_batch < group_size forces MULTIPLE admission waves — the
+        # production regime, where cache-off pays a full-prompt prefill
+        # per wave while cache-on pays one per GROUP.  A single wave
+        # would hide the contrast behind one batched forward.
+        shared_len, new_tokens, group, page = 896, 4, 16, 64
+        max_len, mb_ab, k = 1024, 4, 4
+        max_batch = 8
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, shared_len).tolist()
+    warm_prompt = rng.integers(1, cfg.vocab_size, shared_len).tolist()
+    out: dict = {}
+
+    # ---- (1) rollout prefix-cache A/B ------------------------------
+    def run_arm(prefix_cache: bool) -> dict:
+        w = LLMRolloutWorker(
+            model, seed=0,
+            engine=dict(max_batch=mb_ab, max_len=max_len,
+                        page_size=page, steps_per_sync=k,
+                        prefix_cache=prefix_cache),
+            name=f"bench_rlhf_{int(prefix_cache)}")
+        try:
+            # Warm every program (leader full-prefill bucket, follower
+            # suffix bucket, decode widths, scorer) with a DIFFERENT
+            # prompt: compile-only warmup.  Warming with the timed
+            # prompt would pre-cache it and the timed leader would
+            # prefix-hit too — measuring cross-rollout reuse instead
+            # of the leader-prefill + follower-hit group regime this
+            # row claims.
+            w.rollout([warm_prompt], group_size=mb_ab,
+                      max_new_tokens=new_tokens)
+            t0 = time.perf_counter()
+            traj = w.rollout([prompt], group_size=group,
+                             max_new_tokens=new_tokens)
+            wall = time.perf_counter() - t0
+            toks = int(traj["total_len"].sum())
+            seen = traj["prefix_hit_tokens"] + traj["prefill_tokens"]
+            return {
+                # Generation throughput: the prefix cache's effect.
+                # The (cache-independent) behavior-logprob scoring
+                # pass is reported separately via wall/score_s.
+                "tokens_per_s": round(toks / traj["gen_s"], 1),
+                "tokens_per_s_incl_scoring": round(toks / wall, 1),
+                "gen_s": round(traj["gen_s"], 3),
+                "wall_s": round(wall, 3),
+                "prefill_tokens": traj["prefill_tokens"],
+                "prefix_hit_tokens": traj["prefix_hit_tokens"],
+                "hit_rate": round(
+                    traj["prefix_hit_tokens"] / seen, 3) if seen else 0.0,
+            }
+        finally:
+            w.stop()
+
+    on = run_arm(True)
+    off = run_arm(False)
+    out["rollout"] = {
+        "model": model, "shared_prompt_tokens": shared_len,
+        "group_size": group, "cache_on": on, "cache_off": off,
+        "speedup": round(on["tokens_per_s"]
+                         / max(off["tokens_per_s"], 1e-9), 2),
+    }
+
+    # ---- (2) update throughput + (3) live weight sync --------------
+    # One try/finally covers BOTH windows: a failure anywhere must not
+    # leak the trainer (its engine decode thread would skew every later
+    # bench section on this 1-core box).
+    tr = RLHFTrainer(RLHFConfig(
+        model=model, seed=0, n_prompts=4, prompt_len=min(96, max_len // 4),
+        group_size=4, prompts_per_step=2, max_new_tokens=4,
+        lr=1e-3, engine=dict(max_batch=max_batch, max_len=max_len,
+                             page_size=page, steps_per_sync=k)))
+    try:
+        tr.step()                      # compile warm
+        t0 = time.perf_counter()
+        n = 3
+        ms = [tr.step() for _ in range(n)]
+        wall = time.perf_counter() - t0
+        out["train"] = {
+            "updates_per_s": round(n / wall, 3),
+            "rollout_tokens_per_update": ms[-1]["rollout_tokens"],
+            "reward_mean": round(ms[-1]["reward_mean"], 4),
+            "weight_syncs": tr.weight_syncs,
+            "weight_sync_ms_avg": round(
+                tr.weight_sync_ms / max(tr.weight_syncs, 1), 3),
+        }
+        eng = tr.workers[0].engine
+
+        # ---- (3) live weight sync vs decode windows ----------------
+        q: _q.Queue = _q.Queue()
+        total = min(60, max_len - shared_len - 8)
+        fut = eng.submit(prompt[: max_len - total - 8],
+                         max_new_tokens=total, token_queue=q)
+        stamps = []
+        new_params = jax.tree.map(np.asarray, eng.params)
+        while True:
+            tok = q.get(timeout=300)
+            if tok is None:
+                break
+            stamps.append(time.perf_counter())
+            if len(stamps) == 2 * k:      # true exactly once
+                eng.update_weights(new_params)    # mid-decode stage
+        res = fut.result(timeout=300)
+        assert len(res["tokens"]) == total        # never drained
+        # Tokens land in K-sized bursts, one per sync window: window
+        # wall = gap between burst heads.
+        gaps = np.diff(np.asarray(stamps))
+        burst_gaps = np.sort(gaps)[-max(1, len(gaps) // k):]
+        window_ms = float(np.median(burst_gaps) * 1000.0)
+        sync_ms = eng.last_weight_sync_ms
+        out["weight_sync"] = {
+            "sync_visible_ms": round(sync_ms, 3),
+            "decode_window_ms": round(window_ms, 3),
+            "lag_windows": round(sync_ms / max(window_ms, 1e-9), 2),
+            "weight_updates": eng.weight_updates,
+            "tokens_delivered": len(res["tokens"]),
+        }
+    finally:
+        tr.shutdown()
+    return {"rlhf_bench": out}
+
+
 def _with_timeout(fn, seconds: int):
     """Alarm-guarded call: the chip is single-holder on this box and a
     stuck lease must not zero out the rest of the bench.  On alarm the
@@ -1610,6 +1771,12 @@ def _vs_previous_round(extra: dict) -> dict:
             v = v.get("best")
         return v if isinstance(v, (int, float)) else None
 
+    # Rows whose direction a suffix can't express (round 13): the RLHF
+    # prefix hit rate (higher is better) and the weight-sync lag in
+    # decode windows (lower is better) are the PR's headline claims —
+    # without explicit entries the suffix guards silently skip them.
+    higher_better = {"rlhf_rollout_hit_rate"}
+    lower_better = {"rlhf_weight_lag_windows"}
     out = {}
     for key, val in extra.items():
         pv = _num(prev_extra.get(key))
@@ -1617,9 +1784,10 @@ def _vs_previous_round(extra: dict) -> dict:
         if (key in changed or val is None or pv is None
                 or pv <= 0 or val <= 0):
             continue
-        if key.endswith(("_per_s", "_gib_per_s")):
+        if key in higher_better or key.endswith(("_per_s",
+                                                 "_gib_per_s")):
             worse = val < 0.7 * pv          # throughput: higher is better
-        elif key.endswith(("_s", "_ms")):
+        elif key in lower_better or key.endswith(("_s", "_ms")):
             # Wall-time rows (incl. the chaos_recovery_*_ms MTTR rows):
             # lower is better.
             worse = val > pv / 0.7
@@ -1731,6 +1899,28 @@ def main() -> None:
             row["pd"]["kv_migrate_gib_per_s"]
     except Exception as e:  # noqa: BLE001
         extra["serve_cluster_route"] = {"error": repr(e)}
+    _flush_partial(extra)
+    try:
+        # In-process loop on the debug model: two rollout arms + a
+        # 4-step training run + the mid-decode sync window; compile
+        # time dominates on this box.
+        row = _with_timeout(bench_rlhf, 420)["rlhf_bench"]
+        extra["rlhf_bench"] = row
+        # Flat rows so _vs_previous_round's suffix guards cover the
+        # A/Bs (the nested dict is for humans).
+        extra["rlhf_rollout_tokens_per_s"] = \
+            row["rollout"]["cache_on"]["tokens_per_s"]
+        extra["rlhf_rollout_nocache_tokens_per_s"] = \
+            row["rollout"]["cache_off"]["tokens_per_s"]
+        extra["rlhf_rollout_hit_rate"] = \
+            row["rollout"]["cache_on"]["hit_rate"]
+        extra["rlhf_updates_per_s"] = row["train"]["updates_per_s"]
+        extra["rlhf_weight_sync_ms"] = \
+            row["weight_sync"]["sync_visible_ms"]
+        extra["rlhf_weight_lag_windows"] = \
+            row["weight_sync"]["lag_windows"]
+    except Exception as e:  # noqa: BLE001
+        extra["rlhf_bench"] = {"error": repr(e)}
     _flush_partial(extra)
     regressions = _vs_previous_round(extra)
     if regressions:

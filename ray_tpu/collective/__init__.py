@@ -23,6 +23,8 @@ from ray_tpu.collective.collective import (CollectiveWork, allgather,
                                            allgather_async, allreduce,
                                            allreduce_async, barrier,
                                            broadcast, broadcast_async,
+                                           broadcast_pytree,
+                                           broadcast_pytree_async,
                                            create_collective_group,
                                            deregister_collective_group,
                                            destroy_collective_group,
@@ -37,5 +39,6 @@ __all__ = [
     "allreduce", "allgather", "reducescatter",
     "broadcast", "barrier", "send", "recv", "get_rank",
     "get_collective_group_size", "allreduce_async", "allgather_async",
-    "reducescatter_async", "broadcast_async", "CollectiveWork",
+    "reducescatter_async", "broadcast_async", "broadcast_pytree",
+    "broadcast_pytree_async", "CollectiveWork",
 ]

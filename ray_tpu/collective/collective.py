@@ -599,6 +599,96 @@ def broadcast_async(tensor, src_rank: int = 0,
     return g.submit(run)
 
 
+class _MappedWork(CollectiveWork):
+    """CollectiveWork whose result is `fn(inner result)` — computed once
+    on the first wait (on the WAITER's thread, not the group op thread:
+    unpacking must not serialize behind other queued collectives)."""
+
+    _UNSET = object()
+
+    def __init__(self, inner: CollectiveWork, fn):
+        self._inner = inner
+        self._fn = fn
+        self.seq = inner.seq
+        self._out = _MappedWork._UNSET
+
+    def wait(self, timeout: float | None = None):
+        if self._out is _MappedWork._UNSET:
+            self._out = self._fn(self._inner.wait(timeout))
+        return self._out
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+
+def broadcast_pytree(tree, src_rank: int = 0,
+                     group_name: str = "default"):
+    return broadcast_pytree_async(tree, src_rank, group_name).wait()
+
+
+def broadcast_pytree_async(tree, src_rank: int = 0,
+                           group_name: str = "default") -> CollectiveWork:
+    """Broadcast a whole pytree of arrays as ONE transport (the online
+    RLHF weight-sync path: a llama param tree is hundreds of leaves —
+    per-leaf broadcasts would pay the tree/ring hop latency per leaf;
+    packing them into a single contiguous byte buffer pays it once and
+    lets the ring/tree schedule see one large tensor).
+
+    Contract: every rank passes a tree of the SAME structure and leaf
+    shapes/dtypes — non-src ranks' trees serve as the unpack template
+    (natural for weight sync, where each receiver already holds the
+    previous weights).  Returns the src tree's values unflattened into
+    the caller's structure; leaves come back as numpy arrays on non-src
+    ranks (src gets its own tree back untouched).  A byte-size mismatch
+    (structures drifted) raises a diagnostic instead of mis-slicing."""
+    import jax
+
+    g = _group(group_name)      # fail fast on the caller's thread
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if g.rank == src_rank:
+        # Device leaves: kick every transfer before materializing any
+        # (a synchronous per-leaf fetch through a tunneled chip pays
+        # the full RTT per leaf — the very cost packing exists to
+        # avoid; same pattern as the serve KV-export path).
+        for x in leaves:
+            try:
+                x.copy_to_host_async()
+            except AttributeError:
+                pass
+    arrs = [np.ascontiguousarray(x) for x in leaves]
+    total = sum(a.nbytes for a in arrs)
+    if g.rank == src_rank:
+        payload = np.empty(total, np.uint8)
+        off = 0
+        for a in arrs:
+            n = a.nbytes
+            if n:
+                payload[off:off + n] = a.reshape(-1).view(np.uint8)
+            off += n
+    else:
+        payload = None
+    work = broadcast_async(payload, src_rank, group_name)
+
+    def unpack(flat):
+        if g.rank == src_rank:
+            return tree
+        flat = np.asarray(flat).reshape(-1).view(np.uint8)
+        if flat.nbytes != total:
+            raise RuntimeError(
+                f"broadcast_pytree: received {flat.nbytes} bytes but "
+                f"this rank's template tree holds {total} — src and "
+                "receiver param trees have drifted (different model "
+                "config / stale template?)")
+        out, off = [], 0
+        for a in arrs:
+            n = a.nbytes
+            out.append(flat[off:off + n].view(a.dtype).reshape(a.shape))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return _MappedWork(work, unpack)
+
+
 def barrier(group_name: str = "default") -> None:
     g = _group(group_name)
 

@@ -302,6 +302,24 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> jnp.ndarray:
     return cross_entropy(logits, targets, batch.get("mask"))
 
 
+def token_logprobs(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+                   ) -> jnp.ndarray:
+    """Per-token log-probability scoring path (the RLHF trajectory
+    scorer): out[b, t] = log p(tokens[b, t+1] | tokens[b, :t+1]) for
+    t in [0, s-2] — one teacher-forced forward, fp32 log-softmax
+    (sampling-scale logits overflow bf16 sums), shape [b, s-1].
+
+    Positions past a sequence's true length score garbage (padding
+    attends causally like any token) — callers mask, exactly like
+    cross_entropy's mask contract.  The serve engine's decode samples
+    from these same logits, so scoring a generated completion under the
+    generating params reproduces the behavior policy's logprobs."""
+    logits = forward(params, tokens[:, :-1], cfg)        # [b, s-1, v] f32
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[:, 1:, None].astype(jnp.int32), axis=-1)[..., 0]
+
+
 def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
                       mesh, n_micro: int | None = None) -> jnp.ndarray:
     """loss_fn with the decoder trunk pipelined over the mesh's "stage"

@@ -21,6 +21,9 @@ from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                                     MultiCartPole)
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.rlhf import (GRPOLearner, RLHFConfig, RLHFTrainer,
+                             group_advantages)
+from ray_tpu.rl.rollout_llm import LLMRolloutWorker
 from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
@@ -34,4 +37,6 @@ __all__ = [
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiCartPole",
     "EnvRunner", "EnvRunnerGroup", "Learner", "LearnerGroup",
     "ReplayBuffer", "make_env", "register_env",
+    "RLHFConfig", "RLHFTrainer", "GRPOLearner", "LLMRolloutWorker",
+    "group_advantages",
 ]

@@ -1,0 +1,311 @@
+"""LLM rollout workers: GRPO-group generation through the serve engine.
+
+The online-RLHF sampling half (ROADMAP item 5, "serve-engine rollouts
+feeding a TPU learner"): a rollout worker owns a paged-KV `LLMEngine`
+(ray_tpu.serve.llm) and generates K completions per prompt — a GRPO
+*group*.  Every member of a group shares its prompt, so after the first
+member prefills it the radix prefix cache serves the other K-1 prompts
+from cached blocks: group rollouts cost ~one prompt prefill plus K
+decode streams (the bench asserts the hit rate).
+
+Per-trajectory behavior logprobs come from the model's teacher-forced
+scoring path (`llama.token_logprobs`) under the params that generated
+them — the engine samples from exactly these logits, so the scored
+logprob IS the behavior policy's.  Live weight sync
+(`LLMEngine.update_weights`) can swap params between a completion's
+decode windows; scoring then uses the newest resident tree, which is
+the bounded off-policy staleness GRPO's clipped ratio absorbs (the
+trainer's `max_weight_lag` bounds it).
+
+Trajectories return as plain numpy dicts: called through an actor
+handle, the result rides the object plane as a ref the trainer hands
+straight to the learner.  Workers participate in the learner's weight
+broadcast over the ring collectives (`recv_weights`) on a separate
+actor thread, so generation never pauses for a policy update.
+
+Failpoint site: `rl.rollout_step` (fires at rollout entry — a `crash`
+arm models a rollout actor dying with a group in flight; the trainer
+regenerates the group on a replacement, where the prefix cache makes
+the retry cheap).
+
+Layering: built only on core primitives and public library facades
+(serve engine, collective, failpoints) — enforced by test_layering.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    m = lo
+    while m < n:
+        m *= 2
+    return m
+
+
+# ------------------------------------------------------------- rewards
+def near_token_reward(target: int, vocab: int) -> Callable:
+    """Dense builtin reward: mean over completion tokens of
+    1 - |tok - target| / vocab.  Dense (every completion scores
+    differently) so small GRPO groups see non-degenerate within-group
+    variance — the learning-test reward."""
+    def fn(prompt, completion) -> float:
+        c = np.asarray(completion, np.float32)
+        if c.size == 0:
+            return 0.0
+        return float(np.mean(1.0 - np.abs(c - float(target)) / vocab))
+    return fn
+
+
+def target_token_reward(target: int) -> Callable:
+    """Sparse builtin reward: fraction of completion tokens equal to
+    the target id."""
+    def fn(prompt, completion) -> float:
+        c = np.asarray(completion)
+        return float(np.mean(c == target)) if c.size else 0.0
+    return fn
+
+
+# ------------------------------------------------------------- metrics
+_METRICS = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _rollout_metrics():
+    """Process-wide rollout counters (utils.metrics registry →
+    controller KV → dashboard /metrics), tagged per worker — the PR 3
+    serve_llm_* pattern applied to the RLHF sampling side."""
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            from ray_tpu.utils import metrics as um
+
+            tk = ("worker",)
+            _METRICS = {
+                "groups": um.get_or_create(
+                    um.Counter, "rl_rollout_groups",
+                    "GRPO prompt groups generated", tk),
+                "tokens": um.get_or_create(
+                    um.Counter, "rl_rollout_tokens",
+                    "Completion tokens generated for RLHF", tk),
+                "hit_rate": um.get_or_create(
+                    um.Gauge, "rl_rollout_prefix_hit_rate",
+                    "Rollout prompt tokens served from the prefix "
+                    "cache", tk),
+            }
+    return _METRICS
+
+
+class LLMRolloutWorker:
+    """One rollout actor: paged-KV engine + trajectory scoring.
+
+    Constructor args are picklable (model name or LlamaConfig, engine
+    kwargs dict, optional explicit params, cloudpickled reward_fn), so
+    the same class runs in-process (bench/unit tests) or as a
+    `ray_tpu.remote` actor (spawn with `max_concurrency >= 2`:
+    `recv_weights` must ride a second thread while `rollout` decodes).
+    """
+
+    def __init__(self, model: Any = "debug", *, params: Any = None,
+                 seed: int = 0, engine: dict | None = None,
+                 reward_fn: Callable | None = None,
+                 name: str = "rollout"):
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import LLMEngine
+
+        cfg = llama.llama_configs()[model] if isinstance(model, str) \
+            else model
+        ekw = dict(max_batch=8, max_len=min(cfg.max_seq, 1024),
+                   page_size=64, steps_per_sync=4)
+        ekw.update(engine or {})
+        self.cfg = cfg
+        self.name = name
+        self.engine = LLMEngine(cfg, params, seed=seed, name=name,
+                                **ekw)
+        self.engine.start()
+        self._reward = reward_fn or near_token_reward(
+            cfg.vocab_size // 3, cfg.vocab_size)
+        self.rollout_groups = 0
+        self.rollout_completions = 0
+        self.rollout_tokens = 0
+        # Scoring program: one compile per (pow2 batch, pow2 length)
+        # bucket, same discipline as the engine's prefill buckets.
+        import jax
+
+        self._score = jax.jit(
+            lambda p, t: llama.token_logprobs(p, t, cfg))
+
+    # ------------------------------------------------------ collective
+    def init_collective_group(self, world_size: int, rank: int,
+                              backend: str = "object_store",
+                              group_name: str = "default") -> None:
+        """Join the trainer's weight-broadcast group (the
+        create_collective_group contract)."""
+        from ray_tpu import collective
+
+        collective.init_collective_group(world_size, rank, backend,
+                                         group_name)
+
+    def deregister_collective_group(self, group_name: str) -> None:
+        """Drop this process's state for a stale weight-sync epoch
+        (op/prefetch thread pools; the trainer reaps the rendezvous
+        actor itself)."""
+        from ray_tpu import collective
+
+        collective.deregister_collective_group(group_name)
+
+    def recv_weights(self, version: int, group_name: str,
+                     src_rank: int = 0) -> int:
+        """Receive one weight broadcast (ring/tree schedule, ONE packed
+        transport — collective.broadcast_pytree) and stage it on the
+        engine.  The unpack template is shape/dtype-only (np.empty), so
+        no device fetch of the resident params is paid per sync.
+        Returns the staged version; decode keeps running throughout —
+        the engine swaps between sync windows."""
+        from ray_tpu import collective
+
+        template = self._params_template()
+        tree = collective.broadcast_pytree(template, src_rank,
+                                           group_name)
+        return self.engine.update_weights(tree, version)
+
+    def _params_template(self):
+        import jax
+
+        return jax.tree.map(
+            lambda a: np.empty(a.shape, a.dtype), self.engine.params)
+
+    def update_weights(self, refs, version: int | None = None) -> int:
+        """Direct (object-plane) weight push — the no-collective path
+        the trainer uses in local mode and to bootstrap replacement
+        workers."""
+        return self.engine.update_weights(refs, version)
+
+    # --------------------------------------------------------- rollout
+    def rollout(self, prompts: list, *, group_size: int = 4,
+                max_new_tokens: int = 8, temperature: float = 1.0,
+                eos_id: int | None = None) -> dict:
+        """Generate a GRPO group of `group_size` completions per prompt
+        and score them.  Returns the trajectory batch (numpy):
+
+          tokens   [B, T]   prompt+completion ids, zero right-padded
+          logprobs [B, T-1] behavior logprobs (valid under mask)
+          mask     [B, T-1] 1.0 on completion-token positions
+          prompt_len/total_len [B], rewards [B] (group-major: the K
+          completions of prompt j occupy rows j*K..(j+1)*K-1)
+
+        plus weight_version (the engine's resident policy version when
+        scoring ran), gen_s, rollout_tokens, and the rollout's prefix
+        hit/prefill token deltas (the group-sharing proof)."""
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            failpoints.fire("rl.rollout_step")
+        if group_size < 1:
+            raise ValueError(f"group_size must be >= 1, got {group_size}")
+        eng = self.engine
+        hit0 = eng.stats().get("prefix_hit_tokens", 0)
+        pre0 = eng.prefill_tokens
+        t0 = time.perf_counter()
+        # Leader/followers split: the radix cache commits a prompt's
+        # blocks when a request FINISHES, so a whole group submitted at
+        # once would prefill the shared prompt K times.  One leader per
+        # prompt prefills and commits it (all prompts' leaders run
+        # concurrently); the K-1 followers then prefix-hit those blocks
+        # — group rollouts cost ~one prompt prefill + K decode streams.
+        leader_futs = [eng.submit(
+            list(p), max_new_tokens=max_new_tokens,
+            temperature=temperature, eos_id=eos_id) for p in prompts]
+        leader_outs = [f.result(timeout=600) for f in leader_futs]
+        follower_futs = [
+            [eng.submit(list(p), max_new_tokens=max_new_tokens,
+                        temperature=temperature, eos_id=eos_id)
+             for _ in range(group_size - 1)] for p in prompts]
+        outs = []
+        for j in range(len(prompts)):
+            outs.append(leader_outs[j])
+            outs.extend(f.result(timeout=600)
+                        for f in follower_futs[j])
+        gen_s = time.perf_counter() - t0
+        # Score under the CURRENT resident tree: one consistent
+        # (params, version) pair — the engine publishes both under its
+        # weights lock, so the trajectory's weight_version can never
+        # label logprobs scored under a different tree.  With live
+        # sync on, later windows of a completion may already be newer
+        # than its first; max_weight_lag bounds that staleness.
+        params, version = eng.params_snapshot()
+        seqs, plens = [], []
+        for j, prompt in enumerate(prompts):
+            for k in range(group_size):
+                seqs.append(list(prompt)
+                            + outs[j * group_size + k]["tokens"])
+                plens.append(len(prompt))
+        B = len(seqs)
+        tlens = [len(s) for s in seqs]
+        Tp = _pow2(max(tlens))
+        Bp = _pow2(B, lo=1)
+        toks = np.zeros((Bp, Tp), np.int32)
+        for i, s in enumerate(seqs):
+            toks[i, :len(s)] = s
+        logp = np.asarray(self._score(params, toks))[:B]   # [B, Tp-1]
+        prompt_len = np.asarray(plens, np.int32)
+        total_len = np.asarray(tlens, np.int32)
+        # Completion token at absolute position j scores at column j-1.
+        cols = np.arange(Tp - 1)[None, :]
+        mask = ((cols >= (prompt_len - 1)[:, None])
+                & (cols < (total_len - 1)[:, None])).astype(np.float32)
+        rewards = np.asarray(
+            [self._reward(prompts[i // group_size],
+                          seqs[i][plens[i]:]) for i in range(B)],
+            np.float32)
+        new_tokens = int(total_len.sum() - prompt_len.sum())
+        self.rollout_groups += len(prompts)
+        self.rollout_completions += B
+        self.rollout_tokens += new_tokens
+        s = eng.stats()
+        hit = s.get("prefix_hit_tokens", 0) - hit0
+        prefilled = eng.prefill_tokens - pre0
+        seen = hit + prefilled
+        try:
+            m = _rollout_metrics()
+            tags = {"worker": self.name}
+            m["groups"].inc(len(prompts), tags)
+            m["tokens"].inc(new_tokens, tags)
+            m["hit_rate"].set(hit / seen if seen else 0.0, tags)
+        except Exception:  # noqa: BLE001 - metrics must never fail a rollout
+            pass
+        return {
+            "tokens": toks[:B], "logprobs": logp, "mask": mask,
+            "prompt_len": prompt_len, "total_len": total_len,
+            "rewards": rewards, "group_size": group_size,
+            "weight_version": version, "gen_s": gen_s,
+            "rollout_tokens": new_tokens,
+            "prefix_hit_tokens": hit, "prefill_tokens": prefilled,
+        }
+
+    # ----------------------------------------------------------- admin
+    def stats(self) -> dict:
+        return {
+            "rollout_groups": self.rollout_groups,
+            "rollout_completions": self.rollout_completions,
+            "rollout_tokens": self.rollout_tokens,
+            "weight_version": self.engine.weight_version,
+            "engine": self.engine.stats(),
+        }
+
+    def kv_check(self) -> dict:
+        """Zero-leaked-KV probe (chaos suites): raises on any block
+        accounting inconsistency."""
+        return self.engine.kv_check()
+
+    def pid(self) -> int:
+        import os
+
+        return os.getpid()
+
+    def stop(self) -> None:
+        self.engine.stop()

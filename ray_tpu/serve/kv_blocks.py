@@ -284,6 +284,25 @@ class BlockManager:
             child.last_used = self._clock
             node = child
 
+    @_locked
+    def flush(self) -> int:
+        """Invalidate the ENTIRE prefix cache (the live weight-sync
+        hook: every cached page holds KV computed under the OLD policy
+        — matching it after a param swap would silently attend stale
+        values).  Refcount-0 cached blocks return to the free list;
+        still-referenced blocks are merely un-cached — their in-flight
+        readers finish under the documented staleness and the block
+        frees on its last release.  Returns the number of nodes
+        dropped."""
+        n = len(self._node_of)
+        for b in self._node_of:
+            if self._ref[b] == 0:
+                self._free.append(b)
+        self._node_of.clear()
+        self._root = _Node(None, 0, None)
+        self._summary_cache = None
+        return n
+
     # ----------------------------------------------------------- cluster
     @_locked
     def export_blocks(self, pages: list[int], n_valid_tokens: int,

@@ -138,6 +138,13 @@ def _engine_metrics():
                 "hit_rate": um.get_or_create(
                     um.Gauge, "serve_llm_prefix_hit_rate",
                     "Prefix-cache hit tokens / prompt tokens", tk),
+                "weight_version": um.get_or_create(
+                    um.Gauge, "serve_llm_weight_version",
+                    "Policy weight version currently decoding (online "
+                    "RLHF live weight sync)", tk),
+                "weight_updates": um.get_or_create(
+                    um.Counter, "serve_llm_weight_updates",
+                    "Live weight swaps applied between decode syncs", tk),
             }
     return _METRICS
 
@@ -180,6 +187,11 @@ class _Request:
     # this may be a pinned arena view and must not outlive its use.
     import_kv: Any = None
     import_len: int = 0          # valid KV positions in import_kv
+    # Prefix-cache generation at admission: a live weight swap bumps
+    # the engine's generation and flushes the radix tree; a request
+    # admitted under an older generation must NOT commit its blocks
+    # (its KV was computed under the old policy).
+    cache_gen: int = 0
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -496,6 +508,22 @@ class LLMEngine:
         self._export_thread: threading.Thread | None = None
         self.prefill_tokens = 0        # tokens actually prefilled
         self.decode_tokens = 0
+        # Live weight sync (online RLHF): update_weights() stages a
+        # fresh param tree here; the loop swaps it in BETWEEN decode
+        # sync windows (never mid-block — the compiled program must see
+        # one consistent tree), so decode continues uninterrupted and a
+        # generation replica is never drained for a policy update.
+        self._weights_lock = threading.Lock()
+        self._staged_weights: tuple | None = None   # (version, tree, t)
+        self._staged_version = 0
+        self.weight_version = 0
+        self.weight_updates = 0
+        self.weight_syncs_skipped = 0
+        self.last_weight_sync_ms = 0.0   # stage -> visible-to-decode
+        # Prefix-cache generation: bumped (and the radix tree flushed)
+        # at every weight swap — cached KV belongs to the policy that
+        # computed it.
+        self._cache_gen = 0
         self._metrics_last: dict[str, float] = {}
         self._metrics_t = 0.0
         # stats() flushes from replica threads while the loop flushes on
@@ -636,6 +664,139 @@ class LLMEngine:
         self._waiting.put(req)
         self._wake.set()
         return req.future
+
+    def update_weights(self, refs, version: int | None = None) -> int:
+        """Stage a fresh policy param tree for LIVE weight sync (the
+        online-RLHF loop): the engine loop swaps `self.params` in
+        BETWEEN decode sync windows — never mid-block, never draining a
+        request — so generation replicas keep decoding while training
+        advances the policy.  In-flight completions simply continue
+        under the new weights from their next window (the bounded
+        off-policy staleness the RLHF trainer's `max_weight_lag`
+        accounts for).
+
+        `refs` may be the param tree itself (host or device arrays), ONE
+        ObjectRef to such a tree, or a list of ObjectRefs (the
+        object-plane broadcast shapes) — resolved HERE on the caller's
+        thread, never on the engine loop.  The tree must match the
+        resident params' structure and leaf shapes (validated here, at
+        the API edge — a mismatch inside the jitted decode would kill
+        every tenant); leaves are cast to the resident dtypes at swap so
+        the ONE compiled decode program stays valid.
+
+        The swap also FLUSHES the radix prefix cache and generation-
+        gates pending commits: every cached page holds KV computed
+        under the old policy, and a post-swap prompt match against it
+        would silently attend stale values (recurring RLHF prompts hit
+        this constantly).  Group sharing within one rollout round is
+        unaffected — leaders commit and followers match under the same
+        generation.
+
+        Thread-safe; latest staged version wins if the loop hasn't
+        swapped yet.  Returns the staged version.  Kill switch
+        RAY_TPU_RL_WEIGHT_SYNC=0 (read per call — same-run freeze-policy
+        A/B) drops the update and returns the CURRENT version;
+        `stats()["weight_version"]` is how callers observe propagation
+        either way."""
+        if not _env_on("RAY_TPU_RL_WEIGHT_SYNC"):
+            with self._weights_lock:
+                self.weight_syncs_skipped += 1
+                return self.weight_version
+        import jax
+
+        tree = refs
+        from ray_tpu.object_ref import ObjectRef
+
+        if isinstance(tree, ObjectRef):
+            import ray_tpu
+
+            tree = ray_tpu.get(tree)
+        elif (isinstance(tree, (list, tuple)) and tree
+                and all(isinstance(r, ObjectRef) for r in tree)):
+            import ray_tpu
+
+            got = ray_tpu.get(list(tree))
+            if len(got) == 1:
+                tree = got[0]
+            elif all(isinstance(g, dict) for g in got):
+                # Sharded object-plane push: each ref carries a
+                # disjoint top-level slice of the param dict (e.g.
+                # embed / layers / lm_head as separate objects).
+                tree = {}
+                for g in got:
+                    tree.update(g)
+            else:
+                raise ValueError(
+                    "update_weights: a multi-ref push must resolve to "
+                    "dict shards that merge into the param tree; got "
+                    f"{[type(g).__name__ for g in got]}")
+        new_leaves, new_def = jax.tree_util.tree_flatten(tree)
+        cur_leaves, cur_def = jax.tree_util.tree_flatten(self.params)
+        if new_def != cur_def:
+            raise ValueError(
+                "update_weights: param tree structure does not match "
+                f"the engine's ({new_def} vs {cur_def})")
+        for i, (a, b) in enumerate(zip(new_leaves, cur_leaves)):
+            if tuple(getattr(a, "shape", ())) != tuple(b.shape):
+                raise ValueError(
+                    f"update_weights: leaf {i} shape "
+                    f"{getattr(a, 'shape', ())} != resident {b.shape} "
+                    "(wrong model config?)")
+        with self._weights_lock:
+            if version is None:
+                version = max(self.weight_version,
+                              self._staged_version) + 1
+            # The stage timestamp travels WITH the staged tuple: a
+            # concurrent re-stage must not corrupt the previous swap's
+            # stage→visible latency measurement.
+            self._staged_weights = (version, tree, time.perf_counter())
+            self._staged_version = version
+        self._wake.set()        # idle engines swap promptly too
+        return version
+
+    def _maybe_swap_weights(self) -> None:
+        """Engine-loop half of update_weights: apply the newest staged
+        tree, if any.  Runs at the top of every loop iteration — i.e.
+        between decode sync windows — so an in-flight request's decode
+        stalls at most one window behind a weight push."""
+        with self._weights_lock:
+            staged, self._staged_weights = self._staged_weights, None
+        if staged is None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        version, tree, staged_t = staged
+        # Cast to resident dtypes (bf16 engines fed fp32 learner
+        # trees): the compiled decode program's signature must not
+        # change under a swap.
+        new_params = jax.tree.map(
+            lambda new, old: jnp.asarray(new, old.dtype), tree,
+            self.params)
+        # Publish tree + version ATOMICALLY (params_snapshot takes the
+        # same lock): a scorer must never label logprobs computed under
+        # one tree with the other's version.
+        with self._weights_lock:
+            self.params = new_params
+            self.weight_version = version
+        self.weight_updates += 1
+        if self._mgr is not None:
+            # Cached KV belongs to the OLD policy: flush the radix tree
+            # (refcount-0 pages free now; in-flight readers finish under
+            # the documented staleness) and gate pending commits behind
+            # a fresh generation.
+            self._cache_gen += 1
+            self._mgr.flush()
+        self.last_weight_sync_ms = (time.perf_counter()
+                                    - staged_t) * 1000.0
+
+    def params_snapshot(self):
+        """Consistent (params, weight_version) pair for trajectory
+        scoring: the swap publishes both under the weights lock, so a
+        reader can never see the new tree labeled with the old version
+        (or vice versa)."""
+        with self._weights_lock:
+            return self.params, self.weight_version
 
     def warmup(self, buckets: list[int] | None = None) -> None:
         """Pre-compile the decode program and prefill buckets so the first
@@ -816,6 +977,7 @@ class LLMEngine:
                 self._table_dirty = True
             self._pending.popleft()
             req.slot = free
+            req.cache_gen = self._cache_gen
             self._slots[free] = req
             self._temps[free] = req.temperature
             self._seeds[free] = req.sample_seed
@@ -1124,7 +1286,10 @@ class LLMEngine:
         if not (self.paged and req.pages):
             return
         kv_valid = len(req.prompt) + len(req.tokens) - 1
-        if req.cache_ok:
+        if req.cache_ok and req.cache_gen == self._cache_gen:
+            # A request admitted before a weight swap computed (some
+            # of) its KV under the OLD policy — committing it would
+            # repollute the freshly-flushed cache with stale pages.
             self._mgr.commit(req.prompt + req.tokens,
                              req.pages[:kv_valid // self.page])
         self._mgr.release(req.pages)
@@ -1233,6 +1398,7 @@ class LLMEngine:
         import jax.numpy as jnp
 
         while not self._stop.is_set():
+            self._maybe_swap_weights()
             self._admit()
             active = self._ensure_decode_blocks()
             self._flush_metrics()
@@ -1290,7 +1456,8 @@ class LLMEngine:
         cur = {"prefill_tokens": self.prefill_tokens,
                "decode_tokens": self.decode_tokens,
                "preemptions": self.preemptions,
-               "completed": self.completed}
+               "completed": self.completed,
+               "weight_updates": self.weight_updates}
         if self._mgr is not None:
             cur["prefix_hit_tokens"] = self._mgr.hit_tokens
             cur["evictions"] = self._mgr.evictions
@@ -1304,11 +1471,23 @@ class LLMEngine:
         m["occupancy"].set(
             sum(s is not None for s in self._slots) / self.max_batch,
             tags)
+        m["weight_version"].set(float(self.weight_version), tags)
         if self._mgr is not None:
             m["free_blocks"].set(self._mgr.free_count(), tags)
             seen = self._mgr.hit_tokens + self.prefill_tokens
             m["hit_rate"].set(
                 self._mgr.hit_tokens / seen if seen else 0.0, tags)
+
+    def kv_check(self) -> dict:
+        """Assert the block-state partition (test/ops probe): raises if
+        any KV block is leaked or double-booked.  Shared by the serve
+        replica's kv_check RPC and the RLHF rollout workers' post-chaos
+        leak checks."""
+        if self._mgr is None:
+            return {"ok": True, "paged": False}
+        self._mgr.check()
+        return {"ok": True, "free": self._mgr.free_count(),
+                "available": self._mgr.available()}
 
     def stats(self) -> dict:
         out = {"completed": self.completed,
@@ -1322,7 +1501,12 @@ class LLMEngine:
                "prefix_cache": self._prefix_cache,
                "kv_preempt": self._preempt_on,
                "kv_exports": self.kv_exports,
-               "kv_imports": self.kv_imports}
+               "kv_imports": self.kv_imports,
+               "weight_version": self.weight_version,
+               "weight_updates": self.weight_updates,
+               "weight_syncs_skipped": self.weight_syncs_skipped,
+               "last_weight_sync_ms": round(self.last_weight_sync_ms,
+                                            3)}
         if self._mgr is not None:
             kv = self._mgr.stats()
             out["kv"] = kv
@@ -1564,15 +1748,19 @@ class LLMServer:
         out["migrated"] = True
         return out
 
+    def update_weights(self, refs, version: int | None = None) -> int:
+        """Replica-side weight push (online RLHF): stage a fresh param
+        tree on this replica's engine — decode keeps running; the swap
+        lands between sync windows.  `refs` resolves exactly as
+        LLMEngine.update_weights documents (tree / ObjectRef / list of
+        refs).  Returns the staged (or, kill-switched, current)
+        version."""
+        return self.engine.update_weights(refs, version)
+
     def kv_check(self) -> dict:
         """Assert the engine's block-state partition (test/ops probe):
         raises if any block is leaked or double-booked."""
-        mgr = self.engine._mgr
-        if mgr is None:
-            return {"ok": True, "paged": False}
-        mgr.check()
-        return {"ok": True, "free": mgr.free_count(),
-                "available": mgr.available()}
+        return self.engine.kv_check()
 
     async def __call__(self, request: dict) -> dict:
         import asyncio

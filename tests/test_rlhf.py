@@ -1,0 +1,363 @@
+"""Online RLHF loop (ROADMAP item 5): GRPO rollouts through the serve
+engine, jitted learner updates, live weight sync.
+
+Engine level: `LLMEngine.update_weights` swaps the param tree between
+decode sync windows — an in-flight request keeps decoding through a
+policy update (never drained), the kill switch freezes the policy in
+the same run, and malformed trees are rejected at the API edge.
+
+Loop level: GRPO group rollouts share their prompt through the radix
+prefix cache (the group-sharing proof), behavior logprobs match the
+model's scoring path bit-for-bit, the seeded local loop IMPROVES the
+reward (RL learning-test discipline: seeded, deterministic — fix
+determinism, don't loosen thresholds), and two identical runs produce
+bit-identical advantages and parameter hashes.
+
+Debug-scale fp32 on the CPU mesh — same discipline as
+test_prefix_cache.py / test_pd_disagg.py.
+"""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=256, remat=False, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+ENGINE_KW = dict(max_batch=8, max_len=128, page_size=8,
+                 steps_per_sync=3)
+
+
+def _engine(small, **kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = small
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    eng = LLMEngine(cfg, params, seed=0, paged=True, **merged)
+    eng.start()
+    return eng
+
+
+def _rlhf_cfg(small, **kw):
+    from ray_tpu.rl.rlhf import RLHFConfig
+
+    cfg, params = small
+    base = dict(model=cfg, params=params, seed=0, n_prompts=4,
+                prompt_len=10, group_size=4, prompts_per_step=2,
+                max_new_tokens=5, temperature=1.0, lr=1e-2,
+                engine=dict(ENGINE_KW))
+    base.update(kw)
+    return RLHFConfig(**base)
+
+
+PROMPT = [(i * 7 + 3) % 127 + 1 for i in range(12)]
+
+
+# ------------------------------------------------------------ scoring
+def test_token_logprobs_matches_manual(small):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    toks = jnp.asarray([PROMPT + [9, 4, 2, 77]], jnp.int32)
+    lp = np.asarray(llama.token_logprobs(params, toks, cfg))
+    logits = llama.forward(params, toks[:, :-1], cfg)
+    ref = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    want = ref[0, np.arange(toks.shape[1] - 1), np.asarray(toks)[0, 1:]]
+    np.testing.assert_allclose(lp[0], want, rtol=1e-6)
+    assert lp.shape == (1, toks.shape[1] - 1)
+    assert np.all(lp <= 0.0)
+
+
+def test_group_advantages_math():
+    from ray_tpu.rl.rlhf import group_advantages
+
+    r = np.asarray([1.0, 2.0, 3.0, 4.0,   # group 0
+                    5.0, 5.0, 5.0, 5.0], np.float32)   # degenerate
+    adv = np.asarray(group_advantages(r, 4, eps=1e-6))
+    g0 = adv[:4]
+    assert abs(g0.mean()) < 1e-6
+    assert g0[0] < g0[1] < g0[2] < g0[3]
+    np.testing.assert_allclose(np.abs(g0[:2]), np.abs(g0[2:][::-1]),
+                               rtol=1e-5)
+    # All-equal rewards carry NO signal: zero advantage, not inf/nan.
+    np.testing.assert_allclose(adv[4:], 0.0, atol=1e-6)
+
+
+# ----------------------------------------------------- engine weights
+def test_update_weights_swaps_between_syncs_without_drain(small):
+    """A policy update lands while a request is mid-decode: the request
+    completes its FULL budget (decode was never drained/aborted), the
+    version flips, and the resident tree really is the new one."""
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    eng = _engine(small)
+    try:
+        new_params = llama.init_params(jax.random.PRNGKey(99), cfg)
+        fut = eng.submit(PROMPT, max_new_tokens=30)
+        v = eng.update_weights(
+            jax.tree.map(np.asarray, new_params), 7)
+        assert v == 7
+        out = fut.result(timeout=300)
+        assert len(out["tokens"]) == 30      # never drained
+        assert eng.stats()["weight_version"] == 7
+        assert eng.weight_updates == 1
+        assert eng.last_weight_sync_ms > 0.0
+        np.testing.assert_array_equal(
+            np.asarray(eng.params["final_norm"]),
+            np.asarray(new_params["final_norm"]))
+        # The swapped tree actually decodes (greedy under new params
+        # == a fresh engine built on them).
+        got = eng.generate(PROMPT, max_new_tokens=4)["tokens"]
+        ref_eng = _engine((cfg, new_params))
+        try:
+            ref = ref_eng.generate(PROMPT, max_new_tokens=4)["tokens"]
+        finally:
+            ref_eng.stop()
+        assert got == ref
+    finally:
+        eng.stop()
+
+
+def test_update_weights_kill_switch_freezes_policy(small, monkeypatch):
+    """RAY_TPU_RL_WEIGHT_SYNC=0 (read per call — same-run A/B): the
+    update is dropped, the version never moves, and the resident
+    params are untouched."""
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    eng = _engine(small)
+    try:
+        before = np.asarray(eng.params["final_norm"]).copy()
+        monkeypatch.setenv("RAY_TPU_RL_WEIGHT_SYNC", "0")
+        v = eng.update_weights(jax.tree.map(
+            np.asarray, llama.init_params(jax.random.PRNGKey(99), cfg)),
+            3)
+        assert v == 0
+        assert eng.weight_syncs_skipped == 1
+        eng.generate(PROMPT, max_new_tokens=2)
+        assert eng.stats()["weight_version"] == 0
+        np.testing.assert_array_equal(
+            np.asarray(eng.params["final_norm"]), before)
+        # Same run, switch back on: the next push lands.
+        monkeypatch.delenv("RAY_TPU_RL_WEIGHT_SYNC")
+        v = eng.update_weights(jax.tree.map(
+            np.asarray, llama.init_params(jax.random.PRNGKey(99), cfg)))
+        assert v == 1
+    finally:
+        eng.stop()
+
+
+def test_update_weights_validates_tree(small):
+    import jax
+
+    cfg, params = small
+    eng = _engine(small)
+    try:
+        with pytest.raises(ValueError, match="structure"):
+            eng.update_weights({"nope": np.zeros(3, np.float32)})
+        bad = jax.tree.map(np.asarray, params)
+        bad["final_norm"] = np.zeros((3,), np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            eng.update_weights(bad)
+        assert eng.stats()["weight_version"] == 0
+    finally:
+        eng.stop()
+
+
+def test_weight_version_in_server_stats(small):
+    """The serve replica surface: LLMServer.update_weights stages on
+    the engine and stats() (→ replica_metrics → Prometheus
+    serve_llm_weight_version) reports propagation."""
+    import jax
+
+    from ray_tpu.serve.llm import LLMServer
+
+    cfg, params = small
+    srv = LLMServer(cfg, params=params, max_batch=2, max_len=64,
+                    page_size=8, seed=0)
+    try:
+        assert srv.stats()["weight_version"] == 0
+        v = srv.update_weights(
+            jax.tree.map(np.asarray, srv.engine.params), 4)
+        assert v == 4
+        import time
+
+        deadline = time.monotonic() + 30
+        while srv.stats()["weight_version"] < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------ rollout
+def test_rollout_group_shares_prompt_via_prefix_cache(small):
+    """The GRPO group-sharing contract: K completions of one prompt
+    cost ~one prompt prefill — the followers prefix-hit the leader's
+    committed blocks; behavior logprobs match the scoring path
+    bit-for-bit."""
+    from ray_tpu.models import llama
+    from ray_tpu.rl.rollout_llm import LLMRolloutWorker
+
+    cfg, params = small
+    w = LLMRolloutWorker(cfg, params=params, seed=0,
+                         engine=dict(ENGINE_KW, max_batch=8))
+    try:
+        prompts = [PROMPT[:10], [p % 120 + 1 for p in PROMPT[:10]]]
+        traj = w.rollout(prompts, group_size=4, max_new_tokens=5,
+                         temperature=1.0)
+        B = 2 * 4
+        assert traj["tokens"].shape[0] == B
+        assert traj["rewards"].shape == (B,)
+        assert traj["mask"].shape == traj["logprobs"].shape
+        # Every completion row: exactly max_new_tokens masked columns.
+        np.testing.assert_array_equal(traj["mask"].sum(axis=1),
+                                      np.full(B, 5.0))
+        # Followers hit the leader's blocks: a 10-token prompt commits
+        # one full 8-token page, so each of the 3 followers per group
+        # hits >= 8 tokens.
+        assert traj["prefix_hit_tokens"] >= 2 * 3 * 8
+        # Leaders prefill the full prompt; followers only the suffix.
+        assert traj["prefill_tokens"] < B * 10
+        # Scoring parity: recompute under the same params.
+        import jax.numpy as jnp
+
+        lp = np.asarray(llama.token_logprobs(
+            params, jnp.asarray(traj["tokens"]), cfg))
+        m = traj["mask"] > 0
+        np.testing.assert_allclose(traj["logprobs"][m], lp[m],
+                                   rtol=1e-5, atol=1e-6)
+        # The sample stream is group-member-distinct (temperature 1):
+        # not all completions in a group identical.
+        comp = traj["tokens"][:4, 10:15]
+        assert len({tuple(r) for r in comp}) > 1
+        w.kv_check()
+    finally:
+        w.stop()
+
+
+def test_rollout_failpoint_error_surfaces(small):
+    from ray_tpu._private import failpoints
+
+    from ray_tpu.rl.rollout_llm import LLMRolloutWorker
+
+    cfg, params = small
+    w = LLMRolloutWorker(cfg, params=params, seed=0,
+                         engine=dict(ENGINE_KW))
+    try:
+        failpoints.configure("rl.rollout_step=nth:1+error")
+        with pytest.raises(failpoints.FailpointError):
+            w.rollout([PROMPT[:10]], group_size=2, max_new_tokens=3)
+        # The engine survives the faulted rollout; blocks stay clean.
+        traj = w.rollout([PROMPT[:10]], group_size=2, max_new_tokens=3)
+        assert traj["tokens"].shape[0] == 2
+        w.kv_check()
+    finally:
+        failpoints.reset()
+        w.stop()
+
+
+# --------------------------------------------------------------- loop
+def test_local_loop_learns(small):
+    """Seeded learning test: 12 GRPO updates on the dense near-token
+    reward must improve the mean reward (deterministic — if this
+    flakes under suite load, fix determinism, don't loosen)."""
+    from ray_tpu.rl.rlhf import RLHFTrainer
+
+    tr = RLHFTrainer(_rlhf_cfg(
+        small, group_size=8, prompts_per_step=4, max_new_tokens=6,
+        lr=3e-2, engine=dict(ENGINE_KW, max_batch=32)))
+    try:
+        ms = tr.run(12)
+        rs = [m["reward_mean"] for m in ms]
+        first, last = np.mean(rs[:3]), np.mean(rs[-3:])
+        assert last > first + 0.1, (
+            f"GRPO failed to improve: first3={first:.3f} "
+            f"last3={last:.3f} trajectory={np.round(rs, 3)}")
+        # Weight sync really propagated every update.
+        st = tr.stats()
+        assert st["worker_versions"] == [12]
+        assert st["workers"][0]["weight_version"] == 12
+        assert st["workers"][0]["engine"]["weight_updates"] == 12
+    finally:
+        tr.shutdown()
+
+
+def test_two_runs_bit_identical(small):
+    """RL determinism discipline: same config, same seed → bit-equal
+    advantages and parameter hashes after N updates (learner RNG is
+    fold_in-derived, sampling keys are per-request, no global numpy
+    state anywhere in the loop)."""
+    from ray_tpu.rl.rlhf import RLHFTrainer
+
+    def run():
+        tr = RLHFTrainer(_rlhf_cfg(small, seed=3, temperature=0.9,
+                                   lr=5e-3, minibatch_size=4,
+                                   max_new_tokens=4))
+        try:
+            ms = tr.run(3)
+            advs = [np.asarray(m["advantages"]).tobytes() for m in ms]
+            return advs, tr.learner.param_hash()
+        finally:
+            tr.shutdown()
+
+    advs1, h1 = run()
+    advs2, h2 = run()
+    assert advs1 == advs2, "advantages diverged between identical runs"
+    assert h1 == h2, f"param hashes diverged: {h1} vs {h2}"
+
+
+def test_frozen_policy_ab_in_same_run(small, monkeypatch):
+    """RAY_TPU_RL_WEIGHT_SYNC=0 mid-run freezes generation at the last
+    synced policy while the learner keeps training — the same-run A/B
+    arm: engine version stalls, learner version advances."""
+    from ray_tpu.rl.rlhf import RLHFTrainer
+
+    tr = RLHFTrainer(_rlhf_cfg(small, max_new_tokens=4))
+    try:
+        tr.step()
+        assert tr.stats()["worker_versions"] == [1]
+        monkeypatch.setenv("RAY_TPU_RL_WEIGHT_SYNC", "0")
+        tr.step()
+        st = tr.stats()
+        assert st["version"] == 2
+        assert st["worker_versions"] == [1]      # frozen
+        assert st["workers"][0]["engine"]["weight_syncs_skipped"] >= 1
+        monkeypatch.delenv("RAY_TPU_RL_WEIGHT_SYNC")
+        tr.step()
+        assert tr.stats()["worker_versions"] == [3]   # thawed
+    finally:
+        tr.shutdown()
+
+
+def test_config_validation(small):
+    from ray_tpu.rl.rlhf import RLHFConfig, RLHFTrainer, _reward_fn
+
+    with pytest.raises(ValueError, match="unknown RLHF config"):
+        RLHFTrainer(_rlhf_cfg(small), frobnicate=1)
+    cfg = _rlhf_cfg(small, reward="no_such_reward")
+    with pytest.raises(ValueError, match="unknown reward"):
+        _reward_fn(cfg)
+    with pytest.raises(ValueError, match="remote_learner"):
+        RLHFTrainer(_rlhf_cfg(small, remote_learner=True,
+                              num_rollout_workers=0))
+    assert RLHFConfig().group_size == 4
