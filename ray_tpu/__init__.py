@@ -41,7 +41,7 @@ def __getattr__(name):
     # ray.autoscaler / ray.client are importable off the top level).
     if name in ("autoscaler", "client", "data", "train", "tune", "serve",
                 "rl", "workflow", "dag", "experimental", "utils",
-                "cluster_utils", "failpoints"):
+                "cluster_utils", "failpoints", "tracing"):
         import importlib
 
         return importlib.import_module(f"ray_tpu.{name}")

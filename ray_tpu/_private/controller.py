@@ -29,6 +29,7 @@ from typing import Any
 
 from ray_tpu._private import failpoints
 from ray_tpu._private import scheduler as sched
+from ray_tpu._private import spans
 from ray_tpu._private.config import Config
 from ray_tpu._private.rpc import ClientPool, Publisher, RpcServer
 
@@ -1028,6 +1029,29 @@ class Controller:
                 try:
                     reply, _ = await self.clients.get(node.agent_addr).call(
                         "failpoints", h, timeout=15.0)
+                    return node.node_id, reply
+                except Exception as e:  # noqa: BLE001 - node churning
+                    return node.node_id, {"error": repr(e)}
+
+            local["nodes"] = dict(await asyncio.gather(
+                *(_one(n) for n in alive)))
+        return local
+
+    async def rpc_spans(self, h: dict, _b: list) -> dict:
+        """Cluster-wide flight-recorder harvest: this controller's span
+        buffer and, with broadcast=True, every ALIVE agent's (each of
+        which fans out to its workers) — the failpoints-verb fan-out
+        shape, so a wedged agent costs ONE bounded timeout."""
+        local = spans.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        if h.get("broadcast"):
+            alive = [n for n in list(self.nodes.values())
+                     if n.state == "ALIVE"]
+
+            async def _one(node):
+                try:
+                    reply, _ = await self.clients.get(node.agent_addr).call(
+                        "spans", h, timeout=15.0)
                     return node.node_id, reply
                 except Exception as e:  # noqa: BLE001 - node churning
                     return node.node_id, {"error": repr(e)}

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 
 from ray_tpu._private import failpoints
 from ray_tpu._private import scheduler as sched
+from ray_tpu._private import spans
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.rpc import ClientPool, RpcServer, Subscriber
@@ -1123,6 +1124,30 @@ class NodeAgent:
                 try:
                     reply, _ = await self.clients.get(w.addr).call(
                         "failpoints", sub, timeout=10.0)
+                    return w.worker_id, reply
+                except Exception as e:  # noqa: BLE001 - worker churning
+                    return w.worker_id, {"error": repr(e)}
+
+            local["workers"] = dict(await asyncio.gather(
+                *(_one(w) for w in live)))
+        return local
+
+    async def rpc_spans(self, h: dict, _b: list) -> dict:
+        """Flight-recorder harvest verb: read THIS agent's span buffer
+        and, with broadcast=True, fan out to every live worker it
+        supervises (the failpoints-verb shape — dead/wedged workers
+        cost one bounded timeout each, concurrently, never a hang)."""
+        local = spans.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        if h.get("broadcast"):
+            sub = {k: v for k, v in h.items() if k != "broadcast"}
+            live = [w for w in list(self.workers.values())
+                    if w.addr and w.state not in ("dead", "stopping")]
+
+            async def _one(w):
+                try:
+                    reply, _ = await self.clients.get(w.addr).call(
+                        "spans", sub, timeout=10.0)
                     return w.worker_id, reply
                 except Exception as e:  # noqa: BLE001 - worker churning
                     return w.worker_id, {"error": repr(e)}

@@ -131,6 +131,19 @@ def consume_put_arm() -> dict | None:
 def publish_put_trace(rec: dict) -> None:
     global _put_last
     _put_last = dict(rec)
+    # Flight-recorder bridge: the armed put breakdown also lands in the
+    # merged timeline (arena.put_stages + per-stage children), not only
+    # in this driver-local slot.
+    try:
+        from ray_tpu._private import spans
+
+        if spans.ENABLED:
+            spans.emit_stamps(
+                "arena.put_stages", rec, PUT_ORDER,
+                attrs={k: rec[k] for k in ("path", "bytes")
+                       if k in rec})
+    except Exception:  # noqa: BLE001 - tracing must never fail a put
+        pass
 
 
 def take_put_trace() -> dict | None:
@@ -230,6 +243,16 @@ def arm_collective_trace() -> None:
     _collective_armed = True
 
 
+def blank_collective_rec() -> dict:
+    """A live phase-accumulator record (the consume_collective_arm
+    shape) for always-on consumers: the flight recorder's per-op
+    collective spans reuse the schedules' existing stamp points by
+    handing them this record even when no one-shot trace is armed."""
+    return {"t0": time.monotonic(), "sent_bytes": 0, "recv_bytes": 0,
+            "send_us": 0.0, "pull_us": 0.0, "reduce_us": 0.0,
+            "wait_us": 0.0, "hops": 0}
+
+
 def consume_collective_arm() -> dict | None:
     """Claim the armed trace (called by the collective module at op
     entry).  Returns a live record the schedule mutates in place."""
@@ -237,15 +260,30 @@ def consume_collective_arm() -> dict | None:
     if not _collective_armed:
         return None
     _collective_armed = False
-    return {"t0": time.monotonic(), "sent_bytes": 0, "recv_bytes": 0,
-            "send_us": 0.0, "pull_us": 0.0, "reduce_us": 0.0,
-            "wait_us": 0.0, "hops": 0}
+    return blank_collective_rec()
 
 
 def publish_collective_trace(rec: dict) -> None:
     global _collective_last
     rec["total_us"] = round((time.monotonic() - rec.pop("t0")) * 1e6, 1)
     _collective_last = dict(rec)
+    # Flight-recorder bridge: phase/byte accounting of the armed
+    # collective lands in the merged timeline too.  (The collective
+    # module also emits always-on per-op spans; this bridge covers the
+    # one-shot tracer's richer record when both are active.)
+    try:
+        from ray_tpu._private import spans
+
+        if spans.ENABLED:
+            t1 = time.time()
+            spans.emit(
+                "collective.trace", t1 - rec["total_us"] / 1e6, t1,
+                attrs={k: rec[k] for k in
+                       ("schedule", "op", "bytes", "world", "rank",
+                        "hops", "sent_bytes", "recv_bytes", "send_us",
+                        "pull_us", "reduce_us", "wait_us") if k in rec})
+    except Exception:  # noqa: BLE001 - tracing must never fail an op
+        pass
 
 
 def take_collective_trace() -> dict | None:

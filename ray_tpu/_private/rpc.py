@@ -119,6 +119,17 @@ def _publish_hop_trace(hops: dict) -> None:
     if hops.get("_gen") != _hop_gen:
         return          # superseded trace: drop, don't impersonate
     _hop_last = dict(hops)
+    # Flight-recorder bridge: the armed hop breakdown also lands in the
+    # merged timeline as rpc.hop child spans (one per stamp pair), so a
+    # traced call's per-hop latency shows up next to the request's
+    # other stages instead of only in a driver-local dict.
+    try:
+        from ray_tpu._private import profiling, spans
+
+        if spans.ENABLED:
+            spans.emit_stamps("rpc.hop", hops, profiling.HOP_ORDER)
+    except Exception:  # noqa: BLE001 - tracing must never fail a call
+        pass
 
 
 def pack_header(h: dict) -> bytes:

@@ -42,6 +42,7 @@ from typing import Any
 
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import spans
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import MemoryStore
@@ -182,6 +183,11 @@ class CoreWorker:
         self.node_id = node_id
         self.job_id = job_id
         self.namespace = namespace
+        # Flight-recorder process label: harvest output names spans by
+        # role, not bare pid (driver vs executor worker).
+        spans.set_process_label(
+            "driver" if mode == "driver"
+            else f"worker:{self.worker_id[:12]}")
         self.memory = MemoryStore()
         self.owned: dict[bytes, OwnedObject] = {}
         # Borrower-side table: refs this process holds but does not own
@@ -916,7 +922,11 @@ class CoreWorker:
                                     ref.owner_addr or self.address)
             for oid, owner in borrowed.items():
                 self._add_borrow(oid, owner)
-        tc = self.current_trace
+        # Trace context priority: an OPEN flight-recorder span (contextvar
+        # — set by library spans and by async actor handlers, which never
+        # touch the process-global current_trace) beats the executing
+        # task's header; outside both, the submission roots a new trace.
+        tc = spans.task_trace_context() or self.current_trace
         header = {
             "task_id": task_id.hex(), "function_id": fid,
             "num_returns": num_returns, "resources": resources,
@@ -1373,6 +1383,7 @@ class CoreWorker:
         from ray_tpu._private import profiling
 
         trace = profiling.consume_put_arm()
+        t_span0 = time.time() if spans.ENABLED else 0.0
         oid = ObjectID.for_put(WorkerID.from_hex(self.worker_id),
                                next(self._put_seq)).binary()
         sv = serialize(value)
@@ -1393,6 +1404,7 @@ class CoreWorker:
                 self._add_borrow(c_oid, owner)
         if trace is not None:
             trace["owner_reg_done"] = time.monotonic()
+        put_path = "inline"
         if sv.total_bytes <= self.config.max_inline_object_size:
             if trace is not None:
                 trace["path"] = "inline"
@@ -1417,6 +1429,7 @@ class CoreWorker:
             # a sealed object whose owner never existed.
             if failpoints.ACTIVE:
                 failpoints.fire("put.publish")
+            put_path = "arena"
             if trace is not None:
                 trace["path"] = "arena"
             rec.state = "stored"
@@ -1425,6 +1438,7 @@ class CoreWorker:
             e.has_value, e.value = True, value
             self._post_to_loop(e.wake)
         else:
+            put_path = "rpc"
             if trace is not None:
                 trace["path"] = "rpc"
 
@@ -1442,6 +1456,16 @@ class CoreWorker:
         if trace is not None:
             trace["put_done"] = time.monotonic()
             profiling.publish_put_trace(trace)
+        if spans.ENABLED and t_span0 and sv.total_bytes > \
+                self.config.max_inline_object_size:
+            # Arena/RPC puts only: inline puts are a dict move, and a
+            # span per tiny put would churn the ring for nothing.  The
+            # t_span0 guard (here and at every task-span site) skips
+            # work that started before a LIVE recorder flip — an
+            # epoch-0 t0 would corrupt the merged timeline.
+            spans.emit("arena.put", t_span0,
+                       attrs={"bytes": sv.total_bytes,
+                              "path": put_path})
         return ObjectRef(oid, self.address)
 
     _GET_MISS = object()
@@ -2126,6 +2150,7 @@ class CoreWorker:
 
         rec = {"arg_contained": (), "svs": None, "err": None, "stored": ()}
         hops = th.get("_hops")
+        t_span0 = time.time() if spans.ENABLED else 0.0
         if isinstance(hops, dict):
             hops["exec_start"] = time.monotonic()
         prev = self.current_task_id
@@ -2178,6 +2203,12 @@ class CoreWorker:
             self.current_runtime_env = prev_renv
             if isinstance(hops, dict):
                 hops["exec_end"] = time.monotonic()
+            if spans.ENABLED and t_span0:
+                spans.emit_task(
+                    th.get("trace"),
+                    f"actor.{th['method']}" if th.get("method")
+                    else f"task.{th.get('name') or 'fn'}",
+                    t_span0, err="error" if rec["err"] else None)
         return rec
 
     async def _finalize_simple(self, th: dict, rec: dict) -> tuple[dict, list]:
@@ -2320,6 +2351,7 @@ class CoreWorker:
                                                  self._default_executor)
             finally:
                 self._evict_untracked_args(h)
+        t_span0 = time.time() if spans.ENABLED else 0.0
         try:
             result = await self._run_user_code(
                 _thunk, task_id=task_id, trace=h.get("trace"),
@@ -2328,9 +2360,16 @@ class CoreWorker:
                 resources=h.get("resources"),
                 runtime_env=h.get("runtime_env"))
         except BaseException as e:  # noqa: BLE001
+            if spans.ENABLED and t_span0:
+                spans.emit_task(h.get("trace"),
+                                f"task.{h.get('name') or 'fn'}",
+                                t_span0, err=type(e).__name__)
             return self._error_reply(e)
         finally:
             self._evict_untracked_args(h)
+        if spans.ENABLED and t_span0:
+            spans.emit_task(h.get("trace"),
+                            f"task.{h.get('name') or 'fn'}", t_span0)
         return await self._pack_returns(result, h)
 
     def _make_stream_shipper(self, h: dict):
@@ -2420,6 +2459,9 @@ class CoreWorker:
 
         ship = self._make_stream_shipper(h)
         count = 0
+        # Carry the request's trace context across the stream (same
+        # reason as the async actor path: no process-global to lean on).
+        token = spans.adopt_task_trace(h.get("trace"))
         try:
             if sem is not None:
                 await sem.acquire()
@@ -2442,6 +2484,8 @@ class CoreWorker:
             reply["streamed"] = count
             return reply, rb
         finally:
+            if token is not None:
+                spans._ctx.reset(token)
             self._evict_untracked_args(h)
         return {"status": "ok", "streaming": True, "streamed": count}, []
 
@@ -3035,6 +3079,7 @@ class CoreWorker:
                     return method(*args, **kwargs)
             return self._run_streaming(h, _gen_thunk,
                                        inst.executor_for(group))
+        t_span0 = time.time() if spans.ENABLED else 0.0
         if inst.is_async and asyncio.iscoroutinefunction(method):
             # Concurrency bound: named group's semaphore, or the default
             # group's (only active once the actor declares groups).
@@ -3051,6 +3096,13 @@ class CoreWorker:
 
             async def _run_async():
                 from ray_tpu._private import runtime_env as renv
+
+                # Async actor methods never set the process-global
+                # current_trace (they interleave on one loop); the
+                # handler task carries the request's trace context in
+                # its own contextvars copy instead, so nested handle
+                # calls / recorder spans continue THIS request's trace.
+                spans.adopt_task_trace(h.get("trace"))
 
                 async def _invoke():
                     if inst.runtime_env:
@@ -3096,10 +3148,17 @@ class CoreWorker:
             except asyncio.CancelledError:
                 return {"status": "cancelled"}, []
             except BaseException as e:  # noqa: BLE001
+                if spans.ENABLED and t_span0:
+                    spans.emit_task(h.get("trace"),
+                                    f"actor.{h['method']}", t_span0,
+                                    err=type(e).__name__)
                 return self._error_reply(e)
             finally:
                 self._running_async.pop(task_id, None)
                 self._evict_untracked_args(h)
+            if spans.ENABLED and t_span0:
+                spans.emit_task(h.get("trace"), f"actor.{h['method']}",
+                                t_span0)
             return await self._pack_returns(result, h)
 
         return _finish()
@@ -3754,6 +3813,11 @@ class CoreWorker:
         arm/clear/read the deterministic failpoint table of THIS process
         without restarting it."""
         return failpoints.control(h)
+
+    async def rpc_spans(self, h: dict, _b: list) -> dict:
+        """Flight-recorder harvest verb (see _private/spans): read/clear
+        THIS process's span ring buffer."""
+        return spans.control(h)
 
     # ------------------------------------------------------------ telemetry
     def _record_event(self, task_id: str, state: str, name: str = "",

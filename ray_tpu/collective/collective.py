@@ -37,13 +37,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu import failpoints, profiling
+from ray_tpu import failpoints, profiling, tracing
 from ray_tpu.collective import ring as _ring
 from ray_tpu.collective.ring import _env_float, _env_int
 
@@ -265,11 +266,19 @@ class _GroupState:
 
     def submit(self, fn) -> CollectiveWork:
         """Assign the next seq under the lock and queue `fn(seq)` on the
-        ordered op thread."""
+        ordered op thread.  The caller's trace context is captured HERE
+        (API-call time, caller thread) and re-installed around the op —
+        the op thread otherwise has no idea which request/step asked."""
+        ctx = tracing.capture() if tracing.ENABLED else None
+
+        def run(seq: int):
+            with tracing.context(ctx):
+                return fn(seq)
+
         with self._lock:
             self.seq += 1
             seq = self.seq
-            fut = self._ops.submit(fn, seq)
+            fut = self._ops.submit(run, seq)
         return CollectiveWork(fut, seq)
 
     def close(self) -> None:
@@ -470,17 +479,41 @@ def _pick_schedule(nbytes: int) -> str:
 
 def _traced(g: _GroupState, schedule: str, op: str, tensor,
             seq: int, fn):
-    """Run one collective body with the opt-in phase tracer around it."""
+    """Run one collective body with phase accounting around it: the
+    opt-in one-shot tracer when armed, and — always, unless
+    RAY_TPU_TRACE=0 — a flight-recorder span per op carrying the same
+    send/pull/reduce/wait phase sums the schedules already stamp into
+    the record (the per-collective attribution of "which phase ate
+    this train step")."""
     rec = profiling.consume_collective_arm()
+    armed = rec is not None
+    if not armed and tracing.ENABLED:
+        rec = profiling.blank_collective_rec()
     if rec is not None:
         rec.update(schedule=schedule, op=op,
                    bytes=int(getattr(tensor, "nbytes", 0)),
                    world=g.world_size, rank=g.rank, seq=seq)
+    t_span0 = time.time()
+    err = None
     try:
         return fn(rec)
+    except BaseException as e:  # noqa: BLE001 - recorded, re-raised
+        err = type(e).__name__
+        raise
     finally:
-        if rec is not None:
+        if armed:
+            # publish also bridges the record into the recorder.
             profiling.publish_collective_trace(rec)
+        elif rec is not None:
+            attrs = {k: rec[k] for k in
+                     ("schedule", "op", "bytes", "world", "rank", "seq",
+                      "hops", "sent_bytes", "recv_bytes") if k in rec}
+            for k in profiling.COLLECTIVE_PHASES:
+                if rec.get(k):
+                    attrs[k] = round(rec[k], 1)
+            if err:
+                attrs["error"] = err
+            tracing.emit(f"collective.{op}", t_span0, attrs=attrs)
 
 
 # ------------------------------------------------------------- public API

@@ -127,6 +127,7 @@ class DashboardHead:
         r.add_get("/api/v0/placement_groups", self._pgs)
         r.add_get("/api/v0/objects", self._objects)
         r.add_get("/api/v0/timeline", self._timeline)
+        r.add_get("/api/v0/traces", self._traces)
         r.add_get("/api/v0/worker_messages", self._worker_messages)
         r.add_get("/metrics", self._metrics)
         r.add_get("/api/jobs/", self._jobs_list)
@@ -289,6 +290,32 @@ class DashboardHead:
         events = await self._call(ray_tpu.timeline)
         return _json(events)
 
+    async def _traces(self, req):
+        """Flight-recorder harvest (cluster-wide `spans` verb fan-out)
+        merged by trace_id.  Query params: ?trace_id= filters to one
+        request's tree; ?format=chrome|otlp exports the Chrome-trace /
+        OTLP document shapes (default: the raw merged span list plus
+        per-trace roots)."""
+        from ray_tpu import tracing
+
+        trace_id = req.query.get("trace_id") or None
+        fmt = req.query.get("format", "spans")
+
+        def _collect():
+            spans_list = tracing.harvest(trace_id=trace_id)
+            if fmt == "chrome":
+                return tracing.chrome_trace(spans_list)
+            if fmt == "otlp":
+                return tracing.otlp_document(spans_list)
+            trees = tracing.trace_trees(spans_list)
+            groups = tracing.traces(spans_list)
+            return {"spans": spans_list,
+                    "traces": {tid: {"roots": len(roots),
+                                     "connected": len(roots) == 1,
+                                     "spans": len(groups.get(tid, ()))}
+                               for tid, roots in trees.items()}}
+        return _json(await self._call(_collect))
+
     async def _worker_messages(self, _req):
         """Messages posted via ray_tpu.show_in_dashboard (ray:
         worker.py:2521 → dashboard actor/worker detail panes)."""
@@ -328,6 +355,39 @@ class DashboardHead:
             for m in snap.get("metrics", []):
                 name = "ray_tpu_" + m.get("name", "unnamed")
                 mtype = m.get("type", "gauge")
+                if mtype == "histogram" and m.get("counts"):
+                    # Proper Prometheus histogram family
+                    # (_bucket/_sum/_count with a +Inf bucket) — a
+                    # collapsed scalar sum is scrape-broken: quantile
+                    # queries (histogram_quantile over the new
+                    # TTFT/TPOT series) need the cumulative buckets.
+                    lines.append(f"# TYPE {name} histogram")
+                    bounds = m.get("boundaries", [])
+                    sums = {tuple(sorted(v.get("tags", {}).items())):
+                            v.get("value", 0)
+                            for v in m.get("values", ())}
+                    for row in m.get("counts", ()):
+                        tags = {**row.get("tags", {}), "worker": wid}
+                        base = ",".join(
+                            f'{k}="{tv}"' for k, tv in
+                            sorted(tags.items()))
+                        counts = row.get("counts", [])
+                        cum = 0
+                        for b, c in zip(bounds, counts):
+                            cum += c
+                            lines.append(
+                                f'{name}_bucket{{{base},le="{b}"}} '
+                                f"{cum}")
+                        total = sum(counts)
+                        lines.append(
+                            f'{name}_bucket{{{base},le="+Inf"}} '
+                            f"{total}")
+                        key = tuple(sorted(row.get("tags", {}).items()))
+                        lines.append(
+                            f"{name}_sum{{{base}}} "
+                            f"{sums.get(key, 0)}")
+                        lines.append(f"{name}_count{{{base}}} {total}")
+                    continue
                 lines.append(f"# TYPE {name} "
                              f"{'counter' if mtype == 'counter' else 'gauge'}")
                 for v in m.get("values", ()):

@@ -656,9 +656,22 @@ class RLHFTrainer:
         broadcast (learner rank 0 + every worker's recv thread), timed
         end-to-end as weight_sync_ms.  Decode never pauses — engines
         swap between sync windows."""
-        from ray_tpu import failpoints
+        from ray_tpu import tracing
 
         t0 = time.perf_counter()
+        with tracing.span("rl.weight_sync",
+                          attrs={"version": self.version,
+                                 "mode": "local" if self._local
+                                 else ("driver_learner"
+                                       if not self.cfg.remote_learner
+                                       else "remote_learner")}):
+            self._sync_weights_inner()
+        self.weight_syncs += 1
+        self.weight_sync_ms += (time.perf_counter() - t0) * 1000.0
+
+    def _sync_weights_inner(self) -> None:
+        from ray_tpu import failpoints
+
         if self._local:
             if failpoints.ACTIVE:
                 failpoints.fire("rl.weight_sync")
@@ -750,8 +763,6 @@ class RLHFTrainer:
                 for i, r in enumerate(recv):
                     self._worker_version[i] = ray_tpu.get(r,
                                                           timeout=300)
-        self.weight_syncs += 1
-        self.weight_sync_ms += (time.perf_counter() - t0) * 1000.0
 
     def _lag_exceeded(self) -> bool:
         return (self.version - min(self._worker_version)

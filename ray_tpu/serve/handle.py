@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu import tracing
 from ray_tpu.actor import ActorHandle
 from ray_tpu.object_ref import ObjectRef
 from ray_tpu.serve import kv_router
@@ -403,7 +404,8 @@ class DeploymentHandle:
                 fut.set_exception(e)
 
     # -- routing ------------------------------------------------------------
-    def _pick(self, exclude=(), prompt=None) -> tuple[str, ActorHandle]:
+    def _pick(self, exclude=(), prompt=None,
+              explain: dict | None = None) -> tuple[str, ActorHandle]:
         """Power-of-two choices over in-flight counts, skipping replicas at
         their max_ongoing_requests cap — the routing-side backpressure of
         ray: pow_2_scheduler.py:51 (replicas over capacity are not sent
@@ -443,7 +445,8 @@ class DeploymentHandle:
                     and kv_router.cache_router_on()):
                 choice = kv_router.choose(prompt, eligible,
                                           self._inflight,
-                                          self._summaries)
+                                          self._summaries,
+                                          explain=explain)
             if choice is None:
                 if len(eligible) == 1:
                     choice = eligible[0]
@@ -457,24 +460,46 @@ class DeploymentHandle:
 
     def _submit(self, args: tuple, kwargs: dict,
                 state: dict | None = None) -> ObjectRef:
+        # Routing happens OUTSIDE the flight-recorder span: a
+        # _NoCapacity attempt (the router thread retries every 50ms for
+        # up to 30s) must not burn ring slots on phantom error spans,
+        # nor consume the queued_at stamp the eventually-successful
+        # attempt needs for its serve.queue span.
+        explain: dict = {}
         rid, handle = self._pick(
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
-            if self._summaries else None)
+            if self._summaries else None, explain=explain)
         if state is not None:
             state["rid"] = rid
-        try:
-            args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
-                         else a for a in args)
-            kwargs = {k: (v._to_object_ref()
-                          if isinstance(v, DeploymentResponse) else v)
-                      for k, v in kwargs.items()}
-        except BaseException:
-            self._done(rid)
-            raise
-        ref = handle.handle_request.remote(self._method, args, kwargs)
-        ref.future().add_done_callback(lambda _f: self._done(rid))
-        return ref
+        # Flight-recorder route span: roots the request's trace at the
+        # handle edge (or joins the caller's — a replica calling its
+        # decode pool continues ONE request trace across processes);
+        # the actor_call submitted inside the span parents to it.
+        with tracing.span(
+                "serve.route",
+                ctx=state.get("trace") if state is not None else None,
+                attrs={"deployment": self.deployment_name,
+                       "replica": rid, **explain}):
+            t_q = state.pop("queued_at", None) if state is not None \
+                else None
+            if t_q is not None:
+                # Time the request waited in the router-thread queue
+                # (no membership / no capacity) before routing.
+                tracing.emit("serve.queue", t_q)
+            try:
+                args = tuple(a._to_object_ref()
+                             if isinstance(a, DeploymentResponse)
+                             else a for a in args)
+                kwargs = {k: (v._to_object_ref()
+                              if isinstance(v, DeploymentResponse) else v)
+                          for k, v in kwargs.items()}
+            except BaseException:
+                self._done(rid)
+                raise
+            ref = handle.handle_request.remote(self._method, args, kwargs)
+            ref.future().add_done_callback(lambda _f: self._done(rid))
+            return ref
 
     def _done(self, rid: str) -> None:
         with self._lock:
@@ -485,27 +510,40 @@ class DeploymentHandle:
                           state: dict | None = None):
         """Route one streaming request: returns a
         StreamingObjectRefGenerator over the replica generator's items."""
+        # See _submit: routing stays OUTSIDE the span so _NoCapacity
+        # retries neither emit phantom spans nor eat the queue stamp.
+        explain: dict = {}
         rid, handle = self._pick(
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
-            if self._summaries else None)
+            if self._summaries else None, explain=explain)
         if state is not None:
             state["rid"] = rid
-        try:
-            args = tuple(a._to_object_ref()
-                         if isinstance(a, DeploymentResponse) else a
-                         for a in args)
-            kwargs = {k: (v._to_object_ref()
-                          if isinstance(v, DeploymentResponse) else v)
-                      for k, v in kwargs.items()}
-            gen = handle.handle_request_streaming.options(
-                num_returns="streaming").remote(self._method, args, kwargs)
-        except BaseException:
-            self._done(rid)
-            raise
-        gen.task_done_ref().future().add_done_callback(
-            lambda _f: self._done(rid))
-        return gen
+        with tracing.span(
+                "serve.route",
+                ctx=state.get("trace") if state is not None else None,
+                attrs={"deployment": self.deployment_name,
+                       "stream": True, "replica": rid, **explain}):
+            t_q = state.pop("queued_at", None) if state is not None \
+                else None
+            if t_q is not None:
+                tracing.emit("serve.queue", t_q)
+            try:
+                args = tuple(a._to_object_ref()
+                             if isinstance(a, DeploymentResponse) else a
+                             for a in args)
+                kwargs = {k: (v._to_object_ref()
+                              if isinstance(v, DeploymentResponse) else v)
+                          for k, v in kwargs.items()}
+                gen = handle.handle_request_streaming.options(
+                    num_returns="streaming").remote(self._method, args,
+                                                    kwargs)
+            except BaseException:
+                self._done(rid)
+                raise
+            gen.task_done_ref().future().add_done_callback(
+                lambda _f: self._done(rid))
+            return gen
 
     def _make_requeue(self, submit_fn, args: tuple, kwargs: dict,
                       state: dict):
@@ -526,6 +564,7 @@ class DeploymentHandle:
             except Exception:  # noqa: BLE001 - controller restarting
                 pass
             fut: concurrent.futures.Future = concurrent.futures.Future()
+            state["queued_at"] = time.time()
             self._ensure_router().put(
                 (fut, submit_fn, args, kwargs,
                  time.monotonic() + min(30.0, wait_s)))
@@ -544,8 +583,12 @@ class DeploymentHandle:
             isinstance(a, DeploymentResponse) and a._ref is None
             for a in list(args) + list(kwargs.values()))
         # Per-request routing state: requeue budget + replicas that
-        # already failed it (see _make_requeue).
-        state = {"budget": _REQUEUE_BUDGET, "failed": set(), "rid": None}
+        # already failed it (see _make_requeue) + the caller's trace
+        # context, captured HERE (API edge, caller thread) because the
+        # submit may execute later on the router thread, which has no
+        # ambient context of its own.
+        state = {"budget": _REQUEUE_BUDGET, "failed": set(), "rid": None,
+                 "trace": tracing.capture() if tracing.ENABLED else None}
         if self._stream:
             def submit_stream(a, k):
                 return self._submit_streaming(a, k, state=state)
@@ -565,6 +608,7 @@ class DeploymentHandle:
             # No membership / unresolved chained response / no capacity:
             # the router thread resolves the generator off the caller's
             # thread (which may be a worker IO loop — never block it).
+            state["queued_at"] = time.time()
             self._ensure_router().put(
                 (fut, submit_stream, args, kwargs,
                  time.monotonic() + 30.0))
@@ -589,6 +633,7 @@ class DeploymentHandle:
             except _NoCapacity:
                 pass         # queue to the router thread below
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        state["queued_at"] = time.time()
         self._ensure_router().put(
             (fut, submit, args, kwargs, time.monotonic() + 30.0))
         return DeploymentResponse(None, ref_future=fut, requeue=requeue)

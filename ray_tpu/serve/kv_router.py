@@ -146,7 +146,7 @@ def extract_prompt(args: tuple, kwargs: dict):
 
 
 def choose(prompt, candidates, inflight: dict, summaries: dict,
-           ) -> str | None:
+           explain: dict | None = None) -> str | None:
     """Pick the replica with the best prefix-locality score, or None.
 
     score(replica) = matched_depth(prompt, replica) - alpha * inflight.
@@ -155,10 +155,14 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
     hotspot), but when NO candidate matches at all the answer is None:
     the caller's power-of-two path owns the tie-breaking then.  Ties go
     to the lower in-flight count, then to replica-id order so the
-    choice is deterministic under test."""
+    choice is deterministic under test.
+
+    `explain` (optional dict, mutated in place) receives the winner's
+    score breakdown — matched depth in blocks, queue discount, score —
+    for the flight recorder's router span."""
     alpha = queue_alpha()
     hash_cache: dict[int, list[int]] = {}
-    best = None            # (score, -depth?, inflight, rid)
+    best = None            # ((score-key...), rid, depth)
     any_match = False
     for rid in candidates:
         s = summaries.get(rid)
@@ -174,7 +178,11 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
         q = inflight.get(rid, 0)
         key = (-(depth - alpha * q), q, rid)
         if best is None or key < best[0]:
-            best = (key, rid)
+            best = (key, rid, depth)
     if not any_match or best is None:
         return None
+    if explain is not None:
+        explain.update(cache_depth=best[2],
+                       cache_score=round(-best[0][0], 3),
+                       inflight=best[0][1], alpha=alpha)
     return best[1]

@@ -49,6 +49,7 @@ from typing import Any
 
 import numpy as np
 
+from ray_tpu import tracing
 from ray_tpu.serve.kv_blocks import BlockManager
 
 
@@ -99,6 +100,12 @@ def _pow2(n: int) -> int:
 _METRICS = None
 _METRICS_LOCK = threading.Lock()
 
+# Latency-histogram bucket upper bounds in ms: sub-ms router picks
+# through tunnel-RTT-dominated prefills (~120ms+) up to pathological
+# multi-second p99s the flight recorder exists to attribute.
+_MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+               1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
 
 def _engine_metrics():
     """Process-wide serve-LLM metrics (utils.metrics registry → flushed
@@ -145,6 +152,22 @@ def _engine_metrics():
                 "weight_updates": um.get_or_create(
                     um.Counter, "serve_llm_weight_updates",
                     "Live weight swaps applied between decode syncs", tk),
+                # Request-latency histograms (scraped as proper
+                # Prometheus histogram families — _bucket/_sum/_count —
+                # by the dashboard /metrics exposition).
+                "ttft": um.get_or_create(
+                    um.Histogram, "serve_request_ttft_ms",
+                    "Time to first token per request (ms)", tk,
+                    boundaries=_MS_BUCKETS),
+                "tpot": um.get_or_create(
+                    um.Histogram, "serve_request_tpot_ms",
+                    "Time per output token after the first (ms)", tk,
+                    boundaries=_MS_BUCKETS),
+                "stage": um.get_or_create(
+                    um.Histogram, "serve_request_stage_ms",
+                    "Per-request stage latency breakdown "
+                    "(queue/prefill/decode, ms)", ("engine", "stage"),
+                    boundaries=_MS_BUCKETS),
             }
     return _METRICS
 
@@ -192,6 +215,15 @@ class _Request:
     # admitted under an older generation must NOT commit its blocks
     # (its KV was computed under the old policy).
     cache_gen: int = 0
+    # Flight-recorder context captured at submission ((trace_id,
+    # span_id) or None — the engine loop replays it when emitting this
+    # request's queue/prefill/decode-window spans) plus the wall-clock
+    # stamps those spans need (submitted_at/first_token_at are
+    # perf_counter, a different basis).
+    trace: Any = None
+    t0_wall: float = field(default_factory=time.time)
+    admitted_at: float = 0.0       # perf_counter at slot assignment
+    admitted_wall: float = 0.0
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -578,6 +610,8 @@ class LLMEngine:
                            eos_id, concurrent.futures.Future(),
                            token_queue=token_queue, sample_seed=seed,
                            cache_ok=_cache_ok, prefill_only=prefill_only)
+            if tracing.ENABLED:
+                req.trace = tracing.capture()
             self._waiting.put(req)
             self._wake.set()
         finally:
@@ -661,6 +695,8 @@ class LLMEngine:
                        token_queue=token_queue, sample_seed=sample_seed,
                        tokens=list(tokens), import_kv=kv,
                        import_len=kv_len)
+        if tracing.ENABLED:
+            req.trace = tracing.capture()
         self._waiting.put(req)
         self._wake.set()
         return req.future
@@ -977,6 +1013,8 @@ class LLMEngine:
                 self._table_dirty = True
             self._pending.popleft()
             req.slot = free
+            req.admitted_at = time.perf_counter()
+            req.admitted_wall = time.time()
             req.cache_gen = self._cache_gen
             self._slots[free] = req
             self._temps[free] = req.temperature
@@ -991,9 +1029,17 @@ class LLMEngine:
         imports = [(s, r) for s, r in wave if r.import_kv is not None]
         wave = [(s, r) for s, r in wave if r.import_kv is None]
         for slot, req in imports:
+            t_imp0 = time.time()
+            kv_len = req.import_len
             self._apply_import(slot, req)
             if req.first_token_at is None:
                 req.first_token_at = time.perf_counter()
+            if tracing.ENABLED and req.trace is not None:
+                tracing.emit("llm.queue", req.t0_wall, req.admitted_wall,
+                             ctx=req.trace)
+                tracing.emit("llm.kv_import", t_imp0, ctx=req.trace,
+                             attrs={"kv_len": kv_len,
+                                    "pages": len(req.pages)})
             if self._done(req):
                 self._finish(slot)
         if not wave:
@@ -1011,22 +1057,24 @@ class LLMEngine:
         # fetch first tokens — chunk 1's round trip overlaps chunk 2's
         # compute, so a big burst's p50 TTFT tracks one RTT plus HALF
         # the total prefill instead of all of it.
-        pending_waves = []        # (chunk, nxt_device)
+        pending_waves = []        # (chunk, nxt_device, dispatch wall t)
         for c0 in range(0, len(wave), self._chunk):
             chunk = wave[c0:c0 + self._chunk]
+            t_disp = time.time()
             if self.paged and any(r.prefill_from > 0 for _, r in chunk):
                 nxt = self._prefill_chunk_suffix(chunk)
             else:
                 nxt = self._prefill_chunk_full(chunk)
-            pending_waves.append((chunk, nxt))
-        for _, nxt in pending_waves:
+            pending_waves.append((chunk, nxt, t_disp))
+        for _, nxt, _t in pending_waves:
             try:
                 nxt.copy_to_host_async()
             except AttributeError:
                 pass
-        for chunk, nxt in pending_waves:
+        for chunk, nxt, t_disp in pending_waves:
             firsts = np.asarray(nxt)[:len(chunk)]
             now = time.perf_counter()
+            now_wall = time.time()
             for (slot, req), first in zip(chunk, firsts):
                 if req.first_token_at is None:
                     req.first_token_at = now
@@ -1034,6 +1082,28 @@ class LLMEngine:
                 req.emit(int(first))
                 if self._done(req):
                     self._finish(slot)
+            if not tracing.ENABLED:
+                continue
+            for slot, req in chunk:
+                if req.trace is None:
+                    continue
+                # The request's engine-side TTFT anatomy: queue (submit
+                # → slot), prefill (chunk dispatch → first tokens on
+                # host; chunk-mates share the device call, so they
+                # share the window), first-token marker.
+                tracing.emit("llm.queue", req.t0_wall,
+                             req.admitted_wall, ctx=req.trace)
+                tracing.emit(
+                    "llm.prefill", t_disp, now_wall, ctx=req.trace,
+                    attrs={"prompt_tokens": len(req.prompt),
+                           "prefill_from": req.prefill_from,
+                           "cached_tokens": req.prefill_from})
+                tracing.emit(
+                    "llm.first_token", now_wall, now_wall,
+                    ctx=req.trace,
+                    attrs={"ttft_ms": round(
+                        (req.first_token_at - req.submitted_at)
+                        * 1000, 1)})
 
     def _prefill_chunk_full(self, chunk):
         """Full-prompt prefill (no cached prefix anywhere in the chunk):
@@ -1226,6 +1296,11 @@ class LLMEngine:
                 req.future.set_exception(e)
             return
         self._release_slot(slot, req)
+        # The prefill engine produced the request's REAL first token —
+        # observe its TTFT here (the export early-return in _finish
+        # skips the unified-path observation, and the decode side must
+        # not re-observe a near-zero one).
+        self._observe_done(req, time.perf_counter())
         if self._export_q is None:
             self._export_q = queue.Queue()
             self._export_thread = threading.Thread(
@@ -1245,6 +1320,7 @@ class LLMEngine:
             if item is None:
                 return
             req, arr, ids, kv_len, n = item
+            t_exp0 = time.time()
             try:
                 # Contiguous copy of the REAL payload: a bare slice
                 # would pin the whole pow-2-padded buffer and force
@@ -1258,6 +1334,12 @@ class LLMEngine:
                 continue
             self._mgr.release(ids)
             self.kv_exports += 1
+            if tracing.ENABLED and req.trace is not None:
+                # The device→host KV fetch of one migration — the
+                # export half of the kv_export→put→pull→kv_import leg.
+                tracing.emit("llm.kv_export", t_exp0, ctx=req.trace,
+                             attrs={"bytes": host.nbytes,
+                                    "kv_len": kv_len, "pages": n})
             now = time.perf_counter()
             req.emit(None)
             if not req.future.done():
@@ -1319,6 +1401,7 @@ class LLMEngine:
             return
         self._release_slot(slot, req)
         now = time.perf_counter()
+        self._observe_done(req, now)
         req.emit(None)
         if not req.future.done():
             req.future.set_result({
@@ -1326,6 +1409,43 @@ class LLMEngine:
                 "ttft_s": (req.first_token_at or now) - req.submitted_at,
                 "total_s": now - req.submitted_at,
             })
+
+    def _observe_done(self, req: _Request, now: float) -> None:
+        """Feed the request's latency into the TTFT/TPOT/stage
+        histograms (→ controller KV → dashboard /metrics as proper
+        Prometheus histogram families).  A migrated decode-side request
+        (import_len > 0) skips the TTFT/queue/prefill observations: its
+        first_token_at is the IMPORT application, not a real first
+        token — the prefill engine that produced the token observed
+        the true TTFT (see _finish_export)."""
+        try:
+            m = _engine_metrics()
+        except Exception:  # noqa: BLE001 - metrics must not stop decode
+            return
+        ft = req.first_token_at
+        if ft is None:
+            return
+        tags = {"engine": self.name}
+        imported = req.import_len > 0
+        if not imported:
+            m["ttft"].observe((ft - req.submitted_at) * 1000.0, tags)
+        n = len(req.tokens)
+        if n > 1 and now > ft:
+            m["tpot"].observe((now - ft) * 1000.0 / (n - 1), tags)
+        if req.admitted_at:
+            st = m["stage"]
+            if not imported:
+                st.observe(
+                    (req.admitted_at - req.submitted_at) * 1000.0,
+                    {**tags, "stage": "queue"})
+                st.observe((ft - req.admitted_at) * 1000.0,
+                           {**tags, "stage": "prefill"})
+            if not req.prefill_only:
+                # No decode ran on a prefill-only export — a ~0ms
+                # sample here would drag the cross-engine decode
+                # quantiles toward zero as migration volume grows.
+                st.observe((now - ft) * 1000.0,
+                           {**tags, "stage": "decode"})
 
     def _preempt_slot(self, slot: int) -> None:
         """Evict a running request from its slot: its blocks go to the
@@ -1421,12 +1541,30 @@ class LLMEngine:
             starts = np.zeros((self.max_batch,), np.int32)
             for i in active:
                 starts[i] = len(self._slots[i].tokens)
+            win_traced = tracing.ENABLED and any(
+                self._slots[i] is not None
+                and self._slots[i].trace is not None for i in active)
+            t_win0 = time.time() if win_traced else 0.0
             seq, last, self.cache = self._decode(
                 self.params, self.cache, self._cur_dev,
                 jnp.asarray(self._temps), self._table_dev,
                 jnp.asarray(self._seeds), jnp.asarray(starts))
             self._cur_dev = last                # stays on device
             seq = np.asarray(seq)               # the ONE sync per block
+            if win_traced:
+                # One K-step decode window per traced co-resident
+                # request: the window (dispatch → host sync) is the
+                # decode-side unit of TTFT/TPOT attribution.
+                t_win1 = time.time()
+                for i in active:
+                    r = self._slots[i]
+                    if r is not None and r.trace is not None:
+                        tracing.emit(
+                            "llm.decode_window", t_win0, t_win1,
+                            ctx=r.trace,
+                            attrs={"steps": self.steps_per_sync,
+                                   "weight_version":
+                                   self.weight_version})
             for i in active:
                 req = self._slots[i]
                 if req is None:
@@ -1678,10 +1816,15 @@ class LLMServer:
             return {"tokens": pre["tokens"], "ttft_s": pre["ttft_s"],
                     "total_s": time.perf_counter() - t_start}
         loop = asyncio.get_running_loop()
+        # Executor threads don't inherit the handler task's contextvars:
+        # carry the request's trace into the put explicitly.
+        trace_ctx = tracing.capture() if tracing.ENABLED else None
 
         def _put():
             t0 = time.perf_counter()
-            r = ray_tpu.put(exp["kv"])
+            with tracing.span("serve.kv_put", ctx=trace_ctx,
+                              attrs={"bytes": exp["kv"].nbytes}):
+                r = ray_tpu.put(exp["kv"])
             return r, (time.perf_counter() - t0) * 1000.0
 
         # put() may block on arena allocation — keep it off the event
@@ -1728,10 +1871,12 @@ class LLMServer:
         from ray_tpu.object_ref import ObjectRef
 
         t0 = time.perf_counter()
-        blob = kv_ref
-        if isinstance(blob, ObjectRef):
-            blob = ray_tpu.get(blob)
-        blob = np.asarray(blob)
+        with tracing.span("serve.kv_pull") as sp:
+            blob = kv_ref
+            if isinstance(blob, ObjectRef):
+                blob = ray_tpu.get(blob)
+            blob = np.asarray(blob)
+            sp["bytes"] = blob.nbytes
         pull_ms = (time.perf_counter() - t0) * 1000.0
         fut = self.engine.kv_import(
             meta["prompt"], meta["tokens"], blob,

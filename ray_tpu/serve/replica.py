@@ -99,8 +99,23 @@ class Replica:
         `_num_ongoing` counts queued + executing — the queue-length signal
         the router and autoscaler consume."""
         self._num_ongoing += 1
+        from ray_tpu import tracing
+
+        t_adm = time.time() if tracing.ENABLED else 0.0
         try:
             async with self._slots:
+                # Flight recorder: how long this request waited for a
+                # replica slot (max_ongoing_requests backpressure) —
+                # the replica-side "admit" stage of the serve timeline.
+                # Context: the handler task's adopted trace (async
+                # actor), so it lands in the request's own trace.
+                # The t_adm guard skips requests that entered before a
+                # LIVE recorder flip (t_adm == 0.0 would record an
+                # epoch-0 span).
+                if tracing.ENABLED and t_adm:
+                    tracing.emit("serve.admit", t_adm,
+                                 attrs={"deployment":
+                                        self._context.deployment})
                 # Failpoint window: the request is admitted but the user
                 # callable has not run (crash = replica dies mid-request;
                 # the handle must requeue to another replica).

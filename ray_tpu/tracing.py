@@ -1,0 +1,194 @@
+"""Public facade over the cluster flight recorder.
+
+Library layers (serve/train/data/tune/rl) must build only on core
+primitives and public surfaces, never on runtime internals — this
+module is the public surface for compiling recorder spans into library
+code (the `ray_tpu.failpoints` shape) and for harvesting the cluster's
+buffers into one connected timeline.  See `ray_tpu/_private/spans.py`
+for the recorder semantics and the ``RAY_TPU_TRACE`` /
+``RAY_TPU_TRACE_BUFFER`` env knobs.
+
+Instrumentation:
+
+    from ray_tpu import tracing
+
+    if tracing.ENABLED:
+        with tracing.span("my.stage", attrs={"bytes": n}) as sp:
+            ...
+            sp["replica"] = rid
+
+Harvest / export (driver-side):
+
+    spans = tracing.harvest()              # every process's buffer
+    trees = tracing.trace_trees(spans)     # trace_id -> connected tree
+    tracing.export_chrome_file("/tmp/t.json", spans)
+    tracing.export_otlp_file("/tmp/o.json", spans)
+"""
+from __future__ import annotations
+
+from ray_tpu._private import spans as _impl
+
+# Recorder surface (live module flag ENABLED comes via __getattr__).
+span = _impl.span
+context = _impl.context
+emit = _impl.emit
+emit_stamps = _impl.emit_stamps
+current = _impl.current
+capture = _impl.capture
+set_enabled = _impl.set_enabled
+set_process_label = _impl.set_process_label
+snapshot = _impl.snapshot
+clear = _impl.clear
+stats = _impl.stats
+control = _impl.control
+ENV_VAR = _impl.ENV_VAR
+
+
+def __getattr__(name):
+    # ENABLED is a mutable module flag — read it live off the
+    # implementation module; an import-time snapshot would never flip.
+    return getattr(_impl, name)
+
+
+# ------------------------------------------------------------- harvest
+def harvest(trace_id: str | None = None, clear_buffers: bool = False,
+            timeout: float = 20.0) -> list[dict]:
+    """Collect every process's span buffer — this process's directly,
+    the cluster's through the controller's `spans` verb (the same
+    controller→agents→workers broadcast fan-out as the failpoints
+    verb) — and return one flat span list, each record annotated with
+    the owning process's label."""
+    merged: list[dict] = []
+    seen: set = set()
+
+    def _take(reply) -> None:
+        # In-process topologies (cluster_utils: driver, agents and the
+        # controller can share one interpreter) return the SAME ring
+        # through several fan-out legs — dedupe by the process's boot
+        # token (falling back to pid for older replies; bare pid alone
+        # collides across hosts, where every container starts at low
+        # pids).
+        if not isinstance(reply, dict) or "spans" not in reply:
+            return
+        key = reply.get("boot") or reply.get("pid")
+        if key in seen:
+            return
+        seen.add(key)
+        proc = reply.get("proc", "?")
+        for rec in reply.get("spans", ()):
+            if trace_id and rec.get("tid") != trace_id:
+                continue
+            merged.append({**rec, "proc": proc})
+
+    _take(_impl.control({"op": "collect", "trace_id": trace_id,
+                         "clear": clear_buffers}))
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        reply, _ = w.call(w.controller_addr, "spans",
+                          {"op": "collect", "broadcast": True,
+                           "trace_id": trace_id,
+                           "clear": clear_buffers},
+                          timeout=timeout)
+    except Exception:  # noqa: BLE001 - no cluster: local buffer only
+        reply = {}
+    _take(reply)
+    for node in (reply.get("nodes") or {}).values():
+        if not isinstance(node, dict):
+            continue
+        _take(node)
+        for wrep in (node.get("workers") or {}).values():
+            _take(wrep)
+    merged.sort(key=lambda r: r.get("t0", 0.0))
+    return merged
+
+
+def traces(spans_list: list[dict]) -> dict[str, list[dict]]:
+    """Group a harvested span list by trace_id (insertion keeps t0
+    order from harvest)."""
+    out: dict[str, list[dict]] = {}
+    for rec in spans_list:
+        out.setdefault(rec["tid"], []).append(rec)
+    return out
+
+
+def trace_trees(spans_list: list[dict]) -> dict[str, list[dict]]:
+    """trace_id -> list of root span nodes, each
+    {"span": rec, "children": [...]} — the connected per-request tree.
+    A span whose parent is missing from the harvest (overwritten ring
+    slot, dead process) becomes a root rather than vanishing."""
+    out: dict[str, list[dict]] = {}
+    for tid, recs in traces(spans_list).items():
+        nodes = {r["sid"]: {"span": r, "children": []} for r in recs}
+        roots = []
+        for r in recs:
+            node = nodes[r["sid"]]
+            parent = nodes.get(r.get("par") or "")
+            if parent is not None and parent is not node:
+                parent["children"].append(node)
+            else:
+                roots.append(node)
+        out[tid] = roots
+    return out
+
+
+def connected(spans_list: list[dict], trace_id: str) -> bool:
+    """True when the trace forms ONE tree: a single root every other
+    span reaches through parent links (the acceptance shape for a
+    disaggregated serve request)."""
+    trees = trace_trees(spans_list).get(trace_id, [])
+    return len(trees) == 1
+
+
+# -------------------------------------------------------------- export
+def chrome_trace(spans_list: list[dict]) -> dict:
+    """Chrome trace JSON (the chrome://tracing "traceEvents" shape, the
+    same document family as /api/v0/timeline): one complete ("X") event
+    per span, grouped by process."""
+    events = []
+    for r in spans_list:
+        events.append({
+            "name": r["name"], "ph": "X", "cat": "raytpu",
+            "ts": r["t0"] * 1e6,
+            "dur": max(0.0, (r["t1"] - r["t0"]) * 1e6),
+            "pid": r.get("proc", r.get("pid", 0)),
+            "tid": r["tid"][:16],
+            "args": {**r.get("attrs", {}), "trace_id": r["tid"],
+                     "span_id": r["sid"], "parent": r.get("par", "")},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def otlp_document(spans_list: list[dict],
+                  service_name: str = "ray_tpu") -> dict:
+    """OTLP/JSON export (the `resourceSpans` envelope of
+    utils/tracing.py, fed from recorder spans instead of task events)."""
+    from ray_tpu.utils import tracing as _ut
+
+    return _ut.otlp_from_recorder(spans_list, service_name)
+
+
+def export_chrome_file(path: str,
+                       spans_list: list[dict] | None = None) -> int:
+    import json
+
+    if spans_list is None:
+        spans_list = harvest()
+    doc = chrome_trace(spans_list)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
+def export_otlp_file(path: str,
+                     spans_list: list[dict] | None = None,
+                     service_name: str = "ray_tpu") -> int:
+    import json
+
+    if spans_list is None:
+        spans_list = harvest()
+    doc = otlp_document(spans_list, service_name)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["resourceSpans"][0]["scopeSpans"][0]["spans"])

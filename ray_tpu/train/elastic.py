@@ -50,6 +50,7 @@ from typing import Callable
 
 import ray_tpu
 from ray_tpu import collective as col
+from ray_tpu import tracing
 from ray_tpu.train import backend_executor as _be
 
 logger = logging.getLogger(__name__)
@@ -425,11 +426,18 @@ class ElasticRun:
         return survivors
 
     def _reform(self, roster: list[int], kind: str) -> None:
-        self.epoch += 1
-        self.active = roster
-        workers = [self.wg.workers[s] for s in roster]
-        self.exec.backend.on_epoch_start(workers, self.epoch)
-        self._post_autoscaler_demand()
+        # Flight recorder: one span per membership transition (the MTTR
+        # anatomy — group destroy, backend re-init — lands on the same
+        # timeline as the collectives it unblocks).
+        with tracing.span(f"elastic.{kind}",
+                          attrs={"world": len(roster),
+                                 "trial": self.trial}) as sp:
+            self.epoch += 1
+            sp["epoch"] = self.epoch
+            self.active = roster
+            workers = [self.wg.workers[s] for s in roster]
+            self.exec.backend.on_epoch_start(workers, self.epoch)
+            self._post_autoscaler_demand()
         self.stats["transitions"].append(
             {"epoch": self.epoch, "kind": kind, "world": len(roster)})
         logger.warning("membership epoch %d (%s): world_size=%d "

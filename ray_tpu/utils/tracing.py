@@ -135,6 +135,60 @@ def export_otlp_file(path: str, events: list[dict] | None = None,
     return len(spans)
 
 
+def otlp_from_recorder(spans_list: list[dict],
+                       service_name: str = "ray_tpu") -> dict:
+    """OTLP/JSON export document built from flight-recorder spans
+    (`ray_tpu.tracing.harvest()` records) instead of task events — the
+    same `resourceSpans` envelope, so both sources replay against one
+    collector.  Recorder ids are already hex; `_hex_id` normalizes
+    width (task ids are longer than recorder ids)."""
+    otlp_spans = []
+    for r in spans_list:
+        attrs = [{"key": f"ray_tpu.{k}", "value": _attr_value(v)}
+                 for k, v in (r.get("attrs") or {}).items()]
+        attrs.append({"key": "ray_tpu.proc",
+                      "value": {"stringValue":
+                                str(r.get("proc", r.get("pid", "")))}})
+        failed = bool((r.get("attrs") or {}).get("error"))
+        otlp_spans.append({
+            "traceId": _hex_id(r["tid"], 32),
+            "spanId": _hex_id(r["sid"], 16),
+            "parentSpanId": _hex_id(r["par"], 16) if r.get("par")
+            else "",
+            "name": r["name"],
+            "kind": 1,
+            "startTimeUnixNano": str(int(r["t0"] * 1e9)),
+            "endTimeUnixNano": str(int(r["t1"] * 1e9)),
+            "status": {"code": _ERROR if failed else _OK},
+            "attributes": attrs,
+        })
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": service_name}},
+                {"key": "telemetry.sdk.name",
+                 "value": {"stringValue": "ray_tpu.tracing"}},
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.flight_recorder",
+                          "version": "1"},
+                "spans": otlp_spans,
+            }],
+        }],
+    }
+
+
+def _attr_value(v: Any) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
 def export_otlp_http(endpoint: str, events: list[dict] | None = None,
                      service_name: str = "ray_tpu",
                      timeout: float = 10.0) -> int:

@@ -1,0 +1,397 @@
+"""Cluster flight recorder (ISSUE 10): always-on cross-process request
+tracing with one connected timeline per serve request.
+
+Covers the tentpole's acceptance shape end-to-end:
+  - recorder mechanics: ring bound, kill switch, context nesting;
+  - cross-process propagation: driver → actor → nested task share ONE
+    trace_id with parent links intact;
+  - a disaggregated prefill/decode serve request produces a single
+    connected trace spanning router (driver), prefill replica and
+    decode replica processes, with the KV-migration spans
+    (kv_export → put → pull → kv_import) present, exported as valid
+    Chrome trace JSON and the OTLP document shape;
+  - harvest survives a SIGKILLed replica (chaos marker): the surviving
+    side's spans collect cleanly — bounded, no hang, no corruption.
+
+Engine tests run debug-scale fp32 on the CPU mesh (the
+test_pd_disagg.py discipline).
+"""
+import json
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=128, remat=False, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+PROMPT = [(i * 7 + 3) % 127 + 1 for i in range(21)]
+
+
+# ------------------------------------------------------------ recorder
+def test_span_nesting_and_ids():
+    from ray_tpu import tracing
+    from ray_tpu._private import spans as impl
+
+    with tracing.span("t.root") as root_attrs:
+        root_ctx = tracing.current()
+        root_attrs["k"] = 1
+        with tracing.span("t.child"):
+            child_ctx = tracing.current()
+            tracing.emit("t.leaf", time.time())
+    after = tracing.current()
+    # Context restored outside the block (no leak into later work).
+    assert after is None or after != child_ctx
+    assert child_ctx[0] == root_ctx[0]          # same trace
+    assert child_ctx[1] != root_ctx[1]          # own span id
+    recs = {r["name"]: r for r in impl.snapshot(root_ctx[0])}
+    assert set(recs) == {"t.root", "t.child", "t.leaf"}
+    assert recs["t.child"]["par"] == root_ctx[1]
+    assert recs["t.leaf"]["par"] == recs["t.child"]["sid"]
+    assert recs["t.root"]["attrs"]["k"] == 1
+    local = [{**r, "proc": "local"} for r in impl.snapshot(root_ctx[0])]
+    from ray_tpu import tracing as t
+
+    assert t.connected(local, root_ctx[0])
+
+
+def test_ring_is_bounded_and_kill_switch_is_free():
+    from ray_tpu._private import spans as impl
+
+    cap = impl._CAPACITY
+    before = impl.stats()["emitted"]
+    for i in range(cap + 50):
+        impl.emit("t.flood", time.time())
+    st = impl.stats()
+    assert st["buffered"] <= cap
+    assert st["emitted"] >= before + cap + 50
+    # Kill switch: no records, context manager still yields.
+    impl.set_enabled(False)
+    try:
+        n0 = impl.stats()["emitted"]
+        with impl.span("t.off") as sp:
+            sp["x"] = 1
+        impl.emit("t.off2", time.time())
+        assert impl.stats()["emitted"] == n0
+        import os
+
+        assert os.environ["RAY_TPU_TRACE"] == "0"
+    finally:
+        impl.set_enabled(True)
+
+
+def test_control_verb_roundtrips_msgpack():
+    import msgpack
+
+    from ray_tpu._private import spans as impl
+
+    # Exotic attr values must be coerced, never poison the harvest.
+    impl.emit("t.attr", time.time(),
+              attrs={"obj": object(), "f": 1.5, "b": True, "s": "x",
+                     "n": None})
+    reply = impl.control({"op": "collect"})
+    packed = msgpack.packb(reply, use_bin_type=True)
+    back = msgpack.unpackb(packed, raw=False)
+    rec = next(r for r in back["spans"] if r["name"] == "t.attr")
+    assert rec["attrs"]["f"] == 1.5 and rec["attrs"]["b"] is True
+    assert isinstance(rec["attrs"]["obj"], str)
+
+
+# ------------------------------------------------- cross-process traces
+def test_driver_actor_nested_task_share_one_trace(ray_shared):
+    import ray_tpu
+    from ray_tpu import tracing
+
+    @ray_tpu.remote
+    def nested(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class Middle:
+        def go(self, x):
+            return ray_tpu.get(nested.remote(x)) + 1
+
+    a = Middle.remote()
+    with tracing.span("t.req") as _:
+        ctx = tracing.current()
+        out = ray_tpu.get(a.go.remote(3))
+    assert out == 7
+    spans = tracing.harvest(trace_id=ctx[0])
+    names = {s["name"] for s in spans}
+    assert "t.req" in names
+    assert any(n.startswith("actor.go") for n in names), names
+    assert any(n.startswith("task.") for n in names), names
+    # One trace, parent links intact, spanning >= 2 processes.
+    assert tracing.connected(spans, ctx[0]), [
+        (s["name"], s["sid"], s["par"]) for s in spans]
+    assert len({s["proc"] for s in spans}) >= 2
+    # Every span of the trace shares the trace_id by construction;
+    # the actor's span must be a child of the driver's root span.
+    root = next(s for s in spans if s["name"] == "t.req")
+    actor_span = next(s for s in spans if s["name"].startswith("actor."))
+    assert actor_span["par"] == root["sid"]
+
+
+def test_collective_op_emits_phase_span(ray_shared):
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import tracing
+
+    @ray_tpu.remote
+    class Rank:
+        def init(self, world, rank, name):
+            from ray_tpu import collective
+
+            collective.init_collective_group(world, rank,
+                                             group_name=name)
+            return True
+
+        def reduce(self, name):
+            from ray_tpu import collective
+
+            return collective.allreduce(
+                np.ones(8, np.float32), group_name=name).tolist()
+
+    ranks = [Rank.remote() for _ in range(2)]
+    ray_tpu.get([r.init.remote(2, i, "fr_g") for i, r in
+                 enumerate(ranks)], timeout=120)
+    with tracing.span("t.step") as _:
+        ctx = tracing.current()
+        outs = ray_tpu.get([r.reduce.remote("fr_g") for r in ranks],
+                           timeout=120)
+    assert all(o == [2.0] * 8 for o in outs)
+    spans = tracing.harvest(trace_id=ctx[0])
+    col = [s for s in spans if s["name"].startswith("collective.")]
+    # Both ranks recorded their op with phase/byte accounting attrs.
+    assert len(col) >= 2, [s["name"] for s in spans]
+    assert all(s["attrs"].get("world") == 2 for s in col)
+    assert all("schedule" in s["attrs"] for s in col)
+
+
+# ------------------------------------------------------- engine anatomy
+def _engine(small, **kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = small
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("steps_per_sync", 4)
+    eng = LLMEngine(cfg, params, seed=0, paged=True, **kw)
+    eng.start()
+    return eng
+
+
+def test_engine_request_stage_spans_and_histograms(small):
+    from ray_tpu import tracing
+    from ray_tpu._private import spans as impl
+    from ray_tpu.utils import metrics as um
+
+    eng = _engine(small, name="fr_eng")
+    try:
+        with tracing.span("t.serve") as _:
+            ctx = tracing.current()
+            out = eng.generate(PROMPT, max_new_tokens=8)
+        assert len(out["tokens"]) == 8
+        recs = [r for r in impl.snapshot(ctx[0])]
+        names = [r["name"] for r in recs]
+        for want in ("llm.queue", "llm.prefill", "llm.first_token",
+                     "llm.decode_window"):
+            assert want in names, names
+        # 8 tokens at 4 steps/sync: first token from prefill, then the
+        # decode windows that produced the remaining 7.
+        assert names.count("llm.decode_window") >= 2
+        pre = next(r for r in recs if r["name"] == "llm.prefill")
+        assert pre["attrs"]["prompt_tokens"] == len(PROMPT)
+        ft = next(r for r in recs if r["name"] == "llm.first_token")
+        assert ft["attrs"]["ttft_ms"] >= 0
+        # Latency histograms observed with per-stage tags.
+        h = um.get_or_create(um.Histogram, "serve_request_ttft_ms")
+        snap = h.snapshot()
+        assert any(v["tags"].get("engine") == "fr_eng"
+                   for v in snap["values"])
+        st = um.get_or_create(um.Histogram, "serve_request_stage_ms")
+        stages = {v["tags"]["stage"] for v in st.snapshot()["values"]
+                  if v["tags"].get("engine") == "fr_eng"}
+        assert {"queue", "prefill", "decode"} <= stages
+    finally:
+        eng.stop()
+
+
+def test_engine_kill_switch_same_run(small):
+    """RAY_TPU_TRACE=0 semantics mid-process: requests served with the
+    recorder off emit zero spans; flipping it back restores them — the
+    same-run A/B the bench overhead row rides on."""
+    from ray_tpu import tracing
+    from ray_tpu._private import spans as impl
+
+    eng = _engine(small, name="fr_ab")
+    try:
+        impl.set_enabled(False)
+        n0 = impl.stats()["emitted"]
+        with tracing.span("t.off"):
+            eng.generate(PROMPT, max_new_tokens=4)
+        assert impl.stats()["emitted"] == n0
+        impl.set_enabled(True)
+        with tracing.span("t.on") as _:
+            ctx = tracing.current()
+            eng.generate(PROMPT[:12], max_new_tokens=4)
+        assert any(r["name"] == "llm.decode_window"
+                   for r in impl.snapshot(ctx[0]))
+    finally:
+        impl.set_enabled(True)
+        eng.stop()
+
+
+# --------------------------------------------------- serve PD-disagg
+@pytest.fixture
+def serve_ray(small):
+    import ray_tpu
+    from ray_tpu import serve
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(resources={"CPU": 4})
+    serve.start()
+    yield serve
+    serve.shutdown()
+
+
+def _pd_app(serve, cfg, *, decode_replicas=1, seed=11):
+    from ray_tpu.serve.llm import LLMServer
+
+    ekw = dict(max_batch=2, max_len=64, page_size=8, steps_per_sync=4,
+               seed=seed)
+    Decode = serve.deployment(LLMServer).options(
+        name="decode", num_replicas=decode_replicas,
+        max_ongoing_requests=4)
+    decode_app = Decode.bind(cfg, role="decode", **ekw)
+    Prefill = serve.deployment(LLMServer).options(
+        name="prefill", num_replicas=1, max_ongoing_requests=4)
+    return Prefill.bind(cfg, role="prefill",
+                        decode_deployment=decode_app, **ekw)
+
+
+def test_pd_disagg_one_connected_trace_three_processes(serve_ray, small):
+    """The acceptance criterion: one serve request under disaggregated
+    prefill/decode produces a SINGLE connected trace (shared trace_id,
+    parent links intact) spanning the router process, the prefill
+    replica and the decode replica, with the KV-migration spans
+    present — exported as valid Chrome trace JSON and the OTLP
+    document shape."""
+    from ray_tpu import tracing
+
+    cfg, _params = small
+    h = serve_ray.run(_pd_app(serve_ray, cfg), name="fr_pd",
+                      route_prefix="/frpd")
+    try:
+        with tracing.span("t.pd_request") as _:
+            ctx = tracing.current()
+            out = h.remote({"prompt": PROMPT[:13],
+                            "max_new_tokens": 6}).result(timeout_s=300)
+        assert out.get("disagg") is True
+        assert len(out["tokens"]) == 6
+        deadline = time.time() + 60
+        while True:
+            spans = tracing.harvest(trace_id=ctx[0])
+            names = {s["name"] for s in spans}
+            wanted = {"t.pd_request", "serve.route", "serve.kv_put",
+                      "serve.kv_pull", "llm.kv_export", "llm.kv_import",
+                      "llm.prefill", "llm.decode_window"}
+            if wanted <= names or time.time() > deadline:
+                break
+            time.sleep(0.5)     # export-thread spans land async
+        assert wanted <= names, sorted(names)
+        # Both replica hops execute as Replica.handle_request (the
+        # deployment method name rides as an argument): one span on the
+        # prefill replica, one on the decode replica.
+        handler_procs = {s["proc"] for s in spans
+                         if s["name"] == "actor.handle_request"}
+        assert len(handler_procs) >= 2, sorted(
+            (s["name"], s["proc"]) for s in spans)
+        # ONE connected tree across >= 3 processes.
+        assert tracing.connected(spans, ctx[0]), [
+            (s["name"], s["proc"], s["sid"], s["par"]) for s in spans]
+        procs = {s["proc"] for s in spans}
+        assert len(procs) >= 3, procs
+        # Valid Chrome trace JSON: every span an X event, json-clean.
+        chrome = tracing.chrome_trace(spans)
+        chrome2 = json.loads(json.dumps(chrome))
+        assert len(chrome2["traceEvents"]) == len(spans)
+        assert all(e["ph"] == "X" and e["dur"] >= 0
+                   for e in chrome2["traceEvents"])
+        # Valid OTLP document shape: fixed-width hex ids, one scope.
+        otlp = json.loads(json.dumps(tracing.otlp_document(spans)))
+        oss = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(oss) == len(spans)
+        assert all(len(s["traceId"]) == 32 and len(s["spanId"]) == 16
+                   for s in oss)
+        tid32 = {s["traceId"] for s in oss}
+        assert len(tid32) == 1
+    finally:
+        serve_ray.delete("fr_pd")
+
+
+# ------------------------------------------------------------- chaos
+@pytest.mark.chaos
+def test_harvest_survives_sigkilled_replica(serve_ray, small):
+    """A replica SIGKILLed mid-request: the requeued request completes
+    on the survivor, and a cluster-wide harvest right after returns the
+    surviving side's spans cleanly — bounded time, no hang, no buffer
+    corruption (the dead worker costs one bounded fan-out timeout)."""
+    import ray_tpu
+    from ray_tpu import tracing
+    from ray_tpu._private import failpoints
+
+    cfg, _params = small
+
+    class Echo:
+        def __call__(self, request):
+            return {"ok": True, "pid": __import__("os").getpid()}
+
+    Dep = serve_ray.deployment(Echo).options(
+        name="echo", num_replicas=2, max_ongoing_requests=4)
+    h = serve_ray.run(Dep.bind(), name="fr_chaos",
+                      route_prefix="/frchaos")
+    try:
+        # Warm both replicas, then arm a one-shot crash cluster-wide.
+        for _ in range(4):
+            assert h.remote({"q": 1}).result(timeout_s=120)["ok"]
+        w = ray_tpu._private.worker.global_worker()
+        w.call(w.controller_addr, "failpoints",
+               {"op": "set", "spec": "serve.replica_call=nth:1+crash",
+                "broadcast": True}, timeout=30.0)
+        with tracing.span("t.chaos") as _:
+            ctx = tracing.current()
+            out = h.remote({"q": 2}).result(timeout_s=120)
+        assert out["ok"]
+        t0 = time.time()
+        spans = tracing.harvest(timeout=30.0)
+        elapsed = time.time() - t0
+        assert elapsed < 45, elapsed
+        mine = [s for s in spans if s["tid"] == ctx[0]]
+        names = {s["name"] for s in mine}
+        assert "t.chaos" in names and "serve.route" in names, names
+        # The survivor's execution span made it out.
+        assert any(n.startswith("actor.handle_request")
+                   for n in names), names
+    finally:
+        failpoints.reset()
+        try:
+            w = ray_tpu._private.worker.global_worker()
+            w.call(w.controller_addr, "failpoints",
+                   {"op": "clear", "broadcast": True}, timeout=30.0)
+        except Exception:  # noqa: BLE001 - best-effort disarm
+            pass
+        serve_ray.delete("fr_chaos")

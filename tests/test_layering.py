@@ -112,7 +112,7 @@ RLHF_ALLOWED_PREFIXES = (
     "ray_tpu.collective", "ray_tpu.models", "ray_tpu.ops",
     "ray_tpu.serve", "ray_tpu.rl", "ray_tpu.train.checkpoint",
     "ray_tpu.utils", "ray_tpu.parallel", "ray_tpu.failpoints",
-    "ray_tpu.object_ref", "ray_tpu.exceptions",
+    "ray_tpu.tracing", "ray_tpu.object_ref", "ray_tpu.exceptions",
 )
 
 
@@ -151,6 +151,47 @@ def test_rlhf_modules_import_only_core_and_public_facades():
 @pytest.mark.parametrize("mod", ["ray_tpu.rl.rlhf",
                                  "ray_tpu.rl.rollout_llm"])
 def test_rlhf_modules_importable_standalone(mod):
+    import importlib
+
+    assert importlib.import_module(mod) is not None
+
+
+# --------------------------------------------- flight recorder (ISSUE 10)
+# Library code reaches the recorder ONLY through the ray_tpu.tracing
+# facade (the failpoints shape); the implementation module stays a
+# runtime internal.
+TRACED_LIBRARY_MODULES = (
+    "serve/handle.py", "serve/replica.py", "serve/llm.py",
+    "collective/collective.py", "train/elastic.py", "rl/rlhf.py",
+)
+
+
+def test_tracing_facade_exists_and_layers_hold():
+    """The facade and its implementation exist, and the instrumented
+    library modules import tracing through the facade — never
+    ray_tpu._private.spans (the generic _private ban in _violations()
+    enforces the negative; this pins the positive so a refactor can't
+    silently drop the instrumentation)."""
+    assert os.path.exists(os.path.join(PKG, "tracing.py"))
+    assert os.path.exists(os.path.join(PKG, "_private", "spans.py"))
+    for rel in TRACED_LIBRARY_MODULES:
+        path = os.path.join(PKG, rel)
+        mods = {m for m, _ in _imports_of(path)}
+        assert ("ray_tpu.tracing" in mods), (
+            f"{rel} lost its flight-recorder instrumentation "
+            f"(no ray_tpu.tracing import)")
+        assert not any(m.startswith("ray_tpu._private.spans")
+                       for m in mods), rel
+
+
+def test_tracing_modules_are_walked_by_the_layering_scan():
+    for rel in TRACED_LIBRARY_MODULES:
+        assert list(_imports_of(os.path.join(PKG, rel))), rel
+
+
+@pytest.mark.parametrize("mod", ["ray_tpu.tracing",
+                                 "ray_tpu._private.spans"])
+def test_tracing_importable_standalone(mod):
     import importlib
 
     assert importlib.import_module(mod) is not None
