@@ -1677,6 +1677,169 @@ def bench_serve_cluster_route() -> dict:
             pass
 
 
+def bench_serve_slo() -> dict:
+    """SLO-driven autoscaling + overload control (round 15): a
+    diurnal+spike trace through the full serve stack, same-run A/B via
+    the controller's set_autoscale_enabled RPC (the controller actor
+    outlives the driver's env, so the RAY_TPU_SERVE_AUTOSCALE switch
+    can't flip it mid-run — the RPC can).
+
+    Trace: a quiet warm phase (the diurnal trough), then a 12-way
+    concurrent spike against a deployment whose autoscaling_config
+    targets p99 queue-wait.  Arm OFF holds 1 static replica — the
+    spike piles into bounded admission queues, so requests either
+    attain late or reject early (NEVER timeout: the overload contract).
+    Arm ON scales toward max_replicas; rows:
+
+      serve_slo_attainment_pct  — % of spike requests completing
+                                  within the SLO bound, autoscaled arm
+                                  (higher is better; compare nested
+                                  off-arm value for the A/B gap)
+      serve_time_to_scale_ms    — spike start → second replica RUNNING
+                                  (lower is better; the serve MTTR
+                                  analog of elastic_regrow_mttr_ms)
+
+    Early rejection shows up as serve_slo.{on,off}.rejected with
+    rejected requests resolving in bounded time (no timeout storm)."""
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+    import threading as _th
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 8})
+    service_s = 0.06
+    slo_ms = 400.0           # queue target + service + router slack
+    spike_threads, spike_s = 12, 8.0
+    out: dict = {}
+    try:
+        serve.start()
+
+        # max_queued below the spike width so the static arm really
+        # exercises early rejection (12 concurrent senders vs
+        # 2 executing + 6 queued on one replica).
+        @serve.deployment(max_ongoing_requests=2,
+                          max_queued_requests=6,
+                          autoscaling_config={
+                              "min_replicas": 1, "max_replicas": 3,
+                              "target_ongoing_requests": 2.0,
+                              "upscale_delay_s": 0.3,
+                              "downscale_delay_s": 60.0,
+                              "target_queue_wait_ms": 120.0})
+        class SLOed:
+            def __call__(self, x):
+                time.sleep(service_s)
+                return x
+
+        h = serve.run(SLOed.bind(), name="slo_bench",
+                      route_prefix="/slo")
+        for i in range(4):                       # warm the path
+            h.remote(i).result(timeout_s=60)
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+        def replicas_running() -> int:
+            st = serve.status().get("slo_bench", {})
+            return st.get("deployments", {}).get(
+                "SLOed", {}).get("replicas", 0)
+
+        def run_leg(autoscale: bool) -> dict:
+            ray_tpu.get(ctrl.set_autoscale_enabled.remote(autoscale),
+                        timeout=30.0)
+            lat_ms: list[float] = []
+            rejected = [0]
+            timeouts = [0]
+            stop = _th.Event()
+            t_spike = time.perf_counter()
+            scale_ready = [None]
+
+            def poll_scale():
+                while not stop.is_set():
+                    if replicas_running() >= 2:
+                        scale_ready[0] = (time.perf_counter()
+                                          - t_spike) * 1000.0
+                        return
+                    time.sleep(0.05)
+
+            def flood():
+                # One handle per thread: a single handle's router caps
+                # dispatch at max_ongoing per replica, so only
+                # independent handles actually exercise the replica's
+                # bounded admission queue.
+                hh = serve.get_app_handle("slo_bench")
+                from ray_tpu.exceptions import (GetTimeoutError,
+                                                ServeOverloadedError)
+
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        hh.remote(1).result(timeout_s=30)
+                        lat_ms.append(
+                            (time.perf_counter() - t0) * 1000.0)
+                    except ServeOverloadedError:
+                        rejected[0] += 1
+                        time.sleep(0.1)      # the retry-after contract
+                    except GetTimeoutError:
+                        timeouts[0] += 1
+                    except Exception:  # noqa: BLE001 - teardown races
+                        return
+
+            poller = _th.Thread(target=poll_scale, daemon=True)
+            poller.start()
+            threads = [_th.Thread(target=flood, daemon=True)
+                       for _ in range(spike_threads)]
+            for t in threads:
+                t.start()
+            time.sleep(spike_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=35)
+            poller.join(timeout=1)
+            total = len(lat_ms) + rejected[0] + timeouts[0]
+            attained = sum(1 for v in lat_ms if v <= slo_ms)
+            return {
+                "requests": total,
+                "attainment_pct": round(100.0 * attained
+                                        / max(1, total), 1),
+                "rejected": rejected[0],
+                "timeouts": timeouts[0],
+                "p99_ms": round(sorted(lat_ms)[
+                    min(len(lat_ms) - 1,
+                        int(0.99 * len(lat_ms)))], 1) if lat_ms
+                else None,
+                "replicas_end": replicas_running(),
+                "time_to_scale_ms": None if scale_ready[0] is None
+                else round(scale_ready[0], 1),
+            }
+
+        off = run_leg(False)       # static arm first: still 1 replica
+        on = run_leg(True)
+        ray_tpu.get(ctrl.set_autoscale_enabled.remote(None),
+                    timeout=30.0)
+        out = {
+            "serve_slo": {"on": on, "off": off, "slo_ms": slo_ms,
+                          "spike_threads": spike_threads,
+                          "service_ms": service_s * 1000},
+            "serve_slo_attainment_pct": on["attainment_pct"],
+        }
+        if on["time_to_scale_ms"] is not None:
+            out["serve_time_to_scale_ms"] = on["time_to_scale_ms"]
+        serve.delete("slo_bench")
+        return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def bench_rlhf() -> dict:
     """Online RLHF loop (round 13): three windows through the
     in-process loop on the debug model.
@@ -1906,7 +2069,9 @@ def _vs_previous_round(extra: dict) -> dict:
     # it against the 3% acceptance bar, absolutely.  Its companion
     # serve_trace_{on,off}_tokens_per_s rows ride the *_per_s guard
     # and serve_ttft_traced_ms rides the _ms guard.
-    higher_better = {"rlhf_rollout_hit_rate"}
+    # Round 15: SLO attainment is a percent (higher is better — no
+    # suffix expresses that); time-to-scale rides the _ms guard.
+    higher_better = {"rlhf_rollout_hit_rate", "serve_slo_attainment_pct"}
     lower_better = {"rlhf_weight_lag_windows"}
     absolute_bars = {"trace_overhead_pct": 3.0}
     out = {}
@@ -2039,6 +2204,13 @@ def main() -> None:
             row["pd"]["kv_migrate_gib_per_s"]
     except Exception as e:  # noqa: BLE001
         extra["serve_cluster_route"] = {"error": repr(e)}
+    _flush_partial(extra)
+    try:
+        # Diurnal+spike SLO trace: serve boot + two ~8s spike legs;
+        # replica scale-out (forked workers) dominates the ON leg.
+        extra.update(_with_timeout(bench_serve_slo, 300))
+    except Exception as e:  # noqa: BLE001
+        extra["serve_slo"] = {"error": repr(e)}
     _flush_partial(extra)
     try:
         # In-process loop on the debug model: two rollout arms + a

@@ -35,16 +35,49 @@ class AutoscalerConfig:
 
 
 def request_resources(num_cpus: float = 0, bundles: list | None = None,
-                      controller_addr: str | None = None) -> None:
+                      controller_addr: str | None = None,
+                      requester: str = "default") -> None:
     """Pin a minimum demand floor (ray: autoscaler/sdk.py
-    request_resources); the autoscaler keeps enough nodes for it."""
+    request_resources); the autoscaler keeps enough nodes for it.
+
+    `requester` scopes the demand: each caller owns its own floor
+    (key `autoscaler_requested:<requester>`) and updates it without
+    clobbering the others' — the serve SLO controller and elastic
+    training both post demand concurrently.  Consumers sum across
+    requesters (merged_demand)."""
     from ray_tpu._private.worker import global_worker
 
     core = global_worker()
     payload = {"num_cpus": num_cpus, "bundles": bundles or []}
+    key = REQUEST_KEY if requester == "default" \
+        else f"{REQUEST_KEY}:{requester}"
     core.call(core.controller_addr, "kv_put",
-              {"ns": "autoscaler", "key": REQUEST_KEY},
+              {"ns": "autoscaler", "key": key},
               [json.dumps(payload).encode()], timeout=10.0)
+
+
+def merged_demand(core, controller_addr: str) -> dict:
+    """Sum the demand floors of every requester: {num_cpus, bundles}.
+    Readers (StandardAutoscaler, autoscaler v2 Reconciler) see one
+    aggregate; a requester that posted an empty floor contributes
+    nothing."""
+    reply, _ = core.call(controller_addr, "kv_keys",
+                         {"ns": "autoscaler", "prefix": REQUEST_KEY},
+                         timeout=10.0)
+    total = {"num_cpus": 0.0, "bundles": []}
+    for key in reply.get("keys", []):
+        try:
+            r, blobs = core.call(controller_addr, "kv_get",
+                                 {"ns": "autoscaler", "key": key},
+                                 timeout=10.0)
+            if not blobs:
+                continue
+            payload = json.loads(bytes(blobs[0]))
+        except Exception:  # noqa: BLE001 - racing a concurrent post
+            continue
+        total["num_cpus"] += payload.get("num_cpus", 0) or 0
+        total["bundles"].extend(payload.get("bundles", []) or [])
+    return total
 
 
 class StandardAutoscaler:
@@ -93,10 +126,7 @@ class StandardAutoscaler:
                                   timeout=30.0)
         nodes = [n for n in reply["nodes"] if n["state"] == "ALIVE"]
         try:
-            r, blobs = self.core.call(
-                self.controller_addr, "kv_get",
-                {"ns": "autoscaler", "key": REQUEST_KEY}, timeout=10.0)
-            requested = json.loads(bytes(blobs[0])) if blobs else {}
+            requested = merged_demand(self.core, self.controller_addr)
         except Exception:  # noqa: BLE001
             requested = {}
         return nodes, requested

@@ -156,6 +156,27 @@ class Reconciler:
     def set_target(self, n: int) -> None:
         self.target_count = max(0, int(n))
 
+    def _demand_nodes(self) -> int:
+        """Node count implied by the request_resources demand floors
+        (summed across requesters — the serve SLO controller posts
+        under 'serve', elastic training under 'elastic'): enough nodes
+        of this reconciler's node_config to cover the requested CPUs.
+        This is the hook that lets demand posting provision nodes
+        through v2 (v1's StandardAutoscaler already read it)."""
+        try:
+            from ray_tpu.autoscaler.autoscaler import merged_demand
+
+            req = merged_demand(self.core, self.controller_addr)
+        except Exception:  # noqa: BLE001 - controller restarting
+            return 0
+        want_cpu = (req.get("num_cpus", 0) or 0) + sum(
+            b.get("CPU", 0) for b in req.get("bundles", []))
+        if want_cpu <= 0:
+            return 0
+        node_cpu = max(1e-9, self.node_config.get(
+            "resources", {}).get("CPU", 1))
+        return int(-(-want_cpu // node_cpu))
+
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
@@ -241,8 +262,13 @@ class Reconciler:
                     pass
 
         # 2. Scale toward the target: queue replacements / drain excess.
+        # The target is the MAX of the explicit set_target and the
+        # request_resources demand floors (the serve SLO loop and
+        # elastic training post these) — demand can raise capacity but
+        # an operator's explicit target is never silently shrunk.
         active = self.im.active()
-        deficit = self.target_count - len(active)
+        deficit = max(self.target_count, self._demand_nodes()) \
+            - len(active)
         for _ in range(max(0, deficit)):
             self.im.add(self.node_config)
         if deficit < 0:

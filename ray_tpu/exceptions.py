@@ -112,6 +112,36 @@ class RuntimeEnvSetupError(RayTpuError):
     pass
 
 
+class ServeOverloadedError(RayTpuError, RuntimeError):
+    """A serve request was rejected at admission: every replica's
+    bounded queue is full (or the router could not place the request
+    before its deadline).  Typed and RETRIABLE — the caller should back
+    off ``retry_after_s`` and resend; the request never started, so a
+    resend cannot double-execute.  Subclasses RuntimeError so legacy
+    ``except RuntimeError`` no-capacity handling keeps working.
+
+    The serve analog of ray: serve.exceptions.BackPressureError."""
+
+    def __init__(self, message: str = "serve deployment overloaded",
+                 deployment: str = "", queue_depth: int = 0,
+                 retry_after_s: float = 1.0):
+        self.deployment = deployment
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"{message} (deployment={deployment!r}, "
+            f"queue_depth={queue_depth}, retry_after_s={retry_after_s})")
+        self._message = message
+
+    def __reduce__(self):
+        # Multi-field exceptions MUST override reduce (see TaskError):
+        # the default replays args=(formatted,) into __init__ and
+        # mangles the fields at every process hop.
+        return (ServeOverloadedError,
+                (self._message, self.deployment, self.queue_depth,
+                 self.retry_after_s))
+
+
 # ----------------------------------------------------- reference aliases
 # Reference-spelled names for drop-in `except ray.exceptions.X` code.
 # Same classes, not look-alikes: an except on either name catches both.

@@ -3,6 +3,7 @@
 Controller reconcile loop + replica actors + power-of-two routing +
 stdlib HTTP proxy (SURVEY §2.3 / §3.5).
 """
+from ray_tpu.exceptions import ServeOverloadedError
 from ray_tpu.serve.api import (HTTPOptions, delete, get_app_handle,
                                get_deployment_handle, get_replica_context,
                                grpc_port, http_port, ingress, list_proxies,
@@ -24,6 +25,7 @@ __all__ = [
     "replica_metrics",
     "apply_config", "ingress", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
+    "ServeOverloadedError",
     "DeploymentHandle", "DeploymentResponse", "Request",
     "LLMEngine", "LLMServer",
 ]

@@ -12,10 +12,14 @@ from typing import Any
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Scale replicas on ongoing-request load (ray: serve/config.py
-    AutoscalingConfig; policy in _private/autoscaling_state.py).
+    """Scale replicas on ongoing-request load AND SLO attainment (ray:
+    serve/config.py AutoscalingConfig; policy in
+    _private/autoscaling_state.py + serve/slo.py here).
 
     target_ongoing_requests: per-replica load the autoscaler steers toward.
+    target_p99_ttft_ms / target_queue_wait_ms: optional SLO targets — a
+    sustained p99 breach scales OUT past the load-based answer, and a
+    near-breach blocks downscale (see slo.slo_desired).
     """
     min_replicas: int = 1
     max_replicas: int = 4
@@ -23,6 +27,8 @@ class AutoscalingConfig:
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
     metrics_interval_s: float = 0.2
+    target_p99_ttft_ms: float | None = None
+    target_queue_wait_ms: float | None = None
 
     def desired(self, total_ongoing: float, current: int) -> int:
         if current == 0:
@@ -33,10 +39,60 @@ class AutoscalingConfig:
         want = math.ceil(want) if want > current else math.floor(want)
         return max(self.min_replicas, min(self.max_replicas, int(want)))
 
+    def validate(self, where: str = "autoscaling_config") -> None:
+        """Field-naming validation (deploy-time: serve/schema.py and the
+        @serve.deployment decorator both call this — a bad config must
+        fail at validation, not at the controller's first decision)."""
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"{where}.min_replicas must be >= 1, got "
+                f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"{where}.max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if not self.target_ongoing_requests > 0:
+            raise ValueError(
+                f"{where}.target_ongoing_requests must be > 0, got "
+                f"{self.target_ongoing_requests}")
+        for name in ("upscale_delay_s", "downscale_delay_s",
+                     "metrics_interval_s"):
+            v = getattr(self, name)
+            if v < 0:
+                raise ValueError(f"{where}.{name} must be >= 0, got {v}")
+        for name in ("target_p99_ttft_ms", "target_queue_wait_ms"):
+            v = getattr(self, name)
+            if v is not None and not v > 0:
+                raise ValueError(
+                    f"{where}.{name} must be > 0 when set, got {v}")
+
+
+def autoscaling_config_from_dict(d: dict,
+                                 where: str = "autoscaling_config"
+                                 ) -> AutoscalingConfig:
+    """dict → validated AutoscalingConfig with field-naming errors
+    (unknown keys, min>max, non-positive targets) — the one conversion
+    path shared by schema.py, deployment.py, and dataclasses_replace."""
+    fields = {f.name for f in dataclasses.fields(AutoscalingConfig)}
+    unknown = set(d) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown {where} keys {sorted(unknown)}; valid: "
+            f"{sorted(fields)}")
+    cfg = AutoscalingConfig(**d)
+    cfg.validate(where)
+    return cfg
+
 
 @dataclasses.dataclass
 class DeploymentConfig:
-    """Per-deployment settings (ray: serve/config.py DeploymentConfig)."""
+    """Per-deployment settings (ray: serve/config.py DeploymentConfig).
+
+    max_queued_requests: replica-side admission queue bound (requests
+    waiting past max_ongoing_requests); beyond it the replica rejects
+    early with ServeOverloadedError instead of queueing unboundedly.
+    -1 = default bound of 2 x max_ongoing_requests; 0 = no queue.
+    """
     num_replicas: int = 1
     max_ongoing_requests: int = 8
     autoscaling_config: AutoscalingConfig | None = None
@@ -45,6 +101,7 @@ class DeploymentConfig:
     health_check_timeout_s: float = 10.0
     graceful_shutdown_timeout_s: float = 5.0
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    max_queued_requests: int = -1
 
 
 # Replica lifecycle states (ray: _private/common.py ReplicaState).

@@ -26,6 +26,9 @@ import traceback
 import uuid
 from typing import Any
 
+from ray_tpu import tracing
+from ray_tpu.serve import slo
+
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
@@ -60,6 +63,10 @@ class _DeploymentState:
         self.superseded = False   # replaced by a newer _DeploymentState
         # autoscale probe in flight: list of (rec, ref) + deadline
         self.probe: tuple[list, float] | None = None
+        # Last completed metrics probe, merged: {total_ongoing,
+        # p99_ttft_ms, p99_queue_ms, n, t} — the SLO loop's decision
+        # input and the PD-rebalance pass's stage-split signal.
+        self.slo_snapshot: dict | None = None
 
 
 class ServeController:
@@ -71,6 +78,16 @@ class ServeController:
         self._apps: dict[str, dict] = {}
         self._http_host = "127.0.0.1"
         self._http_port = 0
+        # SLO autoscaling kill-switch override (set_autoscale_enabled
+        # RPC: same-run A/B without touching this process's env);
+        # None = follow RAY_TPU_SERVE_AUTOSCALE.
+        self._autoscale_override: bool | None = None
+        # request_resources demand posting: re-post only when a target
+        # changed (dirty) and at most every few seconds.
+        self._demand_dirty = False
+        self._last_demand_post = 0.0
+        # (app, prefill_deployment) -> last pool-ratio shift time.
+        self._last_pd_shift: dict[tuple, float] = {}
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-ctrl")
@@ -118,6 +135,10 @@ class ServeController:
                     new_st.draining.update(cur.replicas)
                     new_st.draining.update(cur.draining)
                 app["deployments"][d["name"]] = new_st
+            # Post the INITIAL demand floor too: a fresh deploy whose
+            # min_replicas exceed current capacity needs nodes before
+            # any scale decision ever changes a target.
+            self._demand_dirty = True
         for st, user_config in reconfigures:
             self._reconfigure_in_place(st, user_config)
 
@@ -143,6 +164,8 @@ class ServeController:
             for st in app["deployments"].values():
                 st.deleting = True
                 st.target_replicas = 0
+        # The deleted app's autoscaler demand floor must shrink too.
+        self._demand_dirty = True
 
     def get_deployment_info(self, app_name: str, deployment: str) -> dict:
         with self._lock:
@@ -246,6 +269,16 @@ class ServeController:
                 for st in app["deployments"].values():
                     st.deleting = True
                     st.target_replicas = 0
+        # Clear the serve demand floor SYNCHRONOUSLY: serve.shutdown
+        # kills this actor within seconds — the throttled reconcile
+        # re-post may never run, and a stale floor would make the
+        # cluster autoscaler hold nodes for phantom replicas forever.
+        try:
+            from ray_tpu.autoscaler import request_resources
+
+            request_resources(bundles=[], requester="serve")
+        except Exception:  # noqa: BLE001 - no autoscaler wired
+            pass
 
     def wait_for_deployments_ready(self, app_name: str,
                                    timeout_s: float = 60.0) -> bool:
@@ -298,6 +331,13 @@ class ServeController:
                     continue
             self._autoscale(st)
             self._reconcile_deployment(st)
+        if self._autoscale_enabled():
+            self._maybe_rebalance_pd()
+        # Demand posting runs even with autoscaling disabled: a floor
+        # posted while enabled must still SHRINK when the switch flips
+        # off or an app is deleted — otherwise the autoscaler would
+        # hold nodes for replicas that no longer exist.
+        self._post_autoscaler_demand()
         with self._lock:
             for app_name, app in list(self._apps.items()):
                 for name, st in list(app["deployments"].items()):
@@ -372,12 +412,27 @@ class ServeController:
                       if (a.get("name") or "").startswith("SERVE_PROXY::")
                       and a.get("state") == "ALIVE")
 
+    def _autoscale_enabled(self) -> bool:
+        """RAY_TPU_SERVE_AUTOSCALE kill switch, overridable live via
+        the set_autoscale_enabled RPC (same-run A/B: the env of a
+        long-lived controller actor can't be flipped from a driver)."""
+        if self._autoscale_override is not None:
+            return self._autoscale_override
+        return slo.autoscale_on()
+
+    def set_autoscale_enabled(self, on: bool | None) -> None:
+        """None = follow the env switch; True/False = force."""
+        self._autoscale_override = on
+
     def _autoscale(self, st: _DeploymentState) -> None:
-        """Scale on total ongoing requests (ray: autoscaling_state.py;
-        metric = replica-reported num_ongoing).  Probes are in-flight
-        ObjectRefs collected on a later tick — never a long block."""
+        """The SLO loop: scale on ongoing-request load AND p99
+        TTFT / queue-wait attainment (ray: autoscaling_state.py scales
+        on ongoing only; the SLO terms consume the same per-replica
+        latency windows that feed the stage histograms through
+        replica_metrics).  Probes are in-flight ObjectRefs collected on
+        a later tick — never a long block."""
         cfg = st.config.autoscaling_config
-        if cfg is None or st.deleting:
+        if cfg is None or st.deleting or not self._autoscale_enabled():
             return
         import ray_tpu
 
@@ -388,12 +443,37 @@ class ServeController:
                 refs, num_returns=len(refs), timeout=0)
             if len(ready) == len(refs) or time.monotonic() > deadline:
                 total = 0.0
+                ttft: list[float] = []
+                queuew: list[float] = []
                 for ref in ready:
                     try:
-                        total += ray_tpu.get(ref, timeout=1.0)
+                        m = ray_tpu.get(ref, timeout=1.0)
                     except Exception:  # noqa: BLE001
-                        pass
+                        continue
+                    if isinstance(m, (int, float)):
+                        total += m          # legacy queue-len probe
+                        continue
+                    if not isinstance(m, dict):
+                        continue
+                    total += m.get("num_ongoing", 0)
+                    qw = (m.get("queue_wait_ms") or {}).get("p99")
+                    if qw is not None:
+                        queuew.append(qw)
+                    s = (m.get("user_stats") or {}).get("slo") or {}
+                    t = (s.get("ttft_ms") or {}).get("p99")
+                    if t is not None:
+                        ttft.append(t)
+                    q2 = (s.get("queue_ms") or {}).get("p99")
+                    if q2 is not None:
+                        queuew.append(q2)
                 st.probe = None
+                # Tail attainment is per-request, not per-replica:
+                # the WORST replica's p99 is the deployment's p99 bound.
+                st.slo_snapshot = {
+                    "total_ongoing": total,
+                    "p99_ttft_ms": max(ttft) if ttft else None,
+                    "p99_queue_ms": max(queuew) if queuew else None,
+                    "n": len(refs_recs), "t": time.monotonic()}
                 self._apply_autoscale_decision(st, cfg, total,
                                                len(refs_recs))
             return
@@ -402,14 +482,18 @@ class ServeController:
                        if rec["state"] == "RUNNING"]
         if not running:
             return
-        refs_recs = [(rec, rec["handle"].get_queue_len.remote())
+        refs_recs = [(rec, rec["handle"].get_metrics.remote())
                      for rec in running]
         st.probe = (refs_recs, time.monotonic() + 5.0)
 
     def _apply_autoscale_decision(self, st, cfg, total: float,
                                   n_running: int) -> None:
-        desired = cfg.desired(total, n_running)
+        snap = st.slo_snapshot or {}
+        desired, reason = slo.slo_desired(
+            cfg, n_running, total, snap.get("p99_ttft_ms"),
+            snap.get("p99_queue_ms"))
         now = time.monotonic()
+        prev = st.target_replicas
         if desired > st.target_replicas:
             if now - st.last_scale_up >= cfg.upscale_delay_s:
                 st.target_replicas = desired
@@ -420,6 +504,123 @@ class ServeController:
                 st.last_scale_down = now
         else:
             st.last_scale_up = st.last_scale_down = now
+        if st.target_replicas != prev:
+            # Flight-recorder span: WHY capacity changed, with the
+            # metrics that drove it (a trace of the spike shows the
+            # breach → scale → recovery chain).
+            if tracing.ENABLED:
+                tracing.emit(
+                    "serve.scale", time.time(),
+                    attrs={"app": st.app, "deployment": st.name,
+                           "from": prev, "to": st.target_replicas,
+                           "reason": reason,
+                           "total_ongoing": round(total, 1),
+                           "p99_ttft_ms": snap.get("p99_ttft_ms"),
+                           "p99_queue_ms": snap.get("p99_queue_ms")})
+            self._demand_dirty = True
+
+    def _post_autoscaler_demand(self) -> None:
+        """Post the autoscaled deployments' aggregate replica demand as
+        a request_resources floor (requester-scoped: never clobbers
+        elastic training's demand) so the autoscaler v2 reconciler
+        provisions nodes for replicas the cluster can't place yet.
+        Throttled: re-posts only after a target changed, at most every
+        2s.  Best-effort — no autoscaler, no harm."""
+        now = time.monotonic()
+        if not self._demand_dirty or now - self._last_demand_post < 2.0:
+            return
+        self._demand_dirty = False
+        self._last_demand_post = now
+        bundles = []
+        with self._lock:
+            for app in self._apps.values():
+                for st in app["deployments"].values():
+                    if st.config.autoscaling_config is None \
+                            or st.deleting:
+                        continue
+                    cpu = st.config.ray_actor_options.get(
+                        "num_cpus", 0.1)
+                    bundles.extend({"CPU": cpu}
+                                   for _ in range(st.target_replicas))
+        try:
+            from ray_tpu.autoscaler import request_resources
+
+            request_resources(bundles=bundles, requester="serve")
+        except Exception:  # noqa: BLE001 - no autoscaler wired
+            pass
+
+    def _maybe_rebalance_pd(self) -> None:
+        """Prefill:decode pool-ratio knob for disaggregated LLM apps:
+        shift ONE replica of budget from the underloaded pool to the
+        overloaded one when the stage split says so (serve/slo.py
+        pd_rebalance) — a knob no single-pool autoscaler has, because
+        it needs the prefill-vs-decode stage attribution.  Cooldown
+        10s per edge; both pools must be autoscaled and have fresh
+        probe snapshots."""
+        with self._lock:
+            edges = []
+            for app_name, app in self._apps.items():
+                deps = app["deployments"]
+                for name, st in deps.items():
+                    kw = st.init_kwargs or {}
+                    if kw.get("role") != "prefill":
+                        continue
+                    dd = kw.get("decode_deployment")
+                    dd = getattr(dd, "deployment_name", dd)
+                    dst = deps.get(dd) if isinstance(dd, str) else None
+                    if dst is not None:
+                        edges.append((app_name, name, st, dst))
+        now = time.monotonic()
+        for app_name, name, pre, dec in edges:
+            pcfg, dcfg = pre.config.autoscaling_config, \
+                dec.config.autoscaling_config
+            if pcfg is None or dcfg is None or pre.deleting \
+                    or dec.deleting:
+                continue
+            psnap, dsnap = pre.slo_snapshot, dec.slo_snapshot
+            if not psnap or not dsnap:
+                continue
+            # Freshness + zero-load gates (the slo_desired discipline):
+            # a stale or idle-app snapshot's p99 tail must not churn
+            # pool budget after traffic stops.
+            if min(psnap.get("t", 0.0), dsnap.get("t", 0.0)) \
+                    < now - 10.0:
+                continue
+            if psnap.get("total_ongoing", 0) \
+                    + dsnap.get("total_ongoing", 0) <= 0:
+                continue
+            if now - self._last_pd_shift.get((app_name, name), 0.0) \
+                    < 10.0:
+                continue
+            shift = slo.pd_rebalance(psnap, dsnap, pre.target_replicas,
+                                     dec.target_replicas, pcfg, dcfg)
+            if not shift:
+                continue
+            src, dst = (pre, dec) if shift > 0 else (dec, pre)
+            with self._lock:
+                src.target_replicas -= 1
+                dst.target_replicas += 1
+                # Cooldown stamps that keep the shift from being
+                # immediately REVERTED by the per-pool loop: the source
+                # must not upscale straight back (last_scale_up) and
+                # the destination must not downscale straight back
+                # (last_scale_down).
+                src.last_scale_up = dst.last_scale_down = now
+            self._last_pd_shift[(app_name, name)] = now
+            self._demand_dirty = True
+            if tracing.ENABLED:
+                tracing.emit(
+                    "serve.pd_rebalance", time.time(),
+                    attrs={"app": app_name, "prefill": pre.name,
+                           "decode": dec.name,
+                           "shift": "prefill->decode" if shift > 0
+                           else "decode->prefill",
+                           "prefill_p99_queue_ms":
+                           psnap.get("p99_queue_ms"),
+                           "decode_p99_queue_ms":
+                           dsnap.get("p99_queue_ms"),
+                           "prefill_target": pre.target_replicas,
+                           "decode_target": dec.target_replicas})
 
     def _reconcile_deployment(self, st: _DeploymentState) -> None:
         """Start/stop replicas toward target; poll pending inits and
@@ -524,7 +725,9 @@ class ServeController:
             handle = ray_tpu.remote(Replica).options(**actor_opts).remote(
                 st.cls, st.init_args, st.init_kwargs,
                 st.config.max_ongoing_requests, st.config.user_config,
-                app_name=st.app, deployment=st.name)
+                app_name=st.app, deployment=st.name,
+                max_queued_requests=getattr(
+                    st.config, "max_queued_requests", -1))
         except Exception:  # noqa: BLE001
             logger.error("replica start failed:\n%s", traceback.format_exc())
             return

@@ -11,7 +11,8 @@ from __future__ import annotations
 import inspect
 from typing import Any, Callable
 
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
+                                  autoscaling_config_from_dict)
 
 
 def _wrap_function(func: Callable) -> type:
@@ -58,8 +59,10 @@ def dataclasses_replace(config: DeploymentConfig, opts: dict) -> DeploymentConfi
     fields = {f.name for f in dataclasses.fields(DeploymentConfig)}
     updates = {k: v for k, v in opts.items() if k in fields}
     if isinstance(updates.get("autoscaling_config"), dict):
-        updates["autoscaling_config"] = AutoscalingConfig(
-            **updates["autoscaling_config"])
+        updates["autoscaling_config"] = autoscaling_config_from_dict(
+            updates["autoscaling_config"])
+    elif isinstance(updates.get("autoscaling_config"), AutoscalingConfig):
+        updates["autoscaling_config"].validate()
     if updates.get("num_replicas") == "auto":
         # Same translation as the decorator: autoscaling with defaults.
         updates.setdefault(
@@ -100,14 +103,20 @@ def deployment(cls_or_func=None, *, name: str | None = None,
                user_config: Any = None,
                health_check_period_s: float = 1.0,
                graceful_shutdown_timeout_s: float = 5.0,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               max_queued_requests: int = -1):
     """@serve.deployment (ray: serve/api.py deployment decorator).
 
     num_replicas="auto" enables autoscaling with defaults (ray: serve
-    num_replicas="auto").
+    num_replicas="auto").  max_queued_requests bounds the replica-side
+    admission queue (-1 = 2 x max_ongoing_requests, 0 = no queue);
+    beyond it requests reject early with ServeOverloadedError.
     """
     if isinstance(autoscaling_config, dict):
-        autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        autoscaling_config = autoscaling_config_from_dict(
+            autoscaling_config)
+    elif isinstance(autoscaling_config, AutoscalingConfig):
+        autoscaling_config.validate()
     if num_replicas == "auto":
         autoscaling_config = autoscaling_config or AutoscalingConfig()
         num_replicas = autoscaling_config.min_replicas
@@ -120,7 +129,8 @@ def deployment(cls_or_func=None, *, name: str | None = None,
             user_config=user_config,
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
-            ray_actor_options=ray_actor_options or {})
+            ray_actor_options=ray_actor_options or {},
+            max_queued_requests=max_queued_requests)
         return Deployment(target, name or target.__name__, cfg)
 
     if cls_or_func is not None:

@@ -57,6 +57,23 @@ def _is_replica_death(e: BaseException) -> bool:
     return isinstance(e, (ActorError, WorkerCrashedError, ConnectionLost))
 
 
+def _as_overload(e: BaseException):
+    """The ServeOverloadedError behind a response failure, or None.
+    A replica's early rejection crosses the process boundary wrapped in
+    TaskError like any user exception — unwrap it so callers get the
+    TYPED, retriable error (fields: queue_depth, retry_after_s) without
+    fishing through .cause.  Never a replica death, so it spends no
+    dead-replica requeue budget."""
+    from ray_tpu.exceptions import ServeOverloadedError, TaskError
+
+    if isinstance(e, ServeOverloadedError):
+        return e
+    if isinstance(e, TaskError) and isinstance(
+            getattr(e, "cause", None), ServeOverloadedError):
+        return e.cause
+    return None
+
+
 class _NoCapacity(RuntimeError):
     """No replica can accept the request right now — retried by the router
     thread until the 30s assignment deadline."""
@@ -119,6 +136,10 @@ class DeploymentResponse:
                     "deployment response not ready: replica submit did "
                     f"not resolve within {bound}s") from None
             except Exception as e:  # noqa: BLE001 - filtered below
+                ov = _as_overload(e)
+                if ov is not None:
+                    self._requeue = None   # rejected = never ran; typed
+                    raise ov from None
                 if self._requeue is None or not _is_replica_death(e):
                     raise
                 if deadline is None:
@@ -154,6 +175,10 @@ class DeploymentResponse:
                     self._requeue = None   # see result(): drop the payload
                     return value
                 except Exception as e:  # noqa: BLE001 - filtered below
+                    ov = _as_overload(e)
+                    if ov is not None:
+                        self._requeue = None
+                        raise ov from None
                     if self._requeue is None or not _is_replica_death(e):
                         raise
                     # The requeue refreshes membership over blocking RPC
@@ -215,6 +240,10 @@ class DeploymentResponseGenerator:
             except StopIteration:
                 raise
             except Exception as e:  # noqa: BLE001 - filtered in helper
+                ov = _as_overload(e)
+                if ov is not None:
+                    self._requeue = None
+                    raise ov from None
                 if not self._try_requeue(e):
                     raise
                 continue
@@ -241,6 +270,10 @@ class DeploymentResponseGenerator:
             except StopAsyncIteration:
                 raise
             except Exception as e:  # noqa: BLE001 - filtered in helper
+                ov = _as_overload(e)
+                if ov is not None:
+                    self._requeue = None
+                    raise ov from None
                 # Requeue refreshes membership over blocking RPC: keep
                 # it off this (possibly worker-IO) loop.
                 if not await loop.run_in_executor(
@@ -254,12 +287,17 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment: str, app: str, controller_id: str,
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 priority: int | None = None):
         self.deployment_name = deployment
         self.app_name = app
         self._controller_id = controller_id
         self._method = method_name
         self._stream = stream
+        # Admission-priority tier for requests through this handle
+        # (serve/slo.py: 0=high, 1=normal, 2=low); None = let the
+        # replica resolve it from the request payload.
+        self._priority = priority
         self._lock = threading.Lock()
         self._replicas: list[str] = []      # replica actor ids
         self._handles: dict[str, ActorHandle] = {}
@@ -396,7 +434,18 @@ class DeploymentHandle:
                 fut.set_result(submit_fn(args, kwargs))
             except _NoCapacity as e:
                 if time.monotonic() > deadline:
-                    fut.set_exception(RuntimeError(str(e)))
+                    # Router-side overload surface: every replica stayed
+                    # at its cap (or membership stayed empty) for the
+                    # whole assignment window — reject with the typed,
+                    # retriable error instead of a bare RuntimeError
+                    # (which it still subclasses, for legacy handlers).
+                    from ray_tpu.exceptions import ServeOverloadedError
+
+                    with self._lock:
+                        depth = sum(self._inflight.values())
+                    fut.set_exception(ServeOverloadedError(
+                        str(e), deployment=self.deployment_name,
+                        queue_depth=depth, retry_after_s=1.0))
                 else:
                     time.sleep(0.05)
                     self._router_q.put(item)
@@ -497,7 +546,10 @@ class DeploymentHandle:
             except BaseException:
                 self._done(rid)
                 raise
-            ref = handle.handle_request.remote(self._method, args, kwargs)
+            pr = {} if self._priority is None \
+                else {"priority": self._priority}
+            ref = handle.handle_request.remote(self._method, args,
+                                               kwargs, **pr)
             ref.future().add_done_callback(lambda _f: self._done(rid))
             return ref
 
@@ -535,9 +587,11 @@ class DeploymentHandle:
                 kwargs = {k: (v._to_object_ref()
                               if isinstance(v, DeploymentResponse) else v)
                           for k, v in kwargs.items()}
+                pr = {} if self._priority is None \
+                    else {"priority": self._priority}
                 gen = handle.handle_request_streaming.options(
                     num_returns="streaming").remote(self._method, args,
-                                                    kwargs)
+                                                    kwargs, **pr)
             except BaseException:
                 self._done(rid)
                 raise
@@ -639,11 +693,13 @@ class DeploymentHandle:
         return DeploymentResponse(None, ref_future=fut, requeue=requeue)
 
     def options(self, method_name: str | None = None,
-                stream: bool | None = None) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name,
-                                self._controller_id,
-                                method_name or self._method,
-                                self._stream if stream is None else stream)
+                stream: bool | None = None,
+                priority: int | None = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name, self._controller_id,
+            method_name or self._method,
+            self._stream if stream is None else stream,
+            self._priority if priority is None else priority)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -657,4 +713,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name,
                                    self._controller_id, self._method,
-                                   self._stream))
+                                   self._stream, self._priority))

@@ -50,6 +50,7 @@ from typing import Any
 import numpy as np
 
 from ray_tpu import tracing
+from ray_tpu.serve import slo
 from ray_tpu.serve.kv_blocks import BlockManager
 
 
@@ -319,66 +320,83 @@ class LLMEngine:
                                                                starts)
             return _sample_rows(last, temps, keys)
 
-        # One compiled K-step decode program; cache donated (in-place).
-        def _decode_k_dense(params, cache, tokens, temps, table, seeds,
-                            starts):
-            lane_keys = jax.vmap(
-                lambda s: jax.random.fold_in(self._base_key, s))(seeds)
+        # Compiled K-step decode programs, one per sync-window size.
+        # K is baked into the scan at trace time (jit caches on
+        # argument shapes, never on closure attributes), so the
+        # overload ladder's "shrink the sync window" knob needs a
+        # factory: each window size compiles once and stays cached.
+        def _make_decode(K):
+            def _decode_k_dense(params, cache, tokens, temps, table,
+                                seeds, starts):
+                lane_keys = jax.vmap(
+                    lambda s: jax.random.fold_in(self._base_key,
+                                                 s))(seeds)
 
-            def step(carry, j):
-                cache, toks = carry
-                logits, cache = llama.decode_step_unrolled(
-                    params, cache, toks, cfg)
-                keys = jax.vmap(jax.random.fold_in)(lane_keys,
-                                                    starts + j)
-                nxt = _sample_rows(logits, temps, keys)
-                return (cache, nxt), nxt
+                def step(carry, j):
+                    cache, toks = carry
+                    logits, cache = llama.decode_step_unrolled(
+                        params, cache, toks, cfg)
+                    keys = jax.vmap(jax.random.fold_in)(lane_keys,
+                                                        starts + j)
+                    nxt = _sample_rows(logits, temps, keys)
+                    return (cache, nxt), nxt
 
-            (cache, last), seq = jax.lax.scan(
-                step, (cache, tokens), jnp.arange(self.steps_per_sync))
-            return seq, last, cache   # seq [K, B]
+                (cache, last), seq = jax.lax.scan(
+                    step, (cache, tokens), jnp.arange(K))
+                return seq, last, cache   # seq [K, B]
 
-        def _decode_k_paged(params, cache, tokens, temps, table, seeds,
-                            starts):
-            """Pages stay OUT of the scan carry (read-only during the
-            block; a carried write would copy the whole pool every
-            step); new rows ride a small dense tail, merged into the
-            pages once at block end (ops/paged_attention.py)."""
-            from ray_tpu.ops.paged_attention import merge_tail_pages
+            def _decode_k_paged(params, cache, tokens, temps, table,
+                                seeds, starts):
+                """Pages stay OUT of the scan carry (read-only during
+                the block; a carried write would copy the whole pool
+                every step); new rows ride a small dense tail, merged
+                into the pages once at block end
+                (ops/paged_attention.py)."""
+                from ray_tpu.ops.paged_attention import merge_tail_pages
 
-            K = self.steps_per_sync
-            ts = cache["pos"]
-            pages = {"k": cache["k"], "v": cache["v"]}
-            tshape = (max_batch, cfg.n_kv_heads, K, cfg.head_dim)
-            tails = {"k": [jnp.zeros(tshape, cfg.dtype)
-                           for _ in range(cfg.n_layers)],
-                     "v": [jnp.zeros(tshape, cfg.dtype)
-                           for _ in range(cfg.n_layers)]}
-            lane_keys = jax.vmap(
-                lambda s: jax.random.fold_in(self._base_key, s))(seeds)
+                ts = cache["pos"]
+                pages = {"k": cache["k"], "v": cache["v"]}
+                tshape = (max_batch, cfg.n_kv_heads, K, cfg.head_dim)
+                tails = {"k": [jnp.zeros(tshape, cfg.dtype)
+                               for _ in range(cfg.n_layers)],
+                         "v": [jnp.zeros(tshape, cfg.dtype)
+                               for _ in range(cfg.n_layers)]}
+                lane_keys = jax.vmap(
+                    lambda s: jax.random.fold_in(self._base_key,
+                                                 s))(seeds)
 
-            def step(carry, j):
-                tails, pos, toks = carry
-                logits, tails = llama.decode_step_paged(
-                    params, pages, tails, toks, pos, ts, j, table, cfg)
-                keys = jax.vmap(jax.random.fold_in)(lane_keys,
-                                                    starts + j)
-                nxt = _sample_rows(logits, temps, keys)
-                return (tails, pos + 1, nxt), nxt
+                def step(carry, j):
+                    tails, pos, toks = carry
+                    logits, tails = llama.decode_step_paged(
+                        params, pages, tails, toks, pos, ts, j, table,
+                        cfg)
+                    keys = jax.vmap(jax.random.fold_in)(lane_keys,
+                                                        starts + j)
+                    nxt = _sample_rows(logits, temps, keys)
+                    return (tails, pos + 1, nxt), nxt
 
-            (tails, pos, last), seq = jax.lax.scan(
-                step, (tails, ts, tokens), jnp.arange(K))
-            new_k = [merge_tail_pages(pages["k"][li], tails["k"][li],
-                                      table, ts, K)
-                     for li in range(cfg.n_layers)]
-            new_v = [merge_tail_pages(pages["v"][li], tails["v"][li],
-                                      table, ts, K)
-                     for li in range(cfg.n_layers)]
-            return seq, last, {"k": new_k, "v": new_v, "pos": pos}
+                (tails, pos, last), seq = jax.lax.scan(
+                    step, (tails, ts, tokens), jnp.arange(K))
+                new_k = [merge_tail_pages(pages["k"][li],
+                                          tails["k"][li], table, ts, K)
+                         for li in range(cfg.n_layers)]
+                new_v = [merge_tail_pages(pages["v"][li],
+                                          tails["v"][li], table, ts, K)
+                         for li in range(cfg.n_layers)]
+                return seq, last, {"k": new_k, "v": new_v, "pos": pos}
 
-        self._decode = jax.jit(
-            _decode_k_paged if paged else _decode_k_dense,
-            donate_argnums=(1,))
+            return jax.jit(_decode_k_paged if paged else _decode_k_dense,
+                           donate_argnums=(1,))
+
+        self._make_decode = _make_decode
+        self._decode_fns = {self.steps_per_sync:
+                            _make_decode(self.steps_per_sync)}
+        # Live sync-window size: the loop decodes this many steps per
+        # host round trip.  Shrunk under sustained overload (smaller
+        # windows = more admission points = bounded queued-TTFT at a
+        # throughput cost), restored on recovery — see set_sync_window.
+        self._k_live = self.steps_per_sync
+        self.sync_window_shrinks = 0
 
         # Wave prefill: ONE compiled program admits a whole wave of
         # requests — computes all their prompt KV and scatter-writes each
@@ -556,6 +574,10 @@ class LLMEngine:
         # at every weight swap — cached KV belongs to the policy that
         # computed it.
         self._cache_gen = 0
+        # Recent per-request latency window (exact p99 over raw samples
+        # — the controller's SLO loop consumes this via stats() →
+        # replica_metrics; the histograms quantize, this doesn't).
+        self._slo_window = slo.LatencyWindow()
         self._metrics_last: dict[str, float] = {}
         self._metrics_t = 0.0
         # stats() flushes from replica threads while the loop flushes on
@@ -854,6 +876,25 @@ class LLMEngine:
                 self.generate(list(range(1, n + 1)), max_new_tokens=1,
                               _cache_ok=False)
 
+    def set_sync_window(self, k: int | None) -> int:
+        """Set the live decode sync-window size (overload degradation:
+        smaller windows admit/eos-check more often, bounding how long a
+        queued request waits behind a running block, at some
+        amortization cost).  None restores the configured
+        steps_per_sync.  Takes effect at the next window boundary (the
+        loop reads it between blocks); each distinct size compiles its
+        own cached decode program.  Token streams are UNCHANGED by the
+        window size — sampling keys fold in the per-request generation
+        index, not the window phase."""
+        k = self.steps_per_sync if not k \
+            else max(1, min(int(k), self.steps_per_sync))
+        if k != self._k_live:
+            if k < self.steps_per_sync:
+                self.sync_window_shrinks += 1
+            self._k_live = k
+            self._wake.set()
+        return k
+
     def start(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
@@ -919,7 +960,7 @@ class LLMEngine:
         matched = mgr.match(seq) \
             if (req.cache_ok and req.import_kv is None) else []
         matched_tokens = len(matched) * self.page
-        cover = total + (min(remaining, self.steps_per_sync)
+        cover = total + (min(remaining, self._k_live)
                          if self._preempt_on else remaining)
         need = max(0, -(-cover // self.page) - len(matched))
         fresh = mgr.allocate(need)
@@ -1429,6 +1470,8 @@ class LLMEngine:
         imported = req.import_len > 0
         if not imported:
             m["ttft"].observe((ft - req.submitted_at) * 1000.0, tags)
+            self._slo_window.observe(
+                "ttft_ms", (ft - req.submitted_at) * 1000.0)
         n = len(req.tokens)
         if n > 1 and now > ft:
             m["tpot"].observe((now - ft) * 1000.0 / (n - 1), tags)
@@ -1440,12 +1483,19 @@ class LLMEngine:
                     {**tags, "stage": "queue"})
                 st.observe((ft - req.admitted_at) * 1000.0,
                            {**tags, "stage": "prefill"})
+                self._slo_window.observe(
+                    "queue_ms",
+                    (req.admitted_at - req.submitted_at) * 1000.0)
+                self._slo_window.observe(
+                    "prefill_ms", (ft - req.admitted_at) * 1000.0)
             if not req.prefill_only:
                 # No decode ran on a prefill-only export — a ~0ms
                 # sample here would drag the cross-engine decode
                 # quantiles toward zero as migration volume grows.
                 st.observe((now - ft) * 1000.0,
                            {**tags, "stage": "decode"})
+                self._slo_window.observe("decode_ms",
+                                         (now - ft) * 1000.0)
 
     def _preempt_slot(self, slot: int) -> None:
         """Evict a running request from its slot: its blocks go to the
@@ -1463,14 +1513,20 @@ class LLMEngine:
         self.preemptions += 1
         self._pending.appendleft(req)
 
-    def _ensure_decode_blocks(self) -> list[int]:
+    def _ensure_decode_blocks(self, k_win: int | None = None
+                              ) -> list[int]:
         """Block-budget scheduling before each decode block: every
         active slot needs real pages under the next K merge positions.
         Oldest requests are funded first; when the pool (free +
         evictable) runs dry, the NEWEST active request is preempted and
         recomputed later — deterministic, and the oldest request can
         always make progress (its full span fits the pool by the
-        submit-time check).  Returns the surviving active slots."""
+        submit-time check).  Returns the surviving active slots.
+        `k_win` is the loop's snapshot of the sync window — funding and
+        the decode call must agree on it (a concurrent set_sync_window
+        between them must not leave the window underfunded)."""
+        if k_win is None:
+            k_win = self._k_live
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not self.paged or not active:
             return active
@@ -1480,7 +1536,7 @@ class LLMEngine:
             if req is None:                  # preempted this round
                 continue
             total = len(req.prompt) + len(req.tokens)
-            cover = min(total - 1 + self.steps_per_sync,
+            cover = min(total - 1 + k_win,
                         len(req.prompt) + req.max_new_tokens)
             need = -(-cover // self.page) - len(req.pages)
             if need <= 0:
@@ -1520,7 +1576,11 @@ class LLMEngine:
         while not self._stop.is_set():
             self._maybe_swap_weights()
             self._admit()
-            active = self._ensure_decode_blocks()
+            # ONE sync-window snapshot per iteration: funding and the
+            # decode program must see the same K (set_sync_window may
+            # race from a replica thread).
+            k_win = self._k_live
+            active = self._ensure_decode_blocks(k_win)
             self._flush_metrics()
             if not active:
                 if self._pending:
@@ -1545,7 +1605,11 @@ class LLMEngine:
                 self._slots[i] is not None
                 and self._slots[i].trace is not None for i in active)
             t_win0 = time.time() if win_traced else 0.0
-            seq, last, self.cache = self._decode(
+            decode = self._decode_fns.get(k_win)
+            if decode is None:
+                decode = self._decode_fns.setdefault(
+                    k_win, self._make_decode(k_win))
+            seq, last, self.cache = decode(
                 self.params, self.cache, self._cur_dev,
                 jnp.asarray(self._temps), self._table_dev,
                 jnp.asarray(self._seeds), jnp.asarray(starts))
@@ -1562,7 +1626,7 @@ class LLMEngine:
                         tracing.emit(
                             "llm.decode_window", t_win0, t_win1,
                             ctx=r.trace,
-                            attrs={"steps": self.steps_per_sync,
+                            attrs={"steps": k_win,
                                    "weight_version":
                                    self.weight_version})
             for i in active:
@@ -1644,7 +1708,12 @@ class LLMEngine:
                "weight_updates": self.weight_updates,
                "weight_syncs_skipped": self.weight_syncs_skipped,
                "last_weight_sync_ms": round(self.last_weight_sync_ms,
-                                            3)}
+                                            3),
+               # SLO loop inputs (serve/slo.py): recent-request latency
+               # percentiles + the live sync window.
+               "slo": self._slo_window.snapshot(),
+               "sync_window": self._k_live,
+               "sync_window_shrinks": self.sync_window_shrinks}
         if self._mgr is not None:
             kv = self._mgr.stats()
             out["kv"] = kv
@@ -1736,10 +1805,51 @@ class LLMServer:
         self._kv_migrate_put_ms = 0.0
         self._kv_pull_bytes = 0
         self._kv_pull_ms = 0.0
+        # Overload degradation ladder (serve/slo.py OverloadTracker,
+        # pressure = engine queue depth): level 1 sheds PD-disagg to
+        # unified serving (skip the migration round trips), level 2
+        # also shrinks the decode sync window so queued requests admit
+        # sooner.  Both restore on sustained recovery.  Kill switch
+        # RAY_TPU_SERVE_DEGRADE=0.
+        self._overload = slo.OverloadTracker(hi=max(4, 2 * max_batch))
+        self._degraded_window = max(1, min(2, steps_per_sync))
+        self._sheds = 0
+        self._restores = 0
         self.engine = LLMEngine(cfg, params, **self._engine_kwargs)
         self.engine.start()
         if warmup:
             self.engine.warmup()
+
+    # ----------------------------------------------- overload ladder
+    def _update_pressure(self) -> int:
+        """Feed the engine's queue depth to the hysteresis tracker; on
+        a level change apply/restore the sync-window knob and emit a
+        flight-recorder span so a trace shows WHY service degraded.
+        Kill switch RAY_TPU_SERVE_DEGRADE=0 pins level 0 (restoring a
+        previously-shrunk window)."""
+        eng = self.engine
+        if not slo.degrade_on():
+            if self._overload.level:
+                self._overload.level = 0
+                eng.set_sync_window(None)
+            return 0
+        depth = eng._waiting.qsize() + len(eng._pending)
+        level, prev = self._overload.update(depth)
+        if level != prev:
+            eng.set_sync_window(
+                self._degraded_window if level >= 2 else None)
+            if level > prev:
+                self._sheds += 1
+            else:
+                self._restores += 1
+            if tracing.ENABLED:
+                tracing.emit(
+                    "serve.shed" if level > prev else "serve.restore",
+                    time.time(),
+                    attrs={"deployment": eng.name, "level": level,
+                           "from": prev, "depth": depth,
+                           "sync_window": eng._k_live})
+        return level
 
     # ------------------------------------------------- prefill/decode
     def _disagg(self, request: dict) -> bool:
@@ -1910,7 +2020,12 @@ class LLMServer:
     async def __call__(self, request: dict) -> dict:
         import asyncio
 
-        if self._disagg(request):
+        # Degradation ladder: under sustained overload (level >= 1)
+        # disaggregation SHEDS to unified serving on this replica —
+        # same engine, same seed, token-identical output, minus the
+        # migration round trips the overloaded pool can't afford.
+        level = self._update_pressure()
+        if level < 1 and self._disagg(request):
             return await self._prefill_decode(request)
         fut = self.engine.submit(
             request["prompt"],
@@ -1925,6 +2040,10 @@ class LLMServer:
         or the HTTP proxy's chunked path (x-serve-stream: 1)."""
         if isinstance(request, dict) and "prompt" not in request:
             request = request.get("body") or request
+        # The ladder must track streaming traffic too: without this a
+        # streaming-only workload could neither enter overload nor
+        # restore a previously-shrunk sync window.
+        self._update_pressure()
         q: queue.Queue = queue.Queue()
         fut = self.engine.submit(
             request["prompt"],
@@ -1957,6 +2076,11 @@ class LLMServer:
             "kv_migrate_put_ms": round(self._kv_migrate_put_ms, 3),
             "kv_pull_bytes": self._kv_pull_bytes,
             "kv_pull_ms": round(self._kv_pull_ms, 3),
+        }
+        out["overload"] = {
+            "level": self._overload.level,
+            "sheds": self._sheds,
+            "restores": self._restores,
         }
         return out
 

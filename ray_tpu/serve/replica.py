@@ -47,11 +47,31 @@ class Replica:
 
     def __init__(self, cls, init_args: tuple, init_kwargs: dict,
                  max_ongoing_requests: int, user_config: Any = None,
-                 app_name: str = "default", deployment: str = ""):
+                 app_name: str = "default", deployment: str = "",
+                 max_queued_requests: int = -1):
         self._cls = cls
         self._max_ongoing = max_ongoing_requests
         self._num_ongoing = 0
         self._num_processed = 0
+        # Bounded admission queue (overload control): requests waiting
+        # past max_ongoing_requests count against this budget; beyond
+        # it (per priority tier) the request rejects EARLY with
+        # ServeOverloadedError instead of queueing unboundedly.
+        # -1 = default bound of 2 x max_ongoing; kill switch
+        # RAY_TPU_SERVE_ADMISSION=0 restores unbounded queues.
+        self._max_queued = (2 * max_ongoing_requests
+                            if max_queued_requests < 0
+                            else max_queued_requests)
+        self._num_rejected = 0
+        # Recent queue-wait samples (ms, slot-acquisition wait) — the
+        # non-LLM deployment's SLO signal for the controller's scaling
+        # loop (LLM engines report their own richer window via stats).
+        # Age-bounded: a spike's tail must not report its p99 forever.
+        from ray_tpu.serve import slo
+
+        self._queue_waits = slo.LatencyWindow(maxlen=256)
+        # EWMA service seconds — sizes ServeOverloadedError.retry_after_s.
+        self._svc_ewma_s = 0.0
         # Replica-side concurrency bound: routers cap dispatch too, but
         # multiple handles can race past their local counts (ray: replica
         # enforces max_ongoing_requests itself).  Bounds async handlers as
@@ -93,17 +113,68 @@ class Replica:
             await asyncio.get_running_loop().run_in_executor(
                 self._pool, fn, user_config)
 
+    def _admit_or_reject(self, priority, args: tuple,
+                         kwargs: dict) -> None:
+        """Bounded-queue admission decision (overload control): a
+        request arriving while `max_queued_requests` others already
+        wait for a slot rejects EARLY with a typed, retriable
+        ServeOverloadedError — bounded queue wait instead of a timeout
+        storm.  Priority tiers: HIGH may use 2x the budget, LOW half
+        (serve/slo.py queue_budget).  Runs BEFORE _num_ongoing is
+        incremented, so a rejected request never pollutes the router /
+        autoscaler load signal."""
+        from ray_tpu.serve import slo
+
+        if not slo.admission_on():
+            return
+        budget = slo.queue_budget(
+            slo.request_priority(priority, args, kwargs),
+            self._max_queued)
+        # Reject iff the tier's queue budget is consumed: compare the
+        # FULL ongoing count so budget 0 ('no queue') still admits to
+        # free execution slots (queued alone can't tell empty from
+        # exactly-full).
+        if self._num_ongoing < self._max_ongoing + budget:
+            return
+        queued = max(0, self._num_ongoing - self._max_ongoing)
+        self._num_rejected += 1
+        from ray_tpu.exceptions import ServeOverloadedError
+
+        # How long until a queue slot plausibly frees: the wave ahead
+        # of this request, served max_ongoing-wide at the EWMA service
+        # time.
+        retry = (queued + 1) * max(self._svc_ewma_s, 0.01) \
+            / max(1, self._max_ongoing)
+        raise ServeOverloadedError(
+            "replica admission queue full",
+            deployment=self._context.deployment,
+            queue_depth=queued,
+            retry_after_s=round(min(30.0, max(0.05, retry)), 3))
+
     async def handle_request(self, method: str, args: tuple,
-                             kwargs: dict) -> Any:
+                             kwargs: dict,
+                             priority: int | None = None) -> Any:
         """Execute one request (ray: replica.py handle_request).
         `_num_ongoing` counts queued + executing — the queue-length signal
         the router and autoscaler consume."""
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            # Admission-window failpoint: latency/queue-full injection
+            # BEFORE the bounded-queue decision and the ongoing count
+            # (serve.admit=delay:... backs up the queue; =error:
+            # ServeOverloadedError forges a rejection).
+            await failpoints.fire_async("serve.admit")
+        self._admit_or_reject(priority, args, kwargs)
         self._num_ongoing += 1
         from ray_tpu import tracing
 
         t_adm = time.time() if tracing.ENABLED else 0.0
+        t_q0 = time.perf_counter()
         try:
             async with self._slots:
+                self._queue_waits.observe(
+                    "queue", (time.perf_counter() - t_q0) * 1000.0)
                 # Flight recorder: how long this request waited for a
                 # replica slot (max_ongoing_requests backpressure) —
                 # the replica-side "admit" stage of the serve timeline.
@@ -119,12 +190,11 @@ class Replica:
                 # Failpoint window: the request is admitted but the user
                 # callable has not run (crash = replica dies mid-request;
                 # the handle must requeue to another replica).
-                from ray_tpu import failpoints
-
                 if failpoints.ACTIVE:
                     await failpoints.fire_async("serve.replica_call")
                 target = getattr(self._instance, method)
                 token = _ctx_var.set(self._context)
+                t_svc0 = time.perf_counter()
                 try:
                     if inspect.iscoroutinefunction(target):
                         return await target(*args, **kwargs)
@@ -136,16 +206,25 @@ class Replica:
                         lambda: call_ctx.run(target, *args, **kwargs))
                 finally:
                     _ctx_var.reset(token)
+                    dur = time.perf_counter() - t_svc0
+                    self._svc_ewma_s = dur if not self._svc_ewma_s \
+                        else 0.8 * self._svc_ewma_s + 0.2 * dur
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
 
     def handle_request_streaming(self, method: str, args: tuple,
-                                 kwargs: dict):
+                                 kwargs: dict,
+                                 priority: int | None = None):
         """Streaming request: a sync generator the caller invokes with
         num_returns="streaming" — items ship to the consumer as the user
         generator produces them (ray: replica ASGI streaming path).  A
         non-generator result streams as a single item."""
+        from ray_tpu import failpoints
+
+        if failpoints.ACTIVE:
+            failpoints.fire("serve.admit")
+        self._admit_or_reject(priority, args, kwargs)
         self._num_ongoing += 1
         token = _ctx_var.set(self._context)
         try:
@@ -169,6 +248,13 @@ class Replica:
         out = {"num_ongoing": self._num_ongoing,
                "num_processed": self._num_processed,
                "max_ongoing": self._max_ongoing,
+               "max_queued": self._max_queued,
+               "num_rejected": self._num_rejected,
+               # Recent slot-wait percentiles (ms) — the queue-wait SLO
+               # signal the controller's scaling loop consumes for
+               # deployments that report no engine stats.
+               "queue_wait_ms": self._queue_waits.snapshot().get(
+                   "queue"),
                "ts": time.time()}
         # Surface the user callable's own stats() (e.g. the LLM engine's
         # cache hit/preempt counters) through the serve state API, not

@@ -12,6 +12,7 @@ import dataclasses
 import importlib
 from typing import Any
 
+from ray_tpu.serve.config import autoscaling_config_from_dict
 from ray_tpu.serve.deployment import Application
 
 
@@ -43,6 +44,9 @@ class DeploymentSchema:
     user_config: Any = None
     autoscaling_config: dict | None = None
     ray_actor_options: dict | None = None
+    # Replica admission-queue bound (serve/replica.py early rejection);
+    # -1 = 2 x max_ongoing_requests, 0 = no queue.
+    max_queued_requests: int | None = None
     # KV-cache / batching knobs for LLM deployments (serve/llm.py):
     # merged into the deployment's init kwargs at apply time.
     engine_config: dict | None = None
@@ -53,6 +57,26 @@ class DeploymentSchema:
         unknown = set(d) - known
         if unknown:
             raise ValueError(f"unknown deployment config keys {unknown}")
+        ac = d.get("autoscaling_config")
+        if ac is not None:
+            # Deploy-time validation with field-naming errors (unknown
+            # keys, min>max, non-positive targets) — the raw dict used
+            # to pass straight through and fail deep inside the
+            # controller's first scaling decision.
+            if not isinstance(ac, dict):
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: autoscaling_config "
+                    f"must be a dict, got {type(ac).__name__}")
+            autoscaling_config_from_dict(
+                ac, where=f"deployment {d.get('name')!r} "
+                          f"autoscaling_config")
+        mq = d.get("max_queued_requests")
+        if mq is not None and (not isinstance(mq, int)
+                               or isinstance(mq, bool) or mq < -1):
+            raise ValueError(
+                f"deployment {d.get('name')!r}: max_queued_requests "
+                f"must be an int >= -1 (-1 = default bound, 0 = no "
+                f"queue), got {mq!r}")
         ec = d.get("engine_config")
         if ec is not None:
             bad = set(ec) - ENGINE_CONFIG_KEYS

@@ -1,0 +1,302 @@
+"""SLO-driven autoscaling and overload-control policy (ISSUE 11).
+
+The decision half of the serve SLO loop, kept pure and host-side so the
+controller, the replica, and the LLM server can all import it without
+touching jax or the runtime (the `serve/kv_router.py` discipline):
+
+  - **Kill switches** (read per call — same-run A/B):
+    ``RAY_TPU_SERVE_AUTOSCALE=0`` freezes replica targets (static
+    counts), ``RAY_TPU_SERVE_ADMISSION=0`` restores unbounded replica
+    queues (no early rejection, no priority tiers),
+    ``RAY_TPU_SERVE_DEGRADE=0`` disables the overload degradation
+    ladder (no disagg shedding, no sync-window shrink).
+  - **Priority tiers** honored at admission: a HIGH request may use 2x
+    the queue budget (reserved headroom), LOW only half — under
+    overload the best-effort tier is shed first and the latency-critical
+    tier last.
+  - **LatencyWindow**: bounded recent-sample store feeding the
+    controller's scaling decisions with p50/p90/p99 snapshots — the
+    same observations that feed the Prometheus stage histograms, kept
+    as raw samples so percentiles are exact over the recent window
+    (histogram buckets would quantize the p99 the SLO targets).
+  - **OverloadTracker**: hysteresis state machine for the degradation
+    ladder (enter a level only after sustained pressure, leave only
+    after sustained calm — a one-tick spike must not flap the engine's
+    sync window).
+  - **slo_desired / pd_rebalance**: the scaling policies themselves,
+    pure functions of the metric snapshots so they unit-test without a
+    cluster.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from ray_tpu.serve.kv_router import env_on
+
+# Priority tiers (smaller = more important).  A request's tier comes
+# from handle.options(priority=...) or a {"priority": n} key in a
+# dict-shaped request payload.
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+
+def autoscale_on() -> bool:
+    """RAY_TPU_SERVE_AUTOSCALE kill switch (controller-side; off =
+    static replica counts)."""
+    return env_on("RAY_TPU_SERVE_AUTOSCALE")
+
+
+def admission_on() -> bool:
+    """RAY_TPU_SERVE_ADMISSION kill switch (replica-side; off =
+    unbounded queues, legacy behavior)."""
+    return env_on("RAY_TPU_SERVE_ADMISSION")
+
+
+def degrade_on() -> bool:
+    """RAY_TPU_SERVE_DEGRADE kill switch (replica-side; off = never
+    shed disagg / shrink sync windows)."""
+    return env_on("RAY_TPU_SERVE_DEGRADE")
+
+
+def queue_budget(priority: int, max_queued: int) -> int:
+    """Per-tier admission queue budget: HIGH gets 2x headroom, LOW
+    half (shed first).  A budget of 0 means NO queueing for that tier
+    (the request still admits whenever an execution slot is free —
+    admission compares ongoing against max_ongoing + budget)."""
+    if max_queued <= 0:
+        return 0
+    if priority <= PRIORITY_HIGH:
+        return 2 * max_queued
+    if priority >= PRIORITY_LOW:
+        return max_queued // 2
+    return max_queued
+
+
+def request_priority(priority, args: tuple = (), kwargs: dict | None
+                     = None) -> int:
+    """Resolve a request's tier: the handle-level option wins; else a
+    {"serve_priority": n} key in a dict payload — a RESERVED key, not
+    the app's own "priority" field (an application convention where
+    bigger = more urgent would silently invert into the shed-first
+    tier); else NORMAL."""
+    if priority is not None:
+        return int(priority)
+    for v in list(args) + list((kwargs or {}).values()):
+        if isinstance(v, dict):
+            p = v.get("serve_priority")
+            if isinstance(p, int) and not isinstance(p, bool):
+                return p
+    return PRIORITY_NORMAL
+
+
+def percentiles(samples) -> dict | None:
+    """{p50, p90, p99, mean, n} over an iterable of ms samples (None
+    when empty).  Nearest-rank on the sorted copy — exact for the
+    window sizes used here (<= 512)."""
+    vals = sorted(samples)
+    if not vals:
+        return None
+    n = len(vals)
+
+    def pct(q: float) -> float:
+        return vals[min(n - 1, int(q * n))]
+
+    return {"p50": round(pct(0.50), 3), "p90": round(pct(0.90), 3),
+            "p99": round(pct(0.99), 3),
+            "mean": round(sum(vals) / n, 3), "n": n}
+
+
+class LatencyWindow:
+    """Recent latency samples by key ('ttft_ms', 'queue_ms', ...).
+
+    Samples are (monotonic_t, ms) pairs and snapshot() drops anything
+    older than `max_age_s`: a spike's tail must AGE OUT, or an idle
+    deployment would keep reporting the spike's p99 forever and the
+    SLO loop would ratchet it to max_replicas and pin it there.  A
+    lock guards observe/snapshot — copying a deque that another thread
+    appends to raises 'deque mutated during iteration', and a dropped
+    stats() probe would silently blind the router AND the autoscaler
+    exactly under load."""
+
+    def __init__(self, maxlen: int = 512, max_age_s: float = 60.0,
+                 clock=time.monotonic):
+        self._maxlen = maxlen
+        self.max_age_s = max_age_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: dict[str, collections.deque] = {}
+
+    def observe(self, key: str, ms: float) -> None:
+        now = self._clock()
+        with self._lock:
+            d = self._series.get(key)
+            if d is None:
+                d = self._series.setdefault(
+                    key, collections.deque(maxlen=self._maxlen))
+            d.append((now, float(ms)))
+
+    def snapshot(self) -> dict:
+        cutoff = self._clock() - self.max_age_s
+        with self._lock:
+            fresh = {key: [ms for t, ms in d if t >= cutoff]
+                     for key, d in self._series.items()}
+        out = {}
+        for key, vals in fresh.items():
+            p = percentiles(vals)
+            if p is not None:
+                out[key] = p
+        return out
+
+
+class OverloadTracker:
+    """Hysteresis ladder over a scalar pressure signal (queue depth).
+
+    Levels: 0 = normal, 1 = overloaded (shed disagg to unified),
+    2 = severely overloaded (also shrink the decode sync window).
+    A level is ENTERED only after the signal holds above its threshold
+    for `on_s` continuous seconds; it STEPS DOWN one level after
+    `off_s` continuous seconds below that level's own entry threshold
+    (so there is no dead band: steady sub-threshold pressure always
+    decays the ladder), and `off_s` at-or-below `lo` resets straight
+    to 0.  A one-tick spike or dip flaps nothing — every transition
+    needs sustain."""
+
+    def __init__(self, hi: float, hi2: float | None = None,
+                 lo: float | None = None, on_s: float = 0.25,
+                 off_s: float = 1.0, clock=time.monotonic):
+        self.hi = hi
+        self.hi2 = hi2 if hi2 is not None else 2 * hi
+        self.lo = lo if lo is not None else max(0.0, hi / 2)
+        self.on_s = on_s
+        self.off_s = off_s
+        self.level = 0
+        self._clock = clock
+        self._hi_since: float | None = None
+        self._hi2_since: float | None = None
+        self._lo_since: float | None = None
+        self._below_hi_since: float | None = None
+        self._below_hi2_since: float | None = None
+        self._last_update: float | None = None
+
+    def _stamp(self, name: str, armed: bool, now: float) -> None:
+        # Explicit None checks: a start stamp may legitimately be 0.0
+        # (fake clocks under test) — `or` would re-arm it every tick.
+        if armed:
+            if getattr(self, name) is None:
+                setattr(self, name, now)
+        else:
+            setattr(self, name, None)
+
+    def update(self, depth: float) -> tuple[int, int]:
+        """Feed one pressure sample; returns (level, previous_level)."""
+        now = self._clock()
+        # Updates only arrive with traffic (per request / stats probe).
+        # A long gap with LOW depth at its end means the queue drained
+        # ~when traffic stopped: credit the gap as sustained calm by
+        # backdating the calm stamps, or the FIRST request after a lull
+        # would still be served at the spike's degraded level.  Never
+        # credit the gap toward the pressure stamps — absence of
+        # samples is evidence of calm, not of load.
+        gap = None if self._last_update is None \
+            else now - self._last_update
+        self._last_update = now
+        calm_t = now - self.off_s \
+            if gap is not None and gap >= self.off_s else now
+        self._stamp("_hi_since", depth >= self.hi, now)
+        self._stamp("_hi2_since", depth >= self.hi2, now)
+        self._stamp("_lo_since", depth <= self.lo, calm_t)
+        self._stamp("_below_hi_since", depth < self.hi, calm_t)
+        self._stamp("_below_hi2_since", depth < self.hi2, calm_t)
+
+        def held(stamp, dur):
+            return stamp is not None and now - stamp >= dur
+
+        prev = self.level
+        level = prev
+        if held(self._hi2_since, self.on_s):
+            level = 2
+        elif held(self._hi_since, self.on_s):
+            level = max(level, 1)
+        # Step-down: sustained below the CURRENT level's entry
+        # threshold — without this, steady pressure in (lo, hi) would
+        # pin a previously entered level forever (the dead band).
+        if level == 2 and held(self._below_hi2_since, self.off_s):
+            level = 1
+        if level == 1 and held(self._below_hi_since, self.off_s):
+            level = 0
+        if held(self._lo_since, self.off_s):
+            level = 0
+        self.level = level
+        return level, prev
+
+
+def slo_desired(cfg, n_running: int, total_ongoing: float,
+                p99_ttft_ms: float | None = None,
+                p99_queue_ms: float | None = None) -> tuple[int, str]:
+    """Desired replica count for one deployment, from load AND SLO
+    attainment.  Returns (count, reason) where reason is "load",
+    "slo_breach" (an SLO target is violated — step up past the
+    load-based answer), or "slo_hold" (near the edge: never downscale
+    into a breach).
+
+    The load policy is the legacy ongoing-requests one (cfg.desired);
+    the SLO terms only ever RAISE the answer — a deployment with no
+    SLO targets behaves exactly as before.  With ZERO ongoing load the
+    SLO terms are ignored: a breach with nobody waiting is a stale
+    window (the LatencyWindow ages samples out too — belt and
+    braces), and acting on it would scale an idle deployment out and
+    pin it there."""
+    want = cfg.desired(total_ongoing, n_running)
+    if total_ongoing <= 0:
+        return max(cfg.min_replicas,
+                   min(cfg.max_replicas, want)), "load"
+    t = getattr(cfg, "target_p99_ttft_ms", None)
+    q = getattr(cfg, "target_queue_wait_ms", None)
+    breach = ((t is not None and p99_ttft_ms is not None
+               and p99_ttft_ms > t)
+              or (q is not None and p99_queue_ms is not None
+                  and p99_queue_ms > q))
+    near = ((t is not None and p99_ttft_ms is not None
+             and p99_ttft_ms > 0.8 * t)
+            or (q is not None and p99_queue_ms is not None
+                and p99_queue_ms > 0.8 * q))
+    reason = "load"
+    if breach and n_running + 1 > want:
+        want, reason = n_running + 1, "slo_breach"
+    elif near and want < n_running:
+        want, reason = n_running, "slo_hold"
+    want = max(cfg.min_replicas, min(cfg.max_replicas, want))
+    return want, reason
+
+
+def pd_rebalance(prefill_snap: dict, decode_snap: dict,
+                 prefill_target: int, decode_target: int,
+                 prefill_cfg, decode_cfg,
+                 ratio: float = 2.0) -> int:
+    """Prefill:decode pool-ratio knob (no single-pool autoscaler has
+    one): decide whether to shift ONE replica of budget between the
+    pools of a disaggregated app, from the prefill-vs-decode stage
+    split.  Returns +1 (prefill → decode), -1 (decode → prefill), or 0.
+
+    Signal: each pool's p99 queue-wait (replica admission + engine
+    queue) — the stage that grows without bound on the starved side.
+    A shift happens only when one side's wait exceeds `ratio`x the
+    other's AND the move respects both pools' min/max bounds."""
+
+    def _wait(snap: dict) -> float:
+        w = snap.get("p99_queue_ms")
+        return float(w) if w is not None else 0.0
+
+    p_wait, d_wait = _wait(prefill_snap), _wait(decode_snap)
+    if d_wait > ratio * max(p_wait, 1.0) \
+            and decode_target < decode_cfg.max_replicas \
+            and prefill_target > prefill_cfg.min_replicas:
+        return 1
+    if p_wait > ratio * max(d_wait, 1.0) \
+            and prefill_target < prefill_cfg.max_replicas \
+            and decode_target > decode_cfg.min_replicas:
+        return -1
+    return 0

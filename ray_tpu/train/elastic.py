@@ -468,6 +468,6 @@ class ElasticRun:
             from ray_tpu.autoscaler import request_resources
 
             bundles = self.exec.scaling.bundles() if self._lost else []
-            request_resources(bundles=bundles)
+            request_resources(bundles=bundles, requester="elastic")
         except Exception:  # noqa: BLE001
             pass

@@ -189,6 +189,52 @@ def test_tracing_modules_are_walked_by_the_layering_scan():
         assert list(_imports_of(os.path.join(PKG, rel))), rel
 
 
+# --------------------------------- SLO autoscaling/admission (ISSUE 11)
+# The serve SLO loop spans policy (slo.py), control (controller.py),
+# admission (replica.py), and surfacing (handle.py) — all must build on
+# core primitives and public facades only (the RLHF-shape contract):
+# the ray_tpu core API, sibling serve modules, and the public
+# tracing/failpoints/exceptions/autoscaler surfaces.
+SLO_MODULES = ("serve/slo.py", "serve/controller.py",
+               "serve/replica.py", "serve/handle.py")
+
+SLO_ALLOWED_PREFIXES = (
+    "ray_tpu.serve", "ray_tpu.exceptions", "ray_tpu.failpoints",
+    "ray_tpu.tracing", "ray_tpu.autoscaler", "ray_tpu.actor",
+    "ray_tpu.object_ref", "ray_tpu.utils", "ray_tpu.runtime_context",
+)
+
+
+def test_slo_modules_are_walked_by_the_layering_scan():
+    for rel in SLO_MODULES:
+        path = os.path.join(PKG, rel)
+        assert os.path.exists(path), path
+        assert list(_imports_of(path)), f"no imports parsed in {rel}?"
+
+
+def test_slo_modules_import_only_core_and_public_facades():
+    bad = []
+    for rel in SLO_MODULES:
+        path = os.path.join(PKG, rel)
+        for mod, lineno in _imports_of(path):
+            if not (mod == "ray_tpu" or mod.startswith("ray_tpu.")):
+                continue
+            if mod == "ray_tpu" or any(
+                    mod == p or mod.startswith(p + ".")
+                    for p in SLO_ALLOWED_PREFIXES):
+                continue
+            bad.append(f"ray_tpu/{rel}:{lineno}: imports {mod}")
+    assert not bad, (
+        "serve SLO/admission modules must build on core primitives "
+        "and public facades only —\n  " + "\n  ".join(bad))
+
+
+def test_slo_module_importable_standalone():
+    import importlib
+
+    assert importlib.import_module("ray_tpu.serve.slo") is not None
+
+
 @pytest.mark.parametrize("mod", ["ray_tpu.tracing",
                                  "ray_tpu._private.spans"])
 def test_tracing_importable_standalone(mod):

@@ -203,3 +203,42 @@ class TestPoolRoleValidation:
                                "decode_deployment": "dec"}},
             {"name": "dec", "engine_config": {"role": "decode"}},
         ])
+
+
+class TestAutoscalingConfigValidation:
+    """ISSUE 11 satellite: autoscaling_config validates at deploy time
+    with field-naming errors instead of passing the raw dict through
+    (which failed deep inside the controller's first decision)."""
+
+    def test_unknown_keys_rejected_with_valid_list(self):
+        with pytest.raises(ValueError, match="min_replcias.*valid"):
+            DeploymentSchema.from_dict(
+                {"name": "d",
+                 "autoscaling_config": {"min_replcias": 1}})
+
+    def test_min_over_max_rejected(self):
+        with pytest.raises(ValueError, match="max_replicas"):
+            DeploymentSchema.from_dict(
+                {"name": "d", "autoscaling_config": {
+                    "min_replicas": 4, "max_replicas": 2}})
+
+    def test_non_positive_targets_rejected(self):
+        for field, val in (("target_ongoing_requests", 0),
+                           ("target_p99_ttft_ms", 0),
+                           ("target_queue_wait_ms", -1.0)):
+            with pytest.raises(ValueError, match=field):
+                DeploymentSchema.from_dict(
+                    {"name": "d", "autoscaling_config": {field: val}})
+
+    def test_valid_slo_config_accepted(self):
+        DeploymentSchema.from_dict(
+            {"name": "d", "max_queued_requests": 4,
+             "autoscaling_config": {
+                 "min_replicas": 1, "max_replicas": 3,
+                 "target_p99_ttft_ms": 250.0,
+                 "target_queue_wait_ms": 100.0}})
+
+    def test_non_dict_autoscaling_config_rejected(self):
+        with pytest.raises(ValueError, match="must be a dict"):
+            DeploymentSchema.from_dict(
+                {"name": "d", "autoscaling_config": 3})
