@@ -1677,6 +1677,184 @@ def bench_serve_cluster_route() -> dict:
             pass
 
 
+def bench_serve_prefix_store() -> dict:
+    """Cluster prefix-cache economy (round 16): the tiered KV store
+    under a zipf shared-prefix workload whose working set exceeds ALL
+    replicas' page pools COMBINED (the regime where per-engine caches
+    — even cache-aware-routed — must thrash: ~10 groups x 13 pages vs
+    2 x 40 pages).  Demotion saves each eviction victim's KV into a
+    sealed arena object (tier 2); the store arm grafts it back on the
+    next hit, the legacy arm re-prefills.
+
+    Same-run A/B via the per-request {"prefix_store": false} override
+    (the fetch kill switch is replica-side env, unreachable from the
+    driver) + RAY_TPU_PREFIX_STORE=0 driver-side for the router half.
+    Demotion runs in BOTH arms (same deployment): under pressure it
+    demotes exactly the leaves LRU eviction would destroy next, so the
+    off arm approximates the plain-eviction world and the arms differ
+    only in the fetch/graft path.
+
+    Rows: serve_prefix_store_hit_pct (cluster prefix-hit tokens /
+    prompt tokens, store arm — higher better, explicit
+    _vs_previous_round entry) + per-arm p99 TTFT (the _ms guard) +
+    graft/demotion counters."""
+    import numpy as np
+
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+    import ray_tpu
+    from ray_tpu import serve
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 8})
+    prev_env = os.environ.get("RAY_TPU_PREFIX_STORE")
+    out: dict = {}
+    try:
+        serve.start()
+        # 56-page pools: 10 groups x ceil((768+16)/64)=13 pages = 130
+        # pages of RESIDENT working set vs 2x55=110 combined — over
+        # capacity even with perfect cache-aware partitioning — while
+        # the CONCURRENT demand (max_ongoing 4 x 13 pages = 52) still
+        # fits one pool: the arms must compare cache economies, not
+        # preemption-recompute thrash.
+        ekw = dict(max_batch=4, max_len=1024, page_size=64,
+                   steps_per_sync=4, seed=0, kv_pages=56)
+        store_cfg = {"min_idle": 10**9, "watermark_frac": 0.25,
+                     "period_s": 0.05, "limit": 4, "max_inflight": 4,
+                     "min_tokens": 64, "migrate_ms": 0.5}
+        vocab = 256
+        shared_len, unique_len, new_tokens = 768, 16, 2
+        groups, n_req = 10, 20
+        # Generous health windows: a 768-token prefill burst on this
+        # 1-core box can park a replica's event loop past the default
+        # 10s probe timeout, and a mid-arm replica replacement would
+        # reset the counters the A/B deltas ride on.
+        LLM = serve.deployment(serve.LLMServer).options(
+            name="llm", num_replicas=2, max_ongoing_requests=4,
+            health_check_period_s=10.0, health_check_timeout_s=120.0)
+        h = serve.run(LLM.bind("debug", prefix_store=store_cfg, **ekw),
+                      name="ps_bench", route_prefix="/psb")
+        rng = np.random.default_rng(0)
+        warm = [rng.integers(1, vocab,
+                             shared_len + unique_len).tolist()
+                for _ in range(8)]
+        for batch in (warm, warm):
+            futs = [h.remote({"prompt": p, "max_new_tokens": 2})
+                    for p in batch]
+            for f in futs:
+                f.result(timeout_s=600)
+
+        zw = np.array([1.0 / (g + 1) ** 1.1 for g in range(groups)])
+        zw /= zw.sum()
+        # ONE shared zipf realization of group ids: the arms must see
+        # the same hot/cold mix (only the prefix token CONTENT differs
+        # per arm) or the hit-rate comparison measures the draw, not
+        # the store.
+        shared_gids = np.random.default_rng(7).choice(
+            groups, size=2 * n_req, p=zw)
+
+        def cluster_stats():
+            rm = serve.replica_metrics("ps_bench", deployment="llm")
+            reps = [m.get("user_stats", {})
+                    for m in rm["ps_bench"]["llm"].values()]
+            return {
+                "hit_tokens": sum(r.get("prefix_hit_tokens", 0)
+                                  for r in reps),
+                "grafts": sum(r.get("kv_grafts", 0) for r in reps),
+                "graft_tokens": sum(r.get("graft_tokens", 0)
+                                    for r in reps),
+                "demotes": sum(r.get("demote_published", 0)
+                               for r in reps),
+            }
+
+        def run_arm(store_on: bool, seed: int) -> dict:
+            os.environ["RAY_TPU_PREFIX_STORE"] = \
+                "1" if store_on else "0"
+            arng = np.random.default_rng(seed)
+            prefixes = [arng.integers(1, vocab, shared_len).tolist()
+                        for _ in range(groups)]
+            gids = shared_gids
+            prompts = [prefixes[g]
+                       + arng.integers(1, vocab, unique_len).tolist()
+                       for g in gids]          # 2 x n_req prompts
+            # Seeding pass: every prefix computed once somewhere; the
+            # over-capacity pools demote/evict the cold tail.
+            for p in prefixes:
+                h.remote({"prompt": p + [5, 6, 7],
+                          "max_new_tokens": 2,
+                          "prefix_store": store_on}
+                         ).result(timeout_s=600)
+            time.sleep(1.6)      # one summary-poll TTL
+            base = cluster_stats()
+            # 2 x n_req zipf draws of the SHARED group sequence at a
+            # BOUNDED in-flight window (the serving capacity, 2x4):
+            # an unbounded burst makes the p99 row a queue-depth
+            # lottery that drowns the miss-path difference; at bounded
+            # depth the tail measures what the store changes — graft
+            # (+ short suffix prefill) vs 768-token re-prefill.
+            t0 = time.perf_counter()
+            results = []
+            active = []
+            for p in prompts:
+                active.append(h.remote(
+                    {"prompt": p, "max_new_tokens": new_tokens,
+                     "prefix_store": store_on}))
+                if len(active) >= 8:
+                    results.append(active.pop(0).result(timeout_s=600))
+            results += [f.result(timeout_s=600) for f in active]
+            wall = time.perf_counter() - t0
+            cur = cluster_stats()
+            ttfts = sorted(r["ttft_s"] for r in results)
+            toks = sum(len(p) + new_tokens for p in prompts)
+            prompt_toks = sum(len(p) for p in prompts)
+            return {
+                "tokens_per_s": round(toks / wall, 1),
+                "wall_s": round(wall, 3),
+                "p50_ttft_ms": round(
+                    ttfts[len(ttfts) // 2] * 1000, 1),
+                "p99_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1,
+                              int(0.99 * len(ttfts)))] * 1000, 1),
+                "hit_rate": round(
+                    (cur["hit_tokens"] - base["hit_tokens"])
+                    / prompt_toks, 3),
+                "grafts": cur["grafts"] - base["grafts"],
+                "graft_tokens": (cur["graft_tokens"]
+                                 - base["graft_tokens"]),
+                "demotes": cur["demotes"] - base["demotes"],
+            }
+
+        off = run_arm(False, seed=303)
+        on = run_arm(True, seed=404)
+        out["prefix_store"] = {
+            "replicas": 2, "requests": n_req, "groups": groups,
+            "shared_prefix_tokens": shared_len,
+            "pool_pages_per_replica": ekw["kv_pages"],
+            "working_set_pages": groups * (
+                -(-(shared_len + unique_len) // ekw["page_size"])),
+            "on": on, "off": off,
+            # The off arm must really have skipped the store.
+            "off_arm_grafts": off["grafts"],
+        }
+        serve.delete("ps_bench")
+        return out
+    finally:
+        if prev_env is None:
+            os.environ.pop("RAY_TPU_PREFIX_STORE", None)
+        else:
+            os.environ["RAY_TPU_PREFIX_STORE"] = prev_env
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def bench_serve_slo() -> dict:
     """SLO-driven autoscaling + overload control (round 15): a
     diurnal+spike trace through the full serve stack, same-run A/B via
@@ -2071,7 +2249,11 @@ def _vs_previous_round(extra: dict) -> dict:
     # and serve_ttft_traced_ms rides the _ms guard.
     # Round 15: SLO attainment is a percent (higher is better — no
     # suffix expresses that); time-to-scale rides the _ms guard.
-    higher_better = {"rlhf_rollout_hit_rate", "serve_slo_attainment_pct"}
+    # Round 16: the cluster prefix-store hit rate is a percent (higher
+    # is better — no suffix expresses that); its p99-TTFT companions
+    # ride the _ms guard.
+    higher_better = {"rlhf_rollout_hit_rate", "serve_slo_attainment_pct",
+                     "serve_prefix_store_hit_pct"}
     lower_better = {"rlhf_weight_lag_windows"}
     absolute_bars = {"trace_overhead_pct": 3.0}
     out = {}
@@ -2204,6 +2386,27 @@ def main() -> None:
             row["pd"]["kv_migrate_gib_per_s"]
     except Exception as e:  # noqa: BLE001
         extra["serve_cluster_route"] = {"error": repr(e)}
+    _flush_partial(extra)
+    try:
+        # Tiered prefix store on a zipf over-capacity trace: serve
+        # boot + two prefill-heavy arms (768-token shared prefixes at
+        # debug scale); demotion/graft legs ride the request waves.
+        row = _with_timeout(bench_serve_prefix_store, 560)
+        extra["serve_prefix_store"] = row
+        ps = row["prefix_store"]
+        # Flat rows so _vs_previous_round's guards cover the A/B (the
+        # nested dict is for humans): hit rate as an explicit
+        # higher-is-better percent, TTFTs on the _ms guard.
+        extra["serve_prefix_store_hit_pct"] = round(
+            100.0 * ps["on"]["hit_rate"], 1)
+        extra["serve_prefix_store_off_hit_pct"] = round(
+            100.0 * ps["off"]["hit_rate"], 1)
+        extra["serve_prefix_store_on_p99_ttft_ms"] = \
+            ps["on"]["p99_ttft_ms"]
+        extra["serve_prefix_store_off_p99_ttft_ms"] = \
+            ps["off"]["p99_ttft_ms"]
+    except Exception as e:  # noqa: BLE001
+        extra["serve_prefix_store"] = {"error": repr(e)}
     _flush_partial(extra)
     try:
         # Diurnal+spike SLO trace: serve boot + two ~8s spike legs;

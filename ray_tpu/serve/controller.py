@@ -88,6 +88,12 @@ class ServeController:
         self._last_demand_post = 0.0
         # (app, prefill_deployment) -> last pool-ratio shift time.
         self._last_pd_shift: dict[tuple, float] = {}
+        # Tier-2 prefix-store directory (serve/prefix_store.py): hash →
+        # demoted-subtree entries published by the replicas.  Scrubbed
+        # with the app (delete_app) and with each dead replica.
+        from ray_tpu.serve.prefix_store import StoreDirectory
+
+        self._prefix_store = StoreDirectory()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-ctrl")
@@ -164,7 +170,11 @@ class ServeController:
             for st in app["deployments"].values():
                 st.deleting = True
                 st.target_replicas = 0
-        # The deleted app's autoscaler demand floor must shrink too.
+        # The deleted app's autoscaler demand floor must shrink too,
+        # and its demoted prefix entries must not outlive it (the
+        # directory's borrowed refs go here; the replicas drop their
+        # primary refs in LLMServer.shutdown during drain).
+        self._prefix_store.drop_app(app_name)
         self._demand_dirty = True
 
     def get_deployment_info(self, app_name: str, deployment: str) -> dict:
@@ -227,6 +237,34 @@ class ServeController:
             out.setdefault(an, {}).setdefault(dname, {})[key] = m
         return out
 
+    # --------------------------------------------- prefix-store verbs
+    # Thin RPC surface over the StoreDirectory (serve/prefix_store.py):
+    # replicas publish/withdraw demoted subtrees, the miss path looks
+    # up the deepest stored prefix, and handles poll the summary for
+    # store-aware routing.  All logic lives in the directory.
+    def prefix_store_publish(self, app: str, meta: dict, ref) -> bool:
+        return self._prefix_store.publish(app, meta, ref)
+
+    def prefix_store_lookup(self, app: str, hashes: list, page: int,
+                            seed, weight_version: int | None = None,
+                            min_depth: int = 0):
+        return self._prefix_store.lookup(
+            app, hashes, page, seed, weight_version=weight_version,
+            min_depth=min_depth)
+
+    def prefix_store_forget(self, app: str, replica: str | None = None,
+                            below_version: int | None = None,
+                            hashes: list | None = None) -> int:
+        return self._prefix_store.forget(
+            app, replica=replica, below_version=below_version,
+            hashes=hashes)
+
+    def prefix_store_summary(self, app: str) -> dict:
+        return self._prefix_store.summary(app)
+
+    def prefix_store_stats(self) -> dict:
+        return self._prefix_store.stats()
+
     def get_app_routes(self) -> dict:
         """route_prefix -> (app, ingress deployment); polled by proxies
         (ray: long-poll route table push)."""
@@ -269,6 +307,7 @@ class ServeController:
                 for st in app["deployments"].values():
                     st.deleting = True
                     st.target_replicas = 0
+        self._prefix_store.clear()
         # Clear the serve demand floor SYNCHRONOUSLY: serve.shutdown
         # kills this actor within seconds — the throttled reconcile
         # re-post may never run, and a stale floor would make the
@@ -750,6 +789,12 @@ class ServeController:
         with self._lock:
             rec = st.replicas.pop(rid, None)
             st.membership_version += 1
+        # A removed replica's demoted prefix entries are doomed (its
+        # arena objects die with the owning process — every future pull
+        # would fail): scrub them so lookups don't chase dead refs.
+        # Drained replicas withdraw themselves too (LLMServer.shutdown);
+        # this covers crashes and health-check kills.
+        self._prefix_store.forget(st.app, replica=rid)
         if rec is not None:
             rec["state"] = "STOPPING"
             self._stop_replica(rec, drain=drain,

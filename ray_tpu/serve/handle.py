@@ -19,6 +19,7 @@ that thread and the DeploymentResponse is backed by a Future[ObjectRef].
 from __future__ import annotations
 
 import concurrent.futures
+import logging
 import queue as queue_mod
 import random
 import threading
@@ -29,6 +30,8 @@ from ray_tpu import tracing
 from ray_tpu.actor import ActorHandle
 from ray_tpu.object_ref import ObjectRef
 from ray_tpu.serve import kv_router
+
+logger = logging.getLogger(__name__)
 
 _MEMBERSHIP_TTL_S = 0.5
 # Prefix-summary refresh cadence: the router thread re-pulls every
@@ -316,6 +319,17 @@ class DeploymentHandle:
         self._summaries_at = 0.0
         self._summary_interval = _SUMMARY_TTL_S
         self._last_request_t = 0.0
+        # Tier-2 store view ({page: frozenset(hashes)} — the
+        # controller's prefix_store_summary), refreshed with the
+        # replica summaries: cluster-RESIDENT prefixes score even when
+        # no live radix tree holds them.
+        self._store_sets: dict[int, frozenset] = {}
+        # Malformed-summary accounting: a replica whose metrics dict is
+        # broken must not silently degrade routing to power-of-two —
+        # count every drop and warn ONCE per handle (a gossip
+        # regression is a bug to surface, not noise to repeat).
+        self._summary_drops = 0
+        self._summary_warned = False
 
     # -- membership ---------------------------------------------------------
     def _refresh_blocking(self) -> None:
@@ -354,18 +368,63 @@ class DeploymentHandle:
                 full_ids=True),
             timeout=10.0)
         reps = rm.get(self.app_name, {}).get(self.deployment_name, {})
-        summaries = {}
-        for rid, m in reps.items():
-            s = ((m.get("user_stats") or {}).get("kv") or {}) \
-                .get("prefix_summary") if isinstance(m, dict) else None
-            s = kv_router.compile_summary(s)
-            if s is not None:
-                summaries[rid] = s
+        summaries = self._compile_replica_summaries(reps)
+        store_sets: dict[int, frozenset] = {}
+        if kv_router.prefix_store_on():
+            # Tier-2 directory view, same poll (advisory like the
+            # replica summaries; an old controller without the verb
+            # just leaves it empty).
+            try:
+                ss = ray_tpu.get(
+                    ActorHandle(self._controller_id)
+                    .prefix_store_summary.remote(self.app_name),
+                    timeout=10.0)
+                for page, hs in ((ss or {}).get("pages") or {}).items():
+                    store_sets[int(page)] = frozenset(
+                        int(h) for h in hs)
+            except Exception:  # noqa: BLE001 - controller restarting
+                pass
         with self._lock:
             self._summaries = summaries
+            self._store_sets = store_sets
             self._summaries_at = time.monotonic()
-            self._summary_interval = _SUMMARY_TTL_S if summaries \
-                else 10 * _SUMMARY_TTL_S
+            self._summary_interval = _SUMMARY_TTL_S \
+                if summaries or store_sets else 10 * _SUMMARY_TTL_S
+
+    def _compile_replica_summaries(self, reps: dict) -> dict:
+        """Normalize per-replica prefix summaries for scoring.  A
+        replica that reports NO summary (any non-LLM deployment) is
+        silently skipped — that's the designed shape.  A summary that
+        is PRESENT but unusable (malformed metrics dict, wrong types)
+        means the gossip path regressed: count it and warn once,
+        instead of silently scoring the replica as no-match forever."""
+        summaries = {}
+        for rid, m in reps.items():
+            if not isinstance(m, dict):
+                self._note_malformed_summary(rid, m)
+                continue
+            raw = ((m.get("user_stats") or {}).get("kv") or {}) \
+                .get("prefix_summary")
+            if raw is None:
+                continue       # not an LLM replica — nothing to score
+            s = kv_router.compile_summary(raw)
+            if s is None:
+                self._note_malformed_summary(rid, raw)
+                continue
+            summaries[rid] = s
+        return summaries
+
+    def _note_malformed_summary(self, rid, raw) -> None:
+        self._summary_drops += 1
+        if not self._summary_warned:
+            self._summary_warned = True
+            logger.warning(
+                "deployment %r replica %s reported a malformed prefix "
+                "summary (%s); scoring it as no-match — cache-aware "
+                "routing is silently degrading to power-of-two "
+                "(prefix-summary gossip regression?)",
+                self.deployment_name, str(rid)[:12],
+                type(raw).__name__)
 
     def _ensure_router(self) -> queue_mod.Queue:
         with self._lock:
@@ -490,12 +549,17 @@ class DeploymentHandle:
             else:
                 eligible = reps
             choice = None
-            if (prompt is not None and self._summaries
+            if (prompt is not None
+                    and (self._summaries or self._store_sets)
                     and kv_router.cache_router_on()):
+                store = self._store_sets \
+                    if self._store_sets and kv_router.prefix_store_on() \
+                    else None
                 choice = kv_router.choose(prompt, eligible,
                                           self._inflight,
                                           self._summaries,
-                                          explain=explain)
+                                          explain=explain,
+                                          store=store)
             if choice is None:
                 if len(eligible) == 1:
                     choice = eligible[0]
@@ -518,7 +582,8 @@ class DeploymentHandle:
         rid, handle = self._pick(
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
-            if self._summaries else None, explain=explain)
+            if (self._summaries or self._store_sets) else None,
+            explain=explain)
         if state is not None:
             state["rid"] = rid
         # Flight-recorder route span: roots the request's trace at the
@@ -568,7 +633,8 @@ class DeploymentHandle:
         rid, handle = self._pick(
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
-            if self._summaries else None, explain=explain)
+            if (self._summaries or self._store_sets) else None,
+            explain=explain)
         if state is not None:
             state["rid"] = rid
         with tracing.span(

@@ -102,6 +102,10 @@ class BlockManager:
         self.hit_tokens = 0      # prompt tokens served from cache
         self.evictions = 0
         self.cow_copies = 0
+        self.demotions = 0       # blocks released to tier 2 (prefix store)
+        # Leaf blocks with a demotion in flight (scan → export-thread
+        # publish → finish): pinned via refcount, excluded from rescans.
+        self._demoting: set[int] = set()
         # Memoized prefix_summary (stats() embeds it on every metrics
         # poll): rebuilt only when the cached SET changes (commit /
         # evict) — LRU-clock touches may reorder an over-cap subset,
@@ -305,6 +309,82 @@ class BlockManager:
 
     # ----------------------------------------------------------- cluster
     @_locked
+    def demote_scan(self, *, limit: int = 2, min_idle: int = 0,
+                    watermark: int = 0, exclude=()) -> list[dict]:
+        """Pick cold refcount-0 LEAVES whose subtree KV should demote
+        to the tier-2 prefix store (serve/prefix_store.py).  A leaf is
+        cold when its LRU clock is `min_idle` ticks stale — or, under
+        pool pressure (free < `watermark`), immediately: demoting the
+        next eviction victim saves its KV where plain eviction would
+        destroy it.  Every candidate's WHOLE path root..leaf is pinned
+        (one extra ref per block) so the exporter may gather the pages
+        while serving continues; the caller MUST demote_finish() each
+        candidate exactly once.  `exclude` holds leaf hashes the caller
+        already knows the store won't take (publish declined) — skipped
+        so a disabled store doesn't re-gather the same leaves forever.
+        Coldest-first, deterministic (LRU clock, then block id)."""
+        pressure = len(self._free) < watermark
+        cands = []
+        for node in self._node_of.values():
+            if node.children or self._ref[node.block] != 0:
+                continue
+            if node.block in self._demoting or node.hash in exclude:
+                continue
+            if not pressure and self._clock - node.last_used < min_idle:
+                continue
+            cands.append(node)
+        cands.sort(key=lambda n: (n.last_used, n.block))
+        out = []
+        for node in cands[:limit]:
+            path = []
+            cur = node
+            while cur is not self._root:
+                path.append(cur)
+                cur = cur.parent
+            path.reverse()
+            blocks, tokens, hashes = [], [], []
+            for nd in path:
+                blocks.append(nd.block)
+                tokens.extend(nd.key)
+                hashes.append(nd.hash)
+            self.retain(blocks)
+            self._demoting.add(node.block)
+            out.append({"leaf": node.block, "blocks": blocks,
+                        "tokens": tokens, "hashes": hashes,
+                        "hash": node.hash, "depth": len(blocks)})
+        return out
+
+    @_locked
+    def demote_finish(self, leaf: int, blocks: list[int], *,
+                      drop: bool) -> int:
+        """Complete one demotion: release the scan's pins and — when
+        the store took the entry (`drop`) — evict the maximal cold
+        suffix of the path: the leaf plus every ancestor left
+        childless at refcount 0 (exactly the blocks the sealed entry
+        covers; hotter ancestors, referenced blocks and nodes that
+        grew children mid-demotion stay in tier 1).  Returns the number
+        of blocks freed.  Safe from any thread (the export thread calls
+        it); a weight-swap flush mid-demotion leaves nothing to drop —
+        release() already freed the pinned blocks the flush un-cached."""
+        self._demoting.discard(leaf)
+        self.release(blocks)
+        if not drop:
+            return 0
+        node = self._node_of.get(leaf)
+        freed = 0
+        while (node is not None and node is not self._root
+               and not node.children and self._ref[node.block] == 0):
+            parent = node.parent
+            del parent.children[node.key]
+            del self._node_of[node.block]
+            self._free.append(node.block)
+            self.demotions += 1
+            freed += 1
+            self._summary_cache = None
+            node = parent
+        return freed
+
+    @_locked
     def export_blocks(self, pages: list[int], n_valid_tokens: int,
                       ) -> list[int]:
         """Pin the blocks covering the first `n_valid_tokens` positions
@@ -394,6 +474,7 @@ class BlockManager:
             "hit_tokens": self.hit_tokens,
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
+            "demotions": self.demotions,
             # The cluster router's view of this cache (compiled by the
             # DeploymentHandle via controller replica_metrics).
             "prefix_summary": self.prefix_summary(),

@@ -65,6 +65,13 @@ def pd_disagg_on() -> bool:
     return env_on("RAY_TPU_PD_DISAGG")
 
 
+def prefix_store_on() -> bool:
+    """RAY_TPU_PREFIX_STORE kill switch for the tiered cluster prefix
+    store (serve/prefix_store.py) — lives here with its sibling
+    cluster-serving switches so they can never drift apart."""
+    return env_on("RAY_TPU_PREFIX_STORE")
+
+
 def queue_alpha() -> float:
     try:
         return float(os.environ.get("RAY_TPU_CACHE_ROUTER_ALPHA", ""))
@@ -145,8 +152,23 @@ def extract_prompt(args: tuple, kwargs: dict):
     return None
 
 
+def store_depth_tokens(prompt, store: dict) -> int:
+    """Deepest CLUSTER-RESIDENT prefix of a prompt, in tokens, over the
+    tiered store's hash sets ({page: frozenset(hashes)} — the directory
+    summary the handle polls next to the replica summaries).  Stored
+    prefixes are reachable from ANY replica (a graft away), so this
+    depth is replica-independent."""
+    best = 0
+    for page, cached in sorted(store.items()):
+        d = matched_depth(prompt_hashes(prompt, page), cached) * page
+        if d > best:
+            best = d
+    return best
+
+
 def choose(prompt, candidates, inflight: dict, summaries: dict,
-           explain: dict | None = None) -> str | None:
+           explain: dict | None = None,
+           store: dict | None = None) -> str | None:
     """Pick the replica with the best prefix-locality score, or None.
 
     score(replica) = matched_depth(prompt, replica) - alpha * inflight.
@@ -157,26 +179,49 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
     to the lower in-flight count, then to replica-id order so the
     choice is deterministic under test.
 
+    `store` ({page: frozenset(hashes)}) adds the tier-2 directory's
+    view: a stored prefix serves ANY replica (graft on arrival), so
+    every candidate's effective depth is at least the store's match —
+    a shallow LIVE match can no longer drag the request onto a loaded
+    replica when the cluster store holds a deeper one, and the queue
+    discount spreads store-served prompts across the pool (each graft
+    then makes its target live-warm — the economy compounding).
+
     `explain` (optional dict, mutated in place) receives the winner's
     score breakdown — matched depth in blocks, queue discount, score —
     for the flight recorder's router span."""
     alpha = queue_alpha()
     hash_cache: dict[int, list[int]] = {}
+
+    def hashes_for(page: int) -> list[int]:
+        hs = hash_cache.get(page)
+        if hs is None:
+            hs = prompt_hashes(prompt, page)
+            hash_cache[page] = hs
+        return hs
+
+    store_tok = 0
+    store_page = 0
+    if store:
+        for page, cached in sorted(store.items()):
+            d = matched_depth(hashes_for(page), cached) * page
+            if d > store_tok:
+                store_tok, store_page = d, page
     best = None            # ((score-key...), rid, depth)
     any_match = False
     for rid in candidates:
         s = summaries.get(rid)
         depth = 0
+        page = s["page"] if s is not None else (store_page or 1)
         if s is not None:
-            hs = hash_cache.get(s["page"])
-            if hs is None:
-                hs = prompt_hashes(prompt, s["page"])
-                hash_cache[s["page"]] = hs
-            depth = matched_depth(hs, s["set"])
-        if depth > 0:
+            depth = matched_depth(hashes_for(s["page"]), s["set"])
+        # Effective depth in the candidate's block units: live match or
+        # the (replica-independent) store match, whichever is deeper.
+        eff = max(depth * page, store_tok) / page
+        if eff > 0:
             any_match = True
         q = inflight.get(rid, 0)
-        key = (-(depth - alpha * q), q, rid)
+        key = (-(eff - alpha * q), q, rid)
         if best is None or key < best[0]:
             best = (key, rid, depth)
     if not any_match or best is None:
@@ -185,4 +230,6 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
         explain.update(cache_depth=best[2],
                        cache_score=round(-best[0][0], 3),
                        inflight=best[0][1], alpha=alpha)
+        if store_tok:
+            explain["store_tokens"] = store_tok
     return best[1]

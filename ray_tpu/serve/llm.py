@@ -504,6 +504,20 @@ class LLMEngine:
         self._import_pages = jax.jit(_import_kv_fn,
                                      donate_argnums=(0, 1))
 
+        # Prefix-store graft: scatter a stored subtree's KV into fresh
+        # pool blocks WITHOUT touching any slot (kv_import resumes a
+        # request; a graft only re-warms the radix tree — the blocks
+        # are committed+released right after, so the next admission
+        # prefix-hits them).  Same pow-2 width padding as import.
+        def _graft_kv_fn(cache, kv, ids):
+            k = [cache["k"][li].at[ids].set(kv[0, li])
+                 for li in range(cfg.n_layers)]
+            v = [cache["v"][li].at[ids].set(kv[1, li])
+                 for li in range(cfg.n_layers)]
+            return {"k": k, "v": v, "pos": cache["pos"]}
+
+        self._graft_pages = jax.jit(_graft_kv_fn, donate_argnums=(0,))
+
         # COW page copy: duplicate shared blocks before a writer touches
         # them.  Pairs are padded with (0, 0) — trash-to-trash is a
         # no-op — so the compile count stays at a few pad widths.
@@ -574,6 +588,26 @@ class LLMEngine:
         # at every weight swap — cached KV belongs to the policy that
         # computed it.
         self._cache_gen = 0
+        # Tier-2 prefix store (serve/prefix_store.py): the owning
+        # server installs a demotion callback via set_prefix_store;
+        # the loop then demotes cold radix leaves into sealed arena
+        # objects (gather dispatched on the loop, host fetch + publish
+        # on the export thread) and applies queued grafts.  All
+        # no-ops until a callback is installed.
+        self._demote_cb = None
+        self._demote_knobs: dict = {}
+        self._demote_lock = threading.Lock()
+        self._demote_inflight = 0
+        self._demote_t = 0.0
+        # Leaf hashes the store declined — skipped on rescans so a
+        # disabled/full store doesn't re-gather the same leaves every
+        # period.  Cleared on weight swaps with the tree flush.
+        self._demote_skip: set[int] = set()
+        self._graft_q: queue.Queue = queue.Queue()
+        self.kv_grafts = 0
+        self.graft_tokens = 0
+        self.demote_published = 0
+        self.demote_failures = 0
         # Recent per-request latency window (exact p99 over raw samples
         # — the controller's SLO loop consumes this via stats() →
         # replica_metrics; the histograms quantize, this doesn't).
@@ -723,6 +757,79 @@ class LLMEngine:
         self._wake.set()
         return req.future
 
+    def set_prefix_store(self, publish_cb, *, min_idle: int = 256,
+                         period_s: float = 0.25,
+                         watermark_frac: float = 0.125,
+                         limit: int = 2, max_inflight: int = 2) -> None:
+        """Install (or, with None, remove) the tier-2 prefix-store
+        demotion hook (serve/prefix_store.py).  `publish_cb(entry)`
+        runs on the EXPORT thread with the demoted subtree's host KV
+        ({tokens, kv, hashes, depth, page, weight_version}) and returns
+        True once tier 2 holds it — only then is the tier-1 leaf
+        evicted.  Knobs: a leaf demotes after `min_idle` LRU-clock
+        ticks of disuse, or immediately when the free pool falls under
+        `watermark_frac` (demote-before-evict: plain eviction would
+        destroy KV the cluster could reuse); at most `limit` leaves per
+        `period_s` scan and `max_inflight` unfinished demotions."""
+        self._demote_cb = publish_cb
+        self._demote_knobs = dict(
+            min_idle=max(0, int(min_idle)),
+            period_s=max(0.01, float(period_s)),
+            watermark=int(max(0.0, float(watermark_frac))
+                          * (self.n_pages - 1)) if self.paged else 0,
+            limit=max(1, int(limit)),
+            max_inflight=max(1, int(max_inflight)))
+        with self._demote_lock:
+            self._demote_skip.clear()
+
+    def kv_graft(self, tokens: list[int], kv, *, kv_len: int,
+                 weight_version: int | None = None,
+                 ) -> concurrent.futures.Future:
+        """Graft a stored prefix's KV into this engine's pool: scatter
+        `kv` (kv_export page layout, [2, L, n, kvh, page, hd]) into
+        freshly-allocated blocks and COMMIT them into the radix tree
+        under `tokens` — the next request matching the prefix hits
+        tier 1 as if it had been computed here.  Full blocks only
+        (kv_len must be a page multiple covering all of `tokens`).
+        Applied on the engine loop between decode windows; the future
+        resolves to {"grafted": n_blocks} or {"grafted": 0, "reason"}
+        when skipped — a `weight_version` mismatch at application time
+        NEVER grafts (stale-policy KV must not repollute a flushed
+        cache)."""
+        import numpy as np
+
+        if not self.paged:
+            raise ValueError("kv_graft requires a paged engine")
+        if kv_len <= 0 or kv_len % self.page != 0:
+            raise ValueError(
+                f"kv_len {kv_len} must be a positive multiple of the "
+                f"page size {self.page} (the radix tree is "
+                "block-granular)")
+        if len(tokens) != kv_len:
+            raise ValueError(
+                f"tokens ({len(tokens)}) must cover exactly kv_len "
+                f"({kv_len}) positions")
+        kv = np.asarray(kv)
+        n = kv_len // self.page
+        want = (2, self.cfg.n_layers, n, self.cfg.n_kv_heads,
+                self.page, self.cfg.head_dim)
+        if kv.shape != want:
+            raise ValueError(
+                f"kv shape {kv.shape} does not match this engine "
+                f"(expected {want}: page_size/config mismatch?)")
+        if n > self.n_pages - 1:
+            raise ValueError(
+                f"graft needs {n} KV pages but the pool holds "
+                f"{self.n_pages - 1}")
+        if self._error is not None:
+            raise RuntimeError(
+                "LLM engine is dead after an earlier failure") \
+                from self._error
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._graft_q.put((list(tokens), kv, n, weight_version, fut))
+        self._wake.set()
+        return fut
+
     def update_weights(self, refs, version: int | None = None) -> int:
         """Stage a fresh policy param tree for LIVE weight sync (the
         online-RLHF loop): the engine loop swaps `self.params` in
@@ -845,6 +952,9 @@ class LLMEngine:
             # a fresh generation.
             self._cache_gen += 1
             self._mgr.flush()
+            with self._demote_lock:
+                # Declined-leaf memory belongs to the flushed tree.
+                self._demote_skip.clear()
         self.last_weight_sync_ms = (time.perf_counter()
                                     - staged_t) * 1000.0
 
@@ -923,6 +1033,15 @@ class LLMEngine:
         self._drain_requests(exc)
 
     def _drain_requests(self, exc: BaseException) -> None:
+        # Queued grafts hang their callers' 60s waits if the loop dies
+        # with them unapplied — fail them like every pending request.
+        while True:
+            try:
+                *_rest, fut = self._graft_q.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(exc)
         for req in list(self._pending):
             req.emit(None)
             if not req.future.done():
@@ -944,6 +1063,161 @@ class LLMEngine:
                 req.future.set_exception(exc)
 
     # -------------------------------------------------------------- engine
+    def _apply_grafts(self) -> None:
+        """Engine-loop half of kv_graft: allocate, scatter, commit,
+        release.  Runs right after the weight swap so the version check
+        sees the tree the commit would land in.  A failed graft (the
+        serve.prefix_graft failpoint, pool pressure) fails ITS future
+        only — the loop and every tenant survive."""
+        import jax.numpy as jnp
+
+        from ray_tpu import failpoints
+
+        while True:
+            try:
+                tokens, kv, n, wv, fut = self._graft_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if failpoints.ACTIVE:
+                    failpoints.fire("serve.prefix_graft")
+                if self._mgr is None or not self._prefix_cache:
+                    out = {"grafted": 0, "reason": "no_prefix_cache"}
+                elif wv is not None and wv != self.weight_version:
+                    # Stored KV from another policy version: grafting
+                    # it would silently attend stale values.
+                    out = {"grafted": 0, "reason": "stale_version"}
+                else:
+                    blocks = self._mgr.allocate(n)
+                    if blocks is None:
+                        out = {"grafted": 0, "reason": "no_blocks"}
+                    else:
+                        m = _pow2(n)
+                        ids = list(blocks) + [0] * (m - n)
+                        if m > n:
+                            pad = np.zeros(
+                                kv.shape[:2] + (m - n,) + kv.shape[3:],
+                                kv.dtype)
+                            kv = np.concatenate([kv, pad], axis=2)
+                        self.cache = self._graft_pages(
+                            self.cache, jnp.asarray(kv),
+                            jnp.asarray(ids, jnp.int32))
+                        # Commit BEFORE release: the blocks become
+                        # cached-evictable instead of freed (the
+                        # _release_slot discipline).
+                        self._mgr.commit(tokens, blocks)
+                        self._mgr.release(blocks)
+                        self.kv_grafts += 1
+                        self.graft_tokens += n * self.page
+                        out = {"grafted": n, "tokens": n * self.page}
+            except BaseException as e:  # noqa: BLE001 - injected faults
+                if not fut.done():
+                    fut.set_exception(e)
+                continue
+            if not fut.done():
+                fut.set_result(out)
+
+    def _ensure_export_thread(self) -> queue.Queue:
+        if self._export_q is None:
+            self._export_q = queue.Queue()
+            self._export_thread = threading.Thread(
+                target=self._export_loop, name="llm-kv-export",
+                daemon=True)
+            self._export_thread.start()
+        return self._export_q
+
+    def _maybe_demote(self) -> None:
+        """Loop-side demotion scan (tier-1 → tier-2): pick cold
+        refcount-0 radix leaves (BlockManager.demote_scan), dispatch
+        ONE device gather per candidate covering the whole path
+        root..leaf, and hand the host fetch + publish to the export
+        thread — the loop never blocks on the tunnel round trip.
+        Throttled by period and in-flight cap; no-op until a server
+        installs the callback, and gated per scan by the
+        RAY_TPU_PREFIX_STORE kill switch."""
+        cb = self._demote_cb
+        if (cb is None or self._mgr is None or not self._prefix_cache
+                or not self.paged):
+            return
+        knobs = self._demote_knobs
+        now = time.monotonic()
+        if now - self._demote_t < knobs["period_s"]:
+            return
+        self._demote_t = now
+        with self._demote_lock:
+            if self._demote_inflight >= knobs["max_inflight"]:
+                return
+            budget = knobs["max_inflight"] - self._demote_inflight
+            exclude = set(self._demote_skip)
+        from ray_tpu.serve.kv_router import prefix_store_on
+
+        if not prefix_store_on():
+            return
+        cands = self._mgr.demote_scan(
+            limit=min(knobs["limit"], budget),
+            min_idle=knobs["min_idle"], watermark=knobs["watermark"],
+            exclude=exclude)
+        if not cands:
+            return
+        import jax.numpy as jnp
+
+        q = self._ensure_export_thread()
+        gen, wv = self._cache_gen, self.weight_version
+        for c in cands:
+            n = c["depth"]
+            ids_p = list(c["blocks"]) + [0] * (_pow2(n) - n)
+            arr = self._gather_kv(self.cache["k"], self.cache["v"],
+                                  jnp.asarray(ids_p, jnp.int32))
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+            with self._demote_lock:
+                self._demote_inflight += 1
+            q.put(("demote", c, arr, gen, wv))
+
+    def _demote_one(self, c: dict, arr, gen: int, wv: int) -> None:
+        """Export-thread half of one demotion: materialize the host KV,
+        publish to the store, then finish the manager-side accounting
+        (pins released either way; the tier-1 leaf drops only when
+        tier 2 really holds the entry AND no weight swap invalidated
+        the KV mid-flight)."""
+        published = False
+        try:
+            host = np.ascontiguousarray(
+                np.asarray(arr)[:, :, :c["depth"]])
+        except BaseException:  # noqa: BLE001 - device fault
+            self.demote_failures += 1
+            host = None
+        if host is not None and gen == self._cache_gen:
+            from ray_tpu import failpoints
+
+            try:
+                if failpoints.ACTIVE:
+                    # The mid-demotion fault window: a crash here dies
+                    # BETWEEN the KV gather and the store registration
+                    # — the chaos shape the accounting must survive.
+                    failpoints.fire("serve.prefix_demote")
+                published = bool(self._demote_cb(dict(
+                    tokens=c["tokens"], kv=host, hashes=c["hashes"],
+                    depth=c["depth"], page=self.page,
+                    weight_version=wv)))
+            except BaseException:  # noqa: BLE001 - injected faults
+                self.demote_failures += 1
+            if not published:
+                with self._demote_lock:
+                    self._demote_skip.add(c["hash"])
+                    if len(self._demote_skip) > 4096:
+                        self._demote_skip.clear()
+        self._mgr.demote_finish(
+            c["leaf"], c["blocks"],
+            drop=published and gen == self._cache_gen)
+        if published:
+            self.demote_published += 1
+        with self._demote_lock:
+            self._demote_inflight -= 1
+        self._wake.set()
+
     def _reserve_blocks(self, req: _Request,
                         copies: list[tuple[int, int]]) -> bool:
         """Admission-time block reservation: match the longest cached
@@ -1342,58 +1616,61 @@ class LLMEngine:
         # skips the unified-path observation, and the decode side must
         # not re-observe a near-zero one).
         self._observe_done(req, time.perf_counter())
-        if self._export_q is None:
-            self._export_q = queue.Queue()
-            self._export_thread = threading.Thread(
-                target=self._export_loop, name="llm-kv-export",
-                daemon=True)
-            self._export_thread.start()
-        self._export_q.put((req, arr, ids, kv_len, n))
+        self._ensure_export_thread().put(
+            ("export", req, arr, ids, kv_len, n))
 
     def _export_loop(self) -> None:
-        """Materializes export payloads off the engine loop: one
-        stacked [2, L, n, kvh, page, hd] host array covering every
-        position whose KV has been written (the newest token's hasn't
-        — the importer recomputes it as its first decode step), then
-        resolves the request's future and drops the export pins."""
+        """Materializes device→host payloads off the engine loop: KV
+        migrations (kv_export) and prefix-store demotions both fetch
+        here so the decode loop never blocks on a tunnel round trip."""
         while True:
             item = self._export_q.get()
             if item is None:
                 return
-            req, arr, ids, kv_len, n = item
-            t_exp0 = time.time()
-            try:
-                # Contiguous copy of the REAL payload: a bare slice
-                # would pin the whole pow-2-padded buffer and force
-                # put() to copy the non-contiguous view again.
-                host = np.ascontiguousarray(np.asarray(arr)[:, :, :n])
-            except BaseException as e:  # noqa: BLE001
-                self._mgr.release(ids)
-                req.emit(None)
-                if not req.future.done():
-                    req.future.set_exception(e)
-                continue
+            if item[0] == "demote":
+                self._demote_one(*item[1:])
+            else:
+                self._export_one(*item[1:])
+
+    def _export_one(self, req, arr, ids, kv_len: int, n: int) -> None:
+        """One kv_export materialization: the stacked
+        [2, L, n, kvh, page, hd] host array covers every position whose
+        KV has been written (the newest token's hasn't — the importer
+        recomputes it as its first decode step); resolves the request's
+        future and drops the export pins."""
+        t_exp0 = time.time()
+        try:
+            # Contiguous copy of the REAL payload: a bare slice
+            # would pin the whole pow-2-padded buffer and force
+            # put() to copy the non-contiguous view again.
+            host = np.ascontiguousarray(np.asarray(arr)[:, :, :n])
+        except BaseException as e:  # noqa: BLE001
             self._mgr.release(ids)
-            self.kv_exports += 1
-            if tracing.ENABLED and req.trace is not None:
-                # The device→host KV fetch of one migration — the
-                # export half of the kv_export→put→pull→kv_import leg.
-                tracing.emit("llm.kv_export", t_exp0, ctx=req.trace,
-                             attrs={"bytes": host.nbytes,
-                                    "kv_len": kv_len, "pages": n})
-            now = time.perf_counter()
             req.emit(None)
             if not req.future.done():
-                req.future.set_result({
-                    "tokens": req.tokens,
-                    "ttft_s": (req.first_token_at or now)
-                    - req.submitted_at,
-                    "total_s": now - req.submitted_at,
-                    "kv_export": {
-                        "kv": host, "len": kv_len, "page": self.page,
-                        "sample_seed": req.sample_seed,
-                        "tokens": list(req.tokens)},
-                })
+                req.future.set_exception(e)
+            return
+        self._mgr.release(ids)
+        self.kv_exports += 1
+        if tracing.ENABLED and req.trace is not None:
+            # The device→host KV fetch of one migration — the
+            # export half of the kv_export→put→pull→kv_import leg.
+            tracing.emit("llm.kv_export", t_exp0, ctx=req.trace,
+                         attrs={"bytes": host.nbytes,
+                                "kv_len": kv_len, "pages": n})
+        now = time.perf_counter()
+        req.emit(None)
+        if not req.future.done():
+            req.future.set_result({
+                "tokens": req.tokens,
+                "ttft_s": (req.first_token_at or now)
+                - req.submitted_at,
+                "total_s": now - req.submitted_at,
+                "kv_export": {
+                    "kv": host, "len": kv_len, "page": self.page,
+                    "sample_seed": req.sample_seed,
+                    "tokens": list(req.tokens)},
+            })
 
     def _done(self, req: _Request) -> bool:
         return (len(req.tokens) >= req.max_new_tokens
@@ -1575,12 +1852,17 @@ class LLMEngine:
 
         while not self._stop.is_set():
             self._maybe_swap_weights()
+            # Grafts apply right after the swap (the version check must
+            # see the tree a commit would land in) and BEFORE admission
+            # so the request that triggered the graft prefix-hits it.
+            self._apply_grafts()
             self._admit()
             # ONE sync-window snapshot per iteration: funding and the
             # decode program must see the same K (set_sync_window may
             # race from a replica thread).
             k_win = self._k_live
             active = self._ensure_decode_blocks(k_win)
+            self._maybe_demote()
             self._flush_metrics()
             if not active:
                 if self._pending:
@@ -1704,6 +1986,10 @@ class LLMEngine:
                "kv_preempt": self._preempt_on,
                "kv_exports": self.kv_exports,
                "kv_imports": self.kv_imports,
+               "kv_grafts": self.kv_grafts,
+               "graft_tokens": self.graft_tokens,
+               "demote_published": self.demote_published,
+               "demote_failures": self.demote_failures,
                "weight_version": self.weight_version,
                "weight_updates": self.weight_updates,
                "weight_syncs_skipped": self.weight_syncs_skipped,
@@ -1760,7 +2046,8 @@ class LLMServer:
                  kv_preempt: bool | None = None,
                  steps_per_sync: int = 8,
                  role: str = "unified",
-                 decode_deployment=None):
+                 decode_deployment=None,
+                 prefix_store: dict | None = None):
         from ray_tpu.models import llama
 
         _check_pool_role(role, decode_deployment)
@@ -1815,10 +2102,103 @@ class LLMServer:
         self._degraded_window = max(1, min(2, steps_per_sync))
         self._sheds = 0
         self._restores = 0
+        # Tier-2 cluster prefix store (serve/prefix_store.py): the
+        # client owns this replica's demoted arena objects and runs
+        # the miss-path fetch/graft; config knobs ride the
+        # `prefix_store` dict ({"enabled", "min_idle", "period_s",
+        # "watermark_frac", "min_tokens", "migrate_ms", ...}).
+        self._prefix_store_cfg = dict(prefix_store or {})
+        self._prefix_client = None
+        self._closed = False
         self.engine = LLMEngine(cfg, params, **self._engine_kwargs)
+        self._install_prefix_store()
         self.engine.start()
         if warmup:
             self.engine.warmup()
+
+    def _install_prefix_store(self) -> None:
+        """(Re)attach the prefix-store client + demotion hook to the
+        current engine (constructor and every reconfigure rebuild).
+        Disabled for dense engines, prefix_cache=0 engines, and
+        explicitly via prefix_store={"enabled": False}."""
+        from ray_tpu.serve import prefix_store as pstore
+
+        if self._prefix_client is not None:
+            self._prefix_client.close()
+            self._prefix_client = None
+        eng = self.engine
+        cfg = self._prefix_store_cfg
+        if (not eng.paged or eng._mgr is None
+                or not eng._prefix_cache
+                or cfg.get("enabled", True) is False):
+            eng.set_prefix_store(None)
+            return
+        rid = None
+        try:
+            from ray_tpu.serve import replica as _replica
+
+            ctx = _replica.get_current_context()
+            if ctx is not None:
+                rid = ctx.replica_tag or None
+        except Exception:  # noqa: BLE001 - outside a replica
+            pass
+        self._prefix_client = pstore.PrefixStoreClient(
+            app=self._app_name or "default", deployment=eng.name,
+            # Unique in-process fallback: several servers can share one
+            # interpreter (tests, local mode) and a bare pid would make
+            # one server's close() withdraw its siblings' entries.
+            replica_id=rid or f"pid:{os.getpid()}-{os.urandom(3).hex()}",
+            seed=self._engine_kwargs.get("seed", 0), page=eng.page,
+            config=cfg, directory=cfg.get("directory"))
+        eng.set_prefix_store(
+            self._prefix_client.publish,
+            min_idle=cfg.get("min_idle", 256),
+            period_s=cfg.get("period_s", 0.25),
+            watermark_frac=cfg.get("watermark_frac", 0.125),
+            limit=cfg.get("limit", 2),
+            max_inflight=cfg.get("max_inflight", 2))
+
+    def _graft_eligible(self, request) -> bool:
+        """ONE copy of the miss-path gate for the unary and streaming
+        entry points (they must never diverge): a store-capable
+        request is a dict with a real token prompt of at least one
+        page, not opted out per request, with the env switch on."""
+        from ray_tpu.serve import prefix_store as pstore
+
+        eng = self.engine
+        if (self._prefix_client is None or not isinstance(request, dict)
+                or not request.get("prefix_store", True)
+                or eng._mgr is None or not eng._prefix_cache):
+            return False
+        prompt = request.get("prompt")
+        if not isinstance(prompt, (list, tuple)) \
+                or len(prompt) < eng.page:
+            return False
+        return pstore.prefix_store_on()
+
+    def _maybe_graft_sync(self, request: dict) -> None:
+        """Miss-path store consultation for one request (the tentpole
+        leg; blocking — callers keep it off the event loop): compare
+        the local radix match with the cluster directory and graft the
+        deepest affordable stored prefix before submitting.
+        Per-request kill switches: RAY_TPU_PREFIX_STORE=0 and
+        {"prefix_store": false}.  Any failure degrades to a plain
+        local prefill."""
+        if not self._graft_eligible(request):
+            return
+        try:
+            self._prefix_client.maybe_graft(
+                self.engine, list(request["prompt"]))
+        except Exception:  # noqa: BLE001 - degrade, never fail
+            pass
+
+    async def _maybe_graft_async(self, request: dict) -> None:
+        import asyncio
+
+        if not self._graft_eligible(request):
+            return
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._maybe_graft_sync, request)
 
     # ----------------------------------------------- overload ladder
     def _update_pressure(self) -> int:
@@ -2010,12 +2390,33 @@ class LLMServer:
         LLMEngine.update_weights documents (tree / ObjectRef / list of
         refs).  Returns the staged (or, kill-switched, current)
         version."""
-        return self.engine.update_weights(refs, version)
+        v = self.engine.update_weights(refs, version)
+        if self._prefix_client is not None:
+            # Cached KV belongs to the policy that computed it — the
+            # engine flushes tier 1; tier 2 invalidates here (lookup's
+            # version filter already refuses stale entries, this
+            # reclaims their arena bytes too).
+            try:
+                self._prefix_client.invalidate(v)
+            except Exception:  # noqa: BLE001 - store is best-effort
+                pass
+        return v
 
     def kv_check(self) -> dict:
         """Assert the engine's block-state partition (test/ops probe):
-        raises if any block is leaked or double-booked."""
-        return self.engine.kv_check()
+        raises if any block is leaked or double-booked.  Also reports
+        the tier-2 prefix objects this replica still owns, and — after
+        shutdown — asserts that count is ZERO (demoted subtrees must
+        be freed when the app is deleted)."""
+        out = self.engine.kv_check()
+        if self._prefix_client is not None:
+            n = self._prefix_client.object_count()
+            out["prefix_store_objects"] = n
+            if self._closed and n:
+                raise AssertionError(
+                    f"{n} tier-2 prefix arena objects leaked after "
+                    "shutdown (demoted subtrees must die with the app)")
+        return out
 
     async def __call__(self, request: dict) -> dict:
         import asyncio
@@ -2025,6 +2426,11 @@ class LLMServer:
         # same engine, same seed, token-identical output, minus the
         # migration round trips the overloaded pool can't afford.
         level = self._update_pressure()
+        if level < 1:
+            # Overloaded replicas (level >= 1) skip the store entirely:
+            # a migration's extra bytes/RTs are exactly what a drowning
+            # pool can't afford — the degradation-ladder discipline.
+            await self._maybe_graft_async(request)
         if level < 1 and self._disagg(request):
             return await self._prefill_decode(request)
         fut = self.engine.submit(
@@ -2043,7 +2449,10 @@ class LLMServer:
         # The ladder must track streaming traffic too: without this a
         # streaming-only workload could neither enter overload nor
         # restore a previously-shrunk sync window.
-        self._update_pressure()
+        level = self._update_pressure()
+        if level < 1:
+            # stream() runs on a pool thread — blocking is fine.
+            self._maybe_graft_sync(request)
         q: queue.Queue = queue.Queue()
         fut = self.engine.submit(
             request["prompt"],
@@ -2082,6 +2491,9 @@ class LLMServer:
             "sheds": self._sheds,
             "restores": self._restores,
         }
+        out["prefix_store"] = (self._prefix_client.stats()
+                               if self._prefix_client is not None
+                               else {"enabled": False})
         return out
 
     def reconfigure(self, user_config: dict) -> None:
@@ -2104,6 +2516,7 @@ class LLMServer:
                 f"unknown engine_config keys {sorted(unknown)}; "
                 f"valid: {sorted(allowed)}")
         cfg = dict(user_config)
+        ps_given = cfg.pop("prefix_store", None)
         # Pool-role knobs live on the SERVER, not the engine: applying
         # them never costs an engine rebuild.  Validate the WHOLE new
         # configuration before mutating anything — a rejected
@@ -2135,6 +2548,9 @@ class LLMServer:
 
         if kwargs == self._engine_kwargs:
             commit_roles()
+            if ps_given is not None:
+                self._prefix_store_cfg = dict(ps_given)
+                self._install_prefix_store()
             return
         old = self.engine
         old.stop()
@@ -2146,6 +2562,12 @@ class LLMServer:
         # of the (unavoidably) stopped engine.
         self.engine = LLMEngine(self._cfg, self._params, **kwargs)
         commit_roles()
+        if ps_given is not None:
+            self._prefix_store_cfg = dict(ps_given)
+        # The rebuilt engine needs the demotion hook re-attached (and
+        # the old engine's published entries withdrawn — their KV may
+        # no longer match the new memory shape).
+        self._install_prefix_store()
         self.engine.start()
         if self._warmup:
             self.engine.warmup()
@@ -2159,6 +2581,15 @@ class LLMServer:
         self.engine.stop()
         self.engine.abort_pending(
             RuntimeError("LLM engine shut down with the replica"))
+        # AFTER engine.stop(): the export thread drains in-flight
+        # demotions first, so a publish can't race the withdraw and
+        # strand an arena object past app delete.
+        self._closed = True
+        if self._prefix_client is not None:
+            try:
+                self._prefix_client.close()
+            except Exception:  # noqa: BLE001 - controller already gone
+                pass
 
     def __del__(self):
         # GC backstop only — the deterministic path is shutdown().

@@ -24,7 +24,8 @@ from ray_tpu.serve.deployment import Application
 # prefill/decode pools (see LLMServer pool roles).
 ENGINE_CONFIG_KEYS = {"page_size", "kv_blocks", "prefix_cache",
                       "kv_preempt", "max_batch", "max_len",
-                      "steps_per_sync", "role", "decode_deployment"}
+                      "steps_per_sync", "role", "decode_deployment",
+                      "prefix_store"}
 
 ENGINE_ROLES = ("unified", "prefill", "decode")
 
@@ -89,6 +90,13 @@ class DeploymentSchema:
                 raise ValueError(
                     f"deployment {d.get('name')!r}: engine_config.role "
                     f"must be one of {list(ENGINE_ROLES)}, got {role!r}")
+            ps = ec.get("prefix_store")
+            if ps is not None and not isinstance(ps, dict):
+                raise ValueError(
+                    f"deployment {d.get('name')!r}: "
+                    f"engine_config.prefix_store must be a dict of "
+                    f"tier-2 store knobs (enabled/min_idle/period_s/"
+                    f"watermark_frac/...), got {type(ps).__name__}")
             dd = ec.get("decode_deployment")
             if dd is not None and not isinstance(dd, str):
                 raise ValueError(

@@ -209,3 +209,60 @@ def test_hammer_random_ops_no_leaks():
     # Everything cached is reclaimable once nothing holds refs.
     assert m.evictable_count() == m.cached_count()
     assert m.allocate(m.n_blocks) is not None
+
+
+# ---------------------------------------- summary truncation (ISSUE 12)
+def test_prefix_summary_cap_truncation_consistent():
+    """A radix tree larger than the summary cap truncates to the
+    newest-LRU subset — and the XOR digest must be computed over
+    EXACTLY the truncated hash list, so router scoring (which compiles
+    the hash list) and store indexing (which trusts the digest as the
+    change probe) can never disagree about the same tree."""
+    from ray_tpu.serve.kv_router import summary_digest
+
+    m = BlockManager(16, 4)
+    seqs = []
+    for i in range(6):
+        toks = [i * 16 + j + 1 for j in range(8)]     # 2 chunks each
+        blocks = m.allocate(2)
+        m.commit(toks, blocks)
+        m.release(blocks)
+        seqs.append(toks)
+    assert m.cached_count() == 12
+    s = m.prefix_summary(cap=5)
+    assert len(s["hashes"]) == 5
+    assert s["cached"] == 12
+    # The digest matches the TRUNCATED list, not the full tree.
+    assert s["digest"] == summary_digest(s["hashes"])
+    full = m.prefix_summary(cap=2048)
+    assert len(full["hashes"]) == 12
+    assert full["digest"] == summary_digest(full["hashes"])
+    assert set(s["hashes"]) <= set(full["hashes"])
+    # Newest-LRU first: touching an old path pulls its hashes into the
+    # truncated set on the next rebuild (the memo keys on (cap, set)).
+    got = m.match(seqs[0])
+    m.release(got)
+    blocks = m.allocate(2)
+    m.commit([991, 992, 993, 994, 995, 996, 997, 998], blocks)
+    m.release(blocks)                     # set changed -> memo drops
+    s2 = m.prefix_summary(cap=5)
+    assert s2["digest"] == summary_digest(s2["hashes"])
+    from ray_tpu.serve.kv_router import prompt_hashes
+
+    assert set(prompt_hashes(seqs[0], 4)) <= set(s2["hashes"])
+    m.check()
+
+
+def test_prefix_summary_cap_rebuilds_per_cap():
+    """Different caps rebuild (the memo is cap-keyed): a small-cap call
+    must not poison a later full-cap call or vice versa."""
+    m = BlockManager(16, 4)
+    for i in range(4):
+        blocks = m.allocate(2)
+        m.commit([i * 16 + j + 1 for j in range(8)], blocks)
+        m.release(blocks)
+    small = m.prefix_summary(cap=3)
+    big = m.prefix_summary(cap=100)
+    assert len(small["hashes"]) == 3 and len(big["hashes"]) == 8
+    small2 = m.prefix_summary(cap=3)
+    assert small2["hashes"] == small["hashes"]

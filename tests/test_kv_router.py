@@ -182,3 +182,90 @@ def test_handle_pick_capacity_overrides_locality(monkeypatch):
     h._inflight["b"] = 1
     rid2, _ = h._pick(prompt=PROMPT)
     assert rid2 == "b"
+
+
+# -------------------------------------- tier-2 store scoring (ISSUE 12)
+def test_store_depth_tokens():
+    hs = kv_router.prompt_hashes(PROMPT, 8)
+    store = {8: frozenset(hs[:2])}
+    assert kv_router.store_depth_tokens(PROMPT, store) == 16
+    assert kv_router.store_depth_tokens([7] * 32, store) == 0
+    # Deepest across page groups wins, measured in TOKENS.
+    hs4 = kv_router.prompt_hashes(PROMPT, 4)
+    store2 = {8: frozenset(hs[:1]), 4: frozenset(hs4[:5])}
+    assert kv_router.store_depth_tokens(PROMPT, store2) == 20
+
+
+def test_choose_store_levels_the_field():
+    """A deep tier-2 (cluster-resident) prefix serves ANY replica — a
+    shallow LIVE match must no longer drag the request onto a loaded
+    replica, and the queue discount spreads the load instead."""
+    summaries = {"a": _summary_for(PROMPT[:8])}      # 1 block live
+    store = {8: frozenset(kv_router.prompt_hashes(PROMPT, 8))}  # 4 deep
+    # Without the store view: the shallow live match wins while idle.
+    assert kv_router.choose(PROMPT, ["a", "b"], {"a": 0, "b": 0},
+                            summaries) == "a"
+    # With it: both replicas score the store's depth; a's load tips the
+    # tie to idle b (graft there, then IT is live-warm).
+    assert kv_router.choose(PROMPT, ["a", "b"], {"a": 2, "b": 0},
+                            summaries, store=store) == "b"
+    # A store-only match still counts as a match (no pow-2 fallback),
+    # and the explain breakdown records the store depth.
+    explain = {}
+    got = kv_router.choose(PROMPT, ["b"], {}, {}, explain=explain,
+                           store=store)
+    assert got == "b" and explain["store_tokens"] == 32
+    # Store empty → byte-for-byte the legacy scoring.
+    assert kv_router.choose(PROMPT, ["a", "b"], {"a": 0, "b": 0},
+                            summaries, store={}) == "a"
+
+
+def test_handle_pick_uses_store_sets(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_CACHE_ROUTER", raising=False)
+    monkeypatch.delenv("RAY_TPU_PREFIX_STORE", raising=False)
+    h = _fake_handle({"b": _summary_for(PROMPT[:8])},
+                     {"a": 0, "b": 3})
+    h._store_sets = {8: frozenset(kv_router.prompt_hashes(PROMPT, 8))}
+    rid, _ = h._pick(prompt=PROMPT)
+    assert rid == "a"                # store levels b's shallow match
+    h._done(rid)
+    # Kill switch drops the store view but keeps live scoring: with
+    # the queues level, b's live match wins again.
+    monkeypatch.setenv("RAY_TPU_PREFIX_STORE", "0")
+    h._inflight = {"a": 0, "b": 0}
+    rid2, _ = h._pick(prompt=PROMPT)
+    assert rid2 == "b"               # only the live match scores now
+    h._done(rid2)
+
+
+# ------------------------- malformed-summary surfacing (ISSUE 12 sat.)
+def test_malformed_summary_counts_and_warns_once(caplog):
+    """handle._refresh_summaries used to silently score a replica with
+    a broken metrics dict as 'no match' — a gossip regression degraded
+    routing to power-of-two with NO signal.  Now: counter + ONE
+    warning per handle; replicas with no summary at all (non-LLM) stay
+    silent."""
+    import logging
+
+    h = _fake_handle({}, {})
+    good = {"user_stats": {"kv": {"prefix_summary":
+                                  {"page": 8, "hashes": [1, 2],
+                                   "digest": 3}}}}
+    none_at_all = {"user_stats": {"num_ongoing": 0}}
+    malformed = {"user_stats": {"kv": {"prefix_summary":
+                                       {"page": 0, "hashes": None}}}}
+    with caplog.at_level(logging.WARNING, "ray_tpu.serve.handle"):
+        out = h._compile_replica_summaries(
+            {"r1": good, "r2": none_at_all, "r3": malformed,
+             "r4": "not-a-dict"})
+    assert set(out) == {"r1"}
+    assert h._summary_drops == 2          # r3 + r4; r2 is by-design
+    warnings = [r for r in caplog.records
+                if "malformed prefix summary" in r.message]
+    assert len(warnings) == 1             # one-shot
+    with caplog.at_level(logging.WARNING, "ray_tpu.serve.handle"):
+        h._compile_replica_summaries({"r3": malformed})
+    assert h._summary_drops == 3
+    warnings = [r for r in caplog.records
+                if "malformed prefix summary" in r.message]
+    assert len(warnings) == 1             # still one

@@ -241,3 +241,49 @@ def test_tracing_importable_standalone(mod):
     import importlib
 
     assert importlib.import_module(mod) is not None
+
+
+# -------------------------------- cluster prefix store (ISSUE 12)
+# The tiered KV store must build ONLY on core primitives (objects /
+# arena through the ray_tpu api, ObjectRef), public facades (tracing,
+# failpoints, exceptions) and serve siblings — never _private runtime
+# internals (the generic ban in _violations() covers the negative;
+# this pins the allowed-surface contract like the RLHF/SLO sections).
+PREFIX_STORE_MODULES = ("serve/prefix_store.py",)
+
+PREFIX_STORE_ALLOWED_PREFIXES = (
+    "ray_tpu.serve", "ray_tpu.exceptions", "ray_tpu.failpoints",
+    "ray_tpu.tracing", "ray_tpu.object_ref", "ray_tpu.actor",
+    "ray_tpu.runtime_context",
+)
+
+
+def test_prefix_store_is_walked_by_the_layering_scan():
+    for rel in PREFIX_STORE_MODULES:
+        path = os.path.join(PKG, rel)
+        assert os.path.exists(path), path
+        assert list(_imports_of(path)), f"no imports parsed in {rel}?"
+
+
+def test_prefix_store_imports_only_core_and_public_facades():
+    bad = []
+    for rel in PREFIX_STORE_MODULES:
+        path = os.path.join(PKG, rel)
+        for mod, lineno in _imports_of(path):
+            if not (mod == "ray_tpu" or mod.startswith("ray_tpu.")):
+                continue
+            if mod == "ray_tpu" or any(
+                    mod == p or mod.startswith(p + ".")
+                    for p in PREFIX_STORE_ALLOWED_PREFIXES):
+                continue
+            bad.append(f"ray_tpu/{rel}:{lineno}: imports {mod}")
+    assert not bad, (
+        "prefix_store must build on core primitives and public "
+        "facades only —\n  " + "\n  ".join(bad))
+
+
+def test_prefix_store_importable_standalone():
+    import importlib
+
+    assert importlib.import_module(
+        "ray_tpu.serve.prefix_store") is not None
