@@ -1473,6 +1473,125 @@ def bench_trace_overhead() -> dict:
         eng.stop()
 
 
+def bench_memory_ledger() -> dict:
+    """Object-ledger overhead + harvest latency (ISSUE 13): the put/get
+    hot path with the ledger on vs off in the SAME run (set_enabled
+    flips the module flag live, the trace-overhead discipline), then
+    one cluster harvest at ~1k live objects.
+
+    The overhead ARGUMENT counts annotations, not milliseconds
+    (CLAUDE.md: this box's timing swings 3x hour-to-hour): the on leg
+    must annotate every put, the off leg exactly zero.  The guarded
+    memory_ledger_overhead_pct is measured annotation cost over
+    measured per-pair wall (both individually stable), bounded by the
+    acceptance criterion at 3% absolute like trace_overhead_pct; the
+    raw throughput A/B rides along unguarded (adjacent same-arm legs
+    differ ±20% here — a ~1µs/put effect is below that floor)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import memledger as ml
+    from ray_tpu.utils import state
+
+    ray_tpu.init(resources={"CPU": 4},
+                 object_store_memory=512 * 1024 * 1024)
+    prev_enabled = ml.ENABLED
+    out: dict = {}
+    try:
+        payload = np.zeros(1024, np.uint8)   # inline-path put/get
+        # ~1s legs: adjacent 0.2s legs of the SAME arm differ ±30% on
+        # this box (steal bursts), which buries the ~0.7µs/put signal;
+        # second-long windows average the bursts out.
+        n_ops = 20000
+        # Warm the whole put/get path first: this box ramps ~3x over
+        # the first ~12k ops of a fresh driver (allocator/scheduler
+        # warm-up), so a short warmup makes the FIRST leg measure the
+        # ramp, not the ledger.
+        for _ in range(6000):
+            ray_tpu.get(ray_tpu.put(payload))
+
+        def leg(ledger_on: bool) -> dict:
+            ml.set_enabled(ledger_on)
+            noted0 = ml.stats()["noted"]
+            t0 = time.perf_counter()
+            for _ in range(n_ops):
+                ray_tpu.get(ray_tpu.put(payload))
+            wall = time.perf_counter() - t0
+            return {"ops_per_s": round(2 * n_ops / wall, 1),
+                    "wall_s": round(wall, 3),
+                    # Monotonic count: `tracked` nets to zero when refs
+                    # free as fast as they are minted.
+                    "annotations": ml.stats()["noted"] - noted0}
+
+        # Paired rounds, ORDER ALTERNATED, MEDIAN of per-round deltas:
+        # hypervisor steal and an in-process ramp swing single legs
+        # ±15% on this box — far above the ~1µs/put signal.  Pairing
+        # temporally-adjacent legs cancels drift to first order,
+        # alternation cancels residual order bias, and the median
+        # ignores the one stolen round.  (A fixed off-then-on order
+        # measured anywhere from -60% to +15% here.)
+        off_trials, on_trials, deltas = [], [], []
+        for i in range(4):
+            order = (False, True) if i % 2 == 0 else (True, False)
+            pair = {}
+            for arm in order:
+                t = leg(arm)
+                pair[arm] = t
+                (on_trials if arm else off_trials).append(t)
+            deltas.append(
+                (pair[False]["ops_per_s"] - pair[True]["ops_per_s"])
+                / max(pair[False]["ops_per_s"], 1e-9) * 100.0)
+        off = max(off_trials, key=lambda t: t["ops_per_s"])
+        on = max(on_trials, key=lambda t: t["ops_per_s"])
+        off["annotations"] = sum(t["annotations"] for t in off_trials)
+        on["annotations"] = sum(t["annotations"] for t in on_trials)
+        deltas.sort()
+        ab_delta_pct = round((deltas[1] + deltas[2]) / 2.0, 2)
+        # The GUARDED overhead row is annotation-cost ÷ pair-wall: two
+        # individually stable measurements.  The throughput delta of a
+        # ~1µs/put effect is unresolvable here — adjacent ~1s legs of
+        # the SAME arm differ ±20% on this box (hypervisor steal), so
+        # the A/B delta above is reported but not guarded.
+        ml.set_enabled(True)
+        probe = b"\xfe" + b"p" * 15
+        n_probe = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            ml.note_put(probe)
+            ml.note_free(probe)
+        ann_ns = (time.perf_counter() - t0) / n_probe * 1e9
+        off_walls = sorted(t["wall_s"] for t in off_trials)
+        pair_us = off_walls[len(off_walls) // 2] / n_ops * 1e6
+        overhead_pct = round(ann_ns / 1000.0 / pair_us * 100.0, 2)
+        # Harvest latency at ~1k live objects (the "where did the
+        # memory go" call a debugging session actually makes).
+        ml.set_enabled(True)
+        live = [ray_tpu.put(np.full(2048, i % 251, np.uint8))
+                for i in range(1000)]
+        t0 = time.perf_counter()
+        rows = state.list_objects()
+        harvest_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+        out = {
+            "memory_ledger_bench": {"ledger_on": on, "ledger_off": off,
+                                    "annotation_ns": round(ann_ns, 1),
+                                    "pair_wall_us": round(pair_us, 2),
+                                    "ab_delta_pct": ab_delta_pct},
+            "memory_ledger_overhead_pct": overhead_pct,
+            "memory_ledger_on_ops_per_s": on["ops_per_s"],
+            "memory_ledger_off_ops_per_s": off["ops_per_s"],
+            # The off-leg annotation count is the kill-switch proof
+            # (0 == the switch really restored the baseline path).
+            "memory_ledger_off_annotations": off["annotations"],
+            "memory_harvest_ms": harvest_ms,
+            "memory_harvest_rows": len(rows),
+        }
+        del live
+    finally:
+        ml.set_enabled(prev_enabled)
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_serve_cluster_route() -> dict:
     """Cluster-level serving (round 11): TWO same-run A/Bs through the
     full serve stack.
@@ -2255,7 +2374,11 @@ def _vs_previous_round(extra: dict) -> dict:
     higher_better = {"rlhf_rollout_hit_rate", "serve_slo_attainment_pct",
                      "serve_prefix_store_hit_pct"}
     lower_better = {"rlhf_weight_lag_windows"}
-    absolute_bars = {"trace_overhead_pct": 3.0}
+    # Round 17: the memory-ledger overhead is the same noise-around-
+    # zero percent shape as the trace overhead — absolute 3% bar, not
+    # a ratio guard; memory_harvest_ms rides the _ms guard.
+    absolute_bars = {"trace_overhead_pct": 3.0,
+                     "memory_ledger_overhead_pct": 3.0}
     out = {}
     for key, val in extra.items():
         pv = _num(prev_extra.get(key))
@@ -2444,6 +2567,14 @@ def main() -> None:
         extra.update(_with_timeout(bench_trace_overhead, 420))
     except Exception as e:  # noqa: BLE001
         extra["trace_overhead_error"] = repr(e)
+    _flush_partial(extra)
+    try:
+        # Ledger on/off put-get A/B + one ~1k-object harvest on a
+        # fresh local cluster (boot dominates; timed loops are
+        # seconds).
+        extra.update(_with_timeout(bench_memory_ledger, 300))
+    except Exception as e:  # noqa: BLE001
+        extra["memory_ledger_error"] = repr(e)
     _flush_partial(extra)
     regressions = _vs_previous_round(extra)
     if regressions:

@@ -748,6 +748,49 @@ int rt_store_oldest(void* hv, uint8_t* out_id) {
   return 1;
 }
 
+// Memory-ledger scan: pack every live index entry (creating or sealed)
+// into `out` as 48-byte records {id[16], size u64, lru_tick u64,
+// state u32, pins u32, creator_pid i32, pad u32}; returns the record
+// count (never more than max_entries).  One pass under the mutex — the
+// caller (agent leak sentinel / memory harvest) runs on a seconds
+// cadence, so the O(kIndexSlots) walk is off every hot path.
+uint32_t rt_store_scan(void* hv, uint8_t* out, uint32_t max_entries) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(h);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < kIndexSlots && n < max_entries; i++) {
+    IndexEntry* e = &h->hdr->index[i];
+    if (e->state != 1 && e->state != 2) continue;
+    uint8_t* rec = out + static_cast<uint64_t>(n) * 48;
+    std::memcpy(rec, e->id, 16);
+    std::memcpy(rec + 16, &e->size, 8);
+    std::memcpy(rec + 24, &e->lru_tick, 8);
+    std::memcpy(rec + 32, &e->state, 4);
+    std::memcpy(rec + 36, &e->pins, 4);
+    std::memcpy(rec + 40, &e->creator_pid, 4);
+    std::memset(rec + 44, 0, 4);
+    n++;
+  }
+  return n;
+}
+
+// Pin-table scan for pin attribution: 20-byte records {id[16], pid i32}
+// per live read pin.  Same cadence discipline as rt_store_scan.
+uint32_t rt_store_pin_scan(void* hv, uint8_t* out, uint32_t max_entries) {
+  Handle* h = static_cast<Handle*>(hv);
+  MutexGuard g(h);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < kPinSlots && n < max_entries; i++) {
+    PinRecord* r = &h->hdr->pin_records[i];
+    if (r->pid <= 0) continue;
+    uint8_t* rec = out + static_cast<uint64_t>(n) * 20;
+    std::memcpy(rec, r->id, 16);
+    std::memcpy(rec + 16, &r->pid, 4);
+    n++;
+  }
+  return n;
+}
+
 void rt_store_stats(void* hv, uint64_t* used, uint64_t* capacity,
                     uint64_t* num_objects) {
   Handle* h = static_cast<Handle*>(hv);

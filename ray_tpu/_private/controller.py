@@ -28,6 +28,7 @@ from typing import Any
 
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import memledger
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private import spans
 from ray_tpu._private.config import Config
@@ -537,6 +538,23 @@ class Controller:
         prefix = h.get("prefix", "")
         return {"keys": [k for k in ns if k.startswith(prefix)]}
 
+    async def rpc_kv_multiget(self, h: dict, _b: list) -> tuple[dict, list]:
+        """Batched kv_get: explicit `keys`, or every key under a
+        `prefix` — ONE round trip where list_metrics used to pay one
+        per worker (the values ride back as blobs in key order)."""
+        ns = self.kv.get(h.get("ns", ""), {})
+        keys = h.get("keys")
+        if keys is None:
+            prefix = h.get("prefix", "")
+            keys = [k for k in ns if k.startswith(prefix)]
+        found, blobs = [], []
+        for k in keys:
+            val = ns.get(k)
+            if val is not None:
+                found.append(k)
+                blobs.append(val)
+        return {"keys": found}, blobs
+
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
         """Register + schedule an actor (ray: HandleRegisterActor/HandleCreateActor
@@ -1012,6 +1030,13 @@ class Controller:
                                   "driver_addr": h.get("driver_addr")}
         return {}
 
+    async def rpc_job_finished(self, h: dict, _b: list) -> dict:
+        j = self.jobs.get(h["job_id"])
+        if j is not None:
+            j["state"] = "FINISHED"
+            j["end"] = time.time()
+        return {}
+
     async def rpc_failpoints(self, h: dict, _b: list) -> dict:
         """Cluster-wide fault-injection control verb: apply to the
         controller itself and, with broadcast=True, fan out to every
@@ -1058,6 +1083,68 @@ class Controller:
 
             local["nodes"] = dict(await asyncio.gather(
                 *(_one(n) for n in alive)))
+        return local
+
+    async def rpc_memory(self, h: dict, _b: list) -> dict:
+        """Cluster-wide object-ledger harvest: this controller's ledger
+        reply and, with broadcast=True, every ALIVE agent's (each of
+        which joins in its arena pin table and fans out to its
+        workers) — the spans-verb fan-out shape, so a wedged agent
+        costs ONE bounded timeout and the merged table degrades to
+        partial-with-diagnostic."""
+        sub = {k: v for k, v in h.items() if k != "broadcast"}
+        local = memledger.control(sub)
+        if h.get("broadcast"):
+            alive = [n for n in list(self.nodes.values())
+                     if n.state == "ALIVE"]
+
+            async def _one(node):
+                try:
+                    reply, _ = await self.clients.get(node.agent_addr).call(
+                        "memory", h, timeout=15.0)
+                    return node.node_id, reply
+                except Exception as e:  # noqa: BLE001 - node churning
+                    return node.node_id, {"error": repr(e)}
+
+            # Job DRIVERS are workers no agent supervises, yet they own
+            # objects like any worker — without this leg an external
+            # observer (the `ray memory` CLI attaching as its own
+            # driver) would see every other driver's objects as
+            # unowned.  A driver that answers neither memory nor a ping
+            # is demoted to UNREACHABLE so stale jobs cost only a short
+            # probe on later harvests (clean exits report job_finished
+            # and are skipped outright) — and PROMOTED BACK to RUNNING
+            # the moment one answers again: a single missed window
+            # (stalled IO thread, steal burst) must not hide a live
+            # driver's ownership forever.
+            async def _drv(jid, j):
+                addr = j["driver_addr"]
+                demoted = j.get("state") == "UNREACHABLE"
+                try:
+                    reply, _ = await self.clients.get(addr).call(
+                        "memory", sub, timeout=3.0 if demoted else 10.0)
+                    if demoted:
+                        j["state"] = "RUNNING"
+                    return jid, reply
+                except Exception as e:  # noqa: BLE001
+                    if not demoted:
+                        try:
+                            await self.clients.get(addr).call(
+                                "ping", {}, timeout=5.0)
+                            return jid, {"error": repr(e)}
+                        except Exception:  # noqa: BLE001 - driver gone
+                            j["state"] = "UNREACHABLE"
+                    return jid, {"error": f"driver unreachable: {e!r}",
+                                 "gone": True}
+
+            drivers = [(jid, j) for jid, j in list(self.jobs.items())
+                       if j.get("state") in ("RUNNING", "UNREACHABLE")
+                       and j.get("driver_addr")]
+            nodes_res, drivers_res = await asyncio.gather(
+                asyncio.gather(*(_one(n) for n in alive)),
+                asyncio.gather(*(_drv(jid, j) for jid, j in drivers)))
+            local["nodes"] = dict(nodes_res)
+            local["drivers"] = dict(drivers_res)
         return local
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:

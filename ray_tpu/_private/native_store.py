@@ -166,6 +166,12 @@ def load_lib():
     lib.rt_store_stream_mode.argtypes = []
     lib.rt_store_prefault_free.restype = ctypes.c_uint64
     lib.rt_store_prefault_free.argtypes = [ctypes.c_void_p]
+    lib.rt_store_scan.restype = ctypes.c_uint32
+    lib.rt_store_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint32]
+    lib.rt_store_pin_scan.restype = ctypes.c_uint32
+    lib.rt_store_pin_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint32]
     lib.rt_store_close.argtypes = [ctypes.c_void_p]
     lib.rt_store_unlink.argtypes = [ctypes.c_char_p]
     _lib = lib
@@ -522,6 +528,37 @@ class Arena:
         """Reclaim pins held by crash-killed processes (agent-side)."""
         return int(self.lib.rt_store_sweep_dead(self.handle))
 
+    _SCAN_MAX = 65536          # kIndexSlots: one record per index entry
+
+    def scan_objects(self) -> list[dict]:
+        """Memory-ledger view of every live entry: object id, size,
+        sealed/creating state, pin count, creator pid, LRU tick.  One
+        pass under the arena mutex — harvest/sentinel cadence only,
+        never a hot path."""
+        buf = ctypes.create_string_buffer(48 * self._SCAN_MAX)
+        n = int(self.lib.rt_store_scan(self.handle, buf, self._SCAN_MAX))
+        out = []
+        for i in range(n):
+            rec = buf.raw[i * 48:(i + 1) * 48]
+            size, tick = struct.unpack_from("<QQ", rec, 16)
+            state, pins = struct.unpack_from("<II", rec, 32)
+            (creator_pid,) = struct.unpack_from("<i", rec, 40)
+            out.append({"object_id": rec[:16], "size": size,
+                        "lru_tick": tick,
+                        "sealed": state == 2, "pins": pins,
+                        "creator_pid": creator_pid})
+        return out
+
+    def scan_pins(self) -> list[tuple[bytes, int]]:
+        """(object id, reader pid) of every live pid-attributed read
+        pin — the leak sentinel cross-references these against live
+        pids."""
+        buf = ctypes.create_string_buffer(20 * 8192)
+        n = int(self.lib.rt_store_pin_scan(self.handle, buf, 8192))
+        return [(buf.raw[i * 20:i * 20 + 16],
+                 struct.unpack_from("<i", buf.raw, i * 20 + 16)[0])
+                for i in range(n)]
+
     def oldest(self) -> bytes | None:
         """LRU unpinned sealed object id — the next spill candidate."""
         out = ctypes.create_string_buffer(16)
@@ -613,6 +650,12 @@ class NativeStoreBackend:
 
     def sweep_dead(self) -> int:
         return self.arena.sweep_dead()
+
+    def scan_objects(self) -> list[dict]:
+        return self.arena.scan_objects()
+
+    def scan_pins(self) -> list[tuple[bytes, int]]:
+        return self.arena.scan_pins()
 
     def oldest(self) -> bytes | None:
         return self.arena.oldest()

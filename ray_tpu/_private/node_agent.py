@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import memledger
 from ray_tpu._private import scheduler as sched
 from ray_tpu._private import spans
 from ray_tpu._private.config import Config
@@ -171,6 +172,14 @@ class NodeAgent:
             from ray_tpu._private.zygote import ZygoteSpawner
 
             self._zygote = ZygoteSpawner(config.temp_dir)
+        # Leak sentinel (memory ledger): latest scan + cumulative flag
+        # counters.  The reaper scans BEFORE sweep_dead, so every pin
+        # the sweep reclaims was flagged first — the totals never miss
+        # a leak the cluster healed on its own.
+        self._leak_last: dict | None = None
+        self._leak_totals = {"scans": 0, "orphan_pins_flagged": 0,
+                             "orphan_pin_bytes_flagged": 0,
+                             "creating_dead_creator_flagged": 0}
         import tempfile
 
         self._log_dir = os.path.join(
@@ -492,6 +501,17 @@ class NodeAgent:
             now = time.monotonic()
             if now - last_sweep >= 5.0 and self.store is not None:
                 last_sweep = now
+                # Leak sentinel BEFORE the sweep: pins the sweep is
+                # about to reclaim get flagged (span + counters) first,
+                # so a self-healed leak still leaves an alarm trail.
+                # NOT gated on memledger.ENABLED: the kill switch gates
+                # annotations only — a gated scan would freeze
+                # _leak_last at its last (possibly dirty) snapshot and
+                # alarm forever after a live flip.
+                try:
+                    self._leak_scan()
+                except Exception:  # noqa: BLE001
+                    pass
                 sweep = getattr(self.store.backend, "sweep_dead", None)
                 if sweep is not None:
                     try:
@@ -1148,6 +1168,86 @@ class NodeAgent:
                 try:
                     reply, _ = await self.clients.get(w.addr).call(
                         "spans", sub, timeout=10.0)
+                    return w.worker_id, reply
+                except Exception as e:  # noqa: BLE001 - worker churning
+                    return w.worker_id, {"error": repr(e)}
+
+            local["workers"] = dict(await asyncio.gather(
+                *(_one(w) for w in live)))
+        return local
+
+    def _leak_scan(self) -> dict:
+        """One leak-sentinel pass (memledger.sentinel_scan over this
+        node's store): flags arena pins held by dead pids and
+        creating-state blocks with dead creators, emits a
+        `memory.leak` flight-recorder span per dirty scan, and keeps
+        cumulative totals (a flagged pin the very next sweep reclaims
+        must still count)."""
+        if self.store is None:
+            return {}
+        scan = memledger.sentinel_scan(self.store.backend)
+        scan["spilled_bytes"] = self.store.spilled_bytes
+        self._leak_totals["scans"] += 1
+        if scan.get("arena_orphan_pins") or \
+                scan.get("creating_dead_creator"):
+            self._leak_totals["orphan_pins_flagged"] += \
+                scan["arena_orphan_pins"]
+            self._leak_totals["orphan_pin_bytes_flagged"] += \
+                scan["arena_orphan_pin_bytes"]
+            self._leak_totals["creating_dead_creator_flagged"] += \
+                scan["creating_dead_creator"]
+            t = time.time()
+            spans.emit("memory.leak", t, t, attrs={
+                "node": self.node_id[:12],
+                "orphan_pins": scan["arena_orphan_pins"],
+                "orphan_pin_bytes": scan["arena_orphan_pin_bytes"],
+                "orphan_pin_pids": ",".join(
+                    str(p) for p in scan["orphan_pin_pids"]),
+                "creating_dead_creator":
+                    scan["creating_dead_creator"]})
+            logger.warning(
+                "leak sentinel: %d orphan pin(s) (%d B) from dead "
+                "pid(s) %s, %d dead-creator creating block(s) on %s",
+                scan["arena_orphan_pins"],
+                scan["arena_orphan_pin_bytes"],
+                scan["orphan_pin_pids"],
+                scan["creating_dead_creator"], self.node_id[:12])
+        scan["totals"] = dict(self._leak_totals)
+        self._leak_last = scan
+        return scan
+
+    async def rpc_memory(self, h: dict, _b: list) -> dict:
+        """Object-ledger harvest verb: THIS agent's ledger reply plus
+        the node store's pin/spill attribution and the leak sentinel's
+        latest scan; with broadcast=True, fan out to every live worker
+        it supervises (the spans/failpoints-verb shape — dead/wedged
+        workers cost one bounded timeout each, concurrently, never a
+        hang).  op "leak_scan" runs a sentinel pass right now (chaos
+        tests drive the scan deterministically instead of waiting out
+        the reaper cadence)."""
+        if h.get("op") == "leak_scan":
+            return {"node_id": self.node_id, **self._leak_scan()}
+        local = memledger.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        local["node_id"] = self.node_id
+        if h.get("op", "collect") == "collect" and self.store is not None:
+            local["store"] = self.store.memory_report(
+                limit=int(h.get("limit") or 5000))
+            local["sentinel"] = dict(self._leak_last or {})
+        # Failpoint window: local scan complete, reply/fan-out not yet
+        # sent — a crashed or wedged agent here must degrade the
+        # cluster harvest to partial-with-diagnostic, never a hang.
+        if failpoints.ACTIVE:
+            await failpoints.fire_async("memory.harvest")
+        if h.get("broadcast"):
+            sub = {k: v for k, v in h.items() if k != "broadcast"}
+            live = [w for w in list(self.workers.values())
+                    if w.addr and w.state not in ("dead", "stopping")]
+
+            async def _one(w):
+                try:
+                    reply, _ = await self.clients.get(w.addr).call(
+                        "memory", sub, timeout=10.0)
                     return w.worker_id, reply
                 except Exception as e:  # noqa: BLE001 - worker churning
                     return w.worker_id, {"error": repr(e)}

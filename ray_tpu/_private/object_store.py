@@ -173,6 +173,21 @@ class _DictBackend:
     def pin(self, oid: bytes, delta: int) -> None:
         self._pinned[oid] = max(0, self._pinned.get(oid, 0) + delta)
 
+    def scan_objects(self) -> list[dict]:
+        """Ledger view (native scan_objects shape).  The dict backend
+        has no creating state or pid attribution — every entry reads as
+        sealed, created by this process."""
+        return [{"object_id": oid,
+                 "size": sum(len(f) for f in frames),
+                 "lru_tick": self._lru.get(oid, 0.0),
+                 "sealed": True,
+                 "pins": self._pinned.get(oid, 0),
+                 "creator_pid": os.getpid()}
+                for oid, frames in self._data.items()]
+
+    def scan_pins(self) -> list[tuple[bytes, int]]:
+        return []      # no pid-attributed pins without the native arena
+
     def oldest(self) -> bytes | None:
         """LRU unpinned object id — the next spill candidate
         (ray: plasma LRU eviction_policy.h:105)."""
@@ -188,6 +203,13 @@ class _DictBackend:
 
     def close(self) -> None:
         self._data.clear()
+
+
+def _spill_size(path: str) -> int:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
 
 
 def _make_backend(node_id: str, capacity: int, config=None):
@@ -722,6 +744,69 @@ class StoreRunner:
             if reply.get("found"):
                 return await self.put_with_spill(oid, blobs)
         return False
+
+    def memory_report(self, limit: int = 5000) -> dict:
+        """Node-store half of the `memory` verb: every arena entry with
+        size/pins/creator-pid attribution (native scan; the dict backend
+        degrades to sizes only), plus spill state.  Bounded like the
+        ledger reply — biggest rows survive, the drop count is
+        reported."""
+        entries = []
+        scan = getattr(self.backend, "scan_objects", None)
+        if scan is not None:
+            try:
+                entries = scan()
+            except Exception:  # noqa: BLE001 - racing close
+                entries = []
+        # Prefault claims (native_store rt_store_prefault_free: the
+        # 0xFE+"prefault" id namespace) are transient runtime-internal
+        # allocations, not objects — a scan racing a worker's arena
+        # warm-up must not report a phantom 128 MiB unowned block.
+        entries = [e for e in entries
+                   if not e["object_id"].startswith(b"\xfeprefault")]
+        truncated = 0
+        if len(entries) > limit:
+            entries.sort(key=lambda e: -e["size"])
+            truncated = len(entries) - limit
+            entries = entries[:limit]
+        pin_scan = getattr(self.backend, "scan_pins", None)
+        pins: list = []
+        if pin_scan is not None:
+            try:
+                pins = pin_scan()
+            except Exception:  # noqa: BLE001
+                pins = []
+        pin_pids: dict[str, list[int]] = {}
+        for oid, pid in pins:
+            pin_pids.setdefault(oid.hex(), []).append(pid)
+        # Creator liveness is LOCAL-host truth (creators map this
+        # host's arena), answered here so the harvest side can gate the
+        # unreachable-owner gauge on it without remote pid access.
+        from ray_tpu._private.memledger import _pid_alive
+
+        alive: dict[int, bool] = {}
+        for e in entries:
+            pid = e["creator_pid"]
+            if pid not in alive:
+                alive[pid] = _pid_alive(pid)
+        return {
+            "stats": self.backend.stats(),
+            "shm_name": getattr(self.backend, "shm_name", None),
+            "objects": [{"object_id": e["object_id"].hex(),
+                         "size": e["size"], "sealed": e["sealed"],
+                         "pins": e["pins"],
+                         "pin_pids": pin_pids.get(e["object_id"].hex(),
+                                                  []),
+                         "creator_pid": e["creator_pid"],
+                         "creator_alive": alive[e["creator_pid"]]}
+                        for e in entries],
+            "truncated": truncated,
+            "spilled": [{"object_id": oid.hex(), "path": path,
+                         "size": _spill_size(path)}
+                        for oid, path in list(self.spilled.items())],
+            "spilled_bytes": self.spilled_bytes,
+            "pending_deletes": len(self._pending_deletes),
+        }
 
     async def rpc_store_stats(self, h: dict, _b: list) -> dict:
         out = {**self.backend.stats(),

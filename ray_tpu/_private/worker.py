@@ -42,6 +42,7 @@ from typing import Any
 
 
 from ray_tpu._private import failpoints
+from ray_tpu._private import memledger
 from ray_tpu._private import spans
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
@@ -681,6 +682,13 @@ class CoreWorker:
                 rec.local_refs += 1
                 rec.submit_spec = (fid, header, blobs, scheduling_key)
                 rec.retries_left = max(0, retries)
+        if memledger.ENABLED:
+            # The submitted function IS the callsite that groups task
+            # returns in `ray memory` (ray: "(task call) fn" rows).
+            site = "(task) " + getattr(fn, "__qualname__",
+                                       getattr(fn, "__name__", fid[:12]))
+            for rid in return_ids:
+                memledger.note_create(rid, "task_return", site)
 
         def _go():
             self.memory_entries_for(return_ids)
@@ -785,6 +793,8 @@ class CoreWorker:
         index = h["index"]
         tid = TaskID(task_id)
         iid = ObjectID.for_return(tid, index + 1).binary()
+        if memledger.ENABLED:
+            memledger.note_create(iid, "task_return", "(stream item)")
         with self._ref_lock:
             irec = self.owned.setdefault(iid, OwnedObject())
             prev_pins, irec.contained = irec.contained, [
@@ -1211,6 +1221,9 @@ class CoreWorker:
             for j, im in enumerate(meta["dynamic"]):
                 iid = ObjectID.for_return(tid, j + 1).binary()
                 irec = self.owned.setdefault(iid, OwnedObject())
+                if memledger.ENABLED:
+                    memledger.note_create(iid, "task_return",
+                                          "(generator item)")
                 # Pins for refs nested in the item value (re-execution
                 # releases the previous round's, as in the fixed path).
                 prev_item_pins.extend(irec.contained)
@@ -1404,6 +1417,8 @@ class CoreWorker:
                 self._add_borrow(c_oid, owner)
         if trace is not None:
             trace["owner_reg_done"] = time.monotonic()
+        if memledger.ENABLED:
+            memledger.note_put(oid)
         put_path = "inline"
         if sv.total_bytes <= self.config.max_inline_object_size:
             if trace is not None:
@@ -1991,6 +2006,9 @@ class CoreWorker:
                 rec.local_refs += 1
 
     def _free_object(self, object_id: bytes, rec: OwnedObject) -> None:
+        # Inline pop (== memledger.note_free): this runs once per freed
+        # object on the release hot path.
+        memledger._meta.pop(object_id, None)
         with self._ref_lock:
             self.owned.pop(object_id, None)
             contained, rec.contained = rec.contained, []
@@ -3193,6 +3211,10 @@ class CoreWorker:
             for rid in return_ids:
                 rec = self.owned.setdefault(rid, OwnedObject())
                 rec.local_refs += 1
+        if memledger.ENABLED:
+            site = "(actor) " + method
+            for rid in return_ids:
+                memledger.note_create(rid, "task_return", site)
         refs = [ObjectRef(rid, self.address) for rid in return_ids]
         max_task_retries = options.get("max_task_retries", 0)
         st = self._actor_state(actor_id)
@@ -3818,6 +3840,11 @@ class CoreWorker:
         """Flight-recorder harvest verb (see _private/spans): read/clear
         THIS process's span ring buffer."""
         return spans.control(h)
+
+    async def rpc_memory(self, h: dict, _b: list) -> dict:
+        """Object-ledger harvest verb (see _private/memledger): THIS
+        process's owner-side reference table + ledger annotations."""
+        return memledger.control(h)
 
     # ------------------------------------------------------------ telemetry
     def _record_event(self, task_id: str, state: str, name: str = "",

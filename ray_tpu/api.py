@@ -206,8 +206,16 @@ def shutdown() -> None:
     if client_mod._ctx is not None:
         client_mod._ctx.disconnect()
     if worker_mod._global_worker is not None:
+        core = worker_mod._global_worker
         try:
-            worker_mod._global_worker.shutdown()
+            # Mark this job done so cluster harvests (the memory verb's
+            # driver fan-out) stop probing a driver that exited cleanly.
+            core.call(core.controller_addr, "job_finished",
+                      {"job_id": core.job_id}, timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            core.shutdown()
         except Exception:  # noqa: BLE001
             pass
     for proc in _head_processes:

@@ -44,7 +44,7 @@ from typing import Any
 import numpy as np
 
 import ray_tpu
-from ray_tpu import failpoints, profiling, tracing
+from ray_tpu import failpoints, memledger, profiling, tracing
 from ray_tpu.collective import ring as _ring
 from ray_tpu.collective.ring import _env_float, _env_int
 
@@ -424,7 +424,9 @@ def _group(group_name: str) -> _GroupState:
 def _exchange(g: _GroupState, op: str, value, seq: int) -> dict:
     if failpoints.ACTIVE:
         failpoints.fire("collective.chunk_send")
-    ref = ray_tpu.put(value)
+    with memledger.tag("collective_chunk",
+                       label="collective/collective.py exchange"):
+        ref = ray_tpu.put(value)
     # Refs ride inside a list: a bare ObjectRef argument is resolved to its
     # value before dispatch (task dependency resolution), but the
     # rendezvous must pass the *ref* through untouched (same wrapping trick
@@ -735,7 +737,9 @@ def send(tensor, dst_rank: int, group_name: str = "default",
          tag: int = 0) -> None:
     """P2P send (ray: collective.send)."""
     g = _group(group_name)
-    ref = ray_tpu.put(np.asarray(tensor))
+    with memledger.tag("collective_chunk",
+                       label="collective/collective.py send"):
+        ref = ray_tpu.put(np.asarray(tensor))
     ray_tpu.get(g.rendezvous.put_p2p.remote(
         (g.rank, dst_rank, tag), [ref]), timeout=g.timeout_s + 30.0)
 

@@ -37,7 +37,7 @@ import time
 import numpy as np
 
 import ray_tpu
-from ray_tpu import failpoints
+from ray_tpu import failpoints, memledger
 
 # Binary reduce ops (the legacy gather path reduces a stacked axis; the
 # ring/tree paths fold pairwise).
@@ -122,7 +122,9 @@ def _deposit(g, key: tuple, payload_chunks: list[np.ndarray], *,
         failpoints.fire("collective.chunk_send")
     t0 = _now()
     if by_ref:
-        msg = [ray_tpu.put(c) for c in payload_chunks]
+        with memledger.tag("collective_chunk",
+                           label="collective/ring.py hop deposit"):
+            msg = [ray_tpu.put(c) for c in payload_chunks]
         # The sender's handles keep the chunks alive until the op's
         # completion ack proves the peer pulled them.
         holds.extend(msg)
@@ -195,7 +197,9 @@ def _put_chunks(g, payload_chunks: list[np.ndarray], rec: dict | None,
     """Put one hop's sub-chunks into the object plane; the handles stay
     in `holds` until the op's completion ack proves the peers pulled."""
     t0 = _now()
-    msg = [ray_tpu.put(c) for c in payload_chunks]
+    with memledger.tag("collective_chunk",
+                       label="collective/ring.py ring hop"):
+        msg = [ray_tpu.put(c) for c in payload_chunks]
     holds.extend(msg)
     _acc(rec, "send_us", t0)
     _count(rec, "sent_bytes", sum(c.nbytes for c in payload_chunks))

@@ -31,6 +31,13 @@ const state = (s) => `<span class="${esc(s)}">${esc(s)}</span>`;
 const short = (s) => `<span title="${esc(s)}">${esc(String(s).slice(0, 12))}</span>`;
 const fmtRes = (r) => esc(Object.entries(r || {})
   .map(([k, v]) => `${k}:${Math.round(v * 100) / 100}`).join(" "));
+const fmtBytes = (n) => {
+  if (n == null) return "?";
+  const units = ["B", "KiB", "MiB", "GiB"];
+  let i = 0;
+  while (n >= 1024 && i < units.length - 1) { n /= 1024; i++; }
+  return `${Math.round(n * 10) / 10}${units[i]}`;
+};
 
 const render = {
   async overview() {
@@ -97,8 +104,28 @@ const render = {
     return `<pre>${esc(JSON.stringify(s, null, 2))}</pre>`;
   },
   async objects() {
-    const o = await getJSON("/api/v0/objects");
-    return `<pre>${esc(JSON.stringify(o, null, 2))}</pre>`;
+    const [sum, mem] = await Promise.all([
+      getJSON("/api/v0/objects"),
+      getJSON("/api/v0/memory?view=rows&limit=500")]);
+    const c = sum.result?.cluster || {};
+    const leaks = c.leaks || {};
+    const cards = [
+      ["objects", c.total_objects ?? "?"],
+      ["bytes", fmtBytes(c.total_bytes)],
+      ["orphan pin bytes", fmtBytes(leaks.arena_orphan_pin_bytes)],
+      ["unreachable owner bytes",
+       fmtBytes(leaks.objects_unreachable_owner_bytes)],
+    ].map(([k, v]) =>
+      `<div class="card"><div class="k">${esc(k)}</div><div class="v">${esc(v)}</div></div>`
+    ).join("");
+    const rows = (mem.result?.objects || []).map((r) => [
+      short(r.object_id), fmtBytes(r.size), esc(r.tier), esc(r.tag),
+      esc(r.callsite), esc(String(r.owner ?? "UNOWNED")),
+      esc(r.pins), `${esc(r.local_refs)}/${esc(r.borrowers)}`,
+      esc((r.store_nodes || (r.node ? [r.node] : [])).join(","))]);
+    return `<div class="cards">${cards}</div>` +
+      table(["object", "size", "tier", "tag", "callsite", "owner",
+             "pins", "refs/borrow", "nodes"], rows);
   },
   async metrics() {
     const r = await fetch("/metrics");

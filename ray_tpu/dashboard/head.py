@@ -14,7 +14,9 @@ Routes (reference parity):
   GET  /api/v0/tasks                  task events
   GET  /api/v0/tasks/summarize        counts by (function, state)
   GET  /api/v0/placement_groups       placement groups
-  GET  /api/v0/objects                owner-side object stats
+  GET  /api/v0/objects                cluster object ledger summary
+  GET  /api/v0/memory                 object table + leak sentinel
+                                      (?tag=, ?limit=, ?view=rows)
   GET  /api/jobs/                     job list            (ray jobs REST)
   POST /api/jobs/                     submit a job
   GET  /api/jobs/{id}                 job status
@@ -126,6 +128,7 @@ class DashboardHead:
         r.add_get("/api/v0/tasks/summarize", self._tasks_summarize)
         r.add_get("/api/v0/placement_groups", self._pgs)
         r.add_get("/api/v0/objects", self._objects)
+        r.add_get("/api/v0/memory", self._memory)
         r.add_get("/api/v0/timeline", self._timeline)
         r.add_get("/api/v0/traces", self._traces)
         r.add_get("/api/v0/worker_messages", self._worker_messages)
@@ -274,15 +277,61 @@ class DashboardHead:
         return _json({"result":
                       await self._call(state.list_placement_groups)})
 
-    async def _objects(self, _req):
-        def _stats():
-            from ray_tpu._private.worker import global_worker
+    def _harvest_cached(self):
+        """One memory-verb fan-out behind a short TTL feeds the objects
+        tab's rows, /api/v0/objects and every /metrics scrape — each
+        would otherwise fire its own full cluster broadcast
+        (controller→agents→workers→drivers, up to ~15s against a
+        wedged member)."""
+        import time as _time
 
-            core = global_worker()
-            return {"num_owned_objects": len(core.owned),
-                    "num_borrowed": len(core.borrows),
-                    "memory_store_entries": len(core.memory)}
-        return _json({"result": await self._call(_stats)})
+        from ray_tpu.utils import state
+
+        cached = getattr(self, "_harvest_cache", None)
+        now = _time.monotonic()
+        if cached is not None and now - cached[0] < 5.0:
+            return cached[1]
+        harvest = state._harvest_memory(5000, 30.0)
+        self._harvest_cache = (now, harvest)
+        return harvest
+
+    def _summarize_cached(self):
+        from ray_tpu.utils import state
+
+        return state._summarize_from(*self._harvest_cached())
+
+    async def _objects(self, _req):
+        """Cluster object ledger summary (was: this process's own
+        `core.owned` count — a dashboard watching only itself)."""
+        return _json({"result": await self._call(self._summarize_cached)})
+
+    async def _memory(self, req):
+        """Object ledger harvest (the `ray memory` table over HTTP).
+        ?view=rows returns the per-object table (?tag= filters,
+        ?limit= bounds per-process replies); the default is the
+        per-callsite grouped summary plus leak-sentinel gauges."""
+        view = req.query.get("view", "summary")
+        tag = req.query.get("tag") or None
+        try:
+            limit = int(req.query.get("limit", "5000"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"},
+                         status=400)
+
+        def _collect():
+            from ray_tpu.utils import state
+
+            if view == "rows":
+                # Same cached harvest as the summary endpoints: the
+                # objects tab fetches both in one render.
+                procs, agents, _d, _dd = self._harvest_cached()
+                rows, _diag = state._merge_object_rows(procs, agents)
+                rows.sort(key=lambda r: -r["size"])
+                filters = [("tag", "=", tag)] if tag else None
+                return {"objects":
+                        state._apply_filters(rows, filters)[:limit]}
+            return self._summarize_cached()
+        return _json({"result": await self._call(_collect)})
 
     async def _timeline(self, _req):
         import ray_tpu
@@ -402,6 +451,20 @@ class DashboardHead:
             nodes = await self._call(st.list_nodes)
             alive = len([n for n in nodes if n["state"] == "ALIVE"])
             lines.append(f"ray_tpu_cluster_alive_nodes {alive}")
+        except Exception:  # noqa: BLE001
+            pass
+        # Leak-sentinel gauges (memory ledger): the test-only
+        # "zero leaked pins" invariants as live alarms (TTL-cached —
+        # scrapes must not each pay a cluster fan-out).
+        try:
+            leaks = (await self._call(self._summarize_cached))[
+                "cluster"]["leaks"]
+            lines.append("ray_tpu_arena_orphan_pin_bytes "
+                         f"{leaks['arena_orphan_pin_bytes']}")
+            unreach = leaks.get("objects_unreachable_owner_bytes")
+            if unreach is not None:
+                lines.append("ray_tpu_objects_unreachable_owner_bytes "
+                             f"{unreach}")
         except Exception:  # noqa: BLE001
             pass
         return web.Response(text="\n".join(lines) + "\n",

@@ -1,0 +1,47 @@
+"""Public facade over the cluster object ledger.
+
+Library layers (data/train/tune/serve/rl/collective) must build only on
+core primitives and public surfaces, never on runtime internals — this
+module is the public surface for tagging object creations and attaching
+provider rows to the memory harvest (the ``ray_tpu.tracing`` shape; see
+``ray_tpu/_private/memledger.py`` for the ledger semantics and the
+``RAY_TPU_MEMORY_LEDGER`` kill switch).
+
+Tagging a library-layer object creation:
+
+    from ray_tpu import memledger
+
+    with memledger.tag("kv_export", label="serve/llm.py kv_export"):
+        ref = ray_tpu.put(kv)
+
+Attaching non-object memory (e.g. an engine's HBM KV pool) to the
+harvest:
+
+    memledger.register_provider("llm:" + name, lambda: [
+        {"object_id": f"kv:{name}", "size": used_bytes,
+         "tag": "hbm_kv", "tier": "hbm"}])
+
+Harvest surfaces live in ``ray_tpu.utils.state`` (``list_objects`` /
+``summarize_objects``), the ``ray-tpu memory`` CLI, and the dashboard's
+``/api/v0/memory``.
+"""
+from __future__ import annotations
+
+from ray_tpu._private import memledger as _impl
+
+tag = _impl.tag
+note_create = _impl.note_create
+register_provider = _impl.register_provider
+unregister_provider = _impl.unregister_provider
+set_enabled = _impl.set_enabled
+collect = _impl.collect
+control = _impl.control
+stats = _impl.stats
+sentinel_scan = _impl.sentinel_scan
+ENV_VAR = _impl.ENV_VAR
+
+
+def __getattr__(name):
+    # ENABLED is a mutable module flag — read it live off the
+    # implementation module; an import-time snapshot would never flip.
+    return getattr(_impl, name)

@@ -128,9 +128,64 @@ def cmd_status(args) -> None:
               f"resources={n['resources']} available={n['available']}")
 
 
+def _fmt_bytes(n: int | float | None) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B")
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
 def cmd_memory(args) -> None:
-    """ray: `ray memory` — per-node object store usage + spill state."""
+    """ray: `ray memory` — the per-callsite grouped object table over
+    the cluster ledger harvest (owner, tag, size, tier, pins,
+    borrowers, locations), followed by per-node store usage and the
+    leak sentinel's gauges."""
     rt = _attach(args)
+    from ray_tpu.utils import state
+
+    # ONE cluster fan-out feeds both the table and the leak footer
+    # (list_objects + summarize_objects would broadcast twice).
+    harvest = state._harvest_memory(5000, 30.0)
+    rows, _diag = state._merge_object_rows(harvest[0], harvest[1])
+    rows.sort(key=lambda r: -r["size"])
+    filters = []
+    if getattr(args, "tag", None):
+        filters.append(("tag", "=", args.tag))
+    rows = state._apply_filters(rows, filters)
+    if getattr(args, "json", False):
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    groups: dict[str, list] = {}
+    for r in rows:
+        groups.setdefault(r["callsite"], []).append(r)
+    print(f"Grouping by callsite; {len(rows)} object(s), "
+          f"{_fmt_bytes(sum(r['size'] for r in rows))} total\n")
+    hdr = (f"{'OBJECT ID':<16} {'SIZE':>10} {'TIER':<7} {'PINS':>4} "
+           f"{'REFS':>5} {'BORROW':>6} {'AGE_S':>7} {'TAG':<16} "
+           f"{'OWNER':<22} NODES")
+    for site, grp in sorted(groups.items(),
+                            key=lambda kv: -sum(r["size"]
+                                                for r in kv[1])):
+        total = sum(r["size"] for r in grp)
+        print(f"--- {site}  ({len(grp)} object(s), "
+              f"{_fmt_bytes(total)})")
+        print(f"    {hdr}")
+        for r in sorted(grp, key=lambda r: -r["size"]):
+            nodes = ",".join(r.get("store_nodes") or
+                             ([r["node"]] if r["node"] else []))
+            pin_pids = ",".join(
+                str(p) for h in r["pin_holders"] for p in h["pids"])
+            print(f"    {r['object_id'][:16]:<16} "
+                  f"{_fmt_bytes(r['size']):>10} {r['tier']:<7} "
+                  f"{r['pins']:>4} {r['local_refs']:>5} "
+                  f"{r['borrowers']:>6} "
+                  f"{(r['age_s'] if r['age_s'] is not None else '?'):>7} "
+                  f"{r['tag']:<16} {str(r['owner']):<22} {nodes}"
+                  + (f"  pin_pids={pin_pids}" if pin_pids else ""))
+        print()
     from ray_tpu._private.worker import global_worker
 
     core = global_worker()
@@ -151,17 +206,23 @@ def cmd_memory(args) -> None:
               f"({stats.get('spilled_bytes', 0) / 1e6:.1f}MB on disk)")
         total_used += used
         total_objs += stats.get("num_objects", 0)
-    print(f"cluster: {total_used / 1e6:.1f}MB in {total_objs} object(s)")
+    print(f"cluster: {total_used / 1e6:.1f}MB in {total_objs} object(s) "
+          "in node stores")
+    leaks = state._summarize_from(*harvest)["cluster"]["leaks"]
+    print(f"leak sentinel: orphan_pin_bytes="
+          f"{_fmt_bytes(leaks['arena_orphan_pin_bytes'])} "
+          f"unreachable_owner_bytes="
+          f"{_fmt_bytes(leaks.get('objects_unreachable_owner_bytes'))}")
 
 
 def cmd_list(args) -> None:
-    """ray: `ray list actors|nodes|tasks|placement-groups|jobs`."""
+    """ray: `ray list actors|nodes|tasks|objects|placement-groups|jobs`."""
     _attach(args)
     from ray_tpu.utils import state
 
     kind = args.kind.replace("-", "_")
     fn = {"actors": state.list_actors, "nodes": state.list_nodes,
-          "tasks": state.list_tasks,
+          "tasks": state.list_tasks, "objects": state.list_objects,
           "placement_groups": state.list_placement_groups,
           "jobs": state.list_jobs}.get(kind)
     if fn is None:
@@ -175,7 +236,8 @@ def cmd_summary(args) -> None:
     from ray_tpu.utils import state
 
     fn = {"tasks": state.summarize_tasks,
-          "actors": state.summarize_actors}.get(args.kind)
+          "actors": state.summarize_actors,
+          "objects": state.summarize_objects}.get(args.kind)
     if fn is None:
         sys.exit(f"unknown kind {args.kind!r}")
     print(json.dumps(fn(), indent=2))
@@ -410,10 +472,18 @@ def main(argv: list[str] | None = None) -> None:
     sp = sub.add_parser("stop", help="stop local head processes")
     sp.set_defaults(fn=cmd_stop)
 
-    for name, fn in [("status", cmd_status), ("memory", cmd_memory)]:
-        sp = sub.add_parser(name)
-        sp.add_argument("--address")
-        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("status")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "memory", help="cluster object table grouped by callsite")
+    sp.add_argument("--address")
+    sp.add_argument("--tag", help="filter rows by semantic tag "
+                                  "(put/task_return/kv_export/...)")
+    sp.add_argument("--json", action="store_true",
+                    help="raw row list instead of the grouped table")
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("list")
     sp.add_argument("kind")

@@ -377,6 +377,7 @@ class ServeController:
         # off or an app is deleted — otherwise the autoscaler would
         # hold nodes for replicas that no longer exist.
         self._post_autoscaler_demand()
+        self._memory_observe()
         with self._lock:
             for app_name, app in list(self._apps.items()):
                 for name, st in list(app["deployments"].items()):
@@ -384,6 +385,53 @@ class ServeController:
                         del app["deployments"][name]
                 if not app["deployments"]:
                     del self._apps[app_name]
+
+    # ---------------------------------------------- memory observability
+    def _memory_observe(self) -> None:
+        """Memory-ledger leg of the reconcile loop (throttled): publish
+        the per-deployment tier-2 prefix bytes gauge, and flag directory
+        entries whose publishing replica this controller no longer
+        knows — their arena objects died with the publisher, so every
+        lookup against them can only fail (the sentinel alarm; the
+        lazy dead-publisher scrub on the fetch path still does the
+        cleanup)."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_mem_observe", 0.0) < 5.0:
+            return
+        self._last_mem_observe = now
+        try:
+            from ray_tpu.utils import metrics as um
+
+            g = um.get_or_create(
+                um.Gauge, "serve_prefix_tier2_bytes",
+                "Tier-2 prefix-store bytes per deployment",
+                tag_keys=("app", "deployment"))
+            per = self._prefix_store.bytes_by_deployment()
+            # Zero removed series explicitly — gauges have no TTL, and
+            # a deleted app must not read as still holding bytes.
+            for app, dep in getattr(self, "_tier2_keys", set()) - \
+                    set(per):
+                g.set(0.0, tags={"app": app, "deployment": dep})
+            for (app, dep), b in per.items():
+                g.set(float(b), tags={"app": app, "deployment": dep})
+            self._tier2_keys = set(per)
+        except Exception:  # noqa: BLE001 - metrics must never stall
+            pass           # the reconciler
+        with self._lock:
+            live = {rid for app in self._apps.values()
+                    for st in app["deployments"].values()
+                    for rid in (*st.replicas, *st.draining)}
+        orphan = self._prefix_store.replicas() - live
+        warned = getattr(self, "_tier2_orphan_warned", set())
+        for rid in orphan - warned:
+            t = time.time()
+            tracing.emit("memory.leak", t, t, attrs={
+                "kind": "tier2_orphan_publisher", "replica": rid})
+            logger.warning(
+                "leak sentinel: tier-2 prefix entries from unknown "
+                "replica %s (publisher gone — entries are "
+                "unreachable)", rid)
+        self._tier2_orphan_warned = warned | orphan
 
     # --------------------------------------------------------- proxies
     def _reconcile_proxies(self) -> None:

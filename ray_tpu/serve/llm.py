@@ -49,6 +49,7 @@ from typing import Any
 
 import numpy as np
 
+from ray_tpu import memledger
 from ray_tpu import tracing
 from ray_tpu.serve import slo
 from ray_tpu.serve.kv_blocks import BlockManager
@@ -1011,8 +1012,44 @@ class LLMEngine:
             self._thread = threading.Thread(
                 target=self._loop, name="llm-engine", daemon=True)
             self._thread.start()
+            self._register_memledger_provider()
+
+    def _register_memledger_provider(self) -> None:
+        """Attach this engine's resident HBM KV pool to the cluster
+        memory harvest (tier "hbm" rows next to the arena tiers): used
+        bytes = non-free pool blocks x bytes per page, from the same
+        BlockManager accounting the radix cache runs on."""
+        if self._mgr is None:
+            return
+        import jax
+
+        try:
+            pool_bytes = int(sum(
+                x.size * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(self.cache)))
+        except Exception:  # noqa: BLE001 - exotic cache leaves
+            pool_bytes = 0
+        per_page = pool_bytes // max(1, self.n_pages)
+        self._memledger_provider = f"llm:{self.name}:{id(self):x}"
+
+        def _rows():
+            st = self._mgr.stats()
+            used = st["n_blocks"] - st["free"]
+            return [{"object_id": f"kvpool:{self.name}",
+                     "size": used * per_page, "tag": "hbm_kv",
+                     "tier": "hbm",
+                     "callsite": f"serve/llm.py engine {self.name}",
+                     "pool_bytes": pool_bytes,
+                     "blocks_used": used,
+                     "blocks_total": st["n_blocks"],
+                     "blocks_cached": st["cached"]}]
+
+        memledger.register_provider(self._memledger_provider, _rows)
 
     def stop(self) -> None:
+        if getattr(self, "_memledger_provider", None):
+            memledger.unregister_provider(self._memledger_provider)
+            self._memledger_provider = None
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
@@ -2313,7 +2350,9 @@ class LLMServer:
         def _put():
             t0 = time.perf_counter()
             with tracing.span("serve.kv_put", ctx=trace_ctx,
-                              attrs={"bytes": exp["kv"].nbytes}):
+                              attrs={"bytes": exp["kv"].nbytes}), \
+                    memledger.tag("kv_export",
+                                  label="serve/llm.py kv_export"):
                 r = ray_tpu.put(exp["kv"])
             return r, (time.perf_counter() - t0) * 1000.0
 

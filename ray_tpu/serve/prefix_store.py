@@ -358,6 +358,26 @@ class StoreDirectory:
                         pages.setdefault(e["page"], []).append(h)
             return {"pages": pages, "entries": n}
 
+    def bytes_by_deployment(self) -> dict[tuple[str, str], int]:
+        """(app, deployment) -> tier-2 bytes — the per-deployment gauge
+        the serve controller publishes (memory-ledger observability)."""
+        out: dict[tuple[str, str], int] = {}
+        with self._lock:
+            for app, a in self._apps.items():
+                for e in a["entries"].values():
+                    key = (app, e.get("deployment") or "?")
+                    out[key] = out.get(key, 0) + e["nbytes"]
+        return out
+
+    def replicas(self) -> set[str]:
+        """Every replica id with at least one live entry (the serve
+        controller's tier-2 orphan check compares these against its
+        live replica set)."""
+        with self._lock:
+            return {e["replica"] for a in self._apps.values()
+                    for e in a["entries"].values()
+                    if e.get("replica")}
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -485,8 +505,13 @@ class PrefixStoreClient:
             ref, nbytes = cur[0], cur[2]
         elif _object_plane_ready():
             import ray_tpu
+            from ray_tpu import memledger
 
-            ref = ray_tpu.put(kv)
+            with memledger.tag(
+                    "prefix_tier2",
+                    label=f"serve/prefix_store.py tier2 "
+                          f"{self._deployment}"):
+                ref = ray_tpu.put(kv)
             nbytes = int(kv.nbytes)
         elif self._directory is not None:
             # In-process directory with no object plane (unit tests):

@@ -84,19 +84,299 @@ def summarize_actors() -> dict:
 
 def list_metrics() -> list[dict]:
     """Aggregated application metrics from every worker's last flush
-    (ray: per-node Prometheus endpoints; see ray_tpu.utils.metrics)."""
+    (ray: per-node Prometheus endpoints; see ray_tpu.utils.metrics).
+    One kv_multiget round trip regardless of worker count (the old
+    per-key kv_get loop paid one RT per worker)."""
     core = _core()
-    reply, _ = core.call(core.controller_addr, "kv_keys",
-                         {"ns": "metrics"}, timeout=30.0)
+    reply, blobs = core.call(core.controller_addr, "kv_multiget",
+                             {"ns": "metrics", "prefix": ""},
+                             timeout=30.0)
     out = []
-    for key in reply.get("keys", []):
-        r, blobs = core.call(core.controller_addr, "kv_get",
-                             {"ns": "metrics", "key": key}, timeout=30.0)
-        if blobs:
-            snap = json.loads(bytes(blobs[0]))
-            snap["worker_id"] = key
-            out.append(snap)
+    for key, blob in zip(reply.get("keys", []), blobs):
+        snap = json.loads(bytes(blob))
+        snap["worker_id"] = key
+        out.append(snap)
     return out
+
+
+# ----------------------------------------------------- object ledger
+def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    for f in filters or ():
+        key, op, val = f
+        if op == "=":
+            rows = [r for r in rows if r.get(key) == val]
+        elif op == "!=":
+            rows = [r for r in rows if r.get(key) != val]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def _harvest_memory(limit: int,
+                    timeout: float) -> tuple[list, list, list, list]:
+    """Collect every process's `memory`-verb reply — this process's
+    directly, the cluster's through the controller broadcast (the
+    spans-harvest fan-out shape; the controller adds a fan-out leg to
+    every RUNNING job driver — drivers own objects but no agent
+    supervises them).  Returns (worker-ish replies, agent replies as
+    (node_id, reply), diagnostics, driver diagnostics) deduped by boot
+    token.  Agent/worker diagnostics make the harvest PARTIAL (claim
+    sets are missing); driver diagnostics are reported separately — a
+    dead driver's absence is itself a finding, not a hole."""
+    from ray_tpu import memledger
+
+    procs: list[dict] = []
+    agents: list[tuple[str, dict]] = []
+    diags: list[str] = []
+    driver_diags: list[str] = []
+    seen: set = set()
+
+    def _take(reply) -> bool:
+        if not isinstance(reply, dict) or "objects" not in reply:
+            return False
+        key = reply.get("boot") or reply.get("pid")
+        if key in seen:
+            return False
+        seen.add(key)
+        procs.append(reply)
+        return True
+
+    _take(memledger.collect(limit=limit))
+    try:
+        core = _core()
+        reply, _ = core.call(core.controller_addr, "memory",
+                             {"op": "collect", "broadcast": True,
+                              "limit": limit}, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 - no cluster: local only
+        diags.append(f"controller: {e!r}")
+        reply = {}
+    _take(reply)
+    for node_id, nrep in (reply.get("nodes") or {}).items():
+        if not isinstance(nrep, dict) or "objects" not in nrep:
+            # A crashed/wedged agent (the memory.harvest failpoint
+            # shape): the merged table stays partial WITH a diagnostic,
+            # never a silent hole.
+            err = nrep.get("error") if isinstance(nrep, dict) else nrep
+            diags.append(f"node {node_id[:12]}: {err}")
+            continue
+        if _take(nrep):
+            agents.append((node_id, nrep))
+        for wid, wrep in (nrep.get("workers") or {}).items():
+            if not isinstance(wrep, dict) or "objects" not in wrep:
+                err = (wrep.get("error")
+                       if isinstance(wrep, dict) else wrep)
+                diags.append(f"worker {wid[:12]}: {err}")
+                continue
+            _take(wrep)
+    for jid, drep in (reply.get("drivers") or {}).items():
+        if not isinstance(drep, dict) or "objects" not in drep:
+            err = drep.get("error") if isinstance(drep, dict) else drep
+            if isinstance(drep, dict) and drep.get("gone"):
+                # Confirmed-gone driver: its absence is a finding, not
+                # a hole — the gauge stays computable.
+                driver_diags.append(f"driver {jid[:12]}: {err}")
+            else:
+                # ALIVE driver that failed to answer (ping succeeded):
+                # its claim set is missing, so the harvest is partial
+                # exactly like a failed worker leg.
+                diags.append(f"driver {jid[:12]}: {err}")
+            continue
+        _take(drep)
+    return procs, agents, diags, driver_diags
+
+
+def _merge_object_rows(procs: list, agents: list) -> tuple[list, dict]:
+    """Join owner tables, borrower tables, arena pin attribution and
+    spill state into one row per object (the `ray memory` table)."""
+    rows: dict[str, dict] = {}
+    truncated = 0
+    for rep in procs:
+        owner = rep.get("proc", "?")
+        truncated += rep.get("truncated", 0)
+        for o in rep.get("objects", ()):
+            rows[o["object_id"]] = {
+                "object_id": o["object_id"],
+                "owner": owner, "owner_pid": rep.get("pid"),
+                "owner_addr": rep.get("addr", ""),
+                "node": (rep.get("node") or "")[:12],
+                "size": o["size"], "state": o["state"],
+                "tag": o["tag"], "callsite": o["callsite"],
+                "age_s": o["age_s"],
+                "local_refs": o["local_refs"],
+                "borrowers": o["borrowers"],
+                "contained": o["contained"],
+                "locations": list(o.get("locations", ())),
+                "tier": ("inline" if o["state"] == "inline"
+                         else "arena" if o["state"] == "stored"
+                         else o["state"]),
+                "pins": 0, "pin_holders": [],
+                "borrower_procs": [],
+            }
+    for node_id, rep in agents:
+        store = rep.get("store") or {}
+        truncated += store.get("truncated", 0)
+        for e in store.get("objects", ()):
+            row = rows.get(e["object_id"])
+            if row is None:
+                if not e["sealed"]:
+                    # Creating-state block claimed by no owner: an
+                    # in-flight pull/put assembly, not an object — the
+                    # sentinel's dead-creator leg covers the crashed
+                    # kind.
+                    continue
+                # Sealed in the arena but claimed by no harvested
+                # owner: the unreachable-owner candidate the summarize
+                # leg counts (gated there on creator liveness).
+                row = rows[e["object_id"]] = {
+                    "object_id": e["object_id"], "owner": None,
+                    "owner_pid": None, "owner_addr": "", "node": "",
+                    "size": e["size"], "state": "stored",
+                    "tag": "unowned", "callsite": "?", "age_s": None,
+                    "local_refs": 0, "borrowers": 0, "contained": 0,
+                    "locations": [], "tier": "arena", "pins": 0,
+                    "pin_holders": [], "borrower_procs": [],
+                }
+            row["tier"] = "arena"
+            row["pins"] += e["pins"]
+            if e["pins"] or e["pin_pids"]:
+                row["pin_holders"].append(
+                    {"node": node_id[:12], "pins": e["pins"],
+                     "pids": e["pin_pids"]})
+            row.setdefault("store_nodes", []).append(node_id[:12])
+            row.setdefault("creator_pid", e["creator_pid"])
+            # Any-host liveness suffices: replicas make creator pids
+            # per-location, and one live creator means in-flight, not
+            # leaked.
+            row["creator_alive"] = (row.get("creator_alive", False)
+                                    or e.get("creator_alive", False))
+        for s in store.get("spilled", ()):
+            row = rows.get(s["object_id"])
+            if row is None:
+                row = rows[s["object_id"]] = {
+                    "object_id": s["object_id"], "owner": None,
+                    "owner_pid": None, "owner_addr": "", "node": "",
+                    "size": s.get("size", 0), "state": "stored",
+                    "tag": "unowned",
+                    "callsite": "?", "age_s": None, "local_refs": 0,
+                    "borrowers": 0, "contained": 0, "locations": [],
+                    "tier": "spill", "pins": 0, "pin_holders": [],
+                    "borrower_procs": [],
+                }
+            row["tier"] = "spill"
+            row.setdefault("store_nodes", []).append(node_id[:12])
+    # Borrower attribution: which processes hold borrowed refs to each
+    # object (the reference's borrower column).
+    for rep in procs:
+        for b in rep.get("borrows", ()):
+            row = rows.get(b["object_id"])
+            if row is not None:
+                row["borrower_procs"].append(
+                    {"proc": rep.get("proc", "?"),
+                     "count": b["count"]})
+    # Provider rows (HBM KV pools etc.) are their own entries.
+    for rep in procs:
+        for p in rep.get("provider_rows", ()):
+            rows[f"{p.get('provider', '?')}:{p.get('object_id', '?')}"] = {
+                "object_id": p.get("object_id", "?"),
+                "owner": rep.get("proc", "?"),
+                "owner_pid": rep.get("pid"), "owner_addr": "",
+                "node": (rep.get("node") or "")[:12],
+                "size": p.get("size", 0), "state": "resident",
+                "tag": p.get("tag", "provider"),
+                "callsite": p.get("callsite", p.get("provider", "?")),
+                "age_s": None, "local_refs": 0, "borrowers": 0,
+                "contained": 0, "locations": [],
+                "tier": p.get("tier", "hbm"), "pins": 0,
+                "pin_holders": [], "borrower_procs": [],
+            }
+    diag = {"truncated_rows": truncated}
+    return list(rows.values()), diag
+
+
+def list_objects(filters: list[tuple] | None = None,
+                 limit: int = 5000, timeout: float = 30.0) -> list[dict]:
+    """Cluster object table with ownership/pin attribution (ray:
+    util/state/api.py list_objects + `ray memory` rows): one row per
+    object — owner process, size, semantic tag, creation callsite,
+    age, tier (inline / arena / spill / hbm), every store location,
+    every pin holder (node + pid), every borrower.  Filters like
+    [("tag", "=", "kv_export")] — `=`/`!=` over row keys.  `limit`
+    bounds BOTH each per-process reply and the merged result (biggest
+    rows survive, matching the per-reply truncation)."""
+    procs, agents, _diags, _ddiags = _harvest_memory(limit, timeout)
+    rows, _diag = _merge_object_rows(procs, agents)
+    rows.sort(key=lambda r: -r["size"])
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_objects(limit: int = 5000, timeout: float = 30.0) -> dict:
+    """Per-callsite grouped object summary (ray: `ray memory`'s
+    --group-by=STACK_TRACE table / summarize_objects), plus the leak
+    sentinel's cluster gauges: orphan pin bytes from every node's last
+    scan and the unreachable-owner bytes computed by cross-referencing
+    arena objects against every harvested owner table."""
+    return _summarize_from(*_harvest_memory(limit, timeout))
+
+
+def _summarize_from(procs: list, agents: list, diags: list,
+                    driver_diags: list) -> dict:
+    """summarize_objects over an already-collected harvest — one
+    fan-out can feed both the row table and the summary (the CLI and
+    dashboard would otherwise pay the cluster broadcast twice)."""
+    rows, diag = _merge_object_rows(procs, agents)
+    groups: dict[str, dict] = {}
+    by_tag: dict[str, dict] = {}
+    by_node: dict[str, dict] = {}
+    total_bytes = 0
+    for r in rows:
+        total_bytes += r["size"]
+        g = groups.setdefault(r["callsite"], {"count": 0, "bytes": 0,
+                                              "tags": {}})
+        g["count"] += 1
+        g["bytes"] += r["size"]
+        g["tags"][r["tag"]] = g["tags"].get(r["tag"], 0) + 1
+        t = by_tag.setdefault(r["tag"], {"count": 0, "bytes": 0})
+        t["count"] += 1
+        t["bytes"] += r["size"]
+        for node in r.get("store_nodes") or ([r["node"]]
+                                             if r["node"] else []):
+            n = by_node.setdefault(node, {"count": 0, "bytes": 0})
+            n["count"] += 1
+            n["bytes"] += r["size"]
+    leaks: dict = {"arena_orphan_pin_bytes": 0, "arena_orphan_pins": 0,
+                   "creating_dead_creator_bytes": 0}
+    for _node_id, rep in agents:
+        s = rep.get("sentinel") or {}
+        leaks["arena_orphan_pin_bytes"] += s.get(
+            "arena_orphan_pin_bytes", 0)
+        leaks["arena_orphan_pins"] += s.get("arena_orphan_pins", 0)
+        leaks["creating_dead_creator_bytes"] += s.get(
+            "creating_dead_creator_bytes", 0)
+    if diags or diag["truncated_rows"]:
+        # A partial or truncated harvest cannot prove an owner absent:
+        # report the gap instead of a false leak number.  (Driver
+        # diagnostics don't nullify — a GONE driver's absence is the
+        # finding; its sealed objects fail the creator-liveness gate
+        # below and count.)
+        leaks["objects_unreachable_owner_bytes"] = None
+        leaks["unreachable_owner_objects"] = None
+    else:
+        # Sealed, claimed by NO harvested owner, and its creator pid is
+        # dead on every host that holds it: the creator gate keeps a
+        # concurrent in-flight put (sealed between a remote owner's
+        # reply and this agent's scan) from reading as a leak.
+        unreach = [r for r in rows
+                   if r["owner"] is None
+                   and not r.get("creator_alive", False)]
+        leaks["objects_unreachable_owner_bytes"] = sum(
+            r["size"] for r in unreach)
+        leaks["unreachable_owner_objects"] = len(unreach)
+    return {"cluster": {
+        "summary": groups, "by_tag": by_tag, "by_node": by_node,
+        "total_objects": len(rows), "total_bytes": total_bytes,
+        "leaks": leaks,
+        "partial": diags, "driver_diags": driver_diags, **diag,
+    }}
 
 
 def get_actor(actor_id: str) -> dict | None:

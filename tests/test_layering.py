@@ -254,7 +254,7 @@ PREFIX_STORE_MODULES = ("serve/prefix_store.py",)
 PREFIX_STORE_ALLOWED_PREFIXES = (
     "ray_tpu.serve", "ray_tpu.exceptions", "ray_tpu.failpoints",
     "ray_tpu.tracing", "ray_tpu.object_ref", "ray_tpu.actor",
-    "ray_tpu.runtime_context",
+    "ray_tpu.runtime_context", "ray_tpu.memledger",
 )
 
 
@@ -287,3 +287,44 @@ def test_prefix_store_importable_standalone():
 
     assert importlib.import_module(
         "ray_tpu.serve.prefix_store") is not None
+
+
+# --------------------------------------- memory ledger (ISSUE 13)
+# Library code reaches the object ledger ONLY through the
+# ray_tpu.memledger facade (the tracing-facade shape); the
+# implementation module stays a runtime internal.
+LEDGER_TAGGED_LIBRARY_MODULES = (
+    "serve/llm.py", "serve/prefix_store.py",
+    "collective/collective.py", "collective/ring.py",
+)
+
+
+def test_memledger_facade_exists_and_layers_hold():
+    """The facade and its implementation exist, and the tagging
+    library modules import the ledger through the facade — never
+    ray_tpu._private.memledger (the generic _private ban in
+    _violations() enforces the negative; this pins the positive so a
+    refactor can't silently drop the tagging)."""
+    assert os.path.exists(os.path.join(PKG, "memledger.py"))
+    assert os.path.exists(os.path.join(PKG, "_private", "memledger.py"))
+    for rel in LEDGER_TAGGED_LIBRARY_MODULES:
+        path = os.path.join(PKG, rel)
+        mods = {m for m, _ in _imports_of(path)}
+        assert ("ray_tpu.memledger" in mods), (
+            f"{rel} lost its memory-ledger tagging "
+            f"(no ray_tpu.memledger import)")
+        assert not any(m.startswith("ray_tpu._private.memledger")
+                       for m in mods), rel
+
+
+def test_memledger_modules_are_walked_by_the_layering_scan():
+    for rel in LEDGER_TAGGED_LIBRARY_MODULES:
+        assert list(_imports_of(os.path.join(PKG, rel))), rel
+
+
+@pytest.mark.parametrize("mod", ["ray_tpu.memledger",
+                                 "ray_tpu._private.memledger"])
+def test_memledger_importable_standalone(mod):
+    import importlib
+
+    assert importlib.import_module(mod) is not None

@@ -1,0 +1,344 @@
+"""Cluster memory observability (ISSUE 13): object ledger, `memory`
+verb harvest, state API merge, leak sentinel.
+
+Runs its own 2-node Cluster (not ray_shared): harvest-merge assertions
+need a known topology, and the chaos case kills workers.
+"""
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def mem_cluster():
+    import json
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(config_json=json.dumps(
+        {"object_store_memory": 256 * 1024 * 1024}))
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 2, "second": 1})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes(2)
+    yield ray_tpu, cluster, n2
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _agent_addrs(ray_tpu):
+    return {n["node_id"]: n["agent_addr"] for n in ray_tpu.nodes()
+            if n["state"] == "ALIVE"}
+
+
+# ------------------------------------------------------- module basics
+def test_ledger_notes_tags_and_kill_switch():
+    """No cluster needed: note/free, tag context, callsite walk, and
+    the kill switch's zero-annotation off arm."""
+    from ray_tpu._private import memledger as ml
+
+    prev = ml.ENABLED
+    try:
+        ml.set_enabled(True)
+        oid = b"x" * 16
+        ml.note_create(oid)
+        tag, site, t = ml._meta[oid]
+        assert tag == "put"
+        # The walk must land OUTSIDE the runtime (this test file).
+        assert "test_memory_ledger" in ml._fmt_site(site), site
+        assert time.time() - t < 5.0
+        with ml.tag("kv_export", label="here"):
+            ml.note_create(b"y" * 16)
+        assert ml._meta[b"y" * 16][:2] == ("kv_export", "here")
+        ml.note_free(oid)
+        ml.note_free(b"y" * 16)
+        assert oid not in ml._meta
+        n0 = ml.stats()["tracked"]
+        ml.set_enabled(False)
+        ml.note_create(b"z" * 16)
+        assert ml.stats()["tracked"] == n0, "off arm must not annotate"
+    finally:
+        ml.set_enabled(prev)
+        ml.note_free(b"z" * 16)
+
+
+def test_control_verb_ops():
+    from ray_tpu._private import memledger as ml
+
+    rep = ml.control({"op": "stats"})
+    assert {"pid", "boot", "proc", "enabled", "tracked"} <= set(rep)
+    rep = ml.control({"op": "collect"})
+    assert "objects" in rep and "borrows" in rep
+    with pytest.raises(ValueError):
+        ml.control({"op": "nope"})
+
+
+def test_provider_rows_surface_in_collect():
+    from ray_tpu._private import memledger as ml
+
+    ml.register_provider("t:prov", lambda: [
+        {"object_id": "kvpool:test", "size": 123, "tag": "hbm_kv",
+         "tier": "hbm"}])
+    try:
+        rows = ml.collect()["provider_rows"]
+        assert any(r["object_id"] == "kvpool:test" and r["size"] == 123
+                   for r in rows)
+    finally:
+        ml.unregister_provider("t:prov")
+
+
+# ------------------------------------------------------ cluster harvest
+def test_harvest_merge_across_two_nodes(mem_cluster):
+    """The acceptance shape: a put on the driver, a tagged (kv-export
+    style) put, and a task return owned by a second-node worker all
+    show up in ONE merged table with owner/size/tag/location
+    attribution."""
+    import ray_tpu
+    from ray_tpu import memledger
+    from ray_tpu.utils import state
+
+    big = ray_tpu.put(np.zeros(2 * 1024 * 1024, np.uint8))
+    with memledger.tag("kv_export", label="test kv export"):
+        kv = ray_tpu.put(np.ones(512 * 1024, np.uint8))
+
+    @ray_tpu.remote(resources={"second": 0.1})
+    def remote_put():
+        # A worker-owned object on the SECOND node.
+        return np.full(256 * 1024, 7, np.uint8)
+
+    ref2 = remote_put.remote()
+    _ = ray_tpu.get(ref2)
+    rows = state.list_objects()
+    by_id = {r["object_id"]: r for r in rows}
+    b = by_id[big.hex()]
+    assert b["owner"] == "driver" and b["tag"] == "put"
+    assert b["tier"] == "arena" and b["size"] > 2 * 1024 * 1024 - 1
+    assert b["store_nodes"], "arena location attribution missing"
+    k = by_id[kv.hex()]
+    assert k["tag"] == "kv_export" and k["callsite"] == "test kv export"
+    r2 = by_id[ref2.hex()]
+    assert r2["tag"] == "task_return"
+    assert "remote_put" in r2["callsite"]
+    assert r2["owner"] == "driver"      # submitter owns the return
+    del big, kv, ref2
+
+
+def test_filters_and_summarize_grouping(mem_cluster):
+    import ray_tpu
+    from ray_tpu import memledger
+    from ray_tpu.utils import state
+
+    with memledger.tag("checkpoint", label="test ckpt site"):
+        refs = [ray_tpu.put(np.zeros(64 * 1024, np.uint8))
+                for _ in range(3)]
+    only = state.list_objects(filters=[("tag", "=", "checkpoint")])
+    assert len(only) == 3
+    assert all(r["callsite"] == "test ckpt site" for r in only)
+    none = state.list_objects(filters=[("tag", "=", "checkpoint"),
+                                       ("owner", "!=", "driver")])
+    assert none == []
+    with pytest.raises(ValueError):
+        state.list_objects(filters=[("tag", ">", "x")])
+    summary = state.summarize_objects()["cluster"]
+    grp = summary["summary"].get("test ckpt site")
+    assert grp and grp["count"] == 3 and grp["bytes"] >= 3 * 64 * 1024
+    assert summary["by_tag"]["checkpoint"]["count"] == 3
+    # Clean cluster: the sentinel gauges read zero (the
+    # zero-false-positives half of the acceptance criterion).
+    leaks = summary["leaks"]
+    assert leaks["arena_orphan_pin_bytes"] == 0
+    assert leaks["objects_unreachable_owner_bytes"] == 0
+    del refs
+
+
+def test_kill_switch_off_arm_harvest_still_works(mem_cluster):
+    """RAY_TPU_MEMORY_LEDGER=0 (flipped live): puts go unannotated —
+    but the harvest still reports them from the owner table, just
+    untagged.  Same-run A/B, no restart."""
+    import ray_tpu
+    from ray_tpu._private import memledger as ml
+    from ray_tpu.utils import state
+
+    prev = ml.ENABLED
+    try:
+        ml.set_enabled(False)
+        ref = ray_tpu.put(np.zeros(128 * 1024, np.uint8))
+        row = {r["object_id"]: r for r in state.list_objects()}[
+            ref.hex()]
+        assert row["tag"] == "untracked" and row["callsite"] == "?"
+        assert row["size"] > 0 and row["owner"] == "driver"
+    finally:
+        ml.set_enabled(prev)
+    del ref
+
+
+def test_pin_attribution_from_zero_copy_reader(mem_cluster):
+    """An actor holding a zero-copy view of someone else's object shows
+    up in the merged table as a pid-attributed pin holder on its node.
+    (The OWNER's own get never pins — it reads the cached value, so the
+    pin must come from another process.)"""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.utils import state
+
+    w = global_worker()
+    agent0 = sorted(_agent_addrs(ray_tpu).values())[0]
+    stats, _ = w.call(agent0, "store_stats", {}, timeout=30.0)
+    if not stats.get("shm_name"):
+        pytest.skip("native arena not built: no pid-attributed pins")
+    ref = ray_tpu.put(np.zeros(1024 * 1024, np.uint8))
+
+    @ray_tpu.remote
+    class Holder:
+        def hold(self, refs):
+            self.v = ray_tpu.get(refs[0])
+            return int(self.v[0])
+
+    holder = Holder.remote()
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=120) == 0
+    row = {r["object_id"]: r for r in state.list_objects()}[ref.hex()]
+    assert row["pins"] >= 1, row
+    pids = [p for h in row["pin_holders"] for p in h["pids"]]
+    assert pids, row["pin_holders"]
+    ray_tpu.kill(holder)
+    del ref
+
+
+def test_dashboard_memory_endpoints(mem_cluster):
+    pytest.importorskip("aiohttp")
+    import json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard.head import start_dashboard
+
+    ref = ray_tpu.put(np.zeros(256 * 1024, np.uint8))
+    head = start_dashboard(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(head.url + path,
+                                        timeout=60) as r:
+                return json.loads(r.read())
+
+        objs = get("/api/v0/objects")["result"]["cluster"]
+        assert objs["total_objects"] >= 1
+        assert "leaks" in objs
+        mem = get("/api/v0/memory?view=rows")["result"]["objects"]
+        assert any(r["object_id"] == ref.hex() for r in mem)
+        metrics = urllib.request.urlopen(head.url + "/metrics",
+                                         timeout=60).read().decode()
+        assert "ray_tpu_arena_orphan_pin_bytes" in metrics
+    finally:
+        head.stop()
+    del ref
+
+
+def test_list_metrics_single_round_trip(mem_cluster):
+    """The batched kv_multiget satellite: list_metrics returns every
+    flushed snapshot and the multiget verb answers a prefix query in
+    one call."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.utils import metrics as um
+    from ray_tpu.utils import state
+
+    c = um.get_or_create(um.Counter, "memledger_test_counter")
+    c.inc(3.0)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        snaps = state.list_metrics()
+        if any(m.get("name") == "memledger_test_counter"
+               for s in snaps for m in s.get("metrics", ())):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail("metric never surfaced via list_metrics")
+    w = global_worker()
+    reply, blobs = w.call(w.controller_addr, "kv_multiget",
+                          {"ns": "metrics", "prefix": ""}, timeout=30.0)
+    assert reply["keys"] and len(blobs) == len(reply["keys"])
+
+
+def test_harvest_failpoint_degrades_to_partial(mem_cluster):
+    """memory.harvest armed on one agent: the cluster harvest completes
+    in bounded time with a per-node diagnostic — partial, never a
+    hang — and the unreachable-owner gauge refuses to report over a
+    hole."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.utils import state
+
+    w = global_worker()
+    addrs = _agent_addrs(ray_tpu)
+    victim = sorted(addrs)[0]
+    w.call(addrs[victim], "failpoints",
+           {"op": "set", "spec": "memory.harvest=error:RuntimeError"},
+           timeout=30.0)
+    try:
+        t0 = time.time()
+        summary = state.summarize_objects()["cluster"]
+        assert time.time() - t0 < 60
+        assert any(victim[:12] in d for d in summary["partial"]), \
+            summary["partial"]
+        assert summary["leaks"]["objects_unreachable_owner_bytes"] \
+            is None
+    finally:
+        w.call(addrs[victim], "failpoints",
+               {"op": "set", "spec": "memory.harvest=off"},
+               timeout=30.0)
+    # Disarmed: the harvest is whole again.
+    summary = state.summarize_objects()["cluster"]
+    assert not summary["partial"], summary["partial"]
+
+
+@pytest.mark.chaos
+def test_sentinel_flags_orphan_pin_and_recovers(mem_cluster):
+    """SIGKILL a reader holding a zero-copy pin: the sentinel flags the
+    orphan within one scan (leak_scan drives it deterministically),
+    emits a memory.leak span, and the gauge returns to zero after the
+    sweep reclaims the pin."""
+    import ray_tpu
+    from ray_tpu import tracing
+    from ray_tpu._private.worker import global_worker
+
+    big = ray_tpu.put(np.zeros(4 * 1024 * 1024, np.uint8))
+
+    @ray_tpu.remote(max_retries=0)
+    def pin_and_die(refs):
+        import os
+
+        _view = ray_tpu.get(refs[0])    # zero-copy pin on the arena
+        os.kill(os.getpid(), 9)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(pin_and_die.remote([big]), timeout=120)
+    w = global_worker()
+    addrs = _agent_addrs(ray_tpu)
+    flagged = {}
+    for node_id, addr in addrs.items():
+        scan, _ = w.call(addr, "memory", {"op": "leak_scan"},
+                         timeout=30.0)
+        if not scan.get("supported"):
+            pytest.skip("native arena not built: no pid-attributed "
+                        "pins to sentinel")
+        if scan["arena_orphan_pins"] or \
+                scan["totals"]["orphan_pins_flagged"]:
+            flagged[node_id] = (addr, scan)
+    assert flagged, "no sentinel flagged the orphaned pin"
+    # The flight-recorder alarm made it into a harvestable span (the
+    # reaper may have scanned first — either scan emits it).
+    spans = tracing.harvest(timeout=30.0)
+    assert any(s["name"] == "memory.leak" for s in spans)
+    # Sweep reclaims; the gauge returns to zero.
+    for addr, _scan in flagged.values():
+        w.call(addr, "store_stats", {"sweep": True}, timeout=30.0)
+        rescan, _ = w.call(addr, "memory", {"op": "leak_scan"},
+                           timeout=30.0)
+        assert rescan["arena_orphan_pins"] == 0
+        assert rescan["arena_orphan_pin_bytes"] == 0
+    del big
