@@ -133,6 +133,23 @@ class Config:
     # Device workers always cold-spawn.  Any zygote failure falls back to
     # classic spawning automatically.
     worker_zygote: bool = True
+    # --- actor control plane (wave batching; kill switch
+    # RAY_TPU_ACTOR_WAVES=0 restores the per-actor legacy path) ---
+    # Accumulation tick for the controller's actor scheduler wave: actor
+    # registrations landing within one tick are placed against a single
+    # cluster view and dispatched as ONE create_actors RPC per agent.
+    actor_wave_tick_s: float = 0.005
+    # DEAD-actor tombstones stay visible (death_cause, get_actor_info)
+    # for this grace window, then are GC'd; the table is also hard-capped
+    # at actor_tombstone_max tombstones (oldest dropped first), so
+    # 10k-actor churn cannot grow the controller resident set unbounded.
+    actor_tombstone_grace_s: float = 60.0
+    actor_tombstone_max: int = 2000
+    # Demand-sized zygote prefork: on a creation wave the agent pre-forks
+    # (pending plain creations - idle/starting spares) workers ahead of
+    # the per-actor acquisition fan-out, capped at this many spares in
+    # flight (bounded additionally by the worker-cap discipline).
+    actor_prefork_spares_cap: int = 32
     # --- health / fault tolerance ---
     heartbeat_period_s: float = 0.5
     # Missed-heartbeat budget before a node is declared dead

@@ -20,6 +20,7 @@ Redis-persistence analog for controller fault tolerance.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import os
 import time
@@ -77,6 +78,10 @@ class ActorInfo:
     affinity_soft: bool = False
     label_hard: dict | None = None
     label_soft: dict | None = None
+    # Wave-scheduler bookkeeping (never snapshotted): dedup flag for the
+    # wave queue, and the death timestamp driving tombstone GC.
+    queued: bool = False
+    died_at: float | None = None
 
 
 @dataclass
@@ -208,6 +213,22 @@ class Controller:
         self._probing: set[str] = set()
         # Wakes pending PG schedulers when bundle releases free capacity.
         self._pg_retry = asyncio.Event()
+        # --- actor wave scheduler (kill switch RAY_TPU_ACTOR_WAVES=0) ---
+        # Pending actors accumulate here for one tick, are placed against
+        # a single cluster view, and dispatched as ONE create_actors RPC
+        # per agent per wave (the batched-PG-reserve shape applied to
+        # actors; ray: GcsActorScheduler batching).
+        self._actor_queue: list[ActorInfo] = []
+        # Infeasible-now actors park HERE and wait for a capacity signal
+        # (node registration / heartbeat reporting more availability /
+        # bundle release) instead of the legacy blind backoff poll.
+        self._actor_parked: list[ActorInfo] = []
+        self._actor_wave_wake = asyncio.Event()
+        self._actor_retry = asyncio.Event()
+        # actor_id -> futures of get_actor_info(wait=True) calls that
+        # arrived BEFORE the (batched, in-flight) registration: a handle
+        # can cross processes ahead of its create_actors flush.
+        self._unknown_actor_waiters: dict[str, list[asyncio.Future]] = {}
 
     # ---------------------------------------------------------------- setup
     async def start(self) -> None:
@@ -228,6 +249,8 @@ class Controller:
         self._bg.append(loop.create_task(self._health_loop()))
         self._bg.append(loop.create_task(self._resource_broadcast_loop()))
         self._bg.append(loop.create_task(self._pg_owner_reaper_loop()))
+        self._bg.append(loop.create_task(self._actor_wave_loop()))
+        self._bg.append(loop.create_task(self._actor_unpark_loop()))
         if self.snapshot_path:
             # Write an initial snapshot NOW: a kill before the first
             # periodic write would otherwise restart with no pub-port
@@ -251,7 +274,7 @@ class Controller:
         self._restored_at = time.monotonic()
         for actor in self.actors.values():
             if actor.state in (PENDING, RESTARTING):
-                loop.create_task(self._schedule_actor(actor))
+                self._schedule(actor)
         for pg in self.pgs.values():
             if pg.state == "PENDING":
                 loop.create_task(self._schedule_pg(pg))
@@ -302,6 +325,10 @@ class Controller:
         snap = pickle.loads(blob)
         for aid, a in snap["actors"].items():
             self.actors[aid] = ActorInfo(**a)
+            if self.actors[aid].state == DEAD:
+                # monotonic clocks don't survive a process restart:
+                # restart the tombstone grace window at restore time.
+                self.actors[aid].died_at = time.monotonic()
         self.named_actors = {tuple(k) if not isinstance(k, tuple) else k: v
                              for k, v in snap["named_actors"].items()}
         for pid, p in snap["pgs"].items():
@@ -363,6 +390,8 @@ class Controller:
             labels=h.get("labels", {}),
         )
         self.nodes[node.node_id] = node
+        # A new node is new capacity: wake parked (infeasible) actors.
+        self._actor_retry.set()
         await self.publisher.publish(
             "node", {"event": "alive", "node_id": node.node_id,
                      "agent_addr": node.agent_addr})
@@ -370,13 +399,35 @@ class Controller:
         return {"config": self.config.to_json(),
                 "pub_addr": self.publisher.address}
 
+    async def rpc_unregister_node(self, h: dict, _b: list) -> dict:
+        """Graceful membership leave: the node drops out of the view and
+        its bundles/actors fail over exactly as on a death, but without
+        the probe delay — and the entry is POPPED, so membership churn
+        (the 1k-node bench row) cannot grow the node table unbounded."""
+        node = self.nodes.get(h["node_id"])
+        if node is None:
+            return {"ok": False}
+        if node.state in ("ALIVE", "DRAINING"):
+            await self._on_node_dead(node)
+        self.nodes.pop(node.node_id, None)
+        return {"ok": True}
+
     async def rpc_heartbeat(self, h: dict, _b: list) -> dict:
         node = self.nodes.get(h["node_id"])
         if node is None or node.state not in ("ALIVE", "DRAINING"):
             return {"ok": False}          # stale node: tell it to re-register
         node.last_heartbeat = time.monotonic()
+        prev = node.available
         node.available = dict(h["available"])
         node.load = h.get("load", 0)
+        # Resource-freed signal for parked actors: this node now reports
+        # MORE of some resource than before (an actor/lease/bundle was
+        # released there) — the event-driven analog of the legacy
+        # backoff-poll retry.
+        if self._actor_parked and any(
+                v > prev.get(k, 0.0) + 1e-9
+                for k, v in node.available.items()):
+            self._actor_retry.set()
         return {"ok": True}
 
     async def _health_loop(self) -> None:
@@ -392,6 +443,10 @@ class Controller:
             last_tick = now
             if stalled:
                 continue
+            try:
+                self._gc_actor_tombstones(now)
+            except Exception:  # noqa: BLE001
+                logger.exception("actor tombstone GC failed")
             for node in list(self.nodes.values()):
                 # DRAINING nodes keep heartbeating and must keep death
                 # DETECTION too — a drained agent that crashes still has
@@ -436,6 +491,11 @@ class Controller:
     async def _on_node_dead(self, node: NodeInfo) -> None:
         node.state = "DEAD"
         logger.warning("node %s declared dead", node.node_id[:12])
+        # Fail OUR in-flight calls to the dead agent NOW (zmq never
+        # surfaces peer death): a wave dispatch mid-flight gets
+        # ConnectionLost and reschedules its actors immediately instead
+        # of waiting out the RPC timeout.
+        self.clients.drop(node.agent_addr)
         await self.publisher.publish(
             "node", {"event": "dead", "node_id": node.node_id,
                      "agent_addr": node.agent_addr})
@@ -556,9 +616,17 @@ class Controller:
         return {"keys": found}, blobs
 
     # --------------------------------------------------------------- actors
-    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
-        """Register + schedule an actor (ray: HandleRegisterActor/HandleCreateActor
-        gcs_actor_manager.cc:311,335)."""
+    @staticmethod
+    def _waves_enabled() -> bool:
+        # Read per call (never cached): the kill switch must flip the
+        # scheduling path mid-run for same-run A/B.
+        return os.environ.get("RAY_TPU_ACTOR_WAVES", "1") \
+            not in ("0", "false")
+
+    def _register_actor(self, h: dict, blobs: list) -> dict:
+        """Register one actor + hand it to the scheduler (ray:
+        HandleRegisterActor/HandleCreateActor gcs_actor_manager.cc:311,
+        335).  Shared by the single verb and the batched create_actors."""
         name = h.get("name")
         namespace = h.get("namespace", "default")
         if name:
@@ -584,20 +652,257 @@ class Controller:
         self.actors[actor.actor_id] = actor
         if name:
             self.named_actors[(namespace, name)] = actor.actor_id
-        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        # A resolver may have raced ahead of this (batched) registration.
+        for fut in self._unknown_actor_waiters.pop(actor.actor_id, ()):
+            if not fut.done():
+                fut.set_result(None)
+        self._schedule(actor, wave=h.get("wave", True))
         return {"actor_id": actor.actor_id}
 
+    def _schedule(self, actor: ActorInfo, wave: bool = True) -> None:
+        """Route an actor to the wave scheduler, or (kill switch / the
+        driver's explicit wave=False header) the legacy per-actor task."""
+        if wave and self._waves_enabled():
+            self._enqueue_actor(actor)
+        else:
+            asyncio.get_running_loop().create_task(
+                self._schedule_actor(actor))
+
+    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        return self._register_actor(h, blobs)
+
+    async def rpc_create_actors(self, h: dict, blobs: list) -> dict:
+        """Batched registration: a driver's burst of N creations lands as
+        ONE controller round trip; per-actor blob frames are multiplexed
+        in order (h["actors"][i]["nblobs"] frames each)."""
+        results = []
+        off = 0
+        for spec in h["actors"]:
+            n = int(spec.get("nblobs", 0))
+            results.append(self._register_actor(spec, blobs[off:off + n]))
+            off += n
+        return {"results": results}
+
+    # ------------------------------------------------- actor wave scheduler
+    def _enqueue_actor(self, actor: ActorInfo) -> None:
+        if actor.queued or actor.state not in (PENDING, RESTARTING):
+            return
+        actor.queued = True
+        self._actor_queue.append(actor)
+        self._actor_wave_wake.set()
+
+    def _requeue_actor_later(self, actor: ActorInfo, delay: float) -> None:
+        """Backoff requeue (agent refusals / dispatch failures): an
+        immediate requeue would spin the wave loop hot against the same
+        stale view."""
+        if delay <= 0:
+            self._enqueue_actor(actor)
+        else:
+            asyncio.get_running_loop().call_later(
+                delay, self._enqueue_actor, actor)
+
+    def _park_actor_on_pg(self, actor: ActorInfo,
+                          pg: PlacementGroupInfo) -> None:
+        """Park an actor targeting a not-yet-CREATED placement group on
+        the PG's transition: CREATED and REMOVED both resolve pg.waiters,
+        re-enqueueing the actor (the next wave then places it — or fails
+        it if the group was removed).  Replaces the legacy sleep-spin."""
+        fut = asyncio.get_running_loop().create_future()
+        pg.waiters.append(fut)
+        fut.add_done_callback(lambda _f, a=actor: self._enqueue_actor(a))
+
+    async def _actor_wave_loop(self) -> None:
+        """The scheduler wave: pending actors accumulate for one tick,
+        are placed against a single cluster view, grouped by target node,
+        and dispatched as ONE create_actors bulk verb per agent (ray:
+        GcsActorScheduler batching; the batched-PG-reserve shape)."""
+        while True:
+            await self._actor_wave_wake.wait()
+            if self.config.actor_wave_tick_s > 0:
+                await asyncio.sleep(self.config.actor_wave_tick_s)
+            self._actor_wave_wake.clear()
+            batch, self._actor_queue = self._actor_queue, []
+            for a in batch:
+                a.queued = False
+            batch = [a for a in batch if a.state in (PENDING, RESTARTING)]
+            if not batch:
+                continue
+            try:
+                await self._run_actor_wave(batch)
+            except Exception:  # noqa: BLE001
+                logger.exception("actor wave failed; rescheduling %d "
+                                 "actor(s)", len(batch))
+                for a in batch:
+                    self._requeue_actor_later(
+                        a, self.config.actor_restart_backoff_s)
+
+    async def _actor_unpark_loop(self) -> None:
+        """Re-queue parked (infeasible) actors when capacity appears:
+        node registration, a heartbeat reporting more availability, or a
+        bundle release set _actor_retry.  The timeout leg is only a
+        missed-signal safety net — NOT the primary retry mechanism."""
+        while True:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._actor_retry.wait(),
+                                       4 * self.config.heartbeat_period_s)
+            self._actor_retry.clear()
+            if self._actor_parked:
+                parked, self._actor_parked = self._actor_parked, []
+                for a in parked:
+                    self._enqueue_actor(a)
+
+    async def _run_actor_wave(self, batch: list[ActorInfo]) -> None:
+        t0 = time.time()
+        view = self._cluster_view()
+        # Scratch availability, decremented per placement: one wave must
+        # not overbook a node against the shared stale view (the same
+        # scorer discipline as place_bundles).
+        scratch = {nid: dict(n["available"]) for nid, n in view.items()}
+        sview = {nid: {**n, "available": scratch[nid]}
+                 for nid, n in view.items()}
+        by_node: dict[str, list[ActorInfo]] = {}
+        parked = 0
+        for actor in batch:
+            strategy = None
+            if actor.pg_id:
+                pg = self.pgs.get(actor.pg_id)
+                if pg is None or pg.state == "REMOVED":
+                    await self._fail_actor(
+                        actor, f"placement group {actor.pg_id[:12]} "
+                               "removed before the actor could be placed")
+                    continue
+                if pg.state != "CREATED":
+                    self._park_actor_on_pg(actor, pg)
+                    continue
+                # Constrain to the node holding the requested bundle.
+                idx = actor.bundle_index if actor.bundle_index >= 0 else 0
+                strategy = sched.NodeAffinity(pg.bundle_nodes.get(idx),
+                                              soft=False)
+            elif actor.affinity_node_id:
+                strategy = sched.NodeAffinity(actor.affinity_node_id,
+                                              soft=actor.affinity_soft)
+            node_id = sched.pick_node(sview, actor.resources, self.config,
+                                      strategy=strategy,
+                                      label_hard=actor.label_hard,
+                                      label_soft=actor.label_soft)
+            if node_id is None:
+                self._actor_parked.append(actor)
+                parked += 1
+                continue
+            if not actor.pg_id:
+                # Bundle-targeted actors draw from the bundle's pool at
+                # the agent, not node availability — don't double-charge.
+                for k, v in actor.resources.items():
+                    scratch[node_id][k] = scratch[node_id].get(k, 0.0) - v
+            by_node.setdefault(node_id, []).append(actor)
+        granted = refused = 0
+        events: list[dict] = []
+        if by_node:
+            outs = await asyncio.gather(
+                *[self._dispatch_wave(nid, actors)
+                  for nid, actors in by_node.items()])
+            for evs, ref in outs:
+                events.extend(evs)
+                refused += ref
+            granted = len(events)
+        if events:
+            # ONE batched pub-sub message for the whole wave's ALIVE
+            # storm (subscribers iterate the batch).
+            await self.publisher.publish("actor", {"batch": events})
+        spans.emit("actor.wave", t0, time.time(), attrs={
+            "count": len(batch), "nodes": len(by_node),
+            "granted": granted, "refused": refused, "parked": parked})
+
+    async def _dispatch_wave(self, node_id: str,
+                             actors: list[ActorInfo]) -> tuple[list, int]:
+        """ONE create_actors RPC carrying every actor of this wave placed
+        on node_id.  Returns (alive events, refused count); refused and
+        transport-failed actors are re-queued (partial grants reschedule
+        only the refused actors)."""
+        backoff = self.config.actor_restart_backoff_s
+        node = self.nodes.get(node_id)
+        try:
+            # Failpoint window: mid-wave on the controller side (error =
+            # this node's whole sub-wave reschedules; crash = restart
+            # restores PENDING actors from the snapshot and re-drives).
+            if failpoints.ACTIVE:
+                await failpoints.fire_async("controller.actor_wave")
+            if node is None or node.state != "ALIVE":
+                raise RuntimeError(f"node {node_id[:12]} left the view")
+            header = {"actors": [
+                {"actor_id": a.actor_id,
+                 "creation_header": a.creation_header,
+                 "resources": a.resources,
+                 "owner_addr": a.owner_addr,
+                 "nblobs": len(a.creation_spec)} for a in actors]}
+            blobs = [f for a in actors for f in a.creation_spec]
+            reply, _ = await self.clients.get(node.agent_addr).call(
+                "create_actors", header, blobs, timeout=120.0)
+            results = reply.get("results", {})
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor wave on %s failed: %s — rescheduling "
+                           "%d actor(s)", node_id[:12], e, len(actors))
+            for a in actors:
+                self._requeue_actor_later(a, backoff)
+            return [], 0
+        events: list[dict] = []
+        refused = 0
+        for a in actors:
+            r = results.get(a.actor_id) or {}
+            if a.state not in (PENDING, RESTARTING):
+                # Killed while the wave was in flight: a grant must not
+                # resurrect it — tear the placement down at the agent.
+                if r.get("ok"):
+                    with contextlib.suppress(Exception):
+                        await self.clients.get(node.agent_addr).notify(
+                            "destroy_actor", {"actor_id": a.actor_id})
+                continue
+            if r.get("ok"):
+                events.append(self._actor_alive(
+                    a, node_id, r["worker_addr"]))
+            elif r.get("error"):
+                await self._fail_actor(a, r["error"])
+            else:
+                refused += 1
+                self._requeue_actor_later(a, backoff)
+        return events, refused
+
+    def _actor_alive(self, actor: ActorInfo, node_id: str,
+                     worker_addr: str) -> dict:
+        actor.state = ALIVE
+        actor.address = worker_addr
+        actor.node_id = node_id
+        for fut in actor.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        actor.waiters.clear()
+        return {"event": "alive", "actor_id": actor.actor_id,
+                "address": actor.address}
+
     async def _schedule_actor(self, actor: ActorInfo) -> None:
-        """Pick a node and ask its agent to start the actor
-        (ray: GcsActorScheduler::Schedule, ScheduleByGcs gcs_actor_scheduler.cc:60)."""
+        """LEGACY per-actor scheduler (kill switch RAY_TPU_ACTOR_WAVES=0;
+        ray: GcsActorScheduler::Schedule gcs_actor_scheduler.cc:60): one
+        controller→agent round trip per actor."""
         delay = self.config.actor_restart_backoff_s
         while actor.state in (PENDING, RESTARTING):
             view = self._cluster_view()
             strategy = None
             if actor.pg_id:
                 pg = self.pgs.get(actor.pg_id)
-                if pg is None or pg.state != "CREATED":
-                    await asyncio.sleep(delay)
+                if pg is None or pg.state == "REMOVED":
+                    await self._fail_actor(
+                        actor, f"placement group {actor.pg_id[:12]} "
+                               "removed before the actor could be placed")
+                    return
+                if pg.state != "CREATED":
+                    # Park on the PG's CREATED/REMOVED transition instead
+                    # of the old sleep-spin (bounded wait as a safety
+                    # net against a missed transition).
+                    fut = asyncio.get_running_loop().create_future()
+                    pg.waiters.append(fut)
+                    with contextlib.suppress(asyncio.TimeoutError):
+                        await asyncio.wait_for(
+                            fut, 20 * self.config.heartbeat_period_s)
                     continue
                 # Constrain to the node holding the requested bundle.
                 idx = actor.bundle_index if actor.bundle_index >= 0 else 0
@@ -628,13 +933,7 @@ class Controller:
                 await asyncio.sleep(delay)
                 continue
             if reply.get("ok"):
-                actor.state = ALIVE
-                actor.address = reply["worker_addr"]
-                actor.node_id = node_id
-                for fut in actor.waiters:
-                    if not fut.done():
-                        fut.set_result(None)
-                actor.waiters.clear()
+                self._actor_alive(actor, node_id, reply["worker_addr"])
                 await self.publisher.publish(
                     "actor", {"event": "alive", "actor_id": actor.actor_id,
                               "address": actor.address})
@@ -647,6 +946,7 @@ class Controller:
     async def _fail_actor(self, actor: ActorInfo, cause: str) -> None:
         actor.state = DEAD
         actor.death_cause = cause
+        actor.died_at = time.monotonic()
         for fut in actor.waiters:
             if not fut.done():
                 fut.set_result(None)
@@ -654,6 +954,29 @@ class Controller:
         await self.publisher.publish(
             "actor", {"event": "dead", "actor_id": actor.actor_id,
                       "cause": cause})
+
+    def _gc_actor_tombstones(self, now: float) -> int:
+        """Bounded DEAD-actor directory: tombstones keep death_cause
+        visible for the grace window, then drop; the table is also
+        hard-capped (oldest first) so 10k-actor churn cannot grow the
+        controller resident set without bound.  Runs off the health
+        loop's tick."""
+        grace = self.config.actor_tombstone_grace_s
+        cap = max(0, self.config.actor_tombstone_max)
+        dead = sorted((a for a in self.actors.values() if a.state == DEAD),
+                      key=lambda a: a.died_at or 0.0)
+        excess = len(dead) - cap
+        dropped = 0
+        for i, a in enumerate(dead):
+            expired = a.died_at is not None and now - a.died_at > grace
+            if i >= excess and not expired:
+                continue
+            self.actors.pop(a.actor_id, None)
+            key = (a.namespace, a.name)
+            if a.name and self.named_actors.get(key) == a.actor_id:
+                del self.named_actors[key]
+            dropped += 1
+        return dropped
 
     async def _on_actor_dead(self, actor: ActorInfo, cause: str) -> None:
         """Restart if budget remains (ray: GcsActorManager::OnWorkerDead
@@ -668,7 +991,7 @@ class Controller:
             actor.node_id = None
             await self.publisher.publish(
                 "actor", {"event": "restarting", "actor_id": actor.actor_id})
-            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+            self._schedule(actor)
         else:
             await self._fail_actor(actor, cause)
 
@@ -690,6 +1013,29 @@ class Controller:
     async def rpc_get_actor_info(self, h: dict, _b: list) -> dict:
         """Resolve an actor to an address; long-polls until ALIVE or DEAD."""
         actor = self.actors.get(h["actor_id"])
+        if actor is None and h.get("wait"):
+            # A handle can cross processes AHEAD of its batched, still
+            # in-flight registration: park briefly for the registration
+            # to land instead of answering UNKNOWN (which resolvers
+            # treat as terminally dead).  Short grace: the race window
+            # is one flush RPC (~ms), and a genuinely unknown id — e.g.
+            # a tombstone-GC'd long-dead actor — must not stall its
+            # resolver for long.
+            fut = asyncio.get_running_loop().create_future()
+            waiters = self._unknown_actor_waiters.setdefault(
+                h["actor_id"], [])
+            waiters.append(fut)
+            try:
+                await asyncio.wait_for(
+                    fut, timeout=min(2.0, h.get("timeout", 60.0)))
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                with contextlib.suppress(ValueError):
+                    waiters.remove(fut)
+                if not waiters:
+                    self._unknown_actor_waiters.pop(h["actor_id"], None)
+            actor = self.actors.get(h["actor_id"])
         if actor is None:
             return {"state": "UNKNOWN"}
         if h.get("wait") and actor.state in (PENDING, RESTARTING):
@@ -976,6 +1322,7 @@ class Controller:
 
         await asyncio.gather(*[_one(n, i) for n, i in by_node.items()])
         self._pg_retry.set()
+        self._actor_retry.set()
 
     # ------------------------------------------------------------ state API
     async def rpc_list_nodes(self, h: dict, _b: list) -> dict:

@@ -201,18 +201,7 @@ class Arena:
                  parallel_min: int | None = None):
         self.lib = load_lib()
         self.name = name
-        # Put-path tuning: explicit args (worker/agent pass Config values)
-        # beat env beats defaults; the kill switches zero out a path.
-        self.stream_min = (stream_min if stream_min is not None else
-                           _env_int("RAY_TPU_PUT_STREAM_MIN_BYTES",
-                                    DEFAULT_STREAM_MIN))
-        self.parallel_min = (parallel_min if parallel_min is not None else
-                             _env_int("RAY_TPU_PUT_PARALLEL_MIN_BYTES",
-                                      DEFAULT_PARALLEL_MIN))
-        if not _env_flag("RAY_TPU_PUT_STREAM"):
-            self.stream_min = 0x7FFFFFFFFFFFFFFF
-        if not _env_flag("RAY_TPU_PUT_PARALLEL"):
-            self.parallel_min = 0x7FFFFFFFFFFFFFFF
+        self.retune(stream_min, parallel_min)
         if create:
             self.handle = self.lib.rt_store_create(
                 name.encode(), ctypes.c_uint64(capacity or 0))
@@ -240,6 +229,23 @@ class Arena:
         size = self.lib.rt_store_mapped_size(self.handle)
         self._map = memoryview(
             (ctypes.c_ubyte * size).from_address(self.base)).cast("B")
+
+    def retune(self, stream_min: int | None = None,
+               parallel_min: int | None = None) -> None:
+        """(Re-)apply put-path tuning: explicit args (worker/agent pass
+        Config values) beat env beats defaults; the kill switches zero
+        out a path.  Re-run post-fork on an inherited pre-warmed arena,
+        whose zygote mapping never saw this worker's config."""
+        self.stream_min = (stream_min if stream_min is not None else
+                           _env_int("RAY_TPU_PUT_STREAM_MIN_BYTES",
+                                    DEFAULT_STREAM_MIN))
+        self.parallel_min = (parallel_min if parallel_min is not None else
+                             _env_int("RAY_TPU_PUT_PARALLEL_MIN_BYTES",
+                                      DEFAULT_PARALLEL_MIN))
+        if not _env_flag("RAY_TPU_PUT_STREAM"):
+            self.stream_min = 0x7FFFFFFFFFFFFFFF
+        if not _env_flag("RAY_TPU_PUT_PARALLEL"):
+            self.parallel_min = 0x7FFFFFFFFFFFFFFF
 
     # ---- write path ----
     def _frame_addr(self, f) -> tuple[int, object] | None:
@@ -694,3 +700,38 @@ class NativeStoreBackend:
 
     def close(self) -> None:
         self.arena.close()
+
+
+# ---------------------------------------------- zygote prefork warm arena
+# The warm-fork spawner maps + write-prefaults the node arena ONCE before
+# forking workers; every child then inherits the fully-populated mapping
+# (VMA and PTEs ride along with fork), so a 24-worker boot storm pays the
+# ~250ms 512MB prefault once instead of 24 times — and each child's own
+# warm_arena pass degenerates to a ~ms touch of already-present pages.
+_PREFORK_ARENA: "tuple[str, Arena] | None" = None
+
+
+def preheat_for_fork(name: str) -> None:
+    """Zygote-side, pre-fork: map + prefault the arena once.  Import/map
+    only — no threads, no sockets (the zygote safety rules)."""
+    global _PREFORK_ARENA
+    if _PREFORK_ARENA is not None and _PREFORK_ARENA[0] == name:
+        return
+    arena = Arena(name)
+    try:
+        arena.prefault_free()
+    except Exception:  # noqa: BLE001 - warm is best-effort
+        pass
+    # Children skip their own warm pass: the inherited PTEs are the
+    # warm state (worker.warm_arena checks this flag).
+    arena.prewarmed = True
+    _PREFORK_ARENA = (name, arena)
+
+
+def take_prefork_arena(name: str) -> "Arena | None":
+    """Worker-side, post-fork: the inherited pre-warmed mapping for this
+    node's store, or None (cold spawn / different store).  The caller
+    must retune() it — the zygote's mapping never saw worker config."""
+    if _PREFORK_ARENA is not None and _PREFORK_ARENA[0] == name:
+        return _PREFORK_ARENA[1]
+    return None

@@ -98,6 +98,9 @@ class WorkerHandle:
     # Isolated-interpreter workers are keyed by their venv hash and only
     # serve leases with the same key (ray: runtime-env-keyed WorkerPool).
     venv_key: str | None = None
+    # Demand-sized prefork pool: spare workers forked ahead of a creation
+    # wave; invisible to idle scans until claimed or absorbed.
+    spare: bool = False
     actor_ids: set[str] = field(default_factory=set)
     # actor_id -> lease header whose resources it holds
     actor_leases: dict = field(default_factory=dict)
@@ -158,6 +161,13 @@ class NodeAgent:
         self._actor_spawn_sem_warm = asyncio.Semaphore(
             max(4 * config.max_concurrent_worker_spawns,
                 config.max_concurrent_worker_spawns))
+        # Demand-sized zygote prefork pool: worker_ids of spare workers
+        # forked ahead of a creation wave (insertion-ordered; see
+        # _prefork_spares/_claim_spare).
+        self._spares: dict[str, None] = {}
+        # Single-flight device-worker spawn: a bulk wave carrying several
+        # TPU actors must not race N concurrent singleton spawns.
+        self._device_spawn_lock = asyncio.Lock()
         self._closed = False
         # Draining: no NEW leases or actor placements; running work
         # finishes (set by the controller's drain_node RPC).
@@ -387,6 +397,7 @@ class NodeAgent:
     async def _get_idle_worker(self, ignore_cap: bool = False,
                                spawn_sem: "asyncio.Semaphore | None" = None,
                                venv: dict | None = None,
+                               use_spares: bool = False,
                                ) -> WorkerHandle | None:
         from ray_tpu._private import runtime_env as renv
 
@@ -395,15 +406,24 @@ class NodeAgent:
         def idle_match() -> WorkerHandle | None:
             # venv workers serve ONLY matching-key leases and plain
             # leases never land on them (the interpreter differs).
+            # Unclaimed SPARES are reserved for wave claimers until the
+            # wave absorbs its leftovers back into the pool.
             for w in self.workers.values():
                 if w.state == "idle" and not w.is_device_worker \
-                        and w.venv_key == vkey:
+                        and not w.spare and w.venv_key == vkey:
                     return w
             return None
 
         w = idle_match()
         if w is not None:
             return w
+        if use_spares and vkey is None:
+            w = await self._claim_spare()
+            if w is not None:
+                return w
+            w = idle_match()   # a worker may have freed while claiming
+            if w is not None:
+                return w
         n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
         if not ignore_cap and \
                 n_alive >= self.config.max_workers_per_node:
@@ -463,24 +483,28 @@ class NodeAgent:
         return w if w.state == "idle" else None
 
     async def _get_device_worker(self) -> WorkerHandle | None:
-        """The singleton process owning this host's TPU chips."""
-        if self._device_worker_id:
-            w = self.workers.get(self._device_worker_id)
-            if w and w.state != "dead":
-                if w.state == "starting":
-                    fut = self._starting.get(w.worker_id)
-                    if fut:
-                        await asyncio.wait_for(asyncio.shield(fut), timeout=120.0)
-                return w
-        w = self._spawn_worker(device_worker=True)
-        self._device_worker_id = w.worker_id
-        fut = self._starting.get(w.worker_id)
-        if fut:
-            try:
-                await asyncio.wait_for(asyncio.shield(fut), timeout=120.0)
-            except asyncio.TimeoutError:
-                return None
-        return w if w.state != "dead" else None
+        """The singleton process owning this host's TPU chips.  Single-
+        flight: concurrent requests (a bulk wave of TPU actors) must
+        share one spawn, never race N singletons."""
+        async with self._device_spawn_lock:
+            if self._device_worker_id:
+                w = self.workers.get(self._device_worker_id)
+                if w and w.state != "dead":
+                    if w.state == "starting":
+                        fut = self._starting.get(w.worker_id)
+                        if fut:
+                            await asyncio.wait_for(asyncio.shield(fut),
+                                                   timeout=120.0)
+                    return w
+            w = self._spawn_worker(device_worker=True)
+            self._device_worker_id = w.worker_id
+            fut = self._starting.get(w.worker_id)
+            if fut:
+                try:
+                    await asyncio.wait_for(asyncio.shield(fut), timeout=120.0)
+                except asyncio.TimeoutError:
+                    return None
+            return w if w.state != "dead" else None
 
     async def _reaper_loop(self) -> None:
         """Detect dead worker processes; fail leases/actors accordingly."""
@@ -667,6 +691,7 @@ class NodeAgent:
             # scrub it from our env before the replacement (spawned
             # with {**os.environ}) inherits it and crashes too.
             failpoints.on_child_sigkill()
+        self._spares.pop(w.worker_id, None)
         prev_state = w.state
         # Capture BEFORE _release_lease_resources nulls them — the
         # worker_died notify below must name the lease and reach the
@@ -972,23 +997,30 @@ class NodeAgent:
             if w.state != "dead")
         return {"draining": self._draining, "busy": busy}
 
-    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
-        """Place an actor into a worker process (controller-initiated)."""
-        if self._draining:
-            # ok=False WITHOUT "error": the controller's scheduler treats
-            # a bare refusal as retriable and re-picks a node (an "error"
-            # reply is terminal and would kill the actor for good).
-            return {"ok": False}
+    def _admit_actor(self, h: dict) -> tuple[dict | None, dict | None]:
+        """Synchronous admission (the _grant discipline extended to N):
+        feasibility check + resource acquisition with NO awaits in
+        between, so a wave's admissions can never double-book capacity.
+        Returns (lease_header, None) on admit, (None, refusal) otherwise
+        — a refusal WITHOUT "error" is retriable (the controller re-picks
+        a node); "error" is terminal."""
         demand = dict(h.get("resources", {}))
         lease_h = {"resources": demand, "submitter": None,
                    "bundle_key": h.get("creation_header", {}).get("bundle_key")}
-        if not lease_h["bundle_key"] and not sched.feasible(self.resources, demand):
-            return {"ok": False, "error": "infeasible"}
+        if not lease_h["bundle_key"] and not sched.feasible(self.resources,
+                                                            demand):
+            return None, {"ok": False, "error": "infeasible"}
         if not self._resources_fit(lease_h):
-            return {"ok": False}
-        # Reserve BEFORE any await (the _grant discipline): concurrent
-        # creations racing through a spawn wait must not double-book.
+            return None, {"ok": False}
         self._acquire(lease_h)
+        return lease_h, None
+
+    async def _place_actor(self, h: dict, blobs: list,
+                           lease_h: dict) -> dict:
+        """Acquire a worker for one ADMITTED actor and start it there
+        (resources already held via lease_h; released on any failure)."""
+        demand = lease_h["resources"]
+        t0 = time.time()
         w = None
         try:
             if demand.get("TPU", 0) > 0:
@@ -1006,12 +1038,14 @@ class NodeAgent:
                     ignore_cap=has_demand,
                     spawn_sem=(self._actor_spawn_sem_warm if warm
                                else self._actor_spawn_sem),
-                    venv=venv)
+                    venv=venv, use_spares=(venv is None))
         finally:
             if w is None or w.addr is None:
                 self._release(lease_h)
         if w is None or w.addr is None:
             return {"ok": False}
+        spans.emit("actor.spawn", t0, time.time(), attrs={
+            "actor_id": h["actor_id"][:12], "worker": w.worker_id[:12]})
         if not w.is_device_worker:
             w.state = "actor"
         w.actor_ids.add(h["actor_id"])
@@ -1042,6 +1076,137 @@ class NodeAgent:
             self._try_grant_pending()
             return {"ok": False, "error": reply["error"]}
         return {"ok": True, "worker_addr": w.addr, "worker_id": w.worker_id}
+
+    async def rpc_create_actor(self, h: dict, blobs: list) -> dict:
+        """Place an actor into a worker process (controller-initiated;
+        the legacy per-actor verb — the wave path uses create_actors)."""
+        if self._draining:
+            return {"ok": False}
+        lease_h, refusal = self._admit_actor(h)
+        if lease_h is None:
+            return refusal
+        return await self._place_actor(h, blobs, lease_h)
+
+    async def rpc_create_actors(self, h: dict, blobs: list) -> dict:
+        """Bulk actor placement: admit the whole wave under ONE lease-
+        acquire pass, pre-fork spare workers to the wave's plain-actor
+        depth, fan worker acquisition out concurrently through the warm-
+        fork gate, and reply per-actor results in one message."""
+        # Failpoint window: wave received, nothing admitted yet (crash =
+        # the agent dies mid-wave; the controller's dispatch failure
+        # reschedules every actor of the wave on survivors).
+        if failpoints.ACTIVE:
+            await failpoints.fire_async("agent.create_actors")
+        actors = h["actors"]
+        specs: list[list] = []
+        off = 0
+        for a in actors:
+            n = int(a.get("nblobs", 0))
+            specs.append(blobs[off:off + n])
+            off += n
+        if self._draining:
+            return {"results": {a["actor_id"]: {"ok": False}
+                                for a in actors}}
+        t0 = time.time()
+        results: dict[str, dict] = {}
+        admitted: list[tuple[dict, list, dict]] = []
+        for a, spec in zip(actors, specs):
+            lease_h, refusal = self._admit_actor(a)
+            if lease_h is None:
+                results[a["actor_id"]] = refusal
+            else:
+                admitted.append((a, spec, lease_h))
+        spans.emit("actor.lease", t0, time.time(), attrs={
+            "count": len(actors), "admitted": len(admitted)})
+        self._prefork_spares(admitted)
+        outs = await asyncio.gather(
+            *[self._place_actor(a, spec, lh) for a, spec, lh in admitted],
+            return_exceptions=True)
+        for (a, _spec, _lh), out in zip(admitted, outs):
+            if isinstance(out, BaseException):
+                # _place_actor released the lease on its way out; the
+                # wave must report the one actor, never die whole.
+                logger.warning("bulk placement of %s failed: %s",
+                               a["actor_id"][:12], out)
+                out = {"ok": False, "error": None, "detail": str(out)}
+            results[a["actor_id"]] = out
+        self._absorb_spares()
+        return {"results": results}
+
+    def _prefork_spares(self, admitted: list) -> None:
+        """Demand-sized zygote pool: fork (pending plain creations −
+        idle/starting stock) spare workers NOW, so the wave's concurrent
+        acquisitions meet warm processes instead of serializing fork-on-
+        demand inside the spawn gate.  Zygote-only — a COLD prefork
+        storm is exactly what the spawn gate exists to prevent — and
+        bounded by the spares cap."""
+        if self._zygote is None or not self._zygote._ready.is_set():
+            return
+        plain = 0
+        for a, _spec, lease_h in admitted:
+            if lease_h["resources"].get("TPU", 0) > 0:
+                continue
+            if (a.get("creation_header", {})
+                    .get("runtime_env") or {}).get("venv"):
+                continue
+            plain += 1
+        if not plain:
+            return
+        stock = sum(
+            1 for w in self.workers.values()
+            if not w.is_device_worker and w.venv_key is None
+            and (w.state == "idle"
+                 or (w.state == "starting" and w.spare)))
+        # The worker cap still binds the prefork: zero-demand actors are
+        # admitted by nothing BUT the cap, so spares must never push the
+        # pool past it (demand-ful actors beyond the headroom fall to
+        # the normal spawn path, which applies ignore_cap per actor).
+        n_alive = sum(1 for w in self.workers.values()
+                      if w.state != "dead")
+        headroom = max(0, self.config.max_workers_per_node - n_alive)
+        need = min(plain - stock, self.config.actor_prefork_spares_cap,
+                   headroom)
+        for _ in range(max(0, need)):
+            w = self._spawn_worker()
+            w.spare = True
+            self._spares[w.worker_id] = None
+
+    async def _claim_spare(self) -> WorkerHandle | None:
+        """Claim one preforked spare (oldest first): await its
+        registration if still starting.  Dead/stuck spares are skipped —
+        the caller falls back to the classic spawn path."""
+        while self._spares:
+            wid = next(iter(self._spares))
+            self._spares.pop(wid, None)
+            w = self.workers.get(wid)
+            if w is None or w.state in ("dead", "stopping"):
+                continue
+            w.spare = False
+            if w.state == "idle":
+                return w
+            fut = self._starting.get(wid)
+            if fut is not None:
+                try:
+                    await asyncio.wait_for(asyncio.shield(fut),
+                                           timeout=60.0)
+                except asyncio.TimeoutError:
+                    continue
+            if w.state == "idle":
+                return w
+        return None
+
+    def _absorb_spares(self) -> None:
+        """Wave end: leftover spares (downstream refusals, races) join
+        the normal idle pool — a free prestart, never a leak."""
+        absorbed = False
+        for wid in list(self._spares):
+            w = self.workers.get(wid)
+            if w is not None:
+                w.spare = False
+                absorbed = True
+        self._spares.clear()
+        if absorbed:
+            self._try_grant_pending()
 
     async def rpc_destroy_actor(self, h: dict, _b: list) -> dict:
         """Tear down one hosted actor and free its resources.  Dedicated

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import hashlib
 import itertools
 import logging
@@ -203,6 +204,15 @@ class CoreWorker:
         # Creation-arg pins per actor created by this process
         # (actor_id -> [(object_id, owner_addr)]).
         self.actor_creation_borrows: dict[str, list] = {}
+        # Burst-fused actor registrations (RAY_TPU_ACTOR_WAVES): unnamed
+        # creations enqueue here and a loop-side flusher coalesces the
+        # burst into ONE create_actors controller round trip (the
+        # call_and_wait fusion shape applied to registration).  The
+        # reply for an unnamed actor is fully determined client-side, so
+        # the user thread never waits on it.
+        self._actor_reg_batch: list[tuple[dict, list]] = []
+        self._actor_reg_lock = threading.Lock()
+        self._actor_reg_task: asyncio.Task | None = None
         self.functions: dict[str, Any] = {}
         self._exported: set[str] = set()
         # id(fn) -> (fid, weakref) — see export_function.
@@ -311,11 +321,14 @@ class CoreWorker:
         from ray_tpu._private.config import tune_gc
 
         tune_gc(framework_process=(self.mode != "driver"))
-        if self.store_name:
+        if self.store_name and os.environ.get(
+                "RAY_TPU_ARENA_WARM", "1") not in ("0", "false"):
             # Map + write-prefault the arena off the hot path: the lazy
             # first-use open costs ~250ms for a 512MB arena
             # (MADV_POPULATE_WRITE), which would land inside the first
-            # big put otherwise.
+            # big put otherwise.  Kill switch RAY_TPU_ARENA_WARM=0: a
+            # boot storm of short-lived actors pays PTE population ×
+            # every worker for puts that never come.
             threading.Thread(target=self.warm_arena, daemon=True,
                              name="raytpu-arena-warm").start()
 
@@ -438,7 +451,13 @@ class CoreWorker:
         set_release_hook(None)
         # Flush fire-and-forget notifications first: a remove_pg posted
         # just before exit must reach the wire or its reservation leaks
-        # cluster-wide (nobody else reaps this driver's PGs).
+        # cluster-wide (nobody else reaps this driver's PGs).  Batched
+        # actor registrations too — a detached actor created right
+        # before exit must reach the controller.
+        try:
+            self.run(self._actor_regs_settled(), timeout=3.0)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
         try:
             self.run(self._drain_nowait(), timeout=3.0)
         except Exception:  # noqa: BLE001 - teardown best-effort
@@ -1325,13 +1344,26 @@ class CoreWorker:
                 if not self._arena_tried:
                     if self.store_name:
                         try:
-                            from ray_tpu._private.native_store import Arena
+                            from ray_tpu._private import native_store
 
-                            self._arena = Arena(
-                                self.store_name,
-                                stream_min=self.config.put_stream_min_bytes,
-                                parallel_min=(
-                                    self.config.put_parallel_min_bytes))
+                            # A zygote-forked worker inherits the pre-
+                            # warmed mapping (PTEs populated pre-fork):
+                            # reuse it instead of re-mapping + re-
+                            # prefaulting 512MB per process.
+                            arena = native_store.take_prefork_arena(
+                                self.store_name)
+                            if arena is not None:
+                                arena.retune(
+                                    self.config.put_stream_min_bytes,
+                                    self.config.put_parallel_min_bytes)
+                            else:
+                                arena = native_store.Arena(
+                                    self.store_name,
+                                    stream_min=(
+                                        self.config.put_stream_min_bytes),
+                                    parallel_min=(
+                                        self.config.put_parallel_min_bytes))
+                            self._arena = arena
                         except Exception as e:  # noqa: BLE001 - RPC fallback
                             self._arena = None
                             self._note_arena_fallback(
@@ -1347,6 +1379,11 @@ class CoreWorker:
         pays a write-protect fault per page on its first bulk put."""
         arena = self.local_arena()
         if arena is None:
+            return
+        if getattr(arena, "prewarmed", False):
+            # Zygote-inherited mapping: PTEs were populated pre-fork —
+            # a second claim/touch pass would only contend the arena
+            # mutex with 23 sibling workers doing the same no-op.
             return
         for attempt in range(3):
             try:
@@ -3626,6 +3663,11 @@ class CoreWorker:
         return st.address
 
     async def _do_resolve(self, st: ActorSubmitState) -> None:
+        # Never overtake our own (batched, possibly still queued)
+        # registration: UNKNOWN from the controller reads as dead.
+        await self._actor_regs_settled()
+        if st.dead:
+            return          # registration flush failed; cause is set
         reply, _ = await self.clients.get(self.controller_addr).call(
             "get_actor_info",
             {"actor_id": st.actor_id, "wait": True, "timeout": 120.0},
@@ -3640,6 +3682,12 @@ class CoreWorker:
             st.death_cause = reply.get("cause") or reply.get("state", "")
 
     async def _on_actor_event(self, _topic: str, payload: dict) -> None:
+        if payload.get("batch"):
+            # A scheduler wave publishes its whole ALIVE storm as ONE
+            # message (controller._run_actor_wave).
+            for ev in payload["batch"]:
+                await self._on_actor_event(_topic, ev)
+            return
         actor_id = payload.get("actor_id", "")
         ev = payload.get("event")
         if ev == "dead":
@@ -3696,22 +3744,37 @@ class CoreWorker:
                 options["concurrency_groups"])
             header["method_groups"] = dict(
                 options.get("method_groups") or {})
+        waves = os.environ.get("RAY_TPU_ACTOR_WAVES", "1") \
+            not in ("0", "false")
+        reg = {"actor_id": actor_id, "creation_header": header,
+               "owner_addr": self.address, "resources": resources,
+               "max_restarts": options.get("max_restarts", 0),
+               "name": options.get("name"),
+               "namespace": options.get("namespace", self.namespace),
+               "get_if_exists": options.get("get_if_exists", False),
+               "detached": options.get("lifetime") == "detached",
+               "pg_id": options.get("pg_id"),
+               "bundle_index": options.get("bundle_index", -1),
+               "affinity_node_id": options.get("affinity_node_id"),
+               "label_hard": options.get("label_hard"),
+               "label_soft": options.get("label_soft"),
+               "affinity_soft": options.get("affinity_soft", False),
+               "wave": waves}
+        if waves and not reg["name"]:
+            # Burst fusion: an UNNAMED actor's registration reply is
+            # fully determined client-side (the id is ours; there is no
+            # name-taken outcome), so don't pay one controller RT per
+            # actor — enqueue, let the loop-side flusher coalesce the
+            # burst into ONE create_actors RT, and return immediately.
+            # Later RPCs naming the actor gate on _actor_regs_settled so
+            # they can never overtake the registration.
+            if creation_borrows:
+                self.actor_creation_borrows[actor_id] = creation_borrows
+            self._enqueue_actor_registration(reg, blobs)
+            return actor_id, False
         try:
             reply, _ = self.call(
-                self.controller_addr, "create_actor",
-                {"actor_id": actor_id, "creation_header": header,
-                 "owner_addr": self.address, "resources": resources,
-                 "max_restarts": options.get("max_restarts", 0),
-                 "name": options.get("name"),
-                 "namespace": options.get("namespace", self.namespace),
-                 "get_if_exists": options.get("get_if_exists", False),
-                 "detached": options.get("lifetime") == "detached",
-                 "pg_id": options.get("pg_id"),
-                 "bundle_index": options.get("bundle_index", -1),
-                 "affinity_node_id": options.get("affinity_node_id"),
-                 "label_hard": options.get("label_hard"),
-                 "label_soft": options.get("label_soft"),
-                 "affinity_soft": options.get("affinity_soft", False)},
+                self.controller_addr, "create_actor", reg,
                 blobs, timeout=120.0)
             if reply.get("error"):
                 raise ValueError(reply["error"])
@@ -3737,9 +3800,72 @@ class CoreWorker:
         for oid, owner in self.actor_creation_borrows.pop(actor_id, ()):
             self._release_borrow(oid, owner)
 
+    # ----------------------- batched actor registration (wave fusion)
+    def _enqueue_actor_registration(self, reg: dict, blobs: list) -> None:
+        with self._actor_reg_lock:
+            self._actor_reg_batch.append((reg, blobs))
+        self._post_to_loop(self._ensure_actor_reg_flusher)
+
+    def _ensure_actor_reg_flusher(self) -> None:
+        """Loop-side: make sure a flusher task is draining the batch."""
+        if self._actor_reg_task is None or self._actor_reg_task.done():
+            self._actor_reg_task = self.loop.create_task(
+                self._flush_actor_regs())
+
+    async def _flush_actor_regs(self) -> None:
+        """Drain enqueued registrations, ONE create_actors RPC per drain.
+        Registrations arriving while a flush RPC is in flight pile up
+        and ride the next drain — burst size tracks controller latency
+        automatically (the call_and_wait fusion shape)."""
+        while True:
+            with self._actor_reg_lock:
+                batch, self._actor_reg_batch = self._actor_reg_batch, []
+            if not batch:
+                return
+            t0 = time.time()
+            header = {"actors": [dict(reg, nblobs=len(blobs))
+                                 for reg, blobs in batch]}
+            frames = [f for _reg, blobs in batch for f in blobs]
+            try:
+                await self.clients.get(self.controller_addr).call(
+                    "create_actors", header, frames, timeout=120.0)
+            except Exception as e:  # noqa: BLE001
+                # The registrations never reached the controller: fail
+                # the handles fast (resolvers see dead, not a 120s park)
+                # and drop the creation-arg pins.
+                logger.warning("batched actor registration failed: %r", e)
+                for reg, _blobs in batch:
+                    st = self._actor_state(reg["actor_id"])
+                    st.dead = True
+                    st.death_cause = f"actor registration failed: {e!r}"
+                    self._release_creation_borrows(reg["actor_id"])
+            spans.emit("actor.submit", t0, attrs={"count": len(batch)})
+
+    async def _actor_regs_settled(self) -> None:
+        """Wait until every enqueued registration has been flushed: an
+        RPC naming the actor (resolve, kill) must never overtake its own
+        registration on the controller connection."""
+        while True:
+            t = self._actor_reg_task
+            if t is not None and not t.done():
+                await asyncio.shield(t)
+                continue
+            with self._actor_reg_lock:
+                if not self._actor_reg_batch:
+                    return
+            self._ensure_actor_reg_flusher()
+
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
-        self.call(self.controller_addr, "remove_actor",
-                  {"actor_id": actor_id}, timeout=30.0)
+        async def _kill():
+            # Bounded settle: the ordering guard must not chain the
+            # flusher's full RPC timeout in front of the kill — with an
+            # unreachable controller the remove fails anyway, and a
+            # remove racing an undelivered registration is a no-op.
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._actor_regs_settled(), 30.0)
+            return await self.acall(self.controller_addr, "remove_actor",
+                                    {"actor_id": actor_id}, timeout=30.0)
+        self.run(_kill())
         st = self.actor_states.get(actor_id)
         if st:
             st.dead = True
@@ -3755,10 +3881,13 @@ class CoreWorker:
             return
 
         def _go():
-            loop.create_task(self.acall(
-                self.controller_addr, "remove_actor",
-                {"actor_id": actor_id, "cause": "handle out of scope"},
-                timeout=30.0))
+            async def _run():
+                await self._actor_regs_settled()
+                await self.acall(
+                    self.controller_addr, "remove_actor",
+                    {"actor_id": actor_id, "cause": "handle out of scope"},
+                    timeout=30.0)
+            loop.create_task(_run())
             self._release_creation_borrows(actor_id)
         try:
             loop.call_soon_threadsafe(_go)

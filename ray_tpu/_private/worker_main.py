@@ -69,6 +69,15 @@ def _pin_jax_platform() -> None:
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
+    import sys
+
+    if "jax" not in sys.modules:
+        # jax is not loaded (no pre-importing sitecustomize on this
+        # image, and the zygote deliberately keeps jax out of the warm
+        # graph): the env var itself governs the platform whenever jax
+        # IS first imported — paying the ~0.5s import here just to call
+        # config.update was the dominant per-worker boot cost.
+        return
     try:
         import jax
 
@@ -78,12 +87,26 @@ def _pin_jax_platform() -> None:
 
 
 def main() -> None:
+    import time as _time
+    _boot_t0 = _time.monotonic()
+    _trace = os.environ.get("RAY_TPU_BOOT_TRACE")
+
+    def _mark(phase: str) -> None:
+        if _trace:
+            print(f"BOOT {os.getpid()} {phase} "
+                  f"{(_time.monotonic() - _boot_t0) * 1000:.1f}ms",
+                  flush=True)
+
+    _mark("enter")
     from ray_tpu._private.stack_dump import install as _install_stack
 
     _install_stack('worker')
+    _mark("stack")
     _pin_jax_platform()
+    _mark("jaxpin")
     _watch_parent()
     _extend_sys_path()
+    _mark("pre")
     # `kill -USR1 <pid>` dumps all thread stacks to stderr — the per-process
     # half of the `ray stack` debugging story (ray: py-spy attach).
     import faulthandler
@@ -98,6 +121,7 @@ def main() -> None:
     from ray_tpu._private.config import Config
     from ray_tpu._private.worker import CoreWorker, set_global_worker
 
+    _mark("imports")
     config = Config().override(None)
     core = CoreWorker(
         mode="worker",
@@ -115,7 +139,9 @@ def main() -> None:
     # it after start() left a window where that raised "not initialized"
     # (seen as a flaky test_handle_passing under heavy box load).
     set_global_worker(core)
+    _mark("core_init")
     core.start()
+    _mark("started")
     try:
         core._shutdown.wait()
     except KeyboardInterrupt:

@@ -253,9 +253,21 @@ def main() -> None:
     agent_pid = os.getppid()
 
     # Pre-warm: pay the import bill once, fork it for free afterwards.
-    # Imports only — no backend init, no sockets, no threads.
+    # Imports only — no backend init, no sockets, no threads.  NOT jax:
+    # eagerly importing it here taxed EVERY agent boot ~2s (each test
+    # cluster pays it), while plain workers don't import jax at boot at
+    # all anymore (worker_main._pin_jax_platform defers to the env var
+    # when jax isn't loaded) — a child only pays the import when its
+    # actor actually uses jax.
     import ray_tpu._private.worker_main  # noqa: F401
     import ray_tpu._private.worker  # noqa: F401
+    # Pre-freeze the warmed import graph: children inherit the permanent
+    # generation, so their own tune_gc() collect walks only post-fork
+    # objects.
+    import gc
+
+    gc.collect()
+    gc.freeze()
 
     listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     try:
@@ -305,6 +317,19 @@ def main() -> None:
             req = _recv(conn)
             if req is None:
                 os._exit(0)             # agent closed the socket
+            store = req["env"].get("RAY_TPU_STORE_NAME")
+            if store:
+                # Pre-fork arena warm: map + prefault the node store ONCE
+                # here so every child inherits the populated mapping
+                # (native_store.preheat_for_fork; fork carries VMAs and
+                # PTEs along).  Best-effort — children fall back to their
+                # own lazy map.
+                try:
+                    from ray_tpu._private import native_store
+
+                    native_store.preheat_for_fork(store)
+                except Exception:  # noqa: BLE001
+                    pass
             pid = os.fork()
             if pid == 0:
                 _child_enter(req, [conn.fileno(), listener.fileno(),

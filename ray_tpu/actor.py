@@ -167,7 +167,6 @@ class ActorClass:
     def _remote(self, args: tuple, kwargs: dict, opts: dict) -> ActorHandle:
         from ray_tpu import client as client_mod
         from ray_tpu._private.worker import global_worker
-        from ray_tpu.remote_function import _wait_pg_ready
 
         if client_mod._ctx is not None:
             return client_mod._ctx.create_actor(self._cls, args, kwargs,
@@ -184,8 +183,10 @@ class ActorClass:
                 if getattr(m, "__ray_tpu_method_opts__", {}).get(
                     "concurrency_group")}
         core = global_worker()
-        if "pg_id" in options:
-            _wait_pg_ready(core, options["pg_id"])
+        # Unlike tasks, actors never block the driver on PG readiness:
+        # the controller parks a PG-targeted actor on the group's
+        # CREATED transition and places it the moment the reservation
+        # lands (a REMOVED group fails the actor with a clear cause).
         actor_id, existing = core.create_actor(self._cls, args, kwargs,
                                                options)
         # The creating handle owns the actor's lifetime unless the actor

@@ -1,0 +1,397 @@
+"""Wave-batched actor control plane (round 18).
+
+Parity: the batched path (driver create_actors fusion → controller
+scheduler wave → agent bulk create_actors) must behave byte-identically
+to the legacy per-actor path — names, get_if_exists, resource refusals
+(partial grants), PG-targeted actors — with RAY_TPU_ACTOR_WAVES=0
+restoring the legacy chain for same-run A/B.
+
+Event-driven scheduling: infeasible actors park on capacity signals
+(never a blind backoff poll), PG-targeted actors park on the group's
+CREATED/REMOVED transition, and DEAD actors are tombstone-GC'd so
+10k-actor churn cannot grow the controller resident set.
+
+Chaos: an agent SIGKILLed mid-wave (agent.create_actors=crash) must
+reschedule every actor of the wave on survivors with zero leaked leases
+and zero dead-process arena pins.
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.utils import state as rt_state
+
+
+def _core():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker()
+
+
+def _actor_states(namefilter=None):
+    states = {}
+    for a in rt_state.list_actors():
+        if namefilter is None or (a.get("name") or "").startswith(namefilter):
+            states[a["actor_id"]] = a["state"]
+    return states
+
+
+# ------------------------------------------------------------- parity
+def test_wave_burst_parity(ray_shared):
+    """A burst of unnamed actors through the batched path: every actor
+    runs, state is isolated, ids are unique."""
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, base):
+            self.v = base
+
+        def get(self):
+            return self.v
+
+    actors = [Holder.options(num_cpus=0.125).remote(i) for i in range(10)]
+    assert len({a.actor_id for a in actors}) == 10
+    vals = ray_tpu.get([a.get.remote() for a in actors], timeout=140.0)
+    assert vals == list(range(10))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_wave_named_and_get_if_exists(ray_shared):
+    """Named actors stay on the synchronous registration path: the
+    name-taken error and get_if_exists dedup both still work under
+    waves."""
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    a = Svc.options(name="wave_svc", num_cpus=0.125).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120.0) == "pong"
+    with pytest.raises(ValueError):
+        Svc.options(name="wave_svc", num_cpus=0.125).remote()
+    b = Svc.options(name="wave_svc", num_cpus=0.125,
+                    get_if_exists=True).remote()
+    assert b.actor_id == a.actor_id
+    ray_tpu.kill(a)
+
+
+def test_wave_kill_switch_legacy_parity(ray_shared):
+    """RAY_TPU_ACTOR_WAVES=0 (read per creation) restores the legacy
+    per-actor chain — driver sync registration, controller per-actor
+    scheduling — and bursts still come up correctly."""
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, base):
+            self.v = base
+
+        def get(self):
+            return self.v
+
+    os.environ["RAY_TPU_ACTOR_WAVES"] = "0"
+    try:
+        actors = [Holder.options(num_cpus=0.125).remote(i)
+                  for i in range(6)]
+        vals = ray_tpu.get([a.get.remote() for a in actors], timeout=140.0)
+        assert vals == list(range(6))
+    finally:
+        os.environ.pop("RAY_TPU_ACTOR_WAVES", None)
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_immediate_kill_never_overtakes_registration(ray_shared):
+    """kill() right after a batched create must not overtake the
+    in-flight registration (remove-before-register would leak a live
+    worker with a DEAD controller entry)."""
+    ray_tpu = ray_shared
+
+    @ray_tpu.remote
+    class Quick:
+        def ping(self):
+            return 1
+
+    actors = [Quick.options(num_cpus=0.125).remote() for _ in range(4)]
+    for a in actors:
+        ray_tpu.kill(a)
+    ids = {a.actor_id for a in actors}
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        listed = {a["actor_id"]: a["state"] for a in rt_state.list_actors()
+                  if a["actor_id"] in ids}
+        if listed and all(s == "DEAD" for s in listed.values()):
+            break
+        time.sleep(0.5)
+    # Every killed actor the controller still lists must be DEAD (some
+    # may already be tombstone-GC'd, which is fine too).
+    for aid, state in listed.items():
+        assert state == "DEAD", (aid, state)
+
+
+# ---------------------------------------------- partial grants / parking
+def test_partial_grant_reschedules_refused_actors():
+    """4 one-CPU actors against a 2-CPU node: one wave grants 2, parks
+    2; killing the granted pair frees capacity and the parked pair is
+    placed by the capacity signal (no blind poll)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(1)
+
+        @ray_tpu.remote(num_cpus=1)
+        class Unit:
+            def ping(self):
+                return os.getpid()
+
+        actors = [Unit.remote() for _ in range(4)]
+        refs = [a.ping.remote() for a in actors]
+        ready, pending = ray_tpu.wait(refs, num_returns=2, timeout=120.0)
+        assert len(ready) == 2
+        # The two others are genuinely parked, not failed.
+        time.sleep(0.5)
+        states = set(_actor_states().values())
+        assert "PENDING" in states and "ALIVE" in states, states
+        placed = [a for a, r in zip(actors, refs) if r in ready]
+        for a in placed:
+            ray_tpu.kill(a)
+        rest = [r for r in refs if r not in ready]
+        assert len(ray_tpu.get(rest, timeout=120.0)) == 2
+        for a, r in zip(actors, refs):
+            if r not in ready:
+                ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_actor_on_pending_pg_parks_places_or_fails():
+    """Actors targeting PENDING placement groups park on the group's
+    transition (satellite fix: no sleep-spin, no driver-side block):
+    a group that becomes feasible places its actor; a group that is
+    REMOVED while pending fails its actor with a diagnostic cause."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        from ray_tpu.utils.placement_group import (placement_group,
+                                                   remove_placement_group)
+
+        cluster.wait_for_nodes(1)
+        pg1 = placement_group([{"CPU": 3}])      # infeasible on 2 CPUs
+        pg2 = placement_group([{"CPU": 99}])     # never feasible
+        assert pg1.ready(timeout=3) is False
+
+        @ray_tpu.remote(num_cpus=1)
+        class InPg:
+            def ping(self):
+                return "placed"
+
+        a1 = InPg.options(placement_group=pg1).remote()
+        a2 = InPg.options(placement_group=pg2).remote()
+        time.sleep(0.8)
+        assert set(_actor_states().values()) == {"PENDING"}
+        cluster.add_node(resources={"CPU": 4})
+        assert pg1.ready(timeout=60), "PG never became ready after join"
+        assert ray_tpu.get(a1.ping.remote(), timeout=120.0) == "placed"
+        # Removing the still-PENDING group fails its parked actor.
+        remove_placement_group(pg2)
+        core = _core()
+        deadline = time.monotonic() + 30
+        state = cause = None
+        while time.monotonic() < deadline:
+            reply, _ = core.call(core.controller_addr, "get_actor_info",
+                                 {"actor_id": a2.actor_id}, timeout=10.0)
+            state, cause = reply.get("state"), reply.get("cause")
+            if state == "DEAD":
+                break
+            time.sleep(0.5)
+        assert state == "DEAD", state
+        assert "placement group" in (cause or ""), cause
+        ray_tpu.kill(a1)
+        remove_placement_group(pg1)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# --------------------------------------------------- tombstones / nodes
+def test_dead_actor_tombstone_gc():
+    """DEAD actors keep death_cause visible for the grace window, then
+    drop from the controller tables — churn cannot grow the resident
+    set without bound."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(config_json='{"actor_tombstone_grace_s": 1.0}')
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(1)
+
+        @ray_tpu.remote(num_cpus=0.25)
+        class Brief:
+            def ping(self):
+                return 1
+
+        a = Brief.options(name="brief").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=120.0) == 1
+        aid = a.actor_id
+        ray_tpu.kill(a)
+        core = _core()
+        # Within the grace window the tombstone (with cause) is visible.
+        reply, _ = core.call(core.controller_addr, "get_actor_info",
+                             {"actor_id": aid}, timeout=10.0)
+        assert reply["state"] == "DEAD"
+        # After the grace window the entry is GONE (UNKNOWN), and the
+        # name table entry with it.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            reply, _ = core.call(core.controller_addr, "get_actor_info",
+                                 {"actor_id": aid}, timeout=10.0)
+            if reply["state"] == "UNKNOWN":
+                break
+            time.sleep(0.5)
+        assert reply["state"] == "UNKNOWN", reply
+        assert all(x["actor_id"] != aid for x in rt_state.list_actors())
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_unregister_node_membership_leave(ray_shared):
+    """Graceful membership leave: the node disappears from the view
+    (popped, not tombstoned) and its events fan out like a death."""
+    core = _core()
+    reply, _ = core.call(core.controller_addr, "register_node",
+                         {"node_id": "ghost01",
+                          "agent_addr": "127.0.0.1:1",
+                          "resources": {"CPU": 0.0}}, timeout=10.0)
+    assert "pub_addr" in reply
+    assert any(n["node_id"] == "ghost01" for n in ray_tpu.nodes())
+    reply, _ = core.call(core.controller_addr, "unregister_node",
+                         {"node_id": "ghost01"}, timeout=10.0)
+    assert reply["ok"]
+    assert all(n["node_id"] != "ghost01" for n in ray_tpu.nodes())
+    # Idempotent: a second leave is a clean no-op.
+    reply, _ = core.call(core.controller_addr, "unregister_node",
+                         {"node_id": "ghost01"}, timeout=10.0)
+    assert not reply["ok"]
+
+
+# ------------------------------------------------------------- chaos
+@pytest.mark.chaos
+def test_wave_error_failpoint_retries():
+    """controller.actor_wave=nth:1+error: the first dispatch aborts
+    before any agent RPC; the wave scheduler re-queues and the actor
+    comes up on the next wave (one-shot site, counters prove it)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(1)
+        core = _core()
+        reply, _ = core.call(
+            core.controller_addr, "failpoints",
+            {"op": "set", "spec": "controller.actor_wave=nth:1+error"},
+            timeout=10.0)
+        assert reply["armed"]
+
+        @ray_tpu.remote(num_cpus=0.25)
+        class Sturdy:
+            def ping(self):
+                return "up"
+
+        a = Sturdy.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=120.0) == "up"
+        reply, _ = core.call(core.controller_addr, "failpoints",
+                             {"op": "counters"}, timeout=10.0)
+        assert reply["counters"]["controller.actor_wave"]["fired"] == 1
+        ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_agent_crash_mid_wave_reschedules_on_survivors():
+    """agent.create_actors=nth:1+crash on node 2: the agent SIGKILLs
+    with a wave in flight.  Every actor of the dead node's sub-wave
+    must reschedule on the survivor — zero leaked leases (survivor
+    capacity returns to full after the kills), zero dead-process arena
+    pins."""
+    from test_chaos_adversarial import _arena_pins_settle
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.start_head()
+    n1 = cluster.add_node(resources={"CPU": 2})
+    n2 = cluster.add_node(resources={"CPU": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(2)
+        core = _core()
+        reply, _ = core.call(
+            n2["agent_addr"], "failpoints",
+            {"op": "set", "spec": "agent.create_actors=nth:1+crash"},
+            timeout=10.0)
+        assert reply["armed"]
+
+        @ray_tpu.remote(num_cpus=0.25)
+        class Survivor:
+            def where(self):
+                return os.environ.get("RAY_TPU_NODE_ID", "")
+
+        # 8 × 0.25 CPU: the hybrid policy spreads the wave over both
+        # nodes once node 1 crosses the 0.5 utilization threshold, so
+        # node 2's sub-wave is non-empty and dies with the agent.
+        actors = [Survivor.remote() for _ in range(8)]
+        homes = ray_tpu.get([a.where.remote() for a in actors],
+                            timeout=140.0)
+        assert len(homes) == 8
+        # Everyone rescheduled onto the survivor.
+        assert set(homes) == {n1["node_id"]}, set(homes)
+        # The dead node is eventually observed dead.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+            if states.get(n2["node_id"]) != "ALIVE":
+                break
+            time.sleep(0.5)
+        assert states.get(n2["node_id"]) != "ALIVE"
+        for a in actors:
+            ray_tpu.kill(a)
+        # Zero leaked leases: node 1's full capacity comes back.
+        deadline = time.monotonic() + 30
+        avail = None
+        while time.monotonic() < deadline:
+            reply, _ = core.call(n1["agent_addr"], "ping", {},
+                                 timeout=10.0)
+            avail = reply["available"].get("CPU")
+            if avail == 2.0 and not reply["active_leases"]:
+                break
+            time.sleep(0.5)
+        assert avail == 2.0, f"leaked actor leases: CPU avail={avail}"
+        stats = _arena_pins_settle()
+        assert not stats.get("swept_dead_pins", 0), stats
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
