@@ -41,7 +41,8 @@ def __getattr__(name):
     # ray.autoscaler / ray.client are importable off the top level).
     if name in ("autoscaler", "client", "data", "train", "tune", "serve",
                 "rl", "workflow", "dag", "experimental", "utils",
-                "cluster_utils", "failpoints", "tracing"):
+                "cluster_utils", "failpoints", "tracing", "telemetry",
+                "memledger"):
         import importlib
 
         return importlib.import_module(f"ray_tpu.{name}")

@@ -1409,27 +1409,96 @@ class Controller:
                 *(_one(n) for n in alive)))
         return local
 
+    async def _harvest_agent_fanout(self, verb: str, h: dict) -> dict:
+        """Fan a harvest verb out to every ALIVE agent (each of which
+        fans to its workers) — the failpoints-verb shape shared by the
+        spans/telemetry/memory verbs: a wedged agent costs ONE bounded
+        timeout, concurrently, and its hole surfaces as {"error"}."""
+        alive = [n for n in list(self.nodes.values())
+                 if n.state == "ALIVE"]
+
+        async def _one(node):
+            try:
+                reply, _ = await self.clients.get(node.agent_addr).call(
+                    verb, h, timeout=15.0)
+                return node.node_id, reply
+            except Exception as e:  # noqa: BLE001 - node churning
+                return node.node_id, {"error": repr(e)}
+
+        return dict(await asyncio.gather(*(_one(n) for n in alive)))
+
+    async def _harvest_driver_fanout(self, verb: str,
+                                     sub: dict) -> dict:
+        """Fan a harvest verb out to every RUNNING job driver.  Job
+        DRIVERS are workers no agent supervises, yet they hold harvest
+        state like any worker — objects they own, the span that ROOTS
+        every serve request they submitted, the metric series of a
+        driver-resident engine; without this leg an external observer
+        (`ray-tpu memory/slow/top` attaching as its own driver) reads
+        partial tables and disconnected trees.  A driver that answers
+        neither the verb nor a ping is demoted to UNREACHABLE so stale
+        jobs cost only a short probe on later harvests (clean exits
+        report job_finished and are skipped outright) — and PROMOTED
+        BACK to RUNNING the moment one answers again: a single missed
+        window (stalled IO thread, steal burst) must not hide a live
+        driver's state forever."""
+        async def _drv(jid, j):
+            addr = j["driver_addr"]
+            demoted = j.get("state") == "UNREACHABLE"
+            try:
+                reply, _ = await self.clients.get(addr).call(
+                    verb, sub, timeout=3.0 if demoted else 10.0)
+                if demoted:
+                    j["state"] = "RUNNING"
+                return jid, reply
+            except Exception as e:  # noqa: BLE001
+                if not demoted:
+                    try:
+                        await self.clients.get(addr).call(
+                            "ping", {}, timeout=5.0)
+                        return jid, {"error": repr(e)}
+                    except Exception:  # noqa: BLE001 - driver gone
+                        j["state"] = "UNREACHABLE"
+                return jid, {"error": f"driver unreachable: {e!r}",
+                             "gone": True}
+
+        drivers = [(jid, j) for jid, j in list(self.jobs.items())
+                   if j.get("state") in ("RUNNING", "UNREACHABLE")
+                   and j.get("driver_addr")]
+        return dict(await asyncio.gather(
+            *(_drv(jid, j) for jid, j in drivers)))
+
     async def rpc_spans(self, h: dict, _b: list) -> dict:
         """Cluster-wide flight-recorder harvest: this controller's span
         buffer and, with broadcast=True, every ALIVE agent's (each of
-        which fans out to its workers) — the failpoints-verb fan-out
-        shape, so a wedged agent costs ONE bounded timeout."""
-        local = spans.control(
-            {k: v for k, v in h.items() if k != "broadcast"})
+        which fans out to its workers) plus every RUNNING job driver's
+        (drivers hold the spans that ROOT serve requests) — the
+        failpoints-verb fan-out shape, so a wedged agent costs ONE
+        bounded timeout."""
+        sub = {k: v for k, v in h.items() if k != "broadcast"}
+        local = spans.control(sub)
         if h.get("broadcast"):
-            alive = [n for n in list(self.nodes.values())
-                     if n.state == "ALIVE"]
+            local["nodes"], local["drivers"] = await asyncio.gather(
+                self._harvest_agent_fanout("spans", h),
+                self._harvest_driver_fanout("spans", sub))
+        return local
 
-            async def _one(node):
-                try:
-                    reply, _ = await self.clients.get(node.agent_addr).call(
-                        "spans", h, timeout=15.0)
-                    return node.node_id, reply
-                except Exception as e:  # noqa: BLE001 - node churning
-                    return node.node_id, {"error": repr(e)}
+    async def rpc_telemetry(self, h: dict, _b: list) -> dict:
+        """Cluster-wide telemetry-timeline harvest: this controller's
+        metrics-snapshot ring and, with broadcast=True, every ALIVE
+        agent's (each of which fans out to its workers) — the
+        spans-verb fan-out shape, so a wedged agent costs ONE bounded
+        timeout and the merged timeline degrades to
+        partial-with-diagnostic.  RUNNING job drivers join the fan-out
+        (a driver-resident engine's series live nowhere else)."""
+        from ray_tpu._private import telemetry
 
-            local["nodes"] = dict(await asyncio.gather(
-                *(_one(n) for n in alive)))
+        sub = {k: v for k, v in h.items() if k != "broadcast"}
+        local = telemetry.control(sub)
+        if h.get("broadcast"):
+            local["nodes"], local["drivers"] = await asyncio.gather(
+                self._harvest_agent_fanout("telemetry", h),
+                self._harvest_driver_fanout("telemetry", sub))
         return local
 
     async def rpc_memory(self, h: dict, _b: list) -> dict:
@@ -1442,56 +1511,11 @@ class Controller:
         sub = {k: v for k, v in h.items() if k != "broadcast"}
         local = memledger.control(sub)
         if h.get("broadcast"):
-            alive = [n for n in list(self.nodes.values())
-                     if n.state == "ALIVE"]
-
-            async def _one(node):
-                try:
-                    reply, _ = await self.clients.get(node.agent_addr).call(
-                        "memory", h, timeout=15.0)
-                    return node.node_id, reply
-                except Exception as e:  # noqa: BLE001 - node churning
-                    return node.node_id, {"error": repr(e)}
-
-            # Job DRIVERS are workers no agent supervises, yet they own
-            # objects like any worker — without this leg an external
-            # observer (the `ray memory` CLI attaching as its own
-            # driver) would see every other driver's objects as
-            # unowned.  A driver that answers neither memory nor a ping
-            # is demoted to UNREACHABLE so stale jobs cost only a short
-            # probe on later harvests (clean exits report job_finished
-            # and are skipped outright) — and PROMOTED BACK to RUNNING
-            # the moment one answers again: a single missed window
-            # (stalled IO thread, steal burst) must not hide a live
-            # driver's ownership forever.
-            async def _drv(jid, j):
-                addr = j["driver_addr"]
-                demoted = j.get("state") == "UNREACHABLE"
-                try:
-                    reply, _ = await self.clients.get(addr).call(
-                        "memory", sub, timeout=3.0 if demoted else 10.0)
-                    if demoted:
-                        j["state"] = "RUNNING"
-                    return jid, reply
-                except Exception as e:  # noqa: BLE001
-                    if not demoted:
-                        try:
-                            await self.clients.get(addr).call(
-                                "ping", {}, timeout=5.0)
-                            return jid, {"error": repr(e)}
-                        except Exception:  # noqa: BLE001 - driver gone
-                            j["state"] = "UNREACHABLE"
-                    return jid, {"error": f"driver unreachable: {e!r}",
-                                 "gone": True}
-
-            drivers = [(jid, j) for jid, j in list(self.jobs.items())
-                       if j.get("state") in ("RUNNING", "UNREACHABLE")
-                       and j.get("driver_addr")]
-            nodes_res, drivers_res = await asyncio.gather(
-                asyncio.gather(*(_one(n) for n in alive)),
-                asyncio.gather(*(_drv(jid, j) for jid, j in drivers)))
-            local["nodes"] = dict(nodes_res)
-            local["drivers"] = dict(drivers_res)
+            # Drivers own objects no agent supervises — see
+            # _harvest_driver_fanout (shared with spans/telemetry).
+            local["nodes"], local["drivers"] = await asyncio.gather(
+                self._harvest_agent_fanout("memory", h),
+                self._harvest_driver_fanout("memory", sub))
         return local
 
     async def rpc_ping(self, h: dict, _b: list) -> dict:

@@ -1341,6 +1341,38 @@ class NodeAgent:
                 *(_one(w) for w in live)))
         return local
 
+    async def rpc_telemetry(self, h: dict, _b: list) -> dict:
+        """Telemetry-timeline harvest verb: THIS agent's
+        metrics-snapshot ring and, with broadcast=True, every live
+        worker's (the spans/failpoints-verb shape — dead/wedged
+        workers cost one bounded timeout each, concurrently, never a
+        hang)."""
+        from ray_tpu._private import telemetry
+
+        local = telemetry.control(
+            {k: v for k, v in h.items() if k != "broadcast"})
+        # Failpoint window: local ring read, reply/fan-out not yet
+        # sent — a crashed or wedged agent here must degrade the
+        # head-side merge to partial-with-diagnostic, never a hang.
+        if failpoints.ACTIVE:
+            await failpoints.fire_async("telemetry.harvest")
+        if h.get("broadcast"):
+            sub = {k: v for k, v in h.items() if k != "broadcast"}
+            live = [w for w in list(self.workers.values())
+                    if w.addr and w.state not in ("dead", "stopping")]
+
+            async def _one(w):
+                try:
+                    reply, _ = await self.clients.get(w.addr).call(
+                        "telemetry", sub, timeout=10.0)
+                    return w.worker_id, reply
+                except Exception as e:  # noqa: BLE001 - worker churning
+                    return w.worker_id, {"error": repr(e)}
+
+            local["workers"] = dict(await asyncio.gather(
+                *(_one(w) for w in live)))
+        return local
+
     def _leak_scan(self) -> dict:
         """One leak-sentinel pass (memledger.sentinel_scan over this
         node's store): flags arena pins held by dead pids and

@@ -1,0 +1,236 @@
+"""Cluster telemetry timeline: per-process metrics-snapshot ring.
+
+Analog of the reference's metrics-agent → time-series → dashboard
+pipeline (ray: python/ray/_private/metrics_agent.py exporting each
+node's OpenCensus registry to Prometheus, where a scraper keeps the
+history) collapsed into the repo's verb/facade shape: every metric
+surface here was instantaneous — `/metrics` is a point-in-time scrape,
+`stats()` a snapshot — so "what did queue depth look like over the last
+five minutes" had no answer.  This module keeps a fixed-size ring of
+registry snapshots per process (the `utils/metrics.py` flush loop
+already walks the registry every ~2s; recording a sample rides that
+walk), serves the `telemetry` RPC verb body shared by
+worker/agent/controller handlers (the `spans`/`memory` shape), and the
+head merges the rings through the established
+controller→agents→workers broadcast fan-out
+(`ray_tpu.telemetry.harvest`).
+
+Design contract (the flight-recorder cost rules):
+
+- **Always on** (kill switch ``RAY_TPU_TELEMETRY=0``): the one sample
+  site (`record_from_snapshots`, called from the metrics flush loop)
+  is gated on ``ENABLED`` — one module-flag truth test per period when
+  disabled.  Harvest correctness never depends on the switch: a
+  disabled process just reports an empty ring.
+- **Bounded**: ``RAY_TPU_TELEMETRY_SAMPLES`` slots (default 150 ≈ 5
+  minutes at the 2s flush period); oldest samples are overwritten,
+  never flushed synchronously.
+- **Tag-aware**: each sample flattens the registry into
+  ``name{k=v,...}`` series keys, so two engines' same-named gauges
+  stay distinct series and the head-side merge never collapses them.
+- **Histogram totals**: histograms sample as ``<name>_sum{...}`` and
+  ``<name>_count{...}`` series — enough to reconstruct rates and means
+  over any window without shipping the buckets every 2s (the full
+  bucket families still ride the dashboard /metrics exposition).
+
+Clock: samples carry wall time (`time.time()`, the spans basis), so
+rings from different processes merge onto one timeline directly.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+
+ENV_VAR = "RAY_TPU_TELEMETRY"
+SAMPLES_VAR = "RAY_TPU_TELEMETRY_SAMPLES"
+
+
+def _env_on() -> bool:
+    v = os.environ.get(ENV_VAR)
+    if v is None:
+        return True
+    return v not in ("0", "false", "False", "")
+
+
+# Module flag read by the sample site (the failpoints ACTIVE
+# discipline): True unless RAY_TPU_TELEMETRY=0.
+ENABLED = _env_on()
+
+_CAPACITY = max(16, int(os.environ.get(SAMPLES_VAR, "150") or "150"))
+_buf: list = [None] * _CAPACITY
+_cursor = itertools.count()
+_sampled = 0                    # approximate (racy +=); stats only
+_pid = os.getpid()
+# Process identity for harvest dedup (the spans-verb convention): bare
+# pids collide across hosts, boot tokens never do.
+_boot = f"{_pid:x}-{time.time_ns():x}"
+
+
+def set_enabled(on: bool) -> None:
+    """Flip the sampler and mirror the choice into os.environ so
+    processes spawned from here inherit it (same-run A/B: the bench
+    runs one serve leg with the sampler on, one with it off)."""
+    global ENABLED
+    ENABLED = bool(on)
+    os.environ[ENV_VAR] = "1" if on else "0"
+
+
+def series_key(name: str, tags: dict | None) -> str:
+    """Canonical series id: ``name`` or ``name{k=v,k2=v2}`` with keys
+    sorted — process-stable (never `hash()`), so the same metric on two
+    hosts lands in the same merged series."""
+    if not tags:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _flatten(snaps: list[dict]) -> dict[str, float]:
+    """One registry snapshot list (utils.metrics Metric.snapshot dicts)
+    → flat {series_key: value}.  Counters/gauges keep their value;
+    histograms contribute `_sum` and `_count` series."""
+    out: dict[str, float] = {}
+    for m in snaps:
+        name = m.get("name", "?")
+        if m.get("type") == "histogram":
+            for row in m.get("counts", ()):
+                out[series_key(name + "_count", row.get("tags"))] = \
+                    float(sum(row.get("counts", ())))
+            for v in m.get("values", ()):
+                # Histogram snapshot values carry the observation sum.
+                out[series_key(name + "_sum", v.get("tags"))] = \
+                    float(v.get("value", 0.0))
+            continue
+        for v in m.get("values", ()):
+            out[series_key(name, v.get("tags"))] = \
+                float(v.get("value", 0.0))
+    return out
+
+
+def record_from_snapshots(snaps: list[dict]) -> None:
+    """Record one timeline sample from already-taken registry
+    snapshots — the metrics flush loop calls this on its existing walk,
+    so sampling adds no extra registry locking."""
+    global _sampled
+    if not ENABLED:
+        return
+    series = _flatten(snaps)
+    if not series:
+        return
+    i = next(_cursor)
+    _buf[i % _CAPACITY] = {"t": time.time(), "series": series}
+    _sampled = i + 1
+
+
+def sample_now() -> bool:
+    """Force one sample right now (tests and the CLI's first paint —
+    the flush-loop cadence is ~2s).  Returns False when disabled or the
+    registry is empty."""
+    if not ENABLED:
+        return False
+    from ray_tpu.utils import metrics as um
+
+    snaps = um.registry_snapshots()
+    if not snaps:
+        return False
+    record_from_snapshots(snaps)
+    return True
+
+
+def _match(key: str, series: list[str] | None) -> bool:
+    if not series:
+        return True
+    return any(key.startswith(p) for p in series)
+
+
+def snapshot(since: float | None = None,
+             series: list[str] | None = None) -> list[dict]:
+    """Copy the live ring, oldest-first, optionally windowed to
+    samples at/after `since` (wall time) and filtered to series whose
+    key starts with any of the `series` prefixes.  The list() copy is
+    a C-level slice under the GIL — concurrent samples may land or
+    miss, never tear a record."""
+    out = [r for r in list(_buf) if r is not None]
+    out.sort(key=lambda r: r["t"])
+    if since is not None:
+        out = [r for r in out if r["t"] >= since]
+    if series:
+        out = [{"t": r["t"],
+                "series": {k: v for k, v in r["series"].items()
+                           if _match(k, series)}}
+               for r in out]
+        out = [r for r in out if r["series"]]
+    return out
+
+
+def clear() -> None:
+    global _buf, _cursor, _sampled
+    _buf = [None] * _CAPACITY
+    _cursor = itertools.count()
+    _sampled = 0
+
+
+def stats() -> dict:
+    return {"enabled": ENABLED, "capacity": _CAPACITY,
+            "sampled": _sampled,
+            "buffered": sum(1 for r in _buf if r is not None),
+            "dropped": max(0, _sampled - _CAPACITY)}
+
+
+def _proc_label() -> str:
+    from ray_tpu._private import spans
+
+    return spans.proc_label()
+
+
+def control(h: dict) -> dict:
+    """The `telemetry` RPC verb body, shared by worker/agent/controller
+    handlers.  ops: collect (optional `since`/`series` filters;
+    `fresh` forces a sample first so a live view never reads 2s
+    stale), sample, clear, stats, enable (flip the sampler live —
+    same-run A/B)."""
+    op = h.get("op", "collect")
+    if op == "collect":
+        if h.get("fresh"):
+            try:
+                sample_now()
+            except Exception:  # noqa: BLE001 - collect must still reply
+                pass
+        since = h.get("since")
+        series = h.get("series")
+        return {"samples": snapshot(
+                    float(since) if since is not None else None,
+                    list(series) if series else None),
+                "pid": _pid, "boot": _boot, "proc": _proc_label(),
+                **stats()}
+    if op == "sample":
+        ok = sample_now()
+        return {"sampled_now": ok, "pid": _pid, "boot": _boot,
+                "proc": _proc_label(), **stats()}
+    if op == "clear":
+        clear()
+        return {"pid": _pid, "boot": _boot, "proc": _proc_label(),
+                **stats()}
+    if op == "enable":
+        set_enabled(bool(h.get("on", True)))
+        return {"pid": _pid, "boot": _boot, "proc": _proc_label(),
+                **stats()}
+    if op == "stats":
+        return {"pid": _pid, "boot": _boot, "proc": _proc_label(),
+                **stats()}
+    raise ValueError(f"telemetry verb: unknown op {op!r}")
+
+
+def _after_fork_child() -> None:
+    # The ring's contents belong to the parent; the child records its
+    # own samples (re-keyed on the child pid/boot token).
+    global _pid, _boot, _buf, _cursor, _sampled
+    _pid = os.getpid()
+    _boot = f"{_pid:x}-{time.time_ns():x}"
+    _buf = [None] * _CAPACITY
+    _cursor = itertools.count()
+    _sampled = 0
+
+
+os.register_at_fork(after_in_child=_after_fork_child)

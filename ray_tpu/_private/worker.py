@@ -701,13 +701,14 @@ class CoreWorker:
                 rec.local_refs += 1
                 rec.submit_spec = (fid, header, blobs, scheduling_key)
                 rec.retries_left = max(0, retries)
+        fn_name = getattr(fn, "__qualname__",
+                          getattr(fn, "__name__", fid[:12]))
         if memledger.ENABLED:
             # The submitted function IS the callsite that groups task
             # returns in `ray memory` (ray: "(task call) fn" rows).
-            site = "(task) " + getattr(fn, "__qualname__",
-                                       getattr(fn, "__name__", fid[:12]))
             for rid in return_ids:
-                memledger.note_create(rid, "task_return", site)
+                memledger.note_create(rid, "task_return",
+                                      "(task) " + fn_name)
 
         def _go():
             self.memory_entries_for(return_ids)
@@ -716,7 +717,9 @@ class CoreWorker:
         self._post_to_loop(_go)
         # The submitted TASK's trace context (not this process's current
         # one): its span_id/parent_span are what the OTLP bridge pairs.
-        self._record_event(task_id.hex(), "SUBMITTED", fid,
+        # Readable function name, not the fid hash — summarize_tasks
+        # groups (and the timeline labels) by it.
+        self._record_event(task_id.hex(), "SUBMITTED", fn_name,
                            trace=header["trace"])
         return refs
 
@@ -3974,6 +3977,13 @@ class CoreWorker:
         """Object-ledger harvest verb (see _private/memledger): THIS
         process's owner-side reference table + ledger annotations."""
         return memledger.control(h)
+
+    async def rpc_telemetry(self, h: dict, _b: list) -> dict:
+        """Telemetry-timeline harvest verb (see _private/telemetry):
+        THIS process's metrics-snapshot ring."""
+        from ray_tpu._private import telemetry
+
+        return telemetry.control(h)
 
     # ------------------------------------------------------------ telemetry
     def _record_event(self, task_id: str, state: str, name: str = "",

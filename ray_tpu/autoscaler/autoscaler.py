@@ -56,25 +56,33 @@ def request_resources(num_cpus: float = 0, bundles: list | None = None,
               [json.dumps(payload).encode()], timeout=10.0)
 
 
+def demand_floors(core, controller_addr: str) -> dict[str, dict]:
+    """Every requester's posted demand floor, keyed by requester name
+    ("default" for the unscoped key): ONE kv_multiget round trip (the
+    list_metrics discipline — the old per-key kv_get loop paid one RT
+    per requester).  Shared by merged_demand and `ray-tpu status`."""
+    reply, blobs = core.call(controller_addr, "kv_multiget",
+                             {"ns": "autoscaler",
+                              "prefix": REQUEST_KEY}, timeout=10.0)
+    out: dict[str, dict] = {}
+    for key, blob in zip(reply.get("keys", []), blobs):
+        try:
+            payload = json.loads(bytes(blob))
+        except Exception:  # noqa: BLE001 - racing a concurrent post
+            continue
+        requester = key[len(REQUEST_KEY) + 1:] \
+            if key.startswith(REQUEST_KEY + ":") else "default"
+        out[requester] = payload
+    return out
+
+
 def merged_demand(core, controller_addr: str) -> dict:
     """Sum the demand floors of every requester: {num_cpus, bundles}.
     Readers (StandardAutoscaler, autoscaler v2 Reconciler) see one
     aggregate; a requester that posted an empty floor contributes
     nothing."""
-    reply, _ = core.call(controller_addr, "kv_keys",
-                         {"ns": "autoscaler", "prefix": REQUEST_KEY},
-                         timeout=10.0)
     total = {"num_cpus": 0.0, "bundles": []}
-    for key in reply.get("keys", []):
-        try:
-            r, blobs = core.call(controller_addr, "kv_get",
-                                 {"ns": "autoscaler", "key": key},
-                                 timeout=10.0)
-            if not blobs:
-                continue
-            payload = json.loads(bytes(blobs[0]))
-        except Exception:  # noqa: BLE001 - racing a concurrent post
-            continue
+    for payload in demand_floors(core, controller_addr).values():
         total["num_cpus"] += payload.get("num_cpus", 0) or 0
         total["bundles"].extend(payload.get("bundles", []) or [])
     return total

@@ -24,6 +24,8 @@ Routes (reference parity):
   GET  /api/jobs/{id}/logs            job logs
   GET  /metrics                       Prometheus text exposition
   GET  /api/v0/timeline               Chrome trace JSON
+  GET  /api/v0/timeseries             telemetry timeline
+                                      (?series=, ?since=, ?fresh=1)
   GET  /api/healthz  /api/gcs_healthz liveness
   GET  /                              minimal HTML summary
 """
@@ -130,6 +132,7 @@ class DashboardHead:
         r.add_get("/api/v0/objects", self._objects)
         r.add_get("/api/v0/memory", self._memory)
         r.add_get("/api/v0/timeline", self._timeline)
+        r.add_get("/api/v0/timeseries", self._timeseries)
         r.add_get("/api/v0/traces", self._traces)
         r.add_get("/api/v0/worker_messages", self._worker_messages)
         r.add_get("/metrics", self._metrics)
@@ -339,30 +342,86 @@ class DashboardHead:
         events = await self._call(ray_tpu.timeline)
         return _json(events)
 
+    async def _timeseries(self, req):
+        """Cluster telemetry timeline (the `telemetry` verb fan-out
+        merged head-side).  Query params: ?series= comma-separated
+        series-key prefixes (e.g. serve_llm_); ?since= either an
+        absolute unix timestamp or, below 1e6, "last N seconds";
+        ?fresh=1 forces every process to sample before replying."""
+        import time as _time
+
+        from ray_tpu import telemetry
+
+        series = [s for s in
+                  (req.query.get("series") or "").split(",") if s] \
+            or None
+        since_q = req.query.get("since")
+        fresh = req.query.get("fresh") in ("1", "true")
+        try:
+            since = float(since_q) if since_q else None
+        except ValueError:
+            return _json({"error": "since must be a number"},
+                         status=400)
+        if since is not None and since < 1e6:
+            since = _time.time() - since
+
+        def _collect():
+            return telemetry.timeseries(series=series, since=since,
+                                        fresh=fresh)
+        return _json({"result": await self._call(_collect)})
+
     async def _traces(self, req):
         """Flight-recorder harvest (cluster-wide `spans` verb fan-out)
         merged by trace_id.  Query params: ?trace_id= filters to one
         request's tree; ?format=chrome|otlp exports the Chrome-trace /
-        OTLP document shapes (default: the raw merged span list plus
-        per-trace roots)."""
+        OTLP document shapes; ?analyze=1 adds the critical-path
+        decomposition (per-stage p50/p99 attribution + the N worst
+        requests with their blocking chains; ?limit= bounds N;
+        ?match= scopes BOTH to traces whose root span name starts with
+        the prefix — without it, every task/actor execution roots its
+        own trace and control-plane stages drown the serve-request
+        percentages, the same failure `ray-tpu slow --match` guards).
+        The default reply carries harvest `diagnostics` — per-process
+        ring stats whose `dropped` counts mark a wrapped buffer, so a
+        partial tree reads as truncated, never as silently complete."""
         from ray_tpu import tracing
 
         trace_id = req.query.get("trace_id") or None
         fmt = req.query.get("format", "spans")
+        analyze = req.query.get("analyze") in ("1", "true")
+        match = req.query.get("match") or None
+        try:
+            limit = int(req.query.get("limit", "10"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"},
+                         status=400)
 
         def _collect():
-            spans_list = tracing.harvest(trace_id=trace_id)
+            spans_list, diags = tracing.harvest(
+                trace_id=trace_id, with_diagnostics=True)
             if fmt == "chrome":
                 return tracing.chrome_trace(spans_list)
             if fmt == "otlp":
                 return tracing.otlp_document(spans_list)
             trees = tracing.trace_trees(spans_list)
             groups = tracing.traces(spans_list)
-            return {"spans": spans_list,
-                    "traces": {tid: {"roots": len(roots),
-                                     "connected": len(roots) == 1,
-                                     "spans": len(groups.get(tid, ()))}
-                               for tid, roots in trees.items()}}
+            out = {"spans": spans_list,
+                   "diagnostics": diags,
+                   "traces": {tid: {"roots": len(roots),
+                                    "connected": len(roots) == 1,
+                                    "spans": len(groups.get(tid, ()))}
+                              for tid, roots in trees.items()}}
+            if analyze:
+                scoped = trees if not match else {
+                    tid: roots for tid, roots in trees.items()
+                    if len(roots) == 1
+                    and roots[0]["span"]["name"].startswith(match)}
+                out["analysis"] = {
+                    "attribution": tracing.attribution(scoped),
+                    "slowest": tracing.slowest(scoped, n=limit,
+                                               prefix=match),
+                }
+            return out
         return _json(await self._call(_collect))
 
     async def _worker_messages(self, _req):

@@ -119,13 +119,51 @@ def _attach(args):
 
 
 def cmd_status(args) -> None:
-    """ray: `ray status` — node/resource overview."""
+    """ray: `ray status` — node/resource overview, plus the
+    autoscaler's posted demand floors per requester (serve/elastic):
+    "why are we holding N nodes" answerable from the CLI."""
     rt = _attach(args)
     nodes = rt.nodes()
     print(f"{len(nodes)} node(s)")
     for n in nodes:
         print(f"  {n['node_id'][:12]} {n['state']:6} "
               f"resources={n['resources']} available={n['available']}")
+    _print_demand_floors()
+
+
+def _print_demand_floors() -> None:
+    """The request_resources floors each requester posted (the
+    autoscaler v2 reconciler's merged_demand input), per requester and
+    summed — empty floors are skipped.  One kv_multiget round trip
+    (autoscaler.demand_floors, shared with merged_demand)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.autoscaler.autoscaler import demand_floors
+
+    core = global_worker()
+    try:
+        floors = demand_floors(core, core.controller_addr)
+    except Exception as e:  # noqa: BLE001 - head without kv: skip
+        print(f"autoscaler demand: unavailable ({e})")
+        return
+    rows = []
+    total_cpus, total_bundles = 0.0, 0
+    for requester, payload in floors.items():
+        cpus = float(payload.get("num_cpus", 0) or 0)
+        bundles = payload.get("bundles") or []
+        if not cpus and not bundles:
+            continue
+        rows.append((requester, cpus, bundles))
+        total_cpus += cpus
+        total_bundles += len(bundles)
+    if not rows:
+        print("autoscaler demand: no floors posted")
+        return
+    print("autoscaler demand floors (request_resources):")
+    for requester, cpus, bundles in sorted(rows):
+        extra = f" bundles={bundles}" if bundles else ""
+        print(f"  {requester:<12} num_cpus={cpus:g}{extra}")
+    print(f"  merged: num_cpus={total_cpus:g} "
+          f"bundles={total_bundles}")
 
 
 def _fmt_bytes(n: int | float | None) -> str:
@@ -213,6 +251,181 @@ def cmd_memory(args) -> None:
           f"{_fmt_bytes(leaks['arena_orphan_pin_bytes'])} "
           f"unreachable_owner_bytes="
           f"{_fmt_bytes(leaks.get('objects_unreachable_owner_bytes'))}")
+
+
+def _parse_series_key(key: str) -> tuple[str, dict]:
+    """`name{k=v,k2=v2}` → (name, tags) — the telemetry series-key
+    shape (_private/telemetry.series_key)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    tags = {}
+    for part in rest.rstrip("}").split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            tags[k] = v
+    return name, tags
+
+
+def cmd_top(args) -> None:
+    """Live cluster telemetry view over the timeline harvest: per
+    serve deployment/engine req/s, queue depth, cache hit rate; per
+    train gang step time.  --once prints one frame; --json dumps the
+    raw merged timeline instead of the table."""
+    _attach(args)
+    from ray_tpu import telemetry
+
+    prefixes = ["serve_llm_", "serve_replica_", "train_"]
+
+    def frame() -> None:
+        # Bounded window: latest() needs one point and rate() a 30s
+        # window — don't re-ship every process's full 5-minute ring
+        # per screen refresh.
+        doc = telemetry.timeseries(series=prefixes, fresh=True,
+                                   since=time.time() - 60.0)
+        if args.json:
+            print(json.dumps(doc, indent=2, default=str))
+            return
+        # Group series keys per display row; each row can hold SEVERAL
+        # keys per metric (one per replica / rank) — aggregate across
+        # them, and within a key across processes (latest_by_proc):
+        # an N-replica gauge read as one "latest" answers for one
+        # replica of N.
+        engines: dict[str, dict] = {}
+        deployments: dict[str, dict] = {}
+        gangs: dict[str, dict] = {}
+        for key in doc["series"]:
+            name, tags = _parse_series_key(key)
+            if name.startswith("serve_llm_") and "engine" in tags:
+                row = engines.setdefault(tags["engine"], {})
+            elif name.startswith("serve_replica_") and \
+                    "deployment" in tags:
+                label = (f"{tags['app']}/{tags['deployment']}"
+                         if tags.get("app") else tags["deployment"])
+                row = deployments.setdefault(label, {})
+            elif name.startswith("train_") and "trial" in tags:
+                row = gangs.setdefault(tags["trial"], {})
+            else:
+                continue
+            row.setdefault(name, []).append(key)
+
+        def agg_latest(keys: list[str], how: str) -> float | None:
+            vals = [v for k in keys
+                    for v in telemetry.latest_by_proc(doc, k)]
+            if not vals:
+                return None
+            if how == "sum":
+                return sum(vals)
+            if how == "max":
+                return max(vals)
+            return sum(vals) / len(vals)           # mean
+
+        def agg_rate(keys: list[str]) -> float:
+            return sum(telemetry.rate(doc, k) or 0.0 for k in keys)
+
+        print(f"ray-tpu top — {time.strftime('%H:%M:%S')}  "
+              f"({len(doc['procs'])} process(es)"
+              + (", PARTIAL: " + "; ".join(doc["diagnostics"])
+                 if doc["diagnostics"] else "") + ")")
+        if engines:
+            print(f"  {'ENGINE':<20} {'REQ/S':>7} {'QUEUE':>6} "
+                  f"{'HIT%':>6} {'OCCUP':>6}")
+            for eng, row in sorted(engines.items()):
+                rps = agg_rate(row.get("serve_llm_requests_completed",
+                                       []))
+                q = agg_latest(row.get("serve_llm_queue_depth", []),
+                               "sum")
+                hit = agg_latest(row.get("serve_llm_prefix_hit_rate",
+                                         []), "mean")
+                occ = agg_latest(row.get("serve_llm_batch_occupancy",
+                                         []), "mean")
+                print(f"  {eng:<20} {rps:>7.2f} "
+                      f"{int(q) if q is not None else '?':>6} "
+                      f"{100 * hit if hit is not None else 0:>6.1f} "
+                      f"{occ if occ is not None else 0:>6.2f}")
+        if deployments:
+            print(f"  {'DEPLOYMENT':<20} {'REQ/S':>7} {'ONGOING':>8}")
+            for dep, row in sorted(deployments.items()):
+                rps = agg_rate(row.get("serve_replica_processed", []))
+                ong = agg_latest(row.get("serve_replica_ongoing", []),
+                                 "sum")
+                print(f"  {dep:<20} {rps:>7.2f} "
+                      f"{int(ong) if ong is not None else 0:>8}")
+        if gangs:
+            print(f"  {'TRAIN GANG':<20} {'STEP_S':>8} {'STEPS/S':>8}")
+            for trial, row in sorted(gangs.items()):
+                step = agg_latest(row.get("train_step_s", []), "max")
+                nranks = max(1, len(row.get("train_reported_steps",
+                                            [])))
+                sps = agg_rate(row.get("train_reported_steps", [])) \
+                    / nranks
+                print(f"  {trial:<20} "
+                      f"{step if step is not None else 0:>8.3f} "
+                      f"{sps:>8.2f}")
+        if not (engines or deployments or gangs):
+            print("  no serve/train series yet "
+                  "(is RAY_TPU_TELEMETRY=0, or nothing running?)")
+
+    if args.once or args.json:
+        frame()
+        return
+    try:
+        while True:
+            print("\033[2J\033[H", end="")     # clear + home
+            frame()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_slow(args) -> None:
+    """The N worst requests in the flight recorder with their critical
+    paths — "which stage moved p99" from a terminal.  Also prints the
+    aggregate per-stage attribution and the harvest's dropped-span
+    diagnostics (a wrapped ring reads as truncated, never silent)."""
+    _attach(args)
+    from ray_tpu import tracing
+
+    spans, diags = tracing.harvest(with_diagnostics=True)
+    trees = tracing.trace_trees(spans)
+    if args.match:
+        # --match scopes BOTH the worst-N list and the aggregate
+        # attribution — otherwise boot/control-plane traces drown the
+        # request stages in the summary table.
+        trees = {tid: roots for tid, roots in trees.items()
+                 if len(roots) == 1
+                 and roots[0]["span"]["name"].startswith(args.match)}
+    rows = tracing.slowest(trees, n=args.n, prefix=args.match or None)
+    if args.json:
+        print(json.dumps({"slowest": rows,
+                          "attribution": tracing.attribution(trees),
+                          "diagnostics": diags}, indent=2,
+                         default=str))
+        return
+    if not rows:
+        print("no connected traces in the flight recorder"
+              + (f" matching {args.match!r}" if args.match else ""))
+    for i, row in enumerate(rows):
+        print(f"#{i + 1}  {row['name']}  {row['ms']:.1f}ms  "
+              f"trace={row['trace_id']}  [{row['proc']}]")
+        for seg in row["path"]:
+            rel = (seg["t0"] - row["t0"]) * 1000.0
+            print(f"    +{rel:>9.1f}ms {seg['ms']:>9.1f}ms  "
+                  f"{'. ' * seg['depth']}{seg['name']} "
+                  f"[{seg['proc']}]")
+    attr = tracing.attribution(trees)
+    if attr["requests"]:
+        print(f"\nattribution over {attr['requests']} request(s) "
+              f"(total p50={attr['total_ms']['p50']:.1f}ms "
+              f"p99={attr['total_ms']['p99']:.1f}ms):")
+        for name, st in attr["stages"].items():
+            print(f"  {st['share_pct']:>5.1f}%  {name:<28} "
+                  f"p50={st['p50_ms']:.1f}ms p99={st['p99_ms']:.1f}ms "
+                  f"n={st['count']}")
+    if diags["dropped_total"] or diags["errors"]:
+        print(f"\nTRUNCATED harvest: {diags['dropped_total']} span(s) "
+              f"overwritten in per-process rings; "
+              f"{len(diags['errors'])} failed fan-out leg(s)")
 
 
 def cmd_list(args) -> None:
@@ -475,6 +688,27 @@ def main(argv: list[str] | None = None) -> None:
     sp = sub.add_parser("status")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "top", help="live telemetry view (serve req/s, queue depth, "
+                    "hit rate; train step time)")
+    sp.add_argument("--address")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the raw merged timeline")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "slow", help="N worst traced requests with critical paths + "
+                     "per-stage attribution")
+    sp.add_argument("--address")
+    sp.add_argument("-n", type=int, default=5)
+    sp.add_argument("--match", help="filter on root span name prefix "
+                                    "(e.g. serve.)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_slow)
 
     sp = sub.add_parser(
         "memory", help="cluster object table grouped by callsite")

@@ -141,6 +141,10 @@ def _engine_metrics():
                 "occupancy": um.get_or_create(
                     um.Gauge, "serve_llm_batch_occupancy",
                     "Active slots / max_batch", tk),
+                "queue_depth": um.get_or_create(
+                    um.Gauge, "serve_llm_queue_depth",
+                    "Requests waiting for a batch slot (the telemetry "
+                    "timeline's engine-queue series)", tk),
                 "free_blocks": um.get_or_create(
                     um.Gauge, "serve_llm_kv_free_blocks",
                     "Free KV blocks in the pool", tk),
@@ -1992,6 +1996,8 @@ class LLMEngine:
         m["occupancy"].set(
             sum(s is not None for s in self._slots) / self.max_batch,
             tags)
+        m["queue_depth"].set(
+            self._waiting.qsize() + len(self._pending), tags)
         m["weight_version"].set(float(self.weight_version), tags)
         if self._mgr is not None:
             m["free_blocks"].set(self._mgr.free_count(), tags)

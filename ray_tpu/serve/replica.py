@@ -37,6 +37,40 @@ _ctx_var: contextvars.ContextVar = contextvars.ContextVar(
     "raytpu_serve_replica_ctx", default=None)
 _current_context: ReplicaContext | None = None
 
+_METRICS = None
+
+
+def _replica_metrics():
+    """Per-deployment request series (utils.metrics registry → the
+    telemetry timeline + dashboard /metrics): req/s and live queue
+    depth for deployments that run no LLM engine (the engine exports
+    its own richer serve_llm_* series)."""
+    global _METRICS
+    if _METRICS is None:
+        from ray_tpu.utils import metrics as um
+
+        # (app, deployment, replica): deployment names default to the
+        # class name, so two apps' same-named deployments would
+        # otherwise merge into one series (the serve_prefix_tier2_bytes
+        # precedent) — and the replica tag keeps N replicas' gauges
+        # distinct even when they share one process (TPU deployments
+        # co-host every replica on the device worker); the reader sums
+        # per-replica latest values, never trusts one series key to
+        # mean "the deployment".
+        tk = ("app", "deployment", "replica")
+        _METRICS = {
+            "processed": um.get_or_create(
+                um.Counter, "serve_replica_processed",
+                "Requests completed by this replica", tk),
+            "ongoing": um.get_or_create(
+                um.Gauge, "serve_replica_ongoing",
+                "Requests queued + executing on this replica", tk),
+            "rejected": um.get_or_create(
+                um.Counter, "serve_replica_rejected",
+                "Requests rejected by bounded-queue admission", tk),
+        }
+    return _METRICS
+
 
 def get_current_context() -> ReplicaContext | None:
     return _ctx_var.get() or _current_context
@@ -138,6 +172,10 @@ class Replica:
             return
         queued = max(0, self._num_ongoing - self._max_ongoing)
         self._num_rejected += 1
+        try:
+            _replica_metrics()["rejected"].inc(1, self._metric_tags())
+        except Exception:  # noqa: BLE001 - metrics never block serving
+            pass
         from ray_tpu.exceptions import ServeOverloadedError
 
         # How long until a queue slot plausibly frees: the wave ahead
@@ -167,6 +205,7 @@ class Replica:
             await failpoints.fire_async("serve.admit")
         self._admit_or_reject(priority, args, kwargs)
         self._num_ongoing += 1
+        self._observe_load()
         from ray_tpu import tracing
 
         t_adm = time.time() if tracing.ENABLED else 0.0
@@ -212,6 +251,24 @@ class Replica:
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+            self._observe_load(done=True)
+
+    def _metric_tags(self) -> dict:
+        return {"app": self._context.app_name,
+                "deployment": self._context.deployment,
+                "replica": (self._context.replica_tag or "")[:12]}
+
+    def _observe_load(self, done: bool = False) -> None:
+        """Mirror the live queue depth (and completions) into the
+        per-replica metric series the telemetry timeline samples."""
+        try:
+            m = _replica_metrics()
+            tags = self._metric_tags()
+            m["ongoing"].set(float(self._num_ongoing), tags)
+            if done:
+                m["processed"].inc(1, tags)
+        except Exception:  # noqa: BLE001 - metrics never block serving
+            pass
 
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: dict,
@@ -226,6 +283,7 @@ class Replica:
             failpoints.fire("serve.admit")
         self._admit_or_reject(priority, args, kwargs)
         self._num_ongoing += 1
+        self._observe_load()
         token = _ctx_var.set(self._context)
         try:
             target = getattr(self._instance, method)
@@ -238,6 +296,7 @@ class Replica:
             _ctx_var.reset(token)
             self._num_ongoing -= 1
             self._num_processed += 1
+            self._observe_load(done=True)
 
     async def get_queue_len(self) -> int:
         """Probe for the power-of-two-choices router (ray:
@@ -285,6 +344,17 @@ class Replica:
         hook (ray: replica graceful shutdown)."""
         while self._num_ongoing > 0:
             await asyncio.sleep(0.02)
+        # Drop this replica's tagged series: the hosting process (the
+        # co-hosted device worker) outlives replicas, and an autoscaler
+        # cycling replicas all day would otherwise grow the registry —
+        # and leave a stale nonzero `ongoing` gauge `ray-tpu top` sums
+        # as phantom load — without bound.
+        try:
+            tags = self._metric_tags()
+            for m in _replica_metrics().values():
+                m.remove(tags)
+        except Exception:  # noqa: BLE001 - metrics never block shutdown
+            pass
         fn = getattr(self._instance, "shutdown", None)
         if fn is not None:
             r = fn()

@@ -1,0 +1,194 @@
+"""Public facade over the cluster telemetry timeline.
+
+Library layers (serve/train/data/tune/rl) and tooling (dashboard, CLI)
+reach the metrics-snapshot ring ONLY through this module (the
+`ray_tpu.tracing` / `ray_tpu.memledger` shape); the implementation
+stays a runtime internal (`ray_tpu/_private/telemetry.py`, env knobs
+``RAY_TPU_TELEMETRY`` / ``RAY_TPU_TELEMETRY_SAMPLES``).
+
+Harvest (driver-side):
+
+    from ray_tpu import telemetry
+
+    replies, diags = telemetry.harvest()        # every process's ring
+    ts = telemetry.timeseries(series=["serve_llm_"], since=t0)
+    # ts["series"]["serve_llm_queue_depth{engine=llm}"] ->
+    #     [{"t": ..., "v": ..., "proc": "worker:..."}, ...]
+"""
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private import telemetry as _impl
+
+set_enabled = _impl.set_enabled
+sample_now = _impl.sample_now
+snapshot = _impl.snapshot
+series_key = _impl.series_key
+clear = _impl.clear
+stats = _impl.stats
+control = _impl.control
+ENV_VAR = _impl.ENV_VAR
+
+
+def __getattr__(name):
+    # ENABLED is a mutable module flag — read it live off the
+    # implementation module; an import-time snapshot would never flip.
+    return getattr(_impl, name)
+
+
+# ------------------------------------------------------------- harvest
+def harvest(since: float | None = None,
+            series: list[str] | None = None,
+            fresh: bool = False,
+            timeout: float = 20.0) -> tuple[list[dict], list[str]]:
+    """Collect every process's timeline ring — this process's directly,
+    the cluster's through the controller's `telemetry` verb (the same
+    controller→agents→workers broadcast fan-out as the spans verb) —
+    and return (per-process replies, diagnostics).  A crashed or
+    wedged agent (the telemetry.harvest failpoint shape) degrades the
+    merge to partial WITH a diagnostic, never a hang."""
+    replies: list[dict] = []
+    diags: list[str] = []
+    seen: set = set()
+
+    sub = {"op": "collect", "since": since,
+           "series": list(series) if series else None, "fresh": fresh}
+
+    def _take(reply) -> None:
+        # In-process topologies return the SAME ring through several
+        # fan-out legs — dedupe by boot token (the spans convention).
+        if not isinstance(reply, dict) or "samples" not in reply:
+            return
+        key = reply.get("boot") or reply.get("pid")
+        if key in seen:
+            return
+        seen.add(key)
+        replies.append(reply)
+
+    _take(_impl.control(dict(sub)))
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        reply, _ = w.call(w.controller_addr, "telemetry",
+                          {**sub, "broadcast": True}, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 - no cluster: local ring only
+        diags.append(f"controller: {e!r}")
+        reply = {}
+    _take(reply)
+    for node_id, nrep in (reply.get("nodes") or {}).items():
+        if not isinstance(nrep, dict) or "samples" not in nrep:
+            err = nrep.get("error") if isinstance(nrep, dict) else nrep
+            diags.append(f"node {str(node_id)[:12]}: {err}")
+            continue
+        _take(nrep)
+        for wid, wrep in (nrep.get("workers") or {}).items():
+            if not isinstance(wrep, dict) or "samples" not in wrep:
+                err = (wrep.get("error")
+                       if isinstance(wrep, dict) else wrep)
+                diags.append(f"worker {str(wid)[:12]}: {err}")
+                continue
+            _take(wrep)
+    for jid, drep in (reply.get("drivers") or {}).items():
+        # Other jobs' drivers hold driver-resident series (a local
+        # engine, bench metrics); a confirmed-gone driver is no data,
+        # not a hole.
+        if not isinstance(drep, dict) or "samples" not in drep:
+            if isinstance(drep, dict) and drep.get("gone"):
+                continue
+            err = drep.get("error") if isinstance(drep, dict) else drep
+            diags.append(f"driver {str(jid)[:12]}: {err}")
+            continue
+        _take(drep)
+    return replies, diags
+
+
+def merged(replies: list[dict],
+           since: float | None = None,
+           series: list[str] | None = None) -> dict:
+    """Merge harvested rings into one timeline:
+    {"series": {key: [{"t", "v", "proc", "boot"}...] time-sorted},
+     "procs": [labels], "enabled": any}.  Points keep their owning
+    PROCESS IDENTITY — the boot token, not just the display label:
+    every driver-mode process is labeled "driver" and bare pids
+    collide across hosts, so two jobs' same-keyed series must stay
+    distinguishable by boot or rate math would mix their counters."""
+    out: dict[str, list[dict]] = {}
+    procs: list[str] = []
+    enabled = False
+    for rep in replies:
+        proc = rep.get("proc", "?")
+        boot = rep.get("boot") or proc
+        procs.append(proc)
+        enabled = enabled or bool(rep.get("enabled"))
+        for sample in rep.get("samples", ()):
+            t = sample.get("t", 0.0)
+            if since is not None and t < since:
+                continue
+            for key, v in (sample.get("series") or {}).items():
+                if series and not any(key.startswith(p)
+                                      for p in series):
+                    continue
+                out.setdefault(key, []).append(
+                    {"t": t, "v": v, "proc": proc, "boot": boot})
+    for pts in out.values():
+        pts.sort(key=lambda p: p["t"])
+    return {"series": out, "procs": procs, "enabled": enabled}
+
+
+def timeseries(series: list[str] | None = None,
+               since: float | None = None,
+               fresh: bool = False,
+               timeout: float = 20.0) -> dict:
+    """One-call cluster timeline: harvest + merge, with diagnostics
+    attached (`diagnostics` non-empty == partial harvest)."""
+    replies, diags = harvest(since=since, series=series, fresh=fresh,
+                             timeout=timeout)
+    doc = merged(replies, since=since, series=series)
+    doc["diagnostics"] = diags
+    doc["t"] = time.time()
+    return doc
+
+
+def latest(doc: dict, key: str) -> float | None:
+    """Newest value of one merged series (any process), or None."""
+    pts = doc.get("series", {}).get(key) or ()
+    return pts[-1]["v"] if pts else None
+
+
+def latest_by_proc(doc: dict, key: str) -> list[float]:
+    """Each PROCESS's newest value of one merged series (grouped by
+    boot token).  Gauges written by N processes under one series key
+    (N replicas of a deployment-named engine) must be aggregated over
+    this — sum for depth/ongoing, mean for rates — never read via
+    plain latest(), which answers for one arbitrary replica of N."""
+    newest: dict = {}
+    for p in doc.get("series", {}).get(key) or ():
+        newest[p.get("boot") or p["proc"]] = p["v"]   # pts time-sorted
+    return list(newest.values())
+
+
+def rate(doc: dict, key: str, window_s: float = 30.0) -> float | None:
+    """Per-second rate of a counter-shaped merged series over the last
+    `window_s`, summed across processes (each process's delta computes
+    against ITS OWN earlier point, grouped by BOOT TOKEN — the proc
+    label is a display name two processes can share, and counters from
+    different processes must never subtract from each other)."""
+    pts = doc.get("series", {}).get(key)
+    if not pts:
+        return None
+    now = max(p["t"] for p in pts)
+    total = 0.0
+    any_window = False
+    by_proc: dict[str, list[dict]] = {}
+    for p in pts:
+        by_proc.setdefault(p.get("boot") or p["proc"], []).append(p)
+    for seq in by_proc.values():
+        win = [p for p in seq if p["t"] >= now - window_s]
+        if len(win) >= 2:
+            dt = win[-1]["t"] - win[0]["t"]
+            if dt > 0:
+                total += max(0.0, win[-1]["v"] - win[0]["v"]) / dt
+                any_window = True
+    return total if any_window else None

@@ -49,6 +49,10 @@ class _Session:
         self.epoch_abort = False
         self.out: queue.Queue = queue.Queue(maxsize=8)
         self.stop_event = threading.Event()
+        # Per-step telemetry marks: wall time of the previous report()
+        # feeds the gang's step-time series on the cluster timeline.
+        self._last_report_t: float | None = None
+        self._step_metrics = None
 
     def report(self, metrics: dict, checkpoint: Checkpoint | None) -> None:
         if self.stop_event.is_set():
@@ -61,13 +65,64 @@ class _Session:
 
         if failpoints.ACTIVE:
             failpoints.fire("train.step")
+        self._mark_step()
         self.out.put({"type": "report", "metrics": dict(metrics),
                       "checkpoint": checkpoint, "rank": self.world_rank})
+
+    def _mark_step(self) -> None:
+        """Stage mark per report(): step wall time + a step counter as
+        (trial, rank)-tagged metric series — the per-gang rows the
+        telemetry timeline (`ray-tpu top`) samples every ~2s."""
+        import time as _time
+
+        now = _time.monotonic()
+        last, self._last_report_t = self._last_report_t, now
+        try:
+            if self._step_metrics is None:
+                from ray_tpu.utils import metrics as um
+
+                tk = ("trial", "rank")
+                self._step_metrics = {
+                    "step_s": um.get_or_create(
+                        um.Gauge, "train_step_s",
+                        "Wall seconds between successive train "
+                        "reports (per-gang step time)", tk),
+                    "steps": um.get_or_create(
+                        um.Counter, "train_reported_steps",
+                        "train.report() calls", tk),
+                }
+            tags = {"trial": self.trial_name,
+                    "rank": str(self.world_rank)}
+            if last is not None:
+                self._step_metrics["step_s"].set(now - last, tags)
+            self._step_metrics["steps"].inc(1, tags)
+        except Exception:  # noqa: BLE001 - telemetry never fails a step
+            pass
+
+    def drop_step_metrics(self) -> None:
+        """Remove this session's (trial, rank) series from the metric
+        registry (the Metric.remove discipline): the hosting process
+        outlives sessions — an elastic re-form renumbers ranks on the
+        SAME processes and a Tune run cycles trials, so an unremoved
+        gauge would read as a live gang row forever."""
+        if self._step_metrics is None:
+            return
+        try:
+            tags = {"trial": self.trial_name,
+                    "rank": str(self.world_rank)}
+            for m in self._step_metrics.values():
+                m.remove(tags)
+        except Exception:  # noqa: BLE001 - teardown never fails
+            pass
 
 
 def init_session(**kwargs) -> _Session:
     global _session
     with _session_lock:
+        if _session is not None:
+            # Elastic re-form / next trial on the same process: the
+            # outgoing incarnation's series must not linger.
+            _session.drop_step_metrics()
         _session = _Session(**kwargs)
         return _session
 
@@ -75,6 +130,8 @@ def init_session(**kwargs) -> _Session:
 def shutdown_session() -> None:
     global _session
     with _session_lock:
+        if _session is not None:
+            _session.drop_step_metrics()
         _session = None
 
 

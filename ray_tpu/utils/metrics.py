@@ -9,6 +9,7 @@ metrics_agent.py, collapses to the controller here).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Sequence
@@ -46,6 +47,16 @@ class Metric:
             raise ValueError(f"unknown tag keys {unknown}; declared "
                              f"{self.tag_keys}")
         return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def remove(self, tags: dict | None = None) -> None:
+        """Drop one tagged series from this metric.  Short-lived tag
+        values (a per-replica tag under an autoscaler that cycles
+        replicas all day) MUST be removed at teardown or the registry —
+        and every snapshot riding it: telemetry ring samples, harvest
+        replies, /metrics scrapes — grows without bound."""
+        k = self._key(tags)
+        with self._lock:
+            self._values.pop(k, None)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -102,6 +113,13 @@ class Histogram(Metric):
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._values[k] = self._sums[k]   # snapshot shows the sum
 
+    def remove(self, tags: dict | None = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values.pop(k, None)
+            self._counts.pop(k, None)
+            self._sums.pop(k, None)
+
     def snapshot(self) -> dict:
         base = super().snapshot()
         with self._lock:
@@ -137,6 +155,24 @@ def get_or_create(cls, name: str, description: str = "",
     return m
 
 
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an ALREADY-SORTED sequence (0.0
+    for empty) — the one summary-stat helper shared by the trace
+    attribution and task-summary surfaces."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def registry_snapshots() -> list[dict]:
+    """Snapshot every registered metric under the registry lock — the
+    flush loop's walk, shared with the telemetry timeline sampler
+    (_private/telemetry.py sample_now)."""
+    with _registry_lock:
+        return [m.snapshot() for m in _registry.values()]
+
+
 def _ensure_flusher() -> None:
     """Push local metric snapshots to the controller KV (the metrics-agent
     export path, collapsed)."""
@@ -155,14 +191,24 @@ def _flush_loop() -> None:
     while True:
         time.sleep(FLUSH_PERIOD_S)
         try:
+            from ray_tpu._private import telemetry
             from ray_tpu._private.worker import _global_worker
 
             core = _global_worker
-            if core is None or core._shutdown.is_set():
+            flush = core is not None and not core._shutdown.is_set()
+            # One module-flag check per period (the failpoints
+            # discipline): with the timeline off and no worker to flush
+            # to, the loop never walks the registry at all.
+            if not (flush or telemetry.ENABLED):
                 continue
-            with _registry_lock:
-                snaps = [m.snapshot() for m in _registry.values()]
+            snaps = registry_snapshots()
             if not snaps:
+                continue
+            if telemetry.ENABLED:
+                # Timeline sample rides the walk this loop already did
+                # — no extra registry locking for the ring.
+                telemetry.record_from_snapshots(snaps)
+            if not flush:
                 continue
             core.call(core.controller_addr, "kv_put",
                       {"ns": "metrics", "key": core.worker_id},
@@ -171,3 +217,25 @@ def _flush_loop() -> None:
                       timeout=10.0)
         except Exception:  # noqa: BLE001 - metrics must never crash work
             pass
+
+
+def _after_fork_child() -> None:
+    # The flusher THREAD does not survive fork, but the parent's handle
+    # would make _ensure_flusher think it does.  Re-arm the locks FIRST
+    # (a fork can land mid-snapshot, leaving the parent's lock state
+    # poisoned in the child; the handler runs single-threaded, so
+    # replacement is safe), then restart the flusher iff the child
+    # inherited a populated registry — a child updating inherited
+    # metrics through cached handles never calls a constructor, so
+    # nothing else would revive the flush loop or the telemetry
+    # sampling that rides it.
+    global _flusher, _registry_lock
+    _flusher = None
+    _registry_lock = threading.Lock()
+    for m in _registry.values():
+        m._lock = threading.Lock()
+    if _registry:
+        _ensure_flusher()
+
+
+os.register_at_fork(after_in_child=_after_fork_child)

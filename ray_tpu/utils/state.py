@@ -60,17 +60,61 @@ def list_jobs() -> list[dict]:
     return JobSubmissionClient().list_jobs()
 
 
+def _p(sorted_vals: list[float], q: float) -> float:
+    from ray_tpu.utils.metrics import percentile
+
+    return percentile(sorted_vals, q)
+
+
 def summarize_tasks() -> dict:
-    """Counts by (function, state) (ray: summarize_tasks api.py:1365)."""
+    """Per-function task summary over the task-event buffer (ray:
+    summarize_tasks api.py:1365): state counts plus duration p50/p95
+    in ms (first SUBMITTED/RUNNING → FINISHED/FAILED per task), so
+    "which function is slow" is answerable without a trace harvest."""
+    # The buffer interleaves per-process push batches, so ORDER is not
+    # time (a driver's SUBMITTED batch can land after the worker's
+    # FINISHED) — sort by (t, lifecycle rank) first, the
+    # utils/tracing.spans_from_events convention, so duration pairing
+    # sees opens before closes and `latest` really is the last state.
+    rank = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+    events = sorted(list_tasks(limit=100_000),
+                    key=lambda e: (e.get("t", 0.0),
+                                   rank.get(e.get("state"), 0)))
     latest: dict[str, dict] = {}
-    for ev in list_tasks(limit=100_000):
-        latest[ev["task_id"]] = ev
-    summary: dict[str, dict[str, int]] = {}
-    for ev in latest.values():
-        fn = ev.get("name") or ev.get("function", "?")
+    first_t: dict[str, float] = {}
+    durations: dict[str, list[float]] = {}
+    names: dict[str, str] = {}
+    for ev in events:
+        tid = ev["task_id"]
+        latest[tid] = ev
+        name = ev.get("name") or ev.get("function")
+        if name:
+            names[tid] = name
+        t = ev.get("t", 0.0)
+        if ev.get("state") in ("SUBMITTED", "RUNNING"):
+            first_t.setdefault(tid, t)
+        elif ev.get("state") in ("FINISHED", "FAILED") \
+                and tid in first_t:
+            # Pop at the terminal event: a retried task re-opens at its
+            # next RUNNING, so each ATTEMPT measures its own duration —
+            # never the original submit through every retry's backoff.
+            durations.setdefault(tid, []).append(t - first_t.pop(tid))
+    summary: dict[str, dict] = {}
+    by_fn_durs: dict[str, list[float]] = {}
+    for tid, ev in latest.items():
+        fn = names.get(tid) or "?"
         state = ev.get("state", "?")
-        summary.setdefault(fn, {})
-        summary[fn][state] = summary[fn].get(state, 0) + 1
+        row = summary.setdefault(fn, {"states": {}, "duration_ms": None})
+        row["states"][state] = row["states"].get(state, 0) + 1
+        for d in durations.get(tid, ()):
+            by_fn_durs.setdefault(fn, []).append(d * 1000.0)
+    for fn, durs in by_fn_durs.items():
+        durs.sort()
+        summary[fn]["duration_ms"] = {
+            "p50": round(_p(durs, 0.50), 3),
+            "p95": round(_p(durs, 0.95), 3),
+            "count": len(durs),
+        }
     return {"cluster": {"summary": summary,
                         "total_tasks": len(latest)}}
 

@@ -1,0 +1,101 @@
+"""Failpoint-site consistency check (ISSUE 15 satellite).
+
+The failpoint site list has grown to ~25 names across six PRs with no
+check that a site named in CLAUDE.md or armed in a test still exists in
+code — a renamed site would leave chaos tests arming a no-op and docs
+pointing at nothing.  This grep-based test pins both sources against
+the `failpoints.fire("...")` / `fire_async("...")` literals in the
+tree.
+"""
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# site shape: dotted lowercase identifiers (serve.kv_export, arena.copy)
+_SITE = r"[a-z_][a-z0-9_]*\.[a-z_][a-z0-9_]*"
+# Arming spec shape, as tests write it: site=action[+action...]
+_ARM = re.compile(rf"({_SITE})=(?:nth:|prob:|crash|error|delay:"
+                  rf"|drop\b|off\b)")
+# Literal fire sites in runtime/library code.
+_FIRE = re.compile(rf"fire(?:_async)?\(\s*[\"']({_SITE})[\"']")
+# Backticked site tokens in CLAUDE.md prose.
+_DOC_TOKEN = re.compile(rf"`({_SITE})(?:=[^`]*)?`")
+
+
+def _code_sites() -> set[str]:
+    out = set()
+    for dirpath, _dirs, files in os.walk(os.path.join(REPO, "ray_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname),
+                      encoding="utf-8") as f:
+                out.update(_FIRE.findall(f.read()))
+    return out
+
+
+def _claude_md_sites() -> set[str]:
+    """Every site CLAUDE.md names as a failpoint: from each
+    "[Ff]ailpoint site(s)" mention, collect backticked dotted tokens
+    until the sentence ends — ';' or '.'-plus-whitespace, the doc's
+    conventions separating the site list from trailing span/invariant
+    prose — or a 400-char window closes."""
+    with open(os.path.join(REPO, "CLAUDE.md"), encoding="utf-8") as f:
+        text = f.read()
+    out = set()
+    for m in re.finditer(r"[Ff]ailpoint sites?", text):
+        window = text[m.end():m.end() + 400]
+        window = re.split(r";|\.\s", window, maxsplit=1)[0]
+        out.update(_DOC_TOKEN.findall(window))
+    return out
+
+
+def _test_armed_sites() -> set[str]:
+    """Sites armed by spec string anywhere in the test suite — except
+    test_failpoints.py itself, whose synthetic names (a.b, test.probe)
+    exercise the arming machinery, not real sites."""
+    out = set()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for fname in os.listdir(here):
+        if not fname.endswith(".py") or fname == "test_failpoints.py":
+            continue
+        with open(os.path.join(here, fname), encoding="utf-8") as f:
+            out.update(_ARM.findall(f.read()))
+    return out
+
+
+def test_scan_is_not_vacuous():
+    """The greps find real data — a path/convention change must fail
+    loudly, not silently allow-list nothing."""
+    assert len(_code_sites()) >= 20
+    assert len(_claude_md_sites()) >= 10
+    assert len(_test_armed_sites()) >= 8
+
+
+def test_every_claude_md_site_exists_in_code():
+    missing = _claude_md_sites() - _code_sites()
+    assert not missing, (
+        "CLAUDE.md names failpoint sites that no "
+        "failpoints.fire()/fire_async() literal implements: "
+        f"{sorted(missing)}")
+
+
+def test_every_test_armed_site_exists_in_code():
+    missing = _test_armed_sites() - _code_sites()
+    assert not missing, (
+        "tests arm failpoint sites that no "
+        "failpoints.fire()/fire_async() literal implements: "
+        f"{sorted(missing)}")
+
+
+@pytest.mark.parametrize("site", ["telemetry.harvest",
+                                  "memory.harvest"])
+def test_harvest_degradation_sites_present(site):
+    """The observability harvest verbs keep their agent-side
+    degrade-to-partial failpoint windows."""
+    assert site in _code_sites()
