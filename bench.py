@@ -2268,6 +2268,211 @@ def bench_serve_prefix_store() -> dict:
             pass
 
 
+def bench_serve_lora() -> dict:
+    """Multi-LoRA multiplexing (round 20): one 2-replica deployment
+    serves a zipf-popular population of 20 adapters (10x the replica
+    count — the many-tenants regime) with 4 bank slots per engine, so
+    the slot LRU must thrash the cold tail no matter what; what routing
+    controls is WHERE the thrash lands.
+
+    Four same-run arms over ONE shared zipf trace:
+      - on: residency-aware routing (adapters sticky to the replica
+        that already holds them; cold loads land least-loaded).
+      - blind: RAY_TPU_LORA_ROUTER=0 (driver-side, read per pick) —
+        adapters still serve, but pow-2 placement ignores residency,
+        so hot adapters page into BOTH replicas and halve the
+        effective slot pool.
+      - off: the per-request kill switch (model_id absent → base
+        model; the replica-side RAY_TPU_LORA env can't be flipped from
+        the driver post-fork) — the flat floor: no loads, no adapter
+        compute.
+      - per_deployment: the pre-multiplex architecture — one DEDICATED
+        single-replica deployment per adapter.  Equal hardware (2
+        replicas) affords exactly 2 of the 20 adapters; the arm serves
+        only the trace's head and reports its coverage.
+
+    Between adapter arms every adapter is REPUBLISHED (version bump →
+    new KV salt → stale residency everywhere): each arm starts from
+    cold slots instead of inheriting the previous arm's working set.
+
+    Rows: serve_lora_tokens_per_s (+ _blind_/_off_/_per_deployment_
+    siblings, *_per_s guard; the headline row also gets an explicit
+    _vs_previous_round entry) + serve_lora_{on,blind}_p99_ttft_ms
+    (_ms guard) + per-arm adapter load/evict counters (the residency
+    claim: on-arm loads < blind-arm loads)."""
+    import numpy as np
+
+    from ray_tpu._private.jax_compat import install as _jax_compat
+
+    _jax_compat()
+    import jax
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 8})
+    prev_router = os.environ.get("RAY_TPU_LORA_ROUTER")
+    out: dict = {}
+    groups, n_req, slots, rank = 20, 36, 4, 4
+    prefix_len, unique_len, new_tokens = 32, 8, 4
+    cfg = llama.llama_configs()["debug"]
+    try:
+        serve.start()
+        ekw = dict(max_batch=4, max_len=64, page_size=8,
+                   steps_per_sync=4, seed=0,
+                   lora_slots=slots, lora_rank=rank)
+        LLM = serve.deployment(serve.LLMServer).options(
+            name="llm", num_replicas=2, max_ongoing_requests=4,
+            health_check_period_s=10.0, health_check_timeout_s=120.0)
+        h = serve.run(LLM.bind("debug", **ekw),
+                      name="lora_bench", route_prefix="/lb")
+        rng = np.random.default_rng(7)
+        adapters = [llama.init_lora_adapter(
+            jax.random.PRNGKey(100 + g), cfg, rank)
+            for g in range(groups)]
+        mids = [f"tenant/{g}" for g in range(groups)]
+        # ONE shared zipf realization: every arm sees the same hot/cold
+        # request mix or the A/B measures the draw, not the routing.
+        zw = np.array([1.0 / (g + 1) ** 1.1 for g in range(groups)])
+        zw /= zw.sum()
+        shared_gids = rng.choice(groups, size=n_req, p=zw)
+        prefixes = [rng.integers(1, cfg.vocab_size,
+                                 prefix_len).tolist()
+                    for _ in range(groups)]
+        # Warm both replicas' compile caches (prompt bucket + decode
+        # program) before any timed window.
+        for _ in range(2):
+            futs = [h.remote({"prompt": prefixes[0][:16],
+                              "max_new_tokens": 2})
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout_s=600)
+
+        def republish():
+            for mid, ad in zip(mids, adapters):
+                serve.publish_adapter(mid, ad, tenant=mid.split("/")[0])
+
+        def lora_stats():
+            rm = serve.replica_metrics("lora_bench", deployment="llm")
+            reps = [((m or {}).get("user_stats") or {}).get("lora")
+                    or {} for m in rm["lora_bench"]["llm"].values()]
+            return {"loads": sum(r.get("loads", 0) for r in reps),
+                    "evictions": sum(r.get("evictions", 0)
+                                     for r in reps)}
+
+        def run_arm(name: str, with_model_id: bool) -> dict:
+            # Fixed per-arm suffix seeds (never hash(): PYTHONHASHSEED).
+            arng = np.random.default_rng(
+                {"off": 303, "blind": 404, "on": 505}[name])
+            base = lora_stats()
+            t0 = time.perf_counter()
+            results, active = [], []
+            for g in shared_gids:
+                req = {"prompt": prefixes[g]
+                       + arng.integers(1, cfg.vocab_size,
+                                       unique_len).tolist(),
+                       "max_new_tokens": new_tokens}
+                if with_model_id:
+                    req["model_id"] = mids[g]
+                active.append(h.remote(req))
+                if len(active) >= 6:
+                    results.append(active.pop(0).result(timeout_s=600))
+            results += [f.result(timeout_s=600) for f in active]
+            wall = time.perf_counter() - t0
+            cur = lora_stats()
+            ttfts = sorted(r["ttft_s"] for r in results)
+            toks = n_req * (prefix_len + unique_len + new_tokens)
+            return {
+                "tokens_per_s": round(toks / wall, 1),
+                "wall_s": round(wall, 3),
+                "p99_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1,
+                              int(0.99 * len(ttfts)))] * 1000, 1),
+                "adapter_loads": cur["loads"] - base["loads"],
+                "adapter_evictions": (cur["evictions"]
+                                      - base["evictions"]),
+            }
+
+        # Arm order: off (no adapter state touched), then blind, then
+        # residency-aware — with a republish wall between the adapter
+        # arms so neither inherits the other's resident slots.
+        off = run_arm("off", with_model_id=False)
+        republish()
+        time.sleep(2.5)          # directory TTL + one residency poll
+        os.environ["RAY_TPU_LORA_ROUTER"] = "0"
+        blind = run_arm("blind", with_model_id=True)
+        republish()
+        os.environ["RAY_TPU_LORA_ROUTER"] = "1"
+        time.sleep(2.5)
+        on = run_arm("on", with_model_id=True)
+        serve.delete("lora_bench")
+
+        # The pre-multiplex architecture: equal hardware = 2 dedicated
+        # single-replica deployments → 2 of 20 adapters served.
+        PD = serve.deployment(serve.LLMServer).options(
+            name="llm", num_replicas=1, max_ongoing_requests=4,
+            health_check_period_s=10.0, health_check_timeout_s=120.0)
+        pdkw = {k: v for k, v in ekw.items()
+                if not k.startswith("lora_")}
+        heads = {g: serve.run(PD.bind("debug", **pdkw),
+                              name=f"lora_pd{g}",
+                              route_prefix=f"/lpd{g}")
+                 for g in range(2)}
+        for g, hh in heads.items():
+            hh.remote({"prompt": prefixes[g][:16],
+                       "max_new_tokens": 2}).result(timeout_s=600)
+        arng = np.random.default_rng(11)
+        served, active = 0, []
+        t0 = time.perf_counter()
+        for g in shared_gids:
+            if g not in heads:
+                continue         # no deployment for this tenant
+            served += 1
+            active.append(heads[g].remote(
+                {"prompt": prefixes[g]
+                 + arng.integers(1, cfg.vocab_size,
+                                 unique_len).tolist(),
+                 "max_new_tokens": new_tokens}))
+            if len(active) >= 6:
+                active.pop(0).result(timeout_s=600)
+        for f in active:
+            f.result(timeout_s=600)
+        wall = time.perf_counter() - t0
+        per_dep = {
+            "tokens_per_s": round(
+                served * (prefix_len + unique_len + new_tokens)
+                / wall, 1),
+            "wall_s": round(wall, 3),
+            "served_requests": served,
+            "coverage_pct": round(100.0 * served / n_req, 1),
+        }
+        for g in heads:
+            serve.delete(f"lora_pd{g}")
+
+        out["serve_lora"] = {
+            "replicas": 2, "adapters": groups, "slots_per_engine": slots,
+            "requests": n_req, "on": on, "blind": blind, "off": off,
+            "per_deployment": per_dep,
+        }
+        return out
+    finally:
+        if prev_router is None:
+            os.environ.pop("RAY_TPU_LORA_ROUTER", None)
+        else:
+            os.environ["RAY_TPU_LORA_ROUTER"] = prev_router
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def bench_serve_slo() -> dict:
     """SLO-driven autoscaling + overload control (round 15): a
     diurnal+spike trace through the full serve stack, same-run A/B via
@@ -2671,10 +2876,16 @@ def _vs_previous_round(extra: dict) -> dict:
     # though the _per_s suffix would cover them, so a rename can never
     # silently drop them from the guard.  The legacy kill-switch arm
     # (many_actors_ready_legacy_per_s) rides the suffix guard.
+    # Round 20: the multi-LoRA headline throughput gets an explicit
+    # higher-is-better entry (the _per_s suffix would cover it, but a
+    # rename must never silently drop the PR's claim from the guard);
+    # its _blind_/_off_/_per_deployment_ siblings ride the suffix
+    # guard and the p99 TTFTs ride _ms.
     higher_better = {"rlhf_rollout_hit_rate", "serve_slo_attainment_pct",
                      "serve_prefix_store_hit_pct",
                      "many_actors_ready_per_s", "actor_churn_waves_per_s",
-                     "node_membership_churn_per_s"}
+                     "node_membership_churn_per_s",
+                     "serve_lora_tokens_per_s"}
     lower_better = {"rlhf_weight_lag_windows"}
     # Round 17: the memory-ledger overhead is the same noise-around-
     # zero percent shape as the trace overhead — absolute 3% bar, not
@@ -2844,6 +3055,28 @@ def main() -> None:
             ps["off"]["p99_ttft_ms"]
     except Exception as e:  # noqa: BLE001
         extra["serve_prefix_store"] = {"error": repr(e)}
+    _flush_partial(extra)
+    try:
+        # Multi-LoRA zipf trace: serve boot (2 multiplexed + 2
+        # dedicated replicas across the arms) dominates; the four
+        # timed windows are seconds each.
+        row = _with_timeout(bench_serve_lora, 560)
+        extra["serve_lora"] = row["serve_lora"]
+        sl = row["serve_lora"]
+        # Flat rows so _vs_previous_round's guards cover the arms (the
+        # nested dict is for humans): throughputs on the *_per_s
+        # guard (+ the headline row's explicit entry), TTFTs on _ms.
+        extra["serve_lora_tokens_per_s"] = sl["on"]["tokens_per_s"]
+        extra["serve_lora_blind_tokens_per_s"] = \
+            sl["blind"]["tokens_per_s"]
+        extra["serve_lora_off_tokens_per_s"] = sl["off"]["tokens_per_s"]
+        extra["serve_lora_per_deployment_tokens_per_s"] = \
+            sl["per_deployment"]["tokens_per_s"]
+        extra["serve_lora_on_p99_ttft_ms"] = sl["on"]["p99_ttft_ms"]
+        extra["serve_lora_blind_p99_ttft_ms"] = \
+            sl["blind"]["p99_ttft_ms"]
+    except Exception as e:  # noqa: BLE001
+        extra["serve_lora"] = {"error": repr(e)}
     _flush_partial(extra)
     try:
         # Diurnal+spike SLO trace: serve boot + two ~8s spike legs;

@@ -142,6 +142,33 @@ class ServeOverloadedError(RayTpuError, RuntimeError):
                  self.retry_after_s))
 
 
+class AdapterLoadError(RayTpuError, RuntimeError):
+    """A multi-LoRA request's adapter could not be made resident: the
+    registry has no such model id, the fetched weights failed
+    validation, every adapter slot is busy, or the load faulted
+    (serve.adapter_load failpoint).  Typed and raised EARLY — before
+    the request occupies a batch slot — so a load fault degrades to a
+    clean rejection, never a wedged engine loop.  Subclasses
+    RuntimeError so legacy blanket handlers keep working."""
+
+    def __init__(self, message: str = "adapter load failed",
+                 model_id: str = "", deployment: str = "",
+                 reason: str = ""):
+        self.model_id = model_id
+        self.deployment = deployment
+        self.reason = reason
+        super().__init__(
+            f"{message} (model_id={model_id!r}, "
+            f"deployment={deployment!r}, reason={reason!r})")
+        self._message = message
+
+    def __reduce__(self):
+        # Multi-field exceptions MUST override reduce (see TaskError).
+        return (AdapterLoadError,
+                (self._message, self.model_id, self.deployment,
+                 self.reason))
+
+
 # ----------------------------------------------------- reference aliases
 # Reference-spelled names for drop-in `except ray.exceptions.X` code.
 # Same classes, not look-alikes: an except on either name catches both.

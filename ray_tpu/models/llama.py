@@ -392,8 +392,103 @@ def pipelined_loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
     return head_loss(params, x, targets, batch.get("mask"), cfg)
 
 
+# ------------------------------------------------------------------ lora
+# Batched multi-LoRA (S-LoRA/Punica style): adapters live in per-target
+# BANKS — stacked [L, n_slots, din, r] / [L, n_slots, r, dout] arrays —
+# and a per-request int32 index row-gathers each request's slot inside
+# ONE jitted program (BGMV).  The banks are jit ARGUMENTS, never closure
+# constants: loading an adapter swaps arrays without a retrace (static
+# rank bucket per the XLA invariants).  Slot 0 is all-zeros = the base
+# model (y + 0.0 == y), so a batch freely mixes adapter and base rows.
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def lora_target_dims(cfg: LlamaConfig) -> dict[str, tuple[int, int]]:
+    """(din, dout) per LoRA-targetable projection — the shape contract
+    init_lora_adapter, the engine's bank validation, and merge_lora all
+    share."""
+    hd = cfg.head_dim
+    return {
+        "wq": (cfg.dim, cfg.n_heads * hd),
+        "wk": (cfg.dim, cfg.n_kv_heads * hd),
+        "wv": (cfg.dim, cfg.n_kv_heads * hd),
+        "wo": (cfg.n_heads * hd, cfg.dim),
+    }
+
+
+def init_lora_adapter(key: jax.Array, cfg: LlamaConfig, rank: int, *,
+                      targets: tuple | None = None,
+                      scale: float = 1.0) -> dict:
+    """Random adapter {"rank", "targets": {t: {"a": [L, din, r],
+    "b": [L, r, dout]}}}.  The LoRA scale is folded into b at init (the
+    serving path never multiplies by alpha/r at decode time); b is
+    random — a zero-init b (the training convention) would make every
+    synthetic adapter a no-op."""
+    if rank < 1:
+        raise ValueError(f"lora rank must be >= 1, got {rank}")
+    dims = lora_target_dims(cfg)
+    targets = tuple(targets) if targets is not None else LORA_TARGETS
+    bad = set(targets) - set(dims)
+    if bad:
+        raise ValueError(f"unknown lora targets {sorted(bad)}; valid: "
+                         f"{sorted(dims)}")
+    L = cfg.n_layers
+    out = {}
+    for t in targets:
+        din, dout = dims[t]
+        key, ka, kb = jax.random.split(key, 3)
+        out[t] = {
+            "a": (jax.random.normal(ka, (L, din, rank), jnp.float32)
+                  * (din ** -0.5)).astype(cfg.dtype),
+            "b": (jax.random.normal(kb, (L, rank, dout), jnp.float32)
+                  * (rank ** -0.5) * scale).astype(cfg.dtype),
+        }
+    return {"rank": int(rank), "targets": out}
+
+
+def merge_lora(params: dict, adapter: dict, cfg: LlamaConfig) -> dict:
+    """Dense-merge an adapter into a copy of params (W + A @ B, fp32
+    accumulate) — the reference arm the token-identity tests compare
+    the batched engine against."""
+    layers = dict(params["layers"])
+    for t, ab in adapter["targets"].items():
+        w = layers[t]
+        delta = jnp.einsum("ldr,lro->ldo",
+                           jnp.asarray(ab["a"]).astype(jnp.float32),
+                           jnp.asarray(ab["b"]).astype(jnp.float32))
+        layers[t] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return {**params, "layers": layers}
+
+
+def _lora_proj(h, w, bank, idx):
+    """h @ w plus the per-request low-rank delta (h @ A[idx]) @ B[idx].
+
+    bank: {"a": [n_slots, din, r], "b": [n_slots, r, dout]} — ONE
+    layer's slice of the engine bank — or None (plain projection).
+    idx: [b] int32 adapter slots.  The delta accumulates in fp32 and
+    casts once; slot 0's all-zero rows contribute an exact 0.0."""
+    y = h @ w
+    if bank is None:
+        return y
+    a = bank["a"][idx]                                  # [b, din, r]
+    bb = bank["b"][idx]                                 # [b, r, dout]
+    t = jnp.einsum("b...d,bdr->b...r", h, a,
+                   preferred_element_type=jnp.float32)
+    d = jnp.einsum("b...r,bro->b...o", t, bb.astype(jnp.float32))
+    return y + d.astype(y.dtype)
+
+
+def _lora_layer_slice(lora, lid):
+    """Per-layer bank views for the unrolled decode/suffix paths."""
+    if not lora:
+        return None, None
+    return (jax.tree.map(lambda a: a[lid], lora["banks"]),
+            lora["idx"])
+
+
 # ---------------------------------------------------------------- decode
 def prefill(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+            lora: dict | None = None,
             ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prompt pass for serving: final hidden states plus the per-layer
     K/V to seed a decode cache.
@@ -405,24 +500,39 @@ def prefill(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     rows produce garbage K/V that decode never attends to: the decode
     mask admits only kpos <= pos and each decode step overwrites its own
     position before reading it (see decode_step).
+
+    lora: None/{} (base model) or {"idx": [b] int32 slots, "banks":
+    {target: {"a": [L, n_slots, din, r], "b": [L, n_slots, r, dout]}}}
+    — the banks scan alongside params["layers"], so the one compiled
+    layer body serves every adapter mix.
     """
     b, P = tokens.shape
+    lora = lora or None
+    idx = lora["idx"] if lora else None
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
     cos, sin = rope_frequencies(cfg.head_dim, P, cfg.rope_theta)
 
-    def layer(x, lp):
+    def layer(x, scanned):
+        lp = scanned[0]
+        lb = scanned[1] if lora else {}
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, P, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
+        q = _lora_proj(h, lp["wq"], lb.get("wq"), idx) \
+            .reshape(b, P, cfg.n_heads, cfg.head_dim)
+        k = _lora_proj(h, lp["wk"], lb.get("wk"), idx) \
+            .reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
+        v = _lora_proj(h, lp["wv"], lb.get("wv"), idx) \
+            .reshape(b, P, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         o = attention(q, k, v, causal=True)
-        x = x + (o.reshape(b, P, -1) @ lp["wo"])
+        x = x + _lora_proj(o.reshape(b, P, -1), lp["wo"],
+                           lb.get("wo"), idx)
         x = _mlp_block(x, lp, cfg)
         return x, (k.astype(cfg.dtype), v.astype(cfg.dtype))
 
-    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    xs = (params["layers"], lora["banks"]) if lora \
+        else (params["layers"],)
+    x, (ks, vs) = lax.scan(layer, x, xs)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return x, ks, vs
 
@@ -589,6 +699,7 @@ def prefill_with_prefix(params: dict, tokens: jnp.ndarray,
                         pos0: jnp.ndarray, cfg: LlamaConfig,
                         k_pages: list, v_pages: list,
                         prefix_table: jnp.ndarray,
+                        lora: dict | None = None,
                         ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Suffix prompt pass over a CACHED paged prefix (the radix
     prefix-cache fast path: prefill runs only on the tokens the cache
@@ -634,10 +745,15 @@ def prefill_with_prefix(params: dict, tokens: jnp.ndarray,
     ks_out, vs_out = [], []
     for lid in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[lid], params["layers"])
+        lb, lidx = _lora_layer_slice(lora, lid)
+        lb = lb or {}
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, S, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
+        q = _lora_proj(h, lp["wq"], lb.get("wq"), lidx) \
+            .reshape(b, S, cfg.n_heads, cfg.head_dim)
+        k = _lora_proj(h, lp["wk"], lb.get("wk"), lidx) \
+            .reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
+        v = _lora_proj(h, lp["wv"], lb.get("wv"), lidx) \
+            .reshape(b, S, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=positions)
         k = apply_rope(k, cos, sin, positions=positions)
         ks_out.append(k.astype(cfg.dtype))
@@ -654,7 +770,7 @@ def prefill_with_prefix(params: dict, tokens: jnp.ndarray,
         probs = jax.nn.softmax(a, axis=-1).astype(cfg.dtype)
         o = jnp.einsum("bgrsk,bkgd->bsgrd", probs, cv)
         o = o.reshape(b, S, cfg.n_heads * cfg.head_dim)
-        x = x + (o @ lp["wo"])
+        x = x + _lora_proj(o, lp["wo"], lb.get("wo"), lidx)
         x = _mlp_block(x, lp, cfg)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return x, jnp.stack(ks_out), jnp.stack(vs_out)
@@ -663,7 +779,8 @@ def prefill_with_prefix(params: dict, tokens: jnp.ndarray,
 def decode_step_paged(params: dict, pages: dict, tails: dict,
                       tokens: jnp.ndarray, pos: jnp.ndarray,
                       tail_start: jnp.ndarray, j, page_table: jnp.ndarray,
-                      cfg: LlamaConfig) -> tuple[jnp.ndarray, dict]:
+                      cfg: LlamaConfig,
+                      lora: dict | None = None) -> tuple[jnp.ndarray, dict]:
     """One decode step over the paged cache + in-block tail.
 
     pages {"k"/"v": [L x [n_pages, kvh, page, hd]]} are READ-ONLY here
@@ -687,10 +804,15 @@ def decode_step_paged(params: dict, pages: dict, tails: dict,
     new_tk, new_tv = [], []
     for lid in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[lid], params["layers"])
+        lb, lidx = _lora_layer_slice(lora, lid)
+        lb = lb or {}
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = _lora_proj(h, lp["wq"], lb.get("wq"), lidx) \
+            .reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        k = _lora_proj(h, lp["wk"], lb.get("wk"), lidx) \
+            .reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = _lora_proj(h, lp["wv"], lb.get("wv"), lidx) \
+            .reshape(b, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions=pos[:, None])
         k = apply_rope(k, cos, sin, positions=pos[:, None])
         qg = q.reshape(b, cfg.n_kv_heads, n_rep, cfg.head_dim)
@@ -703,7 +825,8 @@ def decode_step_paged(params: dict, pages: dict, tails: dict,
             tk, tv, page_table, pos, tail_start)
         new_tk.append(tk)
         new_tv.append(tv)
-        x = x + (o.reshape(b, 1, cfg.n_heads * cfg.head_dim) @ lp["wo"])
+        x = x + _lora_proj(o.reshape(b, 1, cfg.n_heads * cfg.head_dim),
+                           lp["wo"], lb.get("wo"), lidx)
         x = _mlp_block(x, lp, cfg)
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)

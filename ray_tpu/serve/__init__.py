@@ -3,7 +3,7 @@
 Controller reconcile loop + replica actors + power-of-two routing +
 stdlib HTTP proxy (SURVEY §2.3 / §3.5).
 """
-from ray_tpu.exceptions import ServeOverloadedError
+from ray_tpu.exceptions import AdapterLoadError, ServeOverloadedError
 from ray_tpu.serve.api import (HTTPOptions, delete, get_app_handle,
                                get_deployment_handle, get_replica_context,
                                grpc_port, http_port, ingress, list_proxies,
@@ -15,6 +15,8 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.llm import LLMEngine, LLMServer
+from ray_tpu.serve.lora import (delete_adapter, list_adapters,
+                                publish_adapter)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import Request
 
@@ -25,7 +27,8 @@ __all__ = [
     "replica_metrics",
     "apply_config", "ingress", "batch", "multiplexed",
     "get_multiplexed_model_id", "AutoscalingConfig", "DeploymentConfig",
-    "ServeOverloadedError",
+    "ServeOverloadedError", "AdapterLoadError",
+    "publish_adapter", "delete_adapter", "list_adapters",
     "DeploymentHandle", "DeploymentResponse", "Request",
     "LLMEngine", "LLMServer",
 ]

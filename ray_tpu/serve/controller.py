@@ -94,6 +94,13 @@ class ServeController:
         from ray_tpu.serve.prefix_store import StoreDirectory
 
         self._prefix_store = StoreDirectory()
+        # Multi-LoRA adapter registry (serve/lora.py): model_id →
+        # sealed-adapter object ref + version.  Cluster-scoped (an
+        # adapter serves any lora-enabled deployment), cleared at
+        # graceful_shutdown — the directory holds the primary refs.
+        from ray_tpu.serve.lora import AdapterDirectory
+
+        self._lora = AdapterDirectory()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._run_control_loop, daemon=True, name="serve-ctrl")
@@ -265,6 +272,25 @@ class ServeController:
     def prefix_store_stats(self) -> dict:
         return self._prefix_store.stats()
 
+    # ------------------------------------------------ multi-LoRA verbs
+    # Thin RPC surface over the AdapterDirectory (serve/lora.py):
+    # drivers publish/withdraw adapters, replicas look them up for the
+    # page-in miss path.  All logic lives in the directory.
+    def lora_publish(self, model_id: str, meta: dict, ref) -> dict:
+        return self._lora.publish(model_id, meta, ref)
+
+    def lora_lookup(self, model_id: str):
+        return self._lora.lookup(model_id)
+
+    def lora_forget(self, model_id: str) -> bool:
+        return self._lora.forget(model_id)
+
+    def lora_summary(self) -> dict:
+        return self._lora.summary()
+
+    def lora_stats(self) -> dict:
+        return self._lora.stats()
+
     def get_app_routes(self) -> dict:
         """route_prefix -> (app, ingress deployment); polled by proxies
         (ray: long-poll route table push)."""
@@ -308,6 +334,9 @@ class ServeController:
                     st.deleting = True
                     st.target_replicas = 0
         self._prefix_store.clear()
+        # Published adapters die with serve (the directory holds their
+        # primary refs — dropping the entries releases the arena bytes).
+        self._lora.clear()
         # Clear the serve demand floor SYNCHRONOUSLY: serve.shutdown
         # kills this actor within seconds — the throttled reconcile
         # re-post may never run, and a stale floor would make the

@@ -61,18 +61,22 @@ def _is_replica_death(e: BaseException) -> bool:
 
 
 def _as_overload(e: BaseException):
-    """The ServeOverloadedError behind a response failure, or None.
-    A replica's early rejection crosses the process boundary wrapped in
-    TaskError like any user exception — unwrap it so callers get the
-    TYPED, retriable error (fields: queue_depth, retry_after_s) without
-    fishing through .cause.  Never a replica death, so it spends no
-    dead-replica requeue budget."""
-    from ray_tpu.exceptions import ServeOverloadedError, TaskError
+    """The typed early-rejection behind a response failure, or None:
+    ServeOverloadedError (admission overflow) or AdapterLoadError (a
+    multi-LoRA request whose adapter could not be paged in).  Either
+    crosses the process boundary wrapped in TaskError like any user
+    exception — unwrap it so callers get the TYPED error (fields:
+    queue_depth / retry_after_s, model_id / reason) without fishing
+    through .cause.  Both mean the request NEVER RAN — never a replica
+    death, so they spend no dead-replica requeue budget."""
+    from ray_tpu.exceptions import (AdapterLoadError,
+                                    ServeOverloadedError, TaskError)
 
-    if isinstance(e, ServeOverloadedError):
+    typed = (ServeOverloadedError, AdapterLoadError)
+    if isinstance(e, typed):
         return e
     if isinstance(e, TaskError) and isinstance(
-            getattr(e, "cause", None), ServeOverloadedError):
+            getattr(e, "cause", None), typed):
         return e.cause
     return None
 
@@ -324,6 +328,13 @@ class DeploymentHandle:
         # replica summaries: cluster-RESIDENT prefixes score even when
         # no live radix tree holds them.
         self._store_sets: dict[int, frozenset] = {}
+        # Multi-LoRA residency view ({rid: {model_id: entry}}), same
+        # poll: LLM engines export resident adapters (+ KV salt / LRU
+        # age) under stats()["lora"]["resident"], plain
+        # @serve.multiplexed replicas export bare model-id lists.
+        # kv_router.choose scores residency so a cold adapter loads on
+        # ONE least-loaded replica instead of thrashing the pool.
+        self._residency: dict[str, dict] = {}
         # Malformed-summary accounting: a replica whose metrics dict is
         # broken must not silently degrade routing to power-of-two —
         # count every drop and warn ONCE per handle (a gossip
@@ -369,6 +380,7 @@ class DeploymentHandle:
             timeout=10.0)
         reps = rm.get(self.app_name, {}).get(self.deployment_name, {})
         summaries = self._compile_replica_summaries(reps)
+        residency = self._compile_residency(reps)
         store_sets: dict[int, frozenset] = {}
         if kv_router.prefix_store_on():
             # Tier-2 directory view, same poll (advisory like the
@@ -387,9 +399,11 @@ class DeploymentHandle:
         with self._lock:
             self._summaries = summaries
             self._store_sets = store_sets
+            self._residency = residency
             self._summaries_at = time.monotonic()
             self._summary_interval = _SUMMARY_TTL_S \
-                if summaries or store_sets else 10 * _SUMMARY_TTL_S
+                if summaries or store_sets or residency \
+                else 10 * _SUMMARY_TTL_S
 
     def _compile_replica_summaries(self, reps: dict) -> dict:
         """Normalize per-replica prefix summaries for scoring.  A
@@ -413,6 +427,36 @@ class DeploymentHandle:
                 continue
             summaries[rid] = s
         return summaries
+
+    def _compile_residency(self, reps: dict) -> dict:
+        """Per-replica resident-adapter view out of the same metrics
+        poll: {rid: {model_id: entry}}.  LLM engines report
+        stats()["lora"]["resident"] = {mid: {"salt", "version",
+        "age"}}; plain @serve.multiplexed replicas report a bare
+        model-id list/dict under "multiplexed" (no KV salt — routing
+        still scores residency, just without salted prefix depth).
+        Replicas reporting neither are simply absent."""
+        residency: dict[str, dict] = {}
+        for rid, m in reps.items():
+            if not isinstance(m, dict):
+                continue       # counted by the summary compile already
+            ents: dict = {}
+            lora = (m.get("user_stats") or {}).get("lora")
+            if isinstance(lora, dict) \
+                    and isinstance(lora.get("resident"), dict):
+                ents.update(lora["resident"])
+            mux = m.get("multiplexed")
+            if mux is None:
+                mux = (m.get("user_stats") or {}).get("multiplexed")
+            if isinstance(mux, dict):
+                for mid in mux:
+                    ents.setdefault(mid, True)
+            elif isinstance(mux, (list, tuple, set)):
+                for mid in mux:
+                    ents.setdefault(mid, True)
+            if ents:
+                residency[rid] = ents
+        return residency
 
     def _note_malformed_summary(self, rid, raw) -> None:
         self._summary_drops += 1
@@ -512,7 +556,7 @@ class DeploymentHandle:
                 fut.set_exception(e)
 
     # -- routing ------------------------------------------------------------
-    def _pick(self, exclude=(), prompt=None,
+    def _pick(self, exclude=(), prompt=None, model_id=None,
               explain: dict | None = None) -> tuple[str, ActorHandle]:
         """Power-of-two choices over in-flight counts, skipping replicas at
         their max_ongoing_requests cap — the routing-side backpressure of
@@ -549,17 +593,24 @@ class DeploymentHandle:
             else:
                 eligible = reps
             choice = None
-            if (prompt is not None
-                    and (self._summaries or self._store_sets)
-                    and kv_router.cache_router_on()):
+            # Residency routing for multiplexed requests: gated by its
+            # own switches (RAY_TPU_LORA + RAY_TPU_LORA_ROUTER — the
+            # bench's blind arm turns only the latter off), independent
+            # of the base-model cache router.
+            lora_pick = (model_id is not None and self._residency
+                         and kv_router.lora_on()
+                         and kv_router.lora_router_on())
+            if lora_pick or (prompt is not None
+                             and (self._summaries or self._store_sets)
+                             and kv_router.cache_router_on()):
                 store = self._store_sets \
                     if self._store_sets and kv_router.prefix_store_on() \
                     else None
-                choice = kv_router.choose(prompt, eligible,
-                                          self._inflight,
-                                          self._summaries,
-                                          explain=explain,
-                                          store=store)
+                choice = kv_router.choose(
+                    prompt, eligible, self._inflight, self._summaries,
+                    explain=explain, store=store,
+                    model_id=model_id if lora_pick else None,
+                    residency=self._residency if lora_pick else None)
             if choice is None:
                 if len(eligible) == 1:
                     choice = eligible[0]
@@ -583,6 +634,7 @@ class DeploymentHandle:
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
             if (self._summaries or self._store_sets) else None,
+            model_id=kv_router.extract_model_id(args, kwargs),
             explain=explain)
         if state is not None:
             state["rid"] = rid
@@ -634,6 +686,7 @@ class DeploymentHandle:
             state["failed"] if state is not None else (),
             prompt=kv_router.extract_prompt(args, kwargs)
             if (self._summaries or self._store_sets) else None,
+            model_id=kv_router.extract_model_id(args, kwargs),
             explain=explain)
         if state is not None:
             state["rid"] = rid

@@ -57,18 +57,31 @@ class _Node:
     chained prefix hash (kv_router.chain_hash over the parent's hash +
     this page's token ids): membership of the hash alone proves the
     whole path root..node is cached — the unit the cluster router's
-    prefix summaries are built from."""
+    prefix summaries are built from.
+
+    Multi-LoRA keying: a non-zero `salt` (the adapter's identity salt,
+    serve/lora.adapter_salt over (model_id, weight version)) prefixes
+    the FIRST chunk's key, so one tree holds per-adapter subtrees whose
+    chained hashes are automatically adapter-distinct — base and
+    adapter KV for the same tokens never alias, and an adapter
+    re-upload (new version → new salt) invalidates exactly its own
+    entries by unreachability.  `toks` keeps the pure token tuple (the
+    demotion path reassembles prompts from it — `key` may carry the
+    salt prefix)."""
 
     __slots__ = ("key", "block", "parent", "children", "last_used",
-                 "hash")
+                 "hash", "toks", "salt")
 
     def __init__(self, key: tuple | None, block: int,
-                 parent: "_Node | None"):
+                 parent: "_Node | None", *, toks: tuple | None = None,
+                 salt: int = 0):
         self.key = key
         self.block = block
         self.parent = parent
         self.children: dict[tuple, _Node] = {}
         self.last_used = 0
+        self.toks = toks if toks is not None else key
+        self.salt = salt
         self.hash = ROOT_HASH if parent is None \
             else chain_hash(parent.hash, key)
 
@@ -117,6 +130,15 @@ class BlockManager:
         n = len(tokens) // self.page
         p = self.page
         return [tuple(tokens[i * p:(i + 1) * p]) for i in range(n)]
+
+    @staticmethod
+    def _keys(chunks: list[tuple], salt: int) -> list[tuple]:
+        """Radix keys for a chunk list: a non-zero adapter salt
+        prefixes the FIRST chunk's key (see _Node), branching the tree
+        per adapter right at the root."""
+        if not salt or not chunks:
+            return chunks
+        return [(salt,) + chunks[0]] + chunks[1:]
 
     @_locked
     def free_count(self) -> int:
@@ -235,16 +257,17 @@ class BlockManager:
 
     # ------------------------------------------------------------- radix
     @_locked
-    def match(self, tokens) -> list[int]:
+    def match(self, tokens, *, salt: int = 0) -> list[int]:
         """Longest cached prefix of `tokens` at block granularity.
         Takes one reference on every matched block (caller releases on
-        finish/preempt) and touches the path's LRU clocks."""
+        finish/preempt) and touches the path's LRU clocks.  `salt`
+        scopes the walk to one adapter's subtree (0 = base model)."""
         if not self.prefix_cache:
             return []
         chunks = self._chunks(tokens)
         node, out = self._root, []
         self._clock += 1
-        for key in chunks:
+        for key in self._keys(chunks, salt):
             child = node.children.get(key)
             if child is None:
                 break
@@ -261,27 +284,30 @@ class BlockManager:
         return out
 
     @_locked
-    def commit(self, tokens, blocks: list[int]) -> None:
+    def commit(self, tokens, blocks: list[int], *, salt: int = 0) -> None:
         """Register a request's computed full blocks in the radix tree
         (called at finish/preempt, BEFORE release, so the blocks become
         cached rather than freed).  blocks[i] holds the KV of token
         chunk i; only chunks fully covered by both `tokens` and
         `blocks` are committed.  A chunk already in the tree keeps its
         existing block (ours stays private and frees on release) —
-        first writer wins, duplicates never alias."""
+        first writer wins, duplicates never alias.  `salt` files the
+        path under the adapter's subtree (0 = base model); KV computed
+        under an adapter must never serve a base-model match."""
         if not self.prefix_cache:
             return
         chunks = self._chunks(tokens)[:len(blocks)]
         node = self._root
         self._clock += 1
-        for i, key in enumerate(chunks):
+        for i, key in enumerate(self._keys(chunks, salt)):
             child = node.children.get(key)
             if child is None:
                 if blocks[i] in self._node_of:
                     # Same block under a different path would alias one
                     # page into two tree positions; stop committing.
                     break
-                child = _Node(key, blocks[i], node)
+                child = _Node(key, blocks[i], node, toks=chunks[i],
+                              salt=salt)
                 node.children[key] = child
                 self._node_of[blocks[i]] = child
                 self._summary_cache = None
@@ -345,13 +371,14 @@ class BlockManager:
             blocks, tokens, hashes = [], [], []
             for nd in path:
                 blocks.append(nd.block)
-                tokens.extend(nd.key)
+                tokens.extend(nd.toks)
                 hashes.append(nd.hash)
             self.retain(blocks)
             self._demoting.add(node.block)
             out.append({"leaf": node.block, "blocks": blocks,
                         "tokens": tokens, "hashes": hashes,
-                        "hash": node.hash, "depth": len(blocks)})
+                        "hash": node.hash, "depth": len(blocks),
+                        "salt": node.salt})
         return out
 
     @_locked

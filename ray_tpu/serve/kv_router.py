@@ -45,6 +45,13 @@ ROOT_HASH = 0
 # many blocks of matched depth (RAY_TPU_CACHE_ROUTER_ALPHA).
 _DEFAULT_ALPHA = 1.0
 
+# Adapter-residency bonus: a replica with the request's LoRA adapter
+# already device-resident scores as if it held this many extra blocks
+# of matched prefix (RAY_TPU_LORA_ROUTER_BETA) — a resident replica
+# must beat a cold one unless its queue is deeply worse, or every
+# request cold-thrashes the whole pool's adapter slots.
+_DEFAULT_LORA_BETA = 8.0
+
 
 def env_on(name: str, default: bool = True) -> bool:
     """Shared kill-switch truthiness rule (one copy — serve modules
@@ -72,11 +79,32 @@ def prefix_store_on() -> bool:
     return env_on("RAY_TPU_PREFIX_STORE")
 
 
+def lora_on() -> bool:
+    """RAY_TPU_LORA kill switch for multi-LoRA serving (serve/lora.py +
+    the engine's adapter path) — read per request/pick: same-run A/B,
+    off = every request serves the base model."""
+    return env_on("RAY_TPU_LORA")
+
+
+def lora_router_on() -> bool:
+    """RAY_TPU_LORA_ROUTER gates ONLY the router's adapter-residency
+    scoring (the bench's blind-routing arm: adapters still serve, but
+    placement ignores residency)."""
+    return env_on("RAY_TPU_LORA_ROUTER")
+
+
 def queue_alpha() -> float:
     try:
         return float(os.environ.get("RAY_TPU_CACHE_ROUTER_ALPHA", ""))
     except ValueError:
         return _DEFAULT_ALPHA
+
+
+def lora_beta() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_LORA_ROUTER_BETA", ""))
+    except ValueError:
+        return _DEFAULT_LORA_BETA
 
 
 def chain_hash(parent: int, chunk) -> int:
@@ -91,14 +119,20 @@ def chain_hash(parent: int, chunk) -> int:
     return int.from_bytes(h.digest(), "little")
 
 
-def prompt_hashes(tokens, page: int) -> list[int]:
+def prompt_hashes(tokens, page: int, salt: int = 0) -> list[int]:
     """Chained hashes of a prompt's FULL blocks (block granularity —
     the radix tree never caches partial pages, so a trailing partial
-    chunk can't match anything)."""
+    chunk can't match anything).  A non-zero adapter `salt` prefixes
+    the FIRST block's hashed bytes — exactly how BlockManager keys
+    salted subtrees — so base and per-adapter KV for the same tokens
+    hash apart everywhere (tree, store directory, router summaries)."""
     n = len(tokens) // page
     out, h = [], ROOT_HASH
     for i in range(n):
-        h = chain_hash(h, tokens[i * page:(i + 1) * page])
+        chunk = tokens[i * page:(i + 1) * page]
+        if salt and i == 0:
+            chunk = (salt,) + tuple(chunk)
+        h = chain_hash(h, chunk)
         out.append(h)
     return out
 
@@ -152,6 +186,22 @@ def extract_prompt(args: tuple, kwargs: dict):
     return None
 
 
+def extract_model_id(args: tuple, kwargs: dict) -> str | None:
+    """Pull a multiplexed model id out of a request payload: LLM
+    requests carry {"model_id": "..."} in the request dict, and
+    `@serve.multiplexed` handlers take model_id as a kwarg.  Anything
+    else → None (base model / not a multiplexed call)."""
+    for v in list(args) + list(kwargs.values()):
+        if isinstance(v, dict):
+            m = v.get("model_id")
+            if isinstance(m, str) and m:
+                return m
+    m = kwargs.get("model_id")
+    if isinstance(m, str) and m:
+        return m
+    return None
+
+
 def store_depth_tokens(prompt, store: dict) -> int:
     """Deepest CLUSTER-RESIDENT prefix of a prompt, in tokens, over the
     tiered store's hash sets ({page: frozenset(hashes)} — the directory
@@ -166,9 +216,25 @@ def store_depth_tokens(prompt, store: dict) -> int:
     return best
 
 
+def _residency_salt(ent) -> int:
+    """Adapter salt out of one residency entry.  Replicas export
+    {model_id: {"salt": int, "age": s}} (LLM engines) or
+    {model_id: True} (plain @serve.multiplexed handlers, no KV salt)."""
+    if isinstance(ent, dict):
+        try:
+            return int(ent.get("salt", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(ent, int) and not isinstance(ent, bool):
+        return ent
+    return 0
+
+
 def choose(prompt, candidates, inflight: dict, summaries: dict,
            explain: dict | None = None,
-           store: dict | None = None) -> str | None:
+           store: dict | None = None,
+           model_id: str | None = None,
+           residency: dict | None = None) -> str | None:
     """Pick the replica with the best prefix-locality score, or None.
 
     score(replica) = matched_depth(prompt, replica) - alpha * inflight.
@@ -187,41 +253,84 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
     discount spreads store-served prompts across the pool (each graft
     then makes its target live-warm — the economy compounding).
 
+    `model_id` + `residency` ({rid: {model_id: entry}}) add LoRA
+    residency: a candidate with the adapter device-resident gets a
+    `lora_beta()` block bonus and its prefix/store match runs under
+    the adapter's KV salt (reported in its residency entry — the
+    router never derives salts itself); a candidate WITHOUT it matches
+    nothing, since its cached base-model prefixes cannot serve the
+    adapter.  When the adapter is resident NOWHERE the least-loaded
+    candidate wins outright — the cold load lands on one replica
+    (which the next poll reports resident: sticky) instead of
+    thrashing every pool member.  residency=None disables all of this
+    (legacy calls / router kill switch).
+
     `explain` (optional dict, mutated in place) receives the winner's
     score breakdown — matched depth in blocks, queue discount, score —
     for the flight recorder's router span."""
     alpha = queue_alpha()
-    hash_cache: dict[int, list[int]] = {}
+    hash_cache: dict[tuple, list[int]] = {}
 
-    def hashes_for(page: int) -> list[int]:
-        hs = hash_cache.get(page)
+    def hashes_for(page: int, salt: int = 0) -> list[int]:
+        hs = hash_cache.get((page, salt))
         if hs is None:
-            hs = prompt_hashes(prompt, page)
-            hash_cache[page] = hs
+            hs = prompt_hashes(prompt, page, salt) if prompt else []
+            hash_cache[(page, salt)] = hs
         return hs
 
-    store_tok = 0
-    store_page = 0
-    if store:
-        for page, cached in sorted(store.items()):
-            d = matched_depth(hashes_for(page), cached) * page
-            if d > store_tok:
-                store_tok, store_page = d, page
+    def store_match(salt: int = 0) -> tuple[int, int]:
+        tok = pg = 0
+        if store:
+            for page, cached in sorted(store.items()):
+                d = matched_depth(hashes_for(page, salt), cached) * page
+                if d > tok:
+                    tok, pg = d, page
+        return tok, pg
+
+    lora = model_id is not None and residency is not None
+    if lora and not any(model_id in (residency.get(r) or {})
+                        for r in candidates):
+        # Cold adapter: deterministic least-loaded placement.
+        rid = min(candidates,
+                  key=lambda r: (inflight.get(r, 0), r))
+        if explain is not None:
+            explain.update(lora_cold=True, model_id=model_id,
+                           inflight=inflight.get(rid, 0))
+        return rid
+    store_tok, store_page = store_match()
     best = None            # ((score-key...), rid, depth)
     any_match = False
     for rid in candidates:
         s = summaries.get(rid)
         depth = 0
         page = s["page"] if s is not None else (store_page or 1)
-        if s is not None:
-            depth = matched_depth(hashes_for(s["page"]), s["set"])
-        # Effective depth in the candidate's block units: live match or
-        # the (replica-independent) store match, whichever is deeper.
-        eff = max(depth * page, store_tok) / page
-        if eff > 0:
+        bonus = 0.0
+        res_ent = (residency.get(rid) or {}).get(model_id) \
+            if lora else None
+        if lora:
+            if res_ent is None:
+                # Non-resident: cached BASE prefixes can't serve the
+                # adapter — no locality at all, queue only.
+                eff = 0.0
+            else:
+                salt = _residency_salt(res_ent)
+                if s is not None:
+                    depth = matched_depth(hashes_for(s["page"], salt),
+                                          s["set"])
+                s_tok, _ = store_match(salt)
+                eff = max(depth * page, s_tok) / page
+                bonus = lora_beta()
+        else:
+            if s is not None:
+                depth = matched_depth(hashes_for(s["page"]), s["set"])
+            # Effective depth in the candidate's block units: live
+            # match or the (replica-independent) store match,
+            # whichever is deeper.
+            eff = max(depth * page, store_tok) / page
+        if eff > 0 or bonus > 0:
             any_match = True
         q = inflight.get(rid, 0)
-        key = (-(eff - alpha * q), q, rid)
+        key = (-(eff + bonus - alpha * q), q, rid)
         if best is None or key < best[0]:
             best = (key, rid, depth)
     if not any_match or best is None:
@@ -232,4 +341,6 @@ def choose(prompt, candidates, inflight: dict, summaries: dict,
                        inflight=best[0][1], alpha=alpha)
         if store_tok:
             explain["store_tokens"] = store_tok
+        if lora:
+            explain["model_id"] = model_id
     return best[1]

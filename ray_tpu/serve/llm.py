@@ -51,6 +51,7 @@ import numpy as np
 
 from ray_tpu import memledger
 from ray_tpu import tracing
+from ray_tpu.exceptions import AdapterLoadError
 from ray_tpu.serve import slo
 from ray_tpu.serve.kv_blocks import BlockManager
 
@@ -230,6 +231,13 @@ class _Request:
     t0_wall: float = field(default_factory=time.time)
     admitted_at: float = 0.0       # perf_counter at slot assignment
     admitted_wall: float = 0.0
+    # Multi-LoRA identity (serve/lora.py): the adapter this request
+    # decodes under (None = base model), resolved at ADMISSION to a
+    # device bank slot (0 = the all-zeros base row) plus the KV salt
+    # keying its radix/prefix-store entries per (adapter, version).
+    model_id: str | None = None
+    lora_slot: int = 0
+    salt: int = 0
 
     def emit(self, tok: int | None) -> None:
         if self.token_queue is not None:
@@ -245,6 +253,8 @@ class LLMEngine:
                  page_size: int = 512, kv_pages: int | None = None,
                  prefix_cache: bool | None = None,
                  kv_preempt: bool | None = None,
+                 lora_slots: int = 0, lora_rank: int = 0,
+                 lora_targets: tuple | None = None,
                  name: str = "llm"):
         import jax
         import jax.numpy as jnp
@@ -307,6 +317,55 @@ class LLMEngine:
         # Per-request sampling base key (see _Request.sample_seed).
         self._base_key = jax.random.PRNGKey(seed + 1)
 
+        # Multi-LoRA device banks (serve/lora.py): per-target stacked
+        # [L, n_slots, din, r] / [L, n_slots, r, dout] arrays that a
+        # per-request int32 slot index gathers inside the ONE jitted
+        # decode/prefill program (models/llama._lora_proj) — adapters
+        # swap by bank-row writes, never by retrace.  Slot 0 is the
+        # all-zeros base row (y + 0.0 == y exactly), so base and
+        # adapter requests mix freely within a batch.
+        self.lora_slots = max(0, int(lora_slots))
+        self.lora_rank = int(lora_rank) if self.lora_slots else 0
+        if self.lora_slots:
+            if not paged:
+                raise ValueError(
+                    "lora_slots > 0 requires a paged engine (adapter "
+                    "KV identity is radix/page-granular)")
+            if self.lora_rank < 1:
+                raise ValueError(
+                    "lora_slots > 0 requires lora_rank >= 1 (bank "
+                    "shapes are static — the XLA invariants)")
+            dims = llama.lora_target_dims(cfg)
+            tgts = tuple(lora_targets or llama.LORA_TARGETS)
+            bad = [t for t in tgts if t not in dims]
+            if bad:
+                raise ValueError(
+                    f"unknown lora targets {bad}; valid: {sorted(dims)}")
+            ns = self.lora_slots + 1
+            self._lora_banks = {
+                t: {"a": jnp.zeros((cfg.n_layers, ns, dims[t][0],
+                                    self.lora_rank), cfg.dtype),
+                    "b": jnp.zeros((cfg.n_layers, ns, self.lora_rank,
+                                    dims[t][1]), cfg.dtype)}
+                for t in tgts}
+            self._lora_free = list(range(1, ns))
+        else:
+            self._lora_banks = None
+            self._lora_free = []
+        # Slot-resolution state: model_id -> bank slot + metadata, the
+        # per-lane slot indices the decode program gathers with, and
+        # the ONE lock covering evict-choose + map-update AND the
+        # admission-time resolution.  load_adapter runs on CALLER
+        # threads; the banks dict swaps atomically and jax arrays are
+        # immutable, so in-flight dispatches keep the tree they
+        # captured.
+        self._lora_lock = threading.Lock()
+        self._lora_map: dict[str, int] = {}
+        self._lora_meta: dict[str, dict] = {}
+        self._adapters = np.zeros((max_batch,), np.int32)
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
+
         def _sample_rows(logits, temps, keys):
             """Per-row sampling: each row draws from ITS OWN key — the
             sample stream belongs to the request, not to the batch."""
@@ -332,7 +391,7 @@ class LLMEngine:
         # factory: each window size compiles once and stays cached.
         def _make_decode(K):
             def _decode_k_dense(params, cache, tokens, temps, table,
-                                seeds, starts):
+                                seeds, starts, lora):
                 lane_keys = jax.vmap(
                     lambda s: jax.random.fold_in(self._base_key,
                                                  s))(seeds)
@@ -351,7 +410,7 @@ class LLMEngine:
                 return seq, last, cache   # seq [K, B]
 
             def _decode_k_paged(params, cache, tokens, temps, table,
-                                seeds, starts):
+                                seeds, starts, lora):
                 """Pages stay OUT of the scan carry (read-only during
                 the block; a carried write would copy the whole pool
                 every step); new rows ride a small dense tail, merged
@@ -374,7 +433,7 @@ class LLMEngine:
                     tails, pos, toks = carry
                     logits, tails = llama.decode_step_paged(
                         params, pages, tails, toks, pos, ts, j, table,
-                        cfg)
+                        cfg, lora)
                     keys = jax.vmap(jax.random.fold_in)(lane_keys,
                                                         starts + j)
                     nxt = _sample_rows(logits, temps, keys)
@@ -445,9 +504,9 @@ class LLMEngine:
         # them (round-5 serve-TTFT rework; the fused program measured
         # ~50ms slower per wave).
         def _prefill_fwd_only(params, tokens, true_lens, slots, temps,
-                              seeds, starts):
+                              seeds, starts, lora):
             W = tokens.shape[0]
-            hidden, ks, vs = llama.prefill(params, tokens, cfg)
+            hidden, ks, vs = llama.prefill(params, tokens, cfg, lora)
             last_h = hidden[jnp.arange(W), true_lens - 1]
             nxt = _first_token(params, last_h, temps, seeds, starts)
             return nxt, ks, vs
@@ -459,10 +518,10 @@ class LLMEngine:
         # prefix through the page pool (llama.prefill_with_prefix).
         # Same split as above: the scatter rides program B.
         def _prefill_suffix_fwd(params, kp, vp, tokens, pos0, prefix_t,
-                                last_idx, temps, seeds, starts):
+                                last_idx, temps, seeds, starts, lora):
             W = tokens.shape[0]
             hidden, ks, vs = llama.prefill_with_prefix(
-                params, tokens, pos0, cfg, kp, vp, prefix_t)
+                params, tokens, pos0, cfg, kp, vp, prefix_t, lora)
             last_h = hidden[jnp.arange(W), last_idx]
             nxt = _first_token(params, last_h, temps, seeds, starts)
             return nxt, ks, vs
@@ -630,6 +689,7 @@ class LLMEngine:
                token_queue: "queue.Queue | None" = None,
                _cache_ok: bool = True,
                prefill_only: bool = False,
+               model_id: str | None = None,
                ) -> concurrent.futures.Future:
         """Thread-safe; resolves to {tokens, ttft_s, total_s}.  With
         `token_queue`, every decoded token is ALSO pushed to the queue as
@@ -637,11 +697,20 @@ class LLMEngine:
         `prefill_only` (paged engines), the result additionally carries
         `kv_export`: the request's KV pages as one host array plus the
         metadata kv_import() needs to resume decoding on ANOTHER engine
-        (the prefill half of disaggregated serving)."""
+        (the prefill half of disaggregated serving).  With `model_id`,
+        the request decodes under that LoRA adapter's bank slot (it
+        must be resident — load_adapter — by ADMISSION time, or the
+        future fails with AdapterLoadError) and its KV cache entries
+        key on the adapter's salt."""
         if prefill_only and not self.paged:
             raise ValueError(
                 "prefill_only requires a paged engine (KV export is "
                 "page-granular)")
+        if model_id is not None and self._lora_banks is None:
+            raise AdapterLoadError(
+                "engine has no adapter slots (set lora_slots)",
+                model_id=model_id, deployment=self.name,
+                reason="lora_slots=0")
         if len(prompt) >= self.max_len:
             raise ValueError(
                 f"prompt length {len(prompt)} >= max_len {self.max_len}")
@@ -670,7 +739,8 @@ class LLMEngine:
             req = _Request(list(prompt), max_new_tokens, temperature,
                            eos_id, concurrent.futures.Future(),
                            token_queue=token_queue, sample_seed=seed,
-                           cache_ok=_cache_ok, prefill_only=prefill_only)
+                           cache_ok=_cache_ok, prefill_only=prefill_only,
+                           model_id=model_id)
             if tracing.ENABLED:
                 req.trace = tracing.capture()
             self._waiting.put(req)
@@ -788,7 +858,7 @@ class LLMEngine:
             self._demote_skip.clear()
 
     def kv_graft(self, tokens: list[int], kv, *, kv_len: int,
-                 weight_version: int | None = None,
+                 weight_version: int | None = None, salt: int = 0,
                  ) -> concurrent.futures.Future:
         """Graft a stored prefix's KV into this engine's pool: scatter
         `kv` (kv_export page layout, [2, L, n, kvh, page, hd]) into
@@ -800,7 +870,8 @@ class LLMEngine:
         resolves to {"grafted": n_blocks} or {"grafted": 0, "reason"}
         when skipped — a `weight_version` mismatch at application time
         NEVER grafts (stale-policy KV must not repollute a flushed
-        cache)."""
+        cache).  `salt` keys the committed radix entry per (adapter,
+        version) — see serve/lora.adapter_salt; 0 = base model."""
         import numpy as np
 
         if not self.paged:
@@ -831,9 +902,207 @@ class LLMEngine:
                 "LLM engine is dead after an earlier failure") \
                 from self._error
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        self._graft_q.put((list(tokens), kv, n, weight_version, fut))
+        self._graft_q.put((list(tokens), kv, n, weight_version,
+                           int(salt), fut))
         self._wake.set()
         return fut
+
+    # ------------------------------------------------------- multi-LoRA
+    def _lora_args(self, idx) -> dict | None:
+        """The per-call `lora` jit argument: None (the static base-path
+        trace) when the engine has no banks, else {"idx": [W] int32
+        bank-slot per lane, "banks": the resident stacks}.  Banks are
+        jit ARGUMENTS, so a load_adapter bank swap changes data, never
+        the compiled program."""
+        if self._lora_banks is None:
+            return None
+        import jax.numpy as jnp
+
+        return {"idx": jnp.asarray(np.asarray(idx, np.int32)),
+                "banks": self._lora_banks}
+
+    def load_adapter(self, model_id: str, adapter: dict, *,
+                     version: int = 1) -> int:
+        """Make an adapter device-resident: validate against THIS
+        engine's config, pick a bank slot (free list, else LRU among
+        slots no in-flight request decodes with), and scatter the
+        [L, din, r]/[L, r, dout] stacks into the slot's bank rows.
+        Functional `.at[:, slot].set()` writes and an atomic banks-dict
+        swap mean this runs on the CALLER thread while decode continues
+        (dispatched programs keep the immutable tree they captured).
+        Re-loading a resident (model_id, version) is a no-op; a new
+        version overwrites in place — its KV salt differs, so stale
+        cached KV goes unreachable rather than corrupt.  Raises a typed
+        AdapterLoadError when the weights don't fit this engine or no
+        slot can be freed — reject early, never a wedged loop.  The
+        `serve.adapter_swap` failpoint fires BEFORE an eviction mutates
+        anything."""
+        import jax.numpy as jnp
+
+        from ray_tpu import failpoints
+        from ray_tpu.models import llama
+        from ray_tpu.serve import lora as lora_mod
+
+        if self._lora_banks is None:
+            raise AdapterLoadError(
+                "engine has no adapter slots (set lora_slots)",
+                model_id=model_id, deployment=self.name,
+                reason="lora_slots=0")
+        targets = (adapter or {}).get("targets") or {}
+        dims = llama.lora_target_dims(self.cfg)
+        rank = 0
+        for t, ab in targets.items():
+            if t not in self._lora_banks:
+                raise AdapterLoadError(
+                    f"adapter targets {t!r} but this engine banks "
+                    f"{sorted(self._lora_banks)}", model_id=model_id,
+                    deployment=self.name, reason="bad_target")
+            a, b = np.asarray(ab["a"]), np.asarray(ab["b"])
+            din, dout = dims[t]
+            if (a.ndim != 3 or b.ndim != 3
+                    or a.shape[0] != self.cfg.n_layers
+                    or a.shape[1] != din or b.shape[2] != dout
+                    or a.shape[2] != b.shape[1]):
+                raise AdapterLoadError(
+                    f"adapter target {t!r} shapes {a.shape}/{b.shape} "
+                    f"do not fit this engine (want "
+                    f"[L={self.cfg.n_layers}, {din}, r] / "
+                    f"[L, r, {dout}])", model_id=model_id,
+                    deployment=self.name, reason="bad_shape")
+            rank = max(rank, a.shape[2])
+        if rank < 1:
+            raise AdapterLoadError(
+                "adapter has no targets", model_id=model_id,
+                deployment=self.name, reason="empty")
+        if rank > self.lora_rank:
+            raise AdapterLoadError(
+                f"adapter rank {rank} exceeds the engine's static bank "
+                f"rank {self.lora_rank} (lora_rank)",
+                model_id=model_id, deployment=self.name,
+                reason="rank_overflow")
+        with self._lora_lock:
+            cur = self._lora_map.get(model_id)
+            if cur is not None \
+                    and self._lora_meta[model_id]["version"] == version:
+                self._lora_meta[model_id]["last_used"] = time.monotonic()
+                return cur
+            if cur is not None:
+                slot = cur                      # re-upload in place
+            elif self._lora_free:
+                slot = self._lora_free.pop(0)
+            else:
+                in_use = {int(s) for s in self._adapters if s}
+                cands = [(self._lora_meta[mid]["last_used"], mid)
+                         for mid, s in self._lora_map.items()
+                         if s not in in_use]
+                if not cands:
+                    raise AdapterLoadError(
+                        "every adapter slot has an in-flight request",
+                        model_id=model_id, deployment=self.name,
+                        reason="no_free_slot")
+                if failpoints.ACTIVE:
+                    # Pre-mutation: an injected fault here must leave
+                    # the resident set exactly as it was.
+                    failpoints.fire("serve.adapter_swap")
+                _, victim = min(cands)
+                slot = self._lora_map.pop(victim)
+                del self._lora_meta[victim]
+                self.adapter_evictions += 1
+                if tracing.ENABLED:
+                    tracing.emit(
+                        "serve.adapter_swap", time.time(),
+                        attrs={"deployment": self.name, "slot": slot,
+                               "loaded": model_id, "evicted": victim})
+            banks = {}
+            for t, bank in self._lora_banks.items():
+                ab = targets.get(t)
+                if ab is None:
+                    # Absent target: zero the slot row (no delta).
+                    a = jnp.zeros_like(bank["a"][:, 0])
+                    b = jnp.zeros_like(bank["b"][:, 0])
+                else:
+                    a = jnp.asarray(ab["a"], bank["a"].dtype)
+                    b = jnp.asarray(ab["b"], bank["b"].dtype)
+                    r = a.shape[2]
+                    if r < self.lora_rank:
+                        # Zero-pad narrow adapters into the static
+                        # bank rank: the padded columns/rows contribute
+                        # exactly zero to the delta.
+                        a = jnp.concatenate(
+                            [a, jnp.zeros(a.shape[:2]
+                                          + (self.lora_rank - r,),
+                                          a.dtype)], axis=2)
+                        b = jnp.concatenate(
+                            [b, jnp.zeros((b.shape[0],
+                                           self.lora_rank - r)
+                                          + b.shape[2:], b.dtype)],
+                            axis=1)
+                banks[t] = {"a": bank["a"].at[:, slot].set(a),
+                            "b": bank["b"].at[:, slot].set(b)}
+            self._lora_banks = banks
+            self._lora_map[model_id] = slot
+            self._lora_meta[model_id] = {
+                "version": int(version),
+                "salt": lora_mod.adapter_salt(model_id, version),
+                "rank": rank, "last_used": time.monotonic()}
+            self.adapter_loads += 1
+            return slot
+
+    def adapter_resident(self, model_id: str,
+                         version: int | None = None) -> bool:
+        """Residency probe (the server's per-request fast path): True
+        when the adapter — at `version`, if given — holds a bank
+        slot."""
+        with self._lora_lock:
+            meta = self._lora_meta.get(model_id)
+            return (meta is not None
+                    and (version is None or meta["version"] == version))
+
+    def adapter_touch(self, model_id: str) -> None:
+        """Stamp an adapter's LRU clock (the server's resident fast
+        path calls this per request): eviction must rank by actual
+        request traffic, not by load/swap times — a hot adapter that
+        never reloads would otherwise look permanently stale."""
+        with self._lora_lock:
+            meta = self._lora_meta.get(model_id)
+            if meta is not None:
+                meta["last_used"] = time.monotonic()
+
+    def adapter_salt_of(self, model_id: str | None) -> int:
+        """KV salt of a RESIDENT adapter (0 = base / not resident) —
+        the prefix-store miss path keys its directory lookup with
+        this."""
+        if model_id is None or self._lora_banks is None:
+            return 0
+        with self._lora_lock:
+            meta = self._lora_meta.get(model_id)
+            return meta["salt"] if meta else 0
+
+    def _resolve_adapter(self, req: _Request, lane: int) -> bool:
+        """Admission-time model_id → bank-slot resolution (loop
+        thread).  Marks the LANE in _adapters under the lora lock
+        BEFORE any block work, so a concurrent load_adapter can never
+        evict the slot this admission is about to decode with (the
+        mark is undone if block reservation fails).  A missing adapter
+        — never loaded, or evicted since the server's residency check
+        — fails the ONE request with AdapterLoadError: reject early,
+        never wedge the loop."""
+        with self._lora_lock:
+            slot = self._lora_map.get(req.model_id)
+            if slot is not None:
+                meta = self._lora_meta[req.model_id]
+                req.lora_slot = slot
+                req.salt = meta["salt"]
+                meta["last_used"] = time.monotonic()
+                self._adapters[lane] = slot
+                return True
+        req.emit(None)
+        if not req.future.done():
+            req.future.set_exception(AdapterLoadError(
+                "adapter not resident at admission",
+                model_id=req.model_id, deployment=self.name,
+                reason="not_resident"))
+        return False
 
     def update_weights(self, refs, version: int | None = None) -> int:
         """Stage a fresh policy param tree for LIVE weight sync (the
@@ -1034,12 +1303,17 @@ class LLMEngine:
         except Exception:  # noqa: BLE001 - exotic cache leaves
             pool_bytes = 0
         per_page = pool_bytes // max(1, self.n_pages)
+        lora_bytes = 0
+        if self._lora_banks is not None:
+            lora_bytes = int(sum(
+                x.size * x.dtype.itemsize
+                for t in self._lora_banks.values() for x in t.values()))
         self._memledger_provider = f"llm:{self.name}:{id(self):x}"
 
         def _rows():
             st = self._mgr.stats()
             used = st["n_blocks"] - st["free"]
-            return [{"object_id": f"kvpool:{self.name}",
+            rows = [{"object_id": f"kvpool:{self.name}",
                      "size": used * per_page, "tag": "hbm_kv",
                      "tier": "hbm",
                      "callsite": f"serve/llm.py engine {self.name}",
@@ -1047,6 +1321,14 @@ class LLMEngine:
                      "blocks_used": used,
                      "blocks_total": st["n_blocks"],
                      "blocks_cached": st["cached"]}]
+            if lora_bytes:
+                rows.append({
+                    "object_id": f"lorabanks:{self.name}",
+                    "size": lora_bytes, "tag": "lora_banks",
+                    "tier": "hbm",
+                    "callsite": f"serve/llm.py engine {self.name}",
+                    "slots": self.lora_slots, "rank": self.lora_rank})
+            return rows
 
         memledger.register_provider(self._memledger_provider, _rows)
 
@@ -1116,7 +1398,8 @@ class LLMEngine:
 
         while True:
             try:
-                tokens, kv, n, wv, fut = self._graft_q.get_nowait()
+                tokens, kv, n, wv, salt, fut = \
+                    self._graft_q.get_nowait()
             except queue.Empty:
                 return
             try:
@@ -1146,7 +1429,7 @@ class LLMEngine:
                         # Commit BEFORE release: the blocks become
                         # cached-evictable instead of freed (the
                         # _release_slot discipline).
-                        self._mgr.commit(tokens, blocks)
+                        self._mgr.commit(tokens, blocks, salt=salt)
                         self._mgr.release(blocks)
                         self.kv_grafts += 1
                         self.graft_tokens += n * self.page
@@ -1242,7 +1525,7 @@ class LLMEngine:
                 published = bool(self._demote_cb(dict(
                     tokens=c["tokens"], kv=host, hashes=c["hashes"],
                     depth=c["depth"], page=self.page,
-                    weight_version=wv)))
+                    weight_version=wv, salt=c.get("salt", 0))))
             except BaseException:  # noqa: BLE001 - injected faults
                 self.demote_failures += 1
             if not published:
@@ -1272,7 +1555,7 @@ class LLMEngine:
         remaining = req.max_new_tokens - len(req.tokens)
         # Imported-KV requests never match the local cache: their pages
         # arrive by scatter and must be fresh private blocks.
-        matched = mgr.match(seq) \
+        matched = mgr.match(seq, salt=req.salt) \
             if (req.cache_ok and req.import_kv is None) else []
         matched_tokens = len(matched) * self.page
         cover = total + (min(remaining, self._k_live)
@@ -1358,11 +1641,23 @@ class LLMEngine:
                     break
                 continue
             req = self._pending[0]
+            if req.model_id is not None \
+                    and not self._resolve_adapter(req, free):
+                # Unknown/evicted adapter: fail THIS request early and
+                # keep admitting — an adapter miss must never become a
+                # head-of-line barrier.
+                self._pending.popleft()
+                continue
             if self.paged:
                 # The block pool is the admission control: the FRONT
                 # request blocks FIFO when free + evictable can't cover
                 # it (vLLM-style KV backpressure; nothing skips past).
                 if not self._reserve_blocks(req, copies):
+                    if req.lora_slot:
+                        # Undo the lane's slot mark — the request is
+                        # NOT decoding; its adapter stays evictable.
+                        with self._lora_lock:
+                            self._adapters[free] = 0
                     break
                 self._table[free, :] = 0
                 self._table[free, :len(req.pages)] = req.pages
@@ -1449,6 +1744,14 @@ class LLMEngine:
                 # share the window), first-token marker.
                 tracing.emit("llm.queue", req.t0_wall,
                              req.admitted_wall, ctx=req.trace)
+                if req.model_id is not None:
+                    # The adapter APPLY leg: this request's decode
+                    # gathers bank slot `lora_slot` from here on.
+                    tracing.emit(
+                        "serve.adapter_apply", req.admitted_wall,
+                        req.admitted_wall, ctx=req.trace,
+                        attrs={"model_id": req.model_id,
+                               "slot": req.lora_slot})
                 tracing.emit(
                     "llm.prefill", t_disp, now_wall, ctx=req.trace,
                     attrs={"prompt_tokens": len(req.prompt),
@@ -1484,6 +1787,7 @@ class LLMEngine:
         temps = np.zeros((padded_w,), np.float32)
         seeds = np.zeros((padded_w,), np.int32)
         starts = np.zeros((padded_w,), np.int32)
+        lidx = np.zeros((padded_w,), np.int32)
         for j in range(padded_w):
             slot, req = chunk[min(j, W - 1)]
             seq = req.prompt + req.tokens   # resume: recompute full seq
@@ -1493,6 +1797,7 @@ class LLMEngine:
             temps[j] = req.temperature
             seeds[j] = req.sample_seed
             starts[j] = len(req.tokens)
+            lidx[j] = req.lora_slot
         for _, req in chunk:
             self.prefill_tokens += len(req.prompt) + len(req.tokens)
         slots_dev = jnp.asarray(slots)
@@ -1506,7 +1811,7 @@ class LLMEngine:
             nxt, ks, vs = self._prefill_fwd(
                 self.params, jnp.asarray(tokens), lens_dev,
                 slots_dev, jnp.asarray(temps), jnp.asarray(seeds),
-                jnp.asarray(starts))
+                jnp.asarray(starts), self._lora_args(lidx))
             self.cache = self._scatter_pages(
                 self.cache, ks, vs, jnp.asarray(page_ids),
                 jnp.asarray(rows), slots_dev, lens_dev)
@@ -1540,6 +1845,7 @@ class LLMEngine:
         temps = np.zeros((padded_w,), np.float32)
         seeds = np.zeros((padded_w,), np.int32)
         starts = np.zeros((padded_w,), np.int32)
+        lidx = np.zeros((padded_w,), np.int32)
         for j in range(padded_w):
             slot, req = chunk[min(j, W - 1)]
             seq = req.prompt + req.tokens
@@ -1552,6 +1858,7 @@ class LLMEngine:
             temps[j] = req.temperature
             seeds[j] = req.sample_seed
             starts[j] = len(req.tokens)
+            lidx[j] = req.lora_slot
         for _, req in chunk:
             self.prefill_tokens += (len(req.prompt) + len(req.tokens)
                                     - req.prefill_from)
@@ -1568,7 +1875,8 @@ class LLMEngine:
             self.params, self.cache["k"], self.cache["v"],
             jnp.asarray(tokens), jnp.asarray(pos0),
             jnp.asarray(self._table[slots]), jnp.asarray(last_idx),
-            jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(starts))
+            jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(starts),
+            self._lora_args(lidx))
         self.cache = self._scatter_pages_coord(
             self.cache, ks, vs, jnp.asarray(page_ids),
             jnp.asarray(rows), slots_dev, jnp.asarray(true_lens))
@@ -1732,7 +2040,8 @@ class LLMEngine:
             # of) its KV under the OLD policy — committing it would
             # repollute the freshly-flushed cache with stale pages.
             self._mgr.commit(req.prompt + req.tokens,
-                             req.pages[:kv_valid // self.page])
+                             req.pages[:kv_valid // self.page],
+                             salt=req.salt)
         self._mgr.release(req.pages)
         req.pages = []
         # The freed slot's future (garbage) decode writes go to the
@@ -1745,6 +2054,7 @@ class LLMEngine:
     def _finish(self, slot: int) -> None:
         req = self._slots[slot]
         self._slots[slot] = None
+        self._adapters[slot] = 0      # the lane's adapter is evictable
         self.completed += 1
         if req.prefill_only and self.paged and req.pages \
                 and not (req.eos_id is not None and req.tokens
@@ -1825,6 +2135,7 @@ class LLMEngine:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._seeds[slot] = 0
+        self._adapters[slot] = 0
         self._release_slot(slot, req)
         req.slot = -1
         req.preempted += 1
@@ -1935,7 +2246,8 @@ class LLMEngine:
             seq, last, self.cache = decode(
                 self.params, self.cache, self._cur_dev,
                 jnp.asarray(self._temps), self._table_dev,
-                jnp.asarray(self._seeds), jnp.asarray(starts))
+                jnp.asarray(self._seeds), jnp.asarray(starts),
+                self._lora_args(self._adapters))
             self._cur_dev = last                # stays on device
             seq = np.asarray(seq)               # the ONE sync per block
             if win_traced:
@@ -2043,6 +2355,25 @@ class LLMEngine:
                "slo": self._slo_window.snapshot(),
                "sync_window": self._k_live,
                "sync_window_shrinks": self.sync_window_shrinks}
+        if self._lora_banks is not None:
+            with self._lora_lock:
+                now = time.monotonic()
+                out["lora"] = {
+                    "slots": self.lora_slots,
+                    "rank": self.lora_rank,
+                    "free": len(self._lora_free),
+                    "loads": self.adapter_loads,
+                    "evictions": self.adapter_evictions,
+                    # Residency export → replica_metrics → the handle's
+                    # summary poll → kv_router.choose: the salt lets
+                    # the router score salted prompt hashes per
+                    # candidate; age drives its LRU reasoning.
+                    "resident": {
+                        mid: {"salt": m["salt"],
+                              "version": m["version"],
+                              "age": round(now - m["last_used"], 3)}
+                        for mid, m in self._lora_meta.items()},
+                }
         if self._mgr is not None:
             kv = self._mgr.stats()
             out["kv"] = kv
@@ -2081,6 +2412,13 @@ class LLMServer:
     replica itself — same-run A/B.
     """
 
+    # Adapter requests re-page + resubmit this many times total when a
+    # concurrent tenant's load evicts their adapter between the
+    # server's page-in and engine-loop admission (slots thrash when
+    # adapters >> slots).  Admission precedes block/lane allocation and
+    # the first token, so a resubmit is invisible to the client.
+    _LORA_ADMIT_RETRIES = 3
+
     def __init__(self, model: str = "debug", *, max_batch: int = 8,
                  max_len: int | None = None, params=None, seed: int = 0,
                  warmup: bool = False, paged: bool = True,
@@ -2090,7 +2428,9 @@ class LLMServer:
                  steps_per_sync: int = 8,
                  role: str = "unified",
                  decode_deployment=None,
-                 prefix_store: dict | None = None):
+                 prefix_store: dict | None = None,
+                 lora_slots: int = 0, lora_rank: int = 0,
+                 lora_directory=None):
         from ray_tpu.models import llama
 
         _check_pool_role(role, decode_deployment)
@@ -2115,7 +2455,8 @@ class LLMServer:
             max_batch=max_batch, max_len=max_len, seed=seed, paged=paged,
             page_size=page_size, kv_pages=kv_pages,
             prefix_cache=prefix_cache, kv_preempt=kv_preempt,
-            steps_per_sync=steps_per_sync, name=name)
+            steps_per_sync=steps_per_sync, lora_slots=lora_slots,
+            lora_rank=lora_rank, name=name)
         self._cfg = cfg
         self._params = params
         self._warmup = warmup
@@ -2152,6 +2493,21 @@ class LLMServer:
         # "watermark_frac", "min_tokens", "migrate_ms", ...}).
         self._prefix_store_cfg = dict(prefix_store or {})
         self._prefix_client = None
+        # Multi-LoRA page-in state (serve/lora.py): ONE in-flight load
+        # per model_id (racing requests park on its future) + a short
+        # TTL cache of the directory's (version) answer so the
+        # resident-adapter fast path costs zero controller round
+        # trips.  `lora_directory` injects an in-process
+        # AdapterDirectory (tests / local mode).
+        self._lora_client = None
+        self._lora_directory = lora_directory
+        self._lora_inflight: dict = {}
+        self._lora_inflight_lock = threading.Lock()
+        self._lora_seen: dict[str, tuple[float, int]] = {}
+        self._lora_ttl = float(
+            os.environ.get("RAY_TPU_LORA_TTL_S", "2.0") or 0.0)
+        self.adapter_load_errors = 0
+        self.adapter_admit_retries = 0
         self._closed = False
         self.engine = LLMEngine(cfg, params, **self._engine_kwargs)
         self._install_prefix_store()
@@ -2201,6 +2557,112 @@ class LLMServer:
             limit=cfg.get("limit", 2),
             max_inflight=cfg.get("max_inflight", 2))
 
+    # -------------------------------------------------- multi-LoRA
+    def _request_model_id(self, request) -> str | None:
+        """The request's adapter identity, gated PER REQUEST by the
+        RAY_TPU_LORA kill switch (off → every request serves the base
+        model — the same-run A/B arm).  Absent {"model_id": ...} =
+        base model, always."""
+        from ray_tpu.serve import kv_router
+
+        if not isinstance(request, dict):
+            return None
+        mid = request.get("model_id")
+        if mid is None or not kv_router.lora_on():
+            return None
+        return mid
+
+    def _ensure_adapter_sync(self, model_id: str,
+                             trace_ctx=None) -> None:
+        """Make `model_id` device-resident before submit (blocking —
+        callers keep it off the event loop).  Fast path: resident at
+        the version the directory reported within the last
+        RAY_TPU_LORA_TTL_S seconds — zero controller round trips.
+        Slow path: ONE in-flight load per model_id (racing requests
+        park on its future): directory lookup → object-plane pull
+        (same-host direct-shm / cross-node streaming — the normal get
+        path) → engine.load_adapter.  Every failure surfaces as a
+        typed AdapterLoadError BEFORE the request holds a batch slot;
+        the `serve.adapter_load` failpoint fires at entry, so an
+        injected fault degrades to reject-early, never a wedged
+        engine loop."""
+        from ray_tpu import failpoints
+        from ray_tpu.serve import lora as lora_mod
+
+        eng = self.engine
+        if failpoints.ACTIVE:
+            try:
+                failpoints.fire("serve.adapter_load")
+            except BaseException as e:  # noqa: BLE001 - typed reject
+                self.adapter_load_errors += 1
+                raise AdapterLoadError(
+                    f"adapter load faulted: {type(e).__name__}: {e}",
+                    model_id=model_id, deployment=eng.name,
+                    reason="load_failed") from e
+        if eng._lora_banks is None:
+            raise AdapterLoadError(
+                "deployment has no adapter slots (set engine_config "
+                "lora_slots)", model_id=model_id, deployment=eng.name,
+                reason="lora_slots=0")
+        now = time.monotonic()
+        seen = self._lora_seen.get(model_id)
+        if seen and seen[0] > now \
+                and eng.adapter_resident(model_id, seen[1]):
+            eng.adapter_touch(model_id)
+            return
+        with self._lora_inflight_lock:
+            fut = self._lora_inflight.get(model_id)
+            owner = fut is None
+            if owner:
+                fut = concurrent.futures.Future()
+                self._lora_inflight[model_id] = fut
+        if not owner:
+            fut.result(timeout=120.0)   # re-raises the owner's error
+            return
+        try:
+            t0 = time.time()
+            try:
+                if self._lora_client is None:
+                    self._lora_client = lora_mod.LoraClient(
+                        directory=self._lora_directory)
+                entry = self._lora_client.lookup(model_id)
+                if entry is None:
+                    raise AdapterLoadError(
+                        "no such adapter published",
+                        model_id=model_id, deployment=eng.name,
+                        reason="not_published")
+                if not eng.adapter_resident(model_id,
+                                            entry["version"]):
+                    adapter = lora_mod.resolve_entry(entry)
+                    eng.load_adapter(model_id, adapter,
+                                     version=entry["version"])
+                    if tracing.ENABLED:
+                        tracing.emit(
+                            "serve.adapter_load", t0, time.time(),
+                            ctx=trace_ctx,
+                            attrs={"model_id": model_id,
+                                   "deployment": eng.name,
+                                   "version": entry["version"],
+                                   "bytes": entry.get("nbytes", 0)})
+                eng.adapter_touch(model_id)
+                self._lora_seen[model_id] = (
+                    time.monotonic() + self._lora_ttl,
+                    entry["version"])
+                fut.set_result(None)
+            except BaseException as e:  # noqa: BLE001 - typed reject
+                self.adapter_load_errors += 1
+                err = e if isinstance(e, AdapterLoadError) \
+                    else AdapterLoadError(
+                        f"adapter load faulted: "
+                        f"{type(e).__name__}: {e}",
+                        model_id=model_id, deployment=eng.name,
+                        reason="load_failed")
+                fut.set_exception(err)
+                raise err from (None if e is err else e)
+        finally:
+            with self._lora_inflight_lock:
+                self._lora_inflight.pop(model_id, None)
+
     def _graft_eligible(self, request) -> bool:
         """ONE copy of the miss-path gate for the unary and streaming
         entry points (they must never diverge): a store-capable
@@ -2230,8 +2692,14 @@ class LLMServer:
         if not self._graft_eligible(request):
             return
         try:
+            # Adapter requests graft under the adapter's salt: a tier-2
+            # entry only matches KV computed by the SAME (adapter,
+            # version) — the base model's cache and every other
+            # adapter's hash to disjoint keys.
+            mid = self._request_model_id(request)
+            salt = self.engine.adapter_salt_of(mid) if mid else 0
             self._prefix_client.maybe_graft(
-                self.engine, list(request["prompt"]))
+                self.engine, list(request["prompt"]), salt=salt)
         except Exception:  # noqa: BLE001 - degrade, never fail
             pass
 
@@ -2471,19 +2939,45 @@ class LLMServer:
         # same engine, same seed, token-identical output, minus the
         # migration round trips the overloaded pool can't afford.
         level = self._update_pressure()
-        if level < 1:
-            # Overloaded replicas (level >= 1) skip the store entirely:
-            # a migration's extra bytes/RTs are exactly what a drowning
-            # pool can't afford — the degradation-ladder discipline.
-            await self._maybe_graft_async(request)
-        if level < 1 and self._disagg(request):
-            return await self._prefill_decode(request)
-        fut = self.engine.submit(
-            request["prompt"],
-            max_new_tokens=request.get("max_new_tokens", 32),
-            temperature=request.get("temperature", 0.0),
-            eos_id=request.get("eos_id"))
-        return await asyncio.wrap_future(fut)
+        model_id = self._request_model_id(request)
+        attempts = self._LORA_ADMIT_RETRIES if model_id is not None else 1
+        for attempt in range(attempts):
+            if model_id is not None:
+                # Adapter page-in BEFORE the graft lookup: the radix /
+                # store keys are salted per (adapter, version), and the
+                # salt is only known once the directory's version is.
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._ensure_adapter_sync, model_id,
+                    tracing.current())
+            if level < 1 and attempt == 0:
+                # Overloaded replicas (level >= 1) skip the store
+                # entirely: a migration's extra bytes/RTs are exactly
+                # what a drowning pool can't afford — the
+                # degradation-ladder discipline.
+                await self._maybe_graft_async(request)
+            # Adapter requests serve unified: the KV export/import leg
+            # would also have to ship adapter identity and the decode
+            # pool re-page the weights — cost without benefit at LoRA
+            # sizes.
+            if level < 1 and model_id is None and self._disagg(request):
+                return await self._prefill_decode(request)
+            fut = self.engine.submit(
+                request["prompt"],
+                max_new_tokens=request.get("max_new_tokens", 32),
+                temperature=request.get("temperature", 0.0),
+                eos_id=request.get("eos_id"),
+                model_id=model_id)
+            try:
+                return await asyncio.wrap_future(fut)
+            except AdapterLoadError as e:
+                if e.reason != "not_resident" or attempt >= attempts - 1:
+                    raise
+                # Evicted between page-in and admission by a concurrent
+                # tenant's load (slots thrash when adapters >> slots).
+                # The request held no blocks or lanes yet — admission
+                # failed before any — so re-page and resubmit.
+                self._lora_seen.pop(model_id, None)
+                self.adapter_admit_retries += 1
 
     def stream(self, request: dict):
         """Token-streaming generator: yields each token id as the engine
@@ -2495,29 +2989,45 @@ class LLMServer:
         # streaming-only workload could neither enter overload nor
         # restore a previously-shrunk sync window.
         level = self._update_pressure()
-        if level < 1:
-            # stream() runs on a pool thread — blocking is fine.
-            self._maybe_graft_sync(request)
-        q: queue.Queue = queue.Queue()
-        fut = self.engine.submit(
-            request["prompt"],
-            max_new_tokens=request.get("max_new_tokens", 32),
-            temperature=request.get("temperature", 0.0),
-            eos_id=request.get("eos_id"),
-            token_queue=q)
-        while True:
-            tok = q.get()
-            if tok is None:
-                break
-            yield tok
-        # The None sentinel is emitted just BEFORE the future resolves;
-        # wait briefly so an engine failure can't silently truncate the
-        # stream as a clean-looking completion.
-        try:
-            exc = fut.exception(timeout=5.0)
-        except concurrent.futures.TimeoutError:
-            exc = None
-        if exc is not None:
+        model_id = self._request_model_id(request)
+        attempts = self._LORA_ADMIT_RETRIES if model_id is not None else 1
+        for attempt in range(attempts):
+            if model_id is not None:
+                # stream() runs on a pool thread — blocking is fine.
+                self._ensure_adapter_sync(model_id, tracing.current())
+            if level < 1 and attempt == 0:
+                self._maybe_graft_sync(request)
+            q: queue.Queue = queue.Queue()
+            fut = self.engine.submit(
+                request["prompt"],
+                max_new_tokens=request.get("max_new_tokens", 32),
+                temperature=request.get("temperature", 0.0),
+                eos_id=request.get("eos_id"),
+                token_queue=q,
+                model_id=model_id)
+            while True:
+                tok = q.get()
+                if tok is None:
+                    break
+                yield tok
+            # The None sentinel is emitted just BEFORE the future
+            # resolves; wait briefly so an engine failure can't silently
+            # truncate the stream as a clean-looking completion.
+            try:
+                exc = fut.exception(timeout=5.0)
+            except concurrent.futures.TimeoutError:
+                exc = None
+            if exc is None:
+                return
+            if (isinstance(exc, AdapterLoadError)
+                    and exc.reason == "not_resident"
+                    and attempt < attempts - 1):
+                # Admission-time eviction race (see __call__): nothing
+                # was streamed — admission precedes the first token —
+                # so a re-paged resubmit is transparent to the consumer.
+                self._lora_seen.pop(model_id, None)
+                self.adapter_admit_retries += 1
+                continue
             raise exc
 
     def stats(self) -> dict:
@@ -2539,6 +3049,9 @@ class LLMServer:
         out["prefix_store"] = (self._prefix_client.stats()
                                if self._prefix_client is not None
                                else {"enabled": False})
+        if "lora" in out:
+            out["lora"]["load_errors"] = self.adapter_load_errors
+            out["lora"]["admit_retries"] = self.adapter_admit_retries
         return out
 
     def reconfigure(self, user_config: dict) -> None:
@@ -2606,6 +3119,10 @@ class LLMServer:
         # constructor failure must not leave a half-applied role on top
         # of the (unavoidably) stopped engine.
         self.engine = LLMEngine(self._cfg, self._params, **kwargs)
+        # The fresh engine's banks are empty: drop the residency TTL
+        # cache so the next adapter request re-pages rather than
+        # trusting a stale "resident" answer.
+        self._lora_seen.clear()
         commit_roles()
         if ps_given is not None:
             self._prefix_store_cfg = dict(ps_given)

@@ -1,0 +1,367 @@
+"""Multi-LoRA adapter registry: thousands of fine-tunes, one engine.
+
+The million-user serving regime is many small fine-tunes time-sharing a
+handful of base models (S-LoRA, Punica; the reference's Serve model
+multiplexing).  This module is the OFF-ENGINE half of that subsystem:
+
+  - **Cold adapters** live as sealed arena objects — published exactly
+    like tier-2 prefixes (serve/prefix_store.py): one `ray_tpu.put` per
+    adapter, memledger-tagged per tenant, paged in over the object
+    plane's same-host direct-shm / cross-node streaming get path.  The
+    controller-side **AdapterDirectory** indexes model_id → (ref,
+    version, rank); every upload bumps the version.
+  - **Hot adapters** are device-resident bank rows inside the paged
+    LLMEngine (serve/llm.py): per-target [L, n_slots, din, r] /
+    [L, n_slots, r, dout] stacks a per-request int32 index gathers
+    inside ONE jitted decode/prefill program (models/llama._lora_proj)
+    — never a retrace per adapter, and a batch freely mixes adapters.
+  - **KV identity**: `adapter_salt(model_id, version)` keys the radix
+    tree / prefix store / router hashes per (base seed, adapter,
+    version) — an adapter re-upload or RLHF swap invalidates exactly
+    its own cached KV (a new version hashes to a different subtree;
+    stale entries become unreachable and LRU out).
+
+Kill switches: RAY_TPU_LORA=0 (per request — same-run A/B; off =
+requests serve the base model) and RAY_TPU_LORA_ROUTER=0 (residency
+routing only — the bench's blind-routing arm).  Failpoint sites
+`serve.adapter_load` / `serve.adapter_swap` are armed on the server /
+engine load legs (serve/llm.py) — a load fault degrades to a typed
+AdapterLoadError rejection, never a wedged engine loop.
+
+Dependency-light by the layering invariant: core primitives + public
+facades (memledger, tracing) + serve siblings (kv_router) only.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from ray_tpu.serve.kv_router import lora_on, lora_router_on  # noqa: F401
+
+# Named actor the client resolves lazily (literal, NOT imported from
+# serve/controller.py — the controller imports this module for its
+# directory, and the reverse import would cycle).
+_CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def adapter_salt(model_id: str, version: int) -> int:
+    """KV-identity salt for (adapter, version): a 63-bit blake2b int
+    (non-zero; fits chain_hash's signed-8-byte token encoding) that
+    prefixes the first radix chunk of every prompt served under this
+    adapter — see kv_blocks._Node / kv_router.prompt_hashes.  The
+    VERSION is inside the salt, so a re-upload invalidates old KV by
+    unreachability rather than by scrubbing.  Process-stable (never
+    `hash()`)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(model_id.encode())
+    h.update(int(version).to_bytes(8, "little", signed=True))
+    return (int.from_bytes(h.digest(), "little") & ((1 << 63) - 1)) or 1
+
+
+def _adapter_nbytes(adapter: dict) -> int:
+    n = 0
+    for ab in adapter.get("targets", {}).values():
+        for arr in ab.values():
+            n += int(getattr(arr, "nbytes", 0))
+    return n
+
+
+def _validate_adapter(adapter: dict) -> int:
+    """Shape-contract check at PUBLISH time (the engine re-validates
+    against its own config at load).  Returns the adapter's rank."""
+    if not isinstance(adapter, dict) or "targets" not in adapter:
+        raise ValueError(
+            "adapter must be {'rank': int, 'targets': {name: {'a', "
+            "'b'}}} (see models/llama.init_lora_adapter)")
+    rank = int(adapter.get("rank", 0))
+    for t, ab in adapter["targets"].items():
+        a, b = ab.get("a"), ab.get("b")
+        if a is None or b is None:
+            raise ValueError(f"adapter target {t!r} missing 'a'/'b'")
+        if a.ndim != 3 or b.ndim != 3 or a.shape[2] != b.shape[1]:
+            raise ValueError(
+                f"adapter target {t!r}: want a [L, din, r] / "
+                f"b [L, r, dout], got {a.shape} / {b.shape}")
+        if rank and a.shape[2] != rank:
+            raise ValueError(
+                f"adapter target {t!r}: rank {a.shape[2]} != declared "
+                f"rank {rank}")
+        rank = rank or int(a.shape[2])
+    if rank < 1:
+        raise ValueError("adapter has no targets")
+    return rank
+
+
+class AdapterDirectory:
+    """Controller-side registry of published adapters (one instance on
+    the ServeController; tests may instantiate one directly and hand it
+    to a LoraClient, which then calls it in-process instead of over
+    RPC).  The directory holds a borrowed ref per entry — the borrow
+    keeps the sealed bytes alive after the publisher drops its local
+    handle, and dropping the entry (forget/clear) releases them.  The
+    publisher process is still the object's OWNER (object-plane
+    discipline: owner-is-truth); a publisher that exits strands its
+    adapters, so long-lived tenants re-publish from a live process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # model_id -> {"ref", "version", "rank", "nbytes", "tenant", "t"}
+        self._adapters: dict[str, dict] = {}
+        self.published = 0
+        self.forgotten = 0
+        self.lookups = 0
+        self.lookup_misses = 0
+
+    def publish(self, model_id: str, meta: dict, ref) -> dict:
+        """Register (or re-upload) one adapter.  The directory owns
+        versioning: every publish of a model_id bumps its version, so
+        adapter_salt(model_id, version) — and with it every cached KV
+        key — rolls over atomically with the weights.
+
+        `ref` arrives wrapped in a one-element list when it crosses the
+        controller RPC: a TOP-LEVEL ObjectRef arg is resolved to its
+        value before execution (worker arg semantics), which would make
+        the directory hold the whole host pytree and let the arena
+        object die; nested refs stay refs and register this process as
+        a borrower, so the sealed bytes outlive the publisher's local
+        handle and lookups stay meta-only."""
+        if isinstance(ref, list):
+            ref = ref[0]
+        with self._lock:
+            old = self._adapters.get(model_id)
+            version = (old["version"] + 1) if old else 1
+            self._adapters[model_id] = {
+                "ref": ref,
+                "version": version,
+                "rank": int(meta.get("rank", 0)),
+                "nbytes": int(meta.get("nbytes", 0)),
+                "tenant": meta.get("tenant"),
+                "t": time.monotonic(),
+            }
+            self.published += 1
+        return {"version": version,
+                "salt": adapter_salt(model_id, version)}
+
+    def lookup(self, model_id: str) -> dict | None:
+        with self._lock:
+            self.lookups += 1
+            e = self._adapters.get(model_id)
+            if e is None:
+                self.lookup_misses += 1
+                return None
+            return {"ref": e["ref"], "version": e["version"],
+                    "rank": e["rank"], "nbytes": e["nbytes"],
+                    "salt": adapter_salt(model_id, e["version"])}
+
+    def forget(self, model_id: str) -> bool:
+        with self._lock:
+            e = self._adapters.pop(model_id, None)
+            if e is not None:
+                self.forgotten += 1
+            return e is not None
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._adapters)
+            self._adapters.clear()
+            self.forgotten += n
+        return n
+
+    def summary(self) -> dict:
+        """model_id -> version, for state APIs / dashboards."""
+        with self._lock:
+            return {m: e["version"] for m, e in self._adapters.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "adapters": len(self._adapters),
+                "bytes": sum(e["nbytes"]
+                             for e in self._adapters.values()),
+                "published": self.published,
+                "forgotten": self.forgotten,
+                "lookups": self.lookups,
+                "lookup_misses": self.lookup_misses,
+            }
+
+
+def _object_plane_ready() -> bool:
+    """Same probe as prefix_store: an initialized driver OR a connected
+    worker can put/get arena objects."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        return True
+    try:
+        from ray_tpu.runtime_context import get_runtime_context
+
+        get_runtime_context()
+        return True
+    except Exception:  # noqa: BLE001 - no worker in this process
+        return False
+
+
+class LoraClient:
+    """Publish / fetch half used by drivers (upload) and replicas
+    (page-in).  Mirrors PrefixStoreClient's transport: an injected
+    in-process AdapterDirectory (tests) or the controller's lora_*
+    RPC verbs."""
+
+    def __init__(self, directory: AdapterDirectory | None = None):
+        self._directory = directory
+        self._lock = threading.Lock()
+        self._ctrl = None
+        self._ctrl_retry_at = 0.0
+
+    def _controller(self):
+        if self._directory is not None:
+            return None
+        if not _object_plane_ready():
+            return None
+        import ray_tpu
+
+        with self._lock:
+            if self._ctrl is not None:
+                return self._ctrl
+            if time.monotonic() < self._ctrl_retry_at:
+                return None
+        try:
+            ctrl = ray_tpu.get_actor(_CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 - serve not running
+            with self._lock:
+                self._ctrl_retry_at = time.monotonic() + 5.0
+            return None
+        with self._lock:
+            self._ctrl = ctrl
+        return ctrl
+
+    def _call(self, verb: str, *args, timeout: float = 10.0,
+              default=None, **kwargs):
+        if self._directory is not None:
+            return getattr(self._directory, verb)(*args, **kwargs)
+        ctrl = self._controller()
+        if ctrl is None:
+            return default
+        import ray_tpu
+
+        try:
+            ref = getattr(ctrl, "lora_" + verb).remote(*args, **kwargs)
+            return ray_tpu.get(ref, timeout=timeout)
+        except Exception:  # noqa: BLE001 - controller restarting
+            with self._lock:
+                self._ctrl = None
+                self._ctrl_retry_at = time.monotonic() + 5.0
+            return None
+
+    # ----------------------------------------------------------- publish
+    def publish(self, model_id: str, adapter: dict, *,
+                tenant: str | None = None) -> dict:
+        """Seal an adapter into the object plane and register it.
+        Returns {"version", "salt"}.  The arena object is tagged per
+        tenant in the memory ledger (`ray-tpu memory` groups adapter
+        bytes by who uploaded them)."""
+        if not model_id or not isinstance(model_id, str):
+            raise ValueError(f"model_id must be a non-empty string, "
+                             f"got {model_id!r}")
+        rank = _validate_adapter(adapter)
+        meta = {"rank": rank, "nbytes": _adapter_nbytes(adapter),
+                "tenant": tenant}
+        if self._directory is not None and not _object_plane_ready():
+            # In-process directory with no object plane (unit tests):
+            # the host pytree itself is the payload.
+            ref = adapter
+        else:
+            import ray_tpu
+            from ray_tpu import memledger
+
+            with memledger.tag("lora_adapter",
+                               label=tenant or model_id):
+                ref = ray_tpu.put(adapter)
+        # Nest the ref (one-element list): top-level ObjectRef args are
+        # resolved to values before execution, which would ship the
+        # whole pytree to the controller and free the arena object —
+        # nested refs stay refs and the directory borrows them.
+        reply = self._call("publish", model_id, meta, [ref],
+                           default=None)
+        if reply is None:
+            raise RuntimeError(
+                f"adapter publish failed: no serve controller "
+                f"reachable for {model_id!r}")
+        return reply
+
+    def delete(self, model_id: str) -> bool:
+        return bool(self._call("forget", model_id, default=False))
+
+    # ------------------------------------------------------------- fetch
+    def lookup(self, model_id: str) -> dict | None:
+        """Directory metadata only ({"ref", "version", "rank",
+        "nbytes", "salt"}) — no payload pull, so a replica can check
+        version freshness for one controller round trip and skip the
+        object-plane get when the version is already resident."""
+        return self._call("lookup", model_id, default=None)
+
+    def fetch(self, model_id: str, timeout: float = 30.0) -> dict | None:
+        """Resolve + pull one adapter: {"adapter": pytree, "version",
+        "salt", "rank", "nbytes"} or None when the registry has no such
+        model id.  Pull failures raise (the caller maps them to
+        AdapterLoadError)."""
+        entry = self.lookup(model_id)
+        if entry is None:
+            return None
+        return {"adapter": resolve_entry(entry, timeout=timeout),
+                "version": entry["version"], "salt": entry["salt"],
+                "rank": entry["rank"], "nbytes": entry["nbytes"]}
+
+    def summary(self) -> dict:
+        return self._call("summary", default={}) or {}
+
+    def stats(self) -> dict:
+        return self._call("stats", default={}) or {}
+
+
+def resolve_entry(entry: dict, timeout: float = 30.0) -> dict:
+    """Pull a directory entry's adapter pytree off the object plane
+    (same-host direct-shm / cross-node streaming — the normal get
+    path).  Tests with an in-process directory and no object plane
+    publish the host pytree itself as the ref; that passes through."""
+    payload = entry["ref"]
+    from ray_tpu.object_ref import ObjectRef
+
+    if isinstance(payload, ObjectRef):
+        import ray_tpu
+
+        payload = ray_tpu.get(payload, timeout=timeout)
+    return payload
+
+
+_default_client: LoraClient | None = None
+_default_lock = threading.Lock()
+
+
+def _client() -> LoraClient:
+    global _default_client
+    with _default_lock:
+        if _default_client is None:
+            _default_client = LoraClient()
+        return _default_client
+
+
+def publish_adapter(model_id: str, adapter: dict, *,
+                    tenant: str | None = None) -> dict:
+    """Upload a LoRA adapter under `model_id` (driver-side; see
+    models/llama.init_lora_adapter for the weight format).  Returns
+    {"version", "salt"}.  Requests carrying {"model_id": ...} are then
+    served under these weights by any lora-enabled deployment."""
+    return _client().publish(model_id, adapter, tenant=tenant)
+
+
+def delete_adapter(model_id: str) -> bool:
+    """Withdraw an adapter from the registry.  Engines holding it
+    resident keep serving in-flight requests; new loads miss."""
+    return _client().delete(model_id)
+
+
+def list_adapters() -> dict:
+    """model_id -> version for every published adapter."""
+    return _client().summary()

@@ -4,18 +4,64 @@ Analog of ray: python/ray/serve/multiplex.py (_ModelMultiplexWrapper).
 A replica serving many fine-tuned variants keeps up to
 `max_num_models_per_replica` loaded, evicting least-recently-used (on TPU:
 evicting frees HBM for the incoming model's weights).
+
+Two disciplines the naive version got wrong:
+
+  - Eviction calls the model's EXPLICIT resource hooks — ``close()``,
+    else ``shutdown()`` — before dropping the reference.  A model
+    holding device memory or worker processes must not wait on GC
+    (``__del__`` still runs when the reference dies, as a backstop).
+  - Loads run OUTSIDE the state lock.  A model load is seconds of
+    checkpoint IO; serializing every request of a replica behind one
+    load stalls traffic for models that are already resident.  Racing
+    requests for the SAME model coalesce on one pending future;
+    requests for resident models proceed immediately.
+
+The replica's metrics report resident model ids (`resident_models`)
+through `get_metrics()["multiplexed"]`; the handle's summary poll feeds
+them to kv_router.choose, which routes a multiplexed request to a
+replica that already holds its model (see serve/lora.py for the
+LLM-engine flavor of the same idea).
 """
 from __future__ import annotations
 
 import asyncio
 import collections
+import contextvars
 import functools
 import inspect
+
+_STATE_PREFIX = "__serve_multiplex_"
+
+
+async def _close_model(model) -> None:
+    """Release a model's resources deterministically: the first of
+    close() / shutdown() that exists, awaited if async.  Errors are
+    swallowed — eviction must never fail the request that triggered
+    it."""
+    for name in ("close", "shutdown"):
+        fn = getattr(model, name, None)
+        if callable(fn):
+            try:
+                r = fn()
+                if inspect.isawaitable(r):
+                    await r
+            except Exception:  # noqa: BLE001 - eviction never fails
+                pass
+            return
+    # No explicit hook: legacy models relied on eager finalization at
+    # eviction time (GC order under test is not deterministic).
+    del_fn = getattr(model, "__del__", None)
+    if del_fn is not None:
+        try:
+            del_fn()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
     def wrap(f):
-        attr = f"__serve_multiplex_{f.__name__}"
+        attr = _STATE_PREFIX + f.__name__
 
         @functools.wraps(f)
         async def wrapper(self, model_id: str):
@@ -26,31 +72,73 @@ def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
             state = getattr(self, attr, None)
             if state is None:
                 state = {"models": collections.OrderedDict(),
-                         "lock": asyncio.Lock()}
+                         "lock": asyncio.Lock(), "pending": {}}
                 setattr(self, attr, state)
             models = state["models"]
+            victims = []
             async with state["lock"]:
                 if model_id in models:
                     models.move_to_end(model_id)
                     return models[model_id]
-                while len(models) >= max_num_models_per_replica:
-                    _mid, evicted = models.popitem(last=False)
-                    del_fn = getattr(evicted, "__del__", None)
-                    if del_fn is not None:
-                        try:
-                            del_fn()
-                        except Exception:  # noqa: BLE001
-                            pass
+                fut = state["pending"].get(model_id)
+                if fut is None:
+                    owner = True
+                    fut = asyncio.get_running_loop().create_future()
+                    state["pending"][model_id] = fut
+                    # Reserve capacity BEFORE loading (evicting frees
+                    # the memory the incoming model needs): in-flight
+                    # loads count against the cap too.
+                    room = max(1, max_num_models_per_replica)
+                    while models and \
+                            len(models) + len(state["pending"]) > room:
+                        victims.append(models.popitem(last=False)[1])
+                else:
+                    owner = False
+            if not owner:
+                # Coalesce on the in-flight load (its owner's failure
+                # re-raises here; a retry is a fresh request).
+                return await fut
+            try:
+                for m in victims:
+                    await _close_model(m)
                 loaded = f(self, model_id)
                 if inspect.isawaitable(loaded):
                     loaded = await loaded
+            except BaseException as e:
+                async with state["lock"]:
+                    state["pending"].pop(model_id, None)
+                if not fut.done():
+                    fut.set_exception(e)
+                    fut.exception()   # owner re-raises; mark retrieved
+                raise
+            async with state["lock"]:
+                state["pending"].pop(model_id, None)
                 models[model_id] = loaded
-                return loaded
+            if not fut.done():
+                fut.set_result(loaded)
+            return loaded
         return wrapper
 
     if func is not None:
         return wrap(func)
     return wrap
+
+
+def resident_models(instance) -> list[str]:
+    """Model ids currently loaded by any @serve.multiplexed method of
+    `instance` (resident only — in-flight loads don't count until they
+    commit).  The replica exports this through get_metrics; the handle
+    routes on it."""
+    out: list[str] = []
+    try:
+        attrs = vars(instance)
+    except TypeError:
+        return out
+    for name, state in attrs.items():
+        if name.startswith(_STATE_PREFIX) and isinstance(state, dict) \
+                and isinstance(state.get("models"), dict):
+            out.extend(state["models"].keys())
+    return out
 
 
 def get_multiplexed_model_id() -> str:
@@ -62,8 +150,6 @@ def get_multiplexed_model_id() -> str:
 def _set_current_model_id(model_id: str) -> None:
     _current_model_id.set(model_id)
 
-
-import contextvars  # noqa: E402
 
 _current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")

@@ -182,6 +182,12 @@ class StoreDirectory:
         primary refs of entries the directory evicted/forgot since —
         without this reconciliation the byte cap would bound only the
         index while the arena bytes leaked until replica shutdown."""
+        # The ref arrives nested (one-element list) when it crosses the
+        # controller RPC: a top-level ObjectRef arg would be resolved
+        # to the whole KV array before execution, making the directory
+        # hold tier-2 bytes host-side instead of a borrowed ref.
+        if isinstance(ref, list):
+            ref = ref[0]
         hashes = [int(h) for h in meta["hashes"]]
         if not hashes:
             return {"ok": False, "live": []}
@@ -524,7 +530,10 @@ class PrefixStoreClient:
                 "weight_version": version, "nbytes": nbytes,
                 "replica": self._replica_id,
                 "deployment": self._deployment}
-        reply = self._call("publish", self._app, meta, ref,
+        # Nest the ref so it survives the RPC as a ref (top-level
+        # ObjectRef args resolve to values before execution — the
+        # directory would end up holding the KV bytes themselves).
+        reply = self._call("publish", self._app, meta, [ref],
                            default=None)
         ok = bool(reply and reply.get("ok"))
         if tracing.ENABLED:
@@ -561,17 +570,22 @@ class PrefixStoreClient:
         return bool(ok)
 
     # ------------------------------------------------------------ fetch
-    def maybe_graft(self, engine, prompt: list) -> dict:
+    def maybe_graft(self, engine, prompt: list, *,
+                    salt: int = 0) -> dict:
         """The miss path (blocking; callers run it off the event loop):
         compare the local radix match against the cluster directory and
         — when the cost model approves — pull the stored prefix and
         graft it into the engine's pool.  Every failure degrades to a
-        local prefill, never fails the request."""
+        local prefill, never fails the request.  `salt` is the
+        request's adapter KV identity (serve/lora.adapter_salt): the
+        chain hashes — and with them the directory lookup and the
+        graft's radix commit — are salt-distinct, so a stored prefix
+        only ever serves the (adapter, version) that computed it."""
         from ray_tpu.serve import kv_router
 
         out = {"grafted": 0}
         page = engine.page
-        hashes = kv_router.prompt_hashes(prompt, page)
+        hashes = kv_router.prompt_hashes(prompt, page, salt)
         if not hashes:
             return out
         local_summary = engine._mgr.prefix_summary()
@@ -637,6 +651,7 @@ class PrefixStoreClient:
                         list(prompt[:depth * page]), kv,
                         kv_len=depth * page,
                         weight_version=entry.get("weight_version"),
+                        salt=salt,
                     ).result(timeout=60.0)
                 del blob, kv
             except BaseException:  # noqa: BLE001 - degrade, never fail

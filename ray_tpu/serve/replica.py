@@ -327,6 +327,17 @@ class Replica:
                 out["user_stats"] = r
             except Exception:  # noqa: BLE001 - stats must not fail probes
                 pass
+        # Resident @serve.multiplexed models, for the handle's
+        # residency routing (serve/multiplex.py; LLM engines report
+        # theirs under user_stats["lora"]["resident"] instead).
+        try:
+            from ray_tpu.serve import multiplex
+
+            mux = multiplex.resident_models(self._instance)
+            if mux:
+                out["multiplexed"] = mux
+        except Exception:  # noqa: BLE001 - metrics must not fail probes
+            pass
         return out
 
     async def check_health(self) -> bool:

@@ -21,11 +21,14 @@ from ray_tpu.serve.deployment import Application
 # init kwargs — see LLMServer).  kv_blocks is the operator-facing name
 # for the page-pool size (engine kwarg kv_pages).  role /
 # decode_deployment split an app's replicas into disaggregated
-# prefill/decode pools (see LLMServer pool roles).
+# prefill/decode pools (see LLMServer pool roles).  lora_slots /
+# lora_rank size the engine's multi-LoRA adapter banks (serve/lora.py;
+# 0 = dense-only — the static bucket every adapter must fit, per the
+# one-jitted-program invariant).
 ENGINE_CONFIG_KEYS = {"page_size", "kv_blocks", "prefix_cache",
                       "kv_preempt", "max_batch", "max_len",
                       "steps_per_sync", "role", "decode_deployment",
-                      "prefix_store"}
+                      "prefix_store", "lora_slots", "lora_rank"}
 
 ENGINE_ROLES = ("unified", "prefill", "decode")
 

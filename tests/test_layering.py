@@ -294,7 +294,7 @@ def test_prefix_store_importable_standalone():
 # ray_tpu.memledger facade (the tracing-facade shape); the
 # implementation module stays a runtime internal.
 LEDGER_TAGGED_LIBRARY_MODULES = (
-    "serve/llm.py", "serve/prefix_store.py",
+    "serve/llm.py", "serve/prefix_store.py", "serve/lora.py",
     "collective/collective.py", "collective/ring.py",
 )
 
@@ -386,3 +386,48 @@ def test_telemetry_importable_standalone(mod):
     import importlib
 
     assert importlib.import_module(mod) is not None
+
+
+# ----------------------------------- multi-LoRA serving (ISSUE 18)
+# The adapter registry must build ONLY on core primitives (objects
+# through the ray_tpu api, ObjectRef), public facades (memledger,
+# exceptions) and serve siblings (kv_router) — never _private runtime
+# internals (the generic ban in _violations() covers the negative;
+# this pins the allowed surface like the prefix-store section).
+LORA_MODULES = ("serve/lora.py",)
+
+LORA_ALLOWED_PREFIXES = (
+    "ray_tpu.serve", "ray_tpu.exceptions", "ray_tpu.failpoints",
+    "ray_tpu.tracing", "ray_tpu.object_ref", "ray_tpu.actor",
+    "ray_tpu.runtime_context", "ray_tpu.memledger",
+)
+
+
+def test_lora_is_walked_by_the_layering_scan():
+    for rel in LORA_MODULES:
+        path = os.path.join(PKG, rel)
+        assert os.path.exists(path), path
+        assert list(_imports_of(path)), f"no imports parsed in {rel}?"
+
+
+def test_lora_imports_only_core_and_public_facades():
+    bad = []
+    for rel in LORA_MODULES:
+        path = os.path.join(PKG, rel)
+        for mod, lineno in _imports_of(path):
+            if not (mod == "ray_tpu" or mod.startswith("ray_tpu.")):
+                continue
+            if mod == "ray_tpu" or any(
+                    mod == p or mod.startswith(p + ".")
+                    for p in LORA_ALLOWED_PREFIXES):
+                continue
+            bad.append(f"ray_tpu/{rel}:{lineno}: imports {mod}")
+    assert not bad, (
+        "serve/lora.py must build on core primitives and public "
+        "facades only —\n  " + "\n  ".join(bad))
+
+
+def test_lora_importable_standalone():
+    import importlib
+
+    assert importlib.import_module("ray_tpu.serve.lora") is not None

@@ -1,0 +1,625 @@
+"""Multi-LoRA model multiplexing (ISSUE 18 tentpole).
+
+Engine level: adapter banks as jit arguments (one decode program for
+ANY adapter mix), token identity vs a dense engine with the adapter
+pre-merged (greedy AND sampled), slot LRU with in-use protection, and
+salt-keyed KV (an adapter's cached prefixes are invisible to the base
+model and to every other adapter/version).
+
+Server level (in-process AdapterDirectory): the page-in miss path,
+typed AdapterLoadError rejection, the version-freshness re-page on
+re-upload (the swap-then-serve staleness contract), kill switches, and
+chaos — a fault injected at `serve.adapter_load` degrades to a clean
+rejection with the engine loop alive and kv_check() clean.
+
+Router level (injected summaries, the test_kv_router idiom): residency
+pick, cold-adapter least-loaded placement, the RAY_TPU_LORA_ROUTER
+blind arm, and capacity caps overriding residency.
+
+Debug-scale fp32 on the CPU mesh — same discipline as
+test_prefix_store.py.
+"""
+import asyncio
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq=64, remat=False, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def adapters(small):
+    import jax
+
+    from ray_tpu.models import llama
+
+    cfg, _ = small
+    return {
+        "t/a": llama.init_lora_adapter(jax.random.PRNGKey(1), cfg, 4),
+        "t/b": llama.init_lora_adapter(jax.random.PRNGKey(2), cfg, 4),
+        "t/c": llama.init_lora_adapter(jax.random.PRNGKey(3), cfg, 2,
+                                       targets=("wq", "wv")),
+    }
+
+
+def _engine(small, **kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg, params = kw.pop("cfg", None) or small[0], small[1]
+    params = kw.pop("params", params)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("kv_pages", 32)
+    kw.setdefault("steps_per_sync", 4)
+    eng = LLMEngine(cfg, params, seed=0, paged=True, **kw)
+    eng.start()
+    return eng
+
+
+PROMPT = [(i * 7 + 3) % 127 + 1 for i in range(12)]
+
+
+# ---------------------------------------------------------- registry
+def test_adapter_salt_process_stable_nonzero():
+    import subprocess
+    import sys
+
+    from ray_tpu.serve import lora
+
+    s1 = lora.adapter_salt("tenant/model", 1)
+    assert s1 != 0 and s1 == lora.adapter_salt("tenant/model", 1)
+    # Version is INSIDE the salt: a re-upload rolls every KV key over.
+    assert s1 != lora.adapter_salt("tenant/model", 2)
+    assert s1 != lora.adapter_salt("tenant/model2", 1)
+    # Fits chain_hash's signed-8-byte token encoding.
+    assert 0 < s1 < (1 << 63)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from ray_tpu.serve import lora\n"
+         "print(lora.adapter_salt('tenant/model', 1))"],
+        capture_output=True, text=True, check=True)
+    assert int(out.stdout.strip()) == s1
+
+
+def test_directory_publish_versions_and_lookup(adapters):
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    c = lora.LoraClient(directory=d)
+    r1 = c.publish("t/a", adapters["t/a"])
+    assert r1["version"] == 1
+    r2 = c.publish("t/a", adapters["t/a"])
+    assert r2["version"] == 2 and r2["salt"] != r1["salt"]
+    ent = c.lookup("t/a")
+    assert ent["version"] == 2 and ent["rank"] == 4
+    assert ent["nbytes"] > 0 and ent["salt"] == r2["salt"]
+    got = c.fetch("t/a")
+    assert got["version"] == 2 and "targets" in got["adapter"]
+    assert c.lookup("nope") is None and c.fetch("nope") is None
+    assert d.summary() == {"t/a": 2}
+    assert c.delete("t/a") and not c.delete("t/a")
+    assert d.stats()["forgotten"] == 1
+
+
+def test_directory_unwraps_nested_ref(adapters):
+    """The controller RPC ships the payload nested in a one-element
+    list (a TOP-LEVEL ObjectRef arg would be resolved to its value
+    before execution, leaving the directory holding the whole pytree
+    while the arena object dies); the directory must unwrap it so
+    lookup hands back the inner ref/payload, not the wrapper."""
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    sentinel = adapters["t/a"]
+    d.publish("t/a", {"rank": 4, "nbytes": 1, "tenant": None},
+              [sentinel])
+    ent = d.lookup("t/a")
+    assert ent["ref"] is sentinel
+    # Raw (in-process, unwrapped) publishes keep working too.
+    d.publish("t/b", {"rank": 4, "nbytes": 1, "tenant": None}, sentinel)
+    assert d.lookup("t/b")["ref"] is sentinel
+
+
+def test_publish_validates_shape_contract(adapters):
+    from ray_tpu.serve import lora
+
+    c = lora.LoraClient(directory=lora.AdapterDirectory())
+    with pytest.raises(ValueError, match="no targets"):
+        c.publish("bad", {"targets": {}})
+    with pytest.raises(ValueError, match="model_id"):
+        c.publish("", adapters["t/a"])
+    with pytest.raises(ValueError):
+        c.publish("bad", {"no": "targets"})
+
+
+# ------------------------------------------------------------- engine
+def test_token_identity_vs_merged_dense(small, adapters):
+    """The acceptance contract: adapter decode through the shared
+    banked program is token-identical to a dense engine with the
+    adapter pre-merged — greedy AND sampled (aligned request order
+    keeps the per-request sample seeds in step)."""
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    ad = adapters["t/a"]
+    e1 = _engine(small, lora_slots=2, lora_rank=4, name="banked")
+    e2 = _engine(small, params=llama.merge_lora(params, ad, cfg),
+                 name="merged")
+    try:
+        e1.load_adapter("t/a", ad)
+        for temp in (0.0, 0.8):
+            a = e1.submit(PROMPT, max_new_tokens=6, temperature=temp,
+                          model_id="t/a").result(timeout=120)
+            b = e2.submit(PROMPT, max_new_tokens=6,
+                          temperature=temp).result(timeout=120)
+            assert a["tokens"] == b["tokens"], f"temp={temp}"
+    finally:
+        e1.stop()
+        e2.stop()
+
+
+def test_mixed_batch_base_unaffected_and_salted_kv(small, adapters):
+    """One engine serves base + adapter requests in the same batch:
+    slot 0's all-zero bank rows leave base output EXACTLY what it was
+    before any adapter loaded, and the adapter's committed KV keys
+    under its salt — invisible to base-model prefix matching."""
+    eng = _engine(small, lora_slots=2, lora_rank=4, name="mix")
+    try:
+        base_before = eng.submit(
+            PROMPT, max_new_tokens=5).result(timeout=120)["tokens"]
+        eng.load_adapter("t/a", adapters["t/a"])
+        salt = eng.adapter_salt_of("t/a")
+        assert salt and eng.adapter_resident("t/a", 1)
+        futs = [eng.submit(PROMPT, max_new_tokens=5, model_id="t/a"),
+                eng.submit(PROMPT, max_new_tokens=5)]
+        adapted, base_after = [f.result(timeout=120)["tokens"]
+                               for f in futs]
+        assert base_after == base_before
+        assert adapted != base_before
+        # Radix keying: the adapter's prefix lives under its salt; the
+        # base tree holds the SAME tokens under salt 0 — disjoint.
+        m_salted = eng._mgr.match(PROMPT, salt=salt)
+        m_base = eng._mgr.match(PROMPT, salt=0)
+        assert m_salted and m_base
+        assert set(m_salted).isdisjoint(m_base)
+        eng._mgr.release(m_salted)
+        eng._mgr.release(m_base)
+        eng._mgr.check()
+    finally:
+        eng.stop()
+
+
+def test_slot_lru_eviction_and_in_use_protection(small, adapters):
+    import numpy as np
+
+    from ray_tpu.exceptions import AdapterLoadError
+
+    eng = _engine(small, lora_slots=2, lora_rank=4, name="lru")
+    try:
+        s_a = eng.load_adapter("t/a", adapters["t/a"])
+        s_b = eng.load_adapter("t/b", adapters["t/b"])
+        assert {s_a, s_b} == {1, 2}
+        # Same (model, version) re-load: no-op touch, same slot.
+        assert eng.load_adapter("t/a", adapters["t/a"]) == s_a
+        # Touch a, then load c: the LRU victim is b.
+        eng._lora_meta["t/a"]["last_used"] = time.monotonic()
+        eng._lora_meta["t/b"]["last_used"] = 0.0
+        s_c = eng.load_adapter("t/c", adapters["t/c"])
+        assert s_c == s_b
+        assert not eng.adapter_resident("t/b")
+        assert eng.adapter_resident("t/a") and eng.adapter_resident("t/c")
+        assert eng.adapter_evictions == 1
+        # In-use protection: mark both slots as decoding lanes — no
+        # candidate is evictable, the load must reject (typed), and
+        # the resident set must be untouched.
+        eng._adapters = np.asarray([s_a, s_c, 0, 0], np.int32)
+        with pytest.raises(AdapterLoadError) as ei:
+            eng.load_adapter("t/b", adapters["t/b"])
+        assert ei.value.reason == "no_free_slot"
+        assert eng.adapter_resident("t/a") and eng.adapter_resident("t/c")
+    finally:
+        eng._adapters[:] = 0
+        eng.stop()
+
+
+def test_narrow_adapter_zero_pads_to_bank_rank(small, adapters):
+    """A rank-2 adapter in a rank-4 bank: the padded rows contribute
+    exactly zero, so output matches a dense merge of the rank-2
+    adapter."""
+    from ray_tpu.models import llama
+
+    cfg, params = small
+    ad = adapters["t/c"]
+    e1 = _engine(small, lora_slots=1, lora_rank=4, name="pad")
+    e2 = _engine(small, params=llama.merge_lora(params, ad, cfg),
+                 name="padref")
+    try:
+        e1.load_adapter("t/c", ad)
+        a = e1.submit(PROMPT, max_new_tokens=5,
+                      model_id="t/c").result(timeout=120)
+        b = e2.submit(PROMPT, max_new_tokens=5).result(timeout=120)
+        assert a["tokens"] == b["tokens"]
+    finally:
+        e1.stop()
+        e2.stop()
+
+
+def test_engine_load_rejections_are_typed(small, adapters):
+    from ray_tpu.exceptions import AdapterLoadError
+
+    eng = _engine(small, lora_slots=1, lora_rank=2, name="rej")
+    try:
+        with pytest.raises(AdapterLoadError) as ei:
+            eng.load_adapter("t/a", adapters["t/a"])   # rank 4 > 2
+        assert ei.value.reason == "rank_overflow"
+        with pytest.raises(AdapterLoadError) as ei:
+            eng.load_adapter("x", {"targets": {}})
+        assert ei.value.reason == "empty"
+        with pytest.raises(AdapterLoadError) as ei:
+            eng.submit(PROMPT, model_id="x").result(timeout=60)
+        assert ei.value.reason == "not_resident"
+        # The loop survived the rejection: base traffic still serves.
+        assert eng.submit(PROMPT, max_new_tokens=3).result(
+            timeout=120)["tokens"]
+    finally:
+        eng.stop()
+
+    dense = _engine(small, name="dense")
+    try:
+        with pytest.raises(AdapterLoadError) as ei:
+            dense.submit(PROMPT, model_id="t/a")
+        assert ei.value.reason == "lora_slots=0"
+    finally:
+        dense.stop()
+
+
+# ------------------------------------------------------------- server
+def _server(small, directory, **kw):
+    from ray_tpu.serve.llm import LLMServer
+
+    cfg, params = small
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("kv_pages", 32)
+    kw.setdefault("steps_per_sync", 4)
+    kw.setdefault("lora_slots", 2)
+    kw.setdefault("lora_rank", 4)
+    return LLMServer(cfg, params=params, seed=0, paged=True,
+                     lora_directory=directory, **kw)
+
+
+def test_server_page_in_and_swap_then_serve(small, adapters):
+    """The miss path end to end, plus the staleness contract: after a
+    re-upload (version bump) the server re-pages the adapter and every
+    new KV key carries the NEW salt — v1's cached KV is unreachable,
+    never served (the weight-version filter of the tentpole)."""
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    c = lora.LoraClient(directory=d)
+    r1 = c.publish("t/a", adapters["t/a"], tenant="acme")
+    srv = _server(small, d)
+    try:
+        eng = srv.engine
+        out = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 5,
+                               "model_id": "t/a"}))
+        assert out["tokens"]
+        assert eng.adapter_resident("t/a", 1)
+        assert eng.adapter_salt_of("t/a") == r1["salt"]
+        m1 = eng._mgr.match(PROMPT, salt=r1["salt"])
+        assert m1
+        eng._mgr.release(m1)
+
+        r2 = c.publish("t/a", adapters["t/a"], tenant="acme")
+        srv._lora_seen.clear()       # expire the freshness TTL
+        out2 = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 5,
+                                "model_id": "t/a"}))
+        # Same weights re-published: tokens identical, identity new.
+        assert out2["tokens"] == out["tokens"]
+        assert eng.adapter_resident("t/a", 2)
+        assert eng.adapter_salt_of("t/a") == r2["salt"] != r1["salt"]
+        assert eng.adapter_loads == 2
+        m2 = eng._mgr.match(PROMPT, salt=r2["salt"])
+        assert m2
+        eng._mgr.release(m2)
+        st = srv.stats()
+        assert st["lora"]["resident"]["t/a"]["version"] == 2
+    finally:
+        srv.shutdown()
+    srv.kv_check()
+
+
+def test_server_kill_switch_serves_base(small, adapters,
+                                        monkeypatch):
+    """RAY_TPU_LORA=0 (read per request): a model_id request serves
+    the base model — greedy-identical to a no-model_id request — and
+    nothing pages in.  Same-run flip back restores adapter serving."""
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    lora.LoraClient(directory=d).publish("t/a", adapters["t/a"])
+    srv = _server(small, d)
+    try:
+        base = asyncio.run(srv({"prompt": PROMPT,
+                                "max_new_tokens": 5}))["tokens"]
+        monkeypatch.setenv("RAY_TPU_LORA", "0")
+        off = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 5,
+                               "model_id": "t/a"}))["tokens"]
+        assert off == base
+        assert not srv.engine.adapter_resident("t/a")
+        monkeypatch.delenv("RAY_TPU_LORA")
+        on = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 5,
+                              "model_id": "t/a"}))["tokens"]
+        assert on != base
+        assert srv.engine.adapter_resident("t/a")
+    finally:
+        srv.shutdown()
+    srv.kv_check()
+
+
+def test_server_missing_adapter_rejects_typed(small):
+    from ray_tpu.exceptions import AdapterLoadError
+    from ray_tpu.serve import lora
+
+    srv = _server(small, lora.AdapterDirectory())
+    try:
+        with pytest.raises(AdapterLoadError) as ei:
+            asyncio.run(srv({"prompt": PROMPT, "model_id": "ghost"}))
+        assert ei.value.reason == "not_published"
+        assert srv.adapter_load_errors == 1
+        assert srv.stats()["lora"]["load_errors"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_server_stream_path_serves_adapter(small, adapters):
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    lora.LoraClient(directory=d).publish("t/a", adapters["t/a"])
+    srv = _server(small, d)
+    try:
+        toks = list(srv.stream({"prompt": PROMPT, "max_new_tokens": 4,
+                                "model_id": "t/a"}))
+        assert len(toks) == 4
+        assert srv.engine.adapter_resident("t/a")
+    finally:
+        srv.shutdown()
+
+
+def test_admission_eviction_race_repages_and_resubmits(small, adapters):
+    """The thrash window (adapters >> slots): a concurrent tenant's
+    load evicts an adapter AFTER the server's page-in but BEFORE the
+    engine loop admits the request.  The server re-pages and resubmits
+    (bounded) — the client sees one successful response, never a
+    not_resident error."""
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    c = lora.LoraClient(directory=d)
+    c.publish("t/a", adapters["t/a"])
+    c.publish("t/b", adapters["t/b"])
+    srv = _server(small, d, lora_slots=1)
+    eng = srv.engine
+    real_submit = eng.submit
+    try:
+        want = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 4,
+                                "model_id": "t/b"}))["tokens"]
+        srv._lora_seen.clear()
+        raced = []
+
+        def submit(*a, **kw):
+            if kw.get("model_id") == "t/b" and not raced:
+                raced.append(1)
+                # The concurrent tenant: steals the ONE slot between
+                # the server's page-in and this request's admission.
+                eng.load_adapter("t/a", adapters["t/a"])
+            return real_submit(*a, **kw)
+
+        eng.submit = submit
+        out = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 4,
+                               "model_id": "t/b"}))
+        assert out["tokens"] == want
+        assert srv.adapter_admit_retries == 1
+        assert srv.stats()["lora"]["admit_retries"] == 1
+        assert eng.adapter_resident("t/b")
+    finally:
+        eng.submit = real_submit
+        srv.shutdown()
+    srv.kv_check()
+
+
+# -------------------------------------------------------------- chaos
+def test_adapter_load_fault_degrades_to_rejection(small, adapters):
+    """serve.adapter_load chaos: an injected fault on the page-in leg
+    fails ONE request with the typed error — the engine loop survives,
+    the radix pool leaks nothing, and recovery is immediate once
+    disarmed."""
+    from ray_tpu._private import failpoints
+    from ray_tpu.exceptions import AdapterLoadError
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    lora.LoraClient(directory=d).publish("t/a", adapters["t/a"])
+    srv = _server(small, d)
+    try:
+        failpoints.configure("serve.adapter_load=nth:1+error")
+        with pytest.raises(AdapterLoadError) as ei:
+            asyncio.run(srv({"prompt": PROMPT, "model_id": "t/a"}))
+        assert ei.value.reason == "load_failed"
+        assert srv.adapter_load_errors == 1
+        # Loop alive: base traffic unaffected, then the SAME adapter
+        # request succeeds once the fault clears.
+        assert asyncio.run(srv({"prompt": PROMPT,
+                                "max_new_tokens": 3}))["tokens"]
+        out = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 3,
+                               "model_id": "t/a"}))
+        assert out["tokens"]
+        srv.engine._mgr.check()
+    finally:
+        failpoints.reset()
+        srv.shutdown()
+    srv.kv_check()
+
+
+def test_adapter_swap_fault_leaves_resident_set_intact(small,
+                                                       adapters):
+    """serve.adapter_swap fires BEFORE the eviction mutates anything:
+    an injected fault rejects the incoming load and every resident
+    adapter still serves."""
+    from ray_tpu._private import failpoints
+    from ray_tpu.serve import lora
+
+    d = lora.AdapterDirectory()
+    c = lora.LoraClient(directory=d)
+    for mid in ("t/a", "t/b", "t/c"):
+        c.publish(mid, adapters[mid])
+    srv = _server(small, d)
+    try:
+        for mid in ("t/a", "t/b"):     # fill both slots
+            asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 2,
+                             "model_id": mid}))
+        failpoints.configure("serve.adapter_swap=error")
+        from ray_tpu.exceptions import AdapterLoadError
+
+        with pytest.raises(AdapterLoadError):
+            asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 2,
+                             "model_id": "t/c"}))
+        eng = srv.engine
+        assert eng.adapter_resident("t/a") and eng.adapter_resident("t/b")
+        assert not eng.adapter_resident("t/c")
+        failpoints.reset()
+        srv._lora_seen.clear()
+        out = asyncio.run(srv({"prompt": PROMPT, "max_new_tokens": 2,
+                               "model_id": "t/c"}))
+        assert out["tokens"] and eng.adapter_resident("t/c")
+    finally:
+        failpoints.reset()
+        srv.shutdown()
+    srv.kv_check()
+
+
+# ------------------------------------------------------------- router
+def _fake_handle(summaries, inflight, residency,
+                 replicas=("a", "b"), max_ongoing=0):
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h = DeploymentHandle("dep", "app", "ctrl-id")
+    h._replicas = list(replicas)
+    h._handles = {r: object() for r in replicas}
+    h._inflight = dict(inflight)
+    h._max_ongoing = max_ongoing
+    h._summaries = summaries
+    h._residency = residency
+    return h
+
+
+def test_choose_residency_beats_queue_and_cold_goes_least_loaded():
+    from ray_tpu.serve import kv_router, lora
+
+    salt = lora.adapter_salt("m", 1)
+    res = {"a": {"m": {"salt": salt, "version": 1, "age": 0.1}}}
+    # Resident replica wins even somewhat loaded (beta bonus).
+    assert kv_router.choose(PROMPT, ["a", "b"], {"a": 3, "b": 0}, {},
+                            model_id="m", residency=res) == "a"
+    # Cold adapter: deterministic least-loaded, NOT every replica.
+    got = kv_router.choose(PROMPT, ["a", "b"], {"a": 2, "b": 1}, {},
+                           model_id="ghost", residency=res)
+    assert got == "b"
+    explain = {}
+    kv_router.choose(PROMPT, ["a", "b"], {}, {}, explain=explain,
+                     model_id="ghost", residency=res)
+    assert explain.get("lora_cold") is True
+    # Plain multiplexed entries (True, no salt) also count.
+    res2 = {"b": {"m": True}}
+    assert kv_router.choose(None, ["a", "b"], {}, {},
+                            model_id="m", residency=res2) == "b"
+
+
+def test_choose_salted_prefix_depth_only_for_resident(small):
+    """A resident candidate's radix summary matches under the
+    adapter's salt; a non-resident candidate's BASE-model summary of
+    the same tokens scores zero — base KV cannot serve the adapter."""
+    from ray_tpu.serve import kv_router, lora
+
+    salt = lora.adapter_salt("m", 1)
+    page = 4
+    salted = {"page": page,
+              "hashes": kv_router.prompt_hashes(PROMPT, page, salt),
+              "digest": 1}
+    plain = {"page": page,
+             "hashes": kv_router.prompt_hashes(PROMPT, page),
+             "digest": 2}
+    summaries = {"a": kv_router.compile_summary(salted),
+                 "b": kv_router.compile_summary(plain)}
+    res = {"a": {"m": {"salt": salt, "version": 1, "age": 0.0}},
+           "b": {"m": {"salt": salt, "version": 1, "age": 0.0}}}
+    explain = {}
+    got = kv_router.choose(PROMPT, ["a", "b"], {}, summaries,
+                           explain=explain, model_id="m",
+                           residency=res)
+    assert got == "a" and explain["cache_depth"] > 0
+
+
+def test_handle_pick_residency_and_kill_switches(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_LORA", raising=False)
+    monkeypatch.delenv("RAY_TPU_LORA_ROUTER", raising=False)
+    res = {"b": {"m": {"salt": 7, "version": 1, "age": 0.0}}}
+    h = _fake_handle({}, {"a": 0, "b": 1}, res)
+    for _ in range(5):
+        rid, _ = h._pick(prompt=PROMPT, model_id="m")
+        assert rid == "b"          # resident despite deeper queue
+        h._done(rid)
+    # Blind arm: residency scoring off → pow-2 picks the idle one.
+    monkeypatch.setenv("RAY_TPU_LORA_ROUTER", "0")
+    rid, _ = h._pick(prompt=PROMPT, model_id="m")
+    assert rid == "a"
+    h._done(rid)
+    # Master kill switch behaves the same.
+    monkeypatch.delenv("RAY_TPU_LORA_ROUTER")
+    monkeypatch.setenv("RAY_TPU_LORA", "0")
+    rid, _ = h._pick(prompt=PROMPT, model_id="m")
+    assert rid == "a"
+
+
+def test_handle_pick_capacity_overrides_residency(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_LORA", raising=False)
+    monkeypatch.delenv("RAY_TPU_LORA_ROUTER", raising=False)
+    res = {"b": {"m": {"salt": 7, "version": 1, "age": 0.0}}}
+    h = _fake_handle({}, {"a": 0, "b": 2}, res, max_ongoing=2)
+    rid, _ = h._pick(prompt=PROMPT, model_id="m")
+    assert rid == "a"              # b resident but at its cap
+    h._inflight["b"] = 1
+    rid2, _ = h._pick(prompt=PROMPT, model_id="m")
+    assert rid2 == "b"
+
+
+def test_compile_residency_from_replica_metrics():
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    h = DeploymentHandle("dep", "app", "ctrl-id")
+    reps = {
+        "r1": {"user_stats": {"lora": {"resident": {
+            "m": {"salt": 9, "version": 2, "age": 1.0}}}}},
+        "r2": {"multiplexed": ["x", "y"]},
+        "r3": {"user_stats": {}},
+        "r4": "garbage",
+    }
+    res = h._compile_residency(reps)
+    assert res["r1"]["m"]["salt"] == 9
+    assert res["r2"] == {"x": True, "y": True}
+    assert "r3" not in res and "r4" not in res

@@ -1,0 +1,274 @@
+"""@serve.multiplexed per-replica model LRU (ISSUE 18 satellite).
+
+The two disciplines the rewrite added, proven directly: eviction calls
+the victim's EXPLICIT close()/shutdown() hook (never waits on GC), and
+loads run OUTSIDE the state lock — resident models serve while a slow
+load is in flight, different models load concurrently, and racing
+requests for the SAME model coalesce on one pending load.  Plus the
+contextvar identity (`get_multiplexed_model_id` across interleaved
+async requests) and the residency export the router scores.
+"""
+import asyncio
+
+import pytest
+
+from ray_tpu.serve import multiplex
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                     multiplexed, resident_models)
+
+
+class FakeModel:
+    def __init__(self, mid, journal):
+        self.mid = mid
+        self.journal = journal
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+        self.journal.append(("close", self.mid))
+
+
+class ShutdownOnly:
+    def __init__(self, mid, journal):
+        self.mid = mid
+        self.journal = journal
+
+    def shutdown(self):
+        self.journal.append(("shutdown", self.mid))
+
+
+def test_lru_eviction_order_and_close_hook():
+    journal = []
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            journal.append(("load", model_id))
+            return FakeModel(model_id, journal)
+
+    async def run():
+        r = Replica()
+        a = await r.get_model("a")
+        await r.get_model("b")
+        # Touch a: b becomes the LRU victim when c arrives.
+        assert await r.get_model("a") is a
+        assert journal.count(("load", "a")) == 1   # cache hit, no reload
+        await r.get_model("c")
+        assert ("close", "b") in journal
+        assert not a.closed
+        assert resident_models(r) == ["a", "c"]
+        # And the eviction is ordered: b closed BEFORE c's load ran.
+        assert journal.index(("close", "b")) < journal.index(("load", "c"))
+        await r.get_model("b")     # a is now LRU
+        assert ("close", "a") in journal and a.closed
+        assert resident_models(r) == ["c", "b"]
+
+    asyncio.run(run())
+
+
+def test_shutdown_fallback_and_del_backstop():
+    journal = []
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=1)
+        async def get_model(self, model_id: str):
+            if model_id.startswith("s"):
+                return ShutdownOnly(model_id, journal)
+            return FakeModel(model_id, journal)
+
+    async def run():
+        r = Replica()
+        await r.get_model("s1")
+        await r.get_model("m1")       # evicts s1 via shutdown()
+        assert ("shutdown", "s1") in journal
+        await r.get_model("s2")       # evicts m1 via close()
+        assert ("close", "m1") in journal
+
+    asyncio.run(run())
+
+
+def test_eviction_errors_never_fail_the_request():
+    class Angry:
+        def close(self):
+            raise RuntimeError("device wedged")
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=1)
+        async def get_model(self, model_id: str):
+            return Angry()
+
+    async def run():
+        r = Replica()
+        await r.get_model("a")
+        assert await r.get_model("b") is not None   # close() raised
+        assert resident_models(r) == ["b"]
+
+    asyncio.run(run())
+
+
+def test_same_model_coalesces_one_load():
+    loads = []
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            loads.append(model_id)
+            await asyncio.sleep(0.05)
+            return object()
+
+    async def run():
+        r = Replica()
+        got = await asyncio.gather(*[r.get_model("hot")
+                                     for _ in range(8)])
+        assert loads == ["hot"]                  # ONE load
+        assert all(g is got[0] for g in got)     # everyone shares it
+
+    asyncio.run(run())
+
+
+def test_different_models_load_concurrently_and_hits_skip_lock():
+    """Loads run OUTSIDE the lock: two different models' loads overlap
+    in time, and a request for a RESIDENT model completes while a slow
+    load is still parked."""
+    class Replica:
+        def __init__(self):
+            self.entered = {}
+            self.release = {}
+
+        @multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            self.entered[model_id].set()
+            await self.release[model_id].wait()
+            return model_id + "-loaded"
+
+    async def run():
+        r = Replica()
+        for m in ("a", "b"):
+            r.entered[m] = asyncio.Event()
+            r.release[m] = asyncio.Event()
+        ta = asyncio.ensure_future(r.get_model("a"))
+        tb = asyncio.ensure_future(r.get_model("b"))
+        # BOTH loads entered — neither waits on the other's completion.
+        await asyncio.wait_for(r.entered["a"].wait(), 5)
+        await asyncio.wait_for(r.entered["b"].wait(), 5)
+        # Resident fast path while both loads are still in flight.
+        r.release["a"].set()
+        assert await ta == "a-loaded"
+        assert await asyncio.wait_for(r.get_model("a"), 5) == "a-loaded"
+        assert not tb.done()
+        r.release["b"].set()
+        assert await tb == "b-loaded"
+
+    asyncio.run(run())
+
+
+def test_inflight_loads_count_against_capacity():
+    """Capacity is reserved BEFORE the load runs: a slow in-flight load
+    plus a new request at cap evicts the resident model, never
+    overshoots the cap."""
+    journal = []
+
+    class Replica:
+        def __init__(self):
+            self.gate = None
+
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            if self.gate is not None:
+                await self.gate.wait()
+            return FakeModel(model_id, journal)
+
+    async def run():
+        r = Replica()
+        await r.get_model("a")
+        await r.get_model("b")
+        r.gate = asyncio.Event()
+        tc = asyncio.ensure_future(r.get_model("c"))
+        await asyncio.sleep(0.01)
+        # The pending load already reserved a slot: a (LRU) is out.
+        assert ("close", "a") in journal
+        r.gate.set()
+        await tc
+        assert sorted(resident_models(r)) == ["b", "c"]
+
+    asyncio.run(run())
+
+
+def test_owner_failure_propagates_to_coalesced_waiters():
+    attempts = []
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            attempts.append(model_id)
+            await asyncio.sleep(0.02)
+            if len(attempts) == 1:
+                raise RuntimeError("checkpoint corrupt")
+            return "ok"
+
+    async def run():
+        r = Replica()
+        res = await asyncio.gather(
+            *[r.get_model("m") for _ in range(3)],
+            return_exceptions=True)
+        assert all(isinstance(x, RuntimeError) for x in res)
+        # The failed load left NO residue: a retry is a fresh load.
+        assert resident_models(r) == []
+        assert await r.get_model("m") == "ok"
+        assert attempts == ["m", "m"]
+
+    asyncio.run(run())
+
+
+def test_model_id_contextvar_across_interleaved_requests():
+    """get_multiplexed_model_id() must answer per-REQUEST under
+    interleaved async execution — a process-global would bleed one
+    request's model id into another's handler."""
+    seen = {}
+
+    class Replica:
+        @multiplexed(max_num_models_per_replica=4)
+        async def get_model(self, model_id: str):
+            await asyncio.sleep(0.01)
+            return model_id
+
+        async def handle(self, model_id):
+            await self.get_model(model_id)
+            await asyncio.sleep(0.01)
+            seen[model_id] = get_multiplexed_model_id()
+            return get_multiplexed_model_id()
+
+    async def run():
+        r = Replica()
+        out = await asyncio.gather(*[r.handle(f"m{i}")
+                                     for i in range(4)])
+        assert out == [f"m{i}" for i in range(4)]
+        assert seen == {f"m{i}": f"m{i}" for i in range(4)}
+
+    asyncio.run(run())
+
+
+def test_sync_loader_supported():
+    class Replica:
+        @multiplexed(max_num_models_per_replica=1)
+        def get_model(self, model_id: str):   # plain def loader
+            return model_id.upper()
+
+    async def run():
+        r = Replica()
+        assert await r.get_model("a") == "A"
+        assert await r.get_model("a") == "A"
+        assert resident_models(r) == ["a"]
+
+    asyncio.run(run())
+
+
+def test_resident_models_ignores_foreign_state():
+    class Thing:
+        pass
+
+    t = Thing()
+    t.__serve_multiplex_get_model = {"models": {"x": 1}, "pending": {}}
+    t.unrelated = {"models": "not-a-dict"}
+    assert resident_models(t) == ["x"]
+    assert resident_models(object()) == []
